@@ -1,0 +1,29 @@
+"""karpenter_tpu — a TPU-native node-autoscaling framework.
+
+A ground-up re-implementation of the capabilities of Karpenter core
+(sigs.k8s.io/karpenter, mirrored read-only at /root/reference) in which the two
+compute-heavy search cores — the provisioning scheduler's first-fit-decreasing
+bin-pack (reference: pkg/controllers/provisioning/scheduling/scheduler.go:140)
+and the disruption controller's consolidation search (reference:
+pkg/controllers/disruption/) — are executed as JAX/XLA kernels on TPU: pods and
+instance types become resource / label-mask tensors, requirement intersection
+becomes a vmapped boolean kernel, and thousands of consolidation candidates are
+scored in one batched, mesh-sharded solve.
+
+Layer map (mirrors SURVEY.md §1):
+  apis/           NodePool / NodeClaim / k8s-ish object model (L0)
+  scheduling/     host-side requirements algebra, taints, host ports (L1)
+  cloudprovider/  CloudProvider SPI + fake provider (L2)
+  state/          cluster state cache (L3)
+  provisioning/   provisioner + scheduler orchestration (L4)
+  disruption/     consolidation / drift / expiration engine (L5)
+  lifecycle/      nodeclaim & node lifecycle controllers (L6)
+  operator/       controller runtime shell (L7)
+  ops/            JAX kernels: mask algebra, packing, FFD scan
+  solver/         tensor codec + solver backends (oracle / jax)
+  models/         tensorized problem model (struct-of-arrays)
+  parallel/       device mesh sharding of candidate batches
+  metrics/, events/, utils/, kube/   cross-cutting
+"""
+
+__version__ = "0.1.0"

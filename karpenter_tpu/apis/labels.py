@@ -1,0 +1,122 @@
+"""Well-known labels, restricted domains, and normalization.
+
+Equivalent of reference pkg/apis/v1beta1/labels.go:17-140, re-homed under the
+``karpenter.tpu`` group.
+"""
+
+from __future__ import annotations
+
+GROUP = "karpenter.tpu"
+COMPATIBILITY_GROUP = "compatibility." + GROUP
+
+# architecture / capacity-type values (labels.go:28-33)
+ARCHITECTURE_AMD64 = "amd64"
+ARCHITECTURE_ARM64 = "arm64"
+CAPACITY_TYPE_SPOT = "spot"
+CAPACITY_TYPE_ON_DEMAND = "on-demand"
+
+# upstream k8s labels
+LABEL_HOSTNAME = "kubernetes.io/hostname"
+LABEL_TOPOLOGY_ZONE = "topology.kubernetes.io/zone"
+LABEL_TOPOLOGY_REGION = "topology.kubernetes.io/region"
+LABEL_INSTANCE_TYPE_STABLE = "node.kubernetes.io/instance-type"
+LABEL_ARCH_STABLE = "kubernetes.io/arch"
+LABEL_OS_STABLE = "kubernetes.io/os"
+LABEL_WINDOWS_BUILD = "node.kubernetes.io/windows-build"
+LABEL_NODE_EXCLUDE_DISRUPTION = "node.kubernetes.io/exclude-from-external-load-balancers"
+
+# deprecated aliases
+LABEL_FAILURE_DOMAIN_BETA_ZONE = "failure-domain.beta.kubernetes.io/zone"
+LABEL_FAILURE_DOMAIN_BETA_REGION = "failure-domain.beta.kubernetes.io/region"
+LABEL_INSTANCE_TYPE_BETA = "beta.kubernetes.io/instance-type"
+LABEL_ARCH_BETA = "beta.kubernetes.io/arch"
+LABEL_OS_BETA = "beta.kubernetes.io/os"
+
+# framework-specific labels (labels.go:36-41)
+NODEPOOL_LABEL_KEY = GROUP + "/nodepool"
+NODE_INITIALIZED_LABEL_KEY = GROUP + "/initialized"
+NODE_REGISTERED_LABEL_KEY = GROUP + "/registered"
+CAPACITY_TYPE_LABEL_KEY = GROUP + "/capacity-type"
+
+# annotations (labels.go:44-49)
+DO_NOT_DISRUPT_ANNOTATION_KEY = GROUP + "/do-not-disrupt"
+MANAGED_BY_ANNOTATION_KEY = GROUP + "/managed-by"
+NODEPOOL_HASH_ANNOTATION_KEY = GROUP + "/nodepool-hash"
+
+# finalizers (labels.go:52-54)
+TERMINATION_FINALIZER = GROUP + "/termination"
+
+# the disruption taint (reference pkg/apis/v1beta1/taints.go)
+DISRUPTION_TAINT_KEY = GROUP + "/disruption"
+DISRUPTING_NO_SCHEDULE_TAINT_VALUE = "disrupting"
+
+# well-known kubelet ephemeral taints (reference pkg/scheduling/taints.go:28-32)
+TAINT_NODE_NOT_READY = "node.kubernetes.io/not-ready"
+TAINT_NODE_UNREACHABLE = "node.kubernetes.io/unreachable"
+TAINT_EXTERNAL_CLOUD_PROVIDER = "node.cloudprovider.kubernetes.io/uninitialized"
+TAINT_NODE_UNSCHEDULABLE = "node.kubernetes.io/unschedulable"
+
+RESTRICTED_LABEL_DOMAINS = frozenset({
+    "kubernetes.io",
+    "k8s.io",
+    GROUP,
+})
+
+LABEL_DOMAIN_EXCEPTIONS = frozenset({
+    "kops.k8s.io",
+    "node.kubernetes.io",
+    "node-restriction.kubernetes.io",
+})
+
+WELL_KNOWN_LABELS = frozenset({
+    NODEPOOL_LABEL_KEY,
+    LABEL_TOPOLOGY_ZONE,
+    LABEL_TOPOLOGY_REGION,
+    LABEL_INSTANCE_TYPE_STABLE,
+    LABEL_ARCH_STABLE,
+    LABEL_OS_STABLE,
+    CAPACITY_TYPE_LABEL_KEY,
+    LABEL_WINDOWS_BUILD,
+})
+
+RESTRICTED_LABELS = frozenset({LABEL_HOSTNAME})
+
+# aliased label keys normalized into their stable forms (labels.go:94-100)
+NORMALIZED_LABELS = {
+    LABEL_FAILURE_DOMAIN_BETA_ZONE: LABEL_TOPOLOGY_ZONE,
+    LABEL_FAILURE_DOMAIN_BETA_REGION: LABEL_TOPOLOGY_REGION,
+    LABEL_INSTANCE_TYPE_BETA: LABEL_INSTANCE_TYPE_STABLE,
+    LABEL_ARCH_BETA: LABEL_ARCH_STABLE,
+    LABEL_OS_BETA: LABEL_OS_STABLE,
+}
+
+
+def get_label_domain(key: str) -> str:
+    if "/" in key:
+        return key.split("/", 1)[0]
+    return ""
+
+
+def is_restricted_node_label(key: str) -> bool:
+    """True if this label must not be injected on nodes by the framework
+    (labels.go:117-133)."""
+    if key in WELL_KNOWN_LABELS:
+        return True
+    domain = get_label_domain(key)
+    if any(domain.endswith(exc) for exc in LABEL_DOMAIN_EXCEPTIONS):
+        return False
+    if any(domain.endswith(rest) for rest in RESTRICTED_LABEL_DOMAINS):
+        return True
+    return key in RESTRICTED_LABELS
+
+
+def is_restricted_label(key: str) -> str | None:
+    """Return an error string if the label is restricted (labels.go:104-112)."""
+    if key in WELL_KNOWN_LABELS:
+        return None
+    if is_restricted_node_label(key):
+        return (
+            f"label {key} is restricted; specify a well known label "
+            f"or a custom label that does not use a restricted domain"
+        )
+    return None

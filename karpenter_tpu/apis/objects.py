@@ -1,0 +1,392 @@
+"""Minimal k8s-style object model.
+
+The reference consumes k8s.io/api types directly; we carry a lightweight,
+dependency-free equivalent with just the fields the framework reads:
+Pod (node selector, affinity, topology spread, tolerations, requests, ports),
+Node (labels, taints, capacity/allocatable), plus the small supporting structs.
+
+All objects are plain mutable dataclasses so the fake kube API (kube/) can act
+like an apiserver over them.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time as _time
+import uuid
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+# -- metadata -----------------------------------------------------------------
+
+_creation_counter = itertools.count()
+
+
+@dataclass
+class ObjectMeta:
+    name: str = ""
+    namespace: str = "default"
+    uid: str = field(default_factory=lambda: str(uuid.uuid4()))
+    labels: Dict[str, str] = field(default_factory=dict)
+    annotations: Dict[str, str] = field(default_factory=dict)
+    finalizers: List[str] = field(default_factory=list)
+    owner_references: List["OwnerReference"] = field(default_factory=list)
+    creation_timestamp: float = field(default_factory=_time.time)
+    # Monotonic tiebreaker: k8s creation timestamps have 1s resolution, so the
+    # reference falls back to UID ordering (queue.go:104-110); we keep a strict
+    # creation sequence instead for deterministic test behavior.
+    creation_seq: int = field(default_factory=lambda: next(_creation_counter))
+    deletion_timestamp: Optional[float] = None
+    resource_version: int = 0
+    generation: int = 0
+
+
+@dataclass
+class OwnerReference:
+    kind: str = ""
+    name: str = ""
+    uid: str = ""
+    controller: bool = False
+    block_owner_deletion: bool = False
+
+
+# -- taints / tolerations -----------------------------------------------------
+
+NO_SCHEDULE = "NoSchedule"
+PREFER_NO_SCHEDULE = "PreferNoSchedule"
+NO_EXECUTE = "NoExecute"
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    effect: str = NO_SCHEDULE
+    value: str = ""
+
+    def match(self, other: "Taint") -> bool:
+        """Same key and effect (k8s Taint.MatchTaint)."""
+        return self.key == other.key and self.effect == other.effect
+
+
+@dataclass(frozen=True)
+class Toleration:
+    key: str = ""
+    operator: str = "Equal"  # Equal | Exists
+    value: str = ""
+    effect: str = ""  # "" tolerates all effects
+    toleration_seconds: Optional[float] = None
+
+    def tolerates(self, taint: Taint) -> bool:
+        """k8s Toleration.ToleratesTaint semantics: effect must match (empty
+        tolerates all), key must match (empty key + Exists tolerates all), and
+        for Equal the values must match."""
+        if self.effect and self.effect != taint.effect:
+            return False
+        if self.key and self.key != taint.key:
+            return False
+        if self.operator == "Exists":
+            return True
+        return self.value == taint.value
+
+
+# -- node selectors / affinity ------------------------------------------------
+
+IN = "In"
+NOT_IN = "NotIn"
+EXISTS = "Exists"
+DOES_NOT_EXIST = "DoesNotExist"
+GT = "Gt"
+LT = "Lt"
+
+
+@dataclass(frozen=True)
+class NodeSelectorRequirement:
+    key: str
+    operator: str
+    values: tuple = ()
+
+    def __init__(self, key: str, operator: str, values=()):
+        object.__setattr__(self, "key", key)
+        object.__setattr__(self, "operator", operator)
+        object.__setattr__(self, "values", tuple(values))
+
+
+@dataclass
+class NodeSelectorTerm:
+    match_expressions: List[NodeSelectorRequirement] = field(default_factory=list)
+
+
+@dataclass
+class PreferredSchedulingTerm:
+    weight: int
+    preference: NodeSelectorTerm
+
+
+@dataclass
+class NodeAffinity:
+    required: List[NodeSelectorTerm] = field(default_factory=list)  # OR of terms
+    preferred: List[PreferredSchedulingTerm] = field(default_factory=list)
+
+
+@dataclass
+class LabelSelectorRequirement:
+    key: str
+    operator: str  # In | NotIn | Exists | DoesNotExist
+    values: List[str] = field(default_factory=list)
+
+
+@dataclass
+class LabelSelector:
+    match_labels: Dict[str, str] = field(default_factory=dict)
+    match_expressions: List[LabelSelectorRequirement] = field(default_factory=list)
+
+    def matches(self, labels: Dict[str, str]) -> bool:
+        for k, v in self.match_labels.items():
+            if labels.get(k) != v:
+                return False
+        for expr in self.match_expressions:
+            val = labels.get(expr.key)
+            if expr.operator == IN:
+                if val is None or val not in expr.values:
+                    return False
+            elif expr.operator == NOT_IN:
+                if val is not None and val in expr.values:
+                    return False
+            elif expr.operator == EXISTS:
+                if val is None:
+                    return False
+            elif expr.operator == DOES_NOT_EXIST:
+                if val is not None:
+                    return False
+            else:
+                return False
+        return True
+
+    def is_empty(self) -> bool:
+        return not self.match_labels and not self.match_expressions
+
+
+@dataclass
+class PodAffinityTerm:
+    topology_key: str
+    label_selector: Optional[LabelSelector] = None
+    namespaces: List[str] = field(default_factory=list)
+    namespace_selector: Optional[LabelSelector] = None
+
+
+@dataclass
+class WeightedPodAffinityTerm:
+    weight: int
+    pod_affinity_term: PodAffinityTerm
+
+
+@dataclass
+class PodAffinity:
+    required: List[PodAffinityTerm] = field(default_factory=list)
+    preferred: List[WeightedPodAffinityTerm] = field(default_factory=list)
+
+
+@dataclass
+class PodAntiAffinity:
+    required: List[PodAffinityTerm] = field(default_factory=list)
+    preferred: List[WeightedPodAffinityTerm] = field(default_factory=list)
+
+
+@dataclass
+class Affinity:
+    node_affinity: Optional[NodeAffinity] = None
+    pod_affinity: Optional[PodAffinity] = None
+    pod_anti_affinity: Optional[PodAntiAffinity] = None
+
+
+DO_NOT_SCHEDULE = "DoNotSchedule"
+SCHEDULE_ANYWAY = "ScheduleAnyway"
+
+
+@dataclass
+class TopologySpreadConstraint:
+    max_skew: int
+    topology_key: str
+    when_unsatisfiable: str = DO_NOT_SCHEDULE
+    label_selector: Optional[LabelSelector] = None
+    min_domains: Optional[int] = None
+
+
+# -- pods ---------------------------------------------------------------------
+
+
+@dataclass
+class ContainerPort:
+    container_port: int = 0
+    host_port: int = 0
+    host_ip: str = ""
+    protocol: str = "TCP"
+
+
+@dataclass
+class Container:
+    name: str = "app"
+    requests: Dict[str, float] = field(default_factory=dict)
+    limits: Dict[str, float] = field(default_factory=dict)
+    ports: List[ContainerPort] = field(default_factory=list)
+
+
+@dataclass
+class PersistentVolumeClaimVolume:
+    claim_name: str = ""
+
+
+@dataclass
+class EphemeralVolume:
+    storage_class_name: Optional[str] = None
+    access_modes: List[str] = field(default_factory=list)
+
+
+@dataclass
+class Volume:
+    name: str = ""
+    persistent_volume_claim: Optional[PersistentVolumeClaimVolume] = None
+    ephemeral: Optional[EphemeralVolume] = None
+
+
+@dataclass
+class PodSpec:
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    affinity: Optional[Affinity] = None
+    tolerations: List[Toleration] = field(default_factory=list)
+    topology_spread_constraints: List[TopologySpreadConstraint] = field(default_factory=list)
+    containers: List[Container] = field(default_factory=list)
+    init_containers: List[Container] = field(default_factory=list)
+    volumes: List[Volume] = field(default_factory=list)
+    node_name: str = ""
+    priority: Optional[int] = None
+    priority_class_name: str = ""
+    preemption_policy: str = ""
+    overhead: Dict[str, float] = field(default_factory=dict)
+    termination_grace_period_seconds: Optional[float] = None
+
+
+@dataclass
+class PodCondition:
+    type: str
+    status: str = "True"
+    reason: str = ""
+    last_transition_time: float = 0.0
+
+
+@dataclass
+class PodStatus:
+    phase: str = "Pending"
+    conditions: List[PodCondition] = field(default_factory=list)
+    nominated_node_name: str = ""
+    start_time: Optional[float] = None
+
+
+@dataclass
+class Pod:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: PodSpec = field(default_factory=PodSpec)
+    status: PodStatus = field(default_factory=PodStatus)
+
+    @property
+    def name(self):
+        return self.metadata.name
+
+    @property
+    def namespace(self):
+        return self.metadata.namespace
+
+    @property
+    def uid(self):
+        return self.metadata.uid
+
+    def key(self) -> str:
+        return f"{self.metadata.namespace}/{self.metadata.name}"
+
+
+# -- nodes --------------------------------------------------------------------
+
+
+@dataclass
+class NodeCondition:
+    type: str
+    status: str = "True"
+    reason: str = ""
+
+
+@dataclass
+class NodeStatus:
+    capacity: Dict[str, float] = field(default_factory=dict)
+    allocatable: Dict[str, float] = field(default_factory=dict)
+    conditions: List[NodeCondition] = field(default_factory=list)
+    phase: str = ""
+
+
+@dataclass
+class NodeSpec:
+    provider_id: str = ""
+    taints: List[Taint] = field(default_factory=list)
+    unschedulable: bool = False
+
+
+@dataclass
+class Node:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: NodeSpec = field(default_factory=NodeSpec)
+    status: NodeStatus = field(default_factory=NodeStatus)
+
+    @property
+    def name(self):
+        return self.metadata.name
+
+    def is_ready(self) -> bool:
+        return any(c.type == "Ready" and c.status == "True" for c in self.status.conditions)
+
+
+# -- supporting cluster objects ----------------------------------------------
+
+
+@dataclass
+class DaemonSet:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    pod_template_spec: PodSpec = field(default_factory=PodSpec)
+    pod_template_metadata: ObjectMeta = field(default_factory=lambda: ObjectMeta(name="daemon"))
+
+
+@dataclass
+class PersistentVolumeClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    storage_class_name: Optional[str] = None
+    volume_name: str = ""
+    access_modes: List[str] = field(default_factory=list)
+
+
+@dataclass
+class PersistentVolume:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    node_affinity_required: List[NodeSelectorTerm] = field(default_factory=list)
+    csi_driver: str = ""
+
+
+@dataclass
+class StorageClass:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    provisioner: str = ""
+    allowed_topologies: List[NodeSelectorTerm] = field(default_factory=list)
+    is_default: bool = False
+
+
+@dataclass
+class PodDisruptionBudget:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    selector: Optional[LabelSelector] = None
+    min_available: Optional[object] = None  # int or percentage string
+    max_unavailable: Optional[object] = None
+    disruptions_allowed: int = 0
+    expected_pods: int = 0
+
+
+@dataclass
+class Lease:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    holder_identity: str = ""

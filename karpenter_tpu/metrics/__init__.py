@@ -1,0 +1,10 @@
+from karpenter_tpu.metrics.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    Store,
+    REGISTRY,
+    DURATION_BUCKETS,
+    measure,
+)

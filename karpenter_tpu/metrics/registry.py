@@ -1,0 +1,202 @@
+"""Self-contained Prometheus-style metrics.
+
+Equivalent of reference pkg/metrics/{metrics,constants,store}.go: counters,
+gauges, histograms under the ``karpenter`` namespace, a duration-bucket
+convention, a ``measure`` timing helper, and the diff-based gauge Store used by
+the node/nodepool/pod exporters.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from bisect import bisect_right
+from contextlib import contextmanager
+from typing import Dict, Iterable, List, Optional, Tuple
+
+NAMESPACE = "karpenter"
+
+# reference metrics/constants.go:41-50 (exponential-ish duration buckets)
+DURATION_BUCKETS = (
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.15, 0.2, 0.25, 0.3, 0.35, 0.4, 0.45, 0.5,
+    0.75, 1.0, 2.5, 5.0, 7.5, 10.0, 30.0, 60.0, 120.0, 180.0, 300.0, 450.0, 600.0,
+)
+
+LabelValues = Tuple[Tuple[str, str], ...]
+
+
+def _labels_key(labels: Optional[Dict[str, str]]) -> LabelValues:
+    return tuple(sorted((labels or {}).items()))
+
+
+class _Metric:
+    def __init__(self, name: str, help_: str = "", subsystem: str = ""):
+        parts = [NAMESPACE]
+        if subsystem:
+            parts.append(subsystem)
+        parts.append(name)
+        self.name = "_".join(parts)
+        self.help = help_
+        self._lock = threading.Lock()
+
+
+class Counter(_Metric):
+    def __init__(self, name: str, help_: str = "", subsystem: str = ""):
+        super().__init__(name, help_, subsystem)
+        self._values: Dict[LabelValues, float] = {}
+
+    def inc(self, labels: Optional[Dict[str, str]] = None, value: float = 1.0):
+        key = _labels_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def value(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self._values.get(_labels_key(labels), 0.0)
+
+    def total(self) -> float:
+        return sum(self._values.values())
+
+    def collect(self):
+        return [("counter", self.name, dict(k), v) for k, v in self._values.items()]
+
+
+class Gauge(_Metric):
+    def __init__(self, name: str, help_: str = "", subsystem: str = ""):
+        super().__init__(name, help_, subsystem)
+        self._values: Dict[LabelValues, float] = {}
+
+    def set(self, value: float, labels: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._values[_labels_key(labels)] = value
+
+    def add(self, value: float, labels: Optional[Dict[str, str]] = None):
+        key = _labels_key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + value
+
+    def delete(self, labels: Optional[Dict[str, str]] = None):
+        with self._lock:
+            self._values.pop(_labels_key(labels), None)
+
+    def value(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self._values.get(_labels_key(labels), 0.0)
+
+    def collect(self):
+        return [("gauge", self.name, dict(k), v) for k, v in self._values.items()]
+
+
+class Histogram(_Metric):
+    def __init__(
+        self,
+        name: str,
+        help_: str = "",
+        subsystem: str = "",
+        buckets: Iterable[float] = DURATION_BUCKETS,
+    ):
+        super().__init__(name, help_, subsystem)
+        self.buckets = tuple(sorted(buckets))
+        self._counts: Dict[LabelValues, List[int]] = {}
+        self._sums: Dict[LabelValues, float] = {}
+        self._totals: Dict[LabelValues, int] = {}
+
+    def observe(self, value: float, labels: Optional[Dict[str, str]] = None):
+        key = _labels_key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            idx = bisect_right(self.buckets, value)
+            for i in range(idx, len(self.buckets)):
+                counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0.0) + value
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def count(self, labels: Optional[Dict[str, str]] = None) -> int:
+        return self._totals.get(_labels_key(labels), 0)
+
+    def sum(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self._sums.get(_labels_key(labels), 0.0)
+
+    def collect(self):
+        return [
+            ("histogram", self.name, dict(k), {"count": self._totals[k], "sum": self._sums[k]})
+            for k in self._totals
+        ]
+
+
+class Registry:
+    """Holds every metric so an exporter / test can enumerate them."""
+
+    def __init__(self):
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def register(self, metric: _Metric):
+        with self._lock:
+            self._metrics[metric.name] = metric
+        return metric
+
+    def counter(self, name: str, help_: str = "", subsystem: str = "") -> Counter:
+        return self._get_or_register(Counter(name, help_, subsystem))
+
+    def gauge(self, name: str, help_: str = "", subsystem: str = "") -> Gauge:
+        return self._get_or_register(Gauge(name, help_, subsystem))
+
+    def histogram(self, name: str, help_: str = "", subsystem: str = "", buckets=DURATION_BUCKETS) -> Histogram:
+        return self._get_or_register(Histogram(name, help_, subsystem, buckets))
+
+    def _get_or_register(self, metric):
+        with self._lock:
+            existing = self._metrics.get(metric.name)
+            if existing is not None:
+                return existing
+            self._metrics[metric.name] = metric
+            return metric
+
+    def collect(self):
+        out = []
+        for m in self._metrics.values():
+            out.extend(m.collect())
+        return out
+
+
+REGISTRY = Registry()
+
+
+@contextmanager
+def measure(histogram: Histogram, labels: Optional[Dict[str, str]] = None):
+    """Time a block into a histogram (reference metrics/constants.go:60-67)."""
+    start = time.perf_counter()
+    try:
+        yield
+    finally:
+        histogram.observe(time.perf_counter() - start, labels)
+
+
+class Store:
+    """Diff-based gauge store (reference metrics/store.go:32-102): Update
+    replaces the gauge series owned by a key, deleting series that vanished."""
+
+    def __init__(self):
+        self._owned: Dict[str, List[Tuple[Gauge, Dict[str, str]]]] = {}
+        self._lock = threading.Lock()
+
+    def update(self, key: str, series: List[Tuple[Gauge, Dict[str, str], float]]):
+        with self._lock:
+            for gauge, labels in self._owned.get(key, []):
+                gauge.delete(labels)
+            new_owned = []
+            for gauge, labels, value in series:
+                gauge.set(value, labels)
+                new_owned.append((gauge, labels))
+            self._owned[key] = new_owned
+
+    def delete(self, key: str):
+        with self._lock:
+            for gauge, labels in self._owned.pop(key, []):
+                gauge.delete(labels)
+
+    def replace_all(self, series_by_key: Dict[str, List[Tuple[Gauge, Dict[str, str], float]]]):
+        for key in list(self._owned):
+            if key not in series_by_key:
+                self.delete(key)
+        for key, series in series_by_key.items():
+            self.update(key, series)

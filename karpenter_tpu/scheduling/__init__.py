@@ -1,0 +1,9 @@
+from karpenter_tpu.scheduling.requirements import (  # noqa: F401
+    Requirement,
+    Requirements,
+    pod_requirements,
+    strict_pod_requirements,
+    has_preferred_node_affinity,
+    label_requirements,
+)
+from karpenter_tpu.scheduling.taints import Taints, KNOWN_EPHEMERAL_TAINTS  # noqa: F401

@@ -1,0 +1,405 @@
+"""The scheduling requirements algebra.
+
+Host-side twin of the reference's pkg/scheduling/{requirement,requirements}.go:
+a ``Requirement`` is a set over the (unbounded) space of label-value strings,
+stored either as a finite admitted set (In / DoesNotExist) or as the complement
+of a finite excluded set (NotIn / Exists / Gt / Lt with integer bounds). A
+``Requirements`` maps label key -> Requirement with intersection-on-add.
+
+This module is the semantic ground truth that the tensorized codec in
+``solver/encode.py`` is property-tested against. The closed-world tensor
+encoding is documented there.
+
+Semantics mirrored exactly (file:line refer to /root/reference):
+  - constructor normalization            pkg/scheduling/requirement.go:41-79
+  - Intersection incl. bound handling    requirement.go:128-161
+  - Has with bounds                      requirement.go:182-187
+  - Operator / Len complement logic      requirement.go:197-215
+  - Requirements.Add intersects          requirements.go:118-125
+  - Compatible undefined-key rules       requirements.go:163-174
+  - Intersects NotIn/DoesNotExist escape requirements.go:241-258
+"""
+
+from __future__ import annotations
+
+import random
+import sys
+from typing import Dict, Iterable, Iterator, List, Optional, Set
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.objects import (
+    DOES_NOT_EXIST,
+    EXISTS,
+    GT,
+    IN,
+    LT,
+    NOT_IN,
+    NodeSelectorRequirement,
+    Pod,
+)
+
+# Stand-in for Go's math.MaxInt64-based "infinite" set size.
+INFINITE = sys.maxsize
+
+
+class Requirement:
+    """A set over label values for one key.
+
+    ``complement=False``: the requirement admits exactly ``values``.
+    ``complement=True``: it admits everything except ``values``, further
+    clipped to integer bounds ``(greater_than, less_than)`` when set.
+    """
+
+    __slots__ = ("key", "complement", "values", "greater_than", "less_than")
+
+    def __init__(
+        self,
+        key: str,
+        operator: str,
+        values: Iterable[str] = (),
+        *,
+        _raw: bool = False,
+    ):
+        values = list(values)
+        if not _raw:
+            key = wk.NORMALIZED_LABELS.get(key, key)
+        self.key = key
+        self.greater_than: Optional[int] = None
+        self.less_than: Optional[int] = None
+        if operator == IN:
+            self.values: Set[str] = set(values)
+            self.complement = False
+            return
+        self.values = set()
+        self.complement = operator != DOES_NOT_EXIST
+        if operator == NOT_IN:
+            self.values.update(values)
+        elif operator == GT:
+            self.greater_than = int(values[0])
+        elif operator == LT:
+            self.less_than = int(values[0])
+        elif operator not in (EXISTS, DOES_NOT_EXIST):
+            raise ValueError(f"unsupported operator {operator!r}")
+
+    # -- constructors ---------------------------------------------------------
+
+    @classmethod
+    def _make(cls, key, complement, values, greater_than=None, less_than=None) -> "Requirement":
+        r = cls.__new__(cls)
+        r.key = key
+        r.complement = complement
+        r.values = set(values)
+        r.greater_than = greater_than
+        r.less_than = less_than
+        return r
+
+    def copy(self) -> "Requirement":
+        return Requirement._make(self.key, self.complement, self.values, self.greater_than, self.less_than)
+
+    # -- algebra --------------------------------------------------------------
+
+    def intersection(self, other: "Requirement") -> "Requirement":
+        """Narrow this requirement by another (requirement.go:128-161)."""
+        complement = self.complement and other.complement
+
+        greater_than = _max_opt(self.greater_than, other.greater_than)
+        less_than = _min_opt(self.less_than, other.less_than)
+        if greater_than is not None and less_than is not None and greater_than >= less_than:
+            return Requirement(self.key, DOES_NOT_EXIST)
+
+        if self.complement and other.complement:
+            values = self.values | other.values
+        elif self.complement:
+            values = other.values - self.values
+        elif other.complement:
+            values = self.values - other.values
+        else:
+            values = self.values & other.values
+        values = {v for v in values if _within_bounds(v, greater_than, less_than)}
+
+        if not complement:
+            greater_than, less_than = None, None
+        return Requirement._make(self.key, complement, values, greater_than, less_than)
+
+    def has(self, value: str) -> bool:
+        """True if the requirement admits ``value`` (requirement.go:182-187)."""
+        in_set = value in self.values
+        if self.complement:
+            return not in_set and _within_bounds(value, self.greater_than, self.less_than)
+        return in_set and _within_bounds(value, self.greater_than, self.less_than)
+
+    def insert(self, *values: str) -> None:
+        self.values.update(values)
+
+    def operator(self) -> str:
+        if self.complement:
+            return NOT_IN if self.values else EXISTS
+        return IN if self.values else DOES_NOT_EXIST
+
+    def __len__(self) -> int:
+        # Matches the reference's Len(): bounds are deliberately ignored for
+        # complement sets (requirement.go:210-215).
+        if self.complement:
+            return INFINITE - len(self.values)
+        return len(self.values)
+
+    def is_empty(self) -> bool:
+        return len(self) == 0
+
+    def any_value(self) -> str:
+        """Some admitted value, for label synthesis (requirement.go:163-179)."""
+        op = self.operator()
+        if op == IN:
+            return min(self.values)  # deterministic, unlike the reference
+        if op in (NOT_IN, EXISTS):
+            lo = 0 if self.greater_than is None else self.greater_than + 1
+            hi = (1 << 31) if self.less_than is None else self.less_than
+            for _ in range(100):
+                v = str(random.randrange(lo, hi))
+                if v not in self.values:
+                    return v
+        return ""
+
+    def sorted_values(self) -> List[str]:
+        return sorted(self.values)
+
+    def to_node_selector_requirement(self) -> NodeSelectorRequirement:
+        """Project back to a NodeSelectorRequirement (requirement.go:81-124)."""
+        if self.greater_than is not None:
+            return NodeSelectorRequirement(self.key, GT, [str(self.greater_than)])
+        if self.less_than is not None:
+            return NodeSelectorRequirement(self.key, LT, [str(self.less_than)])
+        if self.complement:
+            if self.values:
+                return NodeSelectorRequirement(self.key, NOT_IN, self.sorted_values())
+            return NodeSelectorRequirement(self.key, EXISTS)
+        if self.values:
+            return NodeSelectorRequirement(self.key, IN, self.sorted_values())
+        return NodeSelectorRequirement(self.key, DOES_NOT_EXIST)
+
+    def __repr__(self) -> str:
+        op = self.operator()
+        if op in (EXISTS, DOES_NOT_EXIST):
+            s = f"{self.key} {op}"
+        else:
+            vals = self.sorted_values()
+            if len(vals) > 5:
+                vals = vals[:5] + [f"and {len(vals) - 5} others"]
+            s = f"{self.key} {op} {vals}"
+        if self.greater_than is not None:
+            s += f" >{self.greater_than}"
+        if self.less_than is not None:
+            s += f" <{self.less_than}"
+        return s
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, Requirement)
+            and self.key == other.key
+            and self.complement == other.complement
+            and self.values == other.values
+            and self.greater_than == other.greater_than
+            and self.less_than == other.less_than
+        )
+
+    def __hash__(self):
+        return hash((self.key, self.complement, frozenset(self.values), self.greater_than, self.less_than))
+
+
+def _within_bounds(value: str, greater_than: Optional[int], less_than: Optional[int]) -> bool:
+    """Integer bound check; non-integers fail when bounds are set
+    (requirement.go:238-254)."""
+    if greater_than is None and less_than is None:
+        return True
+    try:
+        num = int(value)
+    except (TypeError, ValueError):
+        return False
+    if greater_than is not None and greater_than >= num:
+        return False
+    if less_than is not None and less_than <= num:
+        return False
+    return True
+
+
+def _min_opt(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return min(a, b)
+
+
+def _max_opt(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return max(a, b)
+
+
+_NEGATIVE_POLARITY = (NOT_IN, DOES_NOT_EXIST)
+
+
+class Requirements:
+    """Label key -> Requirement, intersecting on add (requirements.go:36-125)."""
+
+    __slots__ = ("_reqs",)
+
+    def __init__(self, *requirements: Requirement):
+        self._reqs: Dict[str, Requirement] = {}
+        self.add(*requirements)
+
+    @classmethod
+    def from_node_selector_requirements(cls, *nsrs: NodeSelectorRequirement) -> "Requirements":
+        return cls(*(Requirement(n.key, n.operator, n.values) for n in nsrs))
+
+    @classmethod
+    def from_labels(cls, labels: Dict[str, str]) -> "Requirements":
+        return cls(*(Requirement(k, IN, [v]) for k, v in labels.items()))
+
+    # -- mapping surface ------------------------------------------------------
+
+    def add(self, *requirements: Requirement) -> None:
+        for req in requirements:
+            existing = self._reqs.get(req.key)
+            if existing is not None:
+                req = req.intersection(existing)
+            self._reqs[req.key] = req
+
+    def keys(self) -> Set[str]:
+        return set(self._reqs)
+
+    def values(self) -> List[Requirement]:
+        return list(self._reqs.values())
+
+    def has(self, key: str) -> bool:
+        return key in self._reqs
+
+    def get(self, key: str) -> Requirement:
+        """Undefined keys read as Exists (requirements.go:145-151)."""
+        req = self._reqs.get(key)
+        if req is None:
+            return Requirement(key, EXISTS)
+        return req
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self._reqs)
+
+    def __len__(self) -> int:
+        return len(self._reqs)
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._reqs
+
+    def copy(self) -> "Requirements":
+        out = Requirements()
+        out._reqs = {k: v.copy() for k, v in self._reqs.items()}
+        return out
+
+    def delete(self, key: str) -> None:
+        self._reqs.pop(key, None)
+
+    def to_node_selector_requirements(self) -> List[NodeSelectorRequirement]:
+        return [r.to_node_selector_requirement() for r in self._reqs.values()]
+
+    # -- compatibility --------------------------------------------------------
+
+    def intersects(self, incoming: "Requirements") -> List[str]:
+        """Error strings for keys in both whose intersection is empty, except
+        when both sides have negative polarity (requirements.go:241-258)."""
+        errs = []
+        for key in self.keys() & incoming.keys():
+            existing = self.get(key)
+            inc = incoming.get(key)
+            if len(existing.intersection(inc)) == 0:
+                if inc.operator() in _NEGATIVE_POLARITY and existing.operator() in _NEGATIVE_POLARITY:
+                    continue
+                errs.append(f"key {key}, {inc!r} not in {existing!r}")
+        return errs
+
+    def compatible(self, incoming: "Requirements", allow_undefined: frozenset = frozenset()) -> List[str]:
+        """Loose compatibility (requirements.go:163-174): keys in ``incoming``
+        outside ``allow_undefined`` must be defined here unless the incoming
+        operator is NotIn/DoesNotExist; then requirements must intersect.
+        Returns error strings, empty when compatible."""
+        errs = []
+        for key in incoming.keys() - allow_undefined:
+            if self.has(key) or incoming.get(key).operator() in _NEGATIVE_POLARITY:
+                continue
+            errs.append(f'label "{key}" does not have known values')
+        errs.extend(self.intersects(incoming))
+        return errs
+
+    def is_compatible(self, incoming: "Requirements", allow_undefined: frozenset = frozenset()) -> bool:
+        return not self.compatible(incoming, allow_undefined)
+
+    def labels(self) -> Dict[str, str]:
+        """Synthesize node labels from the requirements (requirements.go:260-270)."""
+        out = {}
+        for key, req in self._reqs.items():
+            if not wk.is_restricted_node_label(key):
+                value = req.any_value()
+                if value:
+                    out[key] = value
+        return out
+
+    def __repr__(self) -> str:
+        parts = sorted(
+            repr(r) for r in self._reqs.values() if r.key not in wk.RESTRICTED_LABELS
+        )
+        return ", ".join(parts)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Requirements) and self._reqs == other._reqs
+
+
+ALLOW_UNDEFINED_WELL_KNOWN_LABELS = frozenset(wk.WELL_KNOWN_LABELS)
+
+
+def label_requirements(labels: Dict[str, str]) -> Requirements:
+    return Requirements.from_labels(labels)
+
+
+def has_preferred_node_affinity(pod: Pod) -> bool:
+    return bool(
+        pod
+        and pod.spec.affinity
+        and pod.spec.affinity.node_affinity
+        and pod.spec.affinity.node_affinity.preferred
+    )
+
+
+def _pod_requirements(pod: Pod, include_preferred: bool) -> Requirements:
+    """Build requirements from node selector + node affinity
+    (requirements.go:81-101): the heaviest preferred term is treated as
+    required (relaxation drops it later) and only the FIRST required OR-term is
+    used (relaxation pops the rest)."""
+    reqs = Requirements.from_labels(pod.spec.node_selector)
+    affinity = pod.spec.affinity.node_affinity if pod.spec.affinity else None
+    if affinity is None:
+        return reqs
+    if include_preferred and affinity.preferred:
+        heaviest = max(affinity.preferred, key=lambda term: term.weight)
+        reqs.add(
+            *Requirements.from_node_selector_requirements(
+                *heaviest.preference.match_expressions
+            ).values()
+        )
+    if affinity.required:
+        reqs.add(
+            *Requirements.from_node_selector_requirements(
+                *affinity.required[0].match_expressions
+            ).values()
+        )
+    return reqs
+
+
+def pod_requirements(pod: Pod) -> Requirements:
+    """Requirements treating preferences as required (requirements.go:65-67)."""
+    return _pod_requirements(pod, include_preferred=True)
+
+
+def strict_pod_requirements(pod: Pod) -> Requirements:
+    """Only true requirements, no preferences (requirements.go:70-72)."""
+    return _pod_requirements(pod, include_preferred=False)

@@ -1,0 +1,41 @@
+"""Taint / toleration checks (reference pkg/scheduling/taints.go)."""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.objects import NO_SCHEDULE, Pod, Taint
+
+# Taints added/removed by kubelet or cloud controllers during startup; ignored
+# when deciding whether a node can serve pods (taints.go:28-32).
+KNOWN_EPHEMERAL_TAINTS = (
+    Taint(key=wk.TAINT_NODE_NOT_READY, effect=NO_SCHEDULE),
+    Taint(key=wk.TAINT_NODE_UNREACHABLE, effect=NO_SCHEDULE),
+    Taint(key=wk.TAINT_EXTERNAL_CLOUD_PROVIDER, effect=NO_SCHEDULE, value="true"),
+)
+
+
+class Taints(list):
+    """Decorated list of Taint (taints.go:35-65)."""
+
+    def __init__(self, taints: Iterable[Taint] = ()):
+        super().__init__(taints)
+
+    def tolerates(self, pod: Pod) -> List[str]:
+        """Error strings for every taint the pod does not tolerate
+        (taints.go:38-50); empty means fully tolerated."""
+        errs = []
+        for taint in self:
+            if not any(t.tolerates(taint) for t in pod.spec.tolerations):
+                errs.append(f"did not tolerate {taint.key}={taint.value}:{taint.effect}")
+        return errs
+
+    def merge(self, other: Iterable[Taint]) -> "Taints":
+        """Union keeping existing entries on (key, effect) conflict
+        (taints.go:53-65)."""
+        out = Taints(self)
+        for taint in other:
+            if not any(taint.match(t) for t in out):
+                out.append(taint)
+        return out

@@ -1,0 +1,168 @@
+"""ResourceList arithmetic.
+
+Host-side equivalent of the reference's pkg/utils/resources (resources.go):
+Merge/Subtract/Fits/Cmp/MaxResources/RequestsForPods over k8s-style resource
+lists. A ResourceList here is a plain ``dict[str, float]`` in canonical units
+(cpu in cores, memory/ephemeral-storage in bytes, everything else in counts).
+
+Quantity strings follow the k8s resource.Quantity surface syntax: "100m",
+"1Gi", "2", "1500Mi", "0.5".
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, Mapping
+
+ResourceList = Dict[str, float]
+
+CPU = "cpu"
+MEMORY = "memory"
+PODS = "pods"
+EPHEMERAL_STORAGE = "ephemeral-storage"
+
+_BINARY_SUFFIX = {
+    "Ki": 1024.0,
+    "Mi": 1024.0**2,
+    "Gi": 1024.0**3,
+    "Ti": 1024.0**4,
+    "Pi": 1024.0**5,
+    "Ei": 1024.0**6,
+}
+_DECIMAL_SUFFIX = {
+    "n": 1e-9,
+    "u": 1e-6,
+    "m": 1e-3,
+    "": 1.0,
+    "k": 1e3,
+    "M": 1e6,
+    "G": 1e9,
+    "T": 1e12,
+    "P": 1e15,
+    "E": 1e18,
+}
+_QUANTITY_RE = re.compile(r"^([+-]?[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?)([A-Za-z]*)$")
+
+
+def parse_quantity(value) -> float:
+    """Parse a k8s quantity ("100m", "1Gi", 2, "1.5") into a float."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    m = _QUANTITY_RE.match(str(value).strip())
+    if not m:
+        raise ValueError(f"invalid quantity {value!r}")
+    number, suffix = m.groups()
+    if suffix in _BINARY_SUFFIX:
+        return float(number) * _BINARY_SUFFIX[suffix]
+    if suffix in _DECIMAL_SUFFIX:
+        return float(number) * _DECIMAL_SUFFIX[suffix]
+    raise ValueError(f"invalid quantity suffix {suffix!r} in {value!r}")
+
+
+def parse_resource_list(raw: Mapping[str, object] | None) -> ResourceList:
+    """Parse a mapping of resource name -> quantity string/number."""
+    if not raw:
+        return {}
+    return {name: parse_quantity(q) for name, q in raw.items()}
+
+
+def merge(*lists: Mapping[str, float] | None) -> ResourceList:
+    """Sum resource lists elementwise (reference: resources.Merge)."""
+    out: ResourceList = {}
+    for rl in lists:
+        if not rl:
+            continue
+        for name, q in rl.items():
+            out[name] = out.get(name, 0.0) + q
+    return out
+
+
+def subtract(a: Mapping[str, float] | None, b: Mapping[str, float] | None) -> ResourceList:
+    """a - b elementwise over a's keys plus b's keys (missing treated as 0)."""
+    out: ResourceList = dict(a or {})
+    for name, q in (b or {}).items():
+        out[name] = out.get(name, 0.0) - q
+    return out
+
+
+def fits(requests: Mapping[str, float] | None, available: Mapping[str, float] | None) -> bool:
+    """True if every requested quantity is <= the available quantity
+    (reference: resources.Fits). Missing available resources count as 0."""
+    available = available or {}
+    for name, q in (requests or {}).items():
+        if q > available.get(name, 0.0) + 1e-9:
+            return False
+    return True
+
+
+def cmp(a: float, b: float) -> int:
+    if a < b:
+        return -1
+    if a > b:
+        return 1
+    return 0
+
+
+def max_resources(*lists: Mapping[str, float] | None) -> ResourceList:
+    """Elementwise max across resource lists (reference: resources.MaxResources)."""
+    out: ResourceList = {}
+    for rl in lists:
+        if not rl:
+            continue
+        for name, q in rl.items():
+            if name not in out or q > out[name]:
+                out[name] = q
+    return out
+
+
+def requests_for_pods(*pods) -> ResourceList:
+    """Total requests across pods, where each pod request is
+    max(sum(containers), max(initContainers)) (reference: resources.RequestsForPods
+    / podRequests)."""
+    return merge(*(pod_requests(p) for p in pods))
+
+
+def pod_requests(pod) -> ResourceList:
+    """Effective requests of one pod per the k8s resource model: the elementwise
+    max of the summed app-container requests and each init container's requests,
+    plus pod overhead."""
+    app = merge(*(c.requests for c in pod.spec.containers))
+    inits = [c.requests for c in pod.spec.init_containers]
+    out = max_resources(app, *inits)
+    if pod.spec.overhead:
+        out = merge(out, pod.spec.overhead)
+    return out
+
+
+def pod_limits(pod) -> ResourceList:
+    app = merge(*(c.limits for c in pod.spec.containers))
+    inits = [c.limits for c in pod.spec.init_containers]
+    out = max_resources(app, *inits)
+    if pod.spec.overhead:
+        out = merge(out, pod.spec.overhead)
+    return out
+
+
+def is_zero(rl: Mapping[str, float] | None) -> bool:
+    return all(abs(v) < 1e-12 for v in (rl or {}).values())
+
+
+def positive_part(rl: Mapping[str, float] | None) -> ResourceList:
+    return {k: v for k, v in (rl or {}).items() if v > 0}
+
+
+def to_dense(rl: Mapping[str, float] | None, names: Iterable[str]) -> list:
+    """Project a resource list onto an ordered resource-name axis (tensor codec)."""
+    rl = rl or {}
+    return [float(rl.get(name, 0.0)) for name in names]
+
+
+def exceeded_by(limits: Mapping[str, float] | None, usage: Mapping[str, float] | None):
+    """Return the resource names where usage > limits (reference:
+    v1beta1.Limits.ExceededBy, nodepool.go:141-153). Only keys present in limits
+    are checked."""
+    out = []
+    for name, lim in (limits or {}).items():
+        if (usage or {}).get(name, 0.0) > lim + 1e-9:
+            out.append(name)
+    return out

@@ -1,0 +1,168 @@
+"""NodePool / NodeClaim API tests (reference pkg/apis/v1beta1 test suites)."""
+
+import datetime as dt
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodeclaim import (
+    EMPTY,
+    INITIALIZED,
+    LAUNCHED,
+    REGISTERED,
+    NodeClaim,
+)
+from karpenter_tpu.apis.nodepool import (
+    Budget,
+    Disruption,
+    NodeClaimSpec,
+    NodeClaimTemplateSpec,
+    NodePool,
+    NodePoolSpec,
+    UNBOUNDED_DISRUPTIONS,
+    order_by_weight,
+    parse_duration,
+)
+from karpenter_tpu.apis.objects import ObjectMeta, Taint
+from karpenter_tpu.utils import cron
+from karpenter_tpu.utils.clock import FakeClock
+
+
+class TestDuration:
+    def test_parse(self):
+        assert parse_duration("30s") == 30
+        assert parse_duration("5m") == 300
+        assert parse_duration("2h") == 7200
+        assert parse_duration("1h30m") == 5400
+        assert parse_duration("Never") == float("inf")
+        assert parse_duration(None) == float("inf")
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            parse_duration("5x")
+        with pytest.raises(ValueError):
+            parse_duration("5")
+
+
+class TestCron:
+    def test_hourly(self):
+        sched = cron.parse("@hourly")
+        t = dt.datetime(2026, 7, 29, 10, 30)
+        assert sched.next_after(t) == dt.datetime(2026, 7, 29, 11, 0)
+
+    def test_specific_time(self):
+        sched = cron.parse("30 9 * * *")
+        t = dt.datetime(2026, 7, 29, 10, 0)
+        assert sched.next_after(t) == dt.datetime(2026, 7, 30, 9, 30)
+        t2 = dt.datetime(2026, 7, 29, 9, 0)
+        assert sched.next_after(t2) == dt.datetime(2026, 7, 29, 9, 30)
+
+    def test_weekday(self):
+        # 2026-07-29 is a Wednesday; next Monday is 2026-08-03
+        sched = cron.parse("0 0 * * 1")
+        assert sched.next_after(dt.datetime(2026, 7, 29, 12, 0)) == dt.datetime(2026, 8, 3)
+
+    def test_step(self):
+        sched = cron.parse("*/15 * * * *")
+        assert sched.next_after(dt.datetime(2026, 1, 1, 0, 1)) == dt.datetime(2026, 1, 1, 0, 15)
+
+    def test_invalid(self):
+        with pytest.raises(cron.CronParseError):
+            cron.parse("totally wrong")
+        with pytest.raises(cron.CronParseError):
+            cron.parse("61 * * * *")
+
+
+class TestBudget:
+    def test_always_active_without_schedule(self):
+        clock = FakeClock()
+        assert Budget(nodes="5").is_active(clock)
+
+    def test_allowed_disruptions_int(self):
+        clock = FakeClock()
+        assert Budget(nodes="5").get_allowed_disruptions(clock, 100) == 5
+
+    def test_allowed_disruptions_percent_floor(self):
+        clock = FakeClock()
+        assert Budget(nodes="10%").get_allowed_disruptions(clock, 19) == 1
+        assert Budget(nodes="10%").get_allowed_disruptions(clock, 5) == 0
+        assert Budget(nodes="100%").get_allowed_disruptions(clock, 7) == 7
+
+    def test_scheduled_window(self):
+        # active 09:00-17:00 daily
+        budget = Budget(nodes="0", schedule="0 9 * * *", duration="8h")
+        clock = FakeClock()
+        # set to 10:00 local of an arbitrary day
+        base = dt.datetime(2026, 7, 29, 10, 0).timestamp()
+        clock.set(base)
+        assert budget.is_active(clock)
+        assert budget.get_allowed_disruptions(clock, 100) == 0
+        # 18:00 -> inactive -> unbounded
+        clock.set(dt.datetime(2026, 7, 29, 18, 0).timestamp())
+        assert not budget.is_active(clock)
+        assert budget.get_allowed_disruptions(clock, 100) == UNBOUNDED_DISRUPTIONS
+
+    def test_nodepool_min_across_budgets(self):
+        clock = FakeClock()
+        np = NodePool(
+            spec=NodePoolSpec(
+                disruption=Disruption(budgets=[Budget(nodes="10"), Budget(nodes="3")])
+            )
+        )
+        assert np.get_allowed_disruptions(clock, 100) == 3
+
+
+class TestNodePool:
+    def make(self, name="pool", weight=None, labels=None, taints=None):
+        return NodePool(
+            metadata=ObjectMeta(name=name),
+            spec=NodePoolSpec(
+                weight=weight,
+                template=NodeClaimTemplateSpec(
+                    labels=labels or {},
+                    spec=NodeClaimSpec(taints=taints or []),
+                ),
+            ),
+        )
+
+    def test_order_by_weight(self):
+        pools = [self.make("a", 1), self.make("b", 50), self.make("c", None)]
+        ordered = order_by_weight(pools)
+        assert [p.name for p in ordered] == ["b", "a", "c"]
+
+    def test_hash_stable(self):
+        assert self.make().hash() == self.make().hash()
+
+    def test_hash_changes_on_template_change(self):
+        a = self.make(labels={"x": "1"})
+        b = self.make(labels={"x": "2"})
+        assert a.hash() != b.hash()
+        c = self.make(taints=[Taint(key="k")])
+        assert a.hash() != c.hash()
+
+    def test_hash_ignores_weight(self):
+        # weight/limits/budgets are hash-ignored in the reference
+        assert self.make(weight=1).hash() == self.make(weight=99).hash()
+
+
+class TestNodeClaim:
+    def test_conditions_lifecycle(self):
+        nc = NodeClaim(metadata=ObjectMeta(name="nc-1"))
+        assert not nc.is_launched()
+        nc.status.conditions.set_true(LAUNCHED)
+        nc.status.conditions.set_true(REGISTERED)
+        assert nc.is_launched() and nc.is_registered()
+        assert not nc.status.conditions.root_is_true()
+        nc.status.conditions.set_true(INITIALIZED)
+        assert nc.status.conditions.root_is_true()
+
+    def test_marker_conditions(self):
+        nc = NodeClaim()
+        nc.status.conditions.set_true(EMPTY, reason="no pods")
+        assert nc.status.conditions.is_true(EMPTY)
+        nc.status.conditions.clear(EMPTY)
+        assert not nc.status.conditions.is_true(EMPTY)
+
+    def test_nodepool_label(self):
+        nc = NodeClaim(metadata=ObjectMeta(labels={wk.NODEPOOL_LABEL_KEY: "pool-1"}))
+        assert nc.nodepool_name == "pool-1"

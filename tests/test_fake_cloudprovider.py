@@ -1,0 +1,143 @@
+"""Fake cloud provider tests (reference pkg/cloudprovider/fake)."""
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodeclaim import NodeClaim
+from karpenter_tpu.apis.nodepool import NodeClaimSpec, NodePool
+from karpenter_tpu.apis.objects import IN, NodeSelectorRequirement, ObjectMeta
+from karpenter_tpu.cloudprovider import (
+    InsufficientCapacityError,
+    NodeClaimNotFoundError,
+    order_by_price,
+)
+from karpenter_tpu.cloudprovider.fake import (
+    GI,
+    FakeCloudProvider,
+    default_instance_types,
+    instance_types,
+    instance_types_assorted,
+    make_instance_type,
+)
+from karpenter_tpu.scheduling import Requirement, Requirements
+from karpenter_tpu.utils import resources as res
+
+
+class TestInstanceTypeGenerators:
+    def test_defaults(self):
+        it = make_instance_type("it-1")
+        assert it.capacity[res.CPU] == 4
+        assert it.capacity[res.MEMORY] == 4 * GI
+        assert it.capacity[res.PODS] == 5
+        assert len(it.offerings) == 5
+        # requirements carry every well-known label it supports
+        assert it.requirements.get(wk.LABEL_INSTANCE_TYPE_STABLE).has("it-1")
+        assert it.requirements.get(wk.LABEL_TOPOLOGY_ZONE).has("test-zone-1")
+        assert it.requirements.get(wk.CAPACITY_TYPE_LABEL_KEY).has("spot")
+
+    def test_allocatable_subtracts_overhead(self):
+        it = make_instance_type("it-1")
+        assert it.allocatable()[res.CPU] == pytest.approx(3.9)
+        assert it.allocatable()[res.MEMORY] < it.capacity[res.MEMORY]
+
+    def test_size_labels(self):
+        small = make_instance_type("s", resources={res.CPU: 2.0})
+        large = make_instance_type("l", resources={res.CPU: 16.0, res.MEMORY: 64 * GI})
+        assert small.requirements.get("size").has("small")
+        assert large.requirements.get("size").has("large")
+        assert large.requirements.get("special").has("optional")
+
+    def test_incrementing_catalog(self):
+        cat = instance_types(5)
+        assert len(cat) == 5
+        assert cat[2].capacity[res.CPU] == 3
+        assert cat[2].capacity[res.MEMORY] == 6 * GI
+        assert cat[2].capacity[res.PODS] == 30
+
+    def test_assorted_catalog_size(self):
+        cat = instance_types_assorted()
+        assert len(cat) == 7 * 8 * 3 * 2 * 2 * 2
+
+    def test_order_by_price(self):
+        cat = instance_types(10)
+        ordered = order_by_price(cat, Requirements())
+        prices = [it.offerings.available().cheapest().price for it in ordered]
+        assert prices == sorted(prices)
+
+
+class TestFakeCloudProvider:
+    def make_claim(self, requirements=(), requests=None, labels=None):
+        return NodeClaim(
+            metadata=ObjectMeta(name="claim-1", labels=labels or {}),
+            spec=NodeClaimSpec(
+                requirements=[NodeSelectorRequirement(*r) for r in requirements],
+                resource_requests=requests or {},
+            ),
+        )
+
+    def test_create_picks_cheapest_compatible(self):
+        cp = FakeCloudProvider()
+        cp.instance_types = instance_types(10)
+        created = cp.create(self.make_claim(requests={res.CPU: 3.0}))
+        # cheapest IT with allocatable cpu >= 3 is fake-it-3 (4 cpu - 0.1 overhead)
+        assert created.metadata.labels[wk.LABEL_INSTANCE_TYPE_STABLE] == "fake-it-3"
+        assert created.status.provider_id
+        assert created.status.capacity[res.CPU] == 4
+
+    def test_create_respects_requirements(self):
+        cp = FakeCloudProvider()
+        created = cp.create(
+            self.make_claim(requirements=[(wk.LABEL_ARCH_STABLE, IN, ["arm64"])])
+        )
+        assert created.metadata.labels[wk.LABEL_INSTANCE_TYPE_STABLE] == "arm-instance-type"
+
+    def test_create_assigns_offering_labels(self):
+        cp = FakeCloudProvider()
+        created = cp.create(
+            self.make_claim(
+                requirements=[
+                    (wk.LABEL_TOPOLOGY_ZONE, IN, ["test-zone-2"]),
+                    (wk.CAPACITY_TYPE_LABEL_KEY, IN, ["spot"]),
+                ]
+            )
+        )
+        assert created.metadata.labels[wk.LABEL_TOPOLOGY_ZONE] == "test-zone-2"
+        assert created.metadata.labels[wk.CAPACITY_TYPE_LABEL_KEY] == "spot"
+
+    def test_get_list_delete(self):
+        cp = FakeCloudProvider()
+        created = cp.create(self.make_claim())
+        assert cp.get(created.status.provider_id).name == "claim-1"
+        assert len(cp.list()) == 1
+        cp.delete(created)
+        assert cp.list() == []
+        with pytest.raises(NodeClaimNotFoundError):
+            cp.get(created.status.provider_id)
+        with pytest.raises(NodeClaimNotFoundError):
+            cp.delete(created)
+
+    def test_next_create_error_fires_once(self):
+        cp = FakeCloudProvider()
+        cp.next_create_error = InsufficientCapacityError("no capacity")
+        with pytest.raises(InsufficientCapacityError):
+            cp.create(self.make_claim())
+        # consumed: next call succeeds
+        cp.create(self.make_claim())
+
+    def test_allowed_create_calls(self):
+        cp = FakeCloudProvider()
+        cp.allowed_create_calls = 1
+        cp.create(self.make_claim())
+        with pytest.raises(RuntimeError):
+            cp.create(self.make_claim())
+
+    def test_per_nodepool_instance_types_and_errors(self):
+        cp = FakeCloudProvider()
+        cp.instance_types_for_nodepool["pool-a"] = instance_types(1)
+        cp.errors_for_nodepool["pool-b"] = RuntimeError("boom")
+        np_a = NodePool(metadata=ObjectMeta(name="pool-a"))
+        np_b = NodePool(metadata=ObjectMeta(name="pool-b"))
+        assert len(cp.get_instance_types(np_a)) == 1
+        with pytest.raises(RuntimeError):
+            cp.get_instance_types(np_b)
+        assert len(cp.get_instance_types(None)) == len(default_instance_types())

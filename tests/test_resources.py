@@ -1,0 +1,91 @@
+"""ResourceList arithmetic tests (reference pkg/utils/resources)."""
+
+import pytest
+
+from karpenter_tpu.apis.objects import Container, Pod, PodSpec
+from karpenter_tpu.utils import resources as res
+
+
+class TestParseQuantity:
+    def test_plain_numbers(self):
+        assert res.parse_quantity("2") == 2.0
+        assert res.parse_quantity(3) == 3.0
+        assert res.parse_quantity("1.5") == 1.5
+
+    def test_milli(self):
+        assert res.parse_quantity("100m") == pytest.approx(0.1)
+        assert res.parse_quantity("1500m") == pytest.approx(1.5)
+
+    def test_binary_suffixes(self):
+        assert res.parse_quantity("1Ki") == 1024
+        assert res.parse_quantity("2Mi") == 2 * 1024**2
+        assert res.parse_quantity("3Gi") == 3 * 1024**3
+        assert res.parse_quantity("1Ti") == 1024**4
+
+    def test_decimal_suffixes(self):
+        assert res.parse_quantity("1k") == 1000
+        assert res.parse_quantity("2M") == 2e6
+        assert res.parse_quantity("1G") == 1e9
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            res.parse_quantity("abc")
+        with pytest.raises(ValueError):
+            res.parse_quantity("1Qi")
+
+
+class TestArithmetic:
+    def test_merge(self):
+        out = res.merge({"cpu": 1, "memory": 10}, {"cpu": 2}, None, {"gpu": 1})
+        assert out == {"cpu": 3, "memory": 10, "gpu": 1}
+
+    def test_subtract(self):
+        out = res.subtract({"cpu": 3, "memory": 10}, {"cpu": 1, "gpu": 2})
+        assert out == {"cpu": 2, "memory": 10, "gpu": -2}
+
+    def test_fits(self):
+        assert res.fits({"cpu": 1}, {"cpu": 1})
+        assert res.fits({"cpu": 1}, {"cpu": 2, "memory": 1})
+        assert not res.fits({"cpu": 3}, {"cpu": 2})
+        # missing available resource treated as zero
+        assert not res.fits({"gpu": 1}, {"cpu": 4})
+        assert res.fits({}, {})
+
+    def test_max_resources(self):
+        out = res.max_resources({"cpu": 1, "memory": 5}, {"cpu": 3, "gpu": 1})
+        assert out == {"cpu": 3, "memory": 5, "gpu": 1}
+
+    def test_exceeded_by(self):
+        assert res.exceeded_by({"cpu": 10}, {"cpu": 11}) == ["cpu"]
+        assert res.exceeded_by({"cpu": 10}, {"cpu": 9, "gpu": 100}) == []
+        assert res.exceeded_by(None, {"cpu": 1}) == []
+
+
+def make_pod(containers, init_containers=(), overhead=None):
+    return Pod(
+        spec=PodSpec(
+            containers=[Container(requests=c) for c in containers],
+            init_containers=[Container(requests=c) for c in init_containers],
+            overhead=overhead or {},
+        )
+    )
+
+
+class TestPodRequests:
+    def test_sum_of_containers(self):
+        pod = make_pod([{"cpu": 1}, {"cpu": 2, "memory": 4}])
+        assert res.pod_requests(pod) == {"cpu": 3, "memory": 4}
+
+    def test_init_container_max(self):
+        # effective request = max(sum(app), each init)
+        pod = make_pod([{"cpu": 1}], init_containers=[{"cpu": 4}])
+        assert res.pod_requests(pod)["cpu"] == 4
+
+    def test_overhead_added(self):
+        pod = make_pod([{"cpu": 1}], overhead={"cpu": 0.5})
+        assert res.pod_requests(pod)["cpu"] == pytest.approx(1.5)
+
+    def test_requests_for_pods(self):
+        p1 = make_pod([{"cpu": 1}])
+        p2 = make_pod([{"cpu": 2, "memory": 8}])
+        assert res.requests_for_pods(p1, p2) == {"cpu": 3, "memory": 8}

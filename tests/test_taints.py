@@ -1,0 +1,75 @@
+"""Taint / toleration tests (reference pkg/scheduling/taints.go)."""
+
+from karpenter_tpu.apis.objects import (
+    NO_EXECUTE,
+    NO_SCHEDULE,
+    PREFER_NO_SCHEDULE,
+    Pod,
+    PodSpec,
+    Taint,
+    Toleration,
+)
+from karpenter_tpu.scheduling import Taints
+
+
+def pod_with(*tolerations):
+    return Pod(spec=PodSpec(tolerations=list(tolerations)))
+
+
+class TestTolerates:
+    def test_no_taints_always_ok(self):
+        assert Taints().tolerates(pod_with()) == []
+
+    def test_untolerated(self):
+        taints = Taints([Taint(key="gpu", effect=NO_SCHEDULE, value="true")])
+        errs = taints.tolerates(pod_with())
+        assert errs and "gpu" in errs[0]
+
+    def test_equal_match(self):
+        taints = Taints([Taint(key="gpu", effect=NO_SCHEDULE, value="true")])
+        tol = Toleration(key="gpu", operator="Equal", value="true", effect=NO_SCHEDULE)
+        assert taints.tolerates(pod_with(tol)) == []
+        wrong_value = Toleration(key="gpu", operator="Equal", value="false", effect=NO_SCHEDULE)
+        assert taints.tolerates(pod_with(wrong_value))
+
+    def test_exists_match(self):
+        taints = Taints([Taint(key="gpu", effect=NO_SCHEDULE, value="true")])
+        tol = Toleration(key="gpu", operator="Exists")
+        assert taints.tolerates(pod_with(tol)) == []
+
+    def test_tolerate_everything(self):
+        taints = Taints([Taint(key="a", effect=NO_SCHEDULE), Taint(key="b", effect=NO_EXECUTE)])
+        tol = Toleration(operator="Exists")  # empty key Exists tolerates all
+        assert taints.tolerates(pod_with(tol)) == []
+
+    def test_effect_scoping(self):
+        taints = Taints([Taint(key="k", effect=NO_EXECUTE)])
+        tol = Toleration(key="k", operator="Exists", effect=NO_SCHEDULE)
+        assert taints.tolerates(pod_with(tol))  # wrong effect
+        tol2 = Toleration(key="k", operator="Exists", effect="")
+        assert taints.tolerates(pod_with(tol2)) == []  # empty effect matches all
+
+    def test_multiple_taints_all_must_be_tolerated(self):
+        taints = Taints([
+            Taint(key="a", effect=NO_SCHEDULE),
+            Taint(key="b", effect=NO_SCHEDULE),
+        ])
+        tol_a = Toleration(key="a", operator="Exists")
+        errs = taints.tolerates(pod_with(tol_a))
+        assert len(errs) == 1 and "b" in errs[0]
+
+
+class TestMerge:
+    def test_merge_dedupes_by_key_and_effect(self):
+        a = Taints([Taint(key="k", effect=NO_SCHEDULE, value="v1")])
+        b = [Taint(key="k", effect=NO_SCHEDULE, value="v2"), Taint(key="k", effect=NO_EXECUTE)]
+        out = a.merge(b)
+        assert len(out) == 2
+        # existing entry wins on conflict
+        assert out[0].value == "v1"
+        assert out[1].effect == NO_EXECUTE
+
+    def test_merge_prefer_no_schedule_distinct(self):
+        a = Taints([Taint(key="k", effect=NO_SCHEDULE)])
+        out = a.merge([Taint(key="k", effect=PREFER_NO_SCHEDULE)])
+        assert len(out) == 2
