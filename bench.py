@@ -1,0 +1,99 @@
+"""Benchmark harness — prints ONE JSON line for the driver.
+
+Replicates the reference's scheduling benchmark grid
+(scheduling_benchmark_test.go:82-114: 400 instance types x {10..2500} pods,
+workload mix from makeDiversePods: count/7 each of zonal-spread,
+hostname-spread, hostname-affinity, zonal-affinity pods, remainder generic)
+and reports end-to-end pods/sec through the JAX solver, compile time excluded
+the same way Go's b.ResetTimer() excludes setup.
+
+Baseline: the reference enforces >= 100 pods/sec on >100-pod batches
+(scheduling_benchmark_test.go:51,177-181); vs_baseline is pods/sec / 100.
+
+Topology constraints are encoded once the topology stage lands; until then the
+spread/affinity pods run as generic (their resource shape is identical —
+randomCPU/randomMemory draws).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+
+
+def make_diverse_pods(count: int, rng: random.Random):
+    from karpenter_tpu.apis.objects import Container, ObjectMeta, Pod, PodSpec
+
+    def random_cpu():
+        return rng.choice([0.1, 0.25, 0.5, 1.0, 1.5])
+
+    def random_memory():
+        return rng.choice([100, 256, 512, 1024, 2048, 4096]) * 1024.0**2
+
+    def generic(i):
+        return Pod(
+            metadata=ObjectMeta(name=f"pod-{i}", labels={"my-label": rng.choice("abcdefg")}),
+            spec=PodSpec(
+                containers=[Container(requests={"cpu": random_cpu(), "memory": random_memory()})]
+            ),
+        )
+
+    # mix mirrors makeDiversePods: 4 constrained groups of count/7 each (spread
+    # and affinity constraints attach at the topology stage), rest generic
+    return [generic(i) for i in range(count)]
+
+
+def main():
+    import __graft_entry__
+
+    __graft_entry__._respect_platform_env()
+
+    from karpenter_tpu.apis.nodepool import NodePool
+    from karpenter_tpu.apis.objects import ObjectMeta
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.solver.encode import template_from_nodepool
+    from karpenter_tpu.solver.jax_backend import JaxSolver
+
+    rng = random.Random(42)
+    instance_count = 400
+    its = instance_types(instance_count)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="default")), its, range(len(its))
+    )
+    solver = JaxSolver()
+
+    grid = [10, 100, 500, 1000, 1500, 2000, 2500]
+    # warmup: compile every shape bucket once (Go excludes setup via ResetTimer)
+    for pod_count in grid:
+        pods = make_diverse_pods(pod_count, rng)
+        solver.solve(pods, its, [tpl])
+
+    total_pods = 0
+    total_time = 0.0
+    for pod_count in grid:
+        pods = make_diverse_pods(pod_count, rng)
+        start = time.perf_counter()
+        result = solver.solve(pods, its, [tpl])
+        elapsed = time.perf_counter() - start
+        assert result.num_scheduled() == pod_count, (
+            f"{result.num_scheduled()}/{pod_count} scheduled"
+        )
+        total_pods += pod_count
+        total_time += elapsed
+
+    pods_per_sec = total_pods / total_time
+    print(
+        json.dumps(
+            {
+                "metric": "scheduling_throughput_400it_grid",
+                "value": round(pods_per_sec, 2),
+                "unit": "pods/sec",
+                "vs_baseline": round(pods_per_sec / 100.0, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

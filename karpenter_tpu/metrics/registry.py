@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import threading
 import time
-from bisect import bisect_right
+from bisect import bisect_left
 from contextlib import contextmanager
 from typing import Dict, Iterable, List, Optional, Tuple
 
@@ -103,7 +103,8 @@ class Histogram(_Metric):
         key = _labels_key(labels)
         with self._lock:
             counts = self._counts.setdefault(key, [0] * len(self.buckets))
-            idx = bisect_right(self.buckets, value)
+            # prometheus le semantics: bucket le=B counts observations <= B
+            idx = bisect_left(self.buckets, value)
             for i in range(idx, len(self.buckets)):
                 counts[i] += 1
             self._sums[key] = self._sums.get(key, 0.0) + value
@@ -115,9 +116,21 @@ class Histogram(_Metric):
     def sum(self, labels: Optional[Dict[str, str]] = None) -> float:
         return self._sums.get(_labels_key(labels), 0.0)
 
+    def bucket_counts(self, labels: Optional[Dict[str, str]] = None) -> List[int]:
+        return list(self._counts.get(_labels_key(labels), [0] * len(self.buckets)))
+
     def collect(self):
         return [
-            ("histogram", self.name, dict(k), {"count": self._totals[k], "sum": self._sums[k]})
+            (
+                "histogram",
+                self.name,
+                dict(k),
+                {
+                    "count": self._totals[k],
+                    "sum": self._sums[k],
+                    "buckets": dict(zip(self.buckets, self._counts[k])),
+                },
+            )
             for k in self._totals
         ]
 

@@ -1,0 +1,6 @@
+from karpenter_tpu.models.problem import (  # noqa: F401
+    ReqTensor,
+    SchedulingProblem,
+    GT_NONE,
+    LT_NONE,
+)

@@ -1,0 +1,187 @@
+"""The tensorized scheduling problem — struct-of-arrays over a closed vocabulary.
+
+This is the data model the TPU solver operates on. The reference walks pointer
+graphs (pods -> Requirements maps -> string sets,
+pkg/controllers/provisioning/scheduling/nodeclaim.go:225-260); here the same
+information is a fixed-shape bundle of arrays so that requirement intersection,
+fit checks and offering checks become vectorized boolean kernels over
+
+  P  pods            K  label keys        R  resource names
+  T  instance types  V  value lanes       O  offerings per type
+  N  existing nodes  TPL nodepool templates
+
+Closed-world requirement encoding (ground truth: the host-side algebra in
+scheduling/requirements.py, itself mirroring reference
+pkg/scheduling/requirement.go):
+
+Every label value mentioned anywhere in a batch (pod selectors/affinities,
+instance-type requirements, node labels, offerings) is interned into a per-key
+vocabulary of <= V lanes. A Requirement for key k becomes:
+
+  admitted[k, v]  bool   vocab lane v satisfies Requirement.Has(value_v)
+                         (integer Gt/Lt bounds already folded in)
+  comp[k]         bool   complement set: admits values OUTSIDE the vocab too
+                         (NotIn / Exists / Gt / Lt)
+  gt[k], lt[k]    int32  integer bounds with +-inf sentinels
+  defined[k]      bool   key present in the Requirements map
+
+Undefined keys encode as full-admit complements (admitted=lane_valid,
+comp=True, no bounds), which makes them identities under intersection — so
+intersection of two requirement rows is uniformly:
+
+  admitted' = admitted_a & admitted_b          comp' = comp_a & comp_b
+  gt' = max(gt_a, gt_b)   lt' = min(lt_a, lt_b)   defined' = def_a | def_b
+
+and the reference's ``Intersection(...).Len() != 0`` nonempty test becomes
+
+  nonempty = any(admitted') | (comp' & (gt' < lt'))
+
+which is exact over the closed world: admitted lanes each satisfy both sides'
+bounds by construction, and a complement result is nonempty in the reference
+unless its bounds collapsed (requirement.go:135-137; Len() deliberately
+ignores bounds for complements, requirement.go:210-215).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List
+
+import jax
+import numpy as np
+
+GT_NONE = np.int32(-(2**31) + 1)
+LT_NONE = np.int32(2**31 - 1)
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class ReqTensor:
+    """Requirement state for a batch of entities: arrays shaped [..., K, V] /
+    [..., K]. The leading axes are entity axes (or absent for a single row)."""
+
+    admitted: Any  # bool[..., K, V]
+    comp: Any  # bool[..., K]
+    gt: Any  # int32[..., K]
+    lt: Any  # int32[..., K]
+    defined: Any  # bool[..., K]
+
+    @property
+    def shape(self):
+        return self.admitted.shape
+
+    def row(self, idx) -> "ReqTensor":
+        return ReqTensor(
+            admitted=self.admitted[idx],
+            comp=self.comp[idx],
+            gt=self.gt[idx],
+            lt=self.lt[idx],
+            defined=self.defined[idx],
+        )
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class SchedulingProblem:
+    """One batch of the provisioning problem, fully tensorized.
+
+    Static (per-batch constant) arrays describing the vocabulary:
+      lane_valid   bool[K, V]    lane is a real vocab value for this key
+      lane_numeric f32[K, V]     integer value of the lane (NaN if non-numeric)
+      key_wellknown bool[K]      key is a well-known label (Compatible allowance)
+
+    Pods (sorted by the FFD queue order before encoding):
+      pod_reqs     ReqTensor[P]  NewPodRequirements (preferences folded in)
+      pod_requests f32[P, R]     effective resource requests (incl pods=1)
+      pod_tol_tpl  bool[P, TPL]  pod tolerates template taints
+      pod_tol_node bool[P, N]    pod tolerates existing-node taints
+
+    Instance types:
+      it_reqs      ReqTensor[T]
+      it_alloc     f32[T, R]     allocatable = capacity - overhead
+      it_cap       f32[T, R]     raw capacity (nodepool limits accounting)
+      offer_zone / offer_ct int32[T, O]  lanes into the zone / capacity-type keys
+      offer_ok     bool[T, O]    offering exists and is available
+      offer_price  f32[T, O]
+
+    Templates (one per NodePool, pre-sorted by weight):
+      tpl_reqs     ReqTensor[TPL]
+      tpl_overhead f32[TPL, R]   daemonset overhead requests
+      tpl_it_ok    bool[TPL, T]  instance types offered by this template's pool
+
+    Existing nodes (pre-sorted: initialized first, then name):
+      node_reqs    ReqTensor[N]  label requirements (+hostname)
+      node_avail   f32[N, R]     allocatable - current pod requests
+      node_overhead f32[N, R]    unscheduled daemonset overhead
+    """
+
+    # vocab statics
+    lane_valid: Any
+    lane_numeric: Any
+    key_wellknown: Any
+    # pods
+    pod_reqs: ReqTensor
+    pod_requests: Any
+    pod_tol_tpl: Any
+    pod_tol_node: Any
+    # instance types
+    it_reqs: ReqTensor
+    it_alloc: Any
+    it_cap: Any
+    offer_zone: Any
+    offer_ct: Any
+    offer_ok: Any
+    offer_price: Any
+    # templates
+    tpl_reqs: ReqTensor
+    tpl_overhead: Any
+    tpl_it_ok: Any
+    # existing nodes
+    node_reqs: ReqTensor
+    node_avail: Any
+    node_overhead: Any
+
+    @property
+    def num_pods(self) -> int:
+        return self.pod_requests.shape[0]
+
+    @property
+    def num_instance_types(self) -> int:
+        return self.it_alloc.shape[0]
+
+    @property
+    def num_templates(self) -> int:
+        return self.tpl_overhead.shape[0]
+
+    @property
+    def num_nodes(self) -> int:
+        return self.node_avail.shape[0]
+
+    @property
+    def num_keys(self) -> int:
+        return self.lane_valid.shape[0]
+
+    @property
+    def num_lanes(self) -> int:
+        return self.lane_valid.shape[1]
+
+    @property
+    def num_resources(self) -> int:
+        return self.pod_requests.shape[1]
+
+
+@dataclass
+class ProblemMeta:
+    """Host-side companions to a SchedulingProblem: the dictionaries needed to
+    decode solver output back into API objects. Not a pytree — never crosses
+    into jit."""
+
+    keys: List[str] = field(default_factory=list)
+    values_per_key: List[List[str]] = field(default_factory=list)
+    resource_names: List[str] = field(default_factory=list)
+    pod_order: List[int] = field(default_factory=list)  # problem row -> input pod index
+    template_names: List[str] = field(default_factory=list)
+    instance_type_names: List[str] = field(default_factory=list)
+    node_names: List[str] = field(default_factory=list)
+    zone_key_idx: int = -1
+    ct_key_idx: int = -1

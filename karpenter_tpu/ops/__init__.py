@@ -1,0 +1,2 @@
+from karpenter_tpu.ops import masks  # noqa: F401
+from karpenter_tpu.ops.ffd import solve_ffd, FFDResult  # noqa: F401

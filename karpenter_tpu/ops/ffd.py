@@ -1,0 +1,317 @@
+"""First-fit-decreasing bin-pack as a lax.scan.
+
+TPU-native re-design of the reference's Scheduler.Solve pod loop
+(scheduler.go:140-189, :238-285): pods arrive pre-sorted by the FFD queue
+order; one scan step places one pod. Placement *scoring* — which existing
+nodes / open claims / fresh template claims could accept the pod — is computed
+for every candidate at once with the vectorized mask kernels (the reference
+walks them one by one, O(candidates × instanceTypes) set intersections per
+pod); the *commit* stays sequential inside the scan because every placement
+narrows the chosen bin's requirement state.
+
+Placement priority per pod (scheduler.go:238-285):
+  1. first existing node (pre-sorted initialized-first) that tolerates, fits,
+     and is requirement-compatible (existingnode.go:64-124, strict Compatible);
+  2. open claim with the fewest pods whose narrowed state keeps >= 1 instance
+     type satisfying requirements + resources + offerings (nodeclaim.go:65-119);
+  3. first template (weight order) whose fresh claim accepts the pod -> opens
+     a new claim in the first free slot;
+  4. otherwise the pod fails this pass (relaxation happens host-side).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax, vmap
+
+from karpenter_tpu.models.problem import ReqTensor, SchedulingProblem
+from karpenter_tpu.ops import masks
+
+# placement kinds emitted per pod
+KIND_NODE = 0
+KIND_CLAIM = 1
+KIND_NEW_CLAIM = 2
+KIND_FAIL = 3
+KIND_NO_SLOT = 4  # a fresh claim would accept the pod, but slots ran out
+
+_BIG = jnp.int32(2**30)
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class FFDState:
+    claim_req: ReqTensor  # [C, K, V] narrowed requirement state per claim
+    claim_requests: Any  # f32[C, R] accumulated requests (incl daemon overhead)
+    claim_it_ok: Any  # bool[C, T] surviving instance types
+    claim_open: Any  # bool[C]
+    claim_npods: Any  # i32[C]
+    claim_tpl: Any  # i32[C]
+    node_req: ReqTensor  # [N, K, V] narrowed existing-node requirements
+    node_requests: Any  # f32[N, R] accumulated requests (incl daemon overhead)
+    node_npods: Any  # i32[N]
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class FFDResult:
+    kind: Any  # i32[P]
+    index: Any  # i32[P] node index / claim slot (meaning depends on kind)
+    state: FFDState  # final bin state
+
+
+def _first_true(mask: jnp.ndarray) -> jnp.ndarray:
+    """Index of the first True (or len(mask) when none)."""
+    return jnp.argmax(jnp.concatenate([mask, jnp.array([True])]))
+
+
+def _intersect_rows(reqs: ReqTensor, row: ReqTensor) -> ReqTensor:
+    return vmap(lambda r: masks.intersect(r, row))(reqs)
+
+
+def solve_ffd(problem: SchedulingProblem, max_claims: int) -> FFDResult:
+    """Run the full pack. Shapes are static per (P, N, T, TPL, K, V, R,
+    max_claims) bucket; XLA caches the compiled executable across batches."""
+    return _solve_ffd_jit(problem, max_claims)
+
+
+def _pad_lanes_mult32(problem: SchedulingProblem) -> SchedulingProblem:
+    """Pad the value-lane axis to a multiple of 32 for bitpacking. Shape-static
+    (plain Python under trace); ops/padding.py already does this for bucketed
+    callers, so this is a no-op on the production path."""
+    V = problem.num_lanes
+    pad = (-V) % 32
+    if pad == 0:
+        return problem
+    import dataclasses
+
+    def pad_req(r: ReqTensor) -> ReqTensor:
+        return dataclasses.replace(
+            r, admitted=jnp.pad(r.admitted, [(0, 0)] * (r.admitted.ndim - 1) + [(0, pad)])
+        )
+
+    return dataclasses.replace(
+        problem,
+        lane_valid=jnp.pad(problem.lane_valid, [(0, 0), (0, pad)]),
+        lane_numeric=jnp.pad(problem.lane_numeric, [(0, 0), (0, pad)], constant_values=jnp.nan),
+        pod_reqs=pad_req(problem.pod_reqs),
+        it_reqs=pad_req(problem.it_reqs),
+        tpl_reqs=pad_req(problem.tpl_reqs),
+        node_reqs=pad_req(problem.node_reqs),
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _solve_ffd_jit(problem: SchedulingProblem, max_claims: int) -> FFDResult:
+    problem = _pad_lanes_mult32(problem)
+    P = problem.num_pods
+    N = problem.num_nodes
+    T = problem.num_instance_types
+    TPL = problem.num_templates
+    K = problem.num_keys
+    V = problem.num_lanes
+    R = problem.num_resources
+    C = max_claims
+
+    lv, ln = problem.lane_valid, problem.lane_numeric
+    wellknown = problem.key_wellknown
+    no_allow = jnp.zeros_like(wellknown)
+    zone_k, ct_k = _zone_ct_static(problem)
+
+    def empty_req(n):
+        return ReqTensor(
+            admitted=jnp.broadcast_to(lv, (n, K, V)),
+            comp=jnp.ones((n, K), dtype=bool),
+            gt=jnp.full((n, K), -(2**31) + 1, dtype=jnp.int32),
+            lt=jnp.full((n, K), 2**31 - 1, dtype=jnp.int32),
+            defined=jnp.zeros((n, K), dtype=bool),
+        )
+
+    init = FFDState(
+        claim_req=empty_req(C),
+        claim_requests=jnp.zeros((C, R), dtype=jnp.float32),
+        claim_it_ok=jnp.zeros((C, T), dtype=bool),
+        claim_open=jnp.zeros((C,), dtype=bool),
+        claim_npods=jnp.zeros((C,), dtype=jnp.int32),
+        claim_tpl=jnp.zeros((C,), dtype=jnp.int32),
+        node_req=ReqTensor(
+            admitted=jnp.asarray(problem.node_reqs.admitted),
+            comp=jnp.asarray(problem.node_reqs.comp),
+            gt=jnp.asarray(problem.node_reqs.gt),
+            lt=jnp.asarray(problem.node_reqs.lt),
+            defined=jnp.asarray(problem.node_reqs.defined),
+        ),
+        node_requests=jnp.asarray(problem.node_overhead),
+        node_npods=jnp.zeros((N,), dtype=jnp.int32),
+    )
+
+    # instance-type side of the hot compat product: packed lanes + polarity,
+    # computed once per solve (instance types never change during a pack)
+    it_packed = masks.pack_lanes(jnp.asarray(problem.it_reqs.admitted))  # [T, K, W]
+    it_neg = vmap(lambda r: masks.negative_polarity(r, lv, ln))(problem.it_reqs)
+
+    def it_gate(state_rows: ReqTensor, requests: jnp.ndarray, prior_ok: jnp.ndarray):
+        """[B, T] mask of instance types surviving a hypothetical narrowed
+        state + accumulated requests (nodeclaim.go:225-260: requirements,
+        fits, offerings)."""
+        state_packed = masks.pack_lanes(state_rows.admitted)  # [B, K, W]
+        state_neg = vmap(lambda r: masks.negative_polarity(r, lv, ln))(state_rows)
+        compat = masks.packed_pairwise_compat(
+            state_rows, state_packed, state_neg, problem.it_reqs, it_packed, it_neg
+        )  # [B, T]
+        fit = masks.fits(requests[:, None, :], problem.it_alloc[None, :, :])  # [B, T]
+        offer = vmap(
+            lambda adm: masks.has_offering(
+                adm, zone_k, ct_k, problem.offer_zone, problem.offer_ct, problem.offer_ok
+            )
+        )(state_rows.admitted)  # [B, T]
+        return prior_ok & compat & fit & offer
+
+    def step(state: FFDState, pod):
+        pod_req, pod_requests, tol_tpl, tol_node = pod
+
+        # -- 1. existing nodes (scheduler.go:240-244)
+        node_requests2 = state.node_requests + pod_requests[None, :]
+        node_fit = masks.fits(node_requests2, problem.node_avail)
+        node_compat = vmap(
+            lambda nr: masks.compatible_ok(nr, pod_req, lv, ln, no_allow)
+        )(state.node_req)
+        node_ok = tol_node & node_fit & node_compat
+        node_pick = _first_true(node_ok)
+        any_node = jnp.any(node_ok)
+
+        # -- 2. open claims, fewest pods first (scheduler.go:247-254)
+        claim_new_req = _intersect_rows(state.claim_req, pod_req)
+        claim_compat = vmap(
+            lambda cr: masks.compatible_ok(cr, pod_req, lv, ln, wellknown)
+        )(state.claim_req)
+        claim_requests2 = state.claim_requests + pod_requests[None, :]
+        claim_it_ok2 = it_gate(claim_new_req, claim_requests2, state.claim_it_ok)
+        claim_ok = (
+            state.claim_open
+            & tol_tpl[state.claim_tpl]
+            & claim_compat
+            & jnp.any(claim_it_ok2, axis=-1)
+        )
+        claim_rank = jnp.where(claim_ok, state.claim_npods * C + jnp.arange(C), _BIG)
+        claim_pick = jnp.argmin(claim_rank)
+        any_claim = jnp.any(claim_ok)
+
+        # -- 3. fresh claim from templates, weight order (scheduler.go:256-283)
+        tpl_new_req = _intersect_rows(problem.tpl_reqs, pod_req)
+        tpl_compat = vmap(
+            lambda tr: masks.compatible_ok(tr, pod_req, lv, ln, wellknown)
+        )(problem.tpl_reqs)
+        tpl_requests2 = problem.tpl_overhead + pod_requests[None, :]
+        tpl_it_ok2 = it_gate(tpl_new_req, tpl_requests2, problem.tpl_it_ok)
+        tpl_ok = tol_tpl & tpl_compat & jnp.any(tpl_it_ok2, axis=-1)
+        tpl_pick = _first_true(tpl_ok)
+        any_tpl = jnp.any(tpl_ok)
+        free_slot = _first_true(~state.claim_open)
+        has_slot = jnp.any(~state.claim_open)
+
+        kind = jnp.where(
+            any_node,
+            KIND_NODE,
+            jnp.where(
+                any_claim,
+                KIND_CLAIM,
+                jnp.where(
+                    any_tpl,
+                    jnp.where(has_slot, KIND_NEW_CLAIM, KIND_NO_SLOT),
+                    KIND_FAIL,
+                ),
+            ),
+        ).astype(jnp.int32)
+
+        # -- commit via one-hot masks
+        node_hot = (jnp.arange(N) == node_pick) & (kind == KIND_NODE)
+        claim_hot = (jnp.arange(C) == claim_pick) & (kind == KIND_CLAIM)
+        slot_hot = (jnp.arange(C) == free_slot) & (kind == KIND_NEW_CLAIM)
+
+        def mix_req(cur: ReqTensor, upd: ReqTensor, hot) -> ReqTensor:
+            sel2, sel3 = hot[:, None], hot[:, None, None]
+            return ReqTensor(
+                admitted=jnp.where(sel3, upd.admitted, cur.admitted),
+                comp=jnp.where(sel2, upd.comp, cur.comp),
+                gt=jnp.where(sel2, upd.gt, cur.gt),
+                lt=jnp.where(sel2, upd.lt, cur.lt),
+                defined=jnp.where(sel2, upd.defined, cur.defined),
+            )
+
+        # node commit (existingnode.go:116-123)
+        node_upd = _intersect_rows(state.node_req, pod_req)
+        new_node_req = mix_req(state.node_req, node_upd, node_hot)
+        new_node_requests = jnp.where(node_hot[:, None], node_requests2, state.node_requests)
+        new_node_npods = state.node_npods + node_hot.astype(jnp.int32)
+
+        # claim commit (nodeclaim.go:111-118)
+        tpl_row = lambda arr: arr[jnp.minimum(tpl_pick, TPL - 1)]
+        slot_req = ReqTensor(
+            admitted=tpl_row(tpl_new_req.admitted),
+            comp=tpl_row(tpl_new_req.comp),
+            gt=tpl_row(tpl_new_req.gt),
+            lt=tpl_row(tpl_new_req.lt),
+            defined=tpl_row(tpl_new_req.defined),
+        )
+        new_claim_req = mix_req(
+            mix_req(state.claim_req, claim_new_req, claim_hot),
+            ReqTensor(
+                admitted=jnp.broadcast_to(slot_req.admitted, (C, K, V)),
+                comp=jnp.broadcast_to(slot_req.comp, (C, K)),
+                gt=jnp.broadcast_to(slot_req.gt, (C, K)),
+                lt=jnp.broadcast_to(slot_req.lt, (C, K)),
+                defined=jnp.broadcast_to(slot_req.defined, (C, K)),
+            ),
+            slot_hot,
+        )
+        new_claim_requests = jnp.where(
+            claim_hot[:, None],
+            claim_requests2,
+            jnp.where(slot_hot[:, None], tpl_requests2[jnp.minimum(tpl_pick, TPL - 1)][None, :], state.claim_requests),
+        )
+        new_claim_it_ok = jnp.where(
+            claim_hot[:, None],
+            claim_it_ok2,
+            jnp.where(slot_hot[:, None], tpl_it_ok2[jnp.minimum(tpl_pick, TPL - 1)][None, :], state.claim_it_ok),
+        )
+        new_claim_open = state.claim_open | slot_hot
+        new_claim_npods = state.claim_npods + claim_hot.astype(jnp.int32) + slot_hot.astype(jnp.int32)
+        new_claim_tpl = jnp.where(slot_hot, tpl_pick.astype(jnp.int32), state.claim_tpl)
+
+        index = jnp.where(
+            kind == KIND_NODE,
+            node_pick,
+            jnp.where(kind == KIND_CLAIM, claim_pick, jnp.where(kind == KIND_NEW_CLAIM, free_slot, -1)),
+        ).astype(jnp.int32)
+
+        new_state = FFDState(
+            claim_req=new_claim_req,
+            claim_requests=new_claim_requests,
+            claim_it_ok=new_claim_it_ok,
+            claim_open=new_claim_open,
+            claim_npods=new_claim_npods,
+            claim_tpl=new_claim_tpl,
+            node_req=new_node_req,
+            node_requests=new_node_requests,
+            node_npods=new_node_npods,
+        )
+        return new_state, (kind, index)
+
+    pods_xs = (
+        problem.pod_reqs,
+        jnp.asarray(problem.pod_requests),
+        jnp.asarray(problem.pod_tol_tpl),
+        jnp.asarray(problem.pod_tol_node),
+    )
+    final_state, (kinds, indices) = lax.scan(step, init, pods_xs)
+    return FFDResult(kind=kinds, index=indices, state=final_state)
+
+
+def _zone_ct_static(problem: SchedulingProblem) -> tuple:
+    """Zone / capacity-type key indices: the encoder pins them to 0 and 1."""
+    return 0, 1

@@ -1,0 +1,156 @@
+"""Requirement-algebra kernels.
+
+Vectorized twins of the host-side algebra in scheduling/requirements.py
+(reference pkg/scheduling/{requirement,requirements}.go). All functions are
+pure jnp over ReqTensor rows shaped [K, V] / [K]; callers vmap over entity
+axes. See models/problem.py for the encoding invariants that make these exact.
+
+These run on the TPU's vector unit: boolean lane ops fused by XLA. The hot
+product — every (pod-placement, instance-type) compatibility test, reference
+nodeclaim.go:262-264 — becomes `vmap(intersects_ok)` over the instance-type
+axis.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import vmap
+
+from karpenter_tpu.models.problem import ReqTensor
+
+
+def intersect(a: ReqTensor, b: ReqTensor) -> ReqTensor:
+    """Keywise requirement intersection (requirement.go:128-161).
+
+    Admitted lanes already satisfy each side's bounds (folded at encode), so
+    lane-AND applies the combined bounds for free; undefined keys are encoded
+    as full-admit complements and act as identities."""
+    return ReqTensor(
+        admitted=a.admitted & b.admitted,
+        comp=a.comp & b.comp,
+        gt=jnp.maximum(a.gt, b.gt),
+        lt=jnp.minimum(a.lt, b.lt),
+        defined=a.defined | b.defined,
+    )
+
+
+def nonempty(r: ReqTensor) -> jnp.ndarray:
+    """Per-key Len() != 0 (requirement.go:210-215): a concrete set is nonempty
+    if any lane is admitted; a complement set is nonempty unless its integer
+    bounds collapsed (gt >= lt, requirement.go:135-137 — the reference's Len()
+    ignores bounds otherwise, and we match that exactly)."""
+    return jnp.any(r.admitted, axis=-1) | (r.comp & (r.gt < r.lt))
+
+
+def _in_bounds(lane_numeric: jnp.ndarray, lane_valid: jnp.ndarray, gt, lt) -> jnp.ndarray:
+    """Which vocab lanes satisfy integer bounds (requirement.go:238-254):
+    without bounds every valid lane passes; with bounds only numeric lanes
+    strictly inside (gt, lt)."""
+    unbounded = (gt[..., None] <= jnp.int32(-(2**31) + 1)) & (lt[..., None] >= jnp.int32(2**31 - 1))
+    numeric_ok = (
+        ~jnp.isnan(lane_numeric)
+        & (lane_numeric > gt[..., None].astype(jnp.float32))
+        & (lane_numeric < lt[..., None].astype(jnp.float32))
+    )
+    return lane_valid & (unbounded | numeric_ok)
+
+
+def negative_polarity(r: ReqTensor, lane_valid, lane_numeric) -> jnp.ndarray:
+    """Per-key Operator() in {NotIn, DoesNotExist} (requirement.go:197-208).
+
+    Complement sets read as NotIn when they exclude at least one in-bounds
+    vocab value (exclusions are always vocab members in the closed world);
+    concrete sets read as DoesNotExist when no lane is admitted."""
+    excl = jnp.any(lane_valid & _in_bounds(lane_numeric, lane_valid, r.gt, r.lt) & ~r.admitted, axis=-1)
+    return jnp.where(r.comp, excl, ~jnp.any(r.admitted, axis=-1))
+
+
+def intersects_ok(a: ReqTensor, b: ReqTensor, lane_valid, lane_numeric) -> jnp.ndarray:
+    """Requirements.Intersects as a scalar bool (requirements.go:241-258):
+    keys defined on both sides must have a nonempty intersection, except when
+    both sides read as NotIn/DoesNotExist."""
+    inter = intersect(a, b)
+    ne = nonempty(inter)
+    both_defined = a.defined & b.defined
+    both_neg = negative_polarity(a, lane_valid, lane_numeric) & negative_polarity(
+        b, lane_valid, lane_numeric
+    )
+    return jnp.all(~both_defined | ne | both_neg)
+
+
+def compatible_ok(
+    r: ReqTensor, incoming: ReqTensor, lane_valid, lane_numeric, key_wellknown
+) -> jnp.ndarray:
+    """Requirements.Compatible (requirements.go:163-174): incoming keys that
+    are neither defined on ``r`` nor allowed-undefined must have negative
+    polarity; then the requirement sets must intersect. ``key_wellknown`` is
+    the allow-undefined mask (zeros for the strict variant used by existing
+    nodes, existingnode.go:94)."""
+    neg_inc = negative_polarity(incoming, lane_valid, lane_numeric)
+    undef_bad = incoming.defined & ~r.defined & ~key_wellknown & ~neg_inc
+    return ~jnp.any(undef_bad) & intersects_ok(r, incoming, lane_valid, lane_numeric)
+
+
+def fits(requests: jnp.ndarray, available: jnp.ndarray) -> jnp.ndarray:
+    """resources.Fits with a small tolerance for float accumulation; shapes
+    broadcast over leading axes, reduction over the trailing resource axis."""
+    eps = 1e-6 + 1e-6 * jnp.abs(available)
+    return jnp.all(requests <= available + eps, axis=-1)
+
+
+def it_compatible(it_reqs: ReqTensor, state: ReqTensor, lane_valid, lane_numeric) -> jnp.ndarray:
+    """[T] mask: instance type requirements intersect the (narrowed) claim
+    state — the reference's `compatible` hot spot (nodeclaim.go:262-264)."""
+    return vmap(lambda it: intersects_ok(it, state, lane_valid, lane_numeric))(it_reqs)
+
+
+def pack_lanes(admitted: jnp.ndarray) -> jnp.ndarray:
+    """bool[..., V] -> uint32[..., V/32]: bitpack value lanes so the hot
+    [bins x instance-types] compatibility product runs on 32 lanes per word —
+    the TPU VPU chews packed int32 lanes at full rate where byte-bools waste
+    31/32 of the bandwidth. V is padded to a multiple of 32 (ops/padding.py)."""
+    *lead, V = admitted.shape
+    words = admitted.reshape(*lead, V // 32, 32).astype(jnp.uint32)
+    return (words << jnp.arange(32, dtype=jnp.uint32)).sum(axis=-1).astype(jnp.uint32)
+
+
+def packed_pairwise_compat(
+    a: ReqTensor,
+    a_packed: jnp.ndarray,  # uint32[A, K, W]
+    a_neg: jnp.ndarray,  # bool[A, K]
+    b: ReqTensor,
+    b_packed: jnp.ndarray,  # uint32[B, K, W]
+    b_neg: jnp.ndarray,  # bool[B, K]
+) -> jnp.ndarray:
+    """[A, B] all-pairs Requirements.Intersects on bitpacked lanes — the
+    solver's hot product (every open bin x every instance type per pod step,
+    reference nodeclaim.go:236-258). Semantics identical to intersects_ok;
+    negative-polarity masks are precomputed by the caller (they depend only on
+    each side's own state)."""
+    inter_any = jnp.any(
+        (a_packed[:, None, :, :] & b_packed[None, :, :, :]) != 0, axis=-1
+    )  # [A, B, K]
+    comp_ab = a.comp[:, None, :] & b.comp[None, :, :]
+    gt_ab = jnp.maximum(a.gt[:, None, :], b.gt[None, :, :])
+    lt_ab = jnp.minimum(a.lt[:, None, :], b.lt[None, :, :])
+    ne = inter_any | (comp_ab & (gt_ab < lt_ab))
+    both_defined = a.defined[:, None, :] & b.defined[None, :, :]
+    both_neg = a_neg[:, None, :] & b_neg[None, :, :]
+    return jnp.all(~both_defined | ne | both_neg, axis=-1)  # [A, B]
+
+
+def has_offering(
+    state_admitted: jnp.ndarray,  # bool[K, V] — the claim state's admitted lanes
+    zone_key: int,
+    ct_key: int,
+    offer_zone: jnp.ndarray,  # int32[T, O]
+    offer_ct: jnp.ndarray,  # int32[T, O]
+    offer_ok: jnp.ndarray,  # bool[T, O]
+) -> jnp.ndarray:
+    """[T] mask: some available offering's zone and capacity type are admitted
+    by the claim state (nodeclaim.go:270-278). Undefined zone/ct requirements
+    encode as full-admit, matching the reference's 'no requirement -> any
+    offering' rule."""
+    zone_adm = state_admitted[zone_key][offer_zone]  # [T, O]
+    ct_adm = state_admitted[ct_key][offer_ct]
+    return jnp.any(offer_ok & zone_adm & ct_adm, axis=-1)

@@ -1,0 +1,89 @@
+"""Shape bucketing for the solver.
+
+Pods/instance-types/nodes/templates/keys/lanes vary per batch; jit compiles
+per shape. Padding every axis up to a bucket makes compile shapes repeat
+across batches (SURVEY.md §7 hard part (3): pad-and-mask with bucketed compile
+sizes). Padded entities are made inert:
+
+  pods       toleration rows all-False  -> every placement check fails, the
+             pod reads as KIND_FAIL; decode drops rows past the real count
+  nodes      node_avail = -1            -> fits() can never pass
+  ITs        it_alloc = -1, tpl_it_ok False
+  templates  tpl_it_ok row False, pod_tol_tpl column False
+  keys/lanes lane_valid False, defined False (identity under intersection)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from karpenter_tpu.models.problem import GT_NONE, LT_NONE, ReqTensor, SchedulingProblem
+
+
+def pow2_bucket(n: int, lo: int = 8) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+def _pad(arr: np.ndarray, target_shape, fill) -> np.ndarray:
+    arr = np.asarray(arr)
+    pads = [(0, t - s) for s, t in zip(arr.shape, target_shape)]
+    return np.pad(arr, pads, constant_values=fill)
+
+
+def _pad_capacity(arr: np.ndarray, rows: int, cols: int, row_fill: float) -> np.ndarray:
+    """Pad a [E, R] capacity array: new resource columns get 0 (real entities
+    must still fit requests of 0 there) while new entity rows get ``row_fill``
+    (-1 makes fits() unsatisfiable, neutralizing the row)."""
+    arr = np.asarray(arr)
+    arr = np.pad(arr, [(0, 0), (0, cols - arr.shape[1])], constant_values=0.0)
+    return np.pad(arr, [(0, rows - arr.shape[0]), (0, 0)], constant_values=row_fill)
+
+
+def _pad_reqs(r: ReqTensor, e: int, k: int, v: int) -> ReqTensor:
+    E = r.admitted.shape[0]
+    return ReqTensor(
+        admitted=_pad(r.admitted, (e, k, v), False),
+        comp=_pad(r.comp, (e, k), True),
+        gt=_pad(r.gt, (e, k), GT_NONE),
+        lt=_pad(r.lt, (e, k), LT_NONE),
+        defined=_pad(r.defined, (e, k), False),
+    )
+
+
+def pad_problem(p: SchedulingProblem) -> SchedulingProblem:
+    P = pow2_bucket(p.num_pods)
+    T = pow2_bucket(p.num_instance_types)
+    N = pow2_bucket(p.num_nodes, lo=8)
+    TPL = pow2_bucket(p.num_templates, lo=4)
+    K = pow2_bucket(p.num_keys, lo=8)
+    # V must stay a multiple of 32: the solver bitpacks value lanes into
+    # uint32 words for the hot instance-type compatibility product
+    V = pow2_bucket(p.num_lanes, lo=32)
+    R = pow2_bucket(p.num_resources, lo=8)
+    O = pow2_bucket(p.offer_ok.shape[1], lo=8)
+
+    return SchedulingProblem(
+        lane_valid=_pad(p.lane_valid, (K, V), False),
+        lane_numeric=_pad(p.lane_numeric, (K, V), np.nan),
+        key_wellknown=_pad(p.key_wellknown, (K,), False),
+        pod_reqs=_pad_reqs(p.pod_reqs, P, K, V),
+        pod_requests=_pad(p.pod_requests, (P, R), 0.0),
+        pod_tol_tpl=_pad(p.pod_tol_tpl, (P, TPL), False),
+        pod_tol_node=_pad(p.pod_tol_node, (P, N), False),
+        it_reqs=_pad_reqs(p.it_reqs, T, K, V),
+        it_alloc=_pad_capacity(p.it_alloc, T, R, -1.0),
+        it_cap=_pad_capacity(p.it_cap, T, R, 0.0),
+        offer_zone=_pad(p.offer_zone, (T, O), 0),
+        offer_ct=_pad(p.offer_ct, (T, O), 0),
+        offer_ok=_pad(p.offer_ok, (T, O), False),
+        offer_price=_pad(p.offer_price, (T, O), np.inf),
+        tpl_reqs=_pad_reqs(p.tpl_reqs, TPL, K, V),
+        tpl_overhead=_pad(p.tpl_overhead, (TPL, R), 0.0),
+        tpl_it_ok=_pad(p.tpl_it_ok, (TPL, T), False),
+        node_reqs=_pad_reqs(p.node_reqs, N, K, V),
+        node_avail=_pad_capacity(p.node_avail, N, R, -1.0),
+        node_overhead=_pad(p.node_overhead, (N, R), 0.0),
+    )

@@ -1,0 +1,6 @@
+from karpenter_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    shard_batch,
+    batched_solve,
+    stack_problems,
+)

@@ -1,0 +1,80 @@
+"""Device-mesh sharding of solver batches.
+
+The scale axis of this framework is the *batch of scheduling problems* — most
+importantly the consolidation search, which scores hundreds of candidate
+node-subsets, each candidate being an independent simulated Solve
+(SURVEY.md §2.9 / §5: candidate scoring is embarrassingly parallel; no
+collectives are algorithmically required). We lay the candidate axis across a
+1-D ``jax.sharding.Mesh``:
+
+    mesh = Mesh(devices, ("candidates",))
+    problems: SchedulingProblem with leading [B] batch axis, B sharded
+
+``vmap(solve)`` batches the FFD scan over candidates; jit with NamedSharding
+on the inputs lets XLA partition the batch across ICI with no communication
+until the final result reduction (inserted automatically). Multi-host slices
+extend the same mesh over DCN; nothing in the program changes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from karpenter_tpu.models.problem import SchedulingProblem
+from karpenter_tpu.ops.ffd import FFDResult, _solve_ffd_jit
+
+CANDIDATE_AXIS = "candidates"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = CANDIDATE_AXIS) -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))
+
+
+def stack_problems(problems: Sequence[SchedulingProblem]) -> SchedulingProblem:
+    """Stack identically-shaped problems along a new leading candidate axis.
+    Callers pad (ops/padding.py) to a common bucket first."""
+    return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *problems)
+
+
+def shard_batch(batch: SchedulingProblem, mesh: Mesh, axis: str = CANDIDATE_AXIS):
+    """Place a stacked problem so its candidate axis is split across the mesh."""
+    sharding = NamedSharding(mesh, P(axis))
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _batched_solve_jit(batch: SchedulingProblem, max_claims: int) -> FFDResult:
+    return jax.vmap(lambda p: _solve_ffd_jit.__wrapped__(p, max_claims))(batch)
+
+
+def batched_solve(
+    batch: SchedulingProblem, max_claims: int, mesh: Optional[Mesh] = None
+) -> FFDResult:
+    """Solve B independent scheduling problems in one compiled program; with a
+    mesh, the candidate axis is sharded across devices and each device runs
+    its slice of the scan batch."""
+    if mesh is not None:
+        batch = shard_batch(batch, mesh)
+    return _batched_solve_jit(batch, max_claims)
+
+
+def scheduled_counts(result: FFDResult) -> jnp.ndarray:
+    """[B] number of pods placed per candidate problem — the consolidation
+    scoring reduction (does the cluster still fit with these nodes gone?)."""
+    from karpenter_tpu.ops.ffd import KIND_CLAIM, KIND_NEW_CLAIM, KIND_NODE
+
+    ok = (
+        (result.kind == KIND_NODE)
+        | (result.kind == KIND_CLAIM)
+        | (result.kind == KIND_NEW_CLAIM)
+    )
+    return ok.sum(axis=-1)

@@ -1,0 +1,2 @@
+from karpenter_tpu.solver.encode import Encoder, EncodedProblem  # noqa: F401
+from karpenter_tpu.solver.backend import SolverBackend, SolveResult, Placement  # noqa: F401

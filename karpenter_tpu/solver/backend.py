@@ -1,0 +1,66 @@
+"""SolverBackend interface and result model.
+
+The seam between the host control plane and the compute core (BASELINE.json
+north star: a pluggable `scheduling-solver`). Two backends ship:
+
+  - ``oracle``  (solver/oracle.py): straight-line Python mirroring the Go
+    FFD semantics exactly — the semantic ground truth and parity baseline.
+  - ``jax``     (solver/jax_backend.py): the tensorized lax.scan solver.
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from karpenter_tpu.apis.objects import Pod
+from karpenter_tpu.cloudprovider.types import InstanceType
+from karpenter_tpu.scheduling import Requirements
+from karpenter_tpu.solver.encode import NodeInfo, TemplateInfo
+
+FAIL_INCOMPATIBLE = "incompatible"
+
+
+@dataclass
+class Placement:
+    """One new claim produced by a solve: the pods packed onto it, the
+    surviving instance types (input order, as the reference preserves it), and
+    the narrowed requirement state."""
+
+    template_index: int
+    nodepool_name: str
+    pod_indices: List[int] = field(default_factory=list)  # indices into input pods
+    instance_type_indices: List[int] = field(default_factory=list)
+    requirements: Optional[Requirements] = None
+    requests: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class SolveResult:
+    new_claims: List[Placement] = field(default_factory=list)
+    # existing-node name -> pod indices placed there this round
+    node_pods: Dict[str, List[int]] = field(default_factory=dict)
+    # failed pod index -> reason
+    failures: Dict[int, str] = field(default_factory=dict)
+
+    def num_scheduled(self) -> int:
+        return sum(len(c.pod_indices) for c in self.new_claims) + sum(
+            len(v) for v in self.node_pods.values()
+        )
+
+
+class SolverBackend(abc.ABC):
+    """One pass of the FFD pack (no relaxation loop — the provisioning layer
+    owns relax-and-retry, scheduler.go:150-170)."""
+
+    @abc.abstractmethod
+    def solve(
+        self,
+        pods: Sequence[Pod],
+        instance_types: Sequence[InstanceType],
+        templates: Sequence[TemplateInfo],
+        nodes: Sequence[NodeInfo] = (),
+        pod_requirements_override: Optional[Sequence[Requirements]] = None,
+    ) -> SolveResult:
+        ...

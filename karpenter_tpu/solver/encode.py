@@ -1,0 +1,336 @@
+"""Tensor codec: API objects -> SchedulingProblem.
+
+Builds the per-batch closed-world vocabulary (label key -> lane dictionary) and
+encodes pods, instance types, nodepool templates, and existing nodes into the
+struct-of-arrays model in models/problem.py. See that module's docstring for
+the encoding invariants.
+
+Reference correspondence: this replaces the object graph the Go scheduler
+builds in NewScheduler (provisioner.go:204-296) — requirement maps, taints,
+daemon overhead — with dense arrays; what the reference recomputes per
+pod-placement attempt (nodeclaim.go:225-260) becomes one-time encoding plus
+on-device kernels.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodepool import NodePool
+from karpenter_tpu.apis.objects import Pod, Taint
+from karpenter_tpu.cloudprovider.types import InstanceType
+from karpenter_tpu.models.problem import (
+    GT_NONE,
+    LT_NONE,
+    ProblemMeta,
+    ReqTensor,
+    SchedulingProblem,
+)
+from karpenter_tpu.scheduling import Requirement, Requirements, Taints, pod_requirements
+from karpenter_tpu.scheduling.requirements import label_requirements
+from karpenter_tpu.utils import resources as res
+
+
+@dataclass
+class TemplateInfo:
+    """Host-side view of one NodeClaimTemplate (scheduling/nodeclaimtemplate.go:43-53):
+    pool requirements + labels, taints, daemonset overhead, instance types."""
+
+    nodepool_name: str
+    requirements: Requirements
+    taints: Taints
+    daemon_overhead: Dict[str, float]
+    instance_type_indices: List[int]
+
+
+@dataclass
+class NodeInfo:
+    """Host-side view of one existing node entering the solve
+    (scheduling/existingnode.go:40-62)."""
+
+    name: str
+    requirements: Requirements  # label requirements (+hostname)
+    taints: Taints
+    available: Dict[str, float]  # allocatable - scheduled pod requests
+    daemon_overhead: Dict[str, float]  # unscheduled daemonset requests
+
+
+@dataclass
+class EncodedProblem:
+    problem: SchedulingProblem
+    meta: ProblemMeta
+
+
+def ffd_order(pods: Sequence[Pod]) -> List[int]:
+    """The FFD queue order: cpu desc, memory desc, creation time, creation
+    sequence (queue.go:76-111). Shared by every backend — parity depends on a
+    single definition."""
+    keys = []
+    for i, p in enumerate(pods):
+        requests = res.pod_requests(p)
+        keys.append(
+            (
+                -requests.get(res.CPU, 0.0),
+                -requests.get(res.MEMORY, 0.0),
+                p.metadata.creation_timestamp,
+                p.metadata.creation_seq,
+                i,
+            )
+        )
+    return sorted(range(len(pods)), key=lambda i: keys[i])
+
+
+class _Vocab:
+    def __init__(self):
+        self.keys: List[str] = []
+        self.key_index: Dict[str, int] = {}
+        self.values: List[Dict[str, int]] = []
+
+    def key(self, k: str) -> int:
+        if k not in self.key_index:
+            self.key_index[k] = len(self.keys)
+            self.keys.append(k)
+            self.values.append({})
+        return self.key_index[k]
+
+    def value(self, k: str, v: str) -> int:
+        ki = self.key(k)
+        vals = self.values[ki]
+        if v not in vals:
+            vals[v] = len(vals)
+        return vals[v]
+
+    def add_requirements(self, reqs: Requirements):
+        for key in reqs:
+            r = reqs.get(key)
+            self.key(key)
+            for v in r.values:
+                self.value(key, v)
+
+
+class Encoder:
+    """Encodes one scheduling batch. The vocabulary is rebuilt per batch —
+    label spaces are open-ended, so there is no global dictionary to maintain
+    (SURVEY.md §7 'per-batch dictionary + explicit residual')."""
+
+    def __init__(self, well_known_labels: frozenset = wk.WELL_KNOWN_LABELS):
+        self.well_known = well_known_labels
+
+    def encode(
+        self,
+        pods: Sequence[Pod],
+        instance_types: Sequence[InstanceType],
+        templates: Sequence[TemplateInfo],
+        nodes: Sequence[NodeInfo] = (),
+        pod_reqs_override: Optional[Sequence[Requirements]] = None,
+    ) -> EncodedProblem:
+        # -- 1. FFD queue order: cpu desc, mem desc, creation, uid (queue.go:76-111)
+        pod_reqs_list = (
+            list(pod_reqs_override)
+            if pod_reqs_override is not None
+            else [pod_requirements(p) for p in pods]
+        )
+        order = ffd_order(pods)
+        pods = [pods[i] for i in order]
+        pod_reqs_list = [pod_reqs_list[i] for i in order]
+
+        # -- 2. vocabulary over every value mentioned anywhere
+        vocab = _Vocab()
+        # zone / capacity-type keys always exist (offering checks index them)
+        zone_k = vocab.key(wk.LABEL_TOPOLOGY_ZONE)
+        ct_k = vocab.key(wk.CAPACITY_TYPE_LABEL_KEY)
+        for reqs in pod_reqs_list:
+            vocab.add_requirements(reqs)
+        for it in instance_types:
+            vocab.add_requirements(it.requirements)
+            for o in it.offerings:
+                vocab.value(wk.LABEL_TOPOLOGY_ZONE, o.zone)
+                vocab.value(wk.CAPACITY_TYPE_LABEL_KEY, o.capacity_type)
+        for t in templates:
+            vocab.add_requirements(t.requirements)
+        for n in nodes:
+            vocab.add_requirements(n.requirements)
+
+        K = len(vocab.keys)
+        V = max((len(v) for v in vocab.values), default=1) or 1
+
+        lane_valid = np.zeros((K, V), dtype=bool)
+        lane_numeric = np.full((K, V), np.nan, dtype=np.float32)
+        for ki, vals in enumerate(vocab.values):
+            for value, vi in vals.items():
+                lane_valid[ki, vi] = True
+                try:
+                    lane_numeric[ki, vi] = float(int(value))
+                except ValueError:
+                    pass
+        key_wellknown = np.array([k in self.well_known for k in vocab.keys], dtype=bool)
+
+        # -- 3. resource axis
+        resource_names = [res.CPU, res.MEMORY, res.PODS, res.EPHEMERAL_STORAGE]
+        seen = set(resource_names)
+
+        def note_resources(rl):
+            for name in rl:
+                if name not in seen:
+                    seen.add(name)
+                    resource_names.append(name)
+
+        for p in pods:
+            note_resources(res.pod_requests(p))
+        for it in instance_types:
+            note_resources(it.capacity)
+        for t in templates:
+            note_resources(t.daemon_overhead)
+        for n in nodes:
+            note_resources(n.available)
+
+        # -- 4. requirement tensors
+        def encode_reqs(entities: List[Requirements]) -> ReqTensor:
+            E = len(entities)
+            admitted = np.zeros((E, K, V), dtype=bool)
+            comp = np.zeros((E, K), dtype=bool)
+            gt = np.full((E, K), GT_NONE, dtype=np.int32)
+            lt = np.full((E, K), LT_NONE, dtype=np.int32)
+            defined = np.zeros((E, K), dtype=bool)
+            for e, reqs in enumerate(entities):
+                # undefined keys are identity elements: full-admit complements
+                admitted[e] = lane_valid
+                comp[e] = True
+                for key in reqs:
+                    r = reqs.get(key)
+                    ki = vocab.key_index[key]
+                    defined[e, ki] = True
+                    comp[e, ki] = r.complement
+                    if r.greater_than is not None:
+                        gt[e, ki] = r.greater_than
+                    if r.less_than is not None:
+                        lt[e, ki] = r.less_than
+                    row = np.zeros(V, dtype=bool)
+                    for value, vi in vocab.values[ki].items():
+                        row[vi] = r.has(value)
+                    admitted[e, ki] = row
+            return ReqTensor(admitted=admitted, comp=comp, gt=gt, lt=lt, defined=defined)
+
+        pod_reqs = encode_reqs(pod_reqs_list)
+        it_reqs = encode_reqs([it.requirements for it in instance_types])
+        tpl_reqs = encode_reqs([t.requirements for t in templates])
+        node_reqs = encode_reqs([n.requirements for n in nodes])
+
+        # -- 5. resources
+        def dense(rl) -> np.ndarray:
+            return np.array(res.to_dense(rl, resource_names), dtype=np.float32)
+
+        pod_requests = np.stack(
+            [dense({**res.pod_requests(p), res.PODS: 1.0}) for p in pods]
+        ) if pods else np.zeros((0, len(resource_names)), dtype=np.float32)
+        it_alloc = np.stack([dense(it.allocatable()) for it in instance_types]) if instance_types else np.zeros((0, len(resource_names)), dtype=np.float32)
+        it_cap = np.stack([dense(it.capacity) for it in instance_types]) if instance_types else np.zeros((0, len(resource_names)), dtype=np.float32)
+        tpl_overhead = np.stack([dense(t.daemon_overhead) for t in templates]) if templates else np.zeros((0, len(resource_names)), dtype=np.float32)
+        node_avail = np.stack([dense(n.available) for n in nodes]) if nodes else np.zeros((0, len(resource_names)), dtype=np.float32)
+        node_overhead = np.stack([dense(n.daemon_overhead) for n in nodes]) if nodes else np.zeros((0, len(resource_names)), dtype=np.float32)
+
+        # -- 6. offerings
+        T = len(instance_types)
+        O = max((len(it.offerings) for it in instance_types), default=1) or 1
+        offer_zone = np.zeros((T, O), dtype=np.int32)
+        offer_ct = np.zeros((T, O), dtype=np.int32)
+        offer_ok = np.zeros((T, O), dtype=bool)
+        offer_price = np.full((T, O), np.inf, dtype=np.float32)
+        for ti, it in enumerate(instance_types):
+            for oi, o in enumerate(it.offerings):
+                offer_zone[ti, oi] = vocab.values[zone_k][o.zone]
+                offer_ct[ti, oi] = vocab.values[ct_k][o.capacity_type]
+                offer_ok[ti, oi] = o.available
+                offer_price[ti, oi] = o.price
+
+        # -- 7. templates' instance-type universes + taints
+        TPL = len(templates)
+        tpl_it_ok = np.zeros((TPL, T), dtype=bool)
+        for ti, t in enumerate(templates):
+            tpl_it_ok[ti, list(t.instance_type_indices)] = True
+
+        pod_tol_tpl = np.zeros((len(pods), TPL), dtype=bool)
+        for pi, p in enumerate(pods):
+            for ti, t in enumerate(templates):
+                pod_tol_tpl[pi, ti] = not t.taints.tolerates(p)
+        pod_tol_node = np.zeros((len(pods), len(nodes)), dtype=bool)
+        for pi, p in enumerate(pods):
+            for ni, n in enumerate(nodes):
+                pod_tol_node[pi, ni] = not n.taints.tolerates(p)
+
+        problem = SchedulingProblem(
+            lane_valid=lane_valid,
+            lane_numeric=lane_numeric,
+            key_wellknown=key_wellknown,
+            pod_reqs=pod_reqs,
+            pod_requests=pod_requests,
+            pod_tol_tpl=pod_tol_tpl,
+            pod_tol_node=pod_tol_node,
+            it_reqs=it_reqs,
+            it_alloc=it_alloc,
+            it_cap=it_cap,
+            offer_zone=offer_zone,
+            offer_ct=offer_ct,
+            offer_ok=offer_ok,
+            offer_price=offer_price,
+            tpl_reqs=tpl_reqs,
+            tpl_overhead=tpl_overhead,
+            tpl_it_ok=tpl_it_ok,
+            node_reqs=node_reqs,
+            node_avail=node_avail,
+            node_overhead=node_overhead,
+        )
+        meta = ProblemMeta(
+            keys=list(vocab.keys),
+            values_per_key=[
+                [v for v, _ in sorted(vals.items(), key=lambda kv: kv[1])]
+                for vals in vocab.values
+            ],
+            resource_names=resource_names,
+            pod_order=order,
+            template_names=[t.nodepool_name for t in templates],
+            instance_type_names=[it.name for it in instance_types],
+            node_names=[n.name for n in nodes],
+            zone_key_idx=zone_k,
+            ct_key_idx=ct_k,
+        )
+        return EncodedProblem(problem=problem, meta=meta)
+
+
+def template_from_nodepool(
+    nodepool: NodePool,
+    instance_types: Sequence[InstanceType],
+    instance_type_indices: Sequence[int],
+    daemon_pods: Sequence[Pod] = (),
+) -> TemplateInfo:
+    """Build a TemplateInfo the way NewNodeClaimTemplate + getDaemonOverhead do
+    (nodeclaimtemplate.go:43-53, scheduler.go:324-341)."""
+    tpl = nodepool.spec.template
+    requirements = Requirements()
+    requirements.add(
+        *Requirements.from_node_selector_requirements(*tpl.spec.requirements).values()
+    )
+    labels = {**tpl.labels, wk.NODEPOOL_LABEL_KEY: nodepool.name}
+    requirements.add(*label_requirements(labels).values())
+    taints = Taints(tpl.spec.taints)
+
+    daemons = []
+    for p in daemon_pods:
+        if taints.tolerates(p):
+            continue
+        if not requirements.is_compatible(pod_requirements(p), wk.WELL_KNOWN_LABELS):
+            continue
+        daemons.append(p)
+    overhead = res.requests_for_pods(*daemons) if daemons else {}
+
+    return TemplateInfo(
+        nodepool_name=nodepool.name,
+        requirements=requirements,
+        taints=taints,
+        daemon_overhead=overhead,
+        instance_type_indices=list(instance_type_indices),
+    )

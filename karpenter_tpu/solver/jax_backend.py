@@ -1,0 +1,103 @@
+"""The JAX solver backend.
+
+Encodes the batch (solver/encode.py), runs the lax.scan FFD (ops/ffd.py), and
+decodes device output back into the host result model. Claim-slot capacity is
+a static compile dimension: the backend starts from a bucketed guess and
+doubles on overflow (KIND_NO_SLOT), so recompiles stay rare and bounded —
+SURVEY.md §7 hard part (3): pad-and-mask with bucketed compile sizes.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from karpenter_tpu.apis.objects import Pod
+from karpenter_tpu.cloudprovider.types import InstanceType
+from karpenter_tpu.scheduling import Requirements
+from karpenter_tpu.solver.backend import (
+    FAIL_INCOMPATIBLE,
+    Placement,
+    SolveResult,
+    SolverBackend,
+)
+from karpenter_tpu.solver.encode import Encoder, NodeInfo, TemplateInfo
+from karpenter_tpu.ops.padding import pad_problem, pow2_bucket
+from karpenter_tpu.ops.ffd import (
+    KIND_CLAIM,
+    KIND_FAIL,
+    KIND_NEW_CLAIM,
+    KIND_NODE,
+    KIND_NO_SLOT,
+    solve_ffd,
+)
+
+
+class JaxSolver(SolverBackend):
+    def __init__(self, well_known=None, initial_claim_slots: int = 32):
+        from karpenter_tpu.apis import labels as wk
+
+        self.well_known = well_known if well_known is not None else wk.WELL_KNOWN_LABELS
+        # grows on overflow and persists — a steady workload pays the
+        # doubling retries once, not per solve
+        self.claim_slots = pow2_bucket(initial_claim_slots)
+
+    def solve(
+        self,
+        pods: Sequence[Pod],
+        instance_types: Sequence[InstanceType],
+        templates: Sequence[TemplateInfo],
+        nodes: Sequence[NodeInfo] = (),
+        pod_requirements_override: Optional[Sequence[Requirements]] = None,
+    ) -> SolveResult:
+        if not pods:
+            return SolveResult()
+        encoded = Encoder(self.well_known).encode(
+            pods, instance_types, templates, nodes, pod_requirements_override
+        )
+        problem, meta = pad_problem(encoded.problem), encoded.meta
+
+        max_claims = min(self.claim_slots, pow2_bucket(len(pods)))
+        while True:
+            result = solve_ffd(problem, max_claims)
+            kinds = np.asarray(result.kind)
+            if not (kinds == KIND_NO_SLOT).any() or max_claims >= len(pods):
+                break
+            max_claims = min(pow2_bucket(max_claims * 2), pow2_bucket(len(pods)))
+            self.claim_slots = max(self.claim_slots, max_claims)
+
+        indices = np.asarray(result.index)
+        claim_tpl = np.asarray(result.state.claim_tpl)
+        claim_it_ok = np.asarray(result.state.claim_it_ok)
+        claim_open = np.asarray(result.state.claim_open)
+        claim_requests = np.asarray(result.state.claim_requests)
+
+        out = SolveResult()
+        slot_to_claim = {}
+        for slot in range(max_claims):
+            if claim_open[slot]:
+                tpl_idx = int(claim_tpl[slot])
+                placement = Placement(
+                    template_index=tpl_idx,
+                    nodepool_name=meta.template_names[tpl_idx],
+                    instance_type_indices=[int(t) for t in np.flatnonzero(claim_it_ok[slot])],
+                    requests={
+                        name: float(claim_requests[slot, ri])
+                        for ri, name in enumerate(meta.resource_names)
+                        if claim_requests[slot, ri] > 0
+                    },
+                )
+                slot_to_claim[slot] = placement
+                out.new_claims.append(placement)
+
+        for row in range(len(meta.pod_order)):  # rows past this are padding
+            kind, index = kinds[row], indices[row]
+            pod_idx = meta.pod_order[row]  # problem rows are FFD-sorted
+            if kind == KIND_NODE:
+                out.node_pods.setdefault(meta.node_names[index], []).append(pod_idx)
+            elif kind in (KIND_CLAIM, KIND_NEW_CLAIM):
+                slot_to_claim[int(index)].pod_indices.append(pod_idx)
+            else:
+                out.failures[pod_idx] = FAIL_INCOMPATIBLE
+        return out

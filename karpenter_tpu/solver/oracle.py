@@ -1,0 +1,190 @@
+"""Pure-Python FFD oracle.
+
+Mirror of the reference scheduler's placement semantics
+(scheduler.go:238-285, nodeclaim.go:65-119, existingnode.go:64-124), used as
+the golden model the JAX solver is property-tested against, and available as
+the ``oracle`` solver backend for debugging.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.objects import Pod
+from karpenter_tpu.cloudprovider.types import InstanceType
+from karpenter_tpu.scheduling import Requirements, pod_requirements
+from karpenter_tpu.solver.backend import (
+    FAIL_INCOMPATIBLE,
+    Placement,
+    SolveResult,
+    SolverBackend,
+)
+from karpenter_tpu.solver.encode import NodeInfo, TemplateInfo, ffd_order
+from karpenter_tpu.utils import resources as res
+
+
+def _fits(requests: Dict[str, float], available: Dict[str, float]) -> bool:
+    # same tolerance as ops/masks.py fits() so both backends agree bit-for-bit
+    for name, q in requests.items():
+        avail = available.get(name, 0.0)
+        if q > avail + 1e-6 + 1e-6 * abs(avail):
+            return False
+    return True
+
+
+def _has_offering(it: InstanceType, reqs: Requirements) -> bool:
+    return len(it.offerings.available().requirements(reqs)) > 0
+
+
+@dataclass
+class _OpenClaim:
+    template_index: int
+    template: TemplateInfo
+    requirements: Requirements
+    requests: Dict[str, float]
+    it_indices: List[int]
+    pod_indices: List[int] = field(default_factory=list)
+    seq: int = 0
+
+
+@dataclass
+class _NodeBin:
+    info: NodeInfo
+    requirements: Requirements
+    requests: Dict[str, float]
+    pod_indices: List[int] = field(default_factory=list)
+
+
+class OracleSolver(SolverBackend):
+    def __init__(self, well_known: frozenset = wk.WELL_KNOWN_LABELS):
+        self.well_known = well_known
+
+    def solve(
+        self,
+        pods: Sequence[Pod],
+        instance_types: Sequence[InstanceType],
+        templates: Sequence[TemplateInfo],
+        nodes: Sequence[NodeInfo] = (),
+        pod_requirements_override: Optional[Sequence[Requirements]] = None,
+    ) -> SolveResult:
+        pod_reqs = (
+            list(pod_requirements_override)
+            if pod_requirements_override is not None
+            else [pod_requirements(p) for p in pods]
+        )
+        order = ffd_order(pods)
+
+        node_bins = [
+            _NodeBin(
+                info=n,
+                requirements=n.requirements.copy(),
+                requests=dict(n.daemon_overhead),
+            )
+            for n in nodes
+        ]
+        claims: List[_OpenClaim] = []
+        result = SolveResult()
+
+        for pi in order:
+            pod, reqs = pods[pi], pod_reqs[pi]
+            requests = {**res.pod_requests(pod), res.PODS: 1.0}
+            if self._try_nodes(pi, pod, reqs, requests, node_bins):
+                continue
+            if self._try_claims(pi, pod, reqs, requests, claims, instance_types):
+                continue
+            if self._try_templates(pi, pod, reqs, requests, claims, templates, instance_types):
+                continue
+            result.failures[pi] = FAIL_INCOMPATIBLE
+
+        for nb in node_bins:
+            if nb.pod_indices:
+                result.node_pods[nb.info.name] = nb.pod_indices
+        for claim in claims:
+            result.new_claims.append(
+                Placement(
+                    template_index=claim.template_index,
+                    nodepool_name=claim.template.nodepool_name,
+                    pod_indices=claim.pod_indices,
+                    instance_type_indices=claim.it_indices,
+                    requirements=claim.requirements,
+                    requests=claim.requests,
+                )
+            )
+        return result
+
+    # -- placement attempts, in reference priority order ----------------------
+
+    def _try_nodes(self, pi, pod, reqs, requests, node_bins) -> bool:
+        for nb in node_bins:
+            if nb.info.taints.tolerates(pod):
+                continue
+            merged = res.merge(nb.requests, requests)
+            if not _fits(merged, nb.info.available):
+                continue
+            # strict Compatible — no well-known allowance (existingnode.go:94)
+            if not nb.requirements.is_compatible(reqs):
+                continue
+            nb.requests = merged
+            nb.requirements.add(*reqs.values())
+            nb.pod_indices.append(pi)
+            return True
+        return False
+
+    def _try_claims(self, pi, pod, reqs, requests, claims, instance_types) -> bool:
+        for claim in sorted(claims, key=lambda c: (len(c.pod_indices), c.seq)):
+            if claim.template.taints.tolerates(pod):
+                continue
+            if not claim.requirements.is_compatible(reqs, self.well_known):
+                continue
+            narrowed = claim.requirements.copy()
+            narrowed.add(*reqs.values())
+            merged = res.merge(claim.requests, requests)
+            surviving = [
+                ti
+                for ti in claim.it_indices
+                if not instance_types[ti].requirements.intersects(narrowed)
+                and _fits(merged, instance_types[ti].allocatable())
+                and _has_offering(instance_types[ti], narrowed)
+            ]
+            if not surviving:
+                continue
+            claim.requirements = narrowed
+            claim.requests = merged
+            claim.it_indices = surviving
+            claim.pod_indices.append(pi)
+            return True
+        return False
+
+    def _try_templates(self, pi, pod, reqs, requests, claims, templates, instance_types) -> bool:
+        for ti_idx, tpl in enumerate(templates):
+            if tpl.taints.tolerates(pod):
+                continue
+            if not tpl.requirements.is_compatible(reqs, self.well_known):
+                continue
+            narrowed = tpl.requirements.copy()
+            narrowed.add(*reqs.values())
+            merged = res.merge(tpl.daemon_overhead, requests)
+            surviving = [
+                t
+                for t in tpl.instance_type_indices
+                if not instance_types[t].requirements.intersects(narrowed)
+                and _fits(merged, instance_types[t].allocatable())
+                and _has_offering(instance_types[t], narrowed)
+            ]
+            if not surviving:
+                continue
+            claims.append(
+                _OpenClaim(
+                    template_index=ti_idx,
+                    template=tpl,
+                    requirements=narrowed,
+                    requests=merged,
+                    it_indices=surviving,
+                    pod_indices=[pi],
+                    seq=len(claims),
+                )
+            )
+            return True
+        return False

@@ -7,7 +7,6 @@ small self-contained equivalent: parse + "next fire time after t".
 
 from __future__ import annotations
 
-import calendar
 import datetime as _dt
 from dataclasses import dataclass
 from typing import FrozenSet
@@ -22,8 +21,11 @@ _DESCRIPTORS = {
     "@hourly": "0 * * * *",
 }
 
-_MONTH_NAMES = {name.lower(): i for i, name in enumerate(calendar.month_abbr) if name}
-_DAY_NAMES = {name.lower(): i for i, name in enumerate(calendar.day_abbr)}
+# literal name maps — locale-independent (calendar.month_abbr localizes)
+_MONTH_NAMES = {
+    "jan": 1, "feb": 2, "mar": 3, "apr": 4, "may": 5, "jun": 6,
+    "jul": 7, "aug": 8, "sep": 9, "oct": 10, "nov": 11, "dec": 12,
+}
 # cron day-of-week: 0=Sunday; python weekday(): 0=Monday
 _DAY_NAMES = {"sun": 0, "mon": 1, "tue": 2, "wed": 3, "thu": 4, "fri": 5, "sat": 6}
 
@@ -35,8 +37,9 @@ class CronParseError(ValueError):
 def _parse_field(field: str, lo: int, hi: int, names=None) -> FrozenSet[int]:
     out = set()
     for part in field.split(","):
+        has_step = "/" in part
         step = 1
-        if "/" in part:
+        if has_step:
             part, step_s = part.split("/", 1)
             try:
                 step = int(step_s)
@@ -51,9 +54,8 @@ def _parse_field(field: str, lo: int, hi: int, names=None) -> FrozenSet[int]:
             start, end = _parse_value(a, names), _parse_value(b, names)
         else:
             start = _parse_value(part, names)
-            end = hi if "/" in field else start
-            if step == 1:
-                end = start
+            # robfig semantics: "N/step" expands N..max, plain "N" is just N
+            end = hi if has_step else start
         if start < lo or end > hi or start > end:
             raise CronParseError(f"field value out of range [{lo},{hi}]: {field!r}")
         out.update(range(start, end + 1, step))

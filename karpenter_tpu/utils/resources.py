@@ -116,10 +116,12 @@ def max_resources(*lists: Mapping[str, float] | None) -> ResourceList:
 
 
 def requests_for_pods(*pods) -> ResourceList:
-    """Total requests across pods, where each pod request is
-    max(sum(containers), max(initContainers)) (reference: resources.RequestsForPods
-    / podRequests)."""
-    return merge(*(pod_requests(p) for p in pods))
+    """Total requests across pods plus the implicit ``pods`` count — every pod
+    consumes one unit of the node's pod capacity (reference:
+    resources.RequestsForPods, resources.go:26-35)."""
+    out = merge(*(pod_requests(p) for p in pods))
+    out[PODS] = float(len(pods))
+    return out
 
 
 def pod_requests(pod) -> ResourceList:

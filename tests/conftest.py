@@ -3,14 +3,24 @@
 Tests run on a virtual 8-device CPU mesh so multi-chip sharding paths
 (parallel/) can be exercised without TPU hardware; the real-chip path is
 exercised by bench.py / __graft_entry__.py under the driver.
+
+Note: the environment's sitecustomize registers a TPU PJRT plugin and forces
+``jax_platforms="axon,cpu"`` via jax.config at interpreter start, which beats
+the JAX_PLATFORMS env var — so we must override through jax.config *after*
+import. Env vars still matter for the device-count flag, which is read at
+first backend init.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

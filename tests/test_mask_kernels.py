@@ -1,0 +1,116 @@
+"""Property tests: the JAX mask kernels against the host-side algebra.
+
+The tensor encoding (models/problem.py) must reproduce the exact semantics of
+scheduling/requirements.py over a closed vocabulary; these tests fuzz both
+paths with random requirement sets and compare intersects/compatible verdicts.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis.objects import DOES_NOT_EXIST, EXISTS, GT, IN, LT, NOT_IN
+from karpenter_tpu.models.problem import GT_NONE, LT_NONE, ReqTensor
+from karpenter_tpu.ops import masks
+from karpenter_tpu.scheduling import Requirement, Requirements
+
+KEYS = ["k0", "k1", "k2"]
+VALUES = ["a", "b", "1", "2", "7", "15"]
+OPS = [IN, NOT_IN, EXISTS, DOES_NOT_EXIST, GT, LT]
+
+
+def random_requirements(rng, max_keys=3):
+    reqs = Requirements()
+    for key in rng.sample(KEYS, rng.randint(0, max_keys)):
+        op = rng.choice(OPS)
+        if op in (GT, LT):
+            reqs.add(Requirement(key, op, [str(rng.randint(0, 12))]))
+        else:
+            vals = rng.sample(VALUES, rng.randint(0 if op in (EXISTS, DOES_NOT_EXIST) else 1, 3))
+            reqs.add(Requirement(key, op, vals))
+    return reqs
+
+
+def encode_single(reqs: Requirements):
+    """Encode one Requirements over the fixed KEYS×VALUES vocab."""
+    K, V = len(KEYS), len(VALUES)
+    lane_valid = np.ones((K, V), dtype=bool)
+    lane_numeric = np.full((K, V), np.nan, dtype=np.float32)
+    for vi, v in enumerate(VALUES):
+        try:
+            lane_numeric[:, vi] = float(int(v))
+        except ValueError:
+            pass
+    admitted = np.ones((K, V), dtype=bool)
+    comp = np.ones(K, dtype=bool)
+    gt = np.full(K, GT_NONE, dtype=np.int32)
+    lt = np.full(K, LT_NONE, dtype=np.int32)
+    defined = np.zeros(K, dtype=bool)
+    for ki, key in enumerate(KEYS):
+        if not reqs.has(key):
+            continue
+        r = reqs.get(key)
+        defined[ki] = True
+        comp[ki] = r.complement
+        if r.greater_than is not None:
+            gt[ki] = r.greater_than
+        if r.less_than is not None:
+            lt[ki] = r.less_than
+        admitted[ki] = [r.has(v) for v in VALUES]
+    return (
+        ReqTensor(admitted=admitted, comp=comp, gt=gt, lt=lt, defined=defined),
+        lane_valid,
+        lane_numeric,
+    )
+
+
+class TestKernelParity:
+    def test_intersects_parity(self):
+        rng = random.Random(7)
+        for trial in range(300):
+            a, b = random_requirements(rng), random_requirements(rng)
+            ta, lv, ln = encode_single(a)
+            tb, _, _ = encode_single(b)
+            host = not a.intersects(b)
+            device = bool(masks.intersects_ok(ta, tb, lv, ln))
+            assert host == device, f"trial {trial}: {a!r} vs {b!r}: host={host} device={device}"
+
+    def test_compatible_parity(self):
+        rng = random.Random(13)
+        wellknown = np.array([k == "k0" for k in KEYS])  # treat k0 as well-known
+        allow = frozenset({"k0"})
+        for trial in range(300):
+            r, inc = random_requirements(rng), random_requirements(rng)
+            tr, lv, ln = encode_single(r)
+            tinc, _, _ = encode_single(inc)
+            host = r.is_compatible(inc, allow)
+            device = bool(masks.compatible_ok(tr, tinc, lv, ln, wellknown))
+            assert host == device, f"trial {trial}: {r!r} vs {inc!r}: host={host} device={device}"
+
+    def test_intersection_state_parity(self):
+        """Chained on-device intersections must keep matching host semantics
+        (the claim state narrows over many pods)."""
+        rng = random.Random(99)
+        for trial in range(100):
+            seq = [random_requirements(rng) for _ in range(4)]
+            probe = random_requirements(rng)
+            # host: Requirements.add() chain
+            host_state = Requirements()
+            for s in seq:
+                host_state.add(*s.values())
+            # device: ReqTensor intersect chain
+            dev_state, lv, ln = encode_single(seq[0]) if seq else (None, None, None)
+            for s in seq[1:]:
+                t, _, _ = encode_single(s)
+                dev_state = masks.intersect(dev_state, t)
+            tp, _, _ = encode_single(probe)
+            host = not host_state.intersects(probe)
+            device = bool(masks.intersects_ok(dev_state, tp, lv, ln))
+            assert host == device, f"trial {trial}: state={host_state!r} probe={probe!r}"
+
+    def test_fits_kernel(self):
+        req = np.array([[1.0, 2.0], [3.0, 1.0]], dtype=np.float32)
+        avail = np.array([2.0, 2.0], dtype=np.float32)
+        out = np.asarray(masks.fits(req, avail))
+        assert out.tolist() == [True, False]

@@ -88,4 +88,5 @@ class TestPodRequests:
     def test_requests_for_pods(self):
         p1 = make_pod([{"cpu": 1}])
         p2 = make_pod([{"cpu": 2, "memory": 8}])
-        assert res.requests_for_pods(p1, p2) == {"cpu": 3, "memory": 8}
+        # each pod implicitly consumes one unit of node pod capacity
+        assert res.requests_for_pods(p1, p2) == {"cpu": 3, "memory": 8, "pods": 2}

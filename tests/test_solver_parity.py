@@ -1,0 +1,274 @@
+"""Solver parity: the JAX lax.scan FFD against the pure-Python oracle.
+
+The oracle (solver/oracle.py) mirrors the reference Go scheduler's semantics
+line by line; the JAX backend must produce identical placements on every
+workload that doesn't involve the (later-stage) topology/relaxation features.
+"""
+
+import random
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodepool import NodeClaimSpec, NodeClaimTemplateSpec, NodePool, NodePoolSpec
+from karpenter_tpu.apis.objects import (
+    GT,
+    IN,
+    NOT_IN,
+    Container,
+    NodeSelectorRequirement,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    Taint,
+    Toleration,
+)
+from karpenter_tpu.cloudprovider.fake import GI, instance_types, make_instance_type
+from karpenter_tpu.scheduling import Requirements, Taints
+from karpenter_tpu.solver.encode import NodeInfo, TemplateInfo, template_from_nodepool
+from karpenter_tpu.solver.jax_backend import JaxSolver
+from karpenter_tpu.solver.oracle import OracleSolver
+from karpenter_tpu.utils import resources as res
+
+
+def make_pod(i, cpu=0.5, mem=1e8, selector=None, tolerations=None, requirements=None):
+    """requirements: [(key, op, values), ...] become a required node-affinity term."""
+    affinity = None
+    if requirements:
+        from karpenter_tpu.apis.objects import Affinity, NodeAffinity, NodeSelectorTerm
+
+        affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required=[
+                    NodeSelectorTerm([NodeSelectorRequirement(*r) for r in requirements])
+                ]
+            )
+        )
+    return Pod(
+        metadata=ObjectMeta(name=f"p{i}"),
+        spec=PodSpec(
+            containers=[Container(requests={"cpu": cpu, "memory": mem})],
+            node_selector=selector or {},
+            tolerations=tolerations or [],
+            affinity=affinity,
+        ),
+    )
+
+
+def simple_template(its, name="pool", taints=None, labels=None, requirements=None):
+    pool = NodePool(
+        metadata=ObjectMeta(name=name),
+        spec=NodePoolSpec(
+            template=NodeClaimTemplateSpec(
+                labels=labels or {},
+                spec=NodeClaimSpec(
+                    taints=taints or [],
+                    requirements=requirements or [],
+                ),
+            )
+        ),
+    )
+    return template_from_nodepool(pool, its, range(len(its)))
+
+
+def assert_same(oracle_result, jax_result):
+    assert len(oracle_result.new_claims) == len(jax_result.new_claims), (
+        f"claim count: oracle={len(oracle_result.new_claims)} jax={len(jax_result.new_claims)}"
+    )
+    for oc, jc in zip(oracle_result.new_claims, jax_result.new_claims):
+        assert sorted(oc.pod_indices) == sorted(jc.pod_indices)
+        assert sorted(oc.instance_type_indices) == sorted(jc.instance_type_indices)
+        assert oc.template_index == jc.template_index
+    assert oracle_result.node_pods == jax_result.node_pods
+    assert set(oracle_result.failures) == set(jax_result.failures)
+
+
+def run_both(pods, its, templates, nodes=()):
+    # the reference's fake package injects its catalog labels into
+    # WellKnownLabels (fake/instancetype.go:42-48); mirror that here
+    from karpenter_tpu.cloudprovider.fake import FAKE_WELL_KNOWN_LABELS
+
+    o = OracleSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(pods, its, templates, nodes)
+    j = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(pods, its, templates, nodes)
+    assert_same(o, j)
+    return o, j
+
+
+class TestBasicParity:
+    def test_generic_pack(self):
+        its = instance_types(8)
+        pods = [make_pod(i, cpu=0.3 + 0.2 * (i % 5)) for i in range(20)]
+        o, _ = run_both(pods, its, [simple_template(its)])
+        assert o.num_scheduled() == 20
+
+    def test_selector_restricts_instance_types(self):
+        its = instance_types(6)
+        pods = [make_pod(i, selector={"integer": "4"}) for i in range(3)]
+        o, _ = run_both(pods, its, [simple_template(its)])
+        # only fake-it-3 has 4 cpus -> integer=4
+        assert all(c.instance_type_indices == [3] for c in o.new_claims)
+
+    def test_zone_selector(self):
+        its = instance_types(4)
+        pods = [make_pod(i, selector={wk.LABEL_TOPOLOGY_ZONE: "test-zone-3"}) for i in range(4)]
+        run_both(pods, its, [simple_template(its)])
+
+    def test_unschedulable_pod_fails(self):
+        its = instance_types(3)
+        pods = [make_pod(0, selector={"nonexistent-label": "x"})]
+        o, _ = run_both(pods, its, [simple_template(its)])
+        assert 0 in o.failures
+
+    def test_oversized_pod_fails(self):
+        its = instance_types(2)  # max 2 cpu
+        pods = [make_pod(0, cpu=64.0)]
+        o, _ = run_both(pods, its, [simple_template(its)])
+        assert 0 in o.failures
+
+    def test_taints_and_tolerations(self):
+        its = instance_types(4)
+        taint = Taint(key="dedicated", value="infra", effect="NoSchedule")
+        tainted = simple_template(its, name="tainted", taints=[taint])
+        plain = simple_template(its, name="plain")
+        tolerating = [
+            make_pod(i, tolerations=[Toleration(key="dedicated", operator="Exists")])
+            for i in range(2)
+        ]
+        plain_pods = [make_pod(i + 10) for i in range(2)]
+        # tainted pool listed first: tolerating pods land there, others skip to plain
+        o, _ = run_both(tolerating + plain_pods, its, [tainted, plain])
+        pool_of = {
+            pi: c.nodepool_name for c in o.new_claims for pi in c.pod_indices
+        }
+        assert pool_of[0] == pool_of[1] == "tainted"
+        assert pool_of[2] == pool_of[3] == "plain"
+
+    def test_multiple_templates_weight_order(self):
+        its = instance_types(4)
+        small_only = simple_template(
+            its, name="small", requirements=[NodeSelectorRequirement("integer", IN, ["1"])]
+        )
+        general = simple_template(its, name="general")
+        pods = [make_pod(i, cpu=2.5) for i in range(2)]  # doesn't fit 1-cpu type
+        o, _ = run_both(pods, its, [small_only, general])
+        assert all(c.nodepool_name == "general" for c in o.new_claims)
+
+    def test_gt_requirement_on_template(self):
+        its = instance_types(8)
+        tpl = simple_template(
+            its, requirements=[NodeSelectorRequirement("integer", GT, ["4"])]
+        )
+        o, _ = run_both([make_pod(0)], its, [tpl])
+        # surviving instance types all have > 4 cpu
+        for c in o.new_claims:
+            assert all(its[t].capacity[res.CPU] > 4 for t in c.instance_type_indices)
+
+    def test_gt_requirement_on_pod_affinity(self):
+        its = instance_types(8)
+        pods = [make_pod(0, requirements=[("integer", GT, ["5"])])]
+        o, _ = run_both(pods, its, [simple_template(its)])
+        assert not o.failures
+        for c in o.new_claims:
+            assert all(its[t].capacity[res.CPU] > 5 for t in c.instance_type_indices)
+
+    def test_not_in_requirement(self):
+        its = instance_types(4)
+        tpl = simple_template(
+            its,
+            requirements=[
+                NodeSelectorRequirement(wk.LABEL_TOPOLOGY_ZONE, NOT_IN, ["test-zone-1", "test-zone-2"])
+            ],
+        )
+        o, _ = run_both([make_pod(0)], its, [tpl])
+        assert not o.failures
+
+
+class TestExistingNodesParity:
+    def make_node(self, name, cpu=8.0, labels=None, taints=None):
+        reqs = Requirements.from_labels(
+            {
+                **(labels or {}),
+                wk.LABEL_HOSTNAME: name,
+                wk.LABEL_TOPOLOGY_ZONE: "test-zone-1",
+                wk.CAPACITY_TYPE_LABEL_KEY: "on-demand",
+            }
+        )
+        return NodeInfo(
+            name=name,
+            requirements=reqs,
+            taints=Taints(taints or []),
+            available={res.CPU: cpu, res.MEMORY: 16 * GI, res.PODS: 100.0},
+            daemon_overhead={},
+        )
+
+    def test_existing_node_first(self):
+        its = instance_types(4)
+        nodes = [self.make_node("n1", cpu=4.0)]
+        pods = [make_pod(i, cpu=1.0) for i in range(3)]
+        o, _ = run_both(pods, its, [simple_template(its)], nodes)
+        assert len(o.node_pods.get("n1", [])) == 3
+        assert not o.new_claims
+
+    def test_overflow_to_new_claims(self):
+        its = instance_types(4)
+        nodes = [self.make_node("n1", cpu=2.0)]
+        pods = [make_pod(i, cpu=1.0) for i in range(5)]
+        o, _ = run_both(pods, its, [simple_template(its)], nodes)
+        assert len(o.node_pods.get("n1", [])) == 2
+        assert sum(len(c.pod_indices) for c in o.new_claims) == 3
+
+    def test_node_label_compat(self):
+        its = instance_types(4)
+        nodes = [self.make_node("n1", labels={"team": "a"})]
+        match = make_pod(0, selector={"team": "a"})
+        mismatch = make_pod(1, selector={"team": "b"})
+        o, _ = run_both([match, mismatch], its, [simple_template(its)], nodes)
+        assert o.node_pods.get("n1") == [0]
+
+    def test_tainted_node_skipped(self):
+        its = instance_types(4)
+        nodes = [self.make_node("n1", taints=[Taint(key="no", effect="NoSchedule")])]
+        o, _ = run_both([make_pod(0)], its, [simple_template(its)], nodes)
+        assert "n1" not in o.node_pods
+        assert len(o.new_claims) == 1
+
+
+class TestRandomizedParity:
+    """Fuzzed workloads over selectors, tolerations, sizes, and catalogs."""
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_fuzz(self, seed):
+        rng = random.Random(seed)
+        its = instance_types(rng.randint(2, 12))
+        zones = ["test-zone-1", "test-zone-2", "test-zone-3"]
+        taint = Taint(key="team", value="x", effect="NoSchedule")
+        templates = [simple_template(its, name="a")]
+        if rng.random() < 0.5:
+            templates.append(simple_template(its, name="b", taints=[taint]))
+        pods = []
+        for i in range(rng.randint(5, 30)):
+            selector = {}
+            if rng.random() < 0.3:
+                selector[wk.LABEL_TOPOLOGY_ZONE] = rng.choice(zones)
+            if rng.random() < 0.2:
+                selector["integer"] = str(rng.randint(1, 12))
+            if rng.random() < 0.15:
+                selector[wk.CAPACITY_TYPE_LABEL_KEY] = rng.choice(["spot", "on-demand"])
+            tols = (
+                [Toleration(key="team", operator="Exists")] if rng.random() < 0.3 else []
+            )
+            pods.append(
+                make_pod(
+                    i,
+                    cpu=rng.choice([0.1, 0.25, 0.5, 1.0, 1.5, 3.0]),
+                    mem=rng.choice([1e8, 2.5e8, 1e9, 4e9]),
+                    selector=selector,
+                    tolerations=tols,
+                )
+            )
+        nodes = []
+        for n in range(rng.randint(0, 3)):
+            nodes.append(
+                TestExistingNodesParity().make_node(f"node-{n}", cpu=rng.choice([2.0, 4.0, 8.0]))
+            )
+        run_both(pods, its, templates, nodes)
