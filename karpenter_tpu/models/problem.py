@@ -95,6 +95,29 @@ class SchedulingProblem:
       pod_requests f32[P, R]     effective resource requests (incl pods=1)
       pod_tol_tpl  bool[P, TPL]  pod tolerates template taints
       pod_tol_node bool[P, N]    pod tolerates existing-node taints
+      pod_ports    bool[P, PT]   host-port lanes the pod reserves
+      pod_port_conflict bool[P, PT]  lanes that CONFLICT with the pod's ports
+                   (precomputed via HostPort.matches incl. 0.0.0.0 wildcards)
+      pod_strict_reqs ReqTensor[P]  strict requirements (preferences excluded)
+                   — the podDomains side of topology evaluation
+
+    Topology groups (regular spread/affinity/anti-affinity groups first, then
+    inverse anti-affinity groups; see provisioning/topology.py):
+      grp_type     i32[G]        0 spread / 1 affinity / 2 anti-affinity
+      grp_key      i32[G]        vocab key index the group spreads over
+      grp_max_skew i32[G]
+      grp_min_domains i32[G]     -1 when unset
+      grp_counts0  i32[G, V]     seeded domain counts (cluster census)
+      grp_registered0 bool[G, V] known domain lanes
+      grp_inverse  bool[G]       inverse anti-affinity group
+      grp_has_filter bool[G]     spread node-filter present
+      grp_filter   ReqTensor[G, F]  node-filter OR terms
+      grp_filter_valid bool[G, F]
+      pod_grp_match bool[P, G]   group participates in this pod's placement
+                   (owned for regular; selects-victim for inverse)
+      pod_grp_selects bool[P, G] group's selector selects the pod (Record)
+      pod_grp_owned bool[P, G]   pod owns the group (inverse Record)
+      claim_hostname_lane i32[C] hostname vocab lane minted per claim slot
 
     Instance types:
       it_reqs      ReqTensor[T]
@@ -108,22 +131,32 @@ class SchedulingProblem:
       tpl_reqs     ReqTensor[TPL]
       tpl_overhead f32[TPL, R]   daemonset overhead requests
       tpl_it_ok    bool[TPL, T]  instance types offered by this template's pool
+      tpl_remaining f32[TPL, R]  NodePool limits headroom (+inf = unlimited);
+                   the scan subtracts the pessimistic max instance capacity on
+                   every claim open (scheduler.go:347-364)
 
     Existing nodes (pre-sorted: initialized first, then name):
       node_reqs    ReqTensor[N]  label requirements (+hostname)
       node_avail   f32[N, R]     allocatable - current pod requests
       node_overhead f32[N, R]    unscheduled daemonset overhead
+      node_used_ports bool[N, PT] host-port lanes already reserved on the node
     """
 
     # vocab statics
     lane_valid: Any
     lane_numeric: Any
+    lane_lex_rank: Any  # i32[K, V] rank of the lane's value in sorted order —
+    #   topology tie-breaks use it so device picks match the oracle's
+    #   lexicographic rule regardless of lane interning order
     key_wellknown: Any
     # pods
     pod_reqs: ReqTensor
     pod_requests: Any
     pod_tol_tpl: Any
     pod_tol_node: Any
+    pod_ports: Any
+    pod_port_conflict: Any
+    pod_strict_reqs: ReqTensor
     # instance types
     it_reqs: ReqTensor
     it_alloc: Any
@@ -136,10 +169,31 @@ class SchedulingProblem:
     tpl_reqs: ReqTensor
     tpl_overhead: Any
     tpl_it_ok: Any
+    tpl_remaining: Any
     # existing nodes
     node_reqs: ReqTensor
     node_avail: Any
     node_overhead: Any
+    node_used_ports: Any
+    # topology
+    grp_type: Any
+    grp_key: Any
+    grp_max_skew: Any
+    grp_min_domains: Any
+    grp_counts0: Any
+    grp_registered0: Any
+    grp_inverse: Any
+    grp_has_filter: Any
+    grp_filter: ReqTensor
+    grp_filter_valid: Any
+    pod_grp_match: Any
+    pod_grp_selects: Any
+    pod_grp_owned: Any
+    claim_hostname_lane: Any
+
+    @property
+    def num_groups(self) -> int:
+        return self.grp_type.shape[0]
 
     @property
     def num_pods(self) -> int:
@@ -185,3 +239,4 @@ class ProblemMeta:
     node_names: List[str] = field(default_factory=list)
     zone_key_idx: int = -1
     ct_key_idx: int = -1
+    hostname_key_idx: int = -1

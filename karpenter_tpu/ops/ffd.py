@@ -3,27 +3,32 @@
 TPU-native re-design of the reference's Scheduler.Solve pod loop
 (scheduler.go:140-189, :238-285): pods arrive pre-sorted by the FFD queue
 order; one scan step places one pod. Placement *scoring* — which existing
-nodes / open claims / fresh template claims could accept the pod — is computed
-for every candidate at once with the vectorized mask kernels (the reference
-walks them one by one, O(candidates × instanceTypes) set intersections per
-pod); the *commit* stays sequential inside the scan because every placement
-narrows the chosen bin's requirement state.
+nodes / open claims / fresh template claims could accept the pod, including
+the topology domain selection — is computed for every candidate at once with
+the vectorized mask kernels (the reference walks them one by one,
+O(candidates × instanceTypes) set intersections per pod); the *commit* stays
+sequential inside the scan because every placement narrows the chosen bin's
+requirement state and shifts the topology counters.
 
 Placement priority per pod (scheduler.go:238-285):
   1. first existing node (pre-sorted initialized-first) that tolerates, fits,
-     and is requirement-compatible (existingnode.go:64-124, strict Compatible);
-  2. open claim with the fewest pods whose narrowed state keeps >= 1 instance
-     type satisfying requirements + resources + offerings (nodeclaim.go:65-119);
-  3. first template (weight order) whose fresh claim accepts the pod -> opens
-     a new claim in the first free slot;
-  4. otherwise the pod fails this pass (relaxation happens host-side).
+     has no host-port conflict, is requirement-compatible, and satisfies
+     topology (existingnode.go:64-124, strict Compatible);
+  2. open claim with the fewest pods whose topology-narrowed state keeps >= 1
+     instance type satisfying requirements + resources + offerings
+     (nodeclaim.go:65-119);
+  3. first template (weight order) whose fresh claim — minted hostname
+     included — accepts the pod, subject to nodepool limit headroom
+     (filterByRemainingResources / subtractMax, scheduler.go:343-383);
+  4. otherwise the pod fails this pass (relaxation happens host-side between
+     passes, the carried FFDState preserving earlier placements).
 """
 
 from __future__ import annotations
 
 import functools
 from dataclasses import dataclass
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
@@ -31,6 +36,7 @@ from jax import lax, vmap
 
 from karpenter_tpu.models.problem import ReqTensor, SchedulingProblem
 from karpenter_tpu.ops import masks
+from karpenter_tpu.ops.topology_kernels import PodTopoStatics, record, topo_gate
 
 # placement kinds emitted per pod
 KIND_NODE = 0
@@ -38,6 +44,11 @@ KIND_CLAIM = 1
 KIND_NEW_CLAIM = 2
 KIND_FAIL = 3
 KIND_NO_SLOT = 4  # a fresh claim would accept the pod, but slots ran out
+
+# vocab key indices the encoder pins
+ZONE_KEY = 0
+CT_KEY = 1
+HOSTNAME_KEY = 2
 
 _BIG = jnp.int32(2**30)
 
@@ -51,9 +62,14 @@ class FFDState:
     claim_open: Any  # bool[C]
     claim_npods: Any  # i32[C]
     claim_tpl: Any  # i32[C]
+    claim_used_ports: Any  # bool[C, PT] reserved host-port lanes
     node_req: ReqTensor  # [N, K, V] narrowed existing-node requirements
     node_requests: Any  # f32[N, R] accumulated requests (incl daemon overhead)
     node_npods: Any  # i32[N]
+    node_used_ports: Any  # bool[N, PT]
+    remaining: Any  # f32[TPL, R] nodepool limits headroom (+inf unlimited)
+    grp_counts: Any  # i32[G, V] topology domain counts
+    grp_registered: Any  # bool[G, V] known topology domains
 
 
 @jax.tree_util.register_dataclass
@@ -73,10 +89,46 @@ def _intersect_rows(reqs: ReqTensor, row: ReqTensor) -> ReqTensor:
     return vmap(lambda r: masks.intersect(r, row))(reqs)
 
 
-def solve_ffd(problem: SchedulingProblem, max_claims: int) -> FFDResult:
-    """Run the full pack. Shapes are static per (P, N, T, TPL, K, V, R,
-    max_claims) bucket; XLA caches the compiled executable across batches."""
-    return _solve_ffd_jit(problem, max_claims)
+def initial_state(problem: SchedulingProblem, max_claims: int) -> FFDState:
+    K, V = problem.num_keys, problem.num_lanes
+    T, R = problem.num_instance_types, problem.num_resources
+    N, C = problem.num_nodes, max_claims
+    PT = problem.pod_ports.shape[1]
+    lv = jnp.asarray(problem.lane_valid)
+    return FFDState(
+        claim_req=ReqTensor(
+            admitted=jnp.broadcast_to(lv, (C, K, V)),
+            comp=jnp.ones((C, K), dtype=bool),
+            gt=jnp.full((C, K), -(2**31) + 1, dtype=jnp.int32),
+            lt=jnp.full((C, K), 2**31 - 1, dtype=jnp.int32),
+            defined=jnp.zeros((C, K), dtype=bool),
+        ),
+        claim_requests=jnp.zeros((C, R), dtype=jnp.float32),
+        claim_it_ok=jnp.zeros((C, T), dtype=bool),
+        claim_open=jnp.zeros((C,), dtype=bool),
+        claim_npods=jnp.zeros((C,), dtype=jnp.int32),
+        claim_tpl=jnp.zeros((C,), dtype=jnp.int32),
+        claim_used_ports=jnp.zeros((C, PT), dtype=bool),
+        node_req=jax.tree_util.tree_map(jnp.asarray, problem.node_reqs),
+        node_requests=jnp.asarray(problem.node_overhead),
+        node_npods=jnp.zeros((N,), dtype=jnp.int32),
+        node_used_ports=jnp.asarray(problem.node_used_ports),
+        remaining=jnp.asarray(problem.tpl_remaining),
+        grp_counts=jnp.asarray(problem.grp_counts0),
+        grp_registered=jnp.asarray(problem.grp_registered0),
+    )
+
+
+def solve_ffd(
+    problem: SchedulingProblem, max_claims: int, init: Optional[FFDState] = None
+) -> FFDResult:
+    """Run one pack pass. Shapes are static per bucket; XLA caches the
+    compiled executable across batches. ``init`` carries bin + topology state
+    between relax-and-retry passes (the queue requeue of scheduler.go:150-170).
+    """
+    if init is None:
+        init = initial_state(problem, max_claims)
+    return _solve_ffd_jit(problem, init)
 
 
 def _pad_lanes_mult32(problem: SchedulingProblem) -> SchedulingProblem:
@@ -94,60 +146,54 @@ def _pad_lanes_mult32(problem: SchedulingProblem) -> SchedulingProblem:
             r, admitted=jnp.pad(r.admitted, [(0, 0)] * (r.admitted.ndim - 1) + [(0, pad)])
         )
 
+    lane_pad = [(0, 0), (0, pad)]
     return dataclasses.replace(
         problem,
-        lane_valid=jnp.pad(problem.lane_valid, [(0, 0), (0, pad)]),
-        lane_numeric=jnp.pad(problem.lane_numeric, [(0, 0), (0, pad)], constant_values=jnp.nan),
+        lane_valid=jnp.pad(problem.lane_valid, lane_pad),
+        lane_numeric=jnp.pad(problem.lane_numeric, lane_pad, constant_values=jnp.nan),
+        lane_lex_rank=jnp.pad(problem.lane_lex_rank, lane_pad, constant_values=2**30),
         pod_reqs=pad_req(problem.pod_reqs),
+        pod_strict_reqs=pad_req(problem.pod_strict_reqs),
         it_reqs=pad_req(problem.it_reqs),
         tpl_reqs=pad_req(problem.tpl_reqs),
         node_reqs=pad_req(problem.node_reqs),
+        grp_filter=pad_req(problem.grp_filter),
+        grp_counts0=jnp.pad(problem.grp_counts0, lane_pad),
+        grp_registered0=jnp.pad(problem.grp_registered0, lane_pad),
     )
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
-def _solve_ffd_jit(problem: SchedulingProblem, max_claims: int) -> FFDResult:
+@jax.jit
+def _solve_ffd_jit(problem: SchedulingProblem, init: FFDState) -> FFDResult:
     problem = _pad_lanes_mult32(problem)
-    P = problem.num_pods
+    C = init.claim_open.shape[0]
     N = problem.num_nodes
     T = problem.num_instance_types
     TPL = problem.num_templates
     K = problem.num_keys
     V = problem.num_lanes
-    R = problem.num_resources
-    C = max_claims
 
-    lv, ln = problem.lane_valid, problem.lane_numeric
-    wellknown = problem.key_wellknown
-    no_allow = jnp.zeros_like(wellknown)
-    zone_k, ct_k = _zone_ct_static(problem)
+    # lane-pad carried state to match (no-op when init came from initial_state)
+    if init.grp_counts.shape[-1] != V:
+        pad = V - init.grp_counts.shape[-1]
+        import dataclasses
 
-    def empty_req(n):
-        return ReqTensor(
-            admitted=jnp.broadcast_to(lv, (n, K, V)),
-            comp=jnp.ones((n, K), dtype=bool),
-            gt=jnp.full((n, K), -(2**31) + 1, dtype=jnp.int32),
-            lt=jnp.full((n, K), 2**31 - 1, dtype=jnp.int32),
-            defined=jnp.zeros((n, K), dtype=bool),
+        def pad_adm(r):
+            return dataclasses.replace(
+                r, admitted=jnp.pad(r.admitted, [(0, 0)] * (r.admitted.ndim - 1) + [(0, pad)])
+            )
+
+        init = dataclasses.replace(
+            init,
+            claim_req=pad_adm(init.claim_req),
+            node_req=pad_adm(init.node_req),
+            grp_counts=jnp.pad(init.grp_counts, [(0, 0), (0, pad)]),
+            grp_registered=jnp.pad(init.grp_registered, [(0, 0), (0, pad)]),
         )
 
-    init = FFDState(
-        claim_req=empty_req(C),
-        claim_requests=jnp.zeros((C, R), dtype=jnp.float32),
-        claim_it_ok=jnp.zeros((C, T), dtype=bool),
-        claim_open=jnp.zeros((C,), dtype=bool),
-        claim_npods=jnp.zeros((C,), dtype=jnp.int32),
-        claim_tpl=jnp.zeros((C,), dtype=jnp.int32),
-        node_req=ReqTensor(
-            admitted=jnp.asarray(problem.node_reqs.admitted),
-            comp=jnp.asarray(problem.node_reqs.comp),
-            gt=jnp.asarray(problem.node_reqs.gt),
-            lt=jnp.asarray(problem.node_reqs.lt),
-            defined=jnp.asarray(problem.node_reqs.defined),
-        ),
-        node_requests=jnp.asarray(problem.node_overhead),
-        node_npods=jnp.zeros((N,), dtype=jnp.int32),
-    )
+    lv, ln = jnp.asarray(problem.lane_valid), jnp.asarray(problem.lane_numeric)
+    wellknown = jnp.asarray(problem.key_wellknown)
+    no_allow = jnp.zeros_like(wellknown)
 
     # instance-type side of the hot compat product: packed lanes + polarity,
     # computed once per solve (instance types never change during a pack)
@@ -155,9 +201,8 @@ def _solve_ffd_jit(problem: SchedulingProblem, max_claims: int) -> FFDResult:
     it_neg = vmap(lambda r: masks.negative_polarity(r, lv, ln))(problem.it_reqs)
 
     def it_gate(state_rows: ReqTensor, requests: jnp.ndarray, prior_ok: jnp.ndarray):
-        """[B, T] mask of instance types surviving a hypothetical narrowed
-        state + accumulated requests (nodeclaim.go:225-260: requirements,
-        fits, offerings)."""
+        """[B, T] mask of instance types surviving a narrowed state +
+        accumulated requests (nodeclaim.go:225-260)."""
         state_packed = masks.pack_lanes(state_rows.admitted)  # [B, K, W]
         state_neg = vmap(lambda r: masks.negative_polarity(r, lv, ln))(state_rows)
         compat = masks.packed_pairwise_compat(
@@ -166,53 +211,114 @@ def _solve_ffd_jit(problem: SchedulingProblem, max_claims: int) -> FFDResult:
         fit = masks.fits(requests[:, None, :], problem.it_alloc[None, :, :])  # [B, T]
         offer = vmap(
             lambda adm: masks.has_offering(
-                adm, zone_k, ct_k, problem.offer_zone, problem.offer_ct, problem.offer_ok
+                adm, ZONE_KEY, CT_KEY, problem.offer_zone, problem.offer_ct, problem.offer_ok
             )
         )(state_rows.admitted)  # [B, T]
         return prior_ok & compat & fit & offer
 
     def step(state: FFDState, pod):
-        pod_req, pod_requests, tol_tpl, tol_node = pod
+        (
+            pod_req,
+            pod_strict,
+            pod_requests,
+            tol_tpl,
+            tol_node,
+            pod_ports,
+            pod_conflict,
+            grp_match,
+            grp_selects,
+            grp_owned,
+        ) = pod
+        topo_pod = PodTopoStatics(
+            strict_admitted=pod_strict.admitted,
+            grp_match=grp_match,
+            grp_selects=grp_selects,
+            grp_owned=grp_owned,
+        )
 
-        # -- 1. existing nodes (scheduler.go:240-244)
+        # -- 1. existing nodes (scheduler.go:240-244; existingnode.go:64-124)
         node_requests2 = state.node_requests + pod_requests[None, :]
         node_fit = masks.fits(node_requests2, problem.node_avail)
         node_compat = vmap(
             lambda nr: masks.compatible_ok(nr, pod_req, lv, ln, no_allow)
         )(state.node_req)
-        node_ok = tol_node & node_fit & node_compat
+        node_port_ok = ~jnp.any(state.node_used_ports & pod_conflict[None, :], axis=-1)
+        node_merged = _intersect_rows(state.node_req, pod_req)
+        node_topo_ok, node_final = topo_gate(
+            problem, state.grp_counts, state.grp_registered, topo_pod, node_merged, no_allow
+        )
+        node_ok = tol_node & node_fit & node_compat & node_port_ok & node_topo_ok
         node_pick = _first_true(node_ok)
         any_node = jnp.any(node_ok)
 
         # -- 2. open claims, fewest pods first (scheduler.go:247-254)
-        claim_new_req = _intersect_rows(state.claim_req, pod_req)
         claim_compat = vmap(
             lambda cr: masks.compatible_ok(cr, pod_req, lv, ln, wellknown)
         )(state.claim_req)
+        claim_merged = _intersect_rows(state.claim_req, pod_req)
+        claim_topo_ok, claim_final = topo_gate(
+            problem, state.grp_counts, state.grp_registered, topo_pod, claim_merged, wellknown
+        )
         claim_requests2 = state.claim_requests + pod_requests[None, :]
-        claim_it_ok2 = it_gate(claim_new_req, claim_requests2, state.claim_it_ok)
+        claim_it_ok2 = it_gate(claim_final, claim_requests2, state.claim_it_ok)
+        claim_port_ok = ~jnp.any(state.claim_used_ports & pod_conflict[None, :], axis=-1)
         claim_ok = (
             state.claim_open
             & tol_tpl[state.claim_tpl]
+            & claim_port_ok
             & claim_compat
+            & claim_topo_ok
             & jnp.any(claim_it_ok2, axis=-1)
         )
         claim_rank = jnp.where(claim_ok, state.claim_npods * C + jnp.arange(C), _BIG)
         claim_pick = jnp.argmin(claim_rank)
         any_claim = jnp.any(claim_ok)
 
-        # -- 3. fresh claim from templates, weight order (scheduler.go:256-283)
-        tpl_new_req = _intersect_rows(problem.tpl_reqs, pod_req)
+        # -- 3. fresh claim from templates, weight order (scheduler.go:256-283);
+        # the prospective slot's hostname is minted before evaluation
+        # (nodeclaim.go:46-63) and its lane registered for topology if opened
+        free_slot = _first_true(~state.claim_open)
+        has_slot = jnp.any(~state.claim_open)
+        # hostname minting is active only when the encoder allotted claim
+        # hostname lanes (static shape decision)
+        mint_hostnames = problem.claim_hostname_lane.shape[0] > 0
+        if mint_hostnames:
+            host_lane = problem.claim_hostname_lane[
+                jnp.minimum(free_slot, problem.claim_hostname_lane.shape[0] - 1)
+            ]
+            host_onehot = jnp.arange(V) == host_lane  # [V]
+        else:
+            host_onehot = jnp.zeros((V,), dtype=bool)
+
         tpl_compat = vmap(
             lambda tr: masks.compatible_ok(tr, pod_req, lv, ln, wellknown)
         )(problem.tpl_reqs)
+        tpl_merged = _intersect_rows(problem.tpl_reqs, pod_req)
+        if mint_hostnames:
+            tpl_merged = ReqTensor(
+                admitted=tpl_merged.admitted.at[:, HOSTNAME_KEY, :].set(
+                    tpl_merged.admitted[:, HOSTNAME_KEY, :] & host_onehot[None, :]
+                ),
+                comp=tpl_merged.comp.at[:, HOSTNAME_KEY].set(False),
+                gt=tpl_merged.gt,
+                lt=tpl_merged.lt,
+                defined=tpl_merged.defined.at[:, HOSTNAME_KEY].set(True),
+            )
+        # the new hostname is registered before the gate evaluates
+        reg_for_tpl = state.grp_registered | (
+            (problem.grp_key == HOSTNAME_KEY)[:, None] & host_onehot[None, :]
+        )
+        tpl_topo_ok, tpl_final = topo_gate(
+            problem, state.grp_counts, reg_for_tpl, topo_pod, tpl_merged, wellknown
+        )
         tpl_requests2 = problem.tpl_overhead + pod_requests[None, :]
-        tpl_it_ok2 = it_gate(tpl_new_req, tpl_requests2, problem.tpl_it_ok)
-        tpl_ok = tol_tpl & tpl_compat & jnp.any(tpl_it_ok2, axis=-1)
+        within_limits = masks.fits(
+            problem.it_cap[None, :, :], state.remaining[:, None, :]
+        )  # [TPL, T]
+        tpl_it_ok2 = it_gate(tpl_final, tpl_requests2, problem.tpl_it_ok & within_limits)
+        tpl_ok = tol_tpl & tpl_compat & tpl_topo_ok & jnp.any(tpl_it_ok2, axis=-1)
         tpl_pick = _first_true(tpl_ok)
         any_tpl = jnp.any(tpl_ok)
-        free_slot = _first_true(~state.claim_open)
-        has_slot = jnp.any(~state.claim_open)
 
         kind = jnp.where(
             any_node,
@@ -243,23 +349,19 @@ def _solve_ffd_jit(problem: SchedulingProblem, max_claims: int) -> FFDResult:
                 defined=jnp.where(sel2, upd.defined, cur.defined),
             )
 
+        def gather_row(rows: ReqTensor, idx, cap) -> ReqTensor:
+            return rows.row(jnp.minimum(idx, cap - 1))
+
         # node commit (existingnode.go:116-123)
-        node_upd = _intersect_rows(state.node_req, pod_req)
-        new_node_req = mix_req(state.node_req, node_upd, node_hot)
+        new_node_req = mix_req(state.node_req, node_final, node_hot)
         new_node_requests = jnp.where(node_hot[:, None], node_requests2, state.node_requests)
         new_node_npods = state.node_npods + node_hot.astype(jnp.int32)
+        new_node_used_ports = state.node_used_ports | (node_hot[:, None] & pod_ports[None, :])
 
         # claim commit (nodeclaim.go:111-118)
-        tpl_row = lambda arr: arr[jnp.minimum(tpl_pick, TPL - 1)]
-        slot_req = ReqTensor(
-            admitted=tpl_row(tpl_new_req.admitted),
-            comp=tpl_row(tpl_new_req.comp),
-            gt=tpl_row(tpl_new_req.gt),
-            lt=tpl_row(tpl_new_req.lt),
-            defined=tpl_row(tpl_new_req.defined),
-        )
+        slot_req = gather_row(tpl_final, tpl_pick, TPL)
         new_claim_req = mix_req(
-            mix_req(state.claim_req, claim_new_req, claim_hot),
+            mix_req(state.claim_req, claim_final, claim_hot),
             ReqTensor(
                 admitted=jnp.broadcast_to(slot_req.admitted, (C, K, V)),
                 comp=jnp.broadcast_to(slot_req.comp, (C, K)),
@@ -269,19 +371,72 @@ def _solve_ffd_jit(problem: SchedulingProblem, max_claims: int) -> FFDResult:
             ),
             slot_hot,
         )
+        tpl_row_requests = tpl_requests2[jnp.minimum(tpl_pick, TPL - 1)]
         new_claim_requests = jnp.where(
             claim_hot[:, None],
             claim_requests2,
-            jnp.where(slot_hot[:, None], tpl_requests2[jnp.minimum(tpl_pick, TPL - 1)][None, :], state.claim_requests),
+            jnp.where(slot_hot[:, None], tpl_row_requests[None, :], state.claim_requests),
         )
+        tpl_row_it_ok = tpl_it_ok2[jnp.minimum(tpl_pick, TPL - 1)]
         new_claim_it_ok = jnp.where(
             claim_hot[:, None],
             claim_it_ok2,
-            jnp.where(slot_hot[:, None], tpl_it_ok2[jnp.minimum(tpl_pick, TPL - 1)][None, :], state.claim_it_ok),
+            jnp.where(slot_hot[:, None], tpl_row_it_ok[None, :], state.claim_it_ok),
         )
         new_claim_open = state.claim_open | slot_hot
         new_claim_npods = state.claim_npods + claim_hot.astype(jnp.int32) + slot_hot.astype(jnp.int32)
         new_claim_tpl = jnp.where(slot_hot, tpl_pick.astype(jnp.int32), state.claim_tpl)
+        new_claim_used_ports = state.claim_used_ports | (
+            (claim_hot | slot_hot)[:, None] & pod_ports[None, :]
+        )
+
+        # opening a claim burns pessimistic headroom (subtractMax) and
+        # registers its hostname lane for hostname topologies
+        opened = kind == KIND_NEW_CLAIM
+        opened_tpl_hot = (jnp.arange(TPL) == tpl_pick) & opened
+        max_cap = jnp.max(
+            jnp.where(tpl_row_it_ok[:, None], problem.it_cap, 0.0), axis=0
+        )  # [R]
+        new_remaining = jnp.where(
+            opened_tpl_hot[:, None], state.remaining - max_cap[None, :], state.remaining
+        )
+        new_registered = state.grp_registered | (
+            opened
+            & mint_hostnames
+            & (problem.grp_key == HOSTNAME_KEY)[:, None]
+            & host_onehot[None, :]
+        )
+
+        # topology record for the chosen bin (topology.go:125-148)
+        committed = (kind == KIND_NODE) | (kind == KIND_CLAIM) | (kind == KIND_NEW_CLAIM)
+        chosen_final = gather_row(node_final, node_pick, N) if N > 0 else None
+        claim_row = gather_row(claim_final, claim_pick, C)
+        slot_row = slot_req
+
+        def pick_rows(a, b, cond):
+            return jax.tree_util.tree_map(
+                lambda x, y: jnp.where(
+                    jnp.reshape(cond, (1,) * x.ndim), x, y
+                ),
+                a,
+                b,
+            )
+
+        rec_row = pick_rows(claim_row, slot_row, kind == KIND_CLAIM)
+        if N > 0:
+            rec_row = pick_rows(chosen_final, rec_row, kind == KIND_NODE)
+        rec_allow = jnp.where(kind == KIND_NODE, no_allow, wellknown)
+        new_counts, new_registered = record(
+            problem,
+            state.grp_counts,
+            new_registered,
+            topo_pod,
+            rec_row,
+            rec_allow,
+            committed,
+            lv,
+            ln,
+        )
 
         index = jnp.where(
             kind == KIND_NODE,
@@ -296,22 +451,28 @@ def _solve_ffd_jit(problem: SchedulingProblem, max_claims: int) -> FFDResult:
             claim_open=new_claim_open,
             claim_npods=new_claim_npods,
             claim_tpl=new_claim_tpl,
+            claim_used_ports=new_claim_used_ports,
             node_req=new_node_req,
             node_requests=new_node_requests,
             node_npods=new_node_npods,
+            node_used_ports=new_node_used_ports,
+            remaining=new_remaining,
+            grp_counts=new_counts,
+            grp_registered=new_registered,
         )
         return new_state, (kind, index)
 
     pods_xs = (
         problem.pod_reqs,
+        problem.pod_strict_reqs,
         jnp.asarray(problem.pod_requests),
         jnp.asarray(problem.pod_tol_tpl),
         jnp.asarray(problem.pod_tol_node),
+        jnp.asarray(problem.pod_ports),
+        jnp.asarray(problem.pod_port_conflict),
+        jnp.asarray(problem.pod_grp_match),
+        jnp.asarray(problem.pod_grp_selects),
+        jnp.asarray(problem.pod_grp_owned),
     )
     final_state, (kinds, indices) = lax.scan(step, init, pods_xs)
     return FFDResult(kind=kinds, index=indices, state=final_state)
-
-
-def _zone_ct_static(problem: SchedulingProblem) -> tuple:
-    """Zone / capacity-type key indices: the encoder pins them to 0 and 1."""
-    return 0, 1

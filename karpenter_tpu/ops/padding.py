@@ -64,15 +64,23 @@ def pad_problem(p: SchedulingProblem) -> SchedulingProblem:
     V = pow2_bucket(p.num_lanes, lo=32)
     R = pow2_bucket(p.num_resources, lo=8)
     O = pow2_bucket(p.offer_ok.shape[1], lo=8)
+    PT = pow2_bucket(p.pod_ports.shape[1], lo=8)
+    # G=0 stays 0: the topology kernels early-exit statically
+    G = pow2_bucket(p.num_groups, lo=8) if p.num_groups else 0
+    F = pow2_bucket(p.grp_filter_valid.shape[1], lo=2) if p.num_groups else p.grp_filter_valid.shape[1]
 
     return SchedulingProblem(
         lane_valid=_pad(p.lane_valid, (K, V), False),
         lane_numeric=_pad(p.lane_numeric, (K, V), np.nan),
+        lane_lex_rank=_pad(p.lane_lex_rank, (K, V), 2**30),
         key_wellknown=_pad(p.key_wellknown, (K,), False),
         pod_reqs=_pad_reqs(p.pod_reqs, P, K, V),
         pod_requests=_pad(p.pod_requests, (P, R), 0.0),
         pod_tol_tpl=_pad(p.pod_tol_tpl, (P, TPL), False),
         pod_tol_node=_pad(p.pod_tol_node, (P, N), False),
+        pod_ports=_pad(p.pod_ports, (P, PT), False),
+        pod_port_conflict=_pad(p.pod_port_conflict, (P, PT), False),
+        pod_strict_reqs=_pad_reqs(p.pod_strict_reqs, P, K, V),
         it_reqs=_pad_reqs(p.it_reqs, T, K, V),
         it_alloc=_pad_capacity(p.it_alloc, T, R, -1.0),
         it_cap=_pad_capacity(p.it_cap, T, R, 0.0),
@@ -83,7 +91,33 @@ def pad_problem(p: SchedulingProblem) -> SchedulingProblem:
         tpl_reqs=_pad_reqs(p.tpl_reqs, TPL, K, V),
         tpl_overhead=_pad(p.tpl_overhead, (TPL, R), 0.0),
         tpl_it_ok=_pad(p.tpl_it_ok, (TPL, T), False),
+        tpl_remaining=_pad(p.tpl_remaining, (TPL, R), np.float32(np.inf)),
         node_reqs=_pad_reqs(p.node_reqs, N, K, V),
         node_avail=_pad_capacity(p.node_avail, N, R, -1.0),
         node_overhead=_pad(p.node_overhead, (N, R), 0.0),
+        node_used_ports=_pad(p.node_used_ports, (N, PT), False),
+        grp_type=_pad(p.grp_type, (G,), 0),
+        grp_key=_pad(p.grp_key, (G,), 0),
+        grp_max_skew=_pad(p.grp_max_skew, (G,), 2**31 - 1),
+        grp_min_domains=_pad(p.grp_min_domains, (G,), -1),
+        grp_counts0=_pad(p.grp_counts0, (G, V), 0),
+        grp_registered0=_pad(p.grp_registered0, (G, V), False),
+        grp_inverse=_pad(p.grp_inverse, (G,), False),
+        grp_has_filter=_pad(p.grp_has_filter, (G,), False),
+        grp_filter=_pad_filter_reqs(p.grp_filter, G, F, K, V),
+        grp_filter_valid=_pad(p.grp_filter_valid, (G, F), False),
+        pod_grp_match=_pad(p.pod_grp_match, (P, G), False),
+        pod_grp_selects=_pad(p.pod_grp_selects, (P, G), False),
+        pod_grp_owned=_pad(p.pod_grp_owned, (P, G), False),
+        claim_hostname_lane=p.claim_hostname_lane,
+    )
+
+
+def _pad_filter_reqs(r: ReqTensor, g: int, f: int, k: int, v: int) -> ReqTensor:
+    return ReqTensor(
+        admitted=_pad(r.admitted, (g, f, k, v), False),
+        comp=_pad(r.comp, (g, f, k), True),
+        gt=_pad(r.gt, (g, f, k), GT_NONE),
+        lt=_pad(r.lt, (g, f, k), LT_NONE),
+        defined=_pad(r.defined, (g, f, k), False),
     )

@@ -1,0 +1,233 @@
+"""Topology domain-selection kernels.
+
+Device twins of TopologyGroup.Get / Topology.AddRequirements / Topology.Record
+(reference topologygroup.go:93-256, topology.go:125-172), vectorized over
+candidate bins: for one pod step, every open bin's topology verdict and the
+domain narrowing it implies are computed at once as [B, G, V] lane math.
+
+Where the reference breaks ties by Go map iteration order (random), these
+kernels pick the lowest lane index; the host oracle does the same, keeping the
+two backends in lockstep.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax.numpy as jnp
+from jax import vmap
+
+from karpenter_tpu.models.problem import ReqTensor, SchedulingProblem
+from karpenter_tpu.ops import masks
+
+_MAXI = jnp.int32(2**31 - 1)
+
+TYPE_SPREAD = 0
+TYPE_AFFINITY = 1
+TYPE_ANTI_AFFINITY = 2
+
+
+class PodTopoStatics(NamedTuple):
+    """Per-pod static inputs to the gate (one scan step's xs slice)."""
+
+    strict_admitted: Any  # bool[K, V] strict pod requirement lanes
+    grp_match: Any  # bool[G]
+    grp_selects: Any  # bool[G]
+    grp_owned: Any  # bool[G]
+
+
+def _lowest_by_rank(mask: jnp.ndarray, rank: jnp.ndarray) -> jnp.ndarray:
+    """One-hot of the set lane with the smallest rank (lexicographically first
+    value — parity with the oracle's sorted() iteration); all-zero when mask
+    is empty."""
+    ranked = jnp.where(mask, rank, _MAXI)
+    best = jnp.min(ranked, axis=-1, keepdims=True)
+    return mask & (ranked == best) & (best < _MAXI)
+
+
+def allowed_domains(
+    problem: SchedulingProblem,
+    counts: jnp.ndarray,  # i32[G, V] current domain counts
+    registered: jnp.ndarray,  # bool[G, V] current registered domains
+    pod: PodTopoStatics,
+    bin_admitted: jnp.ndarray,  # bool[B, K, V] candidate-bin admitted lanes (after pod merge)
+) -> jnp.ndarray:
+    """bool[B, G, V]: the domains each matching group would allow this pod on
+    each bin — TopologyGroup.Get, batched. Non-matching groups read all-True.
+    """
+    G = counts.shape[0]
+    V = counts.shape[1]
+    key = problem.grp_key  # i32[G]
+
+    pod_dom = pod.strict_admitted[key]  # bool[G, V] podDomains.has(lane)
+    node_dom = bin_admitted[:, key, :]  # bool[B, G, V]
+    reg = registered
+
+    # --- spread (topologygroup.go:163-213) ----------------------------------
+    # global min over registered lanes the pod supports; hostname keys pin 0
+    sup = reg & pod_dom  # bool[G, V]
+    sup_counts = jnp.where(sup, counts, _MAXI)
+    global_min = jnp.min(sup_counts, axis=-1)  # i32[G]
+    n_supported = sup.sum(axis=-1).astype(jnp.int32)
+    has_min_domains = problem.grp_min_domains >= 0
+    global_min = jnp.where(
+        has_min_domains & (n_supported < problem.grp_min_domains), 0, global_min
+    )
+    is_hostname = key == _hostname_key(problem)
+    global_min = jnp.where(is_hostname, 0, global_min)
+
+    self_count = counts + pod.grp_selects[:, None].astype(jnp.int32)  # i32[G, V]
+    within_skew = (self_count - global_min[:, None]) <= problem.grp_max_skew[:, None]
+    eligible = reg[None, :, :] & node_dom & within_skew[None, :, :]  # [B, G, V]
+    # lowest count first, lexicographically-first value on ties (oracle parity)
+    lex = problem.lane_lex_rank[key]  # i32[G, V]
+    rank = jnp.where(eligible, self_count[None, :, :] * V + jnp.minimum(lex, V - 1)[None, :, :], _MAXI)
+    best = jnp.min(rank, axis=-1, keepdims=True)
+    spread_allowed = eligible & (rank == best) & (best < _MAXI)
+
+    # --- affinity (topologygroup.go:215-246) --------------------------------
+    positive = reg & (counts > 0) & pod_dom  # [G, V]
+    aff_allowed = jnp.broadcast_to(positive[None, :, :], spread_allowed.shape)
+    # bootstrap for self-selecting pods when nothing is placed yet
+    nothing_placed = ~jnp.any(positive, axis=-1)  # [G]
+    boot_inter = _lowest_by_rank(
+        reg[None, :, :] & pod_dom[None, :, :] & node_dom, lex[None, :, :]
+    )  # [B, G, V]
+    boot_any = _lowest_by_rank(reg & pod_dom, lex)[None, :, :]  # [1, G, V]
+    bootstrap = (boot_inter | boot_any) & (
+        nothing_placed & pod.grp_selects
+    )[None, :, None]
+    aff_allowed = aff_allowed | bootstrap
+
+    # --- anti-affinity (topologygroup.go:248-256) ---------------------------
+    anti_allowed = jnp.broadcast_to(
+        (reg & (counts == 0) & pod_dom)[None, :, :], spread_allowed.shape
+    )
+
+    allowed = jnp.where(
+        (problem.grp_type == TYPE_SPREAD)[None, :, None],
+        spread_allowed,
+        jnp.where(
+            (problem.grp_type == TYPE_AFFINITY)[None, :, None], aff_allowed, anti_allowed
+        ),
+    )
+    # groups that don't participate in this pod's placement allow everything
+    return jnp.where(pod.grp_match[None, :, None], allowed, True)
+
+
+def topo_gate(
+    problem: SchedulingProblem,
+    counts: jnp.ndarray,
+    registered: jnp.ndarray,
+    pod: PodTopoStatics,
+    bin_rows: ReqTensor,  # [B, K, V...] bin state after pod merge
+    wellknown_allow: jnp.ndarray,  # bool[K] — zeros for existing nodes
+):
+    """Returns (ok[B], final_rows) — the reference's AddRequirements +
+    Compatible + Add sequence (nodeclaim.go:92-100): every matching group must
+    allow >= 1 domain, the allowed domains must intersect the bin state, the
+    undefined-key rule applies (domains are concrete positive sets), and the
+    bin state narrows to the allowed lanes."""
+    G = counts.shape[0]
+    if G == 0:
+        return jnp.ones(bin_rows.admitted.shape[0], dtype=bool), bin_rows
+
+    allowed = allowed_domains(problem, counts, registered, pod, bin_rows.admitted)
+    match = pod.grp_match  # bool[G]
+    # unsatisfiable when a matching group allows no domain at all (allowed is
+    # forced all-True for non-matching groups inside allowed_domains)
+    grp_sat = jnp.any(allowed, axis=-1) | ~match[None, :]  # [B, G]
+
+    # combine per key: AND (scatter-min with duplicate key indices) of all
+    # matching groups' allowed lanes into a [B, K, V] limit mask
+    B, K, V = bin_rows.admitted.shape
+    masked = jnp.where(match[None, :, None], allowed, True).astype(jnp.uint8)
+    limit = (
+        jnp.ones((B, K, V), dtype=jnp.uint8)
+        .at[:, problem.grp_key, :]
+        .min(masked)
+        .astype(bool)
+    )
+    touched = (
+        jnp.zeros((K,), dtype=jnp.uint8)
+        .at[problem.grp_key]
+        .max(match.astype(jnp.uint8))
+        .astype(bool)
+    )
+
+    new_admitted = bin_rows.admitted & jnp.where(touched[None, :, None], limit, True)
+    # Compatible: at touched keys the narrowed set must stay nonempty, and the
+    # key must be defined on the bin or allowed-undefined (domains are
+    # positive concrete sets, so no polarity escape applies)
+    key_ok = (
+        ~touched[None, :]
+        | (
+            jnp.any(new_admitted, axis=-1)
+            & (bin_rows.defined | wellknown_allow[None, :])
+        )
+    )  # [B, K]
+    ok = jnp.all(grp_sat, axis=-1) & jnp.all(key_ok, axis=-1)
+
+    final = ReqTensor(
+        admitted=new_admitted,
+        comp=bin_rows.comp & ~touched[None, :],
+        gt=bin_rows.gt,
+        lt=bin_rows.lt,
+        defined=bin_rows.defined | touched[None, :],
+    )
+    return ok, final
+
+
+def record(
+    problem: SchedulingProblem,
+    counts: jnp.ndarray,
+    registered: jnp.ndarray,
+    pod: PodTopoStatics,
+    final_row: ReqTensor,  # [K, V...] the chosen bin's final state
+    wellknown_allow: jnp.ndarray,
+    committed: jnp.ndarray,  # bool scalar: a placement actually happened
+    lv: jnp.ndarray,
+    ln: jnp.ndarray,
+) -> jnp.ndarray:
+    """(counts', registered') — Topology.Record (topology.go:125-148).
+
+    Regular groups count the pod when the selector selects it and the spread
+    node-filter accepts the final bin state; spread/affinity record only a
+    collapsed single domain, anti-affinity blocks every admitted domain.
+    Inverse groups record the pod's possible domains when the pod owns them.
+    Complement sets record nothing (see provisioning/topology.py on the
+    Values() quirk). Recording a lane also registers it — the reference's
+    domains map gains previously-unknown domains on increment."""
+    G = counts.shape[0]
+    if G == 0:
+        return counts, registered
+    key = problem.grp_key
+    dom = final_row.admitted[key]  # [G, V] candidate record lanes
+    concrete = ~final_row.comp[key]  # [G]
+
+    # node-filter acceptance of the final state (spread only)
+    def filter_match(g):
+        terms = problem.grp_filter.row(g)  # [F, K, V...]
+        term_ok = vmap(
+            lambda t: masks.compatible_ok(final_row, t, lv, ln, wellknown_allow)
+        )(terms)
+        return ~problem.grp_has_filter[g] | jnp.any(
+            problem.grp_filter_valid[g] & term_ok
+        )
+
+    filt = vmap(filter_match)(jnp.arange(G))  # [G]
+    counts_pod = pod.grp_selects & filt & ~problem.grp_inverse  # [G]
+
+    single = dom.sum(axis=-1) == 1  # [G]
+    spread_or_aff = (problem.grp_type == TYPE_SPREAD) | (problem.grp_type == TYPE_AFFINITY)
+    regular_rec = counts_pod & concrete & jnp.where(spread_or_aff, single, True)
+    inverse_rec = problem.grp_inverse & pod.grp_owned & concrete
+
+    rec = (regular_rec | inverse_rec) & committed
+    recorded = rec[:, None] & dom
+    return counts + recorded.astype(jnp.int32), registered | recorded
+
+
+def _hostname_key(problem: SchedulingProblem) -> int:
+    """The encoder pins hostname to vocab key index 2 (zone=0, ct=1)."""
+    return 2

@@ -27,7 +27,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from karpenter_tpu.models.problem import SchedulingProblem
-from karpenter_tpu.ops.ffd import FFDResult, _solve_ffd_jit
+from karpenter_tpu.ops.ffd import FFDResult, _solve_ffd_jit, initial_state
 
 CANDIDATE_AXIS = "candidates"
 
@@ -53,7 +53,9 @@ def shard_batch(batch: SchedulingProblem, mesh: Mesh, axis: str = CANDIDATE_AXIS
 
 @functools.partial(jax.jit, static_argnums=(1,))
 def _batched_solve_jit(batch: SchedulingProblem, max_claims: int) -> FFDResult:
-    return jax.vmap(lambda p: _solve_ffd_jit.__wrapped__(p, max_claims))(batch)
+    return jax.vmap(
+        lambda p: _solve_ffd_jit.__wrapped__(p, initial_state(p, max_claims))
+    )(batch)
 
 
 def batched_solve(

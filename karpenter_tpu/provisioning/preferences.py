@@ -1,0 +1,93 @@
+"""Preference relaxation ladder (reference scheduling/preferences.go:38-147).
+
+When a pod fails to schedule, soft constraints are stripped one notch at a
+time, in a fixed order: drop one required-node-affinity OR term (if more than
+one remains), then the heaviest preferred pod affinity, preferred pod
+anti-affinity, preferred node affinity, a ScheduleAnyway spread constraint,
+and finally (when some pool uses PreferNoSchedule taints) a blanket
+toleration for them.
+
+Mutates the pod in place and returns a reason string, or None when nothing
+was left to relax — mirroring Relax()'s contract.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from karpenter_tpu.apis.objects import (
+    PREFER_NO_SCHEDULE,
+    SCHEDULE_ANYWAY,
+    Pod,
+    Toleration,
+)
+
+
+class Preferences:
+    def __init__(self, tolerate_prefer_no_schedule: bool = False):
+        self.tolerate_prefer_no_schedule = tolerate_prefer_no_schedule
+
+    def relax(self, pod: Pod) -> Optional[str]:
+        steps = [
+            self._remove_required_node_affinity_term,
+            self._remove_preferred_pod_affinity,
+            self._remove_preferred_pod_anti_affinity,
+            self._remove_preferred_node_affinity,
+            self._remove_schedule_anyway_spread,
+        ]
+        if self.tolerate_prefer_no_schedule:
+            steps.append(self._tolerate_prefer_no_schedule)
+        for step in steps:
+            reason = step(pod)
+            if reason is not None:
+                return reason
+        return None
+
+    def _remove_required_node_affinity_term(self, pod: Pod) -> Optional[str]:
+        aff = pod.spec.affinity.node_affinity if pod.spec.affinity else None
+        # OR terms: drop the first only while others remain (preferences.go:75-89)
+        if aff is None or len(aff.required) <= 1:
+            return None
+        dropped = aff.required.pop(0)
+        return f"removed required node affinity term {dropped}"
+
+    def _remove_preferred_pod_affinity(self, pod: Pod) -> Optional[str]:
+        aff = pod.spec.affinity.pod_affinity if pod.spec.affinity else None
+        if aff is None or not aff.preferred:
+            return None
+        aff.preferred.sort(key=lambda t: -t.weight)
+        dropped = aff.preferred.pop(0)
+        return f"removed preferred pod affinity (weight {dropped.weight})"
+
+    def _remove_preferred_pod_anti_affinity(self, pod: Pod) -> Optional[str]:
+        aff = pod.spec.affinity.pod_anti_affinity if pod.spec.affinity else None
+        if aff is None or not aff.preferred:
+            return None
+        aff.preferred.sort(key=lambda t: -t.weight)
+        dropped = aff.preferred.pop(0)
+        return f"removed preferred pod anti-affinity (weight {dropped.weight})"
+
+    def _remove_preferred_node_affinity(self, pod: Pod) -> Optional[str]:
+        aff = pod.spec.affinity.node_affinity if pod.spec.affinity else None
+        if aff is None or not aff.preferred:
+            return None
+        aff.preferred.sort(key=lambda t: -t.weight)
+        dropped = aff.preferred.pop(0)
+        return f"removed preferred node affinity (weight {dropped.weight})"
+
+    def _remove_schedule_anyway_spread(self, pod: Pod) -> Optional[str]:
+        for i, tsc in enumerate(pod.spec.topology_spread_constraints):
+            if tsc.when_unsatisfiable == SCHEDULE_ANYWAY:
+                pod.spec.topology_spread_constraints.pop(i)
+                return f"removed ScheduleAnyway topology spread on {tsc.topology_key}"
+        return None
+
+    def _tolerate_prefer_no_schedule(self, pod: Pod) -> Optional[str]:
+        blanket = Toleration(operator="Exists", effect=PREFER_NO_SCHEDULE)
+        if any(
+            t.operator == "Exists" and t.effect == PREFER_NO_SCHEDULE and not t.key
+            for t in pod.spec.tolerations
+        ):
+            return None
+        pod.spec.tolerations.append(blanket)
+        return "added toleration for PreferNoSchedule taints"

@@ -1,0 +1,423 @@
+"""Topology engine: spread / affinity / anti-affinity group bookkeeping.
+
+Host-side twin of the reference's topology engine
+(pkg/controllers/provisioning/scheduling/{topology,topologygroup,
+topologynodefilter}.go). The oracle solver consumes these classes directly;
+the JAX path encodes the same groups into per-group domain-count tensors
+(solver/encode.py) and evaluates domain selection on device.
+
+Semantic notes preserved from the reference:
+  - groups dedup by (type, key, namespaces, selector, maxSkew, nodeFilter) —
+    minDomains deliberately excluded, matching TopologyGroup.Hash()
+    (topologygroup.go:142-158);
+  - anti-affinity is tracked both ways: the inverse map lets an existing pod's
+    anti-affinity block a new pod that itself has no terms (topology.go:48-52);
+  - spread domain selection follows the kube-scheduler skew rule
+    'count + self - globalMin <= maxSkew' (topologygroup.go:163-190); where
+    the reference picks randomly among ties (Go map iteration), we pick the
+    lexicographically-first domain so both solver backends agree.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.objects import (
+    EXISTS,
+    IN,
+    LabelSelector,
+    Pod,
+)
+from karpenter_tpu.scheduling import (
+    Requirement,
+    Requirements,
+    label_requirements,
+)
+
+TOPOLOGY_TYPE_SPREAD = 0
+TOPOLOGY_TYPE_POD_AFFINITY = 1
+TOPOLOGY_TYPE_POD_ANTI_AFFINITY = 2
+
+MAX_SKEW_UNBOUNDED = 2**31 - 1
+
+
+def _selector_key(sel: Optional[LabelSelector]) -> Tuple:
+    if sel is None:
+        return ()
+    return (
+        tuple(sorted(sel.match_labels.items())),
+        tuple(
+            sorted(
+                (e.key, e.operator, tuple(sorted(e.values))) for e in sel.match_expressions
+            )
+        ),
+    )
+
+
+class TopologyNodeFilter:
+    """OR of requirement sets a node must satisfy to count for a spread
+    constraint (topologynodefilter.go:31-73). Empty filter matches all."""
+
+    def __init__(self, terms: Sequence[Requirements] = ()):
+        self.terms = list(terms)
+
+    @classmethod
+    def for_pod(cls, pod: Pod) -> "TopologyNodeFilter":
+        selector_reqs = label_requirements(pod.spec.node_selector)
+        affinity = pod.spec.affinity.node_affinity if pod.spec.affinity else None
+        if affinity is None or not affinity.required:
+            return cls([selector_reqs])
+        terms = []
+        for term in affinity.required:
+            reqs = Requirements()
+            reqs.add(*selector_reqs.values())
+            reqs.add(
+                *Requirements.from_node_selector_requirements(*term.match_expressions).values()
+            )
+            terms.append(reqs)
+        return cls(terms)
+
+    def matches_requirements(
+        self, requirements: Requirements, allow_undefined: frozenset = frozenset()
+    ) -> bool:
+        if not self.terms:
+            return True
+        return any(requirements.is_compatible(t, allow_undefined) for t in self.terms)
+
+    def key(self) -> Tuple:
+        return tuple(
+            tuple(sorted((r.key, r.operator(), tuple(r.sorted_values()), r.greater_than, r.less_than)
+                          for r in t.values()))
+            for t in self.terms
+        )
+
+
+@dataclass
+class TopologyGroup:
+    """Domain-count table for one constraint (topologygroup.go:56-91)."""
+
+    type: int
+    key: str
+    namespaces: FrozenSet[str]
+    selector: Optional[LabelSelector]
+    max_skew: int = MAX_SKEW_UNBOUNDED
+    min_domains: Optional[int] = None
+    node_filter: TopologyNodeFilter = field(default_factory=TopologyNodeFilter)
+    domains: Dict[str, int] = field(default_factory=dict)
+    owners: Set[str] = field(default_factory=set)
+
+    def hash_key(self) -> Tuple:
+        # minDomains intentionally absent (topologygroup.go:142-158)
+        return (
+            self.type,
+            self.key,
+            tuple(sorted(self.namespaces)),
+            _selector_key(self.selector),
+            self.max_skew,
+            self.node_filter.key(),
+        )
+
+    # -- bookkeeping ----------------------------------------------------------
+
+    def record(self, *domains: str) -> None:
+        for d in domains:
+            self.domains[d] = self.domains.get(d, 0) + 1
+
+    def register(self, *domains: str) -> None:
+        for d in domains:
+            self.domains.setdefault(d, 0)
+
+    def add_owner(self, uid: str) -> None:
+        self.owners.add(uid)
+
+    def remove_owner(self, uid: str) -> None:
+        self.owners.discard(uid)
+
+    def is_owned_by(self, uid: str) -> bool:
+        return uid in self.owners
+
+    def selects(self, pod: Pod) -> bool:
+        """Pod is in one of the group's namespaces and matches the selector
+        (topologygroup.go:259-265). A nil selector matches nothing for
+        spread/affinity per LabelSelectorAsSelector(nil) = Nothing... but the
+        reference builds selectors from the API where nil means empty —
+        metav1.LabelSelectorAsSelector(nil) returns labels.Nothing()."""
+        if pod.namespace not in self.namespaces:
+            return False
+        if self.selector is None:
+            return False
+        return self.selector.matches(pod.metadata.labels)
+
+    def counts(
+        self, pod: Pod, requirements: Requirements, allow_undefined: frozenset = frozenset()
+    ) -> bool:
+        return self.selects(pod) and self.node_filter.matches_requirements(
+            requirements, allow_undefined
+        )
+
+    # -- domain selection (topologygroup.go:93-104) ---------------------------
+
+    def get(self, pod: Pod, pod_domains: Requirement, node_domains: Requirement) -> Requirement:
+        if self.type == TOPOLOGY_TYPE_SPREAD:
+            return self._next_domain_spread(pod, pod_domains, node_domains)
+        if self.type == TOPOLOGY_TYPE_POD_AFFINITY:
+            return self._next_domain_affinity(pod, pod_domains, node_domains)
+        return self._next_domain_anti_affinity(pod_domains)
+
+    def _next_domain_spread(self, pod, pod_domains, node_domains) -> Requirement:
+        global_min = self._domain_min_count(pod_domains)
+        self_selecting = self.selects(pod)
+        best_domain, best_count = None, MAX_SKEW_UNBOUNDED
+        for domain in sorted(self.domains):  # deterministic tie-break
+            if not node_domains.has(domain):
+                continue
+            count = self.domains[domain]
+            if self_selecting:
+                count += 1
+            if count - global_min <= self.max_skew and count < best_count:
+                best_domain, best_count = domain, count
+        if best_domain is None:
+            return Requirement(self.key, IN)
+        return Requirement(self.key, IN, [best_domain])
+
+    def _domain_min_count(self, pod_domains: Requirement) -> int:
+        # one can always mint a fresh hostname (topologygroup.go:192-195)
+        if self.key == wk.LABEL_HOSTNAME:
+            return 0
+        minimum = MAX_SKEW_UNBOUNDED
+        supported = 0
+        for domain, count in self.domains.items():
+            if pod_domains.has(domain):
+                supported += 1
+                if count < minimum:
+                    minimum = count
+        if self.min_domains is not None and supported < self.min_domains:
+            minimum = 0
+        return minimum
+
+    def _next_domain_affinity(self, pod, pod_domains, node_domains) -> Requirement:
+        options = Requirement(self.key, IN)
+        for domain in sorted(self.domains):
+            if pod_domains.has(domain) and self.domains[domain] > 0:
+                options.insert(domain)
+        # bootstrap: self-selecting pod with nothing placed yet may seed any
+        # viable domain (prefer one the candidate bin is already in)
+        if len(options) == 0 and self.selects(pod):
+            intersected = pod_domains.intersection(node_domains)
+            for domain in sorted(self.domains):
+                if intersected.has(domain):
+                    options.insert(domain)
+                    break
+            for domain in sorted(self.domains):
+                if pod_domains.has(domain):
+                    options.insert(domain)
+                    break
+        return options
+
+    def _next_domain_anti_affinity(self, pod_domains: Requirement) -> Requirement:
+        options = Requirement(self.key, IN)
+        for domain in sorted(self.domains):
+            if pod_domains.has(domain) and self.domains[domain] == 0:
+                options.insert(domain)
+        return options
+
+
+class Topology:
+    """Group registry + the AddRequirements/Record protocol
+    (topology.go:42-186). ``domains`` is the per-key domain universe computed
+    by the provisioning layer; ``cluster_pods`` seed counts for pods already
+    running (countDomains without the apiserver round-trips)."""
+
+    def __init__(
+        self,
+        domains: Dict[str, Set[str]],
+        batch_pods: Sequence[Pod] = (),
+        cluster_pods: Sequence[Tuple[Pod, Dict[str, str]]] = (),  # (pod, node labels)
+    ):
+        self.domains = {k: set(v) for k, v in domains.items()}
+        self.topologies: Dict[Tuple, TopologyGroup] = {}
+        self.inverse_topologies: Dict[Tuple, TopologyGroup] = {}
+        self.excluded = {p.uid for p in batch_pods}
+        self.cluster_pods = [
+            (p, labels) for (p, labels) in cluster_pods if p.uid not in self.excluded
+        ]
+        # existing cluster pods with anti-affinity block domains inversely
+        for pod, node_labels in self.cluster_pods:
+            if pod.spec.affinity and pod.spec.affinity.pod_anti_affinity:
+                if pod.spec.affinity.pod_anti_affinity.required:
+                    self._update_inverse_anti_affinity(pod, node_labels)
+        for p in batch_pods:
+            self.update(p)
+
+    # -- group construction ---------------------------------------------------
+
+    def update(self, pod: Pod) -> None:
+        """(Re)register the pod as owner of its current constraint set; called
+        again after relaxation to drop stripped constraints (topology.go:91-122)."""
+        for tg in self.topologies.values():
+            tg.remove_owner(pod.uid)
+        if pod.spec.affinity and pod.spec.affinity.pod_anti_affinity and pod.spec.affinity.pod_anti_affinity.required:
+            self._update_inverse_anti_affinity(pod, None)
+        for tg in self._new_groups(pod):
+            key = tg.hash_key()
+            existing = self.topologies.get(key)
+            if existing is None:
+                self._count_domains(tg)
+                self.topologies[key] = tg
+                existing = tg
+            existing.add_owner(pod.uid)
+
+    def _new_groups(self, pod: Pod) -> List[TopologyGroup]:
+        groups = []
+        for cs in pod.spec.topology_spread_constraints:
+            groups.append(
+                TopologyGroup(
+                    type=TOPOLOGY_TYPE_SPREAD,
+                    key=cs.topology_key,
+                    namespaces=frozenset({pod.namespace}),
+                    selector=cs.label_selector,
+                    max_skew=cs.max_skew,
+                    min_domains=cs.min_domains,
+                    node_filter=TopologyNodeFilter.for_pod(pod),
+                    domains={d: 0 for d in self.domains.get(cs.topology_key, ())},
+                )
+            )
+        affinity = pod.spec.affinity
+        if affinity:
+            terms = []
+            if affinity.pod_affinity:
+                terms += [(TOPOLOGY_TYPE_POD_AFFINITY, t) for t in affinity.pod_affinity.required]
+                terms += [
+                    (TOPOLOGY_TYPE_POD_AFFINITY, wt.pod_affinity_term)
+                    for wt in affinity.pod_affinity.preferred
+                ]
+            if affinity.pod_anti_affinity:
+                terms += [
+                    (TOPOLOGY_TYPE_POD_ANTI_AFFINITY, t)
+                    for t in affinity.pod_anti_affinity.required
+                ]
+                terms += [
+                    (TOPOLOGY_TYPE_POD_ANTI_AFFINITY, wt.pod_affinity_term)
+                    for wt in affinity.pod_anti_affinity.preferred
+                ]
+            for ttype, term in terms:
+                groups.append(
+                    TopologyGroup(
+                        type=ttype,
+                        key=term.topology_key,
+                        namespaces=self._namespace_list(pod.namespace, term),
+                        selector=term.label_selector,
+                        domains={d: 0 for d in self.domains.get(term.topology_key, ())},
+                    )
+                )
+        return groups
+
+    def _namespace_list(self, pod_namespace: str, term) -> FrozenSet[str]:
+        if not term.namespaces and term.namespace_selector is None:
+            return frozenset({pod_namespace})
+        # namespace selectors need an apiserver; the kube layer resolves them
+        # before the solve — here we honor explicit lists
+        return frozenset(term.namespaces or {pod_namespace})
+
+    def _update_inverse_anti_affinity(self, pod: Pod, node_labels: Optional[Dict[str, str]]):
+        """Track the anti-affinity pod itself so its victims can avoid it
+        (topology.go:205-232). Preferences are deliberately not tracked."""
+        for term in pod.spec.affinity.pod_anti_affinity.required:
+            tg = TopologyGroup(
+                type=TOPOLOGY_TYPE_POD_ANTI_AFFINITY,
+                key=term.topology_key,
+                namespaces=self._namespace_list(pod.namespace, term),
+                selector=term.label_selector,
+                domains={d: 0 for d in self.domains.get(term.topology_key, ())},
+            )
+            key = tg.hash_key()
+            existing = self.inverse_topologies.get(key)
+            if existing is None:
+                self.inverse_topologies[key] = tg
+                existing = tg
+            if node_labels and tg.key in node_labels:
+                existing.record(node_labels[tg.key])
+            existing.add_owner(pod.uid)
+
+    def _count_domains(self, tg: TopologyGroup) -> None:
+        """Seed counts from pods already running in the cluster
+        (topology.go:238-291)."""
+        for pod, node_labels in self.cluster_pods:
+            if pod.namespace not in tg.namespaces:
+                continue
+            if tg.selector is None or not tg.selector.matches(pod.metadata.labels):
+                continue
+            domain = node_labels.get(tg.key)
+            if domain is None:
+                continue
+            if not tg.node_filter.matches_requirements(label_requirements(node_labels)):
+                continue
+            tg.record(domain)
+
+    # -- solve-time protocol --------------------------------------------------
+
+    def register(self, topology_key: str, domain: str) -> None:
+        for tg in list(self.topologies.values()) + list(self.inverse_topologies.values()):
+            if tg.key == topology_key:
+                tg.register(domain)
+
+    def add_requirements(
+        self,
+        pod_requirements: Requirements,
+        node_requirements: Requirements,
+        pod: Pod,
+        allow_undefined: frozenset = frozenset(),
+    ) -> Optional[Requirements]:
+        """Tighten node requirements with the domains every matching topology
+        allows; None when some constraint is unsatisfiable (topology.go:154-172)."""
+        requirements = Requirements(*node_requirements.values())
+        for tg in self._matching(pod, node_requirements, allow_undefined):
+            pod_domains = (
+                pod_requirements.get(tg.key)
+                if pod_requirements.has(tg.key)
+                else Requirement(tg.key, EXISTS)
+            )
+            node_domains = (
+                node_requirements.get(tg.key)
+                if node_requirements.has(tg.key)
+                else Requirement(tg.key, EXISTS)
+            )
+            domains = tg.get(pod, pod_domains, node_domains)
+            if len(domains) == 0:
+                return None
+            requirements.add(domains)
+        return requirements
+
+    def _matching(self, pod, node_requirements, allow_undefined) -> List[TopologyGroup]:
+        out = [tg for tg in self.topologies.values() if tg.is_owned_by(pod.uid)]
+        out += [
+            tg
+            for tg in self.inverse_topologies.values()
+            if tg.counts(pod, node_requirements, allow_undefined)
+        ]
+        return out
+
+    def record(
+        self, pod: Pod, requirements: Requirements, allow_undefined: frozenset = frozenset()
+    ) -> None:
+        """Commit the placement into every group that counts it
+        (topology.go:125-148). Divergence from the reference: complement
+        requirement sets record nothing (the reference's Values() would record
+        the *excluded* values — an upstream quirk we do not reproduce)."""
+        for tg in self.topologies.values():
+            if tg.counts(pod, requirements, allow_undefined):
+                domains = requirements.get(tg.key)
+                if domains.complement:
+                    continue
+                if tg.type == TOPOLOGY_TYPE_POD_ANTI_AFFINITY:
+                    tg.record(*domains.values)
+                elif len(domains) == 1:
+                    tg.record(next(iter(domains.values)))
+        for tg in self.inverse_topologies.values():
+            if tg.is_owned_by(pod.uid):
+                domains = requirements.get(tg.key)
+                if not domains.complement:
+                    tg.record(*domains.values)

@@ -1,0 +1,74 @@
+"""Host-port conflict tracking (reference pkg/scheduling/hostportusage.go).
+
+Each <hostIP, hostPort, protocol> on a node must be unique; an unspecified IP
+(0.0.0.0 / ::) wildcards against every IP on the same port+protocol.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+from karpenter_tpu.apis.objects import Pod
+
+UNSPECIFIED_IPS = ("0.0.0.0", "::")
+
+
+@dataclass(frozen=True)
+class HostPort:
+    ip: str
+    port: int
+    protocol: str = "TCP"
+
+    def matches(self, other: "HostPort") -> bool:
+        """Conflict test (hostportusage.go:49-60): same protocol and port, and
+        IPs equal or either side unspecified."""
+        if self.protocol != other.protocol or self.port != other.port:
+            return False
+        if self.ip == other.ip:
+            return True
+        return self.ip in UNSPECIFIED_IPS or other.ip in UNSPECIFIED_IPS
+
+    def __str__(self):
+        return f"IP={self.ip} Port={self.port} Proto={self.protocol}"
+
+
+def get_host_ports(pod: Pod) -> List[HostPort]:
+    """Collect the pod's host ports; empty hostIP defaults to 0.0.0.0
+    (hostportusage.go:92-110)."""
+    out = []
+    for c in pod.spec.containers:
+        for p in c.ports:
+            if not p.host_port:
+                continue
+            out.append(
+                HostPort(ip=p.host_ip or "0.0.0.0", port=p.host_port, protocol=p.protocol or "TCP")
+            )
+    return out
+
+
+class HostPortUsage:
+    """Per-node reservation table keyed by pod (hostportusage.go:33-90)."""
+
+    def __init__(self):
+        self._reserved: Dict[Tuple[str, str], List[HostPort]] = {}
+
+    def conflicts(self, pod: Pod, ports: List[HostPort]) -> str | None:
+        key = (pod.namespace, pod.name)
+        for new in ports:
+            for pod_key, entries in self._reserved.items():
+                if pod_key == key:
+                    continue
+                for existing in entries:
+                    if new.matches(existing):
+                        return f"{new} conflicts with existing HostPort configuration {existing}"
+        return None
+
+    def add(self, pod: Pod, ports: List[HostPort]) -> None:
+        self._reserved[(pod.namespace, pod.name)] = list(ports)
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        self._reserved.pop((namespace, name), None)
+
+    def all_ports(self) -> List[HostPort]:
+        return [p for entries in self._reserved.values() for p in entries]

@@ -30,7 +30,16 @@ from karpenter_tpu.models.problem import (
     ReqTensor,
     SchedulingProblem,
 )
-from karpenter_tpu.scheduling import Requirement, Requirements, Taints, pod_requirements
+from karpenter_tpu.provisioning.topology import Topology, TOPOLOGY_TYPE_SPREAD
+from karpenter_tpu.scheduling import (
+    Requirement,
+    Requirements,
+    Taints,
+    has_preferred_node_affinity,
+    pod_requirements,
+    strict_pod_requirements,
+)
+from karpenter_tpu.scheduling.hostports import HostPort, get_host_ports
 from karpenter_tpu.scheduling.requirements import label_requirements
 from karpenter_tpu.utils import resources as res
 
@@ -38,13 +47,15 @@ from karpenter_tpu.utils import resources as res
 @dataclass
 class TemplateInfo:
     """Host-side view of one NodeClaimTemplate (scheduling/nodeclaimtemplate.go:43-53):
-    pool requirements + labels, taints, daemonset overhead, instance types."""
+    pool requirements + labels, taints, daemonset overhead, instance types, and
+    the NodePool's remaining resource headroom (None = no limits)."""
 
     nodepool_name: str
     requirements: Requirements
     taints: Taints
     daemon_overhead: Dict[str, float]
     instance_type_indices: List[int]
+    remaining_resources: Optional[Dict[str, float]] = None
 
 
 @dataclass
@@ -57,6 +68,11 @@ class NodeInfo:
     taints: Taints
     available: Dict[str, float]  # allocatable - scheduled pod requests
     daemon_overhead: Dict[str, float]  # unscheduled daemonset requests
+    host_ports: List["HostPort"] = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.host_ports is None:
+            self.host_ports = []
 
 
 @dataclass
@@ -127,24 +143,70 @@ class Encoder:
         templates: Sequence[TemplateInfo],
         nodes: Sequence[NodeInfo] = (),
         pod_reqs_override: Optional[Sequence[Requirements]] = None,
+        topology: Optional[Topology] = None,
+        num_claim_slots: int = 0,
+        vocab_pods: Optional[Sequence[Pod]] = None,
     ) -> EncodedProblem:
+        """``vocab_pods`` seeds the vocabulary (defaults to ``pods``): across
+        the relax-and-retry passes the vocabulary must stay identical so the
+        carried solver state keeps valid lane indices — callers pass the
+        original unrelaxed batch there while ``pods`` shrinks to the retry
+        queue."""
         # -- 1. FFD queue order: cpu desc, mem desc, creation, uid (queue.go:76-111)
         pod_reqs_list = (
             list(pod_reqs_override)
             if pod_reqs_override is not None
             else [pod_requirements(p) for p in pods]
         )
+        pod_strict_list = (
+            list(pod_reqs_list)
+            if pod_reqs_override is not None
+            else [
+                strict_pod_requirements(p) if has_preferred_node_affinity(p) else r
+                for p, r in zip(pods, pod_reqs_list)
+            ]
+        )
         order = ffd_order(pods)
         pods = [pods[i] for i in order]
         pod_reqs_list = [pod_reqs_list[i] for i in order]
+        pod_strict_list = [pod_strict_list[i] for i in order]
+        if vocab_pods is None:
+            vocab_pods = pods
+
+        groups = []
+        if topology is not None:
+            groups = list(topology.topologies.values()) + list(
+                topology.inverse_topologies.values()
+            )
+            inverse_from = len(topology.topologies)
 
         # -- 2. vocabulary over every value mentioned anywhere
         vocab = _Vocab()
-        # zone / capacity-type keys always exist (offering checks index them)
+        # zone / capacity-type / hostname keys always exist at pinned indices
+        # (offering checks + claim hostname minting index them statically)
         zone_k = vocab.key(wk.LABEL_TOPOLOGY_ZONE)
         ct_k = vocab.key(wk.CAPACITY_TYPE_LABEL_KEY)
-        for reqs in pod_reqs_list:
-            vocab.add_requirements(reqs)
+        hostname_k = vocab.key(wk.LABEL_HOSTNAME)
+        for p in vocab_pods:
+            # seed EVERY affinity term, not just the active one: relaxation
+            # can surface later OR terms / lighter preferences in later
+            # passes, and the frozen vocabulary must already cover them
+            vocab.add_requirements(label_requirements(p.spec.node_selector))
+            aff = p.spec.affinity.node_affinity if p.spec.affinity else None
+            if aff is not None:
+                for term in aff.required:
+                    vocab.add_requirements(
+                        Requirements.from_node_selector_requirements(*term.match_expressions)
+                    )
+                for pref in aff.preferred:
+                    vocab.add_requirements(
+                        Requirements.from_node_selector_requirements(
+                            *pref.preference.match_expressions
+                        )
+                    )
+        if pod_reqs_override is not None:
+            for reqs in pod_reqs_list:
+                vocab.add_requirements(reqs)
         for it in instance_types:
             vocab.add_requirements(it.requirements)
             for o in it.offerings:
@@ -154,12 +216,23 @@ class Encoder:
             vocab.add_requirements(t.requirements)
         for n in nodes:
             vocab.add_requirements(n.requirements)
+        # topology domains + node-filter terms + claim hostname placeholders
+        for tg in groups:
+            vocab.key(tg.key)
+            for domain in tg.domains:
+                vocab.value(tg.key, domain)
+            for term in tg.node_filter.terms:
+                vocab.add_requirements(term)
+        claim_hostnames = [claim_hostname(i) for i in range(num_claim_slots)]
+        for h in claim_hostnames:
+            vocab.value(wk.LABEL_HOSTNAME, h)
 
         K = len(vocab.keys)
         V = max((len(v) for v in vocab.values), default=1) or 1
 
         lane_valid = np.zeros((K, V), dtype=bool)
         lane_numeric = np.full((K, V), np.nan, dtype=np.float32)
+        lane_lex_rank = np.full((K, V), 2**30, dtype=np.int32)
         for ki, vals in enumerate(vocab.values):
             for value, vi in vals.items():
                 lane_valid[ki, vi] = True
@@ -167,6 +240,8 @@ class Encoder:
                     lane_numeric[ki, vi] = float(int(value))
                 except ValueError:
                     pass
+            for rank, value in enumerate(sorted(vals)):
+                lane_lex_rank[ki, vals[value]] = rank
         key_wellknown = np.array([k in self.well_known for k in vocab.keys], dtype=bool)
 
         # -- 3. resource axis
@@ -216,6 +291,7 @@ class Encoder:
             return ReqTensor(admitted=admitted, comp=comp, gt=gt, lt=lt, defined=defined)
 
         pod_reqs = encode_reqs(pod_reqs_list)
+        pod_strict_reqs = encode_reqs(pod_strict_list)
         it_reqs = encode_reqs([it.requirements for it in instance_types])
         tpl_reqs = encode_reqs([t.requirements for t in templates])
         node_reqs = encode_reqs([n.requirements for n in nodes])
@@ -247,11 +323,16 @@ class Encoder:
                 offer_ok[ti, oi] = o.available
                 offer_price[ti, oi] = o.price
 
-        # -- 7. templates' instance-type universes + taints
+        # -- 7. templates' instance-type universes + taints + limit headroom
         TPL = len(templates)
         tpl_it_ok = np.zeros((TPL, T), dtype=bool)
+        tpl_remaining = np.full((TPL, len(resource_names)), np.inf, dtype=np.float32)
         for ti, t in enumerate(templates):
             tpl_it_ok[ti, list(t.instance_type_indices)] = True
+            if t.remaining_resources is not None:
+                for ri, name in enumerate(resource_names):
+                    if name in t.remaining_resources:
+                        tpl_remaining[ti, ri] = t.remaining_resources[name]
 
         pod_tol_tpl = np.zeros((len(pods), TPL), dtype=bool)
         for pi, p in enumerate(pods):
@@ -262,14 +343,107 @@ class Encoder:
             for ni, n in enumerate(nodes):
                 pod_tol_node[pi, ni] = not n.taints.tolerates(p)
 
+        # -- 8. host-port lanes: vocab over every distinct port tuple in the
+        # batch, with a precomputed lane-vs-lane conflict matrix (wildcard IPs
+        # fold in here, so the device check is a plain mask AND). Lanes come
+        # from the frozen vocab_pods so carried port masks stay valid across
+        # relax passes.
+        pod_port_lists = [get_host_ports(p) for p in pods]
+        port_vocab: Dict[HostPort, int] = {}
+        for p in vocab_pods:
+            for hp in get_host_ports(p):
+                port_vocab.setdefault(hp, len(port_vocab))
+        for n in nodes:
+            for hp in n.host_ports:
+                port_vocab.setdefault(hp, len(port_vocab))
+        PT = max(len(port_vocab), 1)
+        lanes = list(port_vocab.keys())
+        conflict = np.zeros((PT, PT), dtype=bool)
+        for a, hp_a in enumerate(lanes):
+            for b, hp_b in enumerate(lanes):
+                conflict[a, b] = hp_a.matches(hp_b)
+        pod_ports = np.zeros((len(pods), PT), dtype=bool)
+        pod_port_conflict = np.zeros((len(pods), PT), dtype=bool)
+        for pi, plist in enumerate(pod_port_lists):
+            for hp in plist:
+                li = port_vocab[hp]
+                pod_ports[pi, li] = True
+                pod_port_conflict[pi] |= conflict[li]
+        node_used_ports = np.zeros((len(nodes), PT), dtype=bool)
+        for ni, n in enumerate(nodes):
+            for hp in n.host_ports:
+                node_used_ports[ni, port_vocab[hp]] = True
+
+        # -- 9. topology groups (regular first, then inverse)
+        G = len(groups)
+        F = max((len(tg.node_filter.terms) for tg in groups), default=1) or 1
+        grp_type = np.zeros(G, dtype=np.int32)
+        grp_key = np.zeros(G, dtype=np.int32)
+        grp_max_skew = np.full(G, 2**31 - 1, dtype=np.int32)
+        grp_min_domains = np.full(G, -1, dtype=np.int32)
+        grp_counts0 = np.zeros((G, V), dtype=np.int32)
+        grp_registered0 = np.zeros((G, V), dtype=bool)
+        grp_inverse = np.zeros(G, dtype=bool)
+        grp_has_filter = np.zeros(G, dtype=bool)
+        grp_filter_valid = np.zeros((G, F), dtype=bool)
+        filter_rows: List[Requirements] = []
+        for gi, tg in enumerate(groups):
+            grp_type[gi] = tg.type
+            grp_key[gi] = vocab.key_index[tg.key]
+            grp_max_skew[gi] = tg.max_skew
+            if tg.min_domains is not None:
+                grp_min_domains[gi] = tg.min_domains
+            grp_inverse[gi] = topology is not None and gi >= inverse_from
+            for domain, count in tg.domains.items():
+                lane = vocab.values[grp_key[gi]][domain]
+                grp_registered0[gi, lane] = True
+                grp_counts0[gi, lane] = count
+            grp_has_filter[gi] = bool(tg.node_filter.terms)
+            for fi, term in enumerate(tg.node_filter.terms):
+                grp_filter_valid[gi, fi] = True
+            filter_rows.extend(
+                list(tg.node_filter.terms) + [Requirements()] * (F - len(tg.node_filter.terms))
+            )
+        grp_filter_flat = encode_reqs(filter_rows)  # [(G*F), K, V]
+        grp_filter = ReqTensor(
+            admitted=grp_filter_flat.admitted.reshape(G, F, K, V),
+            comp=grp_filter_flat.comp.reshape(G, F, K),
+            gt=grp_filter_flat.gt.reshape(G, F, K),
+            lt=grp_filter_flat.lt.reshape(G, F, K),
+            defined=grp_filter_flat.defined.reshape(G, F, K),
+        ) if G else ReqTensor(
+            admitted=np.zeros((0, F, K, V), dtype=bool),
+            comp=np.zeros((0, F, K), dtype=bool),
+            gt=np.zeros((0, F, K), dtype=np.int32),
+            lt=np.zeros((0, F, K), dtype=np.int32),
+            defined=np.zeros((0, F, K), dtype=bool),
+        )
+        pod_grp_match = np.zeros((len(pods), G), dtype=bool)
+        pod_grp_selects = np.zeros((len(pods), G), dtype=bool)
+        pod_grp_owned = np.zeros((len(pods), G), dtype=bool)
+        for pi, p in enumerate(pods):
+            for gi, tg in enumerate(groups):
+                selects = tg.selects(p)
+                owned = tg.is_owned_by(p.uid)
+                pod_grp_selects[pi, gi] = selects
+                pod_grp_owned[pi, gi] = owned
+                pod_grp_match[pi, gi] = selects if grp_inverse[gi] else owned
+        claim_hostname_lane = np.array(
+            [vocab.values[hostname_k][h] for h in claim_hostnames], dtype=np.int32
+        )
+
         problem = SchedulingProblem(
             lane_valid=lane_valid,
             lane_numeric=lane_numeric,
+            lane_lex_rank=lane_lex_rank,
             key_wellknown=key_wellknown,
             pod_reqs=pod_reqs,
             pod_requests=pod_requests,
             pod_tol_tpl=pod_tol_tpl,
             pod_tol_node=pod_tol_node,
+            pod_ports=pod_ports,
+            pod_port_conflict=pod_port_conflict,
+            pod_strict_reqs=pod_strict_reqs,
             it_reqs=it_reqs,
             it_alloc=it_alloc,
             it_cap=it_cap,
@@ -280,9 +454,25 @@ class Encoder:
             tpl_reqs=tpl_reqs,
             tpl_overhead=tpl_overhead,
             tpl_it_ok=tpl_it_ok,
+            tpl_remaining=tpl_remaining,
             node_reqs=node_reqs,
             node_avail=node_avail,
             node_overhead=node_overhead,
+            node_used_ports=node_used_ports,
+            grp_type=grp_type,
+            grp_key=grp_key,
+            grp_max_skew=grp_max_skew,
+            grp_min_domains=grp_min_domains,
+            grp_counts0=grp_counts0,
+            grp_registered0=grp_registered0,
+            grp_inverse=grp_inverse,
+            grp_has_filter=grp_has_filter,
+            grp_filter=grp_filter,
+            grp_filter_valid=grp_filter_valid,
+            pod_grp_match=pod_grp_match,
+            pod_grp_selects=pod_grp_selects,
+            pod_grp_owned=pod_grp_owned,
+            claim_hostname_lane=claim_hostname_lane,
         )
         meta = ProblemMeta(
             keys=list(vocab.keys),
@@ -297,8 +487,35 @@ class Encoder:
             node_names=[n.name for n in nodes],
             zone_key_idx=zone_k,
             ct_key_idx=ct_k,
+            hostname_key_idx=hostname_k,
         )
         return EncodedProblem(problem=problem, meta=meta)
+
+
+def claim_hostname(slot: int) -> str:
+    """Placeholder hostname minted per claim for hostname-topology purposes
+    (nodeclaim.go:48); both solver backends must agree on the naming."""
+    return f"hostname-placeholder-{slot:04d}"
+
+
+def domains_from_instance_types(
+    instance_types: Sequence[InstanceType], templates: Sequence[TemplateInfo] = ()
+) -> Dict[str, set]:
+    """Default per-key domain universe: every value an instance type or
+    template requirement could produce (the provisioner's domain census,
+    provisioner.go:248-281)."""
+    domains: Dict[str, set] = {}
+    for it in instance_types:
+        for key in it.requirements:
+            r = it.requirements.get(key)
+            if not r.complement:
+                domains.setdefault(key, set()).update(r.values)
+    for t in templates:
+        for key in t.requirements:
+            r = t.requirements.get(key)
+            if not r.complement:
+                domains.setdefault(key, set()).update(r.values)
+    return domains
 
 
 def template_from_nodepool(

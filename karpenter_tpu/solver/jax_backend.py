@@ -1,20 +1,32 @@
 """The JAX solver backend.
 
-Encodes the batch (solver/encode.py), runs the lax.scan FFD (ops/ffd.py), and
-decodes device output back into the host result model. Claim-slot capacity is
-a static compile dimension: the backend starts from a bucketed guess and
-doubles on overflow (KIND_NO_SLOT), so recompiles stay rare and bounded —
-SURVEY.md §7 hard part (3): pad-and-mask with bucketed compile sizes.
+Encodes the batch (solver/encode.py), runs the lax.scan FFD (ops/ffd.py) in
+relax-and-retry passes with carried device state, and decodes back into the
+host result model. Claim-slot capacity is a static compile dimension: the
+backend starts from a bucketed guess and restarts with double the slots on
+overflow (KIND_NO_SLOT), so recompiles stay rare and bounded — SURVEY.md §7
+hard part (3): pad-and-mask with bucketed compile sizes.
+
+Pass structure (the reference's queue requeue + relaxation,
+scheduler.go:150-170): each pass scans the queued pods once against carried
+FFDState (bins + topology counters persist); failed pods are relaxed one
+notch (provisioning/preferences.py) and retried until a pass places nothing
+and relaxes nothing. The vocabulary is frozen from the original unrelaxed
+batch so carried state keeps valid lane indices across passes.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import copy
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 
+from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.apis.objects import Pod
 from karpenter_tpu.cloudprovider.types import InstanceType
+from karpenter_tpu.provisioning.preferences import Preferences
+from karpenter_tpu.provisioning.topology import Topology
 from karpenter_tpu.scheduling import Requirements
 from karpenter_tpu.solver.backend import (
     FAIL_INCOMPATIBLE,
@@ -22,11 +34,15 @@ from karpenter_tpu.solver.backend import (
     SolveResult,
     SolverBackend,
 )
-from karpenter_tpu.solver.encode import Encoder, NodeInfo, TemplateInfo
+from karpenter_tpu.solver.encode import (
+    Encoder,
+    NodeInfo,
+    TemplateInfo,
+    domains_from_instance_types,
+)
 from karpenter_tpu.ops.padding import pad_problem, pow2_bucket
 from karpenter_tpu.ops.ffd import (
     KIND_CLAIM,
-    KIND_FAIL,
     KIND_NEW_CLAIM,
     KIND_NODE,
     KIND_NO_SLOT,
@@ -34,11 +50,35 @@ from karpenter_tpu.ops.ffd import (
 )
 
 
+class _SlotOverflow(Exception):
+    pass
+
+
+def _remap_group_state(state, old_keys, new_keys, padded_problem):
+    """Rebuild grp_counts/grp_registered for a changed group set: carried rows
+    move to their new position (matched by group hash); new groups take their
+    seeded rows from the freshly-encoded problem."""
+    import dataclasses
+
+    old_counts = np.asarray(state.grp_counts)
+    old_reg = np.asarray(state.grp_registered)
+    new_counts = np.array(padded_problem.grp_counts0)
+    new_reg = np.array(padded_problem.grp_registered0)
+    pos_of_old = {k: i for i, k in enumerate(old_keys)}
+    V = min(old_counts.shape[1], new_counts.shape[1])
+    for new_i, k in enumerate(new_keys):
+        old_i = pos_of_old.get(k)
+        if old_i is not None and old_i < old_counts.shape[0]:
+            new_counts[new_i, :V] = old_counts[old_i, :V]
+            new_reg[new_i, :V] = old_reg[old_i, :V]
+    return dataclasses.replace(state, grp_counts=new_counts, grp_registered=new_reg)
+
+
 class JaxSolver(SolverBackend):
     def __init__(self, well_known=None, initial_claim_slots: int = 32):
-        from karpenter_tpu.apis import labels as wk
-
-        self.well_known = well_known if well_known is not None else wk.WELL_KNOWN_LABELS
+        self.well_known = (
+            well_known if well_known is not None else wk.WELL_KNOWN_LABELS
+        )
         # grows on overflow and persists — a steady workload pays the
         # doubling retries once, not per solve
         self.claim_slots = pow2_bucket(initial_claim_slots)
@@ -50,38 +90,130 @@ class JaxSolver(SolverBackend):
         templates: Sequence[TemplateInfo],
         nodes: Sequence[NodeInfo] = (),
         pod_requirements_override: Optional[Sequence[Requirements]] = None,
+        topology: Optional[Topology] = None,
+        cluster_pods: Sequence = (),
+        domains: Optional[Dict[str, set]] = None,
     ) -> SolveResult:
         if not pods:
             return SolveResult()
-        encoded = Encoder(self.well_known).encode(
-            pods, instance_types, templates, nodes, pod_requirements_override
-        )
-        problem, meta = pad_problem(encoded.problem), encoded.meta
+        if domains is None:
+            domains = domains_from_instance_types(instance_types, templates)
 
         max_claims = min(self.claim_slots, pow2_bucket(len(pods)))
         while True:
-            result = solve_ffd(problem, max_claims)
-            kinds = np.asarray(result.kind)
-            if not (kinds == KIND_NO_SLOT).any() or max_claims >= len(pods):
-                break
-            max_claims = min(pow2_bucket(max_claims * 2), pow2_bucket(len(pods)))
-            self.claim_slots = max(self.claim_slots, max_claims)
+            try:
+                return self._solve_with_slots(
+                    pods, instance_types, templates, nodes,
+                    pod_requirements_override, topology, cluster_pods, domains,
+                    max_claims,
+                )
+            except _SlotOverflow:
+                if max_claims >= len(pods):
+                    raise RuntimeError("claim slots exhausted at pod count") from None
+                max_claims = min(pow2_bucket(max_claims * 2), pow2_bucket(len(pods)))
+                self.claim_slots = max(self.claim_slots, max_claims)
 
-        indices = np.asarray(result.index)
-        claim_tpl = np.asarray(result.state.claim_tpl)
-        claim_it_ok = np.asarray(result.state.claim_it_ok)
-        claim_open = np.asarray(result.state.claim_open)
-        claim_requests = np.asarray(result.state.claim_requests)
+    def _solve_with_slots(
+        self, pods, instance_types, templates, nodes,
+        pod_requirements_override, topology, cluster_pods, domains, max_claims,
+    ) -> SolveResult:
+        work = [copy.deepcopy(p) for p in pods]
+        vocab_pods = list(pods)  # frozen vocabulary seed (originals never mutate)
+        topo = (
+            topology
+            if topology is not None
+            else Topology(domains, batch_pods=work, cluster_pods=cluster_pods)
+        )
+        for n in nodes:
+            topo.register(wk.LABEL_HOSTNAME, n.name)
+        prefs = Preferences(
+            tolerate_prefer_no_schedule=any(
+                t.effect == "PreferNoSchedule" for tpl in templates for t in tpl.taints
+            )
+        )
+        encoder = Encoder(self.well_known)
 
         out = SolveResult()
+        pod_kinds: Dict[int, tuple] = {}  # original index -> (kind, bin index)
+        state = None
+        meta = None
+        prev_group_keys = None
+        queue = list(range(len(work)))
+        first_pass = True
+        while queue:
+            encoded = encoder.encode(
+                [work[i] for i in queue],
+                instance_types,
+                templates,
+                nodes,
+                pod_reqs_override=(
+                    [pod_requirements_override[i] for i in queue]
+                    if pod_requirements_override is not None and first_pass
+                    else None
+                ),
+                topology=topo,
+                num_claim_slots=max_claims,
+                vocab_pods=vocab_pods,
+            )
+            first_pass = False
+            problem, meta = pad_problem(encoded.problem), encoded.meta
+            group_keys = [
+                tg.hash_key()
+                for tg in list(topo.topologies.values())
+                + list(topo.inverse_topologies.values())
+            ]
+            if state is not None and group_keys != prev_group_keys:
+                # relaxation changed the group set (e.g. a dropped OR term
+                # produced a new spread node-filter): remap carried rows to
+                # the new group order; brand-new groups start from the fresh
+                # census, exactly like the reference's countDomains on Update
+                state = _remap_group_state(state, prev_group_keys, group_keys, problem)
+            prev_group_keys = group_keys
+            result = solve_ffd(problem, max_claims, init=state)
+            state = result.state
+            kinds = np.asarray(result.kind)
+            indices = np.asarray(result.index)
+            if (kinds[: len(queue)] == KIND_NO_SLOT).any():
+                raise _SlotOverflow()
+
+            failed = []
+            progress = False
+            for row in range(len(meta.pod_order)):
+                orig = queue[meta.pod_order[row]]
+                kind, index = int(kinds[row]), int(indices[row])
+                if kind in (KIND_NODE, KIND_CLAIM, KIND_NEW_CLAIM):
+                    pod_kinds[orig] = (kind, index)
+                    progress = True
+                else:
+                    failed.append(orig)
+            relaxed_any = False
+            for orig in failed:
+                if prefs.relax(work[orig]) is not None:
+                    relaxed_any = True
+                    topo.update(work[orig])
+            if not progress and not relaxed_any:
+                for orig in failed:
+                    out.failures[orig] = FAIL_INCOMPATIBLE
+                break
+            queue = failed
+
+        # -- decode final bin state
+        claim_open = np.asarray(state.claim_open) if state is not None else np.zeros(0)
+        claim_tpl = np.asarray(state.claim_tpl) if state is not None else None
+        claim_it_ok = np.asarray(state.claim_it_ok) if state is not None else None
+        claim_requests = np.asarray(state.claim_requests) if state is not None else None
         slot_to_claim = {}
         for slot in range(max_claims):
-            if claim_open[slot]:
+            if slot < len(claim_open) and claim_open[slot]:
                 tpl_idx = int(claim_tpl[slot])
                 placement = Placement(
                     template_index=tpl_idx,
                     nodepool_name=meta.template_names[tpl_idx],
-                    instance_type_indices=[int(t) for t in np.flatnonzero(claim_it_ok[slot])],
+                    instance_type_indices=[
+                        int(t)
+                        for t in np.flatnonzero(claim_it_ok[slot])
+                        if t < len(meta.instance_type_names)
+                    ],
                     requests={
                         name: float(claim_requests[slot, ri])
                         for ri, name in enumerate(meta.resource_names)
@@ -90,14 +222,9 @@ class JaxSolver(SolverBackend):
                 )
                 slot_to_claim[slot] = placement
                 out.new_claims.append(placement)
-
-        for row in range(len(meta.pod_order)):  # rows past this are padding
-            kind, index = kinds[row], indices[row]
-            pod_idx = meta.pod_order[row]  # problem rows are FFD-sorted
+        for orig, (kind, index) in pod_kinds.items():
             if kind == KIND_NODE:
-                out.node_pods.setdefault(meta.node_names[index], []).append(pod_idx)
-            elif kind in (KIND_CLAIM, KIND_NEW_CLAIM):
-                slot_to_claim[int(index)].pod_indices.append(pod_idx)
+                out.node_pods.setdefault(meta.node_names[index], []).append(orig)
             else:
-                out.failures[pod_idx] = FAIL_INCOMPATIBLE
+                slot_to_claim[index].pod_indices.append(orig)
         return out

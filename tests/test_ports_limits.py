@@ -1,0 +1,144 @@
+"""Host-port conflicts and NodePool limits — oracle/JAX parity + semantics.
+
+Mirrors the reference suites for HostPortUsage (pkg/scheduling) and scheduler
+limit handling (filterByRemainingResources / subtractMax, scheduler.go:343-383).
+"""
+
+import pytest
+
+from karpenter_tpu.apis.objects import Container, ContainerPort, ObjectMeta, Pod, PodSpec
+from karpenter_tpu.cloudprovider.fake import GI, instance_types
+from karpenter_tpu.scheduling.hostports import HostPort, HostPortUsage, get_host_ports
+from karpenter_tpu.solver.encode import TemplateInfo
+from karpenter_tpu.utils import resources as res
+from tests.test_solver_parity import make_pod, run_both, simple_template
+
+
+def pod_with_ports(i, *ports, cpu=0.1):
+    return Pod(
+        metadata=ObjectMeta(name=f"hp{i}"),
+        spec=PodSpec(
+            containers=[
+                Container(
+                    requests={"cpu": cpu},
+                    ports=[
+                        ContainerPort(host_port=p, host_ip=ip, protocol=proto)
+                        for (p, ip, proto) in ports
+                    ],
+                )
+            ]
+        ),
+    )
+
+
+class TestHostPortSemantics:
+    def test_matches_wildcard(self):
+        a = HostPort("0.0.0.0", 80, "TCP")
+        b = HostPort("10.0.0.1", 80, "TCP")
+        c = HostPort("10.0.0.2", 80, "TCP")
+        assert a.matches(b) and b.matches(a)
+        assert not b.matches(c)
+        assert not a.matches(HostPort("0.0.0.0", 81, "TCP"))
+        assert not a.matches(HostPort("0.0.0.0", 80, "UDP"))
+
+    def test_usage_tracking(self):
+        usage = HostPortUsage()
+        p1 = pod_with_ports(1, (80, "", "TCP"))
+        usage.add(p1, get_host_ports(p1))
+        p2 = pod_with_ports(2, (80, "10.0.0.1", "TCP"))
+        assert usage.conflicts(p2, get_host_ports(p2))  # wildcard blocks all IPs
+        p3 = pod_with_ports(3, (81, "", "TCP"))
+        assert usage.conflicts(p3, get_host_ports(p3)) is None
+        usage.delete_pod(p1.namespace, p1.name)
+        assert usage.conflicts(p2, get_host_ports(p2)) is None
+
+    def test_get_host_ports_defaults(self):
+        pod = pod_with_ports(0, (8080, "", ""))
+        hps = get_host_ports(pod)
+        assert hps == [HostPort("0.0.0.0", 8080, "TCP")]
+        # host_port 0 means no host port
+        none = Pod(spec=PodSpec(containers=[Container(ports=[ContainerPort(container_port=80)])]))
+        assert get_host_ports(none) == []
+
+
+class TestHostPortParity:
+    def test_conflicting_pods_split_nodes(self):
+        its = instance_types(4)
+        pods = [pod_with_ports(i, (80, "", "TCP")) for i in range(3)]
+        o, _ = run_both(pods, its, [simple_template(its)])
+        # every pod needs port 80 -> one claim each
+        assert len(o.new_claims) == 3
+        assert all(len(c.pod_indices) == 1 for c in o.new_claims)
+
+    def test_distinct_ports_pack_together(self):
+        its = instance_types(4)
+        pods = [pod_with_ports(i, (8000 + i, "", "TCP")) for i in range(3)]
+        o, _ = run_both(pods, its, [simple_template(its)])
+        assert len(o.new_claims) == 1
+
+    def test_same_port_different_protocol(self):
+        its = instance_types(4)
+        pods = [
+            pod_with_ports(0, (80, "", "TCP")),
+            pod_with_ports(1, (80, "", "UDP")),
+        ]
+        o, _ = run_both(pods, its, [simple_template(its)])
+        assert len(o.new_claims) == 1
+
+    def test_specific_ips_coexist_wildcard_blocks(self):
+        its = instance_types(4)
+        pods = [
+            pod_with_ports(0, (80, "10.0.0.1", "TCP")),
+            pod_with_ports(1, (80, "10.0.0.2", "TCP")),
+            pod_with_ports(2, (80, "", "TCP")),  # wildcard conflicts with both
+        ]
+        o, _ = run_both(pods, its, [simple_template(its)])
+        assert len(o.new_claims) == 2
+        sizes = sorted(len(c.pod_indices) for c in o.new_claims)
+        assert sizes == [1, 2]
+
+
+class TestLimitsParity:
+    def template_with_limits(self, its, remaining, name="pool"):
+        tpl = simple_template(its, name=name)
+        return TemplateInfo(
+            nodepool_name=tpl.nodepool_name,
+            requirements=tpl.requirements,
+            taints=tpl.taints,
+            daemon_overhead=tpl.daemon_overhead,
+            instance_type_indices=tpl.instance_type_indices,
+            remaining_resources=remaining,
+        )
+
+    def test_limits_cap_claim_count(self):
+        its = instance_types(2)  # 1cpu and 2cpu types
+        # headroom of 3 cpu: first claim subtracts max capacity (2 cpu),
+        # second claim can only use the 1cpu type, then pool is exhausted
+        tpl = self.template_with_limits(its, {res.CPU: 3.0})
+        pods = [make_pod(i, cpu=0.8) for i in range(6)]
+        o, _ = run_both(pods, its, [tpl])
+        assert o.failures  # someone doesn't fit once the pool is exhausted
+
+    def test_limit_filters_large_instance_types(self):
+        its = instance_types(8)
+        tpl = self.template_with_limits(its, {res.CPU: 4.0})
+        pods = [make_pod(0, cpu=1.0)]
+        o, _ = run_both(pods, its, [tpl])
+        assert len(o.new_claims) == 1
+        # no surviving instance type exceeds the 4-cpu headroom
+        assert all(its[t].capacity[res.CPU] <= 4.0 for t in o.new_claims[0].instance_type_indices)
+
+    def test_exhausted_pool_falls_to_next_template(self):
+        its = instance_types(4)
+        capped = self.template_with_limits(its, {res.CPU: 0.5}, name="capped")
+        fallback = simple_template(its, name="fallback")
+        pods = [make_pod(0, cpu=1.0)]
+        o, _ = run_both(pods, its, [capped, fallback])
+        assert o.new_claims[0].nodepool_name == "fallback"
+
+    def test_unlimited_pool_unaffected(self):
+        its = instance_types(4)
+        tpl = self.template_with_limits(its, None)
+        pods = [make_pod(i, cpu=1.0) for i in range(4)]
+        o, _ = run_both(pods, its, [tpl])
+        assert not o.failures

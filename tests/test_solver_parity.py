@@ -243,6 +243,9 @@ class TestRandomizedParity:
         zones = ["test-zone-1", "test-zone-2", "test-zone-3"]
         taint = Taint(key="team", value="x", effect="NoSchedule")
         templates = [simple_template(its, name="a")]
+        if rng.random() < 0.3:
+            # cap the first pool's cpu headroom to exercise limit accounting
+            templates[0].remaining_resources = {"cpu": float(rng.randint(4, 40))}
         if rng.random() < 0.5:
             templates.append(simple_template(its, name="b", taints=[taint]))
         pods = []
@@ -257,15 +260,24 @@ class TestRandomizedParity:
             tols = (
                 [Toleration(key="team", operator="Exists")] if rng.random() < 0.3 else []
             )
-            pods.append(
-                make_pod(
-                    i,
-                    cpu=rng.choice([0.1, 0.25, 0.5, 1.0, 1.5, 3.0]),
-                    mem=rng.choice([1e8, 2.5e8, 1e9, 4e9]),
-                    selector=selector,
-                    tolerations=tols,
-                )
+            pod = make_pod(
+                i,
+                cpu=rng.choice([0.1, 0.25, 0.5, 1.0, 1.5, 3.0]),
+                mem=rng.choice([1e8, 2.5e8, 1e9, 4e9]),
+                selector=selector,
+                tolerations=tols,
             )
+            if rng.random() < 0.25:
+                from karpenter_tpu.apis.objects import ContainerPort
+
+                pod.spec.containers[0].ports.append(
+                    ContainerPort(
+                        host_port=rng.choice([80, 443, 8080]),
+                        host_ip=rng.choice(["", "10.0.0.1", "10.0.0.2"]),
+                        protocol=rng.choice(["TCP", "UDP"]),
+                    )
+                )
+            pods.append(pod)
         nodes = []
         for n in range(rng.randint(0, 3)):
             nodes.append(

@@ -1,0 +1,416 @@
+"""Topology tests: spread / affinity / anti-affinity, oracle vs JAX parity.
+
+Mirrors the themes of the reference's topology suite
+(pkg/controllers/provisioning/scheduling/topology_test.go, 2,437 LoC):
+zonal/hostname spread with maxSkew, minDomains, pod affinity incl. bootstrap
+and batch ordering, pod anti-affinity incl. the inverse direction, and
+interaction with preference relaxation.
+"""
+
+import collections
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.objects import (
+    Affinity,
+    Container,
+    DO_NOT_SCHEDULE,
+    LabelSelector,
+    ObjectMeta,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PodSpec,
+    SCHEDULE_ANYWAY,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+)
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.solver.jax_backend import JaxSolver
+from karpenter_tpu.solver.oracle import OracleSolver
+from tests.test_solver_parity import assert_same, simple_template
+
+ZONES = ("test-zone-1", "test-zone-2", "test-zone-3")
+
+
+def spread_pod(i, key=wk.LABEL_TOPOLOGY_ZONE, max_skew=1, labels=None,
+               when=DO_NOT_SCHEDULE, min_domains=None, cpu=0.1):
+    labels = labels if labels is not None else {"app": "web"}
+    return Pod(
+        metadata=ObjectMeta(name=f"sp{i}", labels=labels),
+        spec=PodSpec(
+            containers=[Container(requests={"cpu": cpu})],
+            topology_spread_constraints=[
+                TopologySpreadConstraint(
+                    max_skew=max_skew,
+                    topology_key=key,
+                    when_unsatisfiable=when,
+                    label_selector=LabelSelector(match_labels=labels),
+                    min_domains=min_domains,
+                )
+            ],
+        ),
+    )
+
+
+def affinity_pod(i, labels=None, match=None, key=wk.LABEL_TOPOLOGY_ZONE,
+                 anti=False, preferred=False, cpu=0.1):
+    labels = labels if labels is not None else {"app": "web"}
+    match = match if match is not None else labels
+    term = PodAffinityTerm(topology_key=key, label_selector=LabelSelector(match_labels=match))
+    if anti:
+        aff = Affinity(pod_anti_affinity=PodAntiAffinity(
+            required=[] if preferred else [term],
+            preferred=[WeightedPodAffinityTerm(1, term)] if preferred else [],
+        ))
+    else:
+        aff = Affinity(pod_affinity=PodAffinity(
+            required=[] if preferred else [term],
+            preferred=[WeightedPodAffinityTerm(1, term)] if preferred else [],
+        ))
+    return Pod(
+        metadata=ObjectMeta(name=f"af{i}", labels=labels),
+        spec=PodSpec(containers=[Container(requests={"cpu": cpu})], affinity=aff),
+    )
+
+
+def run_both(pods, its, templates, nodes=()):
+    from karpenter_tpu.cloudprovider.fake import FAKE_WELL_KNOWN_LABELS
+
+    o = OracleSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(pods, its, templates, nodes)
+    j = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(pods, its, templates, nodes)
+    assert_same(o, j)
+    return o, j
+
+
+def zone_of_claim(claim, its):
+    """The single zone a claim's surviving instance-type requirements allow,
+    via the recorded requirements (oracle) — used for skew assertions."""
+    zones = claim.requirements.get(wk.LABEL_TOPOLOGY_ZONE)
+    assert not zones.complement
+    return sorted(zones.values)
+
+
+def skew_by_zone(result, its):
+    counts = collections.Counter()
+    for c in result.new_claims:
+        zs = zone_of_claim(c, its)
+        assert len(zs) == 1, f"zone not pinned: {zs}"
+        counts[zs[0]] += len(c.pod_indices)
+    return counts
+
+
+class TestZonalSpread:
+    def test_even_spread(self):
+        its = instance_types(4)
+        pods = [spread_pod(i) for i in range(9)]
+        o, _ = run_both(pods, its, [simple_template(its)])
+        assert not o.failures
+        counts = skew_by_zone(o, its)
+        # 9 pods over 3 zones with maxSkew 1 -> perfectly even
+        assert sorted(counts.values()) == [3, 3, 3]
+
+    def test_skew_respected_uneven(self):
+        its = instance_types(4)
+        pods = [spread_pod(i) for i in range(7)]
+        o, _ = run_both(pods, its, [simple_template(its)])
+        counts = skew_by_zone(o, its)
+        assert max(counts.values()) - min(counts.values()) <= 1
+        assert sum(counts.values()) == 7
+
+    def test_selector_scopes_counting(self):
+        its = instance_types(4)
+        web = [spread_pod(i, labels={"app": "web"}) for i in range(3)]
+        db = [spread_pod(i + 10, labels={"app": "db"}) for i in range(3)]
+        o, _ = run_both(web + db, its, [simple_template(its)])
+        assert not o.failures
+
+    def test_zone_selector_conflicts_with_spread(self):
+        # pods pinned to one zone but spreading across zones with maxSkew 1:
+        # third pod cannot schedule (would need another zone)
+        its = instance_types(4)
+        pods = [spread_pod(i) for i in range(3)]
+        for p in pods:
+            p.spec.node_selector = {wk.LABEL_TOPOLOGY_ZONE: "test-zone-1"}
+        o, _ = run_both(pods, its, [simple_template(its)])
+        # 1 per... skew vs min: min over pod-supported domains = zone-1 only
+        # -> min tracks zone-1 count; all 3 pods can stack there
+        assert not o.failures
+
+    def test_do_not_schedule_unsatisfiable_fails(self):
+        its = instance_types(4)
+        # spread over a label key that exists in no domain universe
+        pods = [spread_pod(i, key="nonexistent-topology-key") for i in range(2)]
+        o, _ = run_both(pods, its, [simple_template(its)])
+        assert len(o.failures) == 2
+
+
+class TestHostnameSpread:
+    def test_one_pod_per_host(self):
+        its = instance_types(4)
+        pods = [spread_pod(i, key=wk.LABEL_HOSTNAME) for i in range(4)]
+        o, _ = run_both(pods, its, [simple_template(its)])
+        assert not o.failures
+        # maxSkew 1 on hostname: every claim holds at most 1 selected pod more
+        # than the emptiest host; fresh hostnames keep min at 0 -> 1 pod each
+        assert all(len(c.pod_indices) == 1 for c in o.new_claims)
+        assert len(o.new_claims) == 4
+
+    def test_hostname_spread_multiple_per_host_with_skew(self):
+        its = instance_types(4)
+        pods = [spread_pod(i, key=wk.LABEL_HOSTNAME, max_skew=2) for i in range(4)]
+        o, _ = run_both(pods, its, [simple_template(its)])
+        assert not o.failures
+        assert all(len(c.pod_indices) <= 2 for c in o.new_claims)
+
+
+class TestMinDomains:
+    def test_min_domains_forces_extra_zones(self):
+        its = instance_types(4)
+        # pods restricted to 2 zones, minDomains=3: global min forced to 0,
+        # so pods can never stack beyond maxSkew over an empty virtual domain
+        pods = [
+            spread_pod(i, min_domains=3, cpu=0.1) for i in range(4)
+        ]
+        for p in pods:
+            p.spec.node_selector = {wk.LABEL_TOPOLOGY_ZONE: "test-zone-1"}
+        o, _ = run_both(pods, its, [simple_template(its)])
+        # only zone-1 eligible, count would exceed skew vs forced min 0
+        assert len(o.failures) == 3
+        assert o.num_scheduled() == 1
+
+
+class TestPodAffinity:
+    def test_affinity_groups_pods_in_one_zone(self):
+        its = instance_types(8)
+        pods = [affinity_pod(i) for i in range(6)]
+        o, _ = run_both(pods, its, [simple_template(its)])
+        assert not o.failures
+        zones = set()
+        for c in o.new_claims:
+            zones.update(zone_of_claim(c, its))
+        assert len(zones) == 1  # all claims pinned to the same zone
+
+    def test_affinity_to_earlier_batch_pod(self):
+        its = instance_types(8)
+        # anchor pod with label pinned to a zone; followers affine to it land
+        # in the same zone. The zone pin matters: a placement only records a
+        # domain when the claim collapsed to a single zone (Len()==1 rule,
+        # topology.go:134-137 — an unpinned anchor records nothing and
+        # non-self-selecting followers fail, in the reference too).
+        anchor = Pod(
+            metadata=ObjectMeta(name="anchor", labels={"role": "leader"}),
+            spec=PodSpec(
+                containers=[Container(requests={"cpu": 2.0})],
+                node_selector={wk.LABEL_TOPOLOGY_ZONE: "test-zone-2"},
+            ),
+        )
+        followers = [
+            affinity_pod(i, labels={"role": "worker"}, match={"role": "leader"}, cpu=0.1)
+            for i in range(3)
+        ]
+        o, _ = run_both([anchor] + followers, its, [simple_template(its)])
+        assert not o.failures
+        for c in o.new_claims:
+            assert zone_of_claim(c, its) == ["test-zone-2"]
+
+    def test_affinity_unpinned_anchor_strands_followers(self):
+        its = instance_types(8)
+        # reference-faithful negative: anchor without a zone pin records no
+        # domain, so non-self-selecting followers cannot satisfy affinity
+        anchor = Pod(
+            metadata=ObjectMeta(name="anchor", labels={"role": "leader"}),
+            spec=PodSpec(containers=[Container(requests={"cpu": 2.0})]),
+        )
+        followers = [
+            affinity_pod(i, labels={"role": "worker"}, match={"role": "leader"}, cpu=0.1)
+            for i in range(2)
+        ]
+        o, _ = run_both([anchor] + followers, its, [simple_template(its)])
+        assert set(o.failures) == {1, 2}
+
+    def test_affinity_unsatisfiable_without_target(self):
+        its = instance_types(4)
+        # follower selects a label nobody has and isn't self-selecting
+        pods = [affinity_pod(0, labels={"role": "w"}, match={"role": "nobody"})]
+        o, _ = run_both(pods, its, [simple_template(its)])
+        assert 0 in o.failures
+
+    def test_preferred_affinity_relaxes(self):
+        its = instance_types(4)
+        # preferred affinity to a nonexistent target: first pass fails, the
+        # relaxation ladder strips the preference, pod schedules
+        pods = [affinity_pod(0, labels={"r": "x"}, match={"r": "nobody"}, preferred=True)]
+        o, _ = run_both(pods, its, [simple_template(its)])
+        assert not o.failures
+
+    def test_hostname_affinity_packs_same_claim(self):
+        its = instance_types(8)
+        pods = [affinity_pod(i, key=wk.LABEL_HOSTNAME, cpu=0.1) for i in range(4)]
+        o, _ = run_both(pods, its, [simple_template(its)])
+        assert not o.failures
+        assert len(o.new_claims) == 1
+
+
+class TestPodAntiAffinity:
+    def test_self_anti_affinity_zone_one_per_batch(self):
+        # late committal: an unpinned claim could land in any zone, so the
+        # first anti-affine pod blocks ALL its possible zones — only one
+        # zonal self-anti-affine pod schedules per batch, exactly like the
+        # reference ("should support pod anti-affinity with a zone topology",
+        # topology_test.go:2069-2113)
+        its = instance_types(4)
+        pods = [affinity_pod(i, anti=True) for i in range(3)]
+        o, _ = run_both(pods, its, [simple_template(its)])
+        assert o.num_scheduled() == 1
+        assert len(o.failures) == 2
+
+    def test_self_anti_affinity_zone_pinned_spreads(self):
+        # pinning each pod to its own zone avoids the late-committal block
+        its = instance_types(4)
+        pods = [affinity_pod(i, anti=True) for i in range(3)]
+        for i, p in enumerate(pods):
+            p.spec.node_selector = {wk.LABEL_TOPOLOGY_ZONE: ZONES[i]}
+        o, _ = run_both(pods, its, [simple_template(its)])
+        assert not o.failures
+        zones = []
+        for c in o.new_claims:
+            zones.extend(zone_of_claim(c, its))
+        assert sorted(zones) == sorted(ZONES)
+
+    def test_hostname_anti_affinity_unlimited(self):
+        its = instance_types(4)
+        # hostname anti-affinity: fresh hostnames are minted per claim
+        pods = [affinity_pod(i, key=wk.LABEL_HOSTNAME, anti=True) for i in range(5)]
+        o, _ = run_both(pods, its, [simple_template(its)])
+        assert not o.failures
+        assert len(o.new_claims) == 5
+
+    def test_inverse_anti_affinity_schrodinger(self):
+        # pod A has anti-affinity to app=web; pod B is app=web with no terms.
+        # A's claim hasn't committed to a zone, so it could be in ANY zone and
+        # B cannot schedule anywhere — the reference's Schrödinger case
+        # (topology_test.go:1902-1933)
+        its = instance_types(4)
+        a = affinity_pod(0, labels={"app": "guard"}, match={"app": "web"}, anti=True, cpu=2.0)
+        b = Pod(
+            metadata=ObjectMeta(name="victim", labels={"app": "web"}),
+            spec=PodSpec(containers=[Container(requests={"cpu": 0.1})]),
+        )
+        o, _ = run_both([a, b], its, [simple_template(its)])
+        assert set(o.failures) == {1}
+
+    def test_inverse_anti_affinity_pinned_guard_frees_other_zones(self):
+        # with the guard pinned to one zone, the victim lands elsewhere
+        its = instance_types(4)
+        a = affinity_pod(0, labels={"app": "guard"}, match={"app": "web"}, anti=True, cpu=2.0)
+        a.spec.node_selector = {wk.LABEL_TOPOLOGY_ZONE: "test-zone-1"}
+        b = Pod(
+            metadata=ObjectMeta(name="victim", labels={"app": "web"}),
+            spec=PodSpec(containers=[Container(requests={"cpu": 0.1})]),
+        )
+        o, _ = run_both([a, b], its, [simple_template(its)])
+        assert not o.failures
+        zone_b = zone_of_claim(next(c for c in o.new_claims if 1 in c.pod_indices), its)
+        assert "test-zone-1" not in zone_b
+
+    def test_preferred_anti_affinity_relaxes(self):
+        its = instance_types(4)
+        pods = [affinity_pod(i, anti=True, preferred=True) for i in range(5)]
+        o, _ = run_both(pods, its, [simple_template(its)])
+        # preferred anti-affinity must never block scheduling
+        assert not o.failures
+
+
+class TestScheduleAnywayRelaxation:
+    def test_schedule_anyway_spread_dropped_when_needed(self):
+        its = instance_types(4)
+        pods = [
+            spread_pod(i, when=SCHEDULE_ANYWAY) for i in range(4)
+        ]
+        for p in pods:
+            p.spec.node_selector = {wk.LABEL_TOPOLOGY_ZONE: "test-zone-2"}
+        o, _ = run_both(pods, its, [simple_template(its)])
+        # DoNotSchedule would strand pods; ScheduleAnyway relaxes away
+        assert not o.failures
+
+
+class TestCrossPassGroupChange:
+    def test_spread_with_or_term_affinity_relaxation(self):
+        # a spread constraint + two required node-affinity OR terms: pass 1
+        # fails (first term impossible), relaxation pops the term, which
+        # changes the spread group's node filter -> a NEW topology group
+        # appears mid-solve. The carried device state must remap group rows
+        # (jax_backend._remap_group_state) or the pod wrongly never schedules.
+        from karpenter_tpu.apis.objects import (
+            IN,
+            Affinity,
+            NodeAffinity,
+            NodeSelectorRequirement,
+            NodeSelectorTerm,
+        )
+
+        its = instance_types(4)
+        pod = spread_pod(0)
+        pod.spec.affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required=[
+                    NodeSelectorTerm(
+                        [NodeSelectorRequirement(wk.LABEL_TOPOLOGY_ZONE, IN, ["mars"])]
+                    ),
+                    NodeSelectorTerm(
+                        [NodeSelectorRequirement(wk.LABEL_TOPOLOGY_ZONE, IN, ["test-zone-2"])]
+                    ),
+                ]
+            )
+        )
+        o, j = run_both([pod, spread_pod(1)], its, [simple_template(its)])
+        assert not o.failures and not j.failures
+
+
+class TestMixedParityFuzz:
+    @pytest.mark.parametrize("seed", range(6))
+    def test_fuzz_topology(self, seed):
+        import random
+
+        rng = random.Random(1000 + seed)
+        its = instance_types(rng.randint(3, 8))
+        pods = []
+        for i in range(rng.randint(4, 14)):
+            r = rng.random()
+            labels = {"grp": rng.choice("ab")}
+            if r < 0.3:
+                pods.append(
+                    spread_pod(
+                        i,
+                        key=rng.choice([wk.LABEL_TOPOLOGY_ZONE, wk.LABEL_HOSTNAME]),
+                        max_skew=rng.choice([1, 2]),
+                        labels=labels,
+                        when=rng.choice([DO_NOT_SCHEDULE, SCHEDULE_ANYWAY]),
+                        cpu=rng.choice([0.1, 0.5]),
+                    )
+                )
+            elif r < 0.5:
+                pods.append(
+                    affinity_pod(
+                        i,
+                        labels=labels,
+                        match={"grp": rng.choice("ab")},
+                        key=rng.choice([wk.LABEL_TOPOLOGY_ZONE, wk.LABEL_HOSTNAME]),
+                        anti=rng.random() < 0.4,
+                        preferred=rng.random() < 0.3,
+                        cpu=rng.choice([0.1, 0.5]),
+                    )
+                )
+            else:
+                pods.append(
+                    Pod(
+                        metadata=ObjectMeta(name=f"g{i}", labels=labels),
+                        spec=PodSpec(
+                            containers=[Container(requests={"cpu": rng.choice([0.1, 0.5, 1.0])})]
+                        ),
+                    )
+                )
+        run_both(pods, its, [simple_template(its)])
