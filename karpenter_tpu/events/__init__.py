@@ -1,0 +1,1 @@
+from karpenter_tpu.events.recorder import Event, Recorder  # noqa: F401

@@ -1,0 +1,77 @@
+"""Deduplicated event recorder.
+
+Equivalent of reference pkg/events/recorder.go:30-95: events are keyed by
+(involved object kind/name, reason, message) and each key is published at most
+once per TTL window, with a flow-control bucket per key. Our store keeps the
+published events in memory so tests can assert on them (the reference's test
+recorder counts publishes, events/suite_test.go:42-70).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+NORMAL = "Normal"
+WARNING = "Warning"
+
+_DEDUPE_TTL = 2 * 60.0  # recorder.go:36
+
+
+@dataclass
+class Event:
+    involved_kind: str = ""
+    involved_name: str = ""
+    type: str = NORMAL
+    reason: str = ""
+    message: str = ""
+    timestamp: float = 0.0
+
+    def dedupe_key(self) -> str:
+        return "|".join([self.involved_kind, self.involved_name, self.reason, self.message])
+
+
+def object_event(obj, type_: str, reason: str, message: str) -> Event:
+    return Event(
+        involved_kind=type(obj).__name__,
+        involved_name=getattr(obj.metadata, "name", ""),
+        type=type_,
+        reason=reason,
+        message=message,
+    )
+
+
+@dataclass
+class Recorder:
+    clock: Optional[object] = None
+    events: List[Event] = field(default_factory=list)
+    _last_published: Dict[str, float] = field(default_factory=dict)
+    calls: int = 0  # every publish() attempt, pre-dedup
+
+    def _now(self) -> float:
+        return self.clock.now() if self.clock is not None else _time.time()
+
+    def publish(self, *events: Event):
+        for ev in events:
+            self.calls += 1
+            key = ev.dedupe_key()
+            now = self._now()
+            last = self._last_published.get(key)
+            if last is not None and now - last < _DEDUPE_TTL:
+                continue
+            self._last_published[key] = now
+            ev.timestamp = now
+            self.events.append(ev)
+
+    def reset(self):
+        self.events.clear()
+        self._last_published.clear()
+        self.calls = 0
+
+    def count(self, reason: str) -> int:
+        return sum(1 for e in self.events if e.reason == reason)
+
+    def for_object(self, obj) -> List[Event]:
+        kind, name = type(obj).__name__, obj.metadata.name
+        return [e for e in self.events if e.involved_kind == kind and e.involved_name == name]

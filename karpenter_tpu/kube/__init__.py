@@ -1,0 +1,9 @@
+from karpenter_tpu.kube.client import (  # noqa: F401
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AlreadyExists,
+    Conflict,
+    KubeClient,
+    NotFound,
+)

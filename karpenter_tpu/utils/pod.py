@@ -1,0 +1,108 @@
+"""Pod predicates.
+
+Equivalent of reference pkg/utils/pod/scheduling.go:28-120. One deliberate
+divergence: ``failed_to_schedule`` treats a pod with *no* PodScheduled
+condition as unschedulable too — the reference relies on the cluster's
+kube-scheduler to stamp reason=Unschedulable, and in this framework (as in
+the reference's own envtest suites, where no kube-scheduler runs) nothing
+does, so an unbound pending pod is the provisioner's signal.
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.objects import NO_SCHEDULE, Pod, Taint
+from karpenter_tpu.scheduling.taints import Taints
+
+POD_SCHEDULED = "PodScheduled"
+REASON_UNSCHEDULABLE = "Unschedulable"
+
+
+def is_provisionable(pod: Pod) -> bool:
+    """The pending-pod gate (scheduling.go:28-34)."""
+    return (
+        not is_scheduled(pod)
+        and not is_preempting(pod)
+        and failed_to_schedule(pod)
+        and not is_owned_by_daemonset(pod)
+        and not is_owned_by_node(pod)
+    )
+
+
+def failed_to_schedule(pod: Pod) -> bool:
+    has_scheduled_condition = False
+    for c in pod.status.conditions:
+        if c.type == POD_SCHEDULED:
+            has_scheduled_condition = True
+            if c.reason == REASON_UNSCHEDULABLE:
+                return True
+    return not has_scheduled_condition
+
+
+def is_scheduled(pod: Pod) -> bool:
+    return pod.spec.node_name != ""
+
+
+def is_preempting(pod: Pod) -> bool:
+    return pod.status.nominated_node_name != ""
+
+
+def is_terminal(pod: Pod) -> bool:
+    return pod.status.phase in ("Failed", "Succeeded")
+
+
+def is_terminating(pod: Pod) -> bool:
+    return pod.metadata.deletion_timestamp is not None
+
+
+def is_owned_by_daemonset(pod: Pod) -> bool:
+    return is_owned_by(pod, "DaemonSet")
+
+
+def is_owned_by_node(pod: Pod) -> bool:
+    """Static pods (scheduling.go:67-71)."""
+    return is_owned_by(pod, "Node")
+
+
+def is_owned_by(pod: Pod, *kinds: str) -> bool:
+    return any(o.kind in kinds for o in pod.metadata.owner_references)
+
+
+def has_do_not_disrupt(pod: Pod) -> bool:
+    return pod.metadata.annotations.get(wk.DO_NOT_DISRUPT_ANNOTATION_KEY) == "true"
+
+
+def tolerates_unschedulable_taint(pod: Pod) -> bool:
+    return Taints([Taint(key=wk.TAINT_NODE_UNSCHEDULABLE, effect=NO_SCHEDULE)]).tolerates(pod)
+
+
+def tolerates_disruption_no_schedule_taint(pod: Pod) -> bool:
+    return Taints([wk_disruption_taint()]).tolerates(pod)
+
+
+def wk_disruption_taint() -> Taint:
+    return Taint(
+        key=wk.DISRUPTION_TAINT_KEY,
+        effect=NO_SCHEDULE,
+        value=wk.DISRUPTING_NO_SCHEDULE_TAINT_VALUE,
+    )
+
+
+def has_pod_anti_affinity(pod: Pod) -> bool:
+    aff = pod.spec.affinity
+    return (
+        aff is not None
+        and aff.pod_anti_affinity is not None
+        and bool(aff.pod_anti_affinity.required or aff.pod_anti_affinity.preferred)
+    )
+
+
+def has_required_pod_anti_affinity(pod: Pod) -> bool:
+    return has_pod_anti_affinity(pod) and bool(pod.spec.affinity.pod_anti_affinity.required)
+
+
+def is_reschedulable(pod: Pod) -> bool:
+    """Pods that count when simulating where evicted workloads go: active and
+    not bound to a lifetime shorter than the disruption (utils used by
+    disruption candidate building)."""
+    return not is_terminal(pod) and not is_terminating(pod) and not is_owned_by_node(pod)

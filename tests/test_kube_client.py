@@ -1,0 +1,142 @@
+"""KubeClient apiserver semantics: CRUD, versions, finalizers, watches."""
+
+import pytest
+
+from karpenter_tpu.apis.objects import Node, ObjectMeta, Pod
+from karpenter_tpu.events import Event, Recorder
+from karpenter_tpu.kube import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    AlreadyExists,
+    Conflict,
+    KubeClient,
+    NotFound,
+)
+from karpenter_tpu.utils import pod as podutils
+from karpenter_tpu.utils.clock import FakeClock
+
+
+def test_create_get_list_update_delete():
+    c = KubeClient()
+    p = Pod(metadata=ObjectMeta(name="a"))
+    c.create(p)
+    with pytest.raises(AlreadyExists):
+        c.create(Pod(metadata=ObjectMeta(name="a")))
+    got = c.get(Pod, "a")
+    assert got.metadata.name == "a"
+    got.spec.node_name = "n1"
+    c.update(got)
+    assert c.get(Pod, "a").spec.node_name == "n1"
+    assert len(c.list(Pod)) == 1
+    c.delete(Pod, "a")
+    with pytest.raises(NotFound):
+        c.get(Pod, "a")
+
+
+def test_objects_are_isolated_copies():
+    c = KubeClient()
+    p = Pod(metadata=ObjectMeta(name="a", labels={"x": "1"}))
+    c.create(p)
+    p.metadata.labels["x"] = "mutated"
+    assert c.get(Pod, "a").metadata.labels["x"] == "1"
+    got = c.get(Pod, "a")
+    got.metadata.labels["x"] = "2"
+    assert c.get(Pod, "a").metadata.labels["x"] == "1"
+
+
+def test_conflict_on_stale_update():
+    c = KubeClient()
+    c.create(Pod(metadata=ObjectMeta(name="a")))
+    first = c.get(Pod, "a")
+    second = c.get(Pod, "a")
+    c.update(first)
+    with pytest.raises(Conflict):
+        c.update(second)
+    # patch does read-modify-write and never conflicts
+    c.patch(second, lambda p: p.metadata.labels.update({"ok": "1"}))
+    assert c.get(Pod, "a").metadata.labels["ok"] == "1"
+
+
+def test_finalizer_blocks_deletion():
+    c = KubeClient()
+    n = Node(metadata=ObjectMeta(name="n1", finalizers=["karpenter.tpu/termination"]))
+    c.create(n)
+    c.delete(Node, "n1")
+    stored = c.get(Node, "n1")
+    assert stored.metadata.deletion_timestamp is not None
+    # removing the finalizer finalizes the delete
+    stored.metadata.finalizers = []
+    c.update(stored)
+    with pytest.raises(NotFound):
+        c.get(Node, "n1")
+
+
+def test_deletion_timestamp_is_apiserver_owned():
+    c = KubeClient()
+    n = Node(metadata=ObjectMeta(name="n1", finalizers=["f"]))
+    c.create(n)
+    got = c.get(Node, "n1")
+    got.metadata.deletion_timestamp = 123.0  # controller cannot set this
+    c.update(got)
+    assert c.get(Node, "n1").metadata.deletion_timestamp is None
+
+
+def test_watch_stream_and_replay():
+    c = KubeClient()
+    c.create(Pod(metadata=ObjectMeta(name="pre")))
+    events = []
+    c.watch(Pod, lambda ev, obj: events.append((ev, obj.metadata.name)))
+    assert events == [(ADDED, "pre")]
+    c.create(Pod(metadata=ObjectMeta(name="a")))
+    p = c.get(Pod, "a")
+    c.update(p)
+    c.delete(Pod, "a")
+    assert events == [
+        (ADDED, "pre"),
+        (ADDED, "a"),
+        (MODIFIED, "a"),
+        (DELETED, "a"),
+    ]
+
+
+def test_list_filters():
+    c = KubeClient()
+    c.create(Pod(metadata=ObjectMeta(name="a", labels={"app": "x"})))
+    c.create(Pod(metadata=ObjectMeta(name="b", labels={"app": "y"})))
+    c.create(Pod(metadata=ObjectMeta(name="c", namespace="other", labels={"app": "x"})))
+    assert {p.metadata.name for p in c.list(Pod, label_selector={"app": "x"})} == {"a", "c"}
+    assert {p.metadata.name for p in c.list(Pod, namespace="default")} == {"a", "b"}
+    bound = c.list(Pod, predicate=lambda p: p.spec.node_name == "")
+    assert len(bound) == 3
+
+
+def test_recorder_dedup():
+    clock = FakeClock()
+    r = Recorder(clock=clock)
+    ev = lambda: Event(involved_kind="Pod", involved_name="a", reason="Nominate", message="m")
+    r.publish(ev())
+    r.publish(ev())
+    assert len(r.events) == 1 and r.calls == 2
+    clock.step(121)
+    r.publish(ev())
+    assert len(r.events) == 2
+    assert r.count("Nominate") == 2
+
+
+def test_pod_predicates():
+    p = Pod(metadata=ObjectMeta(name="a"))
+    assert podutils.is_provisionable(p)
+    p.spec.node_name = "n1"
+    assert not podutils.is_provisionable(p)
+    p2 = Pod(metadata=ObjectMeta(name="b"))
+    p2.status.nominated_node_name = "n1"
+    assert not podutils.is_provisionable(p2)
+    from karpenter_tpu.apis.objects import OwnerReference
+
+    p3 = Pod(metadata=ObjectMeta(name="c", owner_references=[OwnerReference(kind="DaemonSet")]))
+    assert not podutils.is_provisionable(p3)
+    p4 = Pod(metadata=ObjectMeta(name="d", annotations={"karpenter.tpu/do-not-disrupt": "true"}))
+    assert podutils.has_do_not_disrupt(p4)
+    p4.status.phase = "Succeeded"
+    assert podutils.is_terminal(p4)
