@@ -9,6 +9,7 @@ recorder counts publishes, events/suite_test.go:42-70).
 
 from __future__ import annotations
 
+import dataclasses
 import time as _time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -61,8 +62,8 @@ class Recorder:
             if last is not None and now - last < _DEDUPE_TTL:
                 continue
             self._last_published[key] = now
-            ev.timestamp = now
-            self.events.append(ev)
+            # store a copy: a caller-retained Event must not alias the log
+            self.events.append(dataclasses.replace(ev, timestamp=now))
 
     def reset(self):
         self.events.clear()

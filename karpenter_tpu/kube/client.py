@@ -85,7 +85,8 @@ class KubeClient:
         with self._lock:
             self._watchers.setdefault(kind, []).append(handler)
             if replay:
-                for obj in self._coll(kind).values():
+                # snapshot: the handler may create/delete objects of this kind
+                for obj in list(self._coll(kind).values()):
                     handler(ADDED, copy.deepcopy(obj))
 
     # -- CRUD -----------------------------------------------------------------

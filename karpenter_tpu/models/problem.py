@@ -53,6 +53,12 @@ import numpy as np
 GT_NONE = np.int32(-(2**31) + 1)
 LT_NONE = np.int32(2**31 - 1)
 
+# Vocab key indices the encoder pins (solver/encode.py seeds these first, in
+# this order, and asserts it; the device kernels index them statically).
+ZONE_KEY = 0
+CT_KEY = 1
+HOSTNAME_KEY = 2
+
 
 @jax.tree_util.register_dataclass
 @dataclass

@@ -45,10 +45,8 @@ KIND_NEW_CLAIM = 2
 KIND_FAIL = 3
 KIND_NO_SLOT = 4  # a fresh claim would accept the pod, but slots ran out
 
-# vocab key indices the encoder pins
-ZONE_KEY = 0
-CT_KEY = 1
-HOSTNAME_KEY = 2
+# vocab key indices the encoder pins (single source: models/problem.py)
+from karpenter_tpu.models.problem import CT_KEY, HOSTNAME_KEY, ZONE_KEY  # noqa: E402
 
 _BIG = jnp.int32(2**30)
 

@@ -17,7 +17,7 @@ from typing import Any, NamedTuple
 import jax.numpy as jnp
 from jax import vmap
 
-from karpenter_tpu.models.problem import ReqTensor, SchedulingProblem
+from karpenter_tpu.models.problem import HOSTNAME_KEY, ReqTensor, SchedulingProblem
 from karpenter_tpu.ops import masks
 
 _MAXI = jnp.int32(2**31 - 1)
@@ -73,7 +73,7 @@ def allowed_domains(
     global_min = jnp.where(
         has_min_domains & (n_supported < problem.grp_min_domains), 0, global_min
     )
-    is_hostname = key == _hostname_key(problem)
+    is_hostname = key == HOSTNAME_KEY
     global_min = jnp.where(is_hostname, 0, global_min)
 
     self_count = counts + pod.grp_selects[:, None].astype(jnp.int32)  # i32[G, V]
@@ -227,7 +227,3 @@ def record(
     recorded = rec[:, None] & dom
     return counts + recorded.astype(jnp.int32), registered | recorded
 
-
-def _hostname_key(problem: SchedulingProblem) -> int:
-    """The encoder pins hostname to vocab key index 2 (zone=0, ct=1)."""
-    return 2

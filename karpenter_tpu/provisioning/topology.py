@@ -252,6 +252,23 @@ class Topology:
         for p in batch_pods:
             self.update(p)
 
+    def clone(self) -> "Topology":
+        """Copy the mutable group state (domain counters, owners, registry),
+        sharing the immutable cluster_pods snapshot — what solver backends use
+        to isolate a caller-provided topology without re-copying every running
+        pod in the cluster."""
+        import copy as _copy
+
+        new = Topology.__new__(Topology)
+        new.domains = {k: set(v) for k, v in self.domains.items()}
+        new.excluded = set(self.excluded)
+        new.cluster_pods = self.cluster_pods  # never mutated after __init__
+        new.topologies = {k: _copy.deepcopy(tg) for k, tg in self.topologies.items()}
+        new.inverse_topologies = {
+            k: _copy.deepcopy(tg) for k, tg in self.inverse_topologies.items()
+        }
+        return new
+
     # -- group construction ---------------------------------------------------
 
     def update(self, pod: Pod) -> None:

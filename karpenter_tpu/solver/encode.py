@@ -24,11 +24,14 @@ from karpenter_tpu.apis.nodepool import NodePool
 from karpenter_tpu.apis.objects import Pod, Taint
 from karpenter_tpu.cloudprovider.types import InstanceType
 from karpenter_tpu.models.problem import (
+    CT_KEY,
     GT_NONE,
+    HOSTNAME_KEY,
     LT_NONE,
     ProblemMeta,
     ReqTensor,
     SchedulingProblem,
+    ZONE_KEY,
 )
 from karpenter_tpu.provisioning.topology import Topology, TOPOLOGY_TYPE_SPREAD
 from karpenter_tpu.scheduling import (
@@ -146,12 +149,14 @@ class Encoder:
         topology: Optional[Topology] = None,
         num_claim_slots: int = 0,
         vocab_pods: Optional[Sequence[Pod]] = None,
+        vocab_reqs: Optional[Sequence[Requirements]] = None,
     ) -> EncodedProblem:
         """``vocab_pods`` seeds the vocabulary (defaults to ``pods``): across
         the relax-and-retry passes the vocabulary must stay identical so the
         carried solver state keeps valid lane indices — callers pass the
         original unrelaxed batch there while ``pods`` shrinks to the retry
-        queue."""
+        queue. ``vocab_reqs`` seeds requirement sets that exist outside any pod
+        spec (the full pod_reqs_override universe) for the same reason."""
         # -- 1. FFD queue order: cpu desc, mem desc, creation, uid (queue.go:76-111)
         pod_reqs_list = (
             list(pod_reqs_override)
@@ -187,6 +192,11 @@ class Encoder:
         zone_k = vocab.key(wk.LABEL_TOPOLOGY_ZONE)
         ct_k = vocab.key(wk.CAPACITY_TYPE_LABEL_KEY)
         hostname_k = vocab.key(wk.LABEL_HOSTNAME)
+        if (zone_k, ct_k, hostname_k) != (ZONE_KEY, CT_KEY, HOSTNAME_KEY):
+            # device kernels index these statically; survive python -O
+            raise AssertionError(
+                f"pinned vocab keys moved: {(zone_k, ct_k, hostname_k)}"
+            )
         for p in vocab_pods:
             # seed EVERY affinity term, not just the active one: relaxation
             # can surface later OR terms / lighter preferences in later
@@ -204,6 +214,13 @@ class Encoder:
                             *pref.preference.match_expressions
                         )
                     )
+        # vocab_reqs (stable, full-universe order) must seed BEFORE the
+        # per-pass pod_reqs_list, whose FFD-queue order varies across relax
+        # passes — otherwise override-only keys/values get different lane
+        # indices per pass and carried solver state misreads them
+        if vocab_reqs is not None:
+            for reqs in vocab_reqs:
+                vocab.add_requirements(reqs)
         if pod_reqs_override is not None:
             for reqs in pod_reqs_list:
                 vocab.add_requirements(reqs)

@@ -117,10 +117,15 @@ class JaxSolver(SolverBackend):
         self, pods, instance_types, templates, nodes,
         pod_requirements_override, topology, cluster_pods, domains, max_claims,
     ) -> SolveResult:
-        work = [copy.deepcopy(p) for p in pods]
+        # copy-on-write: pods are only copied when relaxation is about to
+        # mutate them — the common all-scheduled case pays no deepcopy
+        work = list(pods)
+        copied = set()
         vocab_pods = list(pods)  # frozen vocabulary seed (originals never mutate)
+        # a caller-provided topology is isolated per attempt, so a _SlotOverflow
+        # retry re-evaluates the unrelaxed pods against unrelaxed group state
         topo = (
-            topology
+            topology.clone()
             if topology is not None
             else Topology(domains, batch_pods=work, cluster_pods=cluster_pods)
         )
@@ -139,23 +144,27 @@ class JaxSolver(SolverBackend):
         meta = None
         prev_group_keys = None
         queue = list(range(len(work)))
-        first_pass = True
         while queue:
             encoded = encoder.encode(
                 [work[i] for i in queue],
                 instance_types,
                 templates,
                 nodes,
+                # the override pins label requirements for the whole solve —
+                # relaxation still runs its full ladder, but node-affinity
+                # steps can't change the pinned reqs (only topology-side
+                # effects like spread node-filters survive); the override's
+                # full universe seeds the frozen vocabulary
                 pod_reqs_override=(
                     [pod_requirements_override[i] for i in queue]
-                    if pod_requirements_override is not None and first_pass
+                    if pod_requirements_override is not None
                     else None
                 ),
                 topology=topo,
                 num_claim_slots=max_claims,
                 vocab_pods=vocab_pods,
+                vocab_reqs=pod_requirements_override,
             )
-            first_pass = False
             problem, meta = pad_problem(encoded.problem), encoded.meta
             group_keys = [
                 tg.hash_key()
@@ -188,6 +197,9 @@ class JaxSolver(SolverBackend):
                     failed.append(orig)
             relaxed_any = False
             for orig in failed:
+                if orig not in copied:
+                    work[orig] = copy.deepcopy(work[orig])
+                    copied.add(orig)
                 if prefs.relax(work[orig]) is not None:
                     relaxed_any = True
                     topo.update(work[orig])

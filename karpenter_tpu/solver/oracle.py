@@ -101,10 +101,18 @@ class OracleSolver(SolverBackend):
         cluster_pods: Sequence = (),
         domains: Optional[Dict[str, set]] = None,
     ) -> SolveResult:
-        work = [copy.deepcopy(p) for p in pods]
+        # copy-on-write: pods are only copied when relaxation mutates them;
+        # a caller-provided topology is isolated so the caller's group state
+        # never sees this solve's relaxations (matches jax_backend)
+        work = list(pods)
+        copied = set()
         if domains is None:
             domains = domains_from_instance_types(instance_types, templates)
-        topo = topology or Topology(domains, batch_pods=work, cluster_pods=cluster_pods)
+        topo = (
+            topology.clone()
+            if topology is not None
+            else Topology(domains, batch_pods=work, cluster_pods=cluster_pods)
+        )
         for n in nodes:
             topo.register(wk.LABEL_HOSTNAME, n.name)
         prefs = Preferences(
@@ -130,13 +138,15 @@ class OracleSolver(SolverBackend):
         result = SolveResult()
 
         queue = list(range(len(work)))
-        first_pass = True
         while queue:
             progress = False
             failed: List[int] = []
             for pi in [queue[i] for i in ffd_order([work[i] for i in queue])]:
                 pod = work[pi]
-                if pod_requirements_override is not None and first_pass:
+                # the override pins label requirements for the whole solve —
+                # relax still runs its full ladder but node-affinity steps
+                # can't change the pinned reqs (jax parity)
+                if pod_requirements_override is not None:
                     reqs = pod_requirements_override[pi]
                     strict = reqs
                 else:
@@ -161,9 +171,11 @@ class OracleSolver(SolverBackend):
                     progress = True
                 else:
                     failed.append(pi)
-            first_pass = False
             relaxed_any = False
             for pi in failed:
+                if pi not in copied:
+                    work[pi] = copy.deepcopy(work[pi])
+                    copied.add(pi)
                 if prefs.relax(work[pi]) is not None:
                     relaxed_any = True
                     topo.update(work[pi])

@@ -73,11 +73,12 @@ def has_do_not_disrupt(pod: Pod) -> bool:
 
 
 def tolerates_unschedulable_taint(pod: Pod) -> bool:
-    return Taints([Taint(key=wk.TAINT_NODE_UNSCHEDULABLE, effect=NO_SCHEDULE)]).tolerates(pod)
+    # Taints.tolerates returns error strings (empty == tolerated)
+    return not Taints([Taint(key=wk.TAINT_NODE_UNSCHEDULABLE, effect=NO_SCHEDULE)]).tolerates(pod)
 
 
 def tolerates_disruption_no_schedule_taint(pod: Pod) -> bool:
-    return Taints([wk_disruption_taint()]).tolerates(pod)
+    return not Taints([wk_disruption_taint()]).tolerates(pod)
 
 
 def wk_disruption_taint() -> Taint:

@@ -140,3 +140,28 @@ def test_pod_predicates():
     assert podutils.has_do_not_disrupt(p4)
     p4.status.phase = "Succeeded"
     assert podutils.is_terminal(p4)
+
+
+def test_taint_toleration_predicates_truthiness():
+    from karpenter_tpu.apis.objects import Toleration
+
+    bare = Pod(metadata=ObjectMeta(name="bare"))
+    assert not podutils.tolerates_unschedulable_taint(bare)
+    assert not podutils.tolerates_disruption_no_schedule_taint(bare)
+    tolerant = Pod(metadata=ObjectMeta(name="tol"))
+    tolerant.spec.tolerations = [Toleration(operator="Exists")]
+    assert podutils.tolerates_unschedulable_taint(tolerant)
+    assert podutils.tolerates_disruption_no_schedule_taint(tolerant)
+
+
+def test_watch_replay_reentrant():
+    c = KubeClient()
+    c.create(Pod(metadata=ObjectMeta(name="a")))
+    c.create(Pod(metadata=ObjectMeta(name="b")))
+
+    def handler(ev, obj):
+        if ev == ADDED and not obj.metadata.name.startswith("mirror-"):
+            c.create(Pod(metadata=ObjectMeta(name="mirror-" + obj.metadata.name)))
+
+    c.watch(Pod, handler)  # must not raise RuntimeError
+    assert len(c.list(Pod)) == 4

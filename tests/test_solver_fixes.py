@@ -1,0 +1,123 @@
+"""Regressions: override vocabulary stability across relax passes, caller
+topology isolation, and input-pod immutability under copy-on-write."""
+
+import copy
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodepool import NodePool
+from karpenter_tpu.apis.objects import (
+    Affinity,
+    Container,
+    LabelSelector,
+    ObjectMeta,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PodSpec,
+    WeightedPodAffinityTerm,
+)
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.provisioning.topology import Topology
+from karpenter_tpu.scheduling import Requirements, Requirement
+from karpenter_tpu.solver.encode import domains_from_instance_types, template_from_nodepool
+from karpenter_tpu.solver.jax_backend import JaxSolver
+from karpenter_tpu.solver.oracle import OracleSolver
+
+
+def _setup():
+    its = instance_types(10)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="default")), its, range(len(its))
+    )
+    return its, tpl
+
+
+def _relaxable_pod(name):
+    """Fails pass 1 (preferred pod affinity to a label nothing carries), then
+    relaxes and schedules on pass 2."""
+    return Pod(
+        metadata=ObjectMeta(name=name),
+        spec=PodSpec(
+            containers=[Container(requests={"cpu": 0.5})],
+            affinity=Affinity(
+                pod_affinity=PodAffinity(
+                    preferred=[
+                        WeightedPodAffinityTerm(
+                            weight=1,
+                            pod_affinity_term=PodAffinityTerm(
+                                topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                                label_selector=LabelSelector(match_labels={"no": "match"}),
+                            ),
+                        )
+                    ]
+                )
+            ),
+        ),
+    )
+
+
+class TestOverrideVocabStability:
+    def test_override_only_values_survive_relax_pass(self):
+        """A pod whose override mentions values absent from every spec must
+        keep failing cleanly (not crash/misplace) when another pod forces a
+        second, relaxed encoding pass."""
+        its, tpl = _setup()
+        stuck = Pod(
+            metadata=ObjectMeta(name="stuck"),
+            spec=PodSpec(containers=[Container(requests={"cpu": 0.5})]),
+        )
+        reqs = Requirements()
+        reqs.add(Requirement("custom.io/ghost-key", "In", ["ghost-value"]))
+        pods = [stuck, _relaxable_pod("relaxer")]
+        overrides = [reqs, Requirements()]
+        for solver in (OracleSolver(), JaxSolver()):
+            result = solver.solve(pods, its, [tpl], pod_requirements_override=overrides)
+            assert 0 in result.failures, type(solver).__name__
+            assert result.num_scheduled() == 1, type(solver).__name__
+
+    def test_override_pins_requirements_on_every_pass(self):
+        """Oracle and JAX agree that overrides apply beyond pass 1."""
+        its, tpl = _setup()
+        pods = [_relaxable_pod("a"), _relaxable_pod("b")]
+        reqs = Requirements()
+        reqs.add(Requirement(wk.LABEL_TOPOLOGY_ZONE, "In", ["test-zone-1"]))
+        overrides = [reqs, reqs]
+        o = OracleSolver().solve(pods, its, [tpl], pod_requirements_override=overrides)
+        j = JaxSolver().solve(pods, its, [tpl], pod_requirements_override=overrides)
+        assert o.num_scheduled() == j.num_scheduled() == 2
+        for r in (o, j):
+            for claim in r.new_claims:
+                # every surviving instance type offers test-zone-1
+                for i in claim.instance_type_indices:
+                    assert any(
+                        off.zone == "test-zone-1" for off in its[i].offerings
+                    ), its[i].name
+
+
+class TestCallerStateIsolation:
+    def test_caller_topology_not_mutated(self):
+        its, tpl = _setup()
+        pods = [_relaxable_pod("a")]
+        domains = domains_from_instance_types(its, [tpl])
+        for solver in (OracleSolver(), JaxSolver()):
+            topo = Topology(domains, batch_pods=pods)
+            before = copy.deepcopy(
+                {k: dict(tg.domains) for k, tg in topo.topologies.items()}
+            )
+            owners_before = {k: set(tg.owners) for k, tg in topo.topologies.items()}
+            solver.solve(pods, its, [tpl], topology=topo)
+            after = {k: dict(tg.domains) for k, tg in topo.topologies.items()}
+            owners_after = {k: set(tg.owners) for k, tg in topo.topologies.items()}
+            assert before == after, type(solver).__name__
+            assert owners_before == owners_after, type(solver).__name__
+
+    def test_input_pods_never_mutated(self):
+        its, tpl = _setup()
+        pods = [_relaxable_pod("a"), _relaxable_pod("b")]
+        snapshots = [copy.deepcopy(p) for p in pods]
+        for solver in (OracleSolver(), JaxSolver()):
+            result = solver.solve(pods, its, [tpl])
+            assert result.num_scheduled() == 2
+            for p, snap in zip(pods, snapshots):
+                assert len(p.spec.affinity.pod_affinity.preferred) == 1
+                assert p.spec.affinity.pod_affinity.preferred[0].weight == snap.spec.affinity.pod_affinity.preferred[0].weight
