@@ -1,0 +1,4 @@
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.statenode import StateNode
+
+__all__ = ["Cluster", "StateNode"]

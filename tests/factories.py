@@ -1,0 +1,264 @@
+"""Fixture factories — the pkg/test object-factory equivalent.
+
+The reference builds every test object from an option struct
+(`test.Pod(test.PodOptions{...})`, reference pkg/test/pods.go etc.); these
+keyword-driven factories play the same role for the rebuild's suites.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional, Sequence
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.conditions import ConditionSet
+from karpenter_tpu.apis.nodeclaim import (
+    INITIALIZED,
+    LAUNCHED,
+    LIVING_CONDITIONS,
+    NodeClaim,
+    NodeClaimStatus,
+    REGISTERED,
+)
+from karpenter_tpu.apis.nodepool import (
+    Disruption,
+    NodeClaimSpec,
+    NodeClaimTemplateSpec,
+    NodePool,
+    NodePoolSpec,
+)
+from karpenter_tpu.apis.objects import (
+    Affinity,
+    Container,
+    ContainerPort,
+    DaemonSet,
+    LabelSelector,
+    Node,
+    NodeCondition,
+    NodeSelectorRequirement,
+    NodeSpec,
+    NodeStatus,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodAntiAffinity,
+    PodAffinityTerm,
+    PodSpec,
+    PodStatus,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+
+_seq = itertools.count()
+
+
+def _name(prefix: str, name: Optional[str]) -> str:
+    return name if name is not None else f"{prefix}-{next(_seq)}"
+
+
+def make_pod(
+    name: Optional[str] = None,
+    namespace: str = "default",
+    cpu: float = 0.0,
+    memory: float = 0.0,
+    requests: Optional[Dict[str, float]] = None,
+    labels: Optional[Dict[str, str]] = None,
+    annotations: Optional[Dict[str, str]] = None,
+    node_name: str = "",
+    node_selector: Optional[Dict[str, str]] = None,
+    tolerations: Sequence[Toleration] = (),
+    affinity: Optional[Affinity] = None,
+    topology_spread: Sequence[TopologySpreadConstraint] = (),
+    host_ports: Sequence[int] = (),
+    owner_kind: str = "",
+    owner_name: str = "",
+    phase: str = "Pending",
+    conditions=(),
+    priority: Optional[int] = None,
+    deletion_timestamp: Optional[float] = None,
+) -> Pod:
+    reqs = dict(requests or {})
+    if cpu:
+        reqs["cpu"] = cpu
+    if memory:
+        reqs["memory"] = memory
+    containers = [
+        Container(
+            requests=reqs,
+            ports=[ContainerPort(container_port=p, host_port=p) for p in host_ports],
+        )
+    ]
+    owners: List[OwnerReference] = []
+    if owner_kind:
+        owners.append(
+            OwnerReference(kind=owner_kind, name=owner_name or owner_kind.lower(),
+                           controller=True)
+        )
+    return Pod(
+        metadata=ObjectMeta(
+            name=_name("pod", name),
+            namespace=namespace,
+            labels=dict(labels or {}),
+            annotations=dict(annotations or {}),
+            owner_references=owners,
+            deletion_timestamp=deletion_timestamp,
+        ),
+        spec=PodSpec(
+            containers=containers,
+            node_name=node_name,
+            node_selector=dict(node_selector or {}),
+            tolerations=list(tolerations),
+            affinity=affinity,
+            topology_spread_constraints=list(topology_spread),
+            priority=priority,
+        ),
+        status=PodStatus(phase=phase, conditions=list(conditions)),
+    )
+
+
+def make_anti_affinity_pod(topology_key: str = wk.LABEL_HOSTNAME, **kw) -> Pod:
+    labels = kw.setdefault("labels", {"app": "x"})
+    kw["affinity"] = Affinity(
+        pod_anti_affinity=PodAntiAffinity(
+            required=[
+                PodAffinityTerm(
+                    topology_key=topology_key,
+                    label_selector=LabelSelector(match_labels=dict(labels)),
+                )
+            ]
+        )
+    )
+    return make_pod(**kw)
+
+
+def make_node(
+    name: Optional[str] = None,
+    provider_id: str = "",
+    capacity: Optional[Dict[str, float]] = None,
+    allocatable: Optional[Dict[str, float]] = None,
+    labels: Optional[Dict[str, str]] = None,
+    annotations: Optional[Dict[str, str]] = None,
+    taints: Sequence[Taint] = (),
+    ready: bool = True,
+    nodepool: Optional[str] = None,
+    registered: bool = False,
+    initialized: bool = False,
+    finalizers: Sequence[str] = (),
+) -> Node:
+    cap = dict(capacity or {"cpu": 16.0, "memory": 64 * 1024.0**3, "pods": 110.0})
+    alloc = dict(allocatable) if allocatable is not None else dict(cap)
+    lbls = dict(labels or {})
+    if nodepool is not None:
+        lbls[wk.NODEPOOL_LABEL_KEY] = nodepool
+    if registered:
+        lbls[wk.NODE_REGISTERED_LABEL_KEY] = "true"
+    if initialized:
+        lbls[wk.NODE_INITIALIZED_LABEL_KEY] = "true"
+    n = Node(
+        metadata=ObjectMeta(name=_name("node", name), namespace="", labels=lbls,
+                            annotations=dict(annotations or {}),
+                            finalizers=list(finalizers)),
+        spec=NodeSpec(provider_id=provider_id, taints=list(taints)),
+        status=NodeStatus(capacity=cap, allocatable=alloc),
+    )
+    n.metadata.labels.setdefault(wk.LABEL_HOSTNAME, n.metadata.name)
+    if ready:
+        n.status.conditions.append(NodeCondition(type="Ready", status="True"))
+    else:
+        n.status.conditions.append(NodeCondition(type="Ready", status="False"))
+    return n
+
+
+def make_nodeclaim(
+    name: Optional[str] = None,
+    nodepool: str = "default",
+    provider_id: str = "",
+    node_name: str = "",
+    capacity: Optional[Dict[str, float]] = None,
+    allocatable: Optional[Dict[str, float]] = None,
+    labels: Optional[Dict[str, str]] = None,
+    annotations: Optional[Dict[str, str]] = None,
+    requirements: Sequence[NodeSelectorRequirement] = (),
+    taints: Sequence[Taint] = (),
+    startup_taints: Sequence[Taint] = (),
+    launched: bool = False,
+    registered: bool = False,
+    initialized: bool = False,
+    finalizers: Sequence[str] = (),
+) -> NodeClaim:
+    lbls = dict(labels or {})
+    lbls.setdefault(wk.NODEPOOL_LABEL_KEY, nodepool)
+    claim = NodeClaim(
+        metadata=ObjectMeta(name=_name("nodeclaim", name), namespace="", labels=lbls,
+                            annotations=dict(annotations or {}),
+                            finalizers=list(finalizers)),
+        spec=NodeClaimSpec(requirements=list(requirements), taints=list(taints),
+                           startup_taints=list(startup_taints)),
+        status=NodeClaimStatus(
+            provider_id=provider_id,
+            node_name=node_name,
+            capacity=dict(capacity or {}),
+            allocatable=dict(allocatable if allocatable is not None else (capacity or {})),
+            conditions=ConditionSet(living=list(LIVING_CONDITIONS)),
+        ),
+    )
+    if launched:
+        claim.status.conditions.set_true(LAUNCHED)
+    if registered:
+        claim.status.conditions.set_true(REGISTERED)
+    if initialized:
+        claim.status.conditions.set_true(INITIALIZED)
+    return claim
+
+
+def make_nodepool(
+    name: str = "default",
+    weight: Optional[int] = None,
+    limits: Optional[Dict[str, float]] = None,
+    requirements: Sequence[NodeSelectorRequirement] = (),
+    taints: Sequence[Taint] = (),
+    startup_taints: Sequence[Taint] = (),
+    labels: Optional[Dict[str, str]] = None,
+    disruption: Optional[Disruption] = None,
+) -> NodePool:
+    pool = NodePool(
+        metadata=ObjectMeta(name=name, namespace=""),
+        spec=NodePoolSpec(
+            template=NodeClaimTemplateSpec(
+                labels=dict(labels or {}),
+                spec=NodeClaimSpec(requirements=list(requirements), taints=list(taints),
+                                   startup_taints=list(startup_taints)),
+            ),
+        ),
+    )
+    if weight is not None:
+        pool.spec.weight = weight
+    if limits is not None:
+        pool.spec.limits = limits
+    if disruption is not None:
+        pool.spec.disruption = disruption
+    return pool
+
+
+def make_daemonset(
+    name: Optional[str] = None,
+    namespace: str = "default",
+    cpu: float = 0.0,
+    memory: float = 0.0,
+    node_selector: Optional[Dict[str, str]] = None,
+    tolerations: Sequence[Toleration] = (),
+) -> DaemonSet:
+    reqs = {}
+    if cpu:
+        reqs["cpu"] = cpu
+    if memory:
+        reqs["memory"] = memory
+    return DaemonSet(
+        metadata=ObjectMeta(name=_name("daemonset", name), namespace=namespace),
+        pod_template_spec=PodSpec(
+            containers=[Container(requests=reqs)],
+            node_selector=dict(node_selector or {}),
+            tolerations=list(tolerations),
+        ),
+    )

@@ -1,0 +1,238 @@
+"""Cluster state cache suite (reference pkg/controllers/state/suite_test.go)."""
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodeclaim import NodeClaim
+from karpenter_tpu.apis.objects import NO_SCHEDULE, Node, Pod, Taint
+from karpenter_tpu.kube import KubeClient
+from karpenter_tpu.state import Cluster
+from karpenter_tpu.state.cluster import NOMINATION_WINDOW_SECONDS
+from karpenter_tpu.state.informer import start_informers
+from karpenter_tpu.utils.clock import FakeClock
+
+from tests.factories import make_daemonset, make_node, make_nodeclaim, make_pod
+
+
+def harness():
+    kube = KubeClient()
+    clock = FakeClock()
+    cluster = Cluster(kube, clock)
+    start_informers(kube, cluster)
+    return kube, clock, cluster
+
+
+def test_node_and_claim_link_by_provider_id():
+    kube, clock, cluster = harness()
+    claim = make_nodeclaim(name="c1", provider_id="pid-1", capacity={"cpu": 4.0})
+    kube.create(claim)
+    assert len(cluster.nodes()) == 1
+    assert cluster.nodes()[0].node is None
+    # the node registers with the same providerID: same StateNode, fused view
+    node = make_node(name="n1", provider_id="pid-1", nodepool="default")
+    kube.create(node)
+    snap = cluster.nodes()
+    assert len(snap) == 1
+    assert snap[0].node is not None and snap[0].node_claim is not None
+    assert snap[0].name == "n1"
+
+
+def test_claim_gains_provider_id_rekeys():
+    kube, clock, cluster = harness()
+    claim = make_nodeclaim(name="c1")
+    kube.create(claim)
+    got = kube.get(NodeClaim, "c1", "")
+    got.status.provider_id = "pid-9"
+    kube.update(got)
+    assert len(cluster.nodes()) == 1
+    assert cluster.node_for_claim("c1").provider_id == "pid-9"
+
+
+def test_pod_binding_accounting():
+    kube, clock, cluster = harness()
+    kube.create(make_node(name="n1", provider_id="p1", capacity={"cpu": 8.0}))
+    kube.create(make_pod(name="a", cpu=2.0, node_name="n1", phase="Running"))
+    kube.create(make_pod(name="b", cpu=1.5, node_name="n1", phase="Running"))
+    sn = cluster.node_for_name("n1")
+    assert sn.available()["cpu"] == 8.0 - 3.5
+    # pod deletion releases its share
+    kube.delete(Pod, "a")
+    assert cluster.node_for_name("n1").available()["cpu"] == 8.0 - 1.5
+
+
+def test_pod_rebinding_moves_usage():
+    kube, clock, cluster = harness()
+    kube.create(make_node(name="n1", provider_id="p1", capacity={"cpu": 8.0}))
+    kube.create(make_node(name="n2", provider_id="p2", capacity={"cpu": 8.0}))
+    kube.create(make_pod(name="a", cpu=2.0, node_name="n1", phase="Running"))
+    p = kube.get(Pod, "a")
+    p.spec.node_name = "n2"
+    kube.update(p)
+    assert cluster.node_for_name("n1").available()["cpu"] == 8.0
+    assert cluster.node_for_name("n2").available()["cpu"] == 6.0
+
+
+def test_pod_bound_to_unknown_node_creates_shell():
+    kube, clock, cluster = harness()
+    kube.create(make_pod(name="a", cpu=1.0, node_name="ghost", phase="Running"))
+    assert cluster.pods_bound_to("ghost") == ["default/a"]
+
+
+def test_terminal_pods_not_tracked():
+    kube, clock, cluster = harness()
+    kube.create(make_node(name="n1", provider_id="p1", capacity={"cpu": 8.0}))
+    kube.create(make_pod(name="done", cpu=4.0, node_name="n1", phase="Succeeded"))
+    assert cluster.node_for_name("n1").available()["cpu"] == 8.0
+
+
+def test_daemonset_pod_split_accounting():
+    kube, clock, cluster = harness()
+    kube.create(make_node(name="n1", provider_id="p1", capacity={"cpu": 8.0}))
+    kube.create(
+        make_pod(name="ds-pod", cpu=1.0, node_name="n1", phase="Running",
+                 owner_kind="DaemonSet", owner_name="logger")
+    )
+    sn = cluster.node_for_name("n1")
+    assert sn.daemonset_request_total()["cpu"] == 1.0
+    assert sn.pod_request_total()["cpu"] == 1.0
+
+
+def test_taints_prefer_claim_until_initialized():
+    kube, clock, cluster = harness()
+    startup = Taint(key="example.com/starting", effect=NO_SCHEDULE)
+    real = Taint(key="example.com/dedicated", effect=NO_SCHEDULE)
+    claim = make_nodeclaim(name="c1", provider_id="pid", taints=[real],
+                           startup_taints=[startup])
+    kube.create(claim)
+    node = make_node(name="n1", provider_id="pid", nodepool="default",
+                     taints=[real, startup, Taint(key=wk.TAINT_NODE_NOT_READY)])
+    kube.create(node)
+    sn = cluster.node_for_name("n1")
+    # not initialized: claim taints minus startup taints
+    assert list(sn.taints()) == [real]
+    node = kube.get(Node, "n1", "")
+    node.metadata.labels[wk.NODE_INITIALIZED_LABEL_KEY] = "true"
+    node.spec.taints = [real, startup]
+    kube.update(node)
+    sn = cluster.node_for_name("n1")
+    # initialized: node taints verbatim (startup taint no longer carved out)
+    assert list(sn.taints()) == [real, startup]
+
+
+def test_capacity_from_claim_until_registered():
+    kube, clock, cluster = harness()
+    kube.create(make_nodeclaim(name="c1", provider_id="pid", capacity={"cpu": 4.0}))
+    kube.create(make_node(name="n1", provider_id="pid", capacity={}, nodepool="default"))
+    assert cluster.node_for_name("n1").capacity()["cpu"] == 4.0
+    n = kube.get(Node, "n1", "")
+    n.metadata.labels[wk.NODE_REGISTERED_LABEL_KEY] = "true"
+    n.status.capacity = {"cpu": 4.2}
+    kube.update(n)
+    assert cluster.node_for_name("n1").capacity()["cpu"] == 4.2
+
+
+def test_synced_gate():
+    kube = KubeClient()
+    clock = FakeClock()
+    kube.create(make_nodeclaim(name="c1", provider_id="pid"))
+    cluster = Cluster(kube, clock)
+    assert not cluster.synced()  # informers not started: store ahead of cache
+    start_informers(kube, cluster)  # replay catches up
+    assert cluster.synced()
+
+
+def test_nomination_window_expires():
+    kube, clock, cluster = harness()
+    kube.create(make_node(name="n1", provider_id="p1"))
+    cluster.nominate_node_for_pod("n1")
+    assert cluster.is_nominated("n1")
+    clock.step(NOMINATION_WINDOW_SECONDS + 1)
+    assert not cluster.is_nominated("n1")
+
+
+def test_nomination_cleared_when_pod_binds():
+    kube, clock, cluster = harness()
+    kube.create(make_node(name="n1", provider_id="p1"))
+    cluster.nominate_node_for_pod("n1")
+    kube.create(make_pod(name="a", cpu=0.5, node_name="n1", phase="Running"))
+    assert not cluster.is_nominated("n1")
+
+
+def test_mark_for_deletion_roundtrip():
+    kube, clock, cluster = harness()
+    kube.create(make_node(name="n1", provider_id="p1"))
+    cluster.mark_for_deletion("p1")
+    assert cluster.nodes()[0].marked_for_deletion()
+    cluster.unmark_for_deletion("p1")
+    assert not cluster.nodes()[0].marked_for_deletion()
+
+
+def test_deleting_node_is_marked_for_deletion():
+    kube, clock, cluster = harness()
+    kube.create(make_node(name="n1", provider_id="p1", finalizers=["karpenter.tpu/termination"]))
+    kube.delete(Node, "n1", "")
+    assert cluster.nodes()[0].marked_for_deletion()
+
+
+def test_anti_affinity_pod_tracking():
+    from tests.factories import make_anti_affinity_pod
+
+    kube, clock, cluster = harness()
+    pod = make_anti_affinity_pod(name="aa", cpu=0.1)
+    kube.create(pod)
+    assert [p.metadata.name for p in cluster.anti_affinity_pods()] == ["aa"]
+    kube.delete(Pod, "aa")
+    assert cluster.anti_affinity_pods() == []
+
+
+def test_daemonset_template_tracking():
+    kube, clock, cluster = harness()
+    ds = make_daemonset(name="logger", cpu=0.5)
+    kube.create(ds)
+    pods = cluster.daemonset_pods()
+    assert len(pods) == 1
+    assert pods[0].spec.containers[0].requests["cpu"] == 0.5
+
+
+def test_consolidation_state_timestamps():
+    kube, clock, cluster = harness()
+    cluster.mark_consolidated()
+    assert cluster.consolidated()
+    # any cluster change invalidates
+    kube.create(make_node(name="n1", provider_id="p1"))
+    assert not cluster.consolidated()
+    cluster.mark_consolidated()
+    clock.step(301)
+    assert not cluster.consolidated()  # forced 5-minute revisit
+
+
+def test_rekey_merges_pod_bookkeeping():
+    # pod bound to the node arrives before the Node object; the NodeClaim
+    # already holds state under the providerID key — the shell's usage must
+    # survive the merge
+    kube, clock, cluster = harness()
+    kube.create(make_nodeclaim(name="c1", provider_id="pid-1", capacity={"cpu": 8.0}))
+    kube.create(make_pod(name="a", cpu=3.0, node_name="n1", phase="Running"))
+    kube.create(make_node(name="n1", provider_id="pid-1", nodepool="default",
+                          capacity={"cpu": 8.0}))
+    assert len(cluster.nodes()) == 1
+    assert cluster.node_for_name("n1").available()["cpu"] == 5.0
+    assert cluster.pods_bound_to("n1") == ["default/a"]
+
+
+def test_status_update_of_bound_pod_keeps_nomination():
+    kube, clock, cluster = harness()
+    kube.create(make_node(name="n1", provider_id="p1"))
+    kube.create(make_pod(name="q", cpu=0.5, node_name="n1", phase="Running"))
+    cluster.nominate_node_for_pod("n1")
+    p = kube.get(Pod, "q")
+    p.status.phase = "Running"
+    kube.update(p)  # status-only churn must not spend the nomination
+    assert cluster.is_nominated("n1")
+
+
+def test_node_deletion_drops_state_and_bindings():
+    kube, clock, cluster = harness()
+    kube.create(make_node(name="n1", provider_id="p1"))
+    kube.create(make_pod(name="a", cpu=1.0, node_name="n1", phase="Running"))
+    kube.delete(Node, "n1", "")
+    assert cluster.nodes() == []
+    assert cluster.pods_bound_to("n1") == []
