@@ -1,1 +1,1 @@
-from karpenter_tpu.events.recorder import Event, Recorder  # noqa: F401
+from karpenter_tpu.events.recorder import Event, Recorder, object_event  # noqa: F401

@@ -1,0 +1,41 @@
+"""Provisioning trigger controller + singleton loop.
+
+Equivalent of reference pkg/controllers/provisioning/controller.go: a watch on
+Pods fires the batcher whenever a provisionable pod appears; the singleton
+loop waits out the batch window and runs one Provisioner.reconcile
+(singleton.go:81, provisioner.go:106-137).
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.apis.objects import Pod
+from karpenter_tpu.kube.client import DELETED, KubeClient
+from karpenter_tpu.provisioning.batcher import Batcher
+from karpenter_tpu.provisioning.provisioner import Provisioner
+from karpenter_tpu.utils import pod as podutil
+
+
+def watch_pods(kube: KubeClient, batcher: Batcher) -> None:
+    """Register the pod-watch trigger (provisioning/controller.go:58-67)."""
+
+    def on_pod(event: str, pod: Pod):
+        if event == DELETED:
+            return
+        if podutil.is_provisionable(pod):
+            batcher.trigger()
+
+    kube.watch(Pod, on_pod, replay=True)
+
+
+class ProvisioningLoop:
+    """The singleton reconciler: wait for a batch, then run one pass."""
+
+    def __init__(self, provisioner: Provisioner, batcher: Batcher):
+        self.provisioner = provisioner
+        self.batcher = batcher
+
+    def run_once(self):
+        """Returns the ProvisioningPass, or None when no batch formed."""
+        if not self.batcher.wait():
+            return None
+        return self.provisioner.reconcile()

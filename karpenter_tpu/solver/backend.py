@@ -62,5 +62,8 @@ class SolverBackend(abc.ABC):
         templates: Sequence[TemplateInfo],
         nodes: Sequence[NodeInfo] = (),
         pod_requirements_override: Optional[Sequence[Requirements]] = None,
+        topology=None,  # Optional[Topology]: caller-owned group state to clone
+        cluster_pods: Sequence = (),  # (Pod, node labels) pairs for the census
+        domains: Optional[Dict[str, set]] = None,  # per-key domain universe
     ) -> SolveResult:
         ...

@@ -1,0 +1,270 @@
+"""Provisioning suite (reference pkg/controllers/provisioning/suite_test.go).
+
+Drives the full provisioner reconcile path through the Env harness: pending
+pods in, NodeClaims out, with limits, weights, daemonset overhead, taints,
+existing-capacity reuse, relaxation, and the batching trigger.
+"""
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodeclaim import NodeClaim
+from karpenter_tpu.apis.objects import (
+    Affinity,
+    NodeAffinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Pod,
+    PreferredSchedulingTerm,
+    Taint,
+    Toleration,
+)
+from karpenter_tpu.provisioning.batcher import Batcher
+from karpenter_tpu.provisioning.controller import watch_pods
+from karpenter_tpu.provisioning.provisioner import ValidationError, validate_pod
+from karpenter_tpu.utils.clock import FakeClock
+
+from tests.factories import make_daemonset, make_node, make_nodepool, make_pod
+from tests.harness import Env
+
+
+def test_provisions_claim_for_pending_pod():
+    env = Env()
+    env.create(make_nodepool())
+    pod = make_pod(name="p1", cpu=1.0)
+    env.expect_provisioned(pod)
+    assert len(env.nodeclaims()) == 1
+    node = env.expect_scheduled(pod)
+    claim = env.nodeclaims()[0]
+    assert claim.metadata.labels[wk.NODEPOOL_LABEL_KEY] == "default"
+    assert claim.spec.resource_requests["cpu"] >= 1.0
+    assert node == claim.status.node_name
+
+
+def test_no_nodepool_no_claims():
+    env = Env()
+    pod = make_pod(name="p1", cpu=1.0)
+    env.expect_provisioned(pod)
+    assert env.nodeclaims() == []
+    env.expect_not_scheduled(pod)
+
+
+def test_packs_multiple_small_pods_onto_one_claim():
+    env = Env()
+    env.create(make_nodepool())
+    pods = [make_pod(cpu=0.5) for _ in range(4)]
+    env.expect_provisioned(*pods)
+    assert len(env.nodeclaims()) == 1
+    assert len({env.expect_scheduled(p) for p in pods}) == 1
+
+
+def test_reuses_existing_capacity_before_opening_claims():
+    env = Env()
+    env.create(make_nodepool())
+    env.create(make_node(name="n1", provider_id="p1", nodepool="default",
+                         capacity={"cpu": 8.0, "memory": 64 * 1024.0**3, "pods": 110.0},
+                         registered=True, initialized=True))
+    pod = make_pod(name="p1", cpu=1.0)
+    env.expect_provisioned(pod)
+    assert env.nodeclaims() == []
+    assert env.expect_scheduled(pod) == "n1"
+    # the nomination protected the node until the pod landed, then was spent
+    assert env.recorder.count("Nominated") == 1
+    assert not env.cluster.is_nominated("n1")
+
+
+def test_skips_unschedulable_pod_and_reports_event():
+    env = Env()
+    env.create(make_nodepool())
+    pod = make_pod(name="p1", cpu=10_000.0)
+    env.expect_provisioned(pod)
+    assert env.nodeclaims() == []
+    assert env.recorder.count("FailedScheduling") == 1
+
+
+def test_nodepool_limits_cap_claims():
+    env = Env()
+    env.create(make_nodepool(limits={"cpu": 2.0}))
+    pods = [make_pod(cpu=1.5) for _ in range(3)]
+    env.expect_provisioned(*pods)
+    # only one 1.5-cpu claim fits under the 2-cpu limit
+    assert len(env.nodeclaims()) == 1
+
+
+def test_nodepool_weight_orders_templates():
+    env = Env()
+    env.create(make_nodepool(name="light", weight=1))
+    env.create(make_nodepool(name="heavy", weight=100))
+    pod = make_pod(cpu=1.0)
+    env.expect_provisioned(pod)
+    claims = env.nodeclaims()
+    assert len(claims) == 1
+    assert claims[0].metadata.labels[wk.NODEPOOL_LABEL_KEY] == "heavy"
+
+
+def test_taints_need_toleration():
+    env = Env()
+    env.create(make_nodepool(taints=[Taint(key="dedicated", value="gpu")]))
+    intolerant = make_pod(name="intolerant", cpu=1.0)
+    tolerant = make_pod(
+        name="tolerant", cpu=1.0,
+        tolerations=[Toleration(key="dedicated", operator="Equal", value="gpu")],
+    )
+    env.expect_provisioned(intolerant, tolerant)
+    assert len(env.nodeclaims()) == 1
+    env.expect_scheduled(tolerant)
+    env.expect_not_scheduled(intolerant)
+
+
+def test_daemonset_overhead_reserved_on_new_claims():
+    env = Env()
+    env.create(make_nodepool())
+    env.create(make_daemonset(name="logger", cpu=1.0))
+    pod = make_pod(name="p1", cpu=1.0)
+    env.expect_provisioned(pod)
+    claim = env.nodeclaims()[0]
+    assert claim.spec.resource_requests["cpu"] >= 2.0  # pod + daemon
+
+
+def test_node_selector_restricts_pool():
+    env = Env()
+    env.create(make_nodepool(name="amd", labels={"cpu-family": "amd"}))
+    env.create(make_nodepool(name="intel", labels={"cpu-family": "intel"}))
+    pod = make_pod(cpu=1.0, node_selector={"cpu-family": "intel"})
+    env.expect_provisioned(pod)
+    claims = env.nodeclaims()
+    assert len(claims) == 1
+    assert claims[0].metadata.labels[wk.NODEPOOL_LABEL_KEY] == "intel"
+
+
+def test_preferred_affinity_relaxes_when_unsatisfiable():
+    env = Env()
+    env.create(make_nodepool())
+    pod = make_pod(
+        cpu=1.0,
+        affinity=Affinity(
+            node_affinity=NodeAffinity(
+                preferred=[
+                    PreferredSchedulingTerm(
+                        weight=1,
+                        preference=NodeSelectorTerm(
+                            match_expressions=[
+                                NodeSelectorRequirement(
+                                    wk.LABEL_TOPOLOGY_ZONE, "In", ["no-such-zone"]
+                                )
+                            ]
+                        ),
+                    )
+                ]
+            )
+        ),
+    )
+    env.expect_provisioned(pod)
+    assert len(env.nodeclaims()) == 1
+    env.expect_scheduled(pod)
+
+
+def test_deleting_node_pods_get_replacement_capacity():
+    env = Env()
+    env.create(make_nodepool())
+    env.create(make_node(name="n1", provider_id="p1", nodepool="default",
+                         registered=True, initialized=True))
+    victim = make_pod(name="victim", cpu=1.0, node_name="n1", phase="Running")
+    env.create(victim)
+    env.cluster.mark_for_deletion("p1")
+    pass_ = env.provisioner.reconcile()
+    # the deleting node is no bin; a replacement claim covers the victim
+    assert len(pass_.created) == 1
+
+
+def test_claim_requirements_cap_instance_types_by_price():
+    env = Env()
+    env.create(make_nodepool())
+    pod = make_pod(cpu=1.0)
+    env.expect_provisioned(pod)
+    claim = env.nodeclaims()[0]
+    it_req = next(
+        r for r in claim.spec.requirements if r.key == wk.LABEL_INSTANCE_TYPE_STABLE
+    )
+    assert 0 < len(it_req.values) <= 100
+
+
+def test_nodepool_hash_annotation_stamped():
+    env = Env()
+    pool = make_nodepool()
+    env.create(pool)
+    env.expect_provisioned(make_pod(cpu=1.0))
+    claim = env.nodeclaims()[0]
+    assert claim.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] == pool.hash()
+
+
+def test_validate_pod_rejects_malformed():
+    with pytest.raises(ValidationError):
+        validate_pod(make_pod(node_selector={wk.LABEL_HOSTNAME: "pin"}))
+    from karpenter_tpu.apis.objects import TopologySpreadConstraint
+
+    with pytest.raises(ValidationError):
+        validate_pod(
+            make_pod(topology_spread=[
+                TopologySpreadConstraint(max_skew=0, topology_key="zone")
+            ])
+        )
+
+
+def test_validation_failure_excludes_pod_but_not_batch():
+    env = Env()
+    env.create(make_nodepool())
+    bad = make_pod(name="bad", cpu=1.0, node_selector={wk.LABEL_HOSTNAME: "pin"})
+    good = make_pod(name="good", cpu=1.0)
+    env.expect_provisioned(bad, good)
+    env.expect_scheduled(good)
+    env.expect_not_scheduled(bad)
+    assert env.recorder.count("FailedValidation") == 1
+
+
+def test_batcher_window():
+    clock = FakeClock()
+    b = Batcher(clock, idle_duration=1.0, max_duration=10.0)
+    b.trigger()
+    assert b.wait()  # FakeClock.sleep steps time, so the window closes
+
+
+def test_pod_watch_triggers_batcher():
+    env = Env()
+    clock = FakeClock()
+    b = Batcher(clock)
+    watch_pods(env.kube, b)
+    assert not b._trigger.is_set()
+    env.create(make_pod(cpu=1.0))
+    assert b._trigger.is_set()
+    # bound pods don't trigger
+    b._trigger.clear()
+    env.create(make_pod(cpu=1.0, node_name="n1", phase="Running"))
+    assert not b._trigger.is_set()
+
+
+def test_full_pass_through_jax_backend():
+    from karpenter_tpu.solver.jax_backend import JaxSolver
+
+    env = Env(solver=JaxSolver())
+    env.create(make_nodepool())
+    env.create(make_node(name="n1", provider_id="p1", nodepool="default",
+                         capacity={"cpu": 2.0, "memory": 8 * 1024.0**3, "pods": 110.0},
+                         registered=True, initialized=True))
+    pods = [make_pod(cpu=1.0) for _ in range(4)]
+    env.expect_provisioned(*pods)
+    # 2 cpu of existing capacity + one new claim for the remainder
+    for p in pods:
+        env.expect_scheduled(p)
+    assert len(env.nodeclaims()) >= 1
+
+
+def test_second_reconcile_is_idempotent():
+    env = Env()
+    env.create(make_nodepool())
+    pod = make_pod(cpu=1.0)
+    env.expect_provisioned(pod)
+    assert len(env.nodeclaims()) == 1
+    pass2 = env.provisioner.reconcile()
+    assert pass2.created == []
+    assert len(env.nodeclaims()) == 1
