@@ -1,0 +1,3 @@
+from karpenter_tpu.disruption.types import Candidate, Command, DECISION_DELETE, DECISION_NONE, DECISION_REPLACE
+
+__all__ = ["Candidate", "Command", "DECISION_DELETE", "DECISION_NONE", "DECISION_REPLACE"]

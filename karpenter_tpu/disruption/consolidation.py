@@ -1,0 +1,211 @@
+"""Consolidation methods.
+
+Equivalent of reference pkg/controllers/disruption/{consolidation,
+emptynodeconsolidation,multinodeconsolidation,singlenodeconsolidation,
+validation}.go: the shared simulate-and-price core (consolidation.go:113-194),
+the empty-node batch path, the multi-node binary search
+(multinodeconsolidation.go:87-137), the single-node linear scan, and the
+15-second revalidation TTL (consolidation.go:42, validation.go:68-110).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from karpenter_tpu.apis.nodepool import (
+    CONSOLIDATION_POLICY_WHEN_UNDERUTILIZED,
+)
+from karpenter_tpu.disruption.helpers import (
+    filter_replacement_instance_types,
+    get_candidates,
+    simulate_scheduling,
+)
+from karpenter_tpu.disruption.types import Candidate, Command, DECISION_NONE
+from karpenter_tpu.provisioning.provisioner import Provisioner
+
+CONSOLIDATION_TTL_SECONDS = 15.0  # consolidation.go:42
+MULTI_NODE_MAX_CANDIDATES = 100  # multinodeconsolidation.go:34
+MULTI_NODE_TIMEOUT_SECONDS = 60.0  # multinodeconsolidation.go:57-59
+SINGLE_NODE_TIMEOUT_SECONDS = 180.0  # singlenodeconsolidation.go:29
+
+
+def sort_candidates(candidates: Sequence[Candidate]) -> List[Candidate]:
+    """Cheapest-to-disrupt first (types.go disruptionCost ordering)."""
+    return sorted(candidates, key=lambda c: c.disruption_cost)
+
+
+def apply_budgets(
+    candidates: Sequence[Candidate], budgets: Dict[str, int]
+) -> List[Candidate]:
+    """Keep at most the budgeted number of candidates per nodepool, in the
+    given priority order."""
+    taken: Dict[str, int] = {}
+    out = []
+    for c in candidates:
+        pool = c.nodepool.name
+        if taken.get(pool, 0) >= budgets.get(pool, 0):
+            continue
+        taken[pool] = taken.get(pool, 0) + 1
+        out.append(c)
+    return out
+
+
+class ConsolidationBase:
+    """Shared gate + simulate-and-price core."""
+
+    method_name = "consolidation"
+    consolidation_type = ""
+
+    def __init__(self, provisioner: Provisioner, clock):
+        self.provisioner = provisioner
+        self.clock = clock
+
+    def should_disrupt(self, candidate: Candidate) -> bool:
+        """Policy gate (consolidation.go ShouldDisrupt): only pools asking for
+        WhenUnderutilized consolidation participate."""
+        return (
+            candidate.nodepool.spec.disruption.consolidation_policy
+            == CONSOLIDATION_POLICY_WHEN_UNDERUTILIZED
+        )
+
+    def compute_consolidation(self, candidates: Sequence[Candidate]) -> Command:
+        """Simulate removing the candidates; allow at most one replacement,
+        and only when it is strictly cheaper (consolidation.go:113-194)."""
+        if not candidates:
+            return Command(method=self.method_name)
+        sim = simulate_scheduling(self.provisioner, candidates)
+        if sim is None or not sim.all_candidate_pods_scheduled():
+            return Command(method=self.method_name)
+        if len(sim.result.new_claims) > 1:
+            # a multi-replacement trade is never a consolidation win
+            return Command(method=self.method_name)
+        if not filter_replacement_instance_types(sim, candidates):
+            return Command(method=self.method_name)
+        replacements = []
+        for placement in sim.result.new_claims:
+            np_obj = sim.inputs.nodepools.get(placement.nodepool_name)
+            if np_obj is None:
+                return Command(method=self.method_name)
+            replacements.append(
+                self.provisioner._to_node_claim(placement, sim.inputs, np_obj)
+            )
+        return Command(
+            candidates=list(candidates),
+            replacements=replacements,
+            method=self.method_name,
+            consolidation_type=self.consolidation_type,
+        )
+
+    # -- validation (validation.go:68-110) ------------------------------------
+
+    def validate(self, command: Command, kube, cluster, cloud_provider) -> bool:
+        """Re-verify after the TTL: every candidate must still be eligible,
+        and a delete-only decision must re-simulate against the candidates'
+        *fresh* pod lists (validation.go:68-110)."""
+        if command.decision == DECISION_NONE:
+            return False
+        self.clock.sleep(CONSOLIDATION_TTL_SECONDS)
+        fresh = {
+            c.name: c
+            for c in get_candidates(
+                self.clock, kube, cluster, cloud_provider, self.should_disrupt
+            )
+        }
+        refreshed = []
+        for c in command.candidates:
+            now = fresh.get(c.name)
+            if now is None or cluster.is_nominated(c.name):
+                return False
+            refreshed.append(now)
+        if not command.replacements and any(not c.is_empty() for c in refreshed):
+            # nodes may have gained pods during the TTL; the free-drain claim
+            # must hold against what is on them NOW
+            recheck = self.compute_consolidation(refreshed)
+            return recheck.decision == command.decision
+        return True
+
+
+class EmptyNodeConsolidation(ConsolidationBase):
+    """Delete every empty underutilized node in one command
+    (emptynodeconsolidation.go:40-92)."""
+
+    method_name = "empty-node-consolidation"
+    consolidation_type = "empty"
+
+    def compute_command(
+        self, budgets: Dict[str, int], candidates: Sequence[Candidate]
+    ) -> Command:
+        empty = [c for c in sort_candidates(candidates) if c.is_empty()]
+        empty = apply_budgets(empty, budgets)
+        if not empty:
+            return Command(method=self.method_name)
+        return Command(
+            candidates=empty, method=self.method_name,
+            consolidation_type=self.consolidation_type,
+        )
+
+    def validate(self, command: Command, kube, cluster, cloud_provider) -> bool:
+        if command.decision == DECISION_NONE:
+            return False
+        self.clock.sleep(CONSOLIDATION_TTL_SECONDS)
+        fresh = {
+            c.name: c
+            for c in get_candidates(
+                self.clock, kube, cluster, cloud_provider, self.should_disrupt
+            )
+        }
+        return all(
+            c.name in fresh and fresh[c.name].is_empty() and not cluster.is_nominated(c.name)
+            for c in command.candidates
+        )
+
+
+class MultiNodeConsolidation(ConsolidationBase):
+    """Binary search for the largest prefix of (cost-sorted) candidates that
+    consolidates simultaneously (multinodeconsolidation.go:87-137)."""
+
+    method_name = "multi-node-consolidation"
+    consolidation_type = "multi"
+
+    def compute_command(
+        self, budgets: Dict[str, int], candidates: Sequence[Candidate]
+    ) -> Command:
+        ordered = apply_budgets(sort_candidates(candidates), budgets)
+        ordered = ordered[:MULTI_NODE_MAX_CANDIDATES]
+        if not ordered:
+            return Command(method=self.method_name)
+        deadline = self.clock.now() + MULTI_NODE_TIMEOUT_SECONDS
+        best = Command(method=self.method_name)
+        lo, hi = 1, len(ordered)
+        while lo <= hi:
+            if self.clock.now() >= deadline:
+                break
+            mid = (lo + hi) // 2
+            cmd = self.compute_consolidation(ordered[:mid])
+            if cmd.decision != DECISION_NONE:
+                best = cmd
+                lo = mid + 1
+            else:
+                hi = mid - 1
+        return best
+
+
+class SingleNodeConsolidation(ConsolidationBase):
+    """Linear scan, first consolidatable candidate wins
+    (singlenodeconsolidation.go:42-88)."""
+
+    method_name = "single-node-consolidation"
+    consolidation_type = "single"
+
+    def compute_command(
+        self, budgets: Dict[str, int], candidates: Sequence[Candidate]
+    ) -> Command:
+        ordered = apply_budgets(sort_candidates(candidates), budgets)
+        deadline = self.clock.now() + SINGLE_NODE_TIMEOUT_SECONDS
+        for c in ordered:
+            if self.clock.now() >= deadline:
+                break
+            cmd = self.compute_consolidation([c])
+            if cmd.decision != DECISION_NONE:
+                return cmd
+        return Command(method=self.method_name)

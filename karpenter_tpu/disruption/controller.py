@@ -1,0 +1,159 @@
+"""Disruption controller — one action per pass, methods in priority order.
+
+Equivalent of reference pkg/controllers/disruption/controller.go: the 10-second
+singleton poll runs Expiration → Drift → Emptiness → EmptyNodeConsolidation →
+MultiNodeConsolidation → SingleNodeConsolidation (controller.go:72-85), takes
+the first method that produces a command, validates it, and executes: taint
+the candidates, launch replacements, mark for deletion, and hand the command
+to the orchestration queue (controller.go:142-213).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from karpenter_tpu.cloudprovider.types import CloudProvider
+from karpenter_tpu.disruption.consolidation import (
+    EmptyNodeConsolidation,
+    MultiNodeConsolidation,
+    SingleNodeConsolidation,
+)
+from karpenter_tpu.disruption.helpers import (
+    build_disruption_budget_mapping,
+    build_nodepool_map,
+    get_candidates,
+)
+from karpenter_tpu.disruption.methods import Drift, Emptiness, Expiration
+from karpenter_tpu.disruption.orchestration import Queue, set_disruption_taint
+from karpenter_tpu.disruption.types import Command, DECISION_NONE
+from karpenter_tpu.events import Recorder, object_event
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.metrics import REGISTRY, measure
+from karpenter_tpu.provisioning.provisioner import Provisioner
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.statenode import disruption_taint
+from karpenter_tpu.utils.clock import Clock
+
+POLL_PERIOD_SECONDS = 10.0  # controller.go:56
+
+EVALUATION_DURATION = REGISTRY.histogram(
+    "disruption_evaluation_duration_seconds",
+    "Duration of one disruption evaluation pass",
+    subsystem="disruption",
+)
+ELIGIBLE_NODES = REGISTRY.gauge(
+    "disruption_eligible_nodes", "Eligible candidates at last pass",
+    subsystem="disruption",
+)
+
+
+class Controller:
+    def __init__(
+        self,
+        kube: KubeClient,
+        cluster: Cluster,
+        provisioner: Provisioner,
+        cloud_provider: CloudProvider,
+        clock: Clock,
+        recorder: Recorder,
+        queue: Optional[Queue] = None,
+    ):
+        self.kube = kube
+        self.cluster = cluster
+        self.provisioner = provisioner
+        self.cloud_provider = cloud_provider
+        self.clock = clock
+        self.recorder = recorder
+        self.queue = queue if queue is not None else Queue(kube, cluster, clock, recorder)
+        self.methods = [
+            Expiration(provisioner, clock),
+            Drift(provisioner, clock),
+            Emptiness(provisioner, clock),
+            EmptyNodeConsolidation(provisioner, clock),
+            MultiNodeConsolidation(provisioner, clock),
+            SingleNodeConsolidation(provisioner, clock),
+        ]
+
+    def reconcile(self) -> Optional[Command]:
+        """One pass: first method that produces a validated command wins
+        (controller.go:97-171). Returns the executed command, if any."""
+        if not self.cluster.synced():
+            return None
+        self._cleanup_orphaned_taints()
+        self.queue.reconcile()
+        nodepool_map = build_nodepool_map(self.kube, self.cloud_provider)
+        nodepools = nodepool_map[0]
+        evaluated_consolidation = False
+        for method in self.methods:
+            if self._consolidated_gate(method):
+                continue
+            if isinstance(
+                method,
+                (EmptyNodeConsolidation, MultiNodeConsolidation, SingleNodeConsolidation),
+            ):
+                evaluated_consolidation = True
+            candidates = get_candidates(
+                self.clock, self.kube, self.cluster, self.cloud_provider,
+                method.should_disrupt, nodepool_map=nodepool_map,
+            )
+            ELIGIBLE_NODES.set(len(candidates), labels={"method": method.method_name})
+            if not candidates:
+                continue
+            budgets = build_disruption_budget_mapping(
+                self.clock, self.cluster, nodepools
+            )
+            with measure(EVALUATION_DURATION, labels={"method": method.method_name}):
+                command = method.compute_command(budgets, candidates)
+            if command.decision == DECISION_NONE:
+                continue
+            if not method.validate(
+                command, self.kube, self.cluster, self.cloud_provider
+            ):
+                continue
+            self._execute(command)
+            return command
+        # remember a full no-op evaluation until state changes — but only when
+        # the consolidation methods actually ran: re-marking on gated passes
+        # would reset the 5-minute forced-revisit window forever
+        if evaluated_consolidation:
+            self.cluster.mark_consolidated()
+        return None
+
+    def _consolidated_gate(self, method) -> bool:
+        """Consolidation methods skip evaluation while the cluster is in a
+        known-consolidated state (cluster.go:299-325)."""
+        is_consolidation = isinstance(
+            method, (EmptyNodeConsolidation, MultiNodeConsolidation, SingleNodeConsolidation)
+        )
+        return is_consolidation and self.cluster.consolidated()
+
+    def _cleanup_orphaned_taints(self) -> None:
+        """A crash between taint and queue leaves nodes tainted with no
+        in-flight command; untaint them (controller.go:106-118)."""
+        taint = disruption_taint()
+        for sn in self.cluster.nodes():
+            if sn.node is None:
+                continue
+            if sn.marked_for_deletion() or self.queue.has_any(sn.provider_id):
+                continue
+            if any(t.match(taint) for t in sn.node.spec.taints):
+                set_disruption_taint(self.kube, sn.name, add=False)
+
+    def _execute(self, command: Command) -> None:
+        """Taint → launch replacements → mark deleting → enqueue
+        (controller.go:177-213)."""
+        for c in command.candidates:
+            set_disruption_taint(self.kube, c.name, add=True)
+        for claim in command.replacements:
+            self.kube.create(claim)
+        self.cluster.mark_for_deletion(*[c.provider_id for c in command.candidates])
+        self.queue.add(command)
+        for c in command.candidates:
+            if c.node_claim is not None:
+                self.recorder.publish(
+                    object_event(
+                        c.node_claim, "Normal", "DisruptionLaunching",
+                        f"{command.method}: disrupting node {c.name} "
+                        f"({command.decision})",
+                    )
+                )

@@ -1,0 +1,219 @@
+"""Disruption shared machinery.
+
+Equivalent of reference pkg/controllers/disruption/helpers.go: candidate
+collection, the scheduling simulation every consolidation probe runs
+(helpers.go:73-127), nodepool/instance-type maps, disruption budgets, and the
+price filter with its spot rules (helpers.go:160-169, consolidation.go:163-188).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodepool import NodePool
+from karpenter_tpu.apis.objects import Pod
+from karpenter_tpu.cloudprovider.types import CloudProvider, InstanceType
+from karpenter_tpu.disruption.types import Candidate, IneligibleError, new_candidate
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.provisioning.provisioner import Provisioner, SchedulerInputs
+from karpenter_tpu.solver.backend import SolveResult
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.utils.clock import Clock
+
+
+def build_nodepool_map(
+    kube: KubeClient, cloud_provider: CloudProvider
+) -> Tuple[Dict[str, NodePool], Dict[str, Dict[str, InstanceType]]]:
+    """nodepool name -> NodePool, and name -> {instance type name -> IT}
+    (helpers.go:195-222)."""
+    nodepools: Dict[str, NodePool] = {}
+    instance_types: Dict[str, Dict[str, InstanceType]] = {}
+    for np_obj in kube.list(NodePool):
+        if np_obj.metadata.deletion_timestamp is not None:
+            continue
+        try:
+            its = cloud_provider.get_instance_types(np_obj)
+        except Exception:
+            continue
+        if not its:
+            continue
+        nodepools[np_obj.name] = np_obj
+        instance_types[np_obj.name] = {it.name: it for it in its}
+    return nodepools, instance_types
+
+
+def get_candidates(
+    clock: Clock,
+    kube: KubeClient,
+    cluster: Cluster,
+    cloud_provider: CloudProvider,
+    should_disrupt,
+    nodepool_map: Optional[Tuple[Dict[str, NodePool], Dict[str, Dict[str, InstanceType]]]] = None,
+) -> List[Candidate]:
+    """All eligible candidates passing the method's gate (helpers.go:180-192).
+    Pass a prebuilt nodepool_map to avoid re-fetching the instance-type
+    catalog once per method per pass."""
+    nodepools, instance_types = (
+        nodepool_map if nodepool_map is not None
+        else build_nodepool_map(kube, cloud_provider)
+    )
+    out = []
+    for sn in cluster.nodes():
+        pods = []
+        for key in sn.pod_keys():
+            ns, name = key.split("/", 1)
+            pod = kube.get_opt(Pod, name, ns)
+            if pod is not None:
+                pods.append(pod)
+        try:
+            candidate = new_candidate(
+                clock, sn, pods, nodepools, instance_types,
+                is_nominated=cluster.is_nominated(sn.name),
+            )
+        except IneligibleError:
+            continue
+        if should_disrupt(candidate):
+            out.append(candidate)
+    return out
+
+
+def build_disruption_budget_mapping(
+    clock: Clock, cluster: Cluster, nodepools: Dict[str, NodePool]
+) -> Dict[str, int]:
+    """Remaining allowed disruptions per nodepool this pass: the most
+    restrictive active budget minus nodes already disrupting
+    (disruption/helpers.go BuildDisruptionBudgets)."""
+    totals: Dict[str, int] = {}
+    disrupting: Dict[str, int] = {}
+    for sn in cluster.nodes():
+        pool = sn.nodepool_name
+        if pool is None or pool not in nodepools:
+            continue
+        totals[pool] = totals.get(pool, 0) + 1
+        if sn.marked_for_deletion():
+            disrupting[pool] = disrupting.get(pool, 0) + 1
+    out = {}
+    for name, np_obj in nodepools.items():
+        allowed = np_obj.get_allowed_disruptions(clock, totals.get(name, 0))
+        out[name] = max(0, allowed - disrupting.get(name, 0))
+    return out
+
+
+@dataclass
+class SimulationResults:
+    """What one simulated re-schedule of the cluster-minus-candidates showed
+    (helpers.go:73-127)."""
+
+    result: SolveResult
+    inputs: SchedulerInputs
+    pods: List[Pod]
+    # indices >= candidate_pod_start are candidate pods that MUST reschedule
+    candidate_pod_start: int
+
+    def all_candidate_pods_scheduled(self) -> bool:
+        return all(
+            pi < self.candidate_pod_start for pi in self.result.failures
+        )
+
+    def failed_candidate_pods(self) -> List[Pod]:
+        return [
+            self.pods[pi]
+            for pi in self.result.failures
+            if pi >= self.candidate_pod_start
+        ]
+
+
+def simulate_scheduling(
+    provisioner: Provisioner, candidates: Sequence[Candidate]
+) -> Optional[SimulationResults]:
+    """Re-run the scheduler as if the candidates were gone: their pods join
+    the pending set and their nodes leave the bin list (helpers.go:73-127,
+    SimulationMode=true). Returns None when no NodePool can host anything."""
+    candidate_names = {c.name for c in candidates}
+    pending = provisioner.get_pending_pods()
+    deleting = [
+        p for p in provisioner.get_deleting_node_pods()
+        # pods on candidates are added below; don't double-count when a
+        # candidate was already marked deleting by an earlier command
+        if p.spec.node_name not in candidate_names
+    ]
+    candidate_pods = [p for c in candidates for p in c.reschedulable_pods()]
+    pods = pending + deleting + candidate_pods
+    inputs = provisioner.build_inputs(pods)
+    if inputs is None:
+        return None
+    inputs.nodes = [n for n in inputs.nodes if n.name not in candidate_names]
+    result = provisioner.solver.solve(
+        inputs.pods,
+        inputs.instance_types,
+        inputs.templates,
+        nodes=inputs.nodes,
+        cluster_pods=inputs.cluster_pods,
+        domains=inputs.domains,
+    )
+    return SimulationResults(
+        result=result,
+        inputs=inputs,
+        pods=pods,
+        candidate_pod_start=len(pending) + len(deleting),
+    )
+
+
+def candidate_total_price(candidates: Sequence[Candidate]) -> float:
+    return sum(c.price for c in candidates)
+
+
+def filter_replacement_instance_types(
+    sim: SimulationResults, candidates: Sequence[Candidate]
+) -> bool:
+    """Apply the consolidation price rules to the (single) replacement claim
+    in the simulation result, in place (consolidation.go:163-188,
+    helpers.go:235-258):
+
+      - the replacement's viable instance types must be strictly cheaper than
+        the current total price of the candidates;
+      - spot nodes are never replaced by another node for price reasons alone
+        (spot -> spot churn guard): when every candidate is spot, replacement
+        is disallowed entirely;
+      - when candidates are all on-demand, the replacement is restricted to
+        on-demand offerings (a spot replacement would trade reliability, not
+        price).
+
+    Returns False when no instance type survives (consolidation aborts)."""
+    if not sim.result.new_claims:
+        return True
+    if len(sim.result.new_claims) > 1:
+        return False
+    if all(c.capacity_type == wk.CAPACITY_TYPE_SPOT for c in candidates):
+        return False
+    max_price = candidate_total_price(candidates)
+    placement = sim.result.new_claims[0]
+    reqs = placement.requirements
+    require_on_demand = all(
+        c.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND for c in candidates
+    )
+    surviving = []
+    for idx in placement.instance_type_indices:
+        it = sim.inputs.instance_types[idx]
+        offerings = it.offerings.available()
+        if reqs is not None:
+            offerings = offerings.requirements(reqs)
+        if require_on_demand:
+            offerings = type(offerings)(
+                o for o in offerings if o.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND
+            )
+        cheapest = offerings.cheapest()
+        if cheapest is not None and cheapest.price < max_price:
+            surviving.append(idx)
+    if not surviving:
+        return False
+    placement.instance_type_indices = surviving
+    if require_on_demand and reqs is not None:
+        from karpenter_tpu.scheduling.requirements import Requirement
+
+        reqs.add(
+            Requirement(wk.CAPACITY_TYPE_LABEL_KEY, "In", [wk.CAPACITY_TYPE_ON_DEMAND])
+        )
+    return True

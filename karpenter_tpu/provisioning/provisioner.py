@@ -208,11 +208,12 @@ class Provisioner:
         if not templates:
             return None
 
+        its_by_name = {it.name: it for it in instance_types}
         nodes = []
         for sn in self.cluster.nodes():
             if sn.marked_for_deletion():
                 continue
-            nodes.append(self._node_info(sn, daemon_pods))
+            nodes.append(self._node_info(sn, daemon_pods, its_by_name))
 
         domains = domains_from_instance_types(instance_types, templates)
         return SchedulerInputs(
@@ -225,10 +226,41 @@ class Provisioner:
             nodepools=pools,
         )
 
-    def _node_info(self, sn: StateNode, daemon_pods: Sequence[Pod]) -> NodeInfo:
+    def _node_info(
+        self,
+        sn: StateNode,
+        daemon_pods: Sequence[Pod],
+        its_by_name: Optional[Dict[str, InstanceType]] = None,
+    ) -> NodeInfo:
         labels = sn.labels()
         requirements = label_requirements(labels)
         requirements.add(Requirement(wk.LABEL_HOSTNAME, IN, [sn.name]))
+        available = sn.available()
+        if sn.node is None and sn.node_claim is not None:
+            # in-flight claim (calculateExistingNodeClaims,
+            # scheduler.go:287-322): the claim's spec requirements are richer
+            # than its labels, and until the cloud fills status.allocatable we
+            # reserve capacity from the cheapest instance type it can become —
+            # otherwise the pods just planned onto it get provisioned twice
+            claim = sn.node_claim
+            requirements = Requirements.from_node_selector_requirements(
+                *claim.spec.requirements
+            )
+            requirements.add(*label_requirements(claim.metadata.labels).values())
+            requirements.add(Requirement(wk.LABEL_HOSTNAME, IN, [sn.name]))
+            if not available and its_by_name:
+                candidates = [
+                    its_by_name[r]
+                    for r in (
+                        requirements.get(wk.LABEL_INSTANCE_TYPE_STABLE).sorted_values()
+                        if requirements.has(wk.LABEL_INSTANCE_TYPE_STABLE)
+                        else []
+                    )
+                    if r in its_by_name
+                ]
+                ordered = order_by_price(candidates, requirements)
+                if ordered:
+                    available = dict(ordered[0].allocatable())
         # in-flight nodes owe capacity to daemonsets that haven't landed yet
         # (existingnode.go:40-62)
         overhead: Dict[str, float] = {}
@@ -250,7 +282,7 @@ class Provisioner:
             name=sn.name,
             requirements=requirements,
             taints=sn.taints(),
-            available=sn.available(),
+            available=available,
             daemon_overhead=overhead,
             host_ports=sn.host_ports(),
         )

@@ -103,6 +103,68 @@ class Env:
         stored.status.phase = "Running"
         self.kube.update(stored)
 
+    # -- disruption -----------------------------------------------------------
+
+    def disruption_controller(self):
+        from karpenter_tpu.disruption.controller import Controller
+
+        if not hasattr(self, "_disruption"):
+            self._disruption = Controller(
+                self.kube, self.cluster, self.provisioner, self.cloud_provider,
+                self.clock, self.recorder,
+            )
+        return self._disruption
+
+    def create_candidate_node(
+        self,
+        name: str,
+        nodepool: str = "default",
+        it_name: str = "default-instance-type",
+        zone: str = "test-zone-1",
+        capacity_type: str = wk.CAPACITY_TYPE_ON_DEMAND,
+        pods=(),
+        conditions=(),
+        creation_timestamp: Optional[float] = None,
+    ):
+        """A fully-registered node+claim pair shaped like what the lifecycle
+        produced — the substrate every disruption test starts from."""
+        from tests.factories import make_node, make_nodeclaim
+
+        it = next(
+            i for i in self.cloud_provider.get_instance_types(None) if i.name == it_name
+        )
+        labels = {
+            wk.NODEPOOL_LABEL_KEY: nodepool,
+            wk.LABEL_INSTANCE_TYPE_STABLE: it_name,
+            wk.LABEL_TOPOLOGY_ZONE: zone,
+            wk.CAPACITY_TYPE_LABEL_KEY: capacity_type,
+        }
+        claim = make_nodeclaim(
+            name=f"claim-{name}", nodepool=nodepool, provider_id=f"fake:///{name}",
+            node_name=name, capacity=dict(it.capacity),
+            allocatable=dict(it.allocatable()), labels=dict(labels),
+            launched=True, registered=True, initialized=True,
+        )
+        if creation_timestamp is not None:
+            claim.metadata.creation_timestamp = creation_timestamp
+        for cond, when in conditions:
+            claim.status.conditions.set_true(cond, now=when)
+        self.kube.create(claim)
+        node = make_node(
+            name=name, provider_id=f"fake:///{name}", capacity=dict(it.capacity),
+            allocatable=dict(it.allocatable()), labels=dict(labels),
+            nodepool=nodepool, registered=True, initialized=True,
+        )
+        self.kube.create(node)
+        for p in pods:
+            p.spec.node_name = name
+            p.status.phase = "Running"
+            if self.kube.get_opt(Pod, p.metadata.name, p.metadata.namespace) is None:
+                self.kube.create(p)
+            else:
+                self.kube.update(p)
+        return node, claim
+
     # -- assertions -----------------------------------------------------------
 
     def expect_scheduled(self, pod: Pod) -> str:

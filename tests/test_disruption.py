@@ -1,0 +1,316 @@
+"""Disruption suite (reference pkg/controllers/disruption/suite_test.go and
+per-method test files)."""
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis import nodeclaim as nc
+from karpenter_tpu.apis.nodeclaim import NodeClaim
+from karpenter_tpu.apis.nodepool import Budget, Disruption as DisruptionPolicy
+from karpenter_tpu.apis.objects import Node
+from karpenter_tpu.disruption.consolidation import CONSOLIDATION_TTL_SECONDS
+from karpenter_tpu.disruption.orchestration import COMMAND_TIMEOUT_SECONDS
+from karpenter_tpu.disruption.types import DECISION_DELETE, DECISION_REPLACE
+from karpenter_tpu.state.statenode import disruption_taint
+
+from tests.factories import make_nodepool, make_pod
+from tests.harness import Env
+
+
+def make_underutilized_pool(**kw):
+    kw.setdefault("disruption", DisruptionPolicy(
+        consolidation_policy="WhenUnderutilized",
+        budgets=[Budget(nodes="100%")],
+    ))
+    return make_nodepool(**kw)
+
+
+def test_empty_node_consolidation_deletes():
+    env = Env()
+    env.create(make_underutilized_pool())
+    env.create_candidate_node("n1")
+    cmd = env.disruption_controller().reconcile()
+    assert cmd is not None and cmd.decision == DECISION_DELETE
+    assert cmd.method == "empty-node-consolidation"
+    # replacements (none) are trivially initialized: queue deletes the claim
+    env.disruption_controller().queue.reconcile()
+    assert env.kube.get_opt(NodeClaim, "claim-n1", "") is None
+
+
+def test_single_node_consolidation_moves_pods_to_existing_node():
+    env = Env()
+    env.create(make_underutilized_pool())
+    # stuck: a 3.5-cpu pod pins n_stuck (cheapest to disrupt, but nothing can
+    # host its pod more cheaply) — the multi-node prefix search dies on it.
+    # n_move's two small pods fit into n_host's free capacity, so the
+    # single-node linear scan finds it.
+    env.create_candidate_node(
+        "n-stuck", it_name="default-instance-type",
+        pods=[make_pod(name="big", cpu=3.5)],
+    )
+    env.create_candidate_node(
+        "n-move", it_name="small-instance-type",
+        pods=[make_pod(name="s1", cpu=0.1), make_pod(name="s2", cpu=0.1)],
+    )
+    env.create_candidate_node(
+        "n-host", it_name="default-instance-type",
+        pods=[make_pod(name="h1", cpu=3.0)],
+    )
+    cmd = env.disruption_controller().reconcile()
+    assert cmd is not None
+    assert cmd.decision == DECISION_DELETE
+    assert cmd.method == "single-node-consolidation"
+    assert [c.name for c in cmd.candidates] == ["n-move"]
+
+
+def test_consolidation_replace_with_cheaper_instance():
+    env = Env()
+    env.create(make_underutilized_pool())
+    # a big node hosting a tiny pod: a cheaper shape must exist
+    pod = make_pod(name="p1", cpu=0.5)
+    env.create_candidate_node("n1", it_name="default-instance-type", pods=[pod])
+    cmd = env.disruption_controller().reconcile()
+    assert cmd is not None and cmd.decision == DECISION_REPLACE
+    assert len(cmd.replacements) == 1
+    replacement = env.kube.get(NodeClaim, cmd.replacements[0].metadata.name, "")
+    it_req = next(
+        r for r in replacement.spec.requirements
+        if r.key == wk.LABEL_INSTANCE_TYPE_STABLE
+    )
+    # every surviving instance type is strictly cheaper than the candidate
+    its = {i.name: i for i in env.cloud_provider.get_instance_types(None)}
+    old_price = its["default-instance-type"].offerings.get(
+        wk.CAPACITY_TYPE_ON_DEMAND, "test-zone-1"
+    ).price
+    for name in it_req.values:
+        cheapest = its[name].offerings.available().cheapest()
+        assert cheapest.price < old_price
+
+
+def test_spot_candidates_are_never_replaced():
+    env = Env()
+    env.create(make_underutilized_pool())
+    pod = make_pod(name="p1", cpu=0.5)
+    env.create_candidate_node(
+        "n1", it_name="default-instance-type",
+        capacity_type=wk.CAPACITY_TYPE_SPOT, pods=[pod],
+    )
+    cmd = env.disruption_controller().reconcile()
+    # moving the pod needs a replacement, and spot->spot replacement is
+    # blocked: no action
+    assert cmd is None
+
+
+def test_do_not_disrupt_pod_blocks_candidacy():
+    env = Env()
+    env.create(make_underutilized_pool())
+    pod = make_pod(name="p1", cpu=0.5,
+                   annotations={wk.DO_NOT_DISRUPT_ANNOTATION_KEY: "true"})
+    env.create_candidate_node("n1", pods=[pod])
+    assert env.disruption_controller().reconcile() is None
+
+
+def test_nominated_node_is_not_a_candidate():
+    env = Env()
+    env.create(make_underutilized_pool())
+    env.create_candidate_node("n1")
+    env.cluster.nominate_node_for_pod("n1")
+    assert env.disruption_controller().reconcile() is None
+
+
+def test_budget_zero_blocks_disruption():
+    env = Env()
+    env.create(make_nodepool(disruption=DisruptionPolicy(
+        consolidation_policy="WhenUnderutilized",
+        budgets=[Budget(nodes="0")],
+    )))
+    env.create_candidate_node("n1")
+    assert env.disruption_controller().reconcile() is None
+
+
+def test_emptiness_requires_ttl():
+    env = Env()
+    env.create(make_nodepool(disruption=DisruptionPolicy(
+        consolidation_policy="WhenEmpty",
+        consolidate_after="30s",
+        budgets=[Budget(nodes="100%")],
+    )))
+    marked_at = env.clock.now()
+    env.create_candidate_node("n1", conditions=[(nc.EMPTY, marked_at)])
+    # TTL not yet elapsed
+    assert env.disruption_controller().reconcile() is None
+    env.clock.step(31)
+    cmd = env.disruption_controller().reconcile()
+    assert cmd is not None and cmd.method == "emptiness"
+    assert cmd.decision == DECISION_DELETE
+
+
+def test_drift_replaces_occupied_node():
+    env = Env()
+    env.create(make_underutilized_pool())
+    pod = make_pod(name="p1", cpu=0.5)
+    env.create_candidate_node("n1", pods=[pod], conditions=[(nc.DRIFTED, 0.0)])
+    cmd = env.disruption_controller().reconcile()
+    assert cmd is not None and cmd.method == "drift"
+    assert cmd.decision == DECISION_REPLACE
+
+
+def test_empty_drifted_fast_path_deletes():
+    env = Env()
+    env.create(make_underutilized_pool())
+    env.create_candidate_node("n1", conditions=[(nc.DRIFTED, 0.0)])
+    cmd = env.disruption_controller().reconcile()
+    assert cmd is not None and cmd.method == "drift"
+    assert cmd.decision == DECISION_DELETE
+
+
+def test_expiration_prefers_soonest_expired():
+    env = Env()
+    env.create(make_nodepool(disruption=DisruptionPolicy(
+        consolidation_policy="WhenUnderutilized",
+        expire_after="1h",
+        budgets=[Budget(nodes="1")],  # one at a time: ordering is observable
+    )))
+    now = env.clock.now()
+    env.create_candidate_node(
+        "older", conditions=[(nc.EXPIRED, now)], creation_timestamp=now - 7200,
+        pods=[make_pod(name="po", cpu=0.5)],
+    )
+    env.create_candidate_node(
+        "newer", conditions=[(nc.EXPIRED, now)], creation_timestamp=now - 3700,
+        pods=[make_pod(name="pn", cpu=0.5)],
+    )
+    cmd = env.disruption_controller().reconcile()
+    assert cmd is not None and cmd.method == "expiration"
+    assert [c.name for c in cmd.candidates] == ["older"]
+
+
+def test_execute_taints_and_marks():
+    env = Env()
+    env.create(make_underutilized_pool())
+    env.create_candidate_node("n1")
+    cmd = env.disruption_controller().reconcile()
+    assert cmd is not None
+    node = env.kube.get(Node, "n1", "")
+    assert any(t.match(disruption_taint()) for t in node.spec.taints)
+    assert env.cluster.node_for_name("n1").marked_for_deletion()
+
+
+def test_queue_waits_for_replacement_then_deletes():
+    env = Env()
+    env.create(make_underutilized_pool())
+    pod = make_pod(name="p1", cpu=0.5)
+    env.create_candidate_node("n1", pods=[pod])
+    ctrl = env.disruption_controller()
+    cmd = ctrl.reconcile()
+    assert cmd is not None and cmd.decision == DECISION_REPLACE
+    # replacement not initialized yet: candidate survives
+    ctrl.queue.reconcile()
+    assert env.kube.get_opt(NodeClaim, "claim-n1", "") is not None
+    # initialize the replacement; candidate is then retired
+    rep = env.kube.get(NodeClaim, cmd.replacements[0].metadata.name, "")
+    for cond in ("Launched", "Registered", "Initialized"):
+        rep.status.conditions.set_true(cond)
+    env.kube.update(rep)
+    ctrl.queue.reconcile()
+    assert env.kube.get_opt(NodeClaim, "claim-n1", "") is None
+
+
+def test_queue_timeout_rolls_back():
+    env = Env()
+    env.create(make_underutilized_pool())
+    pod = make_pod(name="p1", cpu=0.5)
+    env.create_candidate_node("n1", pods=[pod])
+    ctrl = env.disruption_controller()
+    cmd = ctrl.reconcile()
+    assert cmd is not None and cmd.decision == DECISION_REPLACE
+    env.clock.step(COMMAND_TIMEOUT_SECONDS + 1)
+    ctrl.queue.reconcile()
+    # rollback: untainted, unmarked, replacement deleted, candidate intact
+    node = env.kube.get(Node, "n1", "")
+    assert not any(t.match(disruption_taint()) for t in node.spec.taints)
+    assert not env.cluster.node_for_name("n1").marked_for_deletion()
+    assert env.kube.get_opt(NodeClaim, cmd.replacements[0].metadata.name, "") is None
+    assert env.kube.get_opt(NodeClaim, "claim-n1", "") is not None
+
+
+def test_orphaned_taint_cleanup():
+    env = Env()
+    env.create(make_underutilized_pool())
+    node, _ = env.create_candidate_node("n1", pods=[make_pod(name="p1", cpu=8.0)])
+    stored = env.kube.get(Node, "n1", "")
+    stored.spec.taints.append(disruption_taint())
+    env.kube.update(stored)
+    env.disruption_controller().reconcile()
+    node = env.kube.get(Node, "n1", "")
+    assert not any(t.match(disruption_taint()) for t in node.spec.taints)
+
+
+def test_consolidated_state_short_circuits():
+    env = Env()
+    env.create(make_underutilized_pool())
+    env.create_candidate_node("n1", pods=[make_pod(name="p1", cpu=3.5)])
+    ctrl = env.disruption_controller()
+    assert ctrl.reconcile() is None  # nothing consolidatable: pod fills node
+    assert env.cluster.consolidated()
+    # no state change: the consolidation methods are skipped entirely
+    assert ctrl.reconcile() is None
+
+
+def test_validation_rejects_when_any_candidate_turns_ineligible():
+    from karpenter_tpu.disruption.consolidation import MultiNodeConsolidation
+    from karpenter_tpu.disruption.helpers import get_candidates
+
+    env = Env()
+    env.create(make_underutilized_pool())
+    env.create_candidate_node("n1")
+    env.create_candidate_node("n2")
+    method = MultiNodeConsolidation(env.provisioner, env.clock)
+    candidates = get_candidates(
+        env.clock, env.kube, env.cluster, env.cloud_provider, method.should_disrupt
+    )
+    cmd = method.compute_command({"default": 10}, candidates)
+    assert cmd.decision == DECISION_DELETE and len(cmd.candidates) == 2
+    # during the TTL the SECOND candidate gains a do-not-disrupt pod
+    blocker = make_pod(name="blocker", cpu=0.1,
+                       annotations={wk.DO_NOT_DISRUPT_ANNOTATION_KEY: "true"},
+                       node_name=cmd.candidates[1].name, phase="Running")
+    env.create(blocker)
+    assert not method.validate(cmd, env.kube, env.cluster, env.cloud_provider)
+
+
+def test_consolidated_mark_not_reset_by_gated_passes():
+    env = Env()
+    env.create(make_underutilized_pool())
+    env.create_candidate_node("n1", pods=[make_pod(name="p1", cpu=3.5)])
+    ctrl = env.disruption_controller()
+    assert ctrl.reconcile() is None
+    assert env.cluster.consolidated()
+    marked_at = env.cluster._consolidated_at
+    # gated no-op passes must not refresh the consolidated timestamp
+    env.clock.step(110)
+    ctrl.reconcile()
+    env.clock.step(110)
+    ctrl.reconcile()
+    assert env.cluster._consolidated_at == marked_at
+    # past 300s the gate opens, a real evaluation runs, and re-marks
+    env.clock.step(110)
+    assert not env.cluster.consolidated()
+    ctrl.reconcile()
+    assert env.cluster._consolidated_at > marked_at
+
+
+def test_multi_node_consolidation_batches():
+    env = Env()
+    env.create(make_underutilized_pool())
+    # two near-empty small nodes + one big empty node; multi-node should
+    # clear more than one in a single command
+    env.create_candidate_node("n1", it_name="small-instance-type",
+                              pods=[make_pod(name="p1", cpu=0.1)])
+    env.create_candidate_node("n2", it_name="small-instance-type",
+                              pods=[make_pod(name="p2", cpu=0.1)])
+    env.create_candidate_node("n3", it_name="default-instance-type",
+                              pods=[make_pod(name="p3", cpu=0.1)])
+    cmd = env.disruption_controller().reconcile()
+    assert cmd is not None
+    assert cmd.method == "multi-node-consolidation"
+    assert len(cmd.candidates) >= 2
+    assert len(cmd.replacements) <= 1

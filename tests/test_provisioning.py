@@ -259,6 +259,20 @@ def test_full_pass_through_jax_backend():
     assert len(env.nodeclaims()) >= 1
 
 
+def test_in_flight_claim_absorbs_pending_pods():
+    # the window between NodeClaim create and cloud launch: a second pass must
+    # not double-provision the same pods (scheduler.go:287-322)
+    env = Env()
+    env.create(make_nodepool())
+    pod = make_pod(name="p1", cpu=1.0)
+    env.create(pod)
+    pass1 = env.provisioner.reconcile()
+    assert len(pass1.created) == 1  # claim exists, NOT launched
+    pass2 = env.provisioner.reconcile()
+    assert pass2.created == [], "in-flight claim must reserve its capacity"
+    assert len(env.nodeclaims()) == 1
+
+
 def test_second_reconcile_is_idempotent():
     env = Env()
     env.create(make_nodepool())
