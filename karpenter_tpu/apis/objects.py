@@ -31,7 +31,10 @@ class ObjectMeta:
     annotations: Dict[str, str] = field(default_factory=dict)
     finalizers: List[str] = field(default_factory=list)
     owner_references: List["OwnerReference"] = field(default_factory=list)
-    creation_timestamp: float = field(default_factory=_time.time)
+    # None until the object is stored: KubeClient.create stamps it from its
+    # injected clock, so ages/TTLs are measured in the same timebase as every
+    # controller decision. Objects never stored keep None (age treated as 0).
+    creation_timestamp: Optional[float] = None
     # Monotonic tiebreaker: k8s creation timestamps have 1s resolution, so the
     # reference falls back to UID ordering (queue.go:104-110); we keep a strict
     # creation sequence instead for deterministic test behavior.

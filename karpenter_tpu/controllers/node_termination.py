@@ -1,0 +1,150 @@
+"""Node termination — taint, drain, delete.
+
+Equivalent of reference pkg/controllers/node/termination/: the Node finalizer
+path (termination/controller.go:76-108) —
+
+  1. taint the node so nothing new lands (terminator.go:50-77)
+  2. drain: evict pods in order — non-critical non-daemon first, then
+     non-critical daemon, then critical non-daemon, then critical daemon
+     (terminator.go:112-147); static pods and already-terminating pods are
+     skipped; PodDisruptionBudgets are honored the way the Evict API's 429
+     responses are (terminator/eviction.go:101-149)
+  3. once drained: CloudProvider.Delete and remove the finalizer
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodeclaim import NodeClaim, NodeClaimStatus
+from karpenter_tpu.apis.objects import Node, ObjectMeta, Pod
+from karpenter_tpu.cloudprovider.types import CloudProvider, NodeClaimNotFoundError
+from karpenter_tpu.disruption.pdblimits import PDBLimits
+from karpenter_tpu.events import Recorder, object_event
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.metrics import REGISTRY
+from karpenter_tpu.state.statenode import disruption_taint
+from karpenter_tpu.utils import pod as podutil
+from karpenter_tpu.utils.clock import Clock
+
+SYSTEM_CRITICAL_PRIORITY = 2_000_000_000
+
+TERMINATION_DURATION = REGISTRY.histogram(
+    "node_termination_duration_seconds", "Time from delete to finalizer removal",
+    subsystem="node",
+)
+
+
+def _is_critical(pod: Pod) -> bool:
+    if pod.spec.priority is not None and pod.spec.priority >= SYSTEM_CRITICAL_PRIORITY:
+        return True
+    return pod.spec.priority_class_name in (
+        "system-cluster-critical", "system-node-critical"
+    )
+
+
+def _is_daemon(pod: Pod) -> bool:
+    return podutil.is_owned_by_daemonset(pod)
+
+
+class NodeTerminationController:
+    def __init__(
+        self, kube: KubeClient, cloud_provider: CloudProvider, clock: Clock,
+        recorder: Recorder,
+    ):
+        self.kube = kube
+        self.cloud_provider = cloud_provider
+        self.clock = clock
+        self.recorder = recorder
+
+    def reconcile_all(self) -> None:
+        for node in self.kube.list(Node):
+            if node.metadata.deletion_timestamp is not None:
+                self.reconcile(node)
+
+    def reconcile(self, node: Node) -> str:
+        """Returns 'draining' while evictions are in flight, 'done' when the
+        finalizer came off, 'skip' otherwise."""
+        node = self.kube.get_opt(Node, node.metadata.name, "")
+        if node is None or node.metadata.deletion_timestamp is None:
+            return "skip"
+        if wk.TERMINATION_FINALIZER not in node.metadata.finalizers:
+            return "skip"
+        self._ensure_taint(node)
+        if self._drain(node):
+            return "draining"
+        self._delete_instance(node)
+        deleted_at = node.metadata.deletion_timestamp
+        self.kube.patch(
+            node,
+            lambda n: n.metadata.finalizers.__setitem__(
+                slice(None),
+                [f for f in n.metadata.finalizers if f != wk.TERMINATION_FINALIZER],
+            ),
+        )
+        TERMINATION_DURATION.observe(self.clock.now() - deleted_at)
+        return "done"
+
+    def _ensure_taint(self, node: Node) -> None:
+        taint = disruption_taint()
+        if not any(t.match(taint) for t in node.spec.taints):
+            self.kube.patch(node, lambda n: n.spec.taints.append(taint))
+
+    def _drain(self, node: Node) -> bool:
+        """One eviction pass; True while pods remain (terminator.go:81-147)."""
+        pods = self.kube.list(
+            Pod, predicate=lambda p: p.spec.node_name == node.metadata.name
+        )
+        evictable: List[Pod] = []
+        for p in pods:
+            if podutil.is_owned_by_node(p):  # static pods die with the node
+                continue
+            if podutil.is_terminal(p) or podutil.is_terminating(p):
+                continue
+            evictable.append(p)
+        if not evictable:
+            return False
+        # ordered groups: the first non-empty group drains before later ones
+        groups = [
+            [p for p in evictable if not _is_critical(p) and not _is_daemon(p)],
+            [p for p in evictable if not _is_critical(p) and _is_daemon(p)],
+            [p for p in evictable if _is_critical(p) and not _is_daemon(p)],
+            [p for p in evictable if _is_critical(p) and _is_daemon(p)],
+        ]
+        pdb = PDBLimits(self.kube)
+        for group in groups:
+            if not group:
+                continue
+            for pod in group:
+                if not pdb.try_consume(pod):
+                    # PDB 429: leave it for a later pass (eviction.go:127-149)
+                    self.recorder.publish(
+                        object_event(
+                            pod, "Normal", "EvictionBlocked",
+                            "pod disruption budget prevents eviction",
+                        )
+                    )
+                    continue
+                self.recorder.publish(
+                    object_event(pod, "Normal", "Evicted", "draining node")
+                )
+                self.kube.delete_opt(Pod, pod.metadata.name, pod.metadata.namespace)
+            break  # later groups wait for this one to finish draining
+        return True
+
+    def _delete_instance(self, node: Node) -> None:
+        if not node.spec.provider_id:
+            return
+        claims = self.kube.list(
+            NodeClaim,
+            predicate=lambda c: c.status.provider_id == node.spec.provider_id,
+        )
+        claim = claims[0] if claims else NodeClaim(
+            metadata=ObjectMeta(name=node.metadata.name, namespace=""),
+            status=NodeClaimStatus(provider_id=node.spec.provider_id),
+        )
+        try:
+            self.cloud_provider.delete(claim)
+        except NodeClaimNotFoundError:
+            pass

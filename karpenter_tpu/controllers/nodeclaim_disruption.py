@@ -1,0 +1,127 @@
+"""NodeClaim disruption status markers — Empty, Drifted, Expired.
+
+Equivalent of reference pkg/controllers/nodeclaim/disruption/: a per-claim
+reconciler that stamps (or clears) the three disruption conditions the
+disruption methods key off (nodeclaim/disruption/controller.go:71-79):
+
+  Empty    initialized claim whose node runs no reschedulable pods
+  Drifted  static drift (nodepool-hash annotation mismatch, drift.go:114-121),
+           requirements drift (node labels fall outside the pool's current
+           requirements, drift.go:123), or CloudProvider.IsDrifted
+  Expired  claim older than the pool's expireAfter
+"""
+
+from __future__ import annotations
+
+import copy
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodeclaim import DRIFTED, EMPTY, EXPIRED, NodeClaim
+from karpenter_tpu.apis.nodepool import NEVER, NodePool
+from karpenter_tpu.apis.objects import Pod
+from karpenter_tpu.cloudprovider.types import CloudProvider
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.scheduling.requirements import (
+    Requirements,
+    label_requirements,
+)
+from karpenter_tpu.utils import pod as podutil
+from karpenter_tpu.utils.clock import Clock
+
+
+class DisruptionMarkerController:
+    def __init__(
+        self, kube: KubeClient, cloud_provider: CloudProvider, clock: Clock,
+        drift_enabled: bool = True,
+    ):
+        self.kube = kube
+        self.cloud_provider = cloud_provider
+        self.clock = clock
+        self.drift_enabled = drift_enabled  # --feature-gates Drift (options.go:97)
+
+    def reconcile_all(self) -> None:
+        pools = {np.name: np for np in self.kube.list(NodePool)}
+        for claim in self.kube.list(NodeClaim):
+            if claim.metadata.deletion_timestamp is not None:
+                continue
+            self.reconcile(claim, pools)
+
+    def reconcile(self, claim: NodeClaim, pools=None) -> None:
+        if pools is None:
+            pools = {np.name: np for np in self.kube.list(NodePool)}
+        nodepool = pools.get(claim.nodepool_name or "")
+        if nodepool is None:
+            return
+        now = self.clock.now()
+
+        def mark(c: NodeClaim):
+            self._mark_empty(c, nodepool, now)
+            if self.drift_enabled:
+                self._mark_drifted(c, nodepool, now)
+            self._mark_expired(c, nodepool, now)
+
+        # dry-run against a copy; only write when a condition actually
+        # transitioned — a steady-state pass must not churn resource versions
+        # and fan no-op MODIFIED events into the informers
+        probe = copy.deepcopy(claim)
+        mark(probe)
+        if probe.status.conditions == claim.status.conditions:
+            return
+        self.kube.patch(claim, mark)
+
+    # -- emptiness (nodeclaim/disruption/emptiness.go) ------------------------
+
+    def _mark_empty(self, claim: NodeClaim, nodepool: NodePool, now: float) -> None:
+        if not claim.is_initialized() or not claim.status.node_name:
+            claim.status.conditions.clear(EMPTY)
+            return
+        pods = self.kube.list(
+            Pod,
+            predicate=lambda p: p.spec.node_name == claim.status.node_name
+            and podutil.is_reschedulable(p),
+        )
+        if pods:
+            claim.status.conditions.clear(EMPTY)
+        elif not claim.status.conditions.is_true(EMPTY):
+            claim.status.conditions.set_true(EMPTY, now=now)
+
+    # -- drift (nodeclaim/disruption/drift.go) --------------------------------
+
+    def _mark_drifted(self, claim: NodeClaim, nodepool: NodePool, now: float) -> None:
+        reason = self._drift_reason(claim, nodepool)
+        if reason:
+            if not claim.status.conditions.is_true(DRIFTED):
+                claim.status.conditions.set_true(DRIFTED, reason=reason, now=now)
+        else:
+            claim.status.conditions.clear(DRIFTED)
+
+    def _drift_reason(self, claim: NodeClaim, nodepool: NodePool) -> str:
+        # static drift: the pool template changed under the claim
+        claim_hash = claim.metadata.annotations.get(wk.NODEPOOL_HASH_ANNOTATION_KEY)
+        if claim_hash is not None and claim_hash != nodepool.hash():
+            return "NodePoolStaticDrifted"
+        # requirements drift: claim labels fall outside the pool's requirements
+        pool_reqs = Requirements.from_node_selector_requirements(
+            *nodepool.spec.template.spec.requirements
+        )
+        claim_reqs = label_requirements(claim.metadata.labels)
+        if not pool_reqs.is_compatible(claim_reqs, wk.WELL_KNOWN_LABELS):
+            return "RequirementsDrifted"
+        cloud_reason = self.cloud_provider.is_drifted(claim)
+        if cloud_reason:
+            return cloud_reason
+        return ""
+
+    # -- expiration (nodeclaim/disruption/expiration.go) ----------------------
+
+    def _mark_expired(self, claim: NodeClaim, nodepool: NodePool, now: float) -> None:
+        ttl = nodepool.spec.disruption.expire_after_seconds()
+        created = claim.metadata.creation_timestamp
+        if ttl == NEVER or created is None:
+            claim.status.conditions.clear(EXPIRED)
+            return
+        if now - created >= ttl:
+            if not claim.status.conditions.is_true(EXPIRED):
+                claim.status.conditions.set_true(EXPIRED, now=now)
+        else:
+            claim.status.conditions.clear(EXPIRED)

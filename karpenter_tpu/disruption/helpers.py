@@ -15,6 +15,7 @@ from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.apis.nodepool import NodePool
 from karpenter_tpu.apis.objects import Pod
 from karpenter_tpu.cloudprovider.types import CloudProvider, InstanceType
+from karpenter_tpu.disruption.pdblimits import PDBLimits
 from karpenter_tpu.disruption.types import Candidate, IneligibleError, new_candidate
 from karpenter_tpu.kube.client import KubeClient
 from karpenter_tpu.provisioning.provisioner import Provisioner, SchedulerInputs
@@ -59,6 +60,7 @@ def get_candidates(
         nodepool_map if nodepool_map is not None
         else build_nodepool_map(kube, cloud_provider)
     )
+    pdb = PDBLimits(kube)
     out = []
     for sn in cluster.nodes():
         pods = []
@@ -73,6 +75,10 @@ def get_candidates(
                 is_nominated=cluster.is_nominated(sn.name),
             )
         except IneligibleError:
+            continue
+        # PDB-blocked pods make the node undisruptable (types.go:90-96)
+        ok, _reason = pdb.can_evict_pods(candidate.reschedulable_pods())
+        if not ok:
             continue
         if should_disrupt(candidate):
             out.append(candidate)

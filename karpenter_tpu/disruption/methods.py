@@ -132,7 +132,7 @@ class Expiration(_ConditionReplacer):
         def expiry(c: Candidate) -> float:
             claim = c.node_claim
             ttl = c.nodepool.spec.disruption.expire_after_seconds()
-            if claim is None or ttl == NEVER:
+            if claim is None or ttl == NEVER or claim.metadata.creation_timestamp is None:
                 return float("inf")
             return claim.metadata.creation_timestamp + ttl
 

@@ -53,6 +53,8 @@ def lifetime_remaining(clock: Clock, nodepool: NodePool, node_claim: Optional[No
     expire_after = nodepool.spec.disruption.expire_after_seconds()
     if expire_after == NEVER or expire_after <= 0 or node_claim is None:
         return 1.0
+    if node_claim.metadata.creation_timestamp is None:
+        return 1.0
     age = clock.now() - node_claim.metadata.creation_timestamp
     return max(0.0, min(1.0, 1.0 - age / expire_after))
 

@@ -101,6 +101,9 @@ class KubeClient:
             self._rv += 1
             stored.metadata.resource_version = self._rv
             stored.metadata.generation = 1
+            if stored.metadata.creation_timestamp is None:
+                stored.metadata.creation_timestamp = self._now()
+                obj.metadata.creation_timestamp = stored.metadata.creation_timestamp
             coll[k] = stored
             obj.metadata.resource_version = stored.metadata.resource_version
             obj.metadata.generation = stored.metadata.generation

@@ -415,6 +415,8 @@ class Provisioner:
                 namespace="",
                 labels=labels,
                 annotations={wk.NODEPOOL_HASH_ANNOTATION_KEY: np_obj.hash()},
+                # ages/TTLs are measured against the injected clock
+                creation_timestamp=self.clock.now(),
             ),
         )
         claim.spec.requirements = reqs.to_node_selector_requirements()

@@ -95,7 +95,7 @@ def ffd_order(pods: Sequence[Pod]) -> List[int]:
             (
                 -requests.get(res.CPU, 0.0),
                 -requests.get(res.MEMORY, 0.0),
-                p.metadata.creation_timestamp,
+                p.metadata.creation_timestamp or 0.0,
                 p.metadata.creation_seq,
                 i,
             )

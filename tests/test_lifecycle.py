@@ -1,0 +1,381 @@
+"""Lifecycle & hygiene controller suites (reference
+pkg/controllers/nodeclaim/{lifecycle,termination,garbagecollection,
+consistency}, node/termination, nodepool/{hash,counter},
+leasegarbagecollection)."""
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodeclaim import DRIFTED, EMPTY, EXPIRED, NodeClaim
+from karpenter_tpu.apis.nodepool import Disruption as DisruptionPolicy
+from karpenter_tpu.apis.objects import (
+    Lease,
+    LabelSelector,
+    Node,
+    ObjectMeta,
+    Pod,
+    PodDisruptionBudget,
+    Taint,
+)
+from karpenter_tpu.cloudprovider.types import InsufficientCapacityError
+from karpenter_tpu.controllers.nodeclaim_consistency import ConsistencyController
+from karpenter_tpu.controllers.nodeclaim_disruption import DisruptionMarkerController
+from karpenter_tpu.controllers.nodeclaim_garbagecollection import (
+    GarbageCollectionController,
+    LAUNCH_GRACE_SECONDS,
+)
+from karpenter_tpu.controllers.nodeclaim_lifecycle import (
+    LifecycleController,
+    REGISTRATION_TTL_SECONDS,
+)
+from karpenter_tpu.controllers.nodeclaim_termination import TerminationController
+from karpenter_tpu.controllers.node_termination import NodeTerminationController
+from karpenter_tpu.controllers.nodepool_controllers import (
+    LeaseGarbageCollectionController,
+    NodePoolCounterController,
+    NodePoolHashController,
+)
+
+from tests.factories import make_node, make_nodeclaim, make_nodepool, make_pod
+from tests.harness import Env
+
+
+def lifecycle(env):
+    return LifecycleController(env.kube, env.cloud_provider, env.clock, env.recorder)
+
+
+# -- lifecycle: launch → register → initialize --------------------------------
+
+
+def test_launch_sets_status_from_cloud():
+    env = Env()
+    env.create(make_nodepool())
+    claim = make_nodeclaim(name="c1", requirements=[])
+    env.create(claim)
+    lifecycle(env).reconcile_all()
+    got = env.kube.get(NodeClaim, "c1", "")
+    assert got.is_launched()
+    assert got.status.provider_id.startswith("fake:///")
+    assert got.status.capacity["cpu"] > 0
+    assert wk.TERMINATION_FINALIZER in got.metadata.finalizers
+
+
+def test_insufficient_capacity_deletes_claim():
+    env = Env()
+    env.cloud_provider.next_create_error = InsufficientCapacityError("no capacity")
+    claim = make_nodeclaim(name="c1")
+    env.create(claim)
+    lifecycle(env).reconcile_all()
+    # the finalizer gates actual removal; the claim is at least deleting
+    got = env.kube.get_opt(NodeClaim, "c1", "")
+    assert got is None or got.metadata.deletion_timestamp is not None
+    assert env.recorder.count("LaunchFailed") == 1
+
+
+def test_registration_adopts_node():
+    env = Env()
+    claim = make_nodeclaim(name="c1")
+    env.create(claim)
+    ctrl = lifecycle(env)
+    ctrl.reconcile_all()  # launch
+    launched = env.kube.get(NodeClaim, "c1", "")
+    # the kubelet registers the node with our providerID
+    env.create(make_node(name="n1", provider_id=launched.status.provider_id))
+    ctrl.reconcile_all()
+    got = env.kube.get(NodeClaim, "c1", "")
+    assert got.is_registered() and got.status.node_name == "n1"
+    node = env.kube.get(Node, "n1", "")
+    assert node.metadata.labels[wk.NODE_REGISTERED_LABEL_KEY] == "true"
+    assert wk.TERMINATION_FINALIZER in node.metadata.finalizers
+
+
+def test_initialization_waits_for_startup_taints():
+    env = Env()
+    startup = Taint(key="example.com/starting")
+    claim = make_nodeclaim(name="c1", startup_taints=[startup])
+    env.create(claim)
+    ctrl = lifecycle(env)
+    ctrl.reconcile_all()
+    launched = env.kube.get(NodeClaim, "c1", "")
+    env.create(make_node(name="n1", provider_id=launched.status.provider_id))
+    ctrl.reconcile_all()  # registers; node now carries the startup taint
+    got = env.kube.get(NodeClaim, "c1", "")
+    assert got.is_registered() and not got.is_initialized()
+    # the taint's owner removes it; initialization completes
+    node = env.kube.get(Node, "n1", "")
+    node.spec.taints = [t for t in node.spec.taints if t.key != startup.key]
+    env.kube.update(node)
+    ctrl.reconcile_all()
+    assert env.kube.get(NodeClaim, "c1", "").is_initialized()
+    assert env.kube.get(Node, "n1", "").metadata.labels[
+        wk.NODE_INITIALIZED_LABEL_KEY
+    ] == "true"
+
+
+def test_liveness_deletes_unregistered_claims():
+    env = Env()
+    env.create(make_nodeclaim(name="c1"))
+    ctrl = lifecycle(env)
+    ctrl.reconcile_all()  # launches, but no node ever appears
+    env.clock.step(REGISTRATION_TTL_SECONDS + 1)
+    ctrl.reconcile_all()
+    # deletion is finalizer-gated: the claim is marked deleting
+    got = env.kube.get_opt(NodeClaim, "c1", "")
+    assert got is None or got.metadata.deletion_timestamp is not None
+
+
+# -- disruption markers --------------------------------------------------------
+
+
+def marker(env, drift=True):
+    return DisruptionMarkerController(env.kube, env.cloud_provider, env.clock,
+                                      drift_enabled=drift)
+
+
+def test_empty_condition_tracks_pods():
+    env = Env()
+    env.cloud_provider.drifted = ""
+    env.create(make_nodepool())
+    _, claim = env.create_candidate_node("n1")
+    marker(env).reconcile_all()
+    assert env.kube.get(NodeClaim, claim.metadata.name, "").status.conditions.is_true(EMPTY)
+    env.create(make_pod(name="p1", cpu=0.1, node_name="n1", phase="Running"))
+    marker(env).reconcile_all()
+    assert not env.kube.get(
+        NodeClaim, claim.metadata.name, ""
+    ).status.conditions.is_true(EMPTY)
+
+
+def test_static_drift_on_hash_mismatch():
+    env = Env()
+    env.cloud_provider.drifted = ""
+    pool = make_nodepool()
+    env.create(pool)
+    _, claim = env.create_candidate_node("n1")
+    stored = env.kube.get(NodeClaim, claim.metadata.name, "")
+    stored.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] = pool.hash()
+    env.kube.update(stored)
+    marker(env).reconcile_all()
+    assert not env.kube.get(
+        NodeClaim, claim.metadata.name, ""
+    ).status.conditions.is_true(DRIFTED)
+    # the pool template changes: hash diverges -> static drift
+    stored_pool = env.kube.get(make_nodepool().__class__, "default", "")
+    stored_pool.spec.template.labels["team"] = "changed"
+    env.kube.update(stored_pool)
+    marker(env).reconcile_all()
+    got = env.kube.get(NodeClaim, claim.metadata.name, "")
+    assert got.status.conditions.is_true(DRIFTED)
+    assert got.status.conditions.get(DRIFTED).reason == "NodePoolStaticDrifted"
+
+
+def test_cloud_drift_and_feature_gate():
+    env = Env()
+    env.cloud_provider.drifted = "cloud-drift"
+    env.create(make_nodepool())
+    _, claim = env.create_candidate_node("n1")
+    marker(env, drift=False).reconcile_all()
+    assert not env.kube.get(
+        NodeClaim, claim.metadata.name, ""
+    ).status.conditions.is_true(DRIFTED)
+    marker(env, drift=True).reconcile_all()
+    got = env.kube.get(NodeClaim, claim.metadata.name, "")
+    assert got.status.conditions.is_true(DRIFTED)
+    assert got.status.conditions.get(DRIFTED).reason == "cloud-drift"
+
+
+def test_expired_condition_after_ttl():
+    env = Env()
+    env.cloud_provider.drifted = ""
+    env.create(make_nodepool(disruption=DisruptionPolicy(expire_after="1h")))
+    _, claim = env.create_candidate_node("n1")
+    marker(env).reconcile_all()
+    assert not env.kube.get(
+        NodeClaim, claim.metadata.name, ""
+    ).status.conditions.is_true(EXPIRED)
+    env.clock.step(3601)
+    marker(env).reconcile_all()
+    assert env.kube.get(
+        NodeClaim, claim.metadata.name, ""
+    ).status.conditions.is_true(EXPIRED)
+
+
+def test_marker_steady_state_does_not_churn():
+    env = Env()
+    env.cloud_provider.drifted = ""
+    env.create(make_nodepool())
+    _, claim = env.create_candidate_node("n1")
+    marker(env).reconcile_all()
+    rv = env.kube.get(NodeClaim, claim.metadata.name, "").metadata.resource_version
+    marker(env).reconcile_all()  # nothing changed: no write, no watch event
+    assert env.kube.get(NodeClaim, claim.metadata.name, "").metadata.resource_version == rv
+
+
+# -- nodeclaim termination -----------------------------------------------------
+
+
+def test_claim_termination_cascades():
+    env = Env()
+    env.create(make_nodepool())
+    node, claim = env.create_candidate_node("n1")
+    stored = env.kube.get(NodeClaim, claim.metadata.name, "")
+    stored.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
+    env.kube.update(stored)
+    env.kube.delete(NodeClaim, claim.metadata.name, "")
+    TerminationController(env.kube, env.cloud_provider).reconcile_all()
+    # node had no finalizer: deleted immediately; cloud delete attempted;
+    # claim finalizer removed -> claim gone
+    assert env.kube.get_opt(Node, "n1", "") is None
+    assert env.kube.get_opt(NodeClaim, claim.metadata.name, "") is None
+    assert len(env.cloud_provider.delete_calls) == 1
+
+
+# -- garbage collection --------------------------------------------------------
+
+
+def test_gc_collects_vanished_instances():
+    env = Env()
+    env.create(make_nodepool())
+    claim = make_nodeclaim(name="c1")
+    env.create(claim)
+    lifecycle(env).reconcile_all()  # launch through the fake cloud
+    got = env.kube.get(NodeClaim, "c1", "")
+    gc = GarbageCollectionController(env.kube, env.cloud_provider, env.clock,
+                                     env.recorder)
+    env.clock.step(LAUNCH_GRACE_SECONDS + 1)
+    assert gc.reconcile() == 0  # instance alive: kept
+    # the instance vanishes out from under us
+    env.cloud_provider.created_nodeclaims.pop(got.status.provider_id)
+    assert gc.reconcile() == 1
+
+
+# -- consistency ---------------------------------------------------------------
+
+
+def test_consistency_flags_shape_mismatch():
+    env = Env()
+    env.create(make_nodepool())
+    node, claim = env.create_candidate_node("n1")
+    stored_node = env.kube.get(Node, "n1", "")
+    stored_node.status.capacity["cpu"] = claim.status.capacity["cpu"] * 0.5
+    env.kube.update(stored_node)
+    checker = ConsistencyController(env.kube, env.clock, env.recorder)
+    assert checker.reconcile() == 1
+    assert env.recorder.count("FailedConsistencyCheck") == 1
+
+
+def test_consistency_flags_stuck_termination():
+    env = Env()
+    claim = make_nodeclaim(name="c1", finalizers=[wk.TERMINATION_FINALIZER])
+    env.create(claim)
+    env.kube.delete(NodeClaim, "c1", "")
+    env.clock.step(601)
+    checker = ConsistencyController(env.kube, env.clock, env.recorder)
+    assert checker.reconcile() == 1
+
+
+# -- node termination (drain) --------------------------------------------------
+
+
+def test_drain_orders_and_deletes():
+    env = Env()
+    env.create(make_nodepool())
+    node, claim = env.create_candidate_node("n1", pods=[
+        make_pod(name="app", cpu=0.1),
+        make_pod(name="daemon", cpu=0.1, owner_kind="DaemonSet"),
+    ])
+    stored = env.kube.get(Node, "n1", "")
+    stored.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
+    env.kube.update(stored)
+    env.kube.delete(Node, "n1", "")
+    ctrl = NodeTerminationController(env.kube, env.cloud_provider, env.clock,
+                                     env.recorder)
+    # pass 1: non-daemon app evicted first, daemon survives
+    assert ctrl.reconcile(stored) == "draining"
+    assert env.kube.get_opt(Pod, "app") is None
+    assert env.kube.get_opt(Pod, "daemon") is not None
+    # pass 2: daemon evicted
+    assert ctrl.reconcile(stored) == "draining"
+    assert env.kube.get_opt(Pod, "daemon") is None
+    # pass 3: drained -> instance deleted, finalizer off, node gone
+    assert ctrl.reconcile(stored) == "done"
+    assert env.kube.get_opt(Node, "n1", "") is None
+    assert len(env.cloud_provider.delete_calls) == 1
+
+
+def test_drain_honors_pdb():
+    env = Env()
+    env.create(make_nodepool())
+    env.create(PodDisruptionBudget(
+        metadata=ObjectMeta(name="pdb"),
+        selector=LabelSelector(match_labels={"app": "web"}),
+        min_available=1,
+    ))
+    node, claim = env.create_candidate_node("n1", pods=[
+        make_pod(name="web-1", cpu=0.1, labels={"app": "web"}),
+    ])
+    stored = env.kube.get(Node, "n1", "")
+    stored.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
+    env.kube.update(stored)
+    env.kube.delete(Node, "n1", "")
+    ctrl = NodeTerminationController(env.kube, env.cloud_provider, env.clock,
+                                     env.recorder)
+    assert ctrl.reconcile(stored) == "draining"
+    assert env.kube.get_opt(Pod, "web-1") is not None  # PDB blocked
+    assert env.recorder.count("EvictionBlocked") == 1
+    # a second replica elsewhere frees the budget
+    env.create(make_pod(name="web-2", cpu=0.1, labels={"app": "web"},
+                        node_name="other", phase="Running"))
+    ctrl.reconcile(stored)
+    assert env.kube.get_opt(Pod, "web-1") is None
+
+
+# -- nodepool hash / counter / lease gc ---------------------------------------
+
+
+def test_hash_controller_stamps_and_preserves_drift_signal():
+    env = Env()
+    pool = make_nodepool()
+    env.create(pool)
+    claim = make_nodeclaim(name="c1")
+    env.create(claim)
+    NodePoolHashController(env.kube).reconcile_all()
+    from karpenter_tpu.apis.nodepool import NodePool
+
+    assert env.kube.get(NodePool, "default", "").metadata.annotations[
+        wk.NODEPOOL_HASH_ANNOTATION_KEY
+    ] == pool.hash()
+    assert env.kube.get(NodeClaim, "c1", "").metadata.annotations[
+        wk.NODEPOOL_HASH_ANNOTATION_KEY
+    ] == pool.hash()
+    # a stale claim hash is the drift signal: never overwritten
+    stored = env.kube.get(NodeClaim, "c1", "")
+    stored.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] = "old"
+    env.kube.update(stored)
+    NodePoolHashController(env.kube).reconcile_all()
+    assert env.kube.get(NodeClaim, "c1", "").metadata.annotations[
+        wk.NODEPOOL_HASH_ANNOTATION_KEY
+    ] == "old"
+
+
+def test_counter_aggregates_pool_resources():
+    env = Env()
+    env.create(make_nodepool())
+    env.create_candidate_node("n1")
+    env.create_candidate_node("n2")
+    NodePoolCounterController(env.kube).reconcile_all()
+    from karpenter_tpu.apis.nodepool import NodePool
+
+    got = env.kube.get(NodePool, "default", "")
+    # two default-instance-type nodes, counted once each (claim+node dedup)
+    assert got.status.resources["cpu"] == 8.0
+
+
+def test_lease_gc():
+    env = Env()
+    env.create(make_node(name="n1", provider_id="p1"))
+    env.create(Lease(metadata=ObjectMeta(name="n1", namespace="kube-node-lease"),
+                     holder_identity="n1"))
+    env.create(Lease(metadata=ObjectMeta(name="ghost", namespace="kube-node-lease"),
+                     holder_identity="ghost"))
+    assert LeaseGarbageCollectionController(env.kube).reconcile_all() == 1
+    assert env.kube.get_opt(Lease, "n1", "kube-node-lease") is not None
+    assert env.kube.get_opt(Lease, "ghost", "kube-node-lease") is None
