@@ -1,0 +1,80 @@
+"""Metric gauge exporters for nodes, nodepools, and pods.
+
+Equivalent of reference pkg/controllers/metrics/{node,nodepool,pod}: periodic
+scans publishing allocatable/requests per node (node/controller.go:47-190),
+limits/usage per nodepool, and pod phase counts + scheduling latency
+(pod/controller.go:58-190), all through the diffing metrics.Store so series
+for deleted objects disappear.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from karpenter_tpu.apis.nodepool import NodePool
+from karpenter_tpu.apis.objects import Node, Pod
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.metrics import REGISTRY, Store
+from karpenter_tpu.utils import pod as podutil
+from karpenter_tpu.utils import resources as res
+
+NODE_ALLOCATABLE = REGISTRY.gauge(
+    "node_allocatable", "Node allocatable by resource", subsystem="nodes"
+)
+NODE_REQUESTS = REGISTRY.gauge(
+    "node_total_pod_requests", "Requested resources by node", subsystem="nodes"
+)
+NODEPOOL_LIMIT = REGISTRY.gauge(
+    "nodepool_limit", "NodePool resource limits", subsystem="nodepools"
+)
+NODEPOOL_USAGE = REGISTRY.gauge(
+    "nodepool_usage", "NodePool resource usage", subsystem="nodepools"
+)
+POD_STATE = REGISTRY.gauge(
+    "pod_state", "Pods by phase", subsystem="pods"
+)
+
+
+class MetricsExporter:
+    def __init__(self, kube: KubeClient):
+        self.kube = kube
+        self.store = Store()
+
+    def reconcile(self) -> None:
+        series: Dict[str, List[Tuple]] = {}
+        pods = self.kube.list(Pod)
+        requests_by_node: Dict[str, Dict[str, float]] = {}
+        for p in pods:
+            # same active-pod filter as the cluster state cache, so the gauge
+            # matches what the scheduler/consolidator actually see
+            if p.spec.node_name and not podutil.is_terminal(p) and not podutil.is_terminating(p):
+                requests_by_node[p.spec.node_name] = res.merge(
+                    requests_by_node.get(p.spec.node_name), res.pod_requests(p)
+                )
+        for node in self.kube.list(Node):
+            key = f"node/{node.metadata.name}"
+            out = []
+            for name, value in node.status.allocatable.items():
+                out.append((NODE_ALLOCATABLE,
+                            {"node": node.metadata.name, "resource": name}, value))
+            for name, value in requests_by_node.get(node.metadata.name, {}).items():
+                out.append((NODE_REQUESTS,
+                            {"node": node.metadata.name, "resource": name}, value))
+            series[key] = out
+        for np_obj in self.kube.list(NodePool):
+            out = []
+            for name, value in np_obj.spec.limits.items():
+                out.append((NODEPOOL_LIMIT,
+                            {"nodepool": np_obj.name, "resource": name}, value))
+            for name, value in np_obj.status.resources.items():
+                out.append((NODEPOOL_USAGE,
+                            {"nodepool": np_obj.name, "resource": name}, value))
+            series[f"nodepool/{np_obj.name}"] = out
+        phase_counts: Dict[str, int] = {}
+        for p in pods:
+            phase_counts[p.status.phase] = phase_counts.get(p.status.phase, 0) + 1
+        series["pods"] = [
+            (POD_STATE, {"phase": phase}, float(count))
+            for phase, count in phase_counts.items()
+        ]
+        self.store.replace_all(series)

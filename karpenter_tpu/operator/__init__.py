@@ -1,0 +1,4 @@
+from karpenter_tpu.operator.operator import Operator
+from karpenter_tpu.operator.options import Options
+
+__all__ = ["Operator", "Options"]

@@ -1,0 +1,30 @@
+"""Logging setup (equivalent of reference pkg/operator/logging/logging.go:
+zap-via-knative there, stdlib logging here; --log-level wires through, and
+debug-event suppression maps to the events logger's level)."""
+
+from __future__ import annotations
+
+import logging
+
+_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+}
+
+
+def configure(log_level: str = "info") -> logging.Logger:
+    level = _LEVELS.get(log_level.lower(), logging.INFO)
+    logging.basicConfig(
+        level=level,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    logger = logging.getLogger("karpenter_tpu")
+    logger.setLevel(level)
+    # debug-event suppression (logging.go): events stay quiet unless debug
+    logging.getLogger("karpenter_tpu.events").setLevel(
+        logging.DEBUG if level == logging.DEBUG else logging.WARNING
+    )
+    return logger

@@ -1,0 +1,237 @@
+"""Operator — assembles and runs the whole framework.
+
+Equivalent of reference pkg/operator/operator.go plus
+pkg/controllers/controllers.go:47-82 (the definitive controller registry) and
+the Singleton loop abstraction (operator/controller/singleton.go:53-182).
+
+The reference runs each controller on controller-runtime goroutines; here
+every controller exposes a poll-style reconcile and the Operator drives them
+either cooperatively (``step()`` — deterministic, what tests and simulations
+use) or on real threads (``start()``). The watch-driven paths (informers, the
+provisioning trigger) stay event-driven through the kube store's synchronous
+watch fan-out either way.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional
+
+from karpenter_tpu.cloudprovider.metrics import MetricsCloudProvider
+from karpenter_tpu.cloudprovider.types import CloudProvider
+from karpenter_tpu.controllers.metrics_exporters import MetricsExporter
+from karpenter_tpu.controllers.nodeclaim_consistency import (
+    ConsistencyController,
+    POLL_PERIOD_SECONDS as CONSISTENCY_PERIOD,
+)
+from karpenter_tpu.controllers.nodeclaim_disruption import DisruptionMarkerController
+from karpenter_tpu.controllers.nodeclaim_garbagecollection import (
+    GarbageCollectionController,
+    POLL_PERIOD_SECONDS as GC_PERIOD,
+)
+from karpenter_tpu.controllers.nodeclaim_lifecycle import LifecycleController
+from karpenter_tpu.controllers.nodeclaim_termination import TerminationController
+from karpenter_tpu.controllers.node_termination import NodeTerminationController
+from karpenter_tpu.controllers.nodepool_controllers import (
+    LeaseGarbageCollectionController,
+    NodePoolCounterController,
+    NodePoolHashController,
+)
+from karpenter_tpu.disruption.controller import (
+    Controller as DisruptionController,
+    POLL_PERIOD_SECONDS as DISRUPTION_PERIOD,
+)
+from karpenter_tpu.events import Recorder
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.provisioning.batcher import Batcher
+from karpenter_tpu.provisioning.controller import watch_pods
+from karpenter_tpu.provisioning.provisioner import Provisioner
+from karpenter_tpu.operator.options import Options
+from karpenter_tpu.solver.jax_backend import JaxSolver
+from karpenter_tpu.solver.oracle import OracleSolver
+from karpenter_tpu.state.cluster import Cluster
+from karpenter_tpu.state.informer import start_informers
+from karpenter_tpu.utils.clock import Clock
+
+
+@dataclass
+class _Registered:
+    name: str
+    reconcile: Callable[[], object]
+    period_s: float
+    next_run: float = 0.0
+
+
+class Operator:
+    def __init__(
+        self,
+        cloud_provider: CloudProvider,
+        options: Optional[Options] = None,
+        kube: Optional[KubeClient] = None,
+        clock: Optional[Clock] = None,
+        recorder: Optional[Recorder] = None,
+    ):
+        self.options = options or Options()
+        self.clock = clock or Clock()
+        self.kube = kube or KubeClient(clock=self.clock)
+        self.recorder = recorder or Recorder(clock=self.clock)
+        # method-duration decorator around the provider (cloudprovider/metrics)
+        self.cloud_provider = MetricsCloudProvider(cloud_provider)
+        self.cluster = Cluster(self.kube, self.clock)
+        solver = (
+            JaxSolver() if self.options.solver_backend == "jax" else OracleSolver()
+        )
+        self.provisioner = Provisioner(
+            self.kube, self.cloud_provider, self.cluster, self.clock,
+            self.recorder, solver=solver,
+        )
+        self.batcher = Batcher(
+            self.clock,
+            idle_duration=self.options.batch_idle_duration_s,
+            max_duration=self.options.batch_max_duration_s,
+        )
+        self.disruption = DisruptionController(
+            self.kube, self.cluster, self.provisioner, self.cloud_provider,
+            self.clock, self.recorder,
+        )
+        self.lifecycle = LifecycleController(
+            self.kube, self.cloud_provider, self.clock, self.recorder
+        )
+        self.markers = DisruptionMarkerController(
+            self.kube, self.cloud_provider, self.clock,
+            drift_enabled=self.options.drift_enabled(),
+        )
+        self.claim_termination = TerminationController(self.kube, self.cloud_provider)
+        self.node_termination = NodeTerminationController(
+            self.kube, self.cloud_provider, self.clock, self.recorder
+        )
+        self.gc = GarbageCollectionController(
+            self.kube, self.cloud_provider, self.clock, self.recorder
+        )
+        self.consistency = ConsistencyController(self.kube, self.clock, self.recorder)
+        self.nodepool_hash = NodePoolHashController(self.kube)
+        self.nodepool_counter = NodePoolCounterController(self.kube)
+        self.lease_gc = LeaseGarbageCollectionController(self.kube)
+        self.metrics_exporter = MetricsExporter(self.kube)
+        self._controllers: List[_Registered] = []
+        self._stop = threading.Event()
+        self._wired = False
+
+    # -- registry (controllers.go:47-82) --------------------------------------
+
+    def wire(self) -> "Operator":
+        """Attach informers/watches and register every polling controller."""
+        if self._wired:
+            return self
+        start_informers(self.kube, self.cluster)
+        watch_pods(self.kube, self.batcher)
+        reg = [
+            ("provisioner", self._provision_once, 1.0),
+            ("disruption", self.disruption.reconcile, DISRUPTION_PERIOD),
+            ("nodeclaim.lifecycle", self.lifecycle.reconcile_all, 1.0),
+            ("nodeclaim.markers", self.markers.reconcile_all, 10.0),
+            ("nodeclaim.termination", self.claim_termination.reconcile_all, 1.0),
+            ("node.termination", self.node_termination.reconcile_all, 1.0),
+            ("nodeclaim.garbagecollection", self.gc.reconcile, GC_PERIOD),
+            ("nodeclaim.consistency", self.consistency.reconcile, CONSISTENCY_PERIOD),
+            ("nodepool.hash", self.nodepool_hash.reconcile_all, 10.0),
+            ("nodepool.counter", self.nodepool_counter.reconcile_all, 10.0),
+            ("lease.garbagecollection", self.lease_gc.reconcile_all, 120.0),
+            ("metrics", self.metrics_exporter.reconcile, 10.0),
+        ]
+        now = self.clock.now()
+        self._controllers = [
+            _Registered(name=n, reconcile=r, period_s=p, next_run=now)
+            for n, r, p in reg
+        ]
+        self._wired = True
+        return self
+
+    def _provision_once(self):
+        # the batcher gates real runs; in cooperative mode we only provision
+        # when a trigger is pending so step() never blocks on the window
+        if self.batcher._trigger.is_set():
+            self.batcher._trigger.clear()
+            return self.provisioner.reconcile()
+        return None
+
+    # -- cooperative driver (deterministic; the test/simulation mode) ---------
+
+    def step(self) -> List[str]:
+        """Run every controller whose period elapsed; returns their names."""
+        self.wire()
+        ran = []
+        now = self.clock.now()
+        for c in self._controllers:
+            if now >= c.next_run:
+                c.reconcile()
+                c.next_run = now + c.period_s
+                ran.append(c.name)
+        return ran
+
+    def run_until_settled(self, max_steps: int = 50) -> int:
+        """Step until a full pass changes nothing in the store (test helper)."""
+        self.wire()
+        steps = 0
+        for _ in range(max_steps):
+            before = self.kube._rv
+            for c in self._controllers:
+                c.reconcile()
+            steps += 1
+            if self.kube._rv == before:
+                break
+        return steps
+
+    # -- threaded driver (operator.go:223) ------------------------------------
+
+    def start(self) -> None:
+        from karpenter_tpu.operator import logging as oplog
+        from karpenter_tpu.operator import serving
+        from karpenter_tpu.provisioning.controller import ProvisioningLoop
+
+        self.wire()
+        self._stop.clear()
+        logger = oplog.configure(self.options.log_level)
+        self._server = serving.serve(self.options.metrics_port)
+        if self.options.enable_profiling:
+            serving.start_profiler()
+
+        def loop(name, reconcile, period):
+            while not self._stop.is_set():
+                try:
+                    reconcile()
+                except Exception:
+                    # a controller error must never kill its loop
+                    # (singleton.go requeues on error the same way)
+                    logger.exception("controller %s reconcile failed", name)
+                # Event.wait, not clock.sleep: stop() interrupts promptly
+                self._stop.wait(period)
+
+        # threaded mode provisions through the real batch window
+        # (ProvisioningLoop blocks in Batcher.wait, singleton.go:81)
+        prov_loop = ProvisioningLoop(self.provisioner, self.batcher)
+        self._threads = [
+            threading.Thread(
+                target=loop, args=("provisioner", prov_loop.run_once, 0.0),
+                daemon=True, name="karpenter-tpu/provisioner",
+            )
+        ]
+        self._threads += [
+            threading.Thread(target=loop, args=(c.name, c.reconcile, c.period_s),
+                             daemon=True, name=f"karpenter-tpu/{c.name}")
+            for c in self._controllers
+            if c.name != "provisioner"
+        ]
+        for t in self._threads:
+            t.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        server = getattr(self, "_server", None)
+        if server is not None:
+            server.shutdown()
+        if self.options.enable_profiling:
+            from karpenter_tpu.operator import serving
+
+            serving.stop_profiler()

@@ -1,0 +1,96 @@
+"""Operator configuration.
+
+Equivalent of reference pkg/operator/options/options.go:47-150: a flat Options
+struct populated flags-first with environment-variable fallback, carried to
+every decision point (the reference threads it through context; we pass the
+object explicitly).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import dataclass, field, fields
+from typing import Dict, List, Optional
+
+
+def _env_name(flag: str) -> str:
+    return flag.upper().replace("-", "_")
+
+
+@dataclass
+class Options:
+    # service ports (options.go:49-55); Operator.start() serves /metrics on
+    # metrics_port and /healthz on health_probe_port
+    metrics_port: int = 8000
+    health_probe_port: int = 8081
+    # apiserver client tuning; carried for configuration-surface parity — the
+    # in-memory kube client has no rate limiter to tune (options.go:56-60)
+    kube_client_qps: int = 200
+    kube_client_burst: int = 300
+    # leader election: parity field; this runtime is single-process, a
+    # deployment wrapper running multiple replicas must provide its own lock
+    enable_leader_election: bool = True
+    # memory limit fraction mirrored from GOMEMLIMIT (operator.go:110-113);
+    # parity field — Python has no equivalent soft-limit knob
+    memory_limit_fraction: float = 0.9
+    # batching window (options.go:95-96)
+    batch_max_duration_s: float = 10.0
+    batch_idle_duration_s: float = 1.0
+    # profiling (operator.go:164-180); enables jax profiler traces here
+    enable_profiling: bool = False
+    # feature gates (options.go:97,123-137)
+    feature_gates: Dict[str, bool] = field(default_factory=lambda: {"Drift": True})
+    log_level: str = "info"
+    # solver backend for the scheduling cores: "jax" or "oracle"
+    solver_backend: str = "jax"
+
+    def drift_enabled(self) -> bool:
+        return self.feature_gates.get("Drift", True)
+
+    @classmethod
+    def parse(cls, argv: Optional[List[str]] = None,
+              env: Optional[Dict[str, str]] = None) -> "Options":
+        """Flags win over env vars over defaults (options.go:82-121).
+        argv=None reads sys.argv[1:], the standard argparse contract."""
+        import sys
+
+        if argv is None:
+            argv = sys.argv[1:]
+        env = dict(os.environ if env is None else env)
+        defaults = cls()
+        parser = argparse.ArgumentParser(prog="karpenter-tpu", add_help=False)
+        for f in fields(cls):
+            if f.name == "feature_gates":
+                continue
+            flag = "--" + f.name.replace("_", "-")
+            env_val = env.get(_env_name(f.name))
+            default = getattr(defaults, f.name)
+            if env_val is not None:
+                if f.type == "bool" or isinstance(default, bool):
+                    default = env_val.lower() in ("1", "true", "yes")
+                elif isinstance(default, int):
+                    default = int(env_val)
+                elif isinstance(default, float):
+                    default = float(env_val)
+                else:
+                    default = env_val
+            if isinstance(default, bool):
+                parser.add_argument(flag, dest=f.name, default=default,
+                                    type=lambda s: s.lower() in ("1", "true", "yes"))
+            else:
+                parser.add_argument(flag, dest=f.name, default=default,
+                                    type=type(default))
+        parser.add_argument("--feature-gates", dest="feature_gates",
+                            default=env.get("FEATURE_GATES", ""))
+        ns = parser.parse_args(argv)
+        opts = cls(**{f.name: getattr(ns, f.name) for f in fields(cls)
+                      if f.name != "feature_gates"})
+        gates = dict(defaults.feature_gates)
+        raw = ns.feature_gates
+        if raw:
+            for pair in raw.split(","):
+                name, _, value = pair.partition("=")
+                gates[name.strip()] = value.strip().lower() in ("1", "true", "yes")
+        opts.feature_gates = gates
+        return opts

@@ -1,0 +1,84 @@
+"""Metrics + health endpoints.
+
+Equivalent of the reference's metrics port and health probes
+(operator.go:139-182): /metrics serves the registry in Prometheus text
+format, /healthz and /readyz answer 200. --enable-profiling maps to the JAX
+profiler (the reference mounts net/http/pprof; the TPU-native analogue is a
+jax.profiler trace, SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from karpenter_tpu.metrics import REGISTRY
+
+
+def _series(name: str, labels, value) -> str:
+    if labels:
+        label_s = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+        return f"{name}{{{label_s}}} {value}"
+    return f"{name} {value}"
+
+
+def render_prometheus() -> str:
+    lines = []
+    for kind, name, labels, value in REGISTRY.collect():
+        if kind == "histogram":
+            lines.append(_series(name + "_count", labels, value["count"]))
+            lines.append(_series(name + "_sum", labels, value["sum"]))
+        else:
+            lines.append(_series(name, labels, value))
+    return "\n".join(lines) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802 (http.server API)
+        if self.path.startswith("/metrics"):
+            body = render_prometheus().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+        elif self.path.startswith(("/healthz", "/readyz")):
+            body = b"ok\n"
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain")
+        else:
+            body = b"not found\n"
+            self.send_response(404)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # quiet
+        pass
+
+
+def serve(port: int) -> ThreadingHTTPServer:
+    """Start the endpoint server on a daemon thread; returns the server (call
+    .shutdown() to stop)."""
+    server = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+    threading.Thread(target=server.serve_forever, daemon=True,
+                     name="karpenter-tpu/metrics").start()
+    return server
+
+
+def start_profiler(trace_dir: str = "/tmp/karpenter-tpu-profile") -> Optional[str]:
+    """--enable-profiling: begin a jax profiler trace (SURVEY.md §5)."""
+    try:
+        import jax
+
+        jax.profiler.start_trace(trace_dir)
+        return trace_dir
+    except Exception:
+        return None
+
+
+def stop_profiler() -> None:
+    try:
+        import jax
+
+        jax.profiler.stop_trace()
+    except Exception:
+        pass

@@ -1,0 +1,132 @@
+"""Operator suite: options parsing, the wired registry, and an end-to-end
+cooperative run covering provision → lifecycle → consolidation."""
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodeclaim import NodeClaim
+from karpenter_tpu.apis.nodepool import Budget, Disruption as DisruptionPolicy
+from karpenter_tpu.apis.objects import Node, NodeCondition, NodeSpec, NodeStatus, ObjectMeta, Pod
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_tpu.operator import Operator, Options
+from karpenter_tpu.utils.clock import FakeClock
+
+from tests.factories import make_nodepool, make_pod
+
+
+def test_options_flags_env_defaults():
+    opts = Options.parse([], env={})
+    assert opts.batch_max_duration_s == 10.0
+    assert opts.drift_enabled()
+    opts = Options.parse([], env={"BATCH_MAX_DURATION_S": "5", "LOG_LEVEL": "debug"})
+    assert opts.batch_max_duration_s == 5.0 and opts.log_level == "debug"
+    opts = Options.parse(
+        ["--batch-max-duration-s", "3", "--feature-gates", "Drift=false"],
+        env={"BATCH_MAX_DURATION_S": "5"},
+    )
+    assert opts.batch_max_duration_s == 3.0  # flag beats env
+    assert not opts.drift_enabled()
+
+
+def make_operator():
+    clock = FakeClock()
+    cp = FakeCloudProvider()
+    cp.drifted = ""
+    op = Operator(cp, options=Options(solver_backend="oracle"), clock=clock)
+    return op, clock
+
+
+def kubelet_registers(op):
+    """Fake the kubelet: create a Ready Node for every launched claim."""
+    for claim in op.kube.list(NodeClaim):
+        if not claim.status.provider_id or claim.status.node_name:
+            continue
+        name = f"node-{claim.metadata.name}"
+        if op.kube.get_opt(Node, name, "") is not None:
+            continue
+        op.kube.create(Node(
+            metadata=ObjectMeta(name=name, namespace="", labels={
+                **claim.metadata.labels, wk.LABEL_HOSTNAME: name,
+            }),
+            spec=NodeSpec(provider_id=claim.status.provider_id),
+            status=NodeStatus(capacity=dict(claim.status.capacity),
+                              allocatable=dict(claim.status.allocatable),
+                              conditions=[NodeCondition(type="Ready")]),
+        ))
+
+
+def test_end_to_end_provision_and_initialize():
+    op, clock = make_operator()
+    op.kube.create(make_nodepool())
+    op.kube.create(make_pod(name="p1", cpu=1.0))
+    op.step()  # provisioner fires off the pending-pod trigger
+    claims = op.kube.list(NodeClaim)
+    assert len(claims) == 1
+    op.run_until_settled()   # lifecycle launches
+    kubelet_registers(op)
+    op.run_until_settled()   # register + initialize + hash + counter
+    claim = op.kube.list(NodeClaim)[0]
+    assert claim.is_initialized()
+    from karpenter_tpu.apis.nodepool import NodePool
+
+    pool = op.kube.get(NodePool, "default", "")
+    assert pool.status.resources.get("cpu", 0) > 0
+    assert pool.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] == pool.hash()
+
+
+def test_end_to_end_consolidates_empty_node():
+    op, clock = make_operator()
+    op.kube.create(make_nodepool(disruption=DisruptionPolicy(
+        consolidation_policy="WhenUnderutilized", budgets=[Budget(nodes="100%")],
+    )))
+    op.kube.create(make_pod(name="p1", cpu=1.0))
+    op.step()
+    op.run_until_settled()
+    kubelet_registers(op)
+    op.run_until_settled()
+    # the pod goes away; its node is now empty and consolidatable
+    op.kube.delete(Pod, "p1")
+    clock.step(15)
+    op.run_until_settled(max_steps=80)
+    assert op.kube.list(NodeClaim) == []
+    assert op.kube.list(Node) == []
+
+
+def test_threaded_start_serves_metrics_and_survives_errors():
+    import socket
+    import urllib.request
+
+    from karpenter_tpu.utils.clock import Clock
+
+    cp = FakeCloudProvider()
+    cp.drifted = ""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    op = Operator(cp, options=Options(solver_backend="oracle", metrics_port=port),
+                  clock=Clock())
+    op.kube.create(make_nodepool())
+    # an error-injecting provider must not kill the lifecycle thread
+    cp.errors_for_nodepool["default"] = RuntimeError("boom")
+    op.start()
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ).read().decode()
+        assert "karpenter" in body
+        health = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=5
+        ).read()
+        assert health == b"ok\n"
+    finally:
+        op.stop()
+
+
+def test_step_respects_periods():
+    op, clock = make_operator()
+    op.kube.create(make_nodepool())
+    ran = set(op.step())
+    assert "disruption" in ran and "metrics" in ran
+    # immediately stepping again runs nothing (all periods pending)
+    assert op.step() == []
+    clock.step(10.5)
+    ran = set(op.step())
+    assert "disruption" in ran
