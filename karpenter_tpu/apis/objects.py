@@ -390,6 +390,16 @@ class PodDisruptionBudget:
 
 
 @dataclass
+class CSINode:
+    """Per-node CSI driver attach limits (k8s storage.k8s.io/v1 CSINode);
+    name matches the Node. Feeds VolumeUsage.ExceedsLimits."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    # driver name -> allocatable volume attachments
+    driver_limits: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
 class Lease:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     holder_identity: str = ""

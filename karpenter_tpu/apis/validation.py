@@ -1,0 +1,174 @@
+"""API validation.
+
+Equivalent of reference pkg/apis/v1beta1/{nodepool,nodeclaim}_validation*.go:
+the CEL rules embedded in the CRD schema plus the webhook-path
+RuntimeValidate. The provisioner calls validate_nodepool before building a
+template (provisioner.go:214-228) and skips invalid pools with an event.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodeclaim import NodeClaim
+from karpenter_tpu.apis.nodepool import (
+    CONSOLIDATION_POLICY_WHEN_EMPTY,
+    CONSOLIDATION_POLICY_WHEN_UNDERUTILIZED,
+    NodePool,
+    parse_duration,
+)
+from karpenter_tpu.apis.objects import (
+    DOES_NOT_EXIST,
+    EXISTS,
+    GT,
+    IN,
+    LT,
+    NO_EXECUTE,
+    NO_SCHEDULE,
+    NOT_IN,
+    NodeSelectorRequirement,
+    PREFER_NO_SCHEDULE,
+    Taint,
+)
+from karpenter_tpu.utils import cron as cronutil
+
+SUPPORTED_OPERATORS = {IN, NOT_IN, EXISTS, DOES_NOT_EXIST, GT, LT}
+SUPPORTED_EFFECTS = {NO_SCHEDULE, PREFER_NO_SCHEDULE, NO_EXECUTE}
+
+_QUALIFIED_NAME = re.compile(r"^[A-Za-z0-9]([A-Za-z0-9._-]{0,61}[A-Za-z0-9])?$")
+_LABEL_VALUE = re.compile(r"^([A-Za-z0-9]([A-Za-z0-9._-]{0,61}[A-Za-z0-9])?)?$")
+
+
+def _validate_label_key(key: str) -> Optional[str]:
+    name = key.rsplit("/", 1)[-1]
+    if not name or not _QUALIFIED_NAME.match(name):
+        return f"invalid label key {key!r}"
+    return None
+
+
+def validate_requirement(req: NodeSelectorRequirement) -> List[str]:
+    """One requirement's rules (nodepool_validation.go requirement checks)."""
+    errs = []
+    key_err = _validate_label_key(req.key)
+    if key_err:
+        errs.append(key_err)
+    restricted = wk.is_restricted_label(req.key)
+    if restricted:
+        errs.append(f"{req.key}: {restricted}")
+    if req.operator not in SUPPORTED_OPERATORS:
+        errs.append(f"{req.key}: unsupported operator {req.operator!r}")
+        return errs
+    if req.operator == IN and not req.values:
+        errs.append(f"{req.key}: In requires at least one value")
+    if req.operator in (EXISTS, DOES_NOT_EXIST) and req.values:
+        errs.append(f"{req.key}: {req.operator} must not have values")
+    if req.operator in (GT, LT):
+        if len(req.values) != 1:
+            errs.append(f"{req.key}: {req.operator} requires exactly one value")
+        elif not str(req.values[0]).lstrip("-").isdigit():
+            errs.append(f"{req.key}: {req.operator} value must be an integer")
+    for v in req.values:
+        if not _LABEL_VALUE.match(str(v)):
+            errs.append(f"{req.key}: invalid value {v!r}")
+    return errs
+
+
+def validate_taint(taint: Taint) -> List[str]:
+    errs = []
+    key_err = _validate_label_key(taint.key)
+    if key_err:
+        errs.append(f"taint {key_err}")
+    if taint.effect not in SUPPORTED_EFFECTS:
+        errs.append(f"taint {taint.key}: unsupported effect {taint.effect!r}")
+    if taint.value and not _LABEL_VALUE.match(taint.value):
+        errs.append(f"taint {taint.key}: invalid value {taint.value!r}")
+    return errs
+
+
+def validate_nodepool(np_obj: NodePool) -> List[str]:
+    """RuntimeValidate (nodepool_validation.go); empty list means valid."""
+    errs: List[str] = []
+    tpl = np_obj.spec.template
+    for req in tpl.spec.requirements:
+        errs.extend(validate_requirement(req))
+    seen = set()
+    for req in tpl.spec.requirements:
+        if (req.key, req.operator) in seen:
+            errs.append(f"{req.key}: duplicate requirement with operator {req.operator}")
+        seen.add((req.key, req.operator))
+    for taint in list(tpl.spec.taints) + list(tpl.spec.startup_taints):
+        errs.extend(validate_taint(taint))
+    for key in tpl.labels:
+        restricted = wk.is_restricted_label(key)
+        if restricted:
+            errs.append(f"label {key}: {restricted}")
+        key_err = _validate_label_key(key)
+        if key_err:
+            errs.append(key_err)
+
+    d = np_obj.spec.disruption
+    if d.consolidation_policy not in (
+        CONSOLIDATION_POLICY_WHEN_EMPTY, CONSOLIDATION_POLICY_WHEN_UNDERUTILIZED
+    ):
+        errs.append(f"unsupported consolidationPolicy {d.consolidation_policy!r}")
+    if d.consolidate_after is not None:
+        if d.consolidation_policy != CONSOLIDATION_POLICY_WHEN_EMPTY:
+            # consolidateAfter is WhenEmpty-only (nodepool.go:75-83 CEL rule)
+            errs.append("consolidateAfter is only allowed with policy WhenEmpty")
+        else:
+            try:
+                parse_duration(d.consolidate_after)
+            except ValueError as e:
+                errs.append(f"consolidateAfter: {e}")
+    elif d.consolidation_policy == CONSOLIDATION_POLICY_WHEN_EMPTY:
+        errs.append("consolidateAfter is required with policy WhenEmpty")
+    try:
+        parse_duration(d.expire_after)
+    except ValueError as e:
+        errs.append(f"expireAfter: {e}")
+    for budget in d.budgets:
+        nodes = budget.nodes.strip()
+        if nodes.endswith("%"):
+            body = nodes[:-1]
+            if not body.isdigit() or not (0 <= int(body) <= 100):
+                errs.append(f"budget nodes {budget.nodes!r}: invalid percentage")
+        elif not nodes.isdigit():
+            errs.append(f"budget nodes {budget.nodes!r}: must be an int or percentage")
+        if (budget.schedule is None) != (budget.duration is None):
+            errs.append("budget schedule and duration must be set together")
+        if budget.schedule is not None:
+            try:
+                cronutil.parse(budget.schedule)
+            except ValueError as e:
+                errs.append(f"budget schedule: {e}")
+        if budget.duration is not None:
+            try:
+                parse_duration(budget.duration)
+            except ValueError as e:
+                errs.append(f"budget duration: {e}")
+
+    for name, value in np_obj.spec.limits.items():
+        if value < 0:
+            errs.append(f"limit {name}: must be non-negative")
+    if np_obj.spec.weight is not None and not (1 <= np_obj.spec.weight <= 100):
+        errs.append("weight must be between 1 and 100")
+    return errs
+
+
+def validate_nodeclaim(claim: NodeClaim) -> List[str]:
+    """RuntimeValidate (nodeclaim_validation.go)."""
+    errs: List[str] = []
+    for req in claim.spec.requirements:
+        # the nodepool ownership label is stamped by the provisioner itself
+        # and is legal on claims (launched claims always carry it)
+        if req.key == wk.NODEPOOL_LABEL_KEY:
+            continue
+        errs.extend(validate_requirement(req))
+    for taint in list(claim.spec.taints) + list(claim.spec.startup_taints):
+        errs.extend(validate_taint(taint))
+    for name, value in claim.spec.resource_requests.items():
+        if value < 0:
+            errs.append(f"resource request {name}: must be non-negative")
+    return errs

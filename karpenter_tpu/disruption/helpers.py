@@ -158,6 +158,7 @@ def simulate_scheduling(
         nodes=inputs.nodes,
         cluster_pods=inputs.cluster_pods,
         domains=inputs.domains,
+        pod_volumes=inputs.pod_volumes,
     )
     return SimulationResults(
         result=result,

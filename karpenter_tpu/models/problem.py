@@ -181,6 +181,12 @@ class SchedulingProblem:
     node_avail: Any
     node_overhead: Any
     node_used_ports: Any
+    # CSI attach limits (volumeusage.go); D = drivers with a limit on some
+    # node. Count-based (per-pod) semantics — conservative vs the host-side
+    # unique-volume sets (see scheduling/volumeusage.py docstring)
+    pod_vol_counts: Any  # i32[P, D]
+    node_vol_used: Any  # i32[N, D]
+    node_vol_limits: Any  # i32[N, D]  (huge when unlimited)
     # topology
     grp_type: Any
     grp_key: Any

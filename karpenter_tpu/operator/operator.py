@@ -193,7 +193,9 @@ class Operator:
         self.wire()
         self._stop.clear()
         logger = oplog.configure(self.options.log_level)
-        self._server = serving.serve(self.options.metrics_port)
+        self._servers = [serving.serve(self.options.metrics_port)]
+        if self.options.health_probe_port != self.options.metrics_port:
+            self._servers.append(serving.serve(self.options.health_probe_port))
         if self.options.enable_profiling:
             serving.start_profiler()
 
@@ -228,8 +230,7 @@ class Operator:
 
     def stop(self) -> None:
         self._stop.set()
-        server = getattr(self, "_server", None)
-        if server is not None:
+        for server in getattr(self, "_servers", []):
             server.shutdown()
         if self.options.enable_profiling:
             from karpenter_tpu.operator import serving

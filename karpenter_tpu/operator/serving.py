@@ -55,12 +55,13 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
 
-def serve(port: int) -> ThreadingHTTPServer:
+def serve(port: int, host: str = "") -> ThreadingHTTPServer:
     """Start the endpoint server on a daemon thread; returns the server (call
-    .shutdown() to stop)."""
-    server = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+    .shutdown() to stop). Binds all interfaces by default so in-cluster
+    probes/scrapes against the pod IP work."""
+    server = ThreadingHTTPServer((host, port), _Handler)
     threading.Thread(target=server.serve_forever, daemon=True,
-                     name="karpenter-tpu/metrics").start()
+                     name=f"karpenter-tpu/serve-{port}").start()
     return server
 
 

@@ -65,6 +65,7 @@ class FFDState:
     node_requests: Any  # f32[N, R] accumulated requests (incl daemon overhead)
     node_npods: Any  # i32[N]
     node_used_ports: Any  # bool[N, PT]
+    node_vol_used: Any  # i32[N, D] CSI attach counts per limited driver
     remaining: Any  # f32[TPL, R] nodepool limits headroom (+inf unlimited)
     grp_counts: Any  # i32[G, V] topology domain counts
     grp_registered: Any  # bool[G, V] known topology domains
@@ -111,6 +112,7 @@ def initial_state(problem: SchedulingProblem, max_claims: int) -> FFDState:
         node_requests=jnp.asarray(problem.node_overhead),
         node_npods=jnp.zeros((N,), dtype=jnp.int32),
         node_used_ports=jnp.asarray(problem.node_used_ports),
+        node_vol_used=jnp.asarray(problem.node_vol_used),
         remaining=jnp.asarray(problem.tpl_remaining),
         grp_counts=jnp.asarray(problem.grp_counts0),
         grp_registered=jnp.asarray(problem.grp_registered0),
@@ -226,6 +228,7 @@ def _solve_ffd_jit(problem: SchedulingProblem, init: FFDState) -> FFDResult:
             grp_match,
             grp_selects,
             grp_owned,
+            pod_vols,
         ) = pod
         topo_pod = PodTopoStatics(
             strict_admitted=pod_strict.admitted,
@@ -241,11 +244,15 @@ def _solve_ffd_jit(problem: SchedulingProblem, init: FFDState) -> FFDResult:
             lambda nr: masks.compatible_ok(nr, pod_req, lv, ln, no_allow)
         )(state.node_req)
         node_port_ok = ~jnp.any(state.node_used_ports & pod_conflict[None, :], axis=-1)
+        # CSI attach limits gate existing nodes only (existingnode.go:100-106)
+        node_vol_ok = jnp.all(
+            state.node_vol_used + pod_vols[None, :] <= problem.node_vol_limits, axis=-1
+        )
         node_merged = _intersect_rows(state.node_req, pod_req)
         node_topo_ok, node_final = topo_gate(
             problem, state.grp_counts, state.grp_registered, topo_pod, node_merged, no_allow
         )
-        node_ok = tol_node & node_fit & node_compat & node_port_ok & node_topo_ok
+        node_ok = tol_node & node_fit & node_compat & node_port_ok & node_vol_ok & node_topo_ok
         node_pick = _first_true(node_ok)
         any_node = jnp.any(node_ok)
 
@@ -355,6 +362,7 @@ def _solve_ffd_jit(problem: SchedulingProblem, init: FFDState) -> FFDResult:
         new_node_requests = jnp.where(node_hot[:, None], node_requests2, state.node_requests)
         new_node_npods = state.node_npods + node_hot.astype(jnp.int32)
         new_node_used_ports = state.node_used_ports | (node_hot[:, None] & pod_ports[None, :])
+        new_node_vol_used = state.node_vol_used + node_hot[:, None].astype(jnp.int32) * pod_vols[None, :]
 
         # claim commit (nodeclaim.go:111-118)
         slot_req = gather_row(tpl_final, tpl_pick, TPL)
@@ -454,6 +462,7 @@ def _solve_ffd_jit(problem: SchedulingProblem, init: FFDState) -> FFDResult:
             node_requests=new_node_requests,
             node_npods=new_node_npods,
             node_used_ports=new_node_used_ports,
+            node_vol_used=new_node_vol_used,
             remaining=new_remaining,
             grp_counts=new_counts,
             grp_registered=new_registered,
@@ -471,6 +480,7 @@ def _solve_ffd_jit(problem: SchedulingProblem, init: FFDState) -> FFDResult:
         jnp.asarray(problem.pod_grp_match),
         jnp.asarray(problem.pod_grp_selects),
         jnp.asarray(problem.pod_grp_owned),
+        jnp.asarray(problem.pod_vol_counts),
     )
     final_state, (kinds, indices) = lax.scan(step, init, pods_xs)
     return FFDResult(kind=kinds, index=indices, state=final_state)

@@ -96,6 +96,11 @@ def pad_problem(p: SchedulingProblem) -> SchedulingProblem:
         node_avail=_pad_capacity(p.node_avail, N, R, -1.0),
         node_overhead=_pad(p.node_overhead, (N, R), 0.0),
         node_used_ports=_pad(p.node_used_ports, (N, PT), False),
+        # D stays unpadded (drivers are few and static per batch); padded
+        # node rows get unlimited headroom so they never gate
+        pod_vol_counts=_pad(p.pod_vol_counts, (P, p.pod_vol_counts.shape[1]), 0),
+        node_vol_used=_pad(p.node_vol_used, (N, p.node_vol_used.shape[1]), 0),
+        node_vol_limits=_pad(p.node_vol_limits, (N, p.node_vol_limits.shape[1]), 2**30),
         grp_type=_pad(p.grp_type, (G,), 0),
         grp_key=_pad(p.grp_key, (G,), 0),
         grp_max_skew=_pad(p.grp_max_skew, (G,), 2**31 - 1),

@@ -17,6 +17,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.apis.nodeclaim import NodeClaim
 from karpenter_tpu.apis.nodepool import NodePool, order_by_weight
+from karpenter_tpu.apis.validation import validate_nodepool
 from karpenter_tpu.apis.objects import IN, ObjectMeta, Pod
 from karpenter_tpu.cloudprovider.types import CloudProvider, InstanceType, order_by_price
 from karpenter_tpu.events import Recorder, object_event
@@ -34,6 +35,12 @@ from karpenter_tpu.solver.encode import (
     TemplateInfo,
     domains_from_instance_types,
     template_from_nodepool,
+)
+from karpenter_tpu.provisioning.volumetopology import VolumeTopology
+from karpenter_tpu.scheduling.volumeusage import (
+    VolumeResolver,
+    VolumeUsage,
+    node_volume_limits,
 )
 from karpenter_tpu.solver.oracle import OracleSolver
 from karpenter_tpu.state.cluster import Cluster
@@ -65,6 +72,9 @@ class SchedulerInputs:
     domains: Dict[str, set]
     cluster_pods: List[Tuple[Pod, Dict[str, str]]]
     nodepools: Dict[str, NodePool] = field(default_factory=dict)
+    # resolved CSI volumes per pod (parallel to pods); None when no CSINode
+    # publishes limits, so the volume path costs nothing
+    pod_volumes: Optional[List[Dict[str, frozenset]]] = None
 
 
 @dataclass
@@ -128,6 +138,7 @@ class Provisioner:
         self.clock = clock
         self.recorder = recorder
         self.solver = solver if solver is not None else OracleSolver()
+        self.volume_topology = VolumeTopology(kube)
 
     # -- pod gathering (provisioner.go:298-327) -------------------------------
 
@@ -170,6 +181,12 @@ class Provisioner:
     # -- scheduler input assembly (provisioner.go:204-296) --------------------
 
     def build_inputs(self, pods: Sequence[Pod]) -> Optional[SchedulerInputs]:
+        # fold volume-implied topology into every pod entering the solve —
+        # pending, drained-node, and consolidation-candidate pods alike
+        # (provisioner.go:284 -> volumetopology.go:41)
+        for pod in pods:
+            if pod.spec.volumes:
+                self.volume_topology.inject(pod)
         nodepools = [
             np
             for np in self.kube.list(NodePool)
@@ -184,6 +201,16 @@ class Provisioner:
         templates: List[TemplateInfo] = []
         pools: Dict[str, NodePool] = {}
         for np_obj in nodepools:
+            # RuntimeValidate: a malformed pool is skipped, not fatal
+            # (provisioner.go:214-228)
+            errors = validate_nodepool(np_obj)
+            if errors:
+                self.recorder.publish(
+                    object_event(
+                        np_obj, "Warning", "FailedValidation", "; ".join(errors)
+                    )
+                )
+                continue
             try:
                 its = self.cloud_provider.get_instance_types(np_obj)
             except Exception as e:  # skip the pool, keep the pass going
@@ -208,12 +235,26 @@ class Provisioner:
         if not templates:
             return None
 
+        from karpenter_tpu.apis.objects import CSINode
+
+        has_csi_limits = len(self.kube.list(CSINode)) > 0
+        resolver = VolumeResolver(self.kube) if has_csi_limits else None
+        bound_by_node: Dict[str, List[Pod]] = {}
+        if has_csi_limits:
+            # one LIST feeds every node's usage computation
+            for p in self.kube.list(Pod):
+                if p.spec.node_name and not podutil.is_terminal(p) \
+                        and not podutil.is_terminating(p):
+                    bound_by_node.setdefault(p.spec.node_name, []).append(p)
         its_by_name = {it.name: it for it in instance_types}
         nodes = []
         for sn in self.cluster.nodes():
             if sn.marked_for_deletion():
                 continue
-            nodes.append(self._node_info(sn, daemon_pods, its_by_name))
+            nodes.append(
+                self._node_info(sn, daemon_pods, its_by_name, resolver,
+                                bound_by_node.get(sn.name, []))
+            )
 
         domains = domains_from_instance_types(instance_types, templates)
         return SchedulerInputs(
@@ -224,6 +265,11 @@ class Provisioner:
             domains=domains,
             cluster_pods=self._cluster_pods(),
             nodepools=pools,
+            pod_volumes=(
+                [resolver.pod_volumes(p) for p in pods]
+                if resolver is not None
+                else None
+            ),
         )
 
     def _node_info(
@@ -231,6 +277,8 @@ class Provisioner:
         sn: StateNode,
         daemon_pods: Sequence[Pod],
         its_by_name: Optional[Dict[str, InstanceType]] = None,
+        resolver: Optional[VolumeResolver] = None,
+        bound_pods: Sequence[Pod] = (),
     ) -> NodeInfo:
         labels = sn.labels()
         requirements = label_requirements(labels)
@@ -278,6 +326,15 @@ class Provisioner:
             overhead = res.positive_part(
                 res.subtract(expected, sn.daemonset_request_total())
             )
+        volume_used: Dict[str, int] = {}
+        volume_limits: Dict[str, int] = {}
+        if resolver is not None:
+            volume_limits = node_volume_limits(self.kube, sn.name)
+            if volume_limits:
+                usage = VolumeUsage()
+                for bound in bound_pods:
+                    usage.add(resolver.pod_volumes(bound))
+                volume_used = usage.counts()
         return NodeInfo(
             name=sn.name,
             requirements=requirements,
@@ -285,6 +342,8 @@ class Provisioner:
             available=available,
             daemon_overhead=overhead,
             host_ports=sn.host_ports(),
+            volume_used=volume_used,
+            volume_limits=volume_limits,
         )
 
     def _cluster_pods(self) -> List[Tuple[Pod, Dict[str, str]]]:
@@ -315,6 +374,7 @@ class Provisioner:
                 topology=None,
                 cluster_pods=inputs.cluster_pods,
                 domains=inputs.domains,
+                pod_volumes=inputs.pod_volumes,
             )
         return result, inputs
 

@@ -1,0 +1,80 @@
+"""Volume topology injection.
+
+Equivalent of reference pkg/controllers/provisioning/scheduling/
+volumetopology.go:41-76: before a pod reaches the solver, any zone (or other
+topology) constraints implied by its volumes — a bound PV's node affinity, or
+an unbound PVC's StorageClass allowedTopologies — are injected as required
+node-affinity terms so the pack lands the pod where its storage can attach.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from karpenter_tpu.apis.objects import (
+    Affinity,
+    NodeAffinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    Pod,
+)
+from karpenter_tpu.kube.client import KubeClient
+from karpenter_tpu.scheduling.storageclass import resolve_storage_class
+
+
+class VolumeTopology:
+    def __init__(self, kube: KubeClient):
+        self.kube = kube
+
+    def inject(self, pod: Pod) -> Pod:
+        """Mutates (and returns) the pod with volume-implied requirements
+        (volumetopology.go:41-76)."""
+        requirements: List[NodeSelectorRequirement] = []
+        for volume in pod.spec.volumes:
+            requirements.extend(self._volume_requirements(pod, volume))
+        if not requirements:
+            return pod
+        if pod.spec.affinity is None:
+            pod.spec.affinity = Affinity()
+        if pod.spec.affinity.node_affinity is None:
+            pod.spec.affinity.node_affinity = NodeAffinity()
+        na = pod.spec.affinity.node_affinity
+        if na.required:
+            # AND the volume requirements into every OR term (:60-70)
+            for term in na.required:
+                term.match_expressions.extend(requirements)
+        else:
+            na.required = [NodeSelectorTerm(match_expressions=list(requirements))]
+        return pod
+
+    def _volume_requirements(self, pod: Pod, volume) -> List[NodeSelectorRequirement]:
+        if volume.persistent_volume_claim is not None:
+            pvc = self.kube.get_opt(
+                PersistentVolumeClaim,
+                volume.persistent_volume_claim.claim_name,
+                pod.metadata.namespace,
+            )
+            if pvc is None:
+                return []
+            if pvc.volume_name:
+                pv = self.kube.get_opt(PersistentVolume, pvc.volume_name, "")
+                if pv is not None and pv.node_affinity_required:
+                    # a bound PV pins the pod to its topology (:48-55)
+                    out = []
+                    for term in pv.node_affinity_required:
+                        out.extend(term.match_expressions)
+                    return out
+                return []
+            sc = resolve_storage_class(self.kube, pvc.storage_class_name)
+        elif volume.ephemeral is not None:
+            sc = resolve_storage_class(self.kube, volume.ephemeral.storage_class_name)
+        else:
+            return []
+        if sc is None or not sc.allowed_topologies:
+            return []
+        out = []
+        for term in sc.allowed_topologies:
+            out.extend(term.match_expressions)
+        return out

@@ -65,5 +65,7 @@ class SolverBackend(abc.ABC):
         topology=None,  # Optional[Topology]: caller-owned group state to clone
         cluster_pods: Sequence = (),  # (Pod, node labels) pairs for the census
         domains: Optional[Dict[str, set]] = None,  # per-key domain universe
+        pod_volumes: Optional[Sequence[Dict[str, frozenset]]] = None,  # per-pod
+        # resolved CSI volumes (driver -> unique volume ids), parallel to pods
     ) -> SolveResult:
         ...

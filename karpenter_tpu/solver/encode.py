@@ -72,10 +72,18 @@ class NodeInfo:
     available: Dict[str, float]  # allocatable - scheduled pod requests
     daemon_overhead: Dict[str, float]  # unscheduled daemonset requests
     host_ports: List["HostPort"] = None  # type: ignore[assignment]
+    # CSI attach state (volumeusage.go): current unique-volume counts and the
+    # node's per-driver limits (absent driver = unlimited)
+    volume_used: Dict[str, int] = None  # type: ignore[assignment]
+    volume_limits: Dict[str, int] = None  # type: ignore[assignment]
 
     def __post_init__(self):
         if self.host_ports is None:
             self.host_ports = []
+        if self.volume_used is None:
+            self.volume_used = {}
+        if self.volume_limits is None:
+            self.volume_limits = {}
 
 
 @dataclass
@@ -150,6 +158,7 @@ class Encoder:
         num_claim_slots: int = 0,
         vocab_pods: Optional[Sequence[Pod]] = None,
         vocab_reqs: Optional[Sequence[Requirements]] = None,
+        pod_volumes: Optional[Sequence[Dict[str, frozenset]]] = None,
     ) -> EncodedProblem:
         """``vocab_pods`` seeds the vocabulary (defaults to ``pods``): across
         the relax-and-retry passes the vocabulary must stay identical so the
@@ -175,6 +184,9 @@ class Encoder:
         pods = [pods[i] for i in order]
         pod_reqs_list = [pod_reqs_list[i] for i in order]
         pod_strict_list = [pod_strict_list[i] for i in order]
+        pod_volumes_list = (
+            [pod_volumes[i] for i in order] if pod_volumes is not None else None
+        )
         if vocab_pods is None:
             vocab_pods = pods
 
@@ -386,6 +398,26 @@ class Encoder:
                 li = port_vocab[hp]
                 pod_ports[pi, li] = True
                 pod_port_conflict[pi] |= conflict[li]
+        # -- CSI attach limits: one lane per driver that is limited on some
+        # node (drivers no node limits never gate; see volumeusage.py)
+        drivers = sorted({d for n in nodes for d in n.volume_limits})
+        D = len(drivers)
+        driver_idx = {d: i for i, d in enumerate(drivers)}
+        pod_vol_counts = np.zeros((len(pods), D), dtype=np.int32)
+        if pod_volumes_list is not None and D:
+            for pi, vols in enumerate(pod_volumes_list):
+                for d, ids in (vols or {}).items():
+                    if d in driver_idx:
+                        pod_vol_counts[pi, driver_idx[d]] = len(ids)
+        node_vol_used = np.zeros((len(nodes), D), dtype=np.int32)
+        node_vol_limits = np.full((len(nodes), D), 2**30, dtype=np.int32)
+        for ni, n in enumerate(nodes):
+            for d, count in n.volume_used.items():
+                if d in driver_idx:
+                    node_vol_used[ni, driver_idx[d]] = count
+            for d, limit in n.volume_limits.items():
+                node_vol_limits[ni, driver_idx[d]] = limit
+
         node_used_ports = np.zeros((len(nodes), PT), dtype=bool)
         for ni, n in enumerate(nodes):
             for hp in n.host_ports:
@@ -476,6 +508,9 @@ class Encoder:
             node_avail=node_avail,
             node_overhead=node_overhead,
             node_used_ports=node_used_ports,
+            pod_vol_counts=pod_vol_counts,
+            node_vol_used=node_vol_used,
+            node_vol_limits=node_vol_limits,
             grp_type=grp_type,
             grp_key=grp_key,
             grp_max_skew=grp_max_skew,

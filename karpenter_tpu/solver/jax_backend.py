@@ -93,6 +93,7 @@ class JaxSolver(SolverBackend):
         topology: Optional[Topology] = None,
         cluster_pods: Sequence = (),
         domains: Optional[Dict[str, set]] = None,
+        pod_volumes: Optional[Sequence[Dict[str, frozenset]]] = None,
     ) -> SolveResult:
         if not pods:
             return SolveResult()
@@ -105,7 +106,7 @@ class JaxSolver(SolverBackend):
                 return self._solve_with_slots(
                     pods, instance_types, templates, nodes,
                     pod_requirements_override, topology, cluster_pods, domains,
-                    max_claims,
+                    max_claims, pod_volumes,
                 )
             except _SlotOverflow:
                 if max_claims >= len(pods):
@@ -116,6 +117,7 @@ class JaxSolver(SolverBackend):
     def _solve_with_slots(
         self, pods, instance_types, templates, nodes,
         pod_requirements_override, topology, cluster_pods, domains, max_claims,
+        pod_volumes=None,
     ) -> SolveResult:
         # copy-on-write: pods are only copied when relaxation is about to
         # mutate them — the common all-scheduled case pays no deepcopy
@@ -164,6 +166,11 @@ class JaxSolver(SolverBackend):
                 num_claim_slots=max_claims,
                 vocab_pods=vocab_pods,
                 vocab_reqs=pod_requirements_override,
+                pod_volumes=(
+                    [pod_volumes[i] for i in queue]
+                    if pod_volumes is not None
+                    else None
+                ),
             )
             problem, meta = pad_problem(encoded.problem), encoded.meta
             group_keys = [

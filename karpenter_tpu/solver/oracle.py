@@ -84,6 +84,25 @@ class _NodeBin:
     requests: Dict[str, float]
     pod_indices: List[int] = field(default_factory=list)
     used_ports: List[HostPort] = field(default_factory=list)
+    vol_counts: Dict[str, int] = field(default_factory=dict)
+
+    def vol_fits(self, pod_vols) -> bool:
+        """Count-based CSI gate — intentionally the jax kernel's semantics
+        (see volumeusage.py docstring) so both backends agree bit-for-bit."""
+        if not pod_vols:
+            return True
+        for driver, ids in pod_vols.items():
+            limit = self.info.volume_limits.get(driver)
+            if limit is None:
+                continue
+            if self.vol_counts.get(driver, 0) + len(ids) > limit:
+                return False
+        return True
+
+    def vol_add(self, pod_vols) -> None:
+        for driver, ids in (pod_vols or {}).items():
+            if driver in self.info.volume_limits:
+                self.vol_counts[driver] = self.vol_counts.get(driver, 0) + len(ids)
 
 
 class OracleSolver(SolverBackend):
@@ -100,6 +119,7 @@ class OracleSolver(SolverBackend):
         topology: Optional[Topology] = None,
         cluster_pods: Sequence = (),
         domains: Optional[Dict[str, set]] = None,
+        pod_volumes: Optional[Sequence[Dict[str, frozenset]]] = None,
     ) -> SolveResult:
         # copy-on-write: pods are only copied when relaxation mutates them;
         # a caller-provided topology is isolated so the caller's group state
@@ -127,6 +147,7 @@ class OracleSolver(SolverBackend):
                 requirements=n.requirements.copy(),
                 requests=dict(n.daemon_overhead),
                 used_ports=list(n.host_ports),
+                vol_counts=dict(n.volume_used),
             )
             for n in nodes
         ]
@@ -158,8 +179,10 @@ class OracleSolver(SolverBackend):
                     )
                 requests = {**res.pod_requests(pod), res.PODS: 1.0}
                 ports = get_host_ports(pod)
+                vols = pod_volumes[pi] if pod_volumes is not None else None
                 if (
-                    self._try_nodes(pi, pod, reqs, strict, requests, ports, node_bins, topo)
+                    self._try_nodes(pi, pod, reqs, strict, requests, ports, vols,
+                                    node_bins, topo)
                     or self._try_claims(
                         pi, pod, reqs, strict, requests, ports, claims, instance_types, topo
                     )
@@ -205,11 +228,13 @@ class OracleSolver(SolverBackend):
 
     # -- placement attempts, in reference priority order ----------------------
 
-    def _try_nodes(self, pi, pod, reqs, strict, requests, ports, node_bins, topo) -> bool:
+    def _try_nodes(self, pi, pod, reqs, strict, requests, ports, vols, node_bins, topo) -> bool:
         for nb in node_bins:
             if nb.info.taints.tolerates(pod):
                 continue
             if _port_conflict(nb.used_ports, ports):
+                continue
+            if not nb.vol_fits(vols):
                 continue
             merged_requests = res.merge(nb.requests, requests)
             if not _fits(merged_requests, nb.info.available):
@@ -227,6 +252,7 @@ class OracleSolver(SolverBackend):
             nb.requirements = merged
             nb.pod_indices.append(pi)
             nb.used_ports.extend(ports)
+            nb.vol_add(vols)
             topo.record(pod, merged)
             return True
         return False

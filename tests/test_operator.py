@@ -98,10 +98,12 @@ def test_threaded_start_serves_metrics_and_survives_errors():
 
     cp = FakeCloudProvider()
     cp.drifted = ""
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-    op = Operator(cp, options=Options(solver_backend="oracle", metrics_port=port),
+    with socket.socket() as s1, socket.socket() as s2:
+        s1.bind(("127.0.0.1", 0))
+        s2.bind(("127.0.0.1", 0))
+        port, health_port = s1.getsockname()[1], s2.getsockname()[1]
+    op = Operator(cp, options=Options(solver_backend="oracle", metrics_port=port,
+                                      health_probe_port=health_port),
                   clock=Clock())
     op.kube.create(make_nodepool())
     # an error-injecting provider must not kill the lifecycle thread
@@ -113,7 +115,7 @@ def test_threaded_start_serves_metrics_and_survives_errors():
         ).read().decode()
         assert "karpenter" in body
         health = urllib.request.urlopen(
-            f"http://127.0.0.1:{port}/healthz", timeout=5
+            f"http://127.0.0.1:{health_port}/healthz", timeout=5
         ).read()
         assert health == b"ok\n"
     finally:

@@ -1,0 +1,108 @@
+"""API validation suite — mirrors the shapes of the reference's CEL and
+webhook validation tests (nodepool_validation_cel_test.go,
+nodeclaim_validation_cel_test.go)."""
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodepool import Budget, Disruption as DisruptionPolicy
+from karpenter_tpu.apis.objects import NodeSelectorRequirement, Taint
+from karpenter_tpu.apis.validation import (
+    validate_nodeclaim,
+    validate_nodepool,
+    validate_requirement,
+    validate_taint,
+)
+
+from tests.factories import make_nodeclaim, make_nodepool, make_pod
+from tests.harness import Env
+
+
+def test_valid_nodepool_passes():
+    assert validate_nodepool(make_nodepool()) == []
+    assert validate_nodepool(make_nodepool(
+        requirements=[
+            NodeSelectorRequirement(wk.LABEL_TOPOLOGY_ZONE, "In", ["test-zone-1"]),
+            NodeSelectorRequirement(wk.CAPACITY_TYPE_LABEL_KEY, "In", ["spot"]),
+        ],
+        taints=[Taint(key="dedicated", value="x")],
+        limits={"cpu": 100.0},
+        weight=50,
+    )) == []
+
+
+@pytest.mark.parametrize("req,fragment", [
+    (NodeSelectorRequirement("zone", "BadOp", ["a"]), "unsupported operator"),
+    (NodeSelectorRequirement("zone", "In", []), "at least one value"),
+    (NodeSelectorRequirement("zone", "Exists", ["a"]), "must not have values"),
+    (NodeSelectorRequirement("cpu", "Gt", ["a", "b"]), "exactly one value"),
+    (NodeSelectorRequirement("cpu", "Gt", ["abc"]), "must be an integer"),
+    (NodeSelectorRequirement(wk.LABEL_HOSTNAME, "In", ["x"]), "restricted"),
+    (NodeSelectorRequirement("bad key!", "In", ["x"]), "invalid label key"),
+])
+def test_requirement_rules(req, fragment):
+    errs = validate_requirement(req)
+    assert any(fragment in e for e in errs), errs
+
+
+def test_taint_rules():
+    assert validate_taint(Taint(key="ok", value="v")) == []
+    assert validate_taint(Taint(key="ok", effect="Sideways"))
+    assert validate_taint(Taint(key="bad key!"))
+
+
+def test_consolidate_after_policy_coupling():
+    # WhenEmpty requires consolidateAfter
+    errs = validate_nodepool(make_nodepool(disruption=DisruptionPolicy(
+        consolidation_policy="WhenEmpty")))
+    assert any("required" in e for e in errs)
+    # WhenUnderutilized forbids it
+    errs = validate_nodepool(make_nodepool(disruption=DisruptionPolicy(
+        consolidation_policy="WhenUnderutilized", consolidate_after="30s")))
+    assert any("only allowed" in e for e in errs)
+    assert validate_nodepool(make_nodepool(disruption=DisruptionPolicy(
+        consolidation_policy="WhenEmpty", consolidate_after="30s"))) == []
+
+
+def test_budget_rules():
+    bad = validate_nodepool(make_nodepool(disruption=DisruptionPolicy(
+        budgets=[Budget(nodes="150%")])))
+    assert any("percentage" in e for e in bad)
+    bad = validate_nodepool(make_nodepool(disruption=DisruptionPolicy(
+        budgets=[Budget(nodes="10", schedule="0 9 * * 1-5")])))
+    assert any("together" in e for e in bad)
+    ok = validate_nodepool(make_nodepool(disruption=DisruptionPolicy(
+        budgets=[Budget(nodes="10", schedule="0 9 * * 1-5", duration="8h")])))
+    assert ok == []
+    bad = validate_nodepool(make_nodepool(disruption=DisruptionPolicy(
+        budgets=[Budget(nodes="10", schedule="not a cron", duration="1h")])))
+    assert bad
+
+
+def test_limits_and_weight():
+    assert validate_nodepool(make_nodepool(limits={"cpu": -1.0}))
+    assert validate_nodepool(make_nodepool(weight=0))
+    assert validate_nodepool(make_nodepool(weight=101))
+
+
+def test_nodeclaim_validation():
+    assert validate_nodeclaim(make_nodeclaim()) == []
+    claim = make_nodeclaim(requirements=[
+        NodeSelectorRequirement("zone", "BadOp", ["a"])
+    ])
+    assert validate_nodeclaim(claim)
+    claim = make_nodeclaim()
+    claim.spec.resource_requests = {"cpu": -1.0}
+    assert validate_nodeclaim(claim)
+
+
+def test_provisioner_skips_invalid_pool():
+    env = Env()
+    env.create(make_nodepool(name="bad", weight=0))
+    env.create(make_nodepool(name="good"))
+    pod = make_pod(cpu=1.0)
+    env.expect_provisioned(pod)
+    claims = env.nodeclaims()
+    assert len(claims) == 1
+    assert claims[0].metadata.labels[wk.NODEPOOL_LABEL_KEY] == "good"
+    assert env.recorder.count("FailedValidation") == 1

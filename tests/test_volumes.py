@@ -1,0 +1,144 @@
+"""Volume subsystem suite: VolumeUsage (pkg/scheduling/volumeusage.go),
+storage-class discovery (storageclass.go), VolumeTopology injection
+(scheduling/volumetopology.go), and CSI attach limits through both solver
+backends and the provisioner."""
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.objects import (
+    CSINode,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    ObjectMeta,
+    PersistentVolume,
+    PersistentVolumeClaim,
+    PersistentVolumeClaimVolume,
+    StorageClass,
+    Volume,
+)
+from karpenter_tpu.kube import KubeClient
+from karpenter_tpu.scheduling.storageclass import default_storage_class
+from karpenter_tpu.scheduling.volumeusage import (
+    UNKNOWN_DRIVER,
+    VolumeUsage,
+    get_pod_volumes,
+    node_volume_limits,
+)
+
+from tests.factories import make_nodepool, make_pod
+from tests.harness import Env
+
+
+def pvc_pod(name, claims, **kw):
+    pod = make_pod(name=name, **kw)
+    pod.spec.volumes = [
+        Volume(name=f"v{i}",
+               persistent_volume_claim=PersistentVolumeClaimVolume(claim_name=c))
+        for i, c in enumerate(claims)
+    ]
+    return pod
+
+
+def ebs_class(kube, name="ebs", default=False):
+    kube.create(StorageClass(metadata=ObjectMeta(name=name, namespace=""),
+                             provisioner="ebs.csi", is_default=default))
+
+
+def test_default_storage_class_discovery():
+    kube = KubeClient()
+    ebs_class(kube, "a", default=False)
+    ebs_class(kube, "b", default=True)
+    assert default_storage_class(kube).metadata.name == "b"
+
+
+def test_pod_volume_resolution_via_pvc_pv_and_class():
+    kube = KubeClient()
+    ebs_class(kube, "ebs", default=True)
+    # bound PVC -> PV -> csi driver
+    kube.create(PersistentVolume(metadata=ObjectMeta(name="pv1", namespace=""),
+                                 csi_driver="ebs.csi"))
+    kube.create(PersistentVolumeClaim(metadata=ObjectMeta(name="bound"),
+                                      volume_name="pv1"))
+    # unbound PVC -> default storage class provisioner
+    kube.create(PersistentVolumeClaim(metadata=ObjectMeta(name="unbound")))
+    pod = pvc_pod("p", ["bound", "unbound"])
+    vols = get_pod_volumes(kube, pod)
+    assert vols == {"ebs.csi": frozenset({"default/bound", "default/unbound"})}
+    # a PVC that doesn't exist resolves to the unknown lane
+    missing = get_pod_volumes(kube, pvc_pod("q", ["ghost"]))
+    assert UNKNOWN_DRIVER in missing
+
+
+def test_volume_usage_set_semantics():
+    usage = VolumeUsage()
+    usage.add({"ebs.csi": frozenset({"default/a"})})
+    usage.add({"ebs.csi": frozenset({"default/a", "default/b"})})  # a dedups
+    assert usage.counts() == {"ebs.csi": 2}
+    assert usage.exceeds_limits({"ebs.csi": frozenset({"default/c"})},
+                                {"ebs.csi": 2}) is not None
+    assert usage.exceeds_limits({"ebs.csi": frozenset({"default/b"})},
+                                {"ebs.csi": 2}) is None  # already attached
+
+
+def test_volume_topology_injects_bound_pv_zone():
+    env = Env()
+    env.create(PersistentVolume(
+        metadata=ObjectMeta(name="pv1", namespace=""),
+        csi_driver="ebs.csi",
+        node_affinity_required=[NodeSelectorTerm(match_expressions=[
+            NodeSelectorRequirement(wk.LABEL_TOPOLOGY_ZONE, "In", ["test-zone-2"]),
+        ])],
+    ))
+    env.create(PersistentVolumeClaim(metadata=ObjectMeta(name="data"),
+                                     volume_name="pv1"))
+    env.create(make_nodepool())
+    pod = pvc_pod("p", ["data"], cpu=0.5)
+    env.expect_provisioned(pod)
+    claim = env.nodeclaims()[0]
+    zone_req = next(r for r in claim.spec.requirements
+                    if r.key == wk.LABEL_TOPOLOGY_ZONE)
+    assert list(zone_req.values) == ["test-zone-2"]
+
+
+@pytest.mark.parametrize("backend", ["oracle", "jax"])
+def test_attach_limits_block_existing_node(backend):
+    from karpenter_tpu.solver.jax_backend import JaxSolver
+    from karpenter_tpu.solver.oracle import OracleSolver
+
+    env = Env(solver=JaxSolver() if backend == "jax" else OracleSolver())
+    ebs_class(env.kube, default=True)
+    env.create(make_nodepool())
+    node, claim = env.create_candidate_node("n1")
+    env.create(CSINode(metadata=ObjectMeta(name="n1", namespace=""),
+                       driver_limits={"ebs.csi": 1}))
+    env.create(PersistentVolumeClaim(metadata=ObjectMeta(name="c1")))
+    env.create(PersistentVolumeClaim(metadata=ObjectMeta(name="c2")))
+    # first pod lands on n1 and consumes the single attachment
+    p1 = pvc_pod("p1", ["c1"], cpu=0.1)
+    env.expect_provisioned(p1)
+    assert env.expect_scheduled(p1) == "n1"
+    # second volume pod cannot attach: a fresh claim is opened instead
+    p2 = pvc_pod("p2", ["c2"], cpu=0.1)
+    env.expect_provisioned(p2)
+    assert env.expect_scheduled(p2) != "n1"
+    assert len(env.nodeclaims()) >= 2  # candidate claim + new claim
+
+
+def test_node_volume_limits_reader():
+    kube = KubeClient()
+    kube.create(CSINode(metadata=ObjectMeta(name="n1", namespace=""),
+                        driver_limits={"ebs.csi": 25}))
+    assert node_volume_limits(kube, "n1") == {"ebs.csi": 25}
+    assert node_volume_limits(kube, "missing") == {}
+
+
+def test_volumeless_pods_unaffected_by_limits():
+    env = Env()
+    env.create(make_nodepool())
+    env.create_candidate_node("n1")
+    env.create(CSINode(metadata=ObjectMeta(name="n1", namespace=""),
+                       driver_limits={"ebs.csi": 0}))
+    pod = make_pod(name="p1", cpu=0.5)
+    env.expect_provisioned(pod)
+    assert env.expect_scheduled(pod) == "n1"
