@@ -51,6 +51,10 @@ class Conflict(KubeError):
     """Stale resource_version on update (HTTP 409)."""
 
 
+class Invalid(KubeError):
+    """Rejected by an admission webhook (HTTP 422)."""
+
+
 WatchHandler = Callable[[str, object], None]
 
 
@@ -64,6 +68,9 @@ class KubeClient:
         # kind (python type) -> {(namespace, name): obj}
         self._store: Dict[Type, Dict[Tuple[str, str], object]] = {}
         self._watchers: Dict[Type, List[WatchHandler]] = {}
+        # kind -> admission validators called on create/update; a validator
+        # returns a list of error strings (empty = admitted)
+        self._admission: Dict[Type, List[Callable[[object], list]]] = {}
         self._rv = 0
         self._clock = clock
 
@@ -78,6 +85,19 @@ class KubeClient:
     def _emit(self, kind: Type, event: str, obj):
         for handler in self._watchers.get(kind, []):
             handler(event, copy.deepcopy(obj))
+
+    def admit(self, kind: Type, validator: Callable[[object], list]) -> None:
+        """Register an admission validator for a kind (the webhook seam)."""
+        with self._lock:
+            self._admission.setdefault(kind, []).append(validator)
+
+    def _check_admission(self, obj) -> None:
+        for validator in self._admission.get(type(obj), []):
+            errors = validator(obj)
+            if errors:
+                raise Invalid(
+                    f"{type(obj).__name__} {obj.metadata.name}: " + "; ".join(errors)
+                )
 
     def watch(self, kind: Type, handler: WatchHandler, replay: bool = True):
         """Register a watch callback. With replay=True the handler immediately
@@ -98,6 +118,9 @@ class KubeClient:
             if k in coll:
                 raise AlreadyExists(f"{type(obj).__name__} {k} already exists")
             stored = copy.deepcopy(obj)
+            # validators see the store's copy: a mutating validator can never
+            # leak changes back into the caller's object
+            self._check_admission(stored)
             self._rv += 1
             stored.metadata.resource_version = self._rv
             stored.metadata.generation = 1
@@ -159,6 +182,7 @@ class KubeClient:
                     f"!= {stored.metadata.resource_version}"
                 )
             new = copy.deepcopy(obj)
+            self._check_admission(new)
             # deletion_timestamp is apiserver-owned: preserve the stored value
             new.metadata.deletion_timestamp = stored.metadata.deletion_timestamp
             self._rv += 1

@@ -124,6 +124,10 @@ class Operator:
         """Attach informers/watches and register every polling controller."""
         if self._wired:
             return self
+        if not self.options.disable_webhook:
+            from karpenter_tpu.webhooks import register_webhooks
+
+            register_webhooks(self.kube)
         start_informers(self.kube, self.cluster)
         watch_pods(self.kube, self.batcher)
         reg = [

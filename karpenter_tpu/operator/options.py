@@ -39,6 +39,8 @@ class Options:
     batch_idle_duration_s: float = 1.0
     # profiling (operator.go:164-180); enables jax profiler traces here
     enable_profiling: bool = False
+    # admission webhooks, default-disabled like the reference (options.go:84)
+    disable_webhook: bool = True
     # feature gates (options.go:97,123-137)
     feature_gates: Dict[str, bool] = field(default_factory=lambda: {"Drift": True})
     log_level: str = "info"

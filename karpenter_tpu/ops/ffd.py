@@ -48,7 +48,9 @@ KIND_NO_SLOT = 4  # a fresh claim would accept the pod, but slots ran out
 # vocab key indices the encoder pins (single source: models/problem.py)
 from karpenter_tpu.models.problem import CT_KEY, HOSTNAME_KEY, ZONE_KEY  # noqa: E402
 
-_BIG = jnp.int32(2**30)
+# plain int: a module-level jnp scalar would initialize the JAX backend at
+# import time (and block on the TPU tunnel in processes that never use it)
+_BIG = 2**30
 
 
 @jax.tree_util.register_dataclass

@@ -53,8 +53,12 @@ def _pad_reqs(r: ReqTensor, e: int, k: int, v: int) -> ReqTensor:
     )
 
 
-def pad_problem(p: SchedulingProblem) -> SchedulingProblem:
-    P = pow2_bucket(p.num_pods)
+def pad_problem(p: SchedulingProblem, min_pods: int = 0) -> SchedulingProblem:
+    """``min_pods`` pins the pod-axis bucket: relax-and-retry passes shrink
+    the queue, and padding every pass back to the first pass's bucket reuses
+    one compiled kernel instead of compiling per retry size. Padded pod rows
+    tolerate nothing, so they resolve to KIND_FAIL without touching state."""
+    P = pow2_bucket(max(p.num_pods, min_pods))
     T = pow2_bucket(p.num_instance_types)
     N = pow2_bucket(p.num_nodes, lo=8)
     TPL = pow2_bucket(p.num_templates, lo=4)

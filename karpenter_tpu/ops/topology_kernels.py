@@ -20,7 +20,9 @@ from jax import vmap
 from karpenter_tpu.models.problem import HOSTNAME_KEY, ReqTensor, SchedulingProblem
 from karpenter_tpu.ops import masks
 
-_MAXI = jnp.int32(2**31 - 1)
+# plain int: a module-level jnp scalar would initialize the JAX backend at
+# import time (and block on the TPU tunnel in processes that never use it)
+_MAXI = 2**31 - 1
 
 TYPE_SPREAD = 0
 TYPE_AFFINITY = 1

@@ -76,6 +76,11 @@ def _remap_group_state(state, old_keys, new_keys, padded_problem):
 
 class JaxSolver(SolverBackend):
     def __init__(self, well_known=None, initial_claim_slots: int = 32):
+        # every entrypoint that constructs this backend benefits from the
+        # persistent executable cache (idempotent config update)
+        from karpenter_tpu.utils.jaxtools import enable_compilation_cache
+
+        enable_compilation_cache()
         self.well_known = (
             well_known if well_known is not None else wk.WELL_KNOWN_LABELS
         )
@@ -172,7 +177,11 @@ class JaxSolver(SolverBackend):
                     else None
                 ),
             )
-            problem, meta = pad_problem(encoded.problem), encoded.meta
+            # retry passes stay in the first pass's pod bucket: one compile
+            problem, meta = (
+                pad_problem(encoded.problem, min_pods=len(pods)),
+                encoded.meta,
+            )
             group_keys = [
                 tg.hash_key()
                 for tg in list(topo.topologies.values())

@@ -1,0 +1,19 @@
+"""JAX runtime helpers shared by bench/driver entrypoints."""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compilation_cache(path: str = "/root/repo/.jax_cache") -> None:
+    """Persist compiled executables on disk: the FFD kernel's shape buckets
+    recompile identically across processes and rounds, and on a tunneled TPU
+    each compile costs tens of seconds."""
+    try:
+        import jax
+
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:
+        pass  # older jax or read-only fs: caching is an optimization only

@@ -1,0 +1,3 @@
+from karpenter_tpu.webhooks.webhooks import register_webhooks
+
+__all__ = ["register_webhooks"]

@@ -1,0 +1,21 @@
+"""Admission webhooks.
+
+Equivalent of reference pkg/webhooks/webhooks.go:57-150: validation admission
+for the framework's own API types, default-disabled the same way
+(--disable-webhook, operator/options/options.go:84). Where the reference runs
+a knative webhook server in front of the apiserver, this framework registers
+validators directly on the in-memory kube store's admission seam
+(KubeClient.admit) — same contract, no TLS plumbing.
+"""
+
+from __future__ import annotations
+
+from karpenter_tpu.apis.nodeclaim import NodeClaim
+from karpenter_tpu.apis.nodepool import NodePool
+from karpenter_tpu.apis.validation import validate_nodeclaim, validate_nodepool
+from karpenter_tpu.kube.client import KubeClient
+
+
+def register_webhooks(kube: KubeClient) -> None:
+    kube.admit(NodePool, validate_nodepool)
+    kube.admit(NodeClaim, validate_nodeclaim)

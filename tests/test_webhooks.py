@@ -1,0 +1,60 @@
+"""Webhooks, ChangeMonitor, and CRD export."""
+
+import pytest
+
+from karpenter_tpu.apis.crds import export_crds
+from karpenter_tpu.kube import KubeClient
+from karpenter_tpu.kube.client import Invalid
+from karpenter_tpu.operator import Operator, Options
+from karpenter_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_tpu.utils.clock import FakeClock
+from karpenter_tpu.utils.pretty import ChangeMonitor
+from karpenter_tpu.webhooks import register_webhooks
+
+from tests.factories import make_nodepool
+
+
+def test_webhook_rejects_invalid_nodepool():
+    kube = KubeClient()
+    register_webhooks(kube)
+    kube.create(make_nodepool(name="ok"))
+    with pytest.raises(Invalid):
+        kube.create(make_nodepool(name="bad", weight=0))
+    # update path is guarded too
+    pool = kube.get(make_nodepool().__class__, "ok", "")
+    pool.spec.weight = 0
+    with pytest.raises(Invalid):
+        kube.update(pool)
+
+
+def test_operator_webhooks_default_disabled():
+    cp = FakeCloudProvider()
+    op = Operator(cp, options=Options(solver_backend="oracle"), clock=FakeClock())
+    op.wire()
+    op.kube.create(make_nodepool(name="bad", weight=0))  # admitted: disabled
+    op2 = Operator(cp, options=Options(solver_backend="oracle",
+                                       disable_webhook=False), clock=FakeClock())
+    op2.wire()
+    with pytest.raises(Invalid):
+        op2.kube.create(make_nodepool(name="bad", weight=0))
+
+
+def test_change_monitor():
+    clock = FakeClock()
+    cm = ChangeMonitor(ttl_seconds=60, clock=clock)
+    assert cm.has_changed("pods", 5)
+    assert not cm.has_changed("pods", 5)
+    assert cm.has_changed("pods", 6)
+    assert not cm.has_changed("pods", 6)
+    clock.step(61)
+    assert cm.has_changed("pods", 6)  # TTL re-emit
+
+
+def test_crd_export_shape():
+    crds = export_crds()
+    assert set(crds) == {"karpenter.tpu_nodepools", "karpenter.tpu_nodeclaims"}
+    np_schema = crds["karpenter.tpu_nodepools"]["spec"]["versions"][0]["schema"][
+        "openAPIV3Schema"
+    ]
+    spec = np_schema["properties"]["spec"]["properties"]
+    assert "template" in spec and "disruption" in spec and "limits" in spec
