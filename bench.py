@@ -6,17 +6,37 @@ with the makeDiversePods mix (:184-196) — count/7 each of zonal topology
 spread, hostname topology spread, hostname pod-affinity, and zonal
 pod-affinity pods, remainder generic — and reports end-to-end pods/sec
 through the JAX solver. Compile time is excluded the same way Go's
-b.ResetTimer() excludes setup.
+b.ResetTimer() excludes setup, but is REPORTED separately (compile_s).
+
+Robustness (the TPU tunnel can hang at interpreter start or first compile):
+the top-level process is a thin orchestrator that runs the measurement in a
+child subprocess and reads per-shape JSON progress lines. A hang only costs
+the remaining shapes — whatever completed still produces the final number.
+If the requested backend cannot even run a 4x4 matmul within the probe
+timeout, the bench reruns on CPU with the platform clearly labeled.
 
 Baseline: the reference enforces >= 100 pods/sec on >100-pod batches
 (scheduling_benchmark_test.go:51,177-181); vs_baseline is pods/sec / 100.
+
+Env knobs:
+  BENCH_QUICK=1         small grid (10/100/500 pods)
+  BENCH_DEADLINE=secs   global budget for the child (default 2400)
+  BENCH_STALL=secs      per-line stall timeout (default 600; first TPU
+                        compile of the biggest bucket can take minutes)
 """
 
 from __future__ import annotations
 
 import json
+import os
 import random
+import subprocess
+import sys
 import time
+
+PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
+DEADLINE = float(os.environ.get("BENCH_DEADLINE", "2400"))
+STALL = float(os.environ.get("BENCH_STALL", "600"))
 
 
 def make_diverse_pods(count: int, rng: random.Random):
@@ -102,10 +122,31 @@ def make_diverse_pods(count: int, rng: random.Random):
     return pods
 
 
-def main():
+def _grid():
+    if os.environ.get("BENCH_QUICK"):
+        return [10, 100, 500]
+    return [10, 100, 500, 1000, 1500, 2000, 2500]
+
+
+# ---------------------------------------------------------------------------
+# child: the actual measurement. Emits one JSON line per event on stdout.
+# ---------------------------------------------------------------------------
+
+def run_child():
     import __graft_entry__
 
     __graft_entry__._respect_platform_env()
+
+    import jax
+
+    def emit(obj):
+        print(json.dumps(obj), flush=True)
+
+    t0 = time.perf_counter()
+    dev = jax.devices()[0]
+    x = jax.numpy.ones((4, 4))
+    jax.block_until_ready(x @ x)
+    emit({"event": "backend", "platform": dev.platform, "init_s": round(time.perf_counter() - t0, 2)})
 
     from karpenter_tpu.apis.nodepool import NodePool
     from karpenter_tpu.apis.objects import ObjectMeta
@@ -114,48 +155,240 @@ def main():
     from karpenter_tpu.solver.jax_backend import JaxSolver
 
     rng = random.Random(42)
-    instance_count = 400
-    its = instance_types(instance_count)
+    its = instance_types(400)
     tpl = template_from_nodepool(
         NodePool(metadata=ObjectMeta(name="default")), its, range(len(its))
     )
     solver = JaxSolver()
 
-    import os
-
-    grid = [10, 100, 500, 1000, 1500, 2000, 2500]
-    if os.environ.get("BENCH_QUICK"):
-        grid = [10, 100, 500]
-    # warmup: compile every shape bucket once (Go excludes setup via ResetTimer)
-    for pod_count in grid:
+    for pod_count in _grid():
+        # warmup run compiles this shape bucket (Go excludes setup via
+        # ResetTimer); the repeat run measures steady-state solve time
         pods = make_diverse_pods(pod_count, rng)
+        t0 = time.perf_counter()
         solver.solve(pods, its, [tpl])
+        warm_s = time.perf_counter() - t0
 
-    total_pods = 0
-    total_time = 0.0
-    scheduled = 0
-    for pod_count in grid:
         pods = make_diverse_pods(pod_count, rng)
-        start = time.perf_counter()
+        t0 = time.perf_counter()
         result = solver.solve(pods, its, [tpl])
-        elapsed = time.perf_counter() - start
-        scheduled += result.num_scheduled()
-        total_pods += pod_count
-        total_time += elapsed
-
-    pods_per_sec = total_pods / total_time
-    assert scheduled >= int(0.95 * total_pods), f"only {scheduled}/{total_pods} scheduled"
-    print(
-        json.dumps(
+        solve_s = time.perf_counter() - t0
+        emit(
             {
-                "metric": "scheduling_throughput_400it_diverse_grid",
-                "value": round(pods_per_sec, 2),
-                "unit": "pods/sec",
-                "vs_baseline": round(pods_per_sec / 100.0, 2),
+                "event": "shape",
+                "pods": pod_count,
+                "solve_s": round(solve_s, 4),
+                "compile_s": round(max(warm_s - solve_s, 0.0), 2),
+                "scheduled": result.num_scheduled(),
             }
         )
+
+    # consolidation: score candidate subsets through the batched device path
+    try:
+        from karpenter_tpu.disruption.batch import bench_candidate_scoring
+
+        for n_candidates in (32, 100):
+            t0 = time.perf_counter()
+            bench_candidate_scoring(n_candidates)  # compile warmup
+            warm_s = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            stats = bench_candidate_scoring(n_candidates)
+            solve_s = time.perf_counter() - t0
+            emit(
+                {
+                    "event": "consolidation",
+                    "candidates": n_candidates,
+                    "solve_s": round(solve_s, 4),
+                    "compile_s": round(max(warm_s - solve_s, 0.0), 2),
+                    "consolidatable": stats.get("consolidatable", -1),
+                }
+            )
+    except ImportError:
+        pass
+    emit({"event": "done"})
+
+
+# ---------------------------------------------------------------------------
+# parent: probe, spawn, aggregate. Survives child hangs/crashes.
+# ---------------------------------------------------------------------------
+
+def _probe(env) -> bool:
+    """Can the requested backend run a tiny op at all? Cheap fail-fast guard
+    so a wedged TPU tunnel doesn't eat the whole budget."""
+    code = (
+        "import __graft_entry__, jax;"
+        "__graft_entry__._respect_platform_env();"
+        "x = jax.numpy.ones((4, 4));"
+        "jax.block_until_ready(x @ x);"
+        "print('PROBE_OK', jax.devices()[0].platform)"
     )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code],
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=PROBE_TIMEOUT,
+        )
+        return out.returncode == 0 and "PROBE_OK" in out.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def _cpu_env(env):
+    env = dict(env)
+    env["JAX_PLATFORMS"] = "cpu"
+    # skip the TPU PJRT registration at interpreter start entirely — it can
+    # hang before any python code of ours runs
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
+def _run_measurement(env):
+    """Spawn the child, stream its JSON events, enforce deadline/stall.
+
+    Reads are non-blocking raw os.read so a child that wedges mid-line (or a
+    TPU runtime scribbling partial output) can never hang the parent; on child
+    exit the pipe is drained before the loop breaks so trailing events are
+    kept."""
+    import selectors
+
+    proc = subprocess.Popen(
+        [sys.executable, __file__, "--child"],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=sys.stderr,
+    )
+    os.set_blocking(proc.stdout.fileno(), False)
+    sel = selectors.DefaultSelector()
+    sel.register(proc.stdout, selectors.EVENT_READ)
+
+    events = []
+    start = time.time()
+    last_line = time.time()
+    buf = b""
+    done = False
+
+    def consume(data: bytes):
+        nonlocal buf, last_line, done
+        buf += data
+        while b"\n" in buf:
+            raw, buf = buf.split(b"\n", 1)
+            last_line = time.time()
+            line = raw.decode("utf-8", "replace").strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            print(f"bench: {line}", file=sys.stderr)
+            events.append(ev)
+            if ev.get("event") == "done":
+                done = True
+
+    while not done:
+        budget = min(DEADLINE - (time.time() - start), STALL - (time.time() - last_line))
+        if budget <= 0:
+            print("bench: killing child (deadline/stall exceeded)", file=sys.stderr)
+            proc.kill()
+            break
+        ready = sel.select(timeout=min(budget, 5.0))
+        if ready:
+            try:
+                data = os.read(proc.stdout.fileno(), 65536)
+            except BlockingIOError:
+                continue
+            if data:
+                consume(data)
+                continue
+        if proc.poll() is not None:
+            # child exited: drain whatever is still buffered, then stop
+            try:
+                while data := os.read(proc.stdout.fileno(), 65536):
+                    consume(data)
+            except (BlockingIOError, OSError):
+                pass
+            break
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+    return events
+
+
+def main():
+    base_env = dict(os.environ)
+    platform = "tpu"
+    if not _probe(base_env):
+        print("bench: backend probe failed/hung, falling back to CPU", file=sys.stderr)
+        base_env = _cpu_env(base_env)
+        platform = "cpu-fallback"
+        if not _probe(base_env):
+            print(json.dumps({
+                "metric": "scheduling_throughput_400it_diverse_grid",
+                "value": 0.0,
+                "unit": "pods/sec",
+                "vs_baseline": 0.0,
+                "error": "no usable backend (TPU and CPU probes both failed)",
+            }))
+            return 1
+
+    events = _run_measurement(base_env)
+    shapes = [e for e in events if e.get("event") == "shape"]
+    backend = next((e for e in events if e.get("event") == "backend"), {})
+    consol = [e for e in events if e.get("event") == "consolidation"]
+    if platform == "tpu":
+        platform = backend.get("platform", "tpu")
+
+    if not shapes:
+        print(json.dumps({
+            "metric": "scheduling_throughput_400it_diverse_grid",
+            "value": 0.0,
+            "unit": "pods/sec",
+            "vs_baseline": 0.0,
+            "platform": platform,
+            "error": "no shape completed within budget",
+        }))
+        return 1
+
+    total_pods = sum(e["pods"] for e in shapes)
+    total_time = max(sum(e["solve_s"] for e in shapes), 1e-9)
+    scheduled = sum(e["scheduled"] for e in shapes)
+    scheduled_frac = scheduled / max(total_pods, 1)
+    pods_per_sec = total_pods / total_time
+    out = {
+        "metric": "scheduling_throughput_400it_diverse_grid",
+        "value": round(pods_per_sec, 2),
+        "unit": "pods/sec",
+        "vs_baseline": round(pods_per_sec / 100.0, 2),
+        "platform": platform,
+        "backend_init_s": backend.get("init_s"),
+        "compile_s": round(sum(e["compile_s"] for e in shapes), 2),
+        "scheduled_frac": round(scheduled_frac, 4),
+        "shapes_completed": [e["pods"] for e in shapes],
+        "per_shape_pods_per_sec": {
+            str(e["pods"]): round(e["pods"] / max(e["solve_s"], 1e-9), 1)
+            for e in shapes
+        },
+    }
+    if consol:
+        rate = lambda e: e["candidates"] / max(e["solve_s"], 1e-9)
+        best = max(consol, key=rate)
+        out["consolidation_candidates_per_sec"] = round(rate(best), 1)
+        out["consolidation_vs_target_1k"] = round(rate(best) / 1000.0, 3)
+    if scheduled_frac < 0.95:
+        # a solver that drops pods must not read as a throughput win
+        # (reference asserts full schedulability of the diverse mix)
+        out["error"] = f"only {scheduled}/{total_pods} pods scheduled"
+        print(json.dumps(out))
+        return 1
+    print(json.dumps(out))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        run_child()
+    else:
+        sys.exit(main())
