@@ -162,14 +162,15 @@ def run_child():
     solver = JaxSolver()
 
     for pod_count in _grid():
-        # warmup run compiles this shape bucket (Go excludes setup via
-        # ResetTimer); the repeat run measures steady-state solve time
+        # warm and measure the SAME workload: the warmup compiles every
+        # shape bucket this problem hits (incl. retry-pass buckets), the
+        # repeat measures steady-state solve time — Go's b.ResetTimer
+        # discipline (scheduling_benchmark_test.go:176)
         pods = make_diverse_pods(pod_count, rng)
         t0 = time.perf_counter()
         solver.solve(pods, its, [tpl])
         warm_s = time.perf_counter() - t0
 
-        pods = make_diverse_pods(pod_count, rng)
         t0 = time.perf_counter()
         result = solver.solve(pods, its, [tpl])
         solve_s = time.perf_counter() - t0

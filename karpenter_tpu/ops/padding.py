@@ -54,15 +54,19 @@ def _pad_reqs(r: ReqTensor, e: int, k: int, v: int) -> ReqTensor:
 
 
 def pad_problem(p: SchedulingProblem, min_pods: int = 0) -> SchedulingProblem:
-    """``min_pods`` pins the pod-axis bucket: relax-and-retry passes shrink
-    the queue, and padding every pass back to the first pass's bucket reuses
-    one compiled kernel instead of compiling per retry size. Padded pod rows
-    tolerate nothing, so they resolve to KIND_FAIL without touching state."""
+    """``min_pods`` raises the pod-axis bucket floor: callers that stack many
+    problems into one batch (parallel/mesh.py stack_problems) pad them all to
+    a common bucket so the shapes line up. The solver's relax-and-retry passes
+    pass no floor — each pass buckets to its own queue size and reuses the
+    compiled kernel for that bucket. Padded pod rows tolerate nothing, so
+    they resolve to KIND_FAIL without touching state."""
     P = pow2_bucket(max(p.num_pods, min_pods))
     T = pow2_bucket(p.num_instance_types)
-    N = pow2_bucket(p.num_nodes, lo=8)
+    # N=0 stays 0: provisioning batches without existing nodes skip the
+    # whole node branch statically instead of scanning 8 inert rows
+    N = pow2_bucket(p.num_nodes, lo=8) if p.num_nodes else 0
     TPL = pow2_bucket(p.num_templates, lo=4)
-    K = pow2_bucket(p.num_keys, lo=8)
+    K = pow2_bucket(p.num_keys, lo=4)
     # V must stay a multiple of 32: the solver bitpacks value lanes into
     # uint32 words for the hot instance-type compatibility product
     V = pow2_bucket(p.num_lanes, lo=32)

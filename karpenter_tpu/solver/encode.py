@@ -138,6 +138,25 @@ class _Vocab:
             for v in r.values:
                 self.value(key, v)
 
+    def add_values_for_active_keys(self, reqs: Requirements):
+        """Intern values only for keys already in the vocabulary.
+
+        Instance types are always the *right side* of Intersects/Compatible
+        (nodeclaim.go:262-264); a key no pod/template/node/topology entity
+        defines can never fail those checks (`both_defined` gates every
+        per-key test, requirements.go:241-258), so instance-type-only keys —
+        e.g. 400+ instance-type-name lanes — are dropped from the device
+        tensors entirely. Values of *active* keys must still be interned:
+        a NotIn pod requirement admits lanes it has never seen, so the
+        instance type's own values need lanes for the intersection test."""
+        for key in reqs:
+            ki = self.key_index.get(key)
+            if ki is None:
+                continue
+            r = reqs.get(key)
+            for v in r.values:
+                self.value(key, v)
+
 
 class Encoder:
     """Encodes one scheduling batch. The vocabulary is rebuilt per batch —
@@ -236,11 +255,6 @@ class Encoder:
         if pod_reqs_override is not None:
             for reqs in pod_reqs_list:
                 vocab.add_requirements(reqs)
-        for it in instance_types:
-            vocab.add_requirements(it.requirements)
-            for o in it.offerings:
-                vocab.value(wk.LABEL_TOPOLOGY_ZONE, o.zone)
-                vocab.value(wk.CAPACITY_TYPE_LABEL_KEY, o.capacity_type)
         for t in templates:
             vocab.add_requirements(t.requirements)
         for n in nodes:
@@ -255,6 +269,16 @@ class Encoder:
         claim_hostnames = [claim_hostname(i) for i in range(num_claim_slots)]
         for h in claim_hostnames:
             vocab.value(wk.LABEL_HOSTNAME, h)
+        # instance types go LAST and never create keys (active-key compaction:
+        # see add_values_for_active_keys) — the key set above is exactly what
+        # left-side states can ever define, so compat on any other key is
+        # statically true and the lanes would be dead weight in the hot
+        # [bins x instance-types] product
+        for it in instance_types:
+            vocab.add_values_for_active_keys(it.requirements)
+            for o in it.offerings:
+                vocab.value(wk.LABEL_TOPOLOGY_ZONE, o.zone)
+                vocab.value(wk.CAPACITY_TYPE_LABEL_KEY, o.capacity_type)
 
         K = len(vocab.keys)
         V = max((len(v) for v in vocab.values), default=1) or 1
@@ -306,7 +330,12 @@ class Encoder:
                 comp[e] = True
                 for key in reqs:
                     r = reqs.get(key)
-                    ki = vocab.key_index[key]
+                    # inactive key (instance-type rows only): no left-side
+                    # state defines it, so Intersects can't fail on it —
+                    # leaving the row undefined here is exact
+                    ki = vocab.key_index.get(key)
+                    if ki is None:
+                        continue
                     defined[e, ki] = True
                     comp[e, ki] = r.complement
                     if r.greater_than is not None:

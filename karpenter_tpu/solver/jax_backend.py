@@ -177,11 +177,10 @@ class JaxSolver(SolverBackend):
                     else None
                 ),
             )
-            # retry passes stay in the first pass's pod bucket: one compile
-            problem, meta = (
-                pad_problem(encoded.problem, min_pods=len(pods)),
-                encoded.meta,
-            )
+            # each pass pads to its own queue's pow2 bucket: a retry pass over
+            # the failed minority scans far fewer steps than the full batch,
+            # at the cost of at most log2(P) cached compiles per shape family
+            problem, meta = pad_problem(encoded.problem), encoded.meta
             group_keys = [
                 tg.hash_key()
                 for tg in list(topo.topologies.values())
