@@ -1,0 +1,507 @@
+"""Batched consolidation-candidate scoring — the flagship TPU win.
+
+The reference evaluates consolidation subsets one at a time, each probe
+re-running a full scheduling simulation (multinodeconsolidation.go:87-137 runs
+log2(100) probes; singlenodeconsolidation.go:42-88 runs one per candidate).
+Here the probes become a single device program: every subset is the SAME
+cluster problem with a few rows masked, so we encode the union problem once,
+stack B cheap per-subset variants (node rows disabled, staying pods made
+inert, topology census deltas applied), and score all subsets with one
+vmapped multi-pass solve — optionally sharded across a device mesh on the
+candidate axis (parallel/mesh.py; no collectives are needed, the batch is
+embarrassingly parallel).
+
+Exactness notes:
+  - A subset's variant problem is identical to what simulate_scheduling
+    (disruption/helpers.py) would build for those candidates, except that
+    pods of *other* candidates exist as inert rows (they tolerate nothing, so
+    they fail without touching state) and their topology census contribution
+    is restored via per-candidate count deltas.
+  - The screen runs a fixed number of no-relaxation placement passes
+    (parallel/mesh.py batched_screen); the sequential path additionally runs
+    the preference-relaxation ladder. The screen is therefore pessimistic:
+    a subset it accepts is confirmed by one sequential simulation before a
+    command is issued, and subsets it rejects are rejected (the reference's
+    binary search is itself a heuristic over a non-monotone predicate,
+    multinodeconsolidation.go:99-111).
+  - max_claims=2 suffices: consolidation rejects any result needing more
+    than one replacement (consolidation.go:155-162), and a KIND_NO_SLOT pod
+    can only appear when >2 claims were wanted, which fails the same rule.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.objects import Pod
+from karpenter_tpu.ops.ffd import KIND_FAIL
+from karpenter_tpu.ops.padding import pad_problem
+from karpenter_tpu.parallel.mesh import (
+    batched_screen,
+    default_mesh,
+    stack_problems,
+)
+from karpenter_tpu.provisioning.topology import Topology
+from karpenter_tpu.solver.encode import Encoder, NodeInfo
+
+MAX_SCREEN_CLAIMS = 2
+
+
+def node_labels_of(node: NodeInfo) -> Dict[str, str]:
+    """Recover the node's concrete labels from its requirement rows (every
+    node label encodes as a singleton In requirement, existingnode.go:40-62)."""
+    labels = {}
+    for key in node.requirements:
+        r = node.requirements.get(key)
+        if not r.complement and len(r.values) == 1:
+            labels[key] = next(iter(r.values))
+    return labels
+
+
+@dataclass
+class _CandidateDelta:
+    """What one candidate's *staying put* contributes to the topology census:
+    the counts its pods add to each group and the registered lanes its node
+    hostname provides. Applied for candidates OUTSIDE the scored subset."""
+
+    counts: np.ndarray  # i32[G, V]
+    registered: np.ndarray  # bool[G, V]
+
+
+@dataclass
+class SubsetVerdict:
+    """One subset's screen result."""
+
+    all_pods_scheduled: bool
+    n_new_claims: int
+    # surviving instance-type indices + admitted zone/ct lanes of the single
+    # replacement claim (empty / None when n_new_claims != 1)
+    replacement_its: List[int] = field(default_factory=list)
+    replacement_zones: Optional[Set[str]] = None
+    replacement_cts: Optional[Set[str]] = None
+
+    def consolidatable_with(self, candidates, instance_types) -> bool:
+        """Full consolidation verdict: pods fit elsewhere, at most one
+        replacement, and the replacement passes the price/spot rules
+        (consolidation.go:155-188)."""
+        if not self.all_pods_scheduled or self.n_new_claims > 1:
+            return False
+        if self.n_new_claims == 0:
+            return True
+        if all(c.capacity_type == wk.CAPACITY_TYPE_SPOT for c in candidates):
+            return False
+        max_price = sum(c.price for c in candidates)
+        require_od = all(
+            c.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND for c in candidates
+        )
+        for idx in self.replacement_its:
+            it = instance_types[idx]
+            for o in it.offerings.available():
+                if self.replacement_zones is not None and o.zone not in self.replacement_zones:
+                    continue
+                if self.replacement_cts is not None and o.capacity_type not in self.replacement_cts:
+                    continue
+                if require_od and o.capacity_type != wk.CAPACITY_TYPE_ON_DEMAND:
+                    continue
+                if o.price < max_price:
+                    return True
+        return False
+
+
+class UnionScorer:
+    """Encodes the union problem once and scores arbitrary candidate subsets
+    as one batched solve. ``inputs`` is a provisioning SchedulerInputs whose
+    ``pods`` are the base reschedule set (pending + deleting-node pods) and
+    whose ``nodes`` still CONTAIN the candidates (they are masked per subset).
+    """
+
+    def __init__(
+        self,
+        inputs,
+        candidates: Sequence,
+        num_claim_slots: int = MAX_SCREEN_CLAIMS,
+    ):
+        self.inputs = inputs
+        self.candidates = list(candidates)
+        self.num_claim_slots = num_claim_slots
+
+        cand_names = {c.name for c in self.candidates}
+        node_by_name = {n.name: n for n in inputs.nodes}
+        self.cand_nodes = [node_by_name.get(c.name) for c in self.candidates]
+
+        # union pod list: base pods first, then each candidate's pods as a
+        # contiguous slice
+        self.base_pods: List[Pod] = list(inputs.pods)
+        self.union_pods: List[Pod] = list(inputs.pods)
+        self.cand_slices: List[Tuple[int, int]] = []
+        cand_pod_volumes: List = []
+        for c in self.candidates:
+            pods = c.reschedulable_pods()
+            start = len(self.union_pods)
+            self.union_pods.extend(pods)
+            self.cand_slices.append((start, len(self.union_pods)))
+            cand_pod_volumes.extend([{}] * len(pods))
+        self.pod_volumes = (
+            list(inputs.pod_volumes) + cand_pod_volumes
+            if inputs.pod_volumes is not None
+            else None
+        )
+
+        # topology over the union: batch pods (all candidates') are excluded
+        # from the census, so this is the every-candidate-removed base;
+        # per-candidate deltas restore the census of the ones that stay
+        topo = Topology(
+            inputs.domains,
+            batch_pods=self.union_pods,
+            cluster_pods=inputs.cluster_pods,
+        )
+        for n in inputs.nodes:
+            if n.name not in cand_names:
+                topo.register(wk.LABEL_HOSTNAME, n.name)
+        # encoder group order: regular topologies first, then inverse
+        self.groups = list(topo.topologies.values()) + list(
+            topo.inverse_topologies.values()
+        )
+        self.n_regular = len(topo.topologies)
+
+        encoded = Encoder().encode(
+            self.union_pods,
+            inputs.instance_types,
+            inputs.templates,
+            nodes=inputs.nodes,
+            topology=topo,
+            num_claim_slots=num_claim_slots,
+            pod_volumes=self.pod_volumes,
+        )
+        self.meta = encoded.meta
+        self.base_problem = pad_problem(encoded.problem)
+        self._key_idx = {k: i for i, k in enumerate(self.meta.keys)}
+        self._lane = [
+            {v: i for i, v in enumerate(vals)} for vals in self.meta.values_per_key
+        ]
+        self._node_idx = {n: i for i, n in enumerate(self.meta.node_names)}
+        # problem pod rows are FFD-queue-sorted; candidate slices index the
+        # original union order — precompute each candidate's row indices
+        row_of = {orig: row for row, orig in enumerate(self.meta.pod_order)}
+        self._row_of = row_of
+        self.cand_rows = [
+            np.array([row_of[orig] for orig in range(start, end)], dtype=np.int64)
+            for (start, end) in self.cand_slices
+        ]
+        self.deltas = [self._delta_for(c, n) for c, n in zip(self.candidates, self.cand_nodes)]
+
+    # -- census deltas --------------------------------------------------------
+
+    def _lane_of(self, key: str, value: str) -> Optional[int]:
+        ki = self._key_idx.get(key)
+        if ki is None:
+            return None
+        return self._lane[ki].get(value)
+
+    def _delta_for(self, candidate, node: Optional[NodeInfo]) -> _CandidateDelta:
+        """counts/registered a *staying* candidate contributes: its pods into
+        every regular group that selects them (topology.go:238-291), its
+        anti-affinity pods into their own inverse groups (topology.go:205-232),
+        and its hostname as a registered domain for hostname groups."""
+        G = self.base_problem.grp_counts0.shape[0]
+        V = self.base_problem.grp_counts0.shape[1]
+        counts = np.zeros((G, V), dtype=np.int32)
+        registered = np.zeros((G, V), dtype=bool)
+        if node is None:
+            return _CandidateDelta(counts, registered)
+        labels = node_labels_of(node)
+        from karpenter_tpu.scheduling.requirements import label_requirements
+
+        node_reqs = label_requirements(labels)
+        pods = candidate.reschedulable_pods()
+        for gi, tg in enumerate(self.groups):
+            if gi >= G:
+                break
+            domain = labels.get(tg.key)
+            lane = self._lane_of(tg.key, domain) if domain is not None else None
+            if gi >= self.n_regular:
+                # inverse anti-affinity: the staying pod's own required terms
+                # block its node's domain for prospective victims
+                for pod in pods:
+                    aff = pod.spec.affinity
+                    if not (
+                        aff
+                        and aff.pod_anti_affinity
+                        and aff.pod_anti_affinity.required
+                    ):
+                        continue
+                    if not tg.is_owned_by(pod.uid):
+                        continue
+                    if lane is not None and lane < V:
+                        counts[gi, lane] += 1
+                        registered[gi, lane] = True
+            else:
+                for pod in pods:
+                    if pod.namespace not in tg.namespaces:
+                        continue
+                    if tg.selector is None or not tg.selector.matches(
+                        pod.metadata.labels
+                    ):
+                        continue
+                    if lane is None or lane >= V:
+                        continue
+                    if not tg.node_filter.matches_requirements(node_reqs):
+                        continue
+                    counts[gi, lane] += 1
+                    registered[gi, lane] = True
+                if tg.key == wk.LABEL_HOSTNAME:
+                    hlane = self._lane_of(wk.LABEL_HOSTNAME, node.name)
+                    if hlane is not None and hlane < V:
+                        registered[gi, hlane] = True
+        return _CandidateDelta(counts, registered)
+
+    # -- subset scoring -------------------------------------------------------
+
+    def score_subsets(
+        self,
+        subsets: Sequence[Sequence[int]],
+        mesh="auto",
+        passes: int = 3,
+    ) -> List[SubsetVerdict]:
+        """Score each subset (a list of candidate indices) with one batched
+        device solve. ``mesh='auto'`` shards the subset axis across every
+        local device when more than one is present."""
+        import dataclasses
+
+        if not subsets:
+            return []
+        if mesh == "auto":
+            mesh = default_mesh()
+        base = self.base_problem
+        # every-candidate-stays census, computed once: a subset then only
+        # SUBTRACTS its own members' deltas (boolean OR over the outside set
+        # == integer sum over it > 0, since deltas are non-negative), making
+        # variant construction O(|subset|) instead of O(n_candidates)
+        if self.deltas:
+            delta_counts = np.stack([d.counts for d in self.deltas])
+            # registered deltas already cover counted lanes (_delta_for sets
+            # both together)
+            delta_reg_int = np.stack(
+                [d.registered for d in self.deltas]
+            ).astype(np.int32)
+        else:
+            delta_counts = np.zeros((0,) + base.grp_counts0.shape, dtype=np.int32)
+            delta_reg_int = delta_counts
+        all_counts = base.grp_counts0 + delta_counts.sum(axis=0)
+        all_reg_int = delta_reg_int.sum(axis=0)
+        # candidate pod rows (FFD-sorted positions) are inert unless their
+        # candidate is in the subset; base (pending/deleting) pod rows and
+        # padded rows keep their base toleration masks
+        all_cand_rows = (
+            np.concatenate(self.cand_rows) if self.cand_rows else np.zeros(0, dtype=np.int64)
+        )
+        variants = []
+        for subset in subsets:
+            s = list(subset)
+            node_avail = np.array(base.node_avail)
+            pod_tol_tpl = np.array(base.pod_tol_tpl)
+            pod_tol_node = np.array(base.pod_tol_node)
+            counts = all_counts.copy()
+            reg_int = all_reg_int.copy()
+            inert = np.zeros(pod_tol_tpl.shape[0], dtype=bool)
+            inert[all_cand_rows] = True
+            for ci in s:
+                counts -= delta_counts[ci]
+                reg_int -= delta_reg_int[ci]
+                ni = self._node_idx.get(self.candidates[ci].name)
+                if ni is not None:
+                    node_avail[ni, :] = -1.0
+                inert[self.cand_rows[ci]] = False
+            pod_tol_tpl[inert, :] = False
+            if pod_tol_node.shape[1]:
+                pod_tol_node[inert, :] = False
+            variants.append(
+                dataclasses.replace(
+                    base,
+                    node_avail=node_avail,
+                    pod_tol_tpl=pod_tol_tpl,
+                    pod_tol_node=pod_tol_node,
+                    grp_counts0=counts,
+                    grp_registered0=base.grp_registered0 | (reg_int > 0),
+                )
+            )
+        B = len(variants)
+        pad_to = B
+        if mesh is not None:
+            n_dev = mesh.devices.size
+            pad_to = ((B + n_dev - 1) // n_dev) * n_dev
+        while len(variants) < pad_to:
+            variants.append(variants[0])
+        batch = stack_problems(variants)
+        result = batched_screen(
+            batch, self.num_claim_slots, mesh=mesh, passes=passes
+        )
+        kinds = np.asarray(result.kind)  # [B, P]
+        claim_open = np.asarray(result.state.claim_open)  # [B, C]
+        claim_it_ok = np.asarray(result.state.claim_it_ok)  # [B, C, T]
+        claim_adm = np.asarray(result.state.claim_req.admitted)  # [B, C, K, V]
+
+        T_real = len(self.meta.instance_type_names)
+        zone_k = self.meta.zone_key_idx
+        ct_k = self.meta.ct_key_idx
+        verdicts = []
+        for bi, subset in enumerate(subsets):
+            ok = all(
+                not np.any(kinds[bi, self.cand_rows[ci]] >= KIND_FAIL)
+                for ci in subset
+            )
+            n_claims = int(claim_open[bi].sum())
+            verdict = SubsetVerdict(all_pods_scheduled=ok, n_new_claims=n_claims)
+            if ok and n_claims == 1:
+                slot = int(np.flatnonzero(claim_open[bi])[0])
+                verdict.replacement_its = [
+                    int(t) for t in np.flatnonzero(claim_it_ok[bi, slot]) if t < T_real
+                ]
+                verdict.replacement_zones = self._admitted_values(
+                    claim_adm[bi, slot], zone_k
+                )
+                verdict.replacement_cts = self._admitted_values(
+                    claim_adm[bi, slot], ct_k
+                )
+            verdicts.append(verdict)
+        return verdicts
+
+    def _admitted_values(self, adm_row: np.ndarray, key_idx: int) -> Set[str]:
+        vals = self.meta.values_per_key[key_idx]
+        return {
+            vals[vi]
+            for vi in np.flatnonzero(adm_row[key_idx][: len(vals)])
+        }
+
+
+def build_scorer(provisioner, candidates) -> Optional[UnionScorer]:
+    """Assemble a UnionScorer from the live provisioner state the way
+    simulate_scheduling assembles one probe (helpers.go:73-127): base pods are
+    pending + deleting-node pods; nodes keep the candidates (masked per
+    subset)."""
+    candidate_names = {c.name for c in candidates}
+    pending = provisioner.get_pending_pods()
+    deleting = [
+        p
+        for p in provisioner.get_deleting_node_pods()
+        if p.spec.node_name not in candidate_names
+    ]
+    inputs = provisioner.build_inputs(pending + deleting)
+    if inputs is None:
+        return None
+    return UnionScorer(inputs, candidates)
+
+
+# ---------------------------------------------------------------------------
+# synthetic benchmark entry (bench.py): score all prefixes of a synthetic
+# 100-node cluster the way MultiNodeConsolidation would
+# ---------------------------------------------------------------------------
+
+def bench_candidate_scoring(n_candidates: int = 100, mesh="auto") -> Dict[str, int]:
+    import random
+
+    from karpenter_tpu.apis.nodepool import NodePool
+    from karpenter_tpu.apis.objects import Container, ObjectMeta, PodSpec
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.provisioning.provisioner import SchedulerInputs
+    from karpenter_tpu.scheduling import Requirements, Taints
+    from karpenter_tpu.scheduling.requirements import label_requirements
+    from karpenter_tpu.solver.encode import (
+        domains_from_instance_types,
+        template_from_nodepool,
+    )
+
+    rng = random.Random(7)
+    its = instance_types(100)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="default")), its, range(len(its))
+    )
+
+    class _FakeCandidate:
+        def __init__(self, name, pods, price, capacity_type):
+            self.name = name
+            self._pods = pods
+            self.price = price
+            self.capacity_type = capacity_type
+
+        def reschedulable_pods(self):
+            return self._pods
+
+    nodes = []
+    candidates = []
+    zones = ["test-zone-1", "test-zone-2", "test-zone-3"]
+    for i in range(n_candidates):
+        name = f"cand-node-{i:03d}"
+        labels = {
+            wk.LABEL_HOSTNAME: name,
+            wk.LABEL_TOPOLOGY_ZONE: zones[i % 3],
+            wk.CAPACITY_TYPE_LABEL_KEY: wk.CAPACITY_TYPE_ON_DEMAND,
+            wk.NODEPOOL_LABEL_KEY: "default",
+        }
+        pods = [
+            Pod(
+                metadata=ObjectMeta(name=f"p-{i}-{j}", labels={"app": f"a{j%5}"}),
+                spec=PodSpec(
+                    containers=[
+                        Container(
+                            requests={
+                                "cpu": rng.choice([0.1, 0.25, 0.5]),
+                                "memory": rng.choice([128, 256, 512]) * 1024.0**2,
+                            }
+                        )
+                    ],
+                    node_name=name,
+                ),
+            )
+            for j in range(rng.randint(1, 4))
+        ]
+        nodes.append(
+            NodeInfo(
+                name=name,
+                requirements=label_requirements(labels),
+                taints=Taints([]),
+                available={"cpu": 4.0, "memory": 8 * 1024.0**3, "pods": 110.0},
+                daemon_overhead={},
+            )
+        )
+        candidates.append(_FakeCandidate(name, pods, price=1.0, capacity_type=wk.CAPACITY_TYPE_ON_DEMAND))
+    # roomy survivors so candidate pods have somewhere to go
+    for i in range(8):
+        name = f"big-node-{i}"
+        labels = {
+            wk.LABEL_HOSTNAME: name,
+            wk.LABEL_TOPOLOGY_ZONE: zones[i % 3],
+            wk.CAPACITY_TYPE_LABEL_KEY: wk.CAPACITY_TYPE_ON_DEMAND,
+            wk.NODEPOOL_LABEL_KEY: "default",
+        }
+        nodes.append(
+            NodeInfo(
+                name=name,
+                requirements=label_requirements(labels),
+                taints=Taints([]),
+                available={"cpu": 64.0, "memory": 256 * 1024.0**3, "pods": 500.0},
+                daemon_overhead={},
+            )
+        )
+    cluster_pods = []
+    inputs = SchedulerInputs(
+        pods=[],
+        instance_types=list(its),
+        templates=[tpl],
+        nodes=nodes,
+        domains=domains_from_instance_types(its, [tpl]),
+        cluster_pods=cluster_pods,
+    )
+    scorer = UnionScorer(inputs, candidates)
+    subsets = [list(range(k + 1)) for k in range(n_candidates)]
+    verdicts = scorer.score_subsets(subsets, mesh=mesh)
+    consolidatable = sum(
+        1
+        for v, s in zip(verdicts, subsets)
+        if v.consolidatable_with([candidates[i] for i in s], its)
+    )
+    return {"candidates": n_candidates, "consolidatable": consolidatable}

@@ -10,7 +10,10 @@ the empty-node batch path, the multi-node binary search
 
 from __future__ import annotations
 
+import logging
 from typing import Dict, List, Optional, Sequence
+
+log = logging.getLogger(__name__)
 
 from karpenter_tpu.apis.nodepool import (
     CONSOLIDATION_POLICY_WHEN_UNDERUTILIZED,
@@ -161,8 +164,17 @@ class EmptyNodeConsolidation(ConsolidationBase):
 
 
 class MultiNodeConsolidation(ConsolidationBase):
-    """Binary search for the largest prefix of (cost-sorted) candidates that
-    consolidates simultaneously (multinodeconsolidation.go:87-137)."""
+    """The largest prefix of (cost-sorted) candidates that consolidates
+    simultaneously (multinodeconsolidation.go:87-137).
+
+    TPU path: instead of the reference's sequential binary search — log2(100)
+    probes, each a full scheduling simulation — ALL prefixes are scored at
+    once as a stacked batched solve (disruption/batch.py), then the chosen
+    prefix is confirmed by one sequential simulation that also builds the
+    replacement claim. The screen is relaxation-free and therefore
+    pessimistic; when it rejects everything, the reference binary search runs
+    as the fallback so preference-relaxation-dependent consolidations are
+    still found."""
 
     method_name = "multi-node-consolidation"
     consolidation_type = "multi"
@@ -175,6 +187,43 @@ class MultiNodeConsolidation(ConsolidationBase):
         if not ordered:
             return Command(method=self.method_name)
         deadline = self.clock.now() + MULTI_NODE_TIMEOUT_SECONDS
+
+        best_k = self._screen_best_prefix(ordered)
+        # confirm screened prefixes sequentially, walking down on disagreement
+        # (the sequential sim is the source of truth and builds the command)
+        attempts = 0
+        while best_k > 0 and attempts < 3 and self.clock.now() < deadline:
+            cmd = self.compute_consolidation(ordered[:best_k])
+            if cmd.decision != DECISION_NONE:
+                return cmd
+            best_k -= 1
+            attempts += 1
+        return self._binary_search(ordered, deadline)
+
+    def _screen_best_prefix(self, ordered: Sequence[Candidate]) -> int:
+        """Largest prefix size the batched screen accepts (0 = none)."""
+        try:
+            from karpenter_tpu.disruption.batch import build_scorer
+
+            scorer = build_scorer(self.provisioner, ordered)
+            if scorer is None:
+                return 0
+            subsets = [list(range(k + 1)) for k in range(len(ordered))]
+            verdicts = scorer.score_subsets(subsets)
+            for k in range(len(ordered), 0, -1):
+                if verdicts[k - 1].consolidatable_with(
+                    ordered[:k], scorer.inputs.instance_types
+                ):
+                    return k
+            return 0
+        except Exception:
+            # the screen is an accelerator, never a correctness dependency —
+            # but a silent failure here degrades the flagship fast path, so
+            # make it loud before falling back
+            log.exception("batched multi-node screen failed; using binary search")
+            return 0
+
+    def _binary_search(self, ordered, deadline) -> Command:
         best = Command(method=self.method_name)
         lo, hi = 1, len(ordered)
         while lo <= hi:
@@ -191,8 +240,14 @@ class MultiNodeConsolidation(ConsolidationBase):
 
 
 class SingleNodeConsolidation(ConsolidationBase):
-    """Linear scan, first consolidatable candidate wins
-    (singlenodeconsolidation.go:42-88)."""
+    """First consolidatable candidate wins (singlenodeconsolidation.go:42-88).
+
+    TPU path: all candidates are scored as one batched solve, then the first
+    accepted candidate (in disruption-cost order) is confirmed sequentially.
+    The screen is exact for pods the relaxation ladder cannot touch; screen-
+    rejected candidates that DO carry relaxable preferences still get the
+    sequential probe (bounded by the same 3-minute deadline as the
+    reference), so no consolidation is permanently screened out."""
 
     method_name = "single-node-consolidation"
     consolidation_type = "single"
@@ -200,12 +255,51 @@ class SingleNodeConsolidation(ConsolidationBase):
     def compute_command(
         self, budgets: Dict[str, int], candidates: Sequence[Candidate]
     ) -> Command:
+        from karpenter_tpu.provisioning.preferences import Preferences
+
         ordered = apply_budgets(sort_candidates(candidates), budgets)
+        if not ordered:
+            return Command(method=self.method_name)
         deadline = self.clock.now() + SINGLE_NODE_TIMEOUT_SECONDS
-        for c in ordered:
+
+        screened = self._screen(ordered)
+        if screened is None:
+            probe_order = list(range(len(ordered)))  # screen unavailable
+        else:
+            # screen-accepted first (priority order), then the candidates the
+            # relaxation-free screen may have been pessimistic about
+            accepted = set(screened)
+            relax_dependent = [
+                i
+                for i, c in enumerate(ordered)
+                if i not in accepted
+                and any(Preferences.is_relaxable(p) for p in c.reschedulable_pods())
+            ]
+            probe_order = screened + relax_dependent
+        for i in probe_order:
             if self.clock.now() >= deadline:
                 break
-            cmd = self.compute_consolidation([c])
+            cmd = self.compute_consolidation([ordered[i]])
             if cmd.decision != DECISION_NONE:
                 return cmd
         return Command(method=self.method_name)
+
+    def _screen(self, ordered: Sequence[Candidate]):
+        """Indices of screen-accepted candidates in priority order, or None
+        when the screen is unavailable (fall back to the linear scan)."""
+        try:
+            from karpenter_tpu.disruption.batch import build_scorer
+
+            scorer = build_scorer(self.provisioner, ordered)
+            if scorer is None:
+                return None
+            subsets = [[i] for i in range(len(ordered))]
+            verdicts = scorer.score_subsets(subsets)
+            return [
+                i
+                for i, v in enumerate(verdicts)
+                if v.consolidatable_with([ordered[i]], scorer.inputs.instance_types)
+            ]
+        except Exception:
+            log.exception("batched single-node screen failed; using linear scan")
+            return None

@@ -69,6 +69,63 @@ def batched_solve(
     return _batched_solve_jit(batch, max_claims)
 
 
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _batched_screen_jit(
+    batch: SchedulingProblem, max_claims: int, passes: int
+) -> FFDResult:
+    """Multi-pass batched solve: after each pass, pods that placed are masked
+    inert (their toleration rows zeroed) and the scan re-runs over the carried
+    bin state so order-dependent pods (affinity on a pod placed later in the
+    queue) get their retry — the sequential backend's requeue loop
+    (solver/jax_backend.py pass structure) without relaxation and without a
+    host round-trip. All passes run in one compiled program."""
+    import dataclasses
+
+    from karpenter_tpu.ops.ffd import KIND_FAIL
+
+    def one(p: SchedulingProblem) -> FFDResult:
+        r = _solve_ffd_jit.__wrapped__(p, initial_state(p, max_claims))
+        kind, index = r.kind, r.index
+        for _ in range(passes - 1):
+            placed = kind < KIND_FAIL
+            p2 = dataclasses.replace(
+                p,
+                pod_tol_tpl=p.pod_tol_tpl & ~placed[:, None],
+                pod_tol_node=(
+                    p.pod_tol_node & ~placed[:, None]
+                    if p.pod_tol_node.shape[1]
+                    else p.pod_tol_node
+                ),
+            )
+            r = _solve_ffd_jit.__wrapped__(p2, r.state)
+            kind = jnp.where(placed, kind, r.kind)
+            index = jnp.where(placed, index, r.index)
+        return FFDResult(kind=kind, index=index, state=r.state)
+
+    return jax.vmap(one)(batch)
+
+
+def batched_screen(
+    batch: SchedulingProblem,
+    max_claims: int,
+    mesh: Optional[Mesh] = None,
+    passes: int = 3,
+) -> FFDResult:
+    """batched_solve with ``passes`` placement passes per problem (see
+    _batched_screen_jit) — the consolidation scorer's workhorse."""
+    if mesh is not None:
+        batch = shard_batch(batch, mesh)
+    return _batched_screen_jit(batch, max_claims, passes)
+
+
+def default_mesh(min_devices: int = 2) -> Optional[Mesh]:
+    """A 1-D candidate mesh over every local device, or None on a single
+    device (vmap alone already uses the whole chip)."""
+    if len(jax.devices()) < min_devices:
+        return None
+    return make_mesh()
+
+
 def scheduled_counts(result: FFDResult) -> jnp.ndarray:
     """[B] number of pods placed per candidate problem — the consolidation
     scoring reduction (does the cluster still fit with these nodes gone?)."""

@@ -82,6 +82,27 @@ class Preferences:
                 return f"removed ScheduleAnyway topology spread on {tsc.topology_key}"
         return None
 
+    @staticmethod
+    def is_relaxable(pod: Pod) -> bool:
+        """Whether the ladder has any rung for this pod — i.e. a no-relaxation
+        screen (disruption/batch.py) could be pessimistic about it. Mirrors
+        the step list in relax() minus the template-level PreferNoSchedule
+        blanket (which applies to every pod alike)."""
+        aff = pod.spec.affinity
+        if aff is not None:
+            if aff.node_affinity is not None and (
+                len(aff.node_affinity.required) > 1 or aff.node_affinity.preferred
+            ):
+                return True
+            if aff.pod_affinity is not None and aff.pod_affinity.preferred:
+                return True
+            if aff.pod_anti_affinity is not None and aff.pod_anti_affinity.preferred:
+                return True
+        return any(
+            tsc.when_unsatisfiable == SCHEDULE_ANYWAY
+            for tsc in pod.spec.topology_spread_constraints
+        )
+
     def _tolerate_prefer_no_schedule(self, pod: Pod) -> Optional[str]:
         blanket = Toleration(operator="Exists", effect=PREFER_NO_SCHEDULE)
         if any(

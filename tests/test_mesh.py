@@ -1,0 +1,164 @@
+"""Multi-device solver tests on the virtual 8-device CPU mesh (conftest sets
+xla_force_host_platform_device_count=8): batched-vs-sequential parity, the
+sharding-actually-splits assertion, and the realistic consolidation batch the
+driver's dryrun exercises (VERDICT r1 item 4)."""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis.nodepool import NodePool
+from karpenter_tpu.apis.objects import Container, ObjectMeta, Pod, PodSpec
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.ops.ffd import initial_state, solve_ffd
+from karpenter_tpu.ops.padding import pad_problem
+from karpenter_tpu.parallel.mesh import (
+    CANDIDATE_AXIS,
+    batched_screen,
+    batched_solve,
+    make_mesh,
+    scheduled_counts,
+    shard_batch,
+    stack_problems,
+)
+from karpenter_tpu.solver.encode import Encoder, template_from_nodepool
+
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device CPU mesh from conftest"
+)
+
+
+def _problem(seed: int, num_pods: int = 24, num_its: int = 16, min_pods: int = 0):
+    rng = random.Random(seed)
+    its = instance_types(num_its)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="default")), its, range(len(its))
+    )
+    pods = [
+        Pod(
+            metadata=ObjectMeta(name=f"p{seed}-{i}"),
+            spec=PodSpec(
+                containers=[
+                    Container(
+                        requests={
+                            "cpu": rng.choice([0.1, 0.5, 1.0, 2.0]),
+                            "memory": rng.choice([128, 512, 2048]) * 1024.0**2,
+                        }
+                    )
+                ]
+            ),
+        )
+        for i in range(num_pods)
+    ]
+    encoded = Encoder().encode(pods, its, [tpl], num_claim_slots=8)
+    return pad_problem(encoded.problem, min_pods=min_pods)
+
+
+def test_batched_solve_matches_sequential_over_nontrivial_problems():
+    problems = [_problem(seed, min_pods=24) for seed in range(8)]
+    batch = stack_problems(problems)
+    mesh = make_mesh(8)
+    result = batched_solve(batch, max_claims=8, mesh=mesh)
+    kinds = np.asarray(result.kind)
+    for i, p in enumerate(problems):
+        seq = solve_ffd(p, 8)
+        np.testing.assert_array_equal(
+            kinds[i], np.asarray(seq.kind), err_msg=f"problem {i} diverged"
+        )
+    counts = np.asarray(scheduled_counts(result))
+    assert (counts == 24).all(), counts
+
+
+def test_sharding_actually_splits_candidate_axis():
+    problems = [_problem(seed, min_pods=24) for seed in range(8)]
+    batch = stack_problems(problems)
+    mesh = make_mesh(8)
+    sharded = shard_batch(batch, mesh)
+    sh = sharded.pod_requests.sharding
+    assert sh.spec == jax.sharding.PartitionSpec(CANDIDATE_AXIS)
+    # each of the 8 devices holds exactly one problem's slice
+    shards = sharded.pod_requests.addressable_shards
+    assert len(shards) == 8
+    assert {s.data.shape[0] for s in shards} == {1}
+    assert len({s.device for s in shards}) == 8
+    # and the batched result is itself computed across devices
+    result = batched_solve(sharded, max_claims=8, mesh=None)
+    assert len(result.kind.sharding.device_set) == 8
+
+
+def test_batched_screen_retries_order_dependent_pods():
+    """A pod whose affinity target appears LATER in the FFD queue fails pass
+    one and must succeed on a retry pass — proving the multi-pass screen
+    (mesh.py _batched_screen_jit) actually re-runs failed pods."""
+    from karpenter_tpu.apis import labels as wk
+    from karpenter_tpu.apis.objects import (
+        Affinity,
+        LabelSelector,
+        PodAffinity,
+        PodAffinityTerm,
+    )
+
+    its = instance_types(8)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="default")), its, range(len(its))
+    )
+    affine = Pod(
+        metadata=ObjectMeta(name="wants-buddy", labels={"grp": "x"}),
+        spec=PodSpec(
+            # tiny request -> sorted LAST... no: FFD sorts cpu desc, so the
+            # small affinity pod lands after its big buddy; invert: affinity
+            # pod is BIG so it is queued first, before its target exists
+            containers=[Container(requests={"cpu": 3.0})],
+            affinity=Affinity(
+                pod_affinity=PodAffinity(
+                    required=[
+                        PodAffinityTerm(
+                            topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                            label_selector=LabelSelector(match_labels={"grp": "y"}),
+                        )
+                    ]
+                )
+            ),
+        ),
+    )
+    # the zone selector pins buddy's claim to a single domain, so its
+    # placement is recorded (Record counts only single-domain placements,
+    # topology.go:125-148) and the retry pass can join it
+    buddy = Pod(
+        metadata=ObjectMeta(name="buddy", labels={"grp": "y"}),
+        spec=PodSpec(
+            containers=[Container(requests={"cpu": 0.2})],
+            node_selector={wk.LABEL_TOPOLOGY_ZONE: "test-zone-1"},
+        ),
+    )
+    from karpenter_tpu.provisioning.topology import Topology
+    from karpenter_tpu.solver.encode import domains_from_instance_types
+
+    pods = [affine, buddy]
+    topo = Topology(domains_from_instance_types(its, [tpl]), batch_pods=pods)
+    encoded = Encoder().encode(pods, its, [tpl], num_claim_slots=4, topology=topo)
+    problem = pad_problem(encoded.problem)
+    batch = stack_problems([problem] * 8)
+
+    one_pass = batched_screen(batch, 4, mesh=make_mesh(8), passes=1)
+    multi = batched_screen(batch, 4, mesh=make_mesh(8), passes=3)
+    from karpenter_tpu.ops.ffd import KIND_FAIL
+
+    k1 = np.asarray(one_pass.kind)
+    k3 = np.asarray(multi.kind)
+    # row order: affinity pod first (bigger cpu)
+    assert (k1[:, 0] == KIND_FAIL).all(), "pass 1 must fail the early affinity pod"
+    assert (k3[:, 0] < KIND_FAIL).all(), "retry pass must place it"
+    assert (k3[:, 1] < KIND_FAIL).all()
+
+
+def test_dryrun_scale_consolidation_batch_on_mesh():
+    """The driver's dryrun workload: 100 prefixes of a 100-node cluster,
+    sharded 8 ways."""
+    from karpenter_tpu.disruption.batch import bench_candidate_scoring
+
+    stats = bench_candidate_scoring(24, mesh=make_mesh(8))
+    assert stats["consolidatable"] == 24, stats
