@@ -84,32 +84,38 @@ class SubsetVerdict:
     replacement_zones: Optional[Set[str]] = None
     replacement_cts: Optional[Set[str]] = None
 
+    def _admitted_offerings(self, it):
+        for o in it.offerings.available():
+            if self.replacement_zones is not None and o.zone not in self.replacement_zones:
+                continue
+            if self.replacement_cts is not None and o.capacity_type not in self.replacement_cts:
+                continue
+            yield o
+
     def consolidatable_with(self, candidates, instance_types) -> bool:
         """Full consolidation verdict: pods fit elsewhere, at most one
-        replacement, and the replacement passes the price/spot rules
-        (consolidation.go:155-188)."""
+        replacement, and the replacement passes the price/spot rules —
+        mirroring filter_replacement_instance_types (consolidation.go:150-190):
+        strictly-cheaper instance types must survive, and an all-spot
+        candidate set blocks a replacement that could itself launch as spot."""
         if not self.all_pods_scheduled or self.n_new_claims > 1:
             return False
         if self.n_new_claims == 0:
             return True
-        if all(c.capacity_type == wk.CAPACITY_TYPE_SPOT for c in candidates):
-            return False
         max_price = sum(c.price for c in candidates)
-        require_od = all(
-            c.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND for c in candidates
-        )
+        surviving_cts = set()
         for idx in self.replacement_its:
             it = instance_types[idx]
-            for o in it.offerings.available():
-                if self.replacement_zones is not None and o.zone not in self.replacement_zones:
-                    continue
-                if self.replacement_cts is not None and o.capacity_type not in self.replacement_cts:
-                    continue
-                if require_od and o.capacity_type != wk.CAPACITY_TYPE_ON_DEMAND:
-                    continue
-                if o.price < max_price:
-                    return True
-        return False
+            if any(o.price < max_price for o in self._admitted_offerings(it)):
+                surviving_cts |= {
+                    o.capacity_type for o in self._admitted_offerings(it)
+                }
+        if not surviving_cts:
+            return False
+        all_spot = all(c.capacity_type == wk.CAPACITY_TYPE_SPOT for c in candidates)
+        if all_spot and wk.CAPACITY_TYPE_SPOT in surviving_cts:
+            return False
+        return True
 
 
 class UnionScorer:
@@ -124,7 +130,14 @@ class UnionScorer:
         inputs,
         candidates: Sequence,
         num_claim_slots: int = MAX_SCREEN_CLAIMS,
+        base_pod_count: Optional[int] = None,
     ):
+        """``inputs.pods`` must be the base reschedule set followed by each
+        candidate's reschedulable pods as contiguous slices when
+        ``base_pod_count`` is given (build_scorer's layout — volume topology
+        and CSI limits were then resolved for candidate pods too); with
+        base_pod_count=None the candidates' pods are appended here (synthetic
+        benchmark path, no volumes)."""
         self.inputs = inputs
         self.candidates = list(candidates)
         self.num_claim_slots = num_claim_slots
@@ -133,23 +146,30 @@ class UnionScorer:
         node_by_name = {n.name: n for n in inputs.nodes}
         self.cand_nodes = [node_by_name.get(c.name) for c in self.candidates]
 
-        # union pod list: base pods first, then each candidate's pods as a
-        # contiguous slice
-        self.base_pods: List[Pod] = list(inputs.pods)
-        self.union_pods: List[Pod] = list(inputs.pods)
         self.cand_slices: List[Tuple[int, int]] = []
-        cand_pod_volumes: List = []
-        for c in self.candidates:
-            pods = c.reschedulable_pods()
-            start = len(self.union_pods)
-            self.union_pods.extend(pods)
-            self.cand_slices.append((start, len(self.union_pods)))
-            cand_pod_volumes.extend([{}] * len(pods))
-        self.pod_volumes = (
-            list(inputs.pod_volumes) + cand_pod_volumes
-            if inputs.pod_volumes is not None
-            else None
-        )
+        if base_pod_count is None:
+            self.base_pods: List[Pod] = list(inputs.pods)
+            self.union_pods: List[Pod] = list(inputs.pods)
+            for c in self.candidates:
+                pods = c.reschedulable_pods()
+                start = len(self.union_pods)
+                self.union_pods.extend(pods)
+                self.cand_slices.append((start, len(self.union_pods)))
+            self.pod_volumes = (
+                list(inputs.pod_volumes) + [{}] * (len(self.union_pods) - len(self.base_pods))
+                if inputs.pod_volumes is not None
+                else None
+            )
+        else:
+            self.base_pods = list(inputs.pods[:base_pod_count])
+            self.union_pods = list(inputs.pods)
+            pos = base_pod_count
+            for c in self.candidates:
+                n = len(c.reschedulable_pods())
+                self.cand_slices.append((pos, pos + n))
+                pos += n
+            assert pos == len(self.union_pods), "candidate slices misaligned"
+            self.pod_volumes = inputs.pod_volumes
 
         # topology over the union: batch pods (all candidates') are excluded
         # from the census, so this is the every-candidate-removed base;
@@ -381,8 +401,9 @@ class UnionScorer:
 def build_scorer(provisioner, candidates) -> Optional[UnionScorer]:
     """Assemble a UnionScorer from the live provisioner state the way
     simulate_scheduling assembles one probe (helpers.go:73-127): base pods are
-    pending + deleting-node pods; nodes keep the candidates (masked per
-    subset)."""
+    pending + deleting-node pods; candidate pods join the input set so their
+    volume topology / CSI limits resolve exactly as in the sequential path;
+    nodes keep the candidates (masked per subset)."""
     candidate_names = {c.name for c in candidates}
     pending = provisioner.get_pending_pods()
     deleting = [
@@ -390,10 +411,13 @@ def build_scorer(provisioner, candidates) -> Optional[UnionScorer]:
         for p in provisioner.get_deleting_node_pods()
         if p.spec.node_name not in candidate_names
     ]
-    inputs = provisioner.build_inputs(pending + deleting)
+    cand_pods = [p for c in candidates for p in c.reschedulable_pods()]
+    inputs = provisioner.build_inputs(pending + deleting + cand_pods)
     if inputs is None:
         return None
-    return UnionScorer(inputs, candidates)
+    return UnionScorer(
+        inputs, candidates, base_pod_count=len(pending) + len(deleting)
+    )
 
 
 # ---------------------------------------------------------------------------

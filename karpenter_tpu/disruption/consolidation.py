@@ -37,6 +37,18 @@ def sort_candidates(candidates: Sequence[Candidate]) -> List[Candidate]:
     return sorted(candidates, key=lambda c: c.disruption_cost)
 
 
+def _has_required_pod_terms(pod) -> bool:
+    """Required pod affinity/anti-affinity: placement is order-dependent, so
+    the screen's fixed retry-pass count can be pessimistic about it."""
+    aff = pod.spec.affinity
+    if aff is None:
+        return False
+    return bool(
+        (aff.pod_affinity is not None and aff.pod_affinity.required)
+        or (aff.pod_anti_affinity is not None and aff.pod_anti_affinity.required)
+    )
+
+
 def apply_budgets(
     candidates: Sequence[Candidate], budgets: Dict[str, int]
 ) -> List[Candidate]:
@@ -62,6 +74,18 @@ class ConsolidationBase:
     def __init__(self, provisioner: Provisioner, clock):
         self.provisioner = provisioner
         self.clock = clock
+
+    def _any_prefer_no_schedule(self) -> bool:
+        """Whether any pool's template carries a PreferNoSchedule taint — the
+        relaxation rung the screen never applies (preferences.py
+        _tolerate_prefer_no_schedule)."""
+        from karpenter_tpu.apis.nodepool import NodePool
+
+        for np_obj in self.provisioner.kube.list(NodePool):
+            for t in np_obj.spec.template.spec.taints:
+                if t.effect == "PreferNoSchedule":
+                    return True
+        return False
 
     def should_disrupt(self, candidate: Candidate) -> bool:
         """Policy gate (consolidation.go ShouldDisrupt): only pools asking for
@@ -101,13 +125,20 @@ class ConsolidationBase:
 
     # -- validation (validation.go:68-110) ------------------------------------
 
+    # the controller holds a computed command as pending and calls validate()
+    # only after this much wall-clock has elapsed — reconcile never sleeps
+    # (consolidationTTL, consolidation.go:42)
+    validation_ttl = CONSOLIDATION_TTL_SECONDS
+
     def validate(self, command: Command, kube, cluster, cloud_provider) -> bool:
-        """Re-verify after the TTL: every candidate must still be eligible,
-        and a delete-only decision must re-simulate against the candidates'
-        *fresh* pod lists (validation.go:68-110)."""
+        """Re-verify after the TTL (the controller owns the wait): every
+        candidate must still be eligible and un-nominated, and any decision
+        whose correctness depends on the candidates' pods — replace commands,
+        and delete commands over non-empty nodes — must re-simulate against
+        the candidates' *fresh* pod lists (validation.go:68-110 re-runs the
+        simulation for every command)."""
         if command.decision == DECISION_NONE:
             return False
-        self.clock.sleep(CONSOLIDATION_TTL_SECONDS)
         fresh = {
             c.name: c
             for c in get_candidates(
@@ -120,11 +151,18 @@ class ConsolidationBase:
             if now is None or cluster.is_nominated(c.name):
                 return False
             refreshed.append(now)
-        if not command.replacements and any(not c.is_empty() for c in refreshed):
-            # nodes may have gained pods during the TTL; the free-drain claim
-            # must hold against what is on them NOW
+        if command.replacements or any(not c.is_empty() for c in refreshed):
+            # nodes may have gained pods during the TTL; the decision must
+            # hold against what is on them NOW — and the command is updated
+            # to the FRESH result, so a replacement sized for the old pod set
+            # is never launched (a stale one could be too small for pods that
+            # arrived during the TTL)
             recheck = self.compute_consolidation(refreshed)
-            return recheck.decision == command.decision
+            if recheck.decision != command.decision:
+                return False
+            command.candidates = refreshed
+            command.replacements = recheck.replacements
+            return True
         return True
 
 
@@ -150,7 +188,6 @@ class EmptyNodeConsolidation(ConsolidationBase):
     def validate(self, command: Command, kube, cluster, cloud_provider) -> bool:
         if command.decision == DECISION_NONE:
             return False
-        self.clock.sleep(CONSOLIDATION_TTL_SECONDS)
         fresh = {
             c.name: c
             for c in get_candidates(
@@ -266,16 +303,27 @@ class SingleNodeConsolidation(ConsolidationBase):
         if screened is None:
             probe_order = list(range(len(ordered)))  # screen unavailable
         else:
-            # screen-accepted first (priority order), then the candidates the
-            # relaxation-free screen may have been pessimistic about
+            # screen-accepted first (priority order), then every candidate
+            # the fixed-pass relaxation-free screen may have been pessimistic
+            # about: pods with relaxable preferences, pods with required
+            # affinity chains deeper than the screen's pass count, and any
+            # pod when a pool uses PreferNoSchedule taints (the blanket-
+            # toleration rung relaxes those only in the sequential solver)
+            prefer_no_schedule_pools = self._any_prefer_no_schedule()
             accepted = set(screened)
-            relax_dependent = [
+            maybe_pessimistic = [
                 i
                 for i, c in enumerate(ordered)
                 if i not in accepted
-                and any(Preferences.is_relaxable(p) for p in c.reschedulable_pods())
+                and (
+                    prefer_no_schedule_pools
+                    or any(
+                        Preferences.is_relaxable(p) or _has_required_pod_terms(p)
+                        for p in c.reschedulable_pods()
+                    )
+                )
             ]
-            probe_order = screened + relax_dependent
+            probe_order = screened + maybe_pessimistic
         for i in probe_order:
             if self.clock.now() >= deadline:
                 break

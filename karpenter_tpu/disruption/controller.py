@@ -10,6 +10,7 @@ to the orchestration queue (controller.go:142-213).
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from typing import List, Optional
 
 from karpenter_tpu.cloudprovider.types import CloudProvider
@@ -47,6 +48,18 @@ ELIGIBLE_NODES = REGISTRY.gauge(
 )
 
 
+@dataclass
+class PendingCommand:
+    """A computed consolidation command waiting out its validation TTL.
+    The reference blocks its singleton goroutine on the TTL
+    (consolidation.go IsValid); here the controller parks the command and
+    keeps reconciling — no wall-clock sleep ever happens inside a pass."""
+
+    command: Command
+    method: object
+    computed_at: float
+
+
 class Controller:
     def __init__(
         self,
@@ -73,14 +86,20 @@ class Controller:
             MultiNodeConsolidation(provisioner, clock),
             SingleNodeConsolidation(provisioner, clock),
         ]
+        self.pending: Optional[PendingCommand] = None
 
     def reconcile(self) -> Optional[Command]:
         """One pass: first method that produces a validated command wins
-        (controller.go:97-171). Returns the executed command, if any."""
+        (controller.go:97-171). Consolidation commands are two-phase: the
+        first pass parks them as pending, a pass after the 15s validation TTL
+        revalidates and executes — no pass ever blocks. Returns the executed
+        command, if any."""
         if not self.cluster.synced():
             return None
         self._cleanup_orphaned_taints()
         self.queue.reconcile()
+        if self.pending is not None:
+            return self._resolve_pending()
         nodepool_map = build_nodepool_map(self.kube, self.cloud_provider)
         nodepools = nodepool_map[0]
         evaluated_consolidation = False
@@ -106,6 +125,11 @@ class Controller:
                 command = method.compute_command(budgets, candidates)
             if command.decision == DECISION_NONE:
                 continue
+            if getattr(method, "validation_ttl", 0.0) > 0:
+                # park for TTL revalidation; one action per pass still holds
+                # because nothing else executes while a command is pending
+                self.pending = PendingCommand(command, method, self.clock.now())
+                return None
             if not method.validate(
                 command, self.kube, self.cluster, self.cloud_provider
             ):
@@ -117,6 +141,24 @@ class Controller:
         # would reset the 5-minute forced-revisit window forever
         if evaluated_consolidation:
             self.cluster.mark_consolidated()
+        return None
+
+    def _resolve_pending(self) -> Optional[Command]:
+        """Validate-and-execute a parked command once its TTL has elapsed
+        (validation.go:68-110 semantics without blocking the pass)."""
+        pending = self.pending
+        assert pending is not None
+        if (
+            self.clock.now() - pending.computed_at
+            < pending.method.validation_ttl
+        ):
+            return None
+        self.pending = None
+        if pending.method.validate(
+            pending.command, self.kube, self.cluster, self.cloud_provider
+        ):
+            self._execute(pending.command)
+            return pending.command
         return None
 
     def _consolidated_gate(self, method) -> bool:

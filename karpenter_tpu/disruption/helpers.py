@@ -172,55 +172,75 @@ def candidate_total_price(candidates: Sequence[Candidate]) -> float:
     return sum(c.price for c in candidates)
 
 
+def _replacement_capacity_types(sim, placement, surviving) -> set:
+    """The capacity types the replacement claim could launch as: its explicit
+    capacity-type requirement when concrete, else everything its surviving
+    instance types offer (an undefined requirement admits any type) — the
+    Requirements.Get(CapacityTypeLabelKey) read in consolidation.go:173-188."""
+    reqs = placement.requirements
+    if reqs is not None and reqs.has(wk.CAPACITY_TYPE_LABEL_KEY):
+        r = reqs.get(wk.CAPACITY_TYPE_LABEL_KEY)
+        if not r.complement:
+            return set(r.values)
+    cts = set()
+    for idx in surviving:
+        offerings = sim.inputs.instance_types[idx].offerings.available()
+        if reqs is not None:
+            offerings = offerings.requirements(reqs)
+        cts |= {o.capacity_type for o in offerings}
+    return cts
+
+
 def filter_replacement_instance_types(
     sim: SimulationResults, candidates: Sequence[Candidate]
 ) -> bool:
     """Apply the consolidation price rules to the (single) replacement claim
-    in the simulation result, in place (consolidation.go:163-188,
+    in the simulation result, in place (consolidation.go:150-190,
     helpers.go:235-258):
 
       - the replacement's viable instance types must be strictly cheaper than
-        the current total price of the candidates;
-      - spot nodes are never replaced by another node for price reasons alone
-        (spot -> spot churn guard): when every candidate is spot, replacement
-        is disallowed entirely;
-      - when candidates are all on-demand, the replacement is restricted to
-        on-demand offerings (a spot replacement would trade reliability, not
-        price).
+        the current total price of the candidates (any capacity type);
+      - spot -> spot churn guard: when every candidate is spot AND the
+        replacement could launch as spot, consolidation aborts (availability
+        of the cheaper spot type is not a reliable signal) — an on-demand
+        replacement of spot nodes remains allowed;
+      - when the replacement could be either spot or on-demand, it is PINNED
+        to spot: the price filter assumed the spot price, and falling back to
+        on-demand could launch something more expensive than what exists.
 
     Returns False when no instance type survives (consolidation aborts)."""
     if not sim.result.new_claims:
         return True
     if len(sim.result.new_claims) > 1:
         return False
-    if all(c.capacity_type == wk.CAPACITY_TYPE_SPOT for c in candidates):
-        return False
     max_price = candidate_total_price(candidates)
     placement = sim.result.new_claims[0]
     reqs = placement.requirements
-    require_on_demand = all(
-        c.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND for c in candidates
-    )
     surviving = []
     for idx in placement.instance_type_indices:
         it = sim.inputs.instance_types[idx]
         offerings = it.offerings.available()
         if reqs is not None:
             offerings = offerings.requirements(reqs)
-        if require_on_demand:
-            offerings = type(offerings)(
-                o for o in offerings if o.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND
-            )
         cheapest = offerings.cheapest()
         if cheapest is not None and cheapest.price < max_price:
             surviving.append(idx)
     if not surviving:
         return False
     placement.instance_type_indices = surviving
-    if require_on_demand and reqs is not None:
+
+    cts = _replacement_capacity_types(sim, placement, surviving)
+    all_spot = all(c.capacity_type == wk.CAPACITY_TYPE_SPOT for c in candidates)
+    if all_spot and wk.CAPACITY_TYPE_SPOT in cts:
+        return False
+    if wk.CAPACITY_TYPE_SPOT in cts and wk.CAPACITY_TYPE_ON_DEMAND in cts:
         from karpenter_tpu.scheduling.requirements import Requirement
 
-        reqs.add(
-            Requirement(wk.CAPACITY_TYPE_LABEL_KEY, "In", [wk.CAPACITY_TYPE_ON_DEMAND])
+        if placement.requirements is None:
+            from karpenter_tpu.scheduling import Requirements
+
+            placement.requirements = Requirements()
+        placement.requirements.add(
+            Requirement(wk.CAPACITY_TYPE_LABEL_KEY, "In", [wk.CAPACITY_TYPE_SPOT])
         )
     return True

@@ -78,8 +78,11 @@ class Options:
                 else:
                     default = env_val
             if isinstance(default, bool):
+                # BooleanOptionalAction: bare '--enable-profiling' works like a
+                # conventional CLI boolean and '--no-enable-profiling' negates
+                # (ADVICE r1: type=lambda made the bare flag an argparse error)
                 parser.add_argument(flag, dest=f.name, default=default,
-                                    type=lambda s: s.lower() in ("1", "true", "yes"))
+                                    action=argparse.BooleanOptionalAction)
             else:
                 parser.add_argument(flag, dest=f.name, default=default,
                                     type=type(default))

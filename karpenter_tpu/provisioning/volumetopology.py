@@ -61,11 +61,11 @@ class VolumeTopology:
             if pvc.volume_name:
                 pv = self.kube.get_opt(PersistentVolume, pvc.volume_name, "")
                 if pv is not None and pv.node_affinity_required:
-                    # a bound PV pins the pod to its topology (:48-55)
-                    out = []
-                    for term in pv.node_affinity_required:
-                        out.extend(term.match_expressions)
-                    return out
+                    # a bound PV pins the pod to its topology; NodeSelectorTerms
+                    # are ORed, so only the first term is used — flattening all
+                    # terms would AND mutually-exclusive zones together and
+                    # make the pod unschedulable (volumetopology.go:134-137)
+                    return list(pv.node_affinity_required[0].match_expressions)
                 return []
             sc = resolve_storage_class(self.kube, pvc.storage_class_name)
         elif volume.ephemeral is not None:
@@ -74,7 +74,6 @@ class VolumeTopology:
             return []
         if sc is None or not sc.allowed_topologies:
             return []
-        out = []
-        for term in sc.allowed_topologies:
-            out.extend(term.match_expressions)
-        return out
+        # allowedTopologies terms are ORed like NodeSelectorTerms: first only
+        # (volumetopology.go:146-153)
+        return list(sc.allowed_topologies[0].match_expressions)

@@ -105,6 +105,18 @@ class Env:
 
     # -- disruption -----------------------------------------------------------
 
+    def reconcile_disruption(self):
+        """Drive the controller through the two-phase consolidation TTL:
+        compute pass → step the fake clock past the validation TTL →
+        revalidation pass. Returns the executed command (or None). Mirrors
+        what the 10s singleton poll does against a real clock."""
+        ctrl = self.disruption_controller()
+        cmd = ctrl.reconcile()
+        if cmd is None and ctrl.pending is not None:
+            self.clock.step(ctrl.pending.method.validation_ttl + 0.1)
+            cmd = ctrl.reconcile()
+        return cmd
+
     def disruption_controller(self):
         from karpenter_tpu.disruption.controller import Controller
 

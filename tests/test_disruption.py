@@ -27,7 +27,7 @@ def test_empty_node_consolidation_deletes():
     env = Env()
     env.create(make_underutilized_pool())
     env.create_candidate_node("n1")
-    cmd = env.disruption_controller().reconcile()
+    cmd = env.reconcile_disruption()
     assert cmd is not None and cmd.decision == DECISION_DELETE
     assert cmd.method == "empty-node-consolidation"
     # replacements (none) are trivially initialized: queue deletes the claim
@@ -54,7 +54,7 @@ def test_single_node_consolidation_moves_pods_to_existing_node():
         "n-host", it_name="default-instance-type",
         pods=[make_pod(name="h1", cpu=3.0)],
     )
-    cmd = env.disruption_controller().reconcile()
+    cmd = env.reconcile_disruption()
     assert cmd is not None
     assert cmd.decision == DECISION_DELETE
     assert cmd.method == "single-node-consolidation"
@@ -67,7 +67,7 @@ def test_consolidation_replace_with_cheaper_instance():
     # a big node hosting a tiny pod: a cheaper shape must exist
     pod = make_pod(name="p1", cpu=0.5)
     env.create_candidate_node("n1", it_name="default-instance-type", pods=[pod])
-    cmd = env.disruption_controller().reconcile()
+    cmd = env.reconcile_disruption()
     assert cmd is not None and cmd.decision == DECISION_REPLACE
     assert len(cmd.replacements) == 1
     replacement = env.kube.get(NodeClaim, cmd.replacements[0].metadata.name, "")
@@ -93,7 +93,7 @@ def test_spot_candidates_are_never_replaced():
         "n1", it_name="default-instance-type",
         capacity_type=wk.CAPACITY_TYPE_SPOT, pods=[pod],
     )
-    cmd = env.disruption_controller().reconcile()
+    cmd = env.reconcile_disruption()
     # moving the pod needs a replacement, and spot->spot replacement is
     # blocked: no action
     assert cmd is None
@@ -138,7 +138,7 @@ def test_emptiness_requires_ttl():
     # TTL not yet elapsed
     assert env.disruption_controller().reconcile() is None
     env.clock.step(31)
-    cmd = env.disruption_controller().reconcile()
+    cmd = env.reconcile_disruption()
     assert cmd is not None and cmd.method == "emptiness"
     assert cmd.decision == DECISION_DELETE
 
@@ -148,7 +148,7 @@ def test_drift_replaces_occupied_node():
     env.create(make_underutilized_pool())
     pod = make_pod(name="p1", cpu=0.5)
     env.create_candidate_node("n1", pods=[pod], conditions=[(nc.DRIFTED, 0.0)])
-    cmd = env.disruption_controller().reconcile()
+    cmd = env.reconcile_disruption()
     assert cmd is not None and cmd.method == "drift"
     assert cmd.decision == DECISION_REPLACE
 
@@ -157,7 +157,7 @@ def test_empty_drifted_fast_path_deletes():
     env = Env()
     env.create(make_underutilized_pool())
     env.create_candidate_node("n1", conditions=[(nc.DRIFTED, 0.0)])
-    cmd = env.disruption_controller().reconcile()
+    cmd = env.reconcile_disruption()
     assert cmd is not None and cmd.method == "drift"
     assert cmd.decision == DECISION_DELETE
 
@@ -178,7 +178,7 @@ def test_expiration_prefers_soonest_expired():
         "newer", conditions=[(nc.EXPIRED, now)], creation_timestamp=now - 3700,
         pods=[make_pod(name="pn", cpu=0.5)],
     )
-    cmd = env.disruption_controller().reconcile()
+    cmd = env.reconcile_disruption()
     assert cmd is not None and cmd.method == "expiration"
     assert [c.name for c in cmd.candidates] == ["older"]
 
@@ -187,7 +187,7 @@ def test_execute_taints_and_marks():
     env = Env()
     env.create(make_underutilized_pool())
     env.create_candidate_node("n1")
-    cmd = env.disruption_controller().reconcile()
+    cmd = env.reconcile_disruption()
     assert cmd is not None
     node = env.kube.get(Node, "n1", "")
     assert any(t.match(disruption_taint()) for t in node.spec.taints)
@@ -200,7 +200,7 @@ def test_queue_waits_for_replacement_then_deletes():
     pod = make_pod(name="p1", cpu=0.5)
     env.create_candidate_node("n1", pods=[pod])
     ctrl = env.disruption_controller()
-    cmd = ctrl.reconcile()
+    cmd = env.reconcile_disruption()
     assert cmd is not None and cmd.decision == DECISION_REPLACE
     # replacement not initialized yet: candidate survives
     ctrl.queue.reconcile()
@@ -220,7 +220,7 @@ def test_queue_timeout_rolls_back():
     pod = make_pod(name="p1", cpu=0.5)
     env.create_candidate_node("n1", pods=[pod])
     ctrl = env.disruption_controller()
-    cmd = ctrl.reconcile()
+    cmd = env.reconcile_disruption()
     assert cmd is not None and cmd.decision == DECISION_REPLACE
     env.clock.step(COMMAND_TIMEOUT_SECONDS + 1)
     ctrl.queue.reconcile()
@@ -309,8 +309,72 @@ def test_multi_node_consolidation_batches():
                               pods=[make_pod(name="p2", cpu=0.1)])
     env.create_candidate_node("n3", it_name="default-instance-type",
                               pods=[make_pod(name="p3", cpu=0.1)])
-    cmd = env.disruption_controller().reconcile()
+    cmd = env.reconcile_disruption()
     assert cmd is not None
     assert cmd.method == "multi-node-consolidation"
     assert len(cmd.candidates) >= 2
     assert len(cmd.replacements) <= 1
+
+
+def test_validation_is_two_phase_and_never_blocks():
+    """The compute pass parks the command as pending; it executes only on a
+    pass after the 15s TTL has elapsed on the clock — reconcile never sleeps
+    (validation.go:68-110 without blocking the singleton)."""
+    env = Env()
+    env.create(make_underutilized_pool())
+    env.create_candidate_node("n1")
+    ctrl = env.disruption_controller()
+    t0 = env.clock.now()
+    assert ctrl.reconcile() is None
+    assert ctrl.pending is not None
+    assert env.clock.now() == t0, "reconcile must not advance/block the clock"
+    # before the TTL: still parked
+    env.clock.step(CONSOLIDATION_TTL_SECONDS / 2)
+    assert ctrl.reconcile() is None and ctrl.pending is not None
+    # after the TTL: validated and executed
+    env.clock.step(CONSOLIDATION_TTL_SECONDS)
+    cmd = ctrl.reconcile()
+    assert cmd is not None and cmd.decision == DECISION_DELETE
+
+
+def test_replace_command_revalidates_against_fresh_pods():
+    """Pods that land on a candidate during the TTL must abort a stale
+    replace decision (ADVICE r1: reference ValidateCommand re-simulates every
+    command, not just delete-only ones)."""
+    env = Env()
+    env.create(make_underutilized_pool())
+    env.create_candidate_node("n1", pods=[make_pod(name="p1", cpu=0.5)])
+    ctrl = env.disruption_controller()
+    assert ctrl.reconcile() is None
+    pending = ctrl.pending
+    assert pending is not None and pending.command.replacements
+    # a big pod binds to n1 during the TTL: the cheap replacement no longer
+    # holds, validation must drop the command
+    intruder = make_pod(name="intruder", cpu=3.0, node_name="n1")
+    env.create(intruder)
+    env.bind(intruder, "n1")
+    env.clock.step(CONSOLIDATION_TTL_SECONDS + 1)
+    assert ctrl.reconcile() is None
+    assert ctrl.pending is None
+
+
+def test_od_to_spot_replacement_is_allowed_and_pinned():
+    """All-on-demand candidates may be replaced by a cheaper node, and when
+    the replacement could launch as either spot or on-demand it is pinned to
+    spot — the price filter assumed the spot price (consolidation.go:183-189;
+    ADVICE r1: the old rule forced an on-demand replacement)."""
+    env = Env()
+    env.create(make_underutilized_pool())
+    env.create_candidate_node(
+        "n1", it_name="default-instance-type",
+        capacity_type=wk.CAPACITY_TYPE_ON_DEMAND,
+        pods=[make_pod(name="p1", cpu=0.5)],
+    )
+    cmd = env.reconcile_disruption()
+    assert cmd is not None and cmd.decision == DECISION_REPLACE
+    rep = cmd.replacements[0]
+    # fake ITs offer both spot and on-demand -> the claim must pin spot
+    ct_reqs = [
+        r for r in rep.spec.requirements if r.key == wk.CAPACITY_TYPE_LABEL_KEY
+    ]
+    assert ct_reqs and list(ct_reqs[0].values) == [wk.CAPACITY_TYPE_SPOT], ct_reqs
