@@ -20,8 +20,7 @@ from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.apis.nodeclaim import NodeClaim, NodeClaimStatus
 from karpenter_tpu.apis.objects import Node, ObjectMeta, Pod
 from karpenter_tpu.cloudprovider.types import CloudProvider, NodeClaimNotFoundError
-from karpenter_tpu.disruption.pdblimits import PDBLimits
-from karpenter_tpu.events import Recorder, object_event
+from karpenter_tpu.events import Recorder
 from karpenter_tpu.kube.client import KubeClient
 from karpenter_tpu.metrics import REGISTRY
 from karpenter_tpu.state.statenode import disruption_taint
@@ -51,12 +50,19 @@ def _is_daemon(pod: Pod) -> bool:
 class NodeTerminationController:
     def __init__(
         self, kube: KubeClient, cloud_provider: CloudProvider, clock: Clock,
-        recorder: Recorder,
+        recorder: Recorder, eviction_queue=None,
     ):
+        from karpenter_tpu.controllers.eviction_queue import EvictionQueue
+
         self.kube = kube
         self.cloud_provider = cloud_provider
         self.clock = clock
         self.recorder = recorder
+        self.eviction_queue = (
+            eviction_queue
+            if eviction_queue is not None
+            else EvictionQueue(kube, clock, recorder)
+        )
 
     def reconcile_all(self) -> None:
         for node in self.kube.list(Node):
@@ -92,7 +98,12 @@ class NodeTerminationController:
             self.kube.patch(node, lambda n: n.spec.taints.append(taint))
 
     def _drain(self, node: Node) -> bool:
-        """One eviction pass; True while pods remain (terminator.go:81-147)."""
+        """One drain pass; True while pods remain (terminator.go:81-147).
+
+        Eviction itself is asynchronous: the current priority group's pods go
+        into the singleton eviction queue (PDB-429-aware, exponential
+        backoff) and the drain just observes pods leaving the node — the
+        reference's Terminator.Drain + eviction queue split."""
         pods = self.kube.list(
             Pod, predicate=lambda p: p.spec.node_name == node.metadata.name
         )
@@ -112,25 +123,10 @@ class NodeTerminationController:
             [p for p in evictable if _is_critical(p) and not _is_daemon(p)],
             [p for p in evictable if _is_critical(p) and _is_daemon(p)],
         ]
-        pdb = PDBLimits(self.kube)
         for group in groups:
-            if not group:
-                continue
-            for pod in group:
-                if not pdb.try_consume(pod):
-                    # PDB 429: leave it for a later pass (eviction.go:127-149)
-                    self.recorder.publish(
-                        object_event(
-                            pod, "Normal", "EvictionBlocked",
-                            "pod disruption budget prevents eviction",
-                        )
-                    )
-                    continue
-                self.recorder.publish(
-                    object_event(pod, "Normal", "Evicted", "draining node")
-                )
-                self.kube.delete_opt(Pod, pod.metadata.name, pod.metadata.namespace)
-            break  # later groups wait for this one to finish draining
+            if group:
+                self.eviction_queue.add(*group)
+                break  # later groups wait for this one to finish draining
         return True
 
     def _delete_instance(self, node: Node) -> None:

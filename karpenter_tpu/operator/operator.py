@@ -103,8 +103,12 @@ class Operator:
             drift_enabled=self.options.drift_enabled(),
         )
         self.claim_termination = TerminationController(self.kube, self.cloud_provider)
+        from karpenter_tpu.controllers.eviction_queue import EvictionQueue
+
+        self.eviction_queue = EvictionQueue(self.kube, self.clock, self.recorder)
         self.node_termination = NodeTerminationController(
-            self.kube, self.cloud_provider, self.clock, self.recorder
+            self.kube, self.cloud_provider, self.clock, self.recorder,
+            eviction_queue=self.eviction_queue,
         )
         self.gc = GarbageCollectionController(
             self.kube, self.cloud_provider, self.clock, self.recorder
@@ -137,6 +141,8 @@ class Operator:
             ("nodeclaim.markers", self.markers.reconcile_all, 10.0),
             ("nodeclaim.termination", self.claim_termination.reconcile_all, 1.0),
             ("node.termination", self.node_termination.reconcile_all, 1.0),
+            # sub-second so PDB-429 backoffs (100ms base) retry promptly
+            ("node.eviction_queue", self.eviction_queue.reconcile, 0.1),
             ("nodeclaim.garbagecollection", self.gc.reconcile, GC_PERIOD),
             ("nodeclaim.consistency", self.consistency.reconcile, CONSISTENCY_PERIOD),
             ("nodepool.hash", self.nodepool_hash.reconcile_all, 10.0),

@@ -3,6 +3,7 @@ pkg/controllers/nodeclaim/{lifecycle,termination,garbagecollection,
 consistency}, node/termination, nodepool/{hash,counter},
 leasegarbagecollection)."""
 
+import pytest
 from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.apis.nodeclaim import DRIFTED, EMPTY, EXPIRED, NodeClaim
 from karpenter_tpu.apis.nodepool import Disruption as DisruptionPolicy
@@ -288,12 +289,15 @@ def test_drain_orders_and_deletes():
     env.kube.delete(Node, "n1", "")
     ctrl = NodeTerminationController(env.kube, env.cloud_provider, env.clock,
                                      env.recorder)
-    # pass 1: non-daemon app evicted first, daemon survives
+    # pass 1: non-daemon app enqueued first; the async queue evicts it,
+    # the daemon survives the pass
     assert ctrl.reconcile(stored) == "draining"
+    ctrl.eviction_queue.reconcile()
     assert env.kube.get_opt(Pod, "app") is None
     assert env.kube.get_opt(Pod, "daemon") is not None
-    # pass 2: daemon evicted
+    # pass 2: daemon enqueued and evicted
     assert ctrl.reconcile(stored) == "draining"
+    ctrl.eviction_queue.reconcile()
     assert env.kube.get_opt(Pod, "daemon") is None
     # pass 3: drained -> instance deleted, finalizer off, node gone
     assert ctrl.reconcile(stored) == "done"
@@ -319,12 +323,18 @@ def test_drain_honors_pdb():
     ctrl = NodeTerminationController(env.kube, env.cloud_provider, env.clock,
                                      env.recorder)
     assert ctrl.reconcile(stored) == "draining"
-    assert env.kube.get_opt(Pod, "web-1") is not None  # PDB blocked
+    ctrl.eviction_queue.reconcile()
+    assert env.kube.get_opt(Pod, "web-1") is not None  # PDB blocked (429)
     assert env.recorder.count("EvictionBlocked") == 1
-    # a second replica elsewhere frees the budget
+    # blocked retries back off: an immediate pass does nothing
+    ctrl.eviction_queue.reconcile()
+    assert env.recorder.count("EvictionBlocked") == 1
+    # a second replica elsewhere frees the budget; after the backoff the
+    # queued eviction goes through
     env.create(make_pod(name="web-2", cpu=0.1, labels={"app": "web"},
                         node_name="other", phase="Running"))
-    ctrl.reconcile(stored)
+    env.clock.step(0.2)
+    ctrl.eviction_queue.reconcile()
     assert env.kube.get_opt(Pod, "web-1") is None
 
 
@@ -379,3 +389,43 @@ def test_lease_gc():
     assert LeaseGarbageCollectionController(env.kube).reconcile_all() == 1
     assert env.kube.get_opt(Lease, "n1", "kube-node-lease") is not None
     assert env.kube.get_opt(Lease, "ghost", "kube-node-lease") is None
+
+
+def test_eviction_queue_backoff_grows_and_caps():
+    """PDB-blocked evictions retry on an exponential schedule, 100ms doubling
+    to a 10s cap, and a pod enters the queue only once
+    (terminator/eviction.go:44-45, 92-99)."""
+    from karpenter_tpu.controllers.eviction_queue import (
+        BASE_DELAY_SECONDS,
+        MAX_DELAY_SECONDS,
+        EvictionQueue,
+    )
+
+    env = Env()
+    env.create(PodDisruptionBudget(
+        metadata=ObjectMeta(name="pdb"),
+        selector=LabelSelector(match_labels={"app": "web"}),
+        min_available=1,
+    ))
+    pod = make_pod(name="web-1", cpu=0.1, labels={"app": "web"},
+                   node_name="n1", phase="Running")
+    env.create(pod)
+    q = EvictionQueue(env.kube, env.clock, env.recorder)
+    q.add(pod)
+    q.add(pod)  # dedup: still one item
+    assert len(q) == 1
+    delays = []
+    for _ in range(10):
+        q.reconcile()
+        item = next(iter(q.items.values()))
+        delays.append(item.next_attempt_at - env.clock.now())
+        env.clock.step(item.next_attempt_at - env.clock.now() + 0.001)
+    assert delays[0] == pytest.approx(BASE_DELAY_SECONDS, abs=1e-3)
+    assert delays[1] == pytest.approx(2 * BASE_DELAY_SECONDS, abs=1e-3)
+    assert delays[-1] == pytest.approx(MAX_DELAY_SECONDS, abs=1e-3)
+    # budget freed -> next due attempt evicts and empties the queue
+    env.create(make_pod(name="web-2", cpu=0.1, labels={"app": "web"},
+                        node_name="other", phase="Running"))
+    q.reconcile()
+    assert len(q) == 0
+    assert env.kube.get_opt(Pod, "web-1") is None
