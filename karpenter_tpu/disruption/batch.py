@@ -289,7 +289,15 @@ class UnionScorer:
     ) -> List[SubsetVerdict]:
         """Score each subset (a list of candidate indices) with one batched
         device solve. ``mesh='auto'`` shards the subset axis across every
-        local device when more than one is present."""
+        local device when more than one is present.
+
+        ``passes`` is an upper bound: when no pod interacts with any topology
+        group, one placement pass is a fixed point — within a pass node/claim
+        resources, port reservations, and requirement state only ever shrink,
+        so a pod that failed cannot be unblocked by a later placement (the
+        sequential requeue loop, scheduler.go:150-170, only helps pods whose
+        failure involved topology counters or a not-yet-placed affinity
+        target) — and the screen drops to a single exact pass."""
         import dataclasses
 
         if not subsets:
@@ -297,6 +305,12 @@ class UnionScorer:
         if mesh == "auto":
             mesh = default_mesh()
         base = self.base_problem
+        if base.num_groups == 0 or not (
+            np.any(base.pod_grp_match)
+            or np.any(base.pod_grp_selects)
+            or np.any(base.pod_grp_owned)
+        ):
+            passes = 1
         # every-candidate-stays census, computed once: a subset then only
         # SUBTRACTS its own members' deltas (boolean OR over the outside set
         # == integer sum over it > 0, since deltas are non-negative), making

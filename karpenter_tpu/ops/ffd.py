@@ -52,6 +52,12 @@ from karpenter_tpu.models.problem import CT_KEY, HOSTNAME_KEY, ZONE_KEY  # noqa:
 # import time (and block on the TPU tunnel in processes that never use it)
 _BIG = 2**30
 
+# scan unroll factor: amortizes per-iteration dispatch overhead on
+# accelerators at the cost of a proportionally bigger program to compile
+import os as _os  # noqa: E402
+
+_UNROLL = int(_os.environ.get("KARPENTER_TPU_SCAN_UNROLL", "1"))
+
 
 @jax.tree_util.register_dataclass
 @dataclass
@@ -484,5 +490,5 @@ def _solve_ffd_jit(problem: SchedulingProblem, init: FFDState) -> FFDResult:
         jnp.asarray(problem.pod_grp_owned),
         jnp.asarray(problem.pod_vol_counts),
     )
-    final_state, (kinds, indices) = lax.scan(step, init, pods_xs)
+    final_state, (kinds, indices) = lax.scan(step, init, pods_xs, unroll=_UNROLL)
     return FFDResult(kind=kinds, index=indices, state=final_state)
