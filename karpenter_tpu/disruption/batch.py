@@ -337,28 +337,24 @@ class UnionScorer:
         for subset in subsets:
             s = list(subset)
             node_avail = np.array(base.node_avail)
-            pod_tol_tpl = np.array(base.pod_tol_tpl)
-            pod_tol_node = np.array(base.pod_tol_node)
             counts = all_counts.copy()
             reg_int = all_reg_int.copy()
-            inert = np.zeros(pod_tol_tpl.shape[0], dtype=bool)
-            inert[all_cand_rows] = True
+            # other candidates' pods are masked out via pod_active — the run
+            # structure stays intact and the variant costs two small arrays
+            pod_active = np.array(base.pod_active)
+            pod_active[all_cand_rows] = False
             for ci in s:
                 counts -= delta_counts[ci]
                 reg_int -= delta_reg_int[ci]
                 ni = self._node_idx.get(self.candidates[ci].name)
                 if ni is not None:
                     node_avail[ni, :] = -1.0
-                inert[self.cand_rows[ci]] = False
-            pod_tol_tpl[inert, :] = False
-            if pod_tol_node.shape[1]:
-                pod_tol_node[inert, :] = False
+                pod_active[self.cand_rows[ci]] = True
             variants.append(
                 dataclasses.replace(
                     base,
                     node_avail=node_avail,
-                    pod_tol_tpl=pod_tol_tpl,
-                    pod_tol_node=pod_tol_node,
+                    pod_active=pod_active,
                     grp_counts0=counts,
                     grp_registered0=base.grp_registered0 | (reg_int > 0),
                 )

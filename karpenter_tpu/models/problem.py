@@ -202,6 +202,19 @@ class SchedulingProblem:
     pod_grp_selects: Any
     pod_grp_owned: Any
     claim_hostname_lane: Any
+    # run-length compression of the FFD queue (ops/ffd.py runs solver):
+    # consecutive queue rows with byte-identical encodings and no topology
+    # interaction form one run committed in a single scan step. pod_active
+    # masks rows out of a solve without changing the run structure (the
+    # batched consolidation screen flips it per candidate subset).
+    pod_active: Any = None  # bool[P]
+    run_start: Any = None  # i32[RN] first queue row of each run
+    run_len: Any = None  # i32[RN] rows in the run (0 = padding run)
+    run_multi: Any = None  # bool[RN] eligible for the analytic multi-pod commit
+
+    @property
+    def num_runs(self) -> int:
+        return self.run_start.shape[0]
 
     @property
     def num_groups(self) -> int:

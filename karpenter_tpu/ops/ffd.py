@@ -171,16 +171,9 @@ def _pad_lanes_mult32(problem: SchedulingProblem) -> SchedulingProblem:
     )
 
 
-@jax.jit
-def _solve_ffd_jit(problem: SchedulingProblem, init: FFDState) -> FFDResult:
+def _lane_align(problem: SchedulingProblem, init: FFDState):
     problem = _pad_lanes_mult32(problem)
-    C = init.claim_open.shape[0]
-    N = problem.num_nodes
-    T = problem.num_instance_types
-    TPL = problem.num_templates
-    K = problem.num_keys
     V = problem.num_lanes
-
     # lane-pad carried state to match (no-op when init came from initial_state)
     if init.grp_counts.shape[-1] != V:
         pad = V - init.grp_counts.shape[-1]
@@ -198,15 +191,23 @@ def _solve_ffd_jit(problem: SchedulingProblem, init: FFDState) -> FFDResult:
             grp_counts=jnp.pad(init.grp_counts, [(0, 0), (0, pad)]),
             grp_registered=jnp.pad(init.grp_registered, [(0, 0), (0, pad)]),
         )
+    return problem, init
 
+
+def _statics(problem: SchedulingProblem):
+    """Per-solve invariants shared by the per-pod step and the run commit."""
     lv, ln = jnp.asarray(problem.lane_valid), jnp.asarray(problem.lane_numeric)
     wellknown = jnp.asarray(problem.key_wellknown)
     no_allow = jnp.zeros_like(wellknown)
-
     # instance-type side of the hot compat product: packed lanes + polarity,
     # computed once per solve (instance types never change during a pack)
     it_packed = masks.pack_lanes(jnp.asarray(problem.it_reqs.admitted))  # [T, K, W]
     it_neg = vmap(lambda r: masks.negative_polarity(r, lv, ln))(problem.it_reqs)
+    return lv, ln, wellknown, no_allow, it_packed, it_neg
+
+
+def _make_it_gate(problem, statics):
+    lv, ln, wellknown, no_allow, it_packed, it_neg = statics
 
     def it_gate(state_rows: ReqTensor, requests: jnp.ndarray, prior_ok: jnp.ndarray):
         """[B, T] mask of instance types surviving a narrowed state +
@@ -224,6 +225,79 @@ def _solve_ffd_jit(problem: SchedulingProblem, init: FFDState) -> FFDResult:
         )(state_rows.admitted)  # [B, T]
         return prior_ok & compat & fit & offer
 
+    return it_gate
+
+
+def _mix_req_rows(cur: ReqTensor, upd: ReqTensor, hot) -> ReqTensor:
+    """Commit updated requirement rows where ``hot`` (bool[E]) is set."""
+    sel2, sel3 = hot[:, None], hot[:, None, None]
+    return ReqTensor(
+        admitted=jnp.where(sel3, upd.admitted, cur.admitted),
+        comp=jnp.where(sel2, upd.comp, cur.comp),
+        gt=jnp.where(sel2, upd.gt, cur.gt),
+        lt=jnp.where(sel2, upd.lt, cur.lt),
+        defined=jnp.where(sel2, upd.defined, cur.defined),
+    )
+
+
+def _fresh_template_rows(problem: SchedulingProblem, lv, ln, wellknown, pod_req, free_slot):
+    """Fresh-claim template evaluation shared by the per-pod step and the run
+    commit: the prospective slot's hostname is minted and pinned into the
+    merged template rows before any gate sees them (nodeclaim.go:46-63), and
+    template compatibility uses the well-known allowance. Returns
+    (tpl_merged, tpl_compat, host_onehot)."""
+    V = problem.num_lanes
+    mint_hostnames = problem.claim_hostname_lane.shape[0] > 0
+    if mint_hostnames:
+        host_lane = problem.claim_hostname_lane[
+            jnp.minimum(free_slot, problem.claim_hostname_lane.shape[0] - 1)
+        ]
+        host_onehot = jnp.arange(V) == host_lane  # [V]
+    else:
+        host_onehot = jnp.zeros((V,), dtype=bool)
+    tpl_compat = vmap(
+        lambda tr: masks.compatible_ok(tr, pod_req, lv, ln, wellknown)
+    )(problem.tpl_reqs)
+    tpl_merged = _intersect_rows(problem.tpl_reqs, pod_req)
+    if mint_hostnames:
+        tpl_merged = ReqTensor(
+            admitted=tpl_merged.admitted.at[:, HOSTNAME_KEY, :].set(
+                tpl_merged.admitted[:, HOSTNAME_KEY, :] & host_onehot[None, :]
+            ),
+            comp=tpl_merged.comp.at[:, HOSTNAME_KEY].set(False),
+            gt=tpl_merged.gt,
+            lt=tpl_merged.lt,
+            defined=tpl_merged.defined.at[:, HOSTNAME_KEY].set(True),
+        )
+    return tpl_merged, tpl_compat, host_onehot
+
+
+def _pod_xs(problem: SchedulingProblem):
+    return (
+        problem.pod_reqs,
+        problem.pod_strict_reqs,
+        jnp.asarray(problem.pod_requests),
+        jnp.asarray(problem.pod_tol_tpl),
+        jnp.asarray(problem.pod_tol_node),
+        jnp.asarray(problem.pod_ports),
+        jnp.asarray(problem.pod_port_conflict),
+        jnp.asarray(problem.pod_grp_match),
+        jnp.asarray(problem.pod_grp_selects),
+        jnp.asarray(problem.pod_grp_owned),
+        jnp.asarray(problem.pod_vol_counts),
+        jnp.asarray(problem.pod_active),
+    )
+
+
+def _make_step(problem: SchedulingProblem, statics, C: int):
+    lv, ln, wellknown, no_allow, it_packed, it_neg = statics
+    N = problem.num_nodes
+    T = problem.num_instance_types
+    TPL = problem.num_templates
+    K = problem.num_keys
+    V = problem.num_lanes
+    it_gate = _make_it_gate(problem, statics)
+
     def step(state: FFDState, pod):
         (
             pod_req,
@@ -237,6 +311,7 @@ def _solve_ffd_jit(problem: SchedulingProblem, init: FFDState) -> FFDResult:
             grp_selects,
             grp_owned,
             pod_vols,
+            pod_is_active,
         ) = pod
         topo_pod = PodTopoStatics(
             strict_admitted=pod_strict.admitted,
@@ -295,28 +370,9 @@ def _solve_ffd_jit(problem: SchedulingProblem, init: FFDState) -> FFDResult:
         # hostname minting is active only when the encoder allotted claim
         # hostname lanes (static shape decision)
         mint_hostnames = problem.claim_hostname_lane.shape[0] > 0
-        if mint_hostnames:
-            host_lane = problem.claim_hostname_lane[
-                jnp.minimum(free_slot, problem.claim_hostname_lane.shape[0] - 1)
-            ]
-            host_onehot = jnp.arange(V) == host_lane  # [V]
-        else:
-            host_onehot = jnp.zeros((V,), dtype=bool)
-
-        tpl_compat = vmap(
-            lambda tr: masks.compatible_ok(tr, pod_req, lv, ln, wellknown)
-        )(problem.tpl_reqs)
-        tpl_merged = _intersect_rows(problem.tpl_reqs, pod_req)
-        if mint_hostnames:
-            tpl_merged = ReqTensor(
-                admitted=tpl_merged.admitted.at[:, HOSTNAME_KEY, :].set(
-                    tpl_merged.admitted[:, HOSTNAME_KEY, :] & host_onehot[None, :]
-                ),
-                comp=tpl_merged.comp.at[:, HOSTNAME_KEY].set(False),
-                gt=tpl_merged.gt,
-                lt=tpl_merged.lt,
-                defined=tpl_merged.defined.at[:, HOSTNAME_KEY].set(True),
-            )
+        tpl_merged, tpl_compat, host_onehot = _fresh_template_rows(
+            problem, lv, ln, wellknown, pod_req, free_slot
+        )
         # the new hostname is registered before the gate evaluates
         reg_for_tpl = state.grp_registered | (
             (problem.grp_key == HOSTNAME_KEY)[:, None] & host_onehot[None, :]
@@ -346,21 +402,17 @@ def _solve_ffd_jit(problem: SchedulingProblem, init: FFDState) -> FFDResult:
                 ),
             ),
         ).astype(jnp.int32)
+        # masked-out rows (pod_active=False: padding, or a consolidation
+        # variant's inert candidate pods) fail without touching state — all
+        # one-hot commits below derive from kind
+        kind = jnp.where(pod_is_active, kind, KIND_FAIL)
 
         # -- commit via one-hot masks
         node_hot = (jnp.arange(N) == node_pick) & (kind == KIND_NODE)
         claim_hot = (jnp.arange(C) == claim_pick) & (kind == KIND_CLAIM)
         slot_hot = (jnp.arange(C) == free_slot) & (kind == KIND_NEW_CLAIM)
 
-        def mix_req(cur: ReqTensor, upd: ReqTensor, hot) -> ReqTensor:
-            sel2, sel3 = hot[:, None], hot[:, None, None]
-            return ReqTensor(
-                admitted=jnp.where(sel3, upd.admitted, cur.admitted),
-                comp=jnp.where(sel2, upd.comp, cur.comp),
-                gt=jnp.where(sel2, upd.gt, cur.gt),
-                lt=jnp.where(sel2, upd.lt, cur.lt),
-                defined=jnp.where(sel2, upd.defined, cur.defined),
-            )
+        mix_req = _mix_req_rows
 
         def gather_row(rows: ReqTensor, idx, cap) -> ReqTensor:
             return rows.row(jnp.minimum(idx, cap - 1))
@@ -477,18 +529,483 @@ def _solve_ffd_jit(problem: SchedulingProblem, init: FFDState) -> FFDResult:
         )
         return new_state, (kind, index)
 
-    pods_xs = (
-        problem.pod_reqs,
-        problem.pod_strict_reqs,
-        jnp.asarray(problem.pod_requests),
-        jnp.asarray(problem.pod_tol_tpl),
-        jnp.asarray(problem.pod_tol_node),
-        jnp.asarray(problem.pod_ports),
-        jnp.asarray(problem.pod_port_conflict),
-        jnp.asarray(problem.pod_grp_match),
-        jnp.asarray(problem.pod_grp_selects),
-        jnp.asarray(problem.pod_grp_owned),
-        jnp.asarray(problem.pod_vol_counts),
-    )
-    final_state, (kinds, indices) = lax.scan(step, init, pods_xs, unroll=_UNROLL)
+    return step
+
+
+@jax.jit
+def _solve_ffd_jit(problem: SchedulingProblem, init: FFDState) -> FFDResult:
+    """Reference per-pod scan: one pod per step. Kept as the semantic anchor
+    the run-compressed solver is fuzz-checked against, and as the fallback
+    when KARPENTER_TPU_RUNS=0."""
+    problem, init = _lane_align(problem, init)
+    step = _make_step(problem, _statics(problem), init.claim_open.shape[0])
+    final_state, (kinds, indices) = lax.scan(step, init, _pod_xs(problem), unroll=_UNROLL)
     return FFDResult(kind=kinds, index=indices, state=final_state)
+
+
+# integer "unbounded" sentinel for analytic pod-count capacities; large enough
+# to never bind, small enough that int32 level arithmetic can't overflow
+_BIG_CAP = 2**20
+
+
+def _capacity(avail, used, req):
+    """Integer count of additional identical pods with requests ``req`` that
+    fit in ``avail - used`` (trailing resource axis), honoring fits()'s float
+    tolerance: max j with used + j*req <= avail + eps — the closed form of
+    iterating the per-pod fit check. Zero-request dims still gate: fits()
+    fails on an already-overcommitted dim even when the pod adds nothing to
+    it (and the -1 removed/padded-bin sentinel must reject every pod)."""
+    eps = 1e-6 + 1e-6 * jnp.abs(avail)
+    room = avail + eps - used
+    roomf = room / jnp.where(req > 0, req, 1.0)
+    per_r = jnp.where(req > 0, jnp.floor(roomf), jnp.float32(_BIG_CAP))
+    zero_ok = jnp.all((req > 0) | (room >= 0), axis=-1)
+    cap = jnp.clip(jnp.min(per_r, axis=-1), 0, _BIG_CAP).astype(jnp.int32)
+    return jnp.where(zero_ok, cap, 0)
+
+
+def _water_level(levels, caps, units, iters=22):
+    """Largest integer L with sum(clip(L - levels, 0, caps)) <= units — the
+    common fill level after pouring ``units`` one-by-one into the bin with the
+    lowest level (argmin with index tie-break), each bin bounded by its cap.
+    ``levels``/``caps`` are 1-D [C]; ``units`` may be any shape (the search
+    runs elementwise over it)."""
+    lo = jnp.zeros_like(units)
+    hi = jnp.full_like(units, 2 * _BIG_CAP)
+
+    def bs(_, lohi):
+        lo, hi = lohi
+        mid = (lo + hi + 1) // 2
+        used = jnp.sum(jnp.clip(mid[..., None] - levels, 0, caps), axis=-1)
+        ok = used <= units
+        return jnp.where(ok, mid, lo), jnp.where(ok, hi, mid - 1)
+
+    lo, hi = lax.fori_loop(0, iters, bs, (lo, hi))
+    return lo
+
+
+def _make_run_commit(problem: SchedulingProblem, statics, C: int, max_run: int):
+    """The analytic multi-pod commit: one scan step places an entire run of
+    identical, topology-inert pods, reproducing the per-pod step's outcome
+    (including each pod's (kind, index) in temporal order) in closed form.
+
+    Correctness argument, phase by phase (all against _make_step's semantics):
+      nodes   — a pod takes the FIRST node that passes the static gates with
+                room, so k pods fill nodes in index order up to each node's
+                integer capacity: cumsum fill. Narrowing commits are
+                idempotent for identical pods.
+      claims  — a pod takes the open claim with the FEWEST pods (index
+                tie-break), i.e. pods waterfill claim levels bounded by each
+                claim's capacity (max over surviving instance types of how
+                many more such pods fit). The temporal order of assignments
+                is (level-before, claim index) lexicographic — recovered per
+                ordinal to keep exact per-pod parity with the oracle.
+      opens   — pods that exhaust claim capacity open fresh template claims
+                one at a time; each opened claim absorbs pods up to its own
+                capacity before the next opens (it is the unique unsaturated
+                claim), so openings assign consecutive ordinal blocks in
+                slot order. Limit headroom burns once per open (subtractMax,
+                scheduler.go:347-364).
+    """
+    lv, ln, wellknown, no_allow, it_packed, it_neg = statics
+    N = problem.num_nodes
+    T = problem.num_instance_types
+    TPL = problem.num_templates
+    K = problem.num_keys
+    V = problem.num_lanes
+    D = problem.pod_vol_counts.shape[1]
+    mint_hostnames = problem.claim_hostname_lane.shape[0] > 0
+
+    def has_offering_rows(admitted):
+        return vmap(
+            lambda adm: masks.has_offering(
+                adm, ZONE_KEY, CT_KEY, problem.offer_zone, problem.offer_ct, problem.offer_ok
+            )
+        )(admitted)
+
+    def commit(state: FFDState, pod, start, length, active_arr):
+        (
+            pod_req,
+            _pod_strict,
+            pod_requests,
+            tol_tpl,
+            tol_node,
+            pod_ports,
+            pod_conflict,
+            _gm,
+            _gs,
+            _go,
+            pod_vols,
+            _pa,
+        ) = pod
+        win = jnp.arange(max_run)
+        act = lax.dynamic_slice(active_arr, (start,), (max_run,)) & (win < length)
+        k = act.sum().astype(jnp.int32)
+        ordinal = (jnp.cumsum(act) - 1).astype(jnp.int32)  # [MR]
+        port_cap = jnp.where(jnp.any(pod_ports), 1, _BIG_CAP).astype(jnp.int32)
+
+        # ---- 1. existing nodes: first-fit fill in node order
+        if N > 0:
+            node_merged = _intersect_rows(state.node_req, pod_req)
+            node_compat = vmap(
+                lambda nr: masks.compatible_ok(nr, pod_req, lv, ln, no_allow)
+            )(state.node_req)
+            node_port_ok = ~jnp.any(state.node_used_ports & pod_conflict[None, :], axis=-1)
+            if D > 0:
+                # clamp: pre-existing over-limit attach counts read as 0
+                # capacity, not negative (the per-pod gate simply fails)
+                vol_room = jnp.maximum(
+                    (problem.node_vol_limits - state.node_vol_used)
+                    // jnp.maximum(pod_vols[None, :], 1),
+                    0,
+                )
+                vol_cap = jnp.min(
+                    jnp.where(pod_vols[None, :] > 0, vol_room, _BIG_CAP), axis=-1
+                ).astype(jnp.int32)
+            else:
+                vol_cap = jnp.full((N,), _BIG_CAP, jnp.int32)
+            res_cap = _capacity(
+                problem.node_avail, state.node_requests, pod_requests[None, :]
+            )
+            node_ok = tol_node & node_compat & node_port_ok
+            ncap = jnp.where(node_ok, jnp.minimum(jnp.minimum(res_cap, vol_cap), port_cap), 0)
+            ncum = jnp.cumsum(ncap)
+            placed_n = jnp.minimum(k, ncum[-1])
+            node_take = jnp.clip(k - (ncum - ncap), 0, ncap)
+            took_n = node_take > 0
+            new_node_req = _mix_req_rows(state.node_req, node_merged, took_n)
+            new_node_requests = state.node_requests + node_take[:, None] * pod_requests[None, :]
+            new_node_npods = state.node_npods + node_take
+            new_node_ports = state.node_used_ports | (took_n[:, None] & pod_ports[None, :])
+            new_node_vol = state.node_vol_used + node_take[:, None] * pod_vols[None, :]
+            node_of = jnp.searchsorted(ncum, ordinal, side="right").astype(jnp.int32)
+        else:
+            placed_n = jnp.int32(0)
+            node_of = jnp.zeros((max_run,), jnp.int32)
+            new_node_req = state.node_req
+            new_node_requests = state.node_requests
+            new_node_npods = state.node_npods
+            new_node_ports = state.node_used_ports
+            new_node_vol = state.node_vol_used
+        rem = k - placed_n
+
+        # ---- 2. open claims: fewest-pods waterfill bounded by capacity
+        claim_merged = _intersect_rows(state.claim_req, pod_req)
+        claim_compat = vmap(
+            lambda cr: masks.compatible_ok(cr, pod_req, lv, ln, wellknown)
+        )(state.claim_req)
+        claim_port_ok = ~jnp.any(state.claim_used_ports & pod_conflict[None, :], axis=-1)
+        m_packed = masks.pack_lanes(claim_merged.admitted)
+        m_neg = vmap(lambda r: masks.negative_polarity(r, lv, ln))(claim_merged)
+        itc = masks.packed_pairwise_compat(
+            claim_merged, m_packed, m_neg, problem.it_reqs, it_packed, it_neg
+        )  # [C, T]
+        itok = state.claim_it_ok & itc & has_offering_rows(claim_merged.admitted)
+        cap_ct = _capacity(
+            problem.it_alloc[None, :, :],
+            state.claim_requests[:, None, :],
+            pod_requests[None, None, :],
+        )  # [C, T]
+        cap_c = jnp.max(jnp.where(itok, cap_ct, 0), axis=-1)
+        elig = (
+            state.claim_open
+            & tol_tpl[state.claim_tpl]
+            & claim_compat
+            & claim_port_ok
+        )
+        cap_c = jnp.where(elig, jnp.minimum(cap_c, port_cap), 0)
+        p_lvl = state.claim_npods
+        m = jnp.minimum(rem, cap_c.sum())
+        L = _water_level(p_lvl, cap_c, m)
+        take0 = jnp.clip(L - p_lvl, 0, cap_c)
+        leftover = m - take0.sum()
+        at_level = (p_lvl + take0 == L) & (take0 < cap_c)
+        extra = at_level & (jnp.cumsum(at_level) <= leftover)
+        claim_take = take0 + extra.astype(jnp.int32)
+        tookc = claim_take > 0
+        i_claim_req = _mix_req_rows(state.claim_req, claim_merged, tookc)
+        i_requests = state.claim_requests + claim_take[:, None] * pod_requests[None, :]
+        i_npods = state.claim_npods + claim_take
+        i_itok = jnp.where(tookc[:, None], itok & (cap_ct >= claim_take[:, None]), state.claim_it_ok)
+        i_ports = state.claim_used_ports | (tookc[:, None] & pod_ports[None, :])
+        rem2 = rem - claim_take.sum()
+
+        # temporal ordinal -> claim: assignments sort by (level-before, claim)
+        jj = ordinal - placed_n
+        lev = _water_level(p_lvl, claim_take, jnp.maximum(jj, 0))
+        before = jnp.sum(
+            jnp.clip(lev[:, None] - p_lvl[None, :], 0, claim_take[None, :]), axis=-1
+        )
+        pos = jnp.maximum(jj, 0) - before
+        at_lev = (p_lvl[None, :] <= lev[:, None]) & (
+            lev[:, None] < (p_lvl + claim_take)[None, :]
+        )  # [MR, C]
+        lev_cum = jnp.cumsum(at_lev, axis=-1)
+        claim_of = jnp.argmax(at_lev & (lev_cum == (pos + 1)[:, None]), axis=-1).astype(
+            jnp.int32
+        )
+
+        # ---- 3. fresh template claims, one open at a time
+        def nc_cond(c):
+            return c[0] & (c[1] > 0)
+
+        def nc_body(c):
+            (
+                _keep,
+                c_rem,
+                c_req,
+                c_requests,
+                c_itok,
+                c_open,
+                c_npods,
+                c_tpl,
+                c_ports,
+                c_remaining,
+                c_registered,
+                c_newtake,
+                c_noslot,
+            ) = c
+            free_slot = _first_true(~c_open)
+            has_slot = jnp.any(~c_open)
+            tpl_merged, tpl_compat, host_onehot = _fresh_template_rows(
+                problem, lv, ln, wellknown, pod_req, free_slot
+            )
+            within = masks.fits(problem.it_cap[None, :, :], c_remaining[:, None, :])
+            t_packed = masks.pack_lanes(tpl_merged.admitted)
+            t_neg = vmap(lambda r: masks.negative_polarity(r, lv, ln))(tpl_merged)
+            itc_t = masks.packed_pairwise_compat(
+                tpl_merged, t_packed, t_neg, problem.it_reqs, it_packed, it_neg
+            )  # [TPL, T]
+            cap_tt = _capacity(
+                problem.it_alloc[None, :, :],
+                problem.tpl_overhead[:, None, :],
+                pod_requests[None, None, :],
+            )  # [TPL, T]
+            itok_t = (
+                problem.tpl_it_ok
+                & within
+                & itc_t
+                & has_offering_rows(tpl_merged.admitted)
+                & (cap_tt >= 1)
+            )
+            q_t = jnp.max(jnp.where(itok_t, cap_tt, 0), axis=-1)  # [TPL]
+            tpl_ok = tol_tpl & tpl_compat & (q_t >= 1)
+            pick = _first_true(tpl_ok)
+            any_tpl = jnp.any(tpl_ok)
+            pick_c = jnp.minimum(pick, TPL - 1)
+            can = any_tpl & has_slot
+            take = jnp.where(can, jnp.minimum(c_rem, jnp.minimum(q_t[pick_c], port_cap)), 0)
+            slot_hot = (jnp.arange(C) == free_slot) & (take > 0)
+            slot_req = tpl_merged.row(pick_c)
+            new_req = _mix_req_rows(
+                c_req,
+                ReqTensor(
+                    admitted=jnp.broadcast_to(slot_req.admitted, (C, K, V)),
+                    comp=jnp.broadcast_to(slot_req.comp, (C, K)),
+                    gt=jnp.broadcast_to(slot_req.gt, (C, K)),
+                    lt=jnp.broadcast_to(slot_req.lt, (C, K)),
+                    defined=jnp.broadcast_to(slot_req.defined, (C, K)),
+                ),
+                slot_hot,
+            )
+            surv1 = itok_t[pick_c]  # [T] survivors with the first pod aboard
+            new_itok = jnp.where(
+                slot_hot[:, None], surv1[None, :] & (cap_tt[pick_c][None, :] >= take), c_itok
+            )
+            new_requests = jnp.where(
+                slot_hot[:, None],
+                (problem.tpl_overhead[pick_c] + take * pod_requests)[None, :],
+                c_requests,
+            )
+            opened = take > 0
+            opened_tpl_hot = (jnp.arange(TPL) == pick_c) & opened
+            max_cap = jnp.max(jnp.where(surv1[:, None], problem.it_cap, 0.0), axis=0)
+            new_remaining = jnp.where(
+                opened_tpl_hot[:, None], c_remaining - max_cap[None, :], c_remaining
+            )
+            new_registered = c_registered | (
+                opened
+                & mint_hostnames
+                & (problem.grp_key == HOSTNAME_KEY)[:, None]
+                & host_onehot[None, :]
+            )
+            return (
+                can,
+                c_rem - take,
+                new_req,
+                new_requests,
+                new_itok,
+                c_open | slot_hot,
+                c_npods + slot_hot * take,
+                jnp.where(slot_hot, pick_c.astype(jnp.int32), c_tpl),
+                c_ports | (slot_hot[:, None] & pod_ports[None, :]),
+                new_remaining,
+                new_registered,
+                c_newtake + slot_hot * take,
+                c_noslot | (any_tpl & ~has_slot),
+            )
+
+        nc0 = (
+            jnp.bool_(True),
+            rem2,
+            i_claim_req,
+            i_requests,
+            i_itok,
+            state.claim_open,
+            i_npods,
+            state.claim_tpl,
+            i_ports,
+            state.remaining,
+            state.grp_registered,
+            jnp.zeros((C,), jnp.int32),
+            jnp.bool_(False),
+        )
+        (
+            _keep,
+            rem3,
+            f_claim_req,
+            f_requests,
+            f_itok,
+            f_open,
+            f_npods,
+            f_tpl,
+            f_ports,
+            f_remaining,
+            f_registered,
+            new_take,
+            noslot,
+        ) = lax.while_loop(nc_cond, nc_body, nc0)
+        placed_new = rem2 - rem3
+        new_cum = jnp.cumsum(new_take)  # slot order == temporal opening order
+        nc_ord = ordinal - placed_n - m  # ordinal within the new-claim phase
+        newclaim_of = jnp.searchsorted(new_cum, nc_ord, side="right").astype(jnp.int32)
+        # the pod that OPENS a slot reads KIND_NEW_CLAIM, later joiners
+        # KIND_CLAIM — matching the per-pod step's labels exactly
+        opens_slot = nc_ord == (new_cum - new_take)[jnp.minimum(newclaim_of, C - 1)]
+
+        # ---- 4. per-row outputs, written into the run's queue window
+        fail_kind = jnp.where(noslot, KIND_NO_SLOT, KIND_FAIL).astype(jnp.int32)
+        kind_row = jnp.where(
+            ~act,
+            KIND_FAIL,
+            jnp.where(
+                ordinal < placed_n,
+                KIND_NODE,
+                jnp.where(
+                    ordinal < placed_n + m,
+                    KIND_CLAIM,
+                    jnp.where(
+                        ordinal < placed_n + m + placed_new,
+                        jnp.where(opens_slot, KIND_NEW_CLAIM, KIND_CLAIM),
+                        fail_kind,
+                    ),
+                ),
+            ),
+        ).astype(jnp.int32)
+        # index by PHASE (new-phase joiners are labeled KIND_CLAIM but their
+        # slot comes from the opening partition, not the waterfill)
+        index_row = jnp.where(
+            ~act,
+            -1,
+            jnp.where(
+                ordinal < placed_n,
+                node_of,
+                jnp.where(
+                    ordinal < placed_n + m,
+                    claim_of,
+                    jnp.where(ordinal < placed_n + m + placed_new, newclaim_of, -1),
+                ),
+            ),
+        ).astype(jnp.int32)
+        new_state = FFDState(
+            claim_req=f_claim_req,
+            claim_requests=f_requests,
+            claim_it_ok=f_itok,
+            claim_open=f_open,
+            claim_npods=f_npods,
+            claim_tpl=f_tpl,
+            claim_used_ports=f_ports,
+            node_req=new_node_req,
+            node_requests=new_node_requests,
+            node_npods=new_node_npods,
+            node_used_ports=new_node_ports,
+            node_vol_used=new_node_vol,
+            remaining=f_remaining,
+            grp_counts=state.grp_counts,
+            grp_registered=f_registered,
+        )
+        return new_state, (kind_row, index_row)
+
+    return commit
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _solve_ffd_runs_jit(problem: SchedulingProblem, init: FFDState, max_run: int) -> FFDResult:
+    """Run-compressed scan: one step per run of identical pods (encode.py
+    segmentation). Multi-pod runs take the analytic commit; length-1 runs take
+    the per-pod step. 10k diverse pods collapse to a few hundred steps."""
+    problem, init = _lane_align(problem, init)
+    C = init.claim_open.shape[0]
+    statics = _statics(problem)
+    step = _make_step(problem, statics, C)
+    commit = _make_run_commit(problem, statics, C, max_run)
+    P = problem.num_pods
+    pods_xs = _pod_xs(problem)
+    rep_xs = jax.tree_util.tree_map(lambda a: a[problem.run_start], pods_xs)
+    # scratch tail so a window starting near P never clamps backwards
+    active_arr = jnp.concatenate(
+        [jnp.asarray(problem.pod_active), jnp.zeros((max_run,), dtype=bool)]
+    )
+
+    def outer(state, xs):
+        rep, start, length, multi = xs
+
+        def analytic(_):
+            return commit(state, rep, start, length, active_arr)
+
+        def single(_):
+            new_state, (kind, index) = step(state, rep)
+            kind_row = jnp.full((max_run,), KIND_FAIL, jnp.int32).at[0].set(kind)
+            index_row = jnp.full((max_run,), -1, jnp.int32).at[0].set(index)
+            return new_state, (kind_row, index_row)
+
+        return lax.cond(multi, analytic, single, None)
+
+    run_start = jnp.asarray(problem.run_start)
+    run_len = jnp.asarray(problem.run_len)
+    final_state, (kind_ys, index_ys) = lax.scan(
+        outer,
+        init,
+        (rep_xs, run_start, run_len, jnp.asarray(problem.run_multi)),
+    )
+    # scatter the per-run windows back into queue order; rows no run covers
+    # (padding pods) keep KIND_FAIL. Windows are disjoint, so the masked
+    # scatter writes each real row exactly once.
+    RN = run_start.shape[0]
+    win = jnp.arange(max_run)
+    rows = run_start[:, None] + win[None, :]  # [RN, MR]
+    valid = win[None, :] < run_len[:, None]
+    target = jnp.where(valid, rows, P + max_run - 1)  # dump padding in scratch
+    kinds = (
+        jnp.full((P + max_run,), KIND_FAIL, jnp.int32)
+        .at[target.ravel()]
+        .set(kind_ys.ravel())
+    )
+    idxs = (
+        jnp.full((P + max_run,), -1, jnp.int32).at[target.ravel()].set(index_ys.ravel())
+    )
+    return FFDResult(kind=kinds[:P], index=idxs[:P], state=final_state)
+
+
+def solve_ffd_runs(
+    problem: SchedulingProblem, max_claims: int, init: Optional[FFDState] = None
+) -> FFDResult:
+    """Run one pack pass through the run-compressed solver."""
+    import numpy as np
+
+    if init is None:
+        init = initial_state(problem, max_claims)
+    max_run = int(np.max(np.asarray(problem.run_len), initial=1))
+    from karpenter_tpu.ops.padding import pow2_bucket
+
+    return _solve_ffd_runs_jit(problem, init, pow2_bucket(max_run, lo=1))

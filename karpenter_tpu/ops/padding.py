@@ -123,6 +123,14 @@ def pad_problem(p: SchedulingProblem, min_pods: int = 0) -> SchedulingProblem:
         pod_grp_selects=_pad(p.pod_grp_selects, (P, G), False),
         pod_grp_owned=_pad(p.pod_grp_owned, (P, G), False),
         claim_hostname_lane=p.claim_hostname_lane,
+        # padded pod rows are inactive; padding runs have len 0 (the run
+        # solver's masked window write makes them no-ops). Padded rows are
+        # NOT covered by any run — their outputs stay at the initial
+        # KIND_FAIL and decode drops them anyway.
+        pod_active=_pad(p.pod_active, (P,), False),
+        run_start=_pad(p.run_start, (pow2_bucket(p.num_runs, lo=4),), 0),
+        run_len=_pad(p.run_len, (pow2_bucket(p.num_runs, lo=4),), 0),
+        run_multi=_pad(p.run_multi, (pow2_bucket(p.num_runs, lo=4),), True),
     )
 
 

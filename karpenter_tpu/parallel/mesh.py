@@ -27,9 +27,20 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from karpenter_tpu.models.problem import SchedulingProblem
-from karpenter_tpu.ops.ffd import FFDResult, _solve_ffd_jit, initial_state
+from karpenter_tpu.ops.ffd import (
+    FFDResult,
+    _solve_ffd_jit,
+    _solve_ffd_runs_jit,
+    initial_state,
+)
+from karpenter_tpu.ops.padding import pow2_bucket
 
 CANDIDATE_AXIS = "candidates"
+
+
+def _max_run_bucket(batch: SchedulingProblem) -> int:
+    """Static max-run window for a (possibly stacked) problem."""
+    return pow2_bucket(int(np.max(np.asarray(batch.run_len), initial=1)), lo=1)
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = CANDIDATE_AXIS) -> Mesh:
@@ -51,10 +62,12 @@ def shard_batch(batch: SchedulingProblem, mesh: Mesh, axis: str = CANDIDATE_AXIS
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
-def _batched_solve_jit(batch: SchedulingProblem, max_claims: int) -> FFDResult:
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _batched_solve_jit(
+    batch: SchedulingProblem, max_claims: int, max_run: int
+) -> FFDResult:
     return jax.vmap(
-        lambda p: _solve_ffd_jit.__wrapped__(p, initial_state(p, max_claims))
+        lambda p: _solve_ffd_runs_jit.__wrapped__(p, initial_state(p, max_claims), max_run)
     )(batch)
 
 
@@ -64,40 +77,33 @@ def batched_solve(
     """Solve B independent scheduling problems in one compiled program; with a
     mesh, the candidate axis is sharded across devices and each device runs
     its slice of the scan batch."""
+    max_run = _max_run_bucket(batch)
     if mesh is not None:
         batch = shard_batch(batch, mesh)
-    return _batched_solve_jit(batch, max_claims)
+    return _batched_solve_jit(batch, max_claims, max_run)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
 def _batched_screen_jit(
-    batch: SchedulingProblem, max_claims: int, passes: int
+    batch: SchedulingProblem, max_claims: int, passes: int, max_run: int
 ) -> FFDResult:
     """Multi-pass batched solve: after each pass, pods that placed are masked
-    inert (their toleration rows zeroed) and the scan re-runs over the carried
-    bin state so order-dependent pods (affinity on a pod placed later in the
-    queue) get their retry — the sequential backend's requeue loop
-    (solver/jax_backend.py pass structure) without relaxation and without a
-    host round-trip. All passes run in one compiled program."""
+    out via pod_active (preserving the run structure) and the scan re-runs
+    over the carried bin state so order-dependent pods (affinity on a pod
+    placed later in the queue) get their retry — the sequential backend's
+    requeue loop (solver/jax_backend.py pass structure) without relaxation and
+    without a host round-trip. All passes run in one compiled program."""
     import dataclasses
 
     from karpenter_tpu.ops.ffd import KIND_FAIL
 
     def one(p: SchedulingProblem) -> FFDResult:
-        r = _solve_ffd_jit.__wrapped__(p, initial_state(p, max_claims))
+        r = _solve_ffd_runs_jit.__wrapped__(p, initial_state(p, max_claims), max_run)
         kind, index = r.kind, r.index
         for _ in range(passes - 1):
             placed = kind < KIND_FAIL
-            p2 = dataclasses.replace(
-                p,
-                pod_tol_tpl=p.pod_tol_tpl & ~placed[:, None],
-                pod_tol_node=(
-                    p.pod_tol_node & ~placed[:, None]
-                    if p.pod_tol_node.shape[1]
-                    else p.pod_tol_node
-                ),
-            )
-            r = _solve_ffd_jit.__wrapped__(p2, r.state)
+            p2 = dataclasses.replace(p, pod_active=p.pod_active & ~placed)
+            r = _solve_ffd_runs_jit.__wrapped__(p2, r.state, max_run)
             kind = jnp.where(placed, kind, r.kind)
             index = jnp.where(placed, index, r.index)
         return FFDResult(kind=kind, index=index, state=r.state)
@@ -113,9 +119,10 @@ def batched_screen(
 ) -> FFDResult:
     """batched_solve with ``passes`` placement passes per problem (see
     _batched_screen_jit) — the consolidation scorer's workhorse."""
+    max_run = _max_run_bucket(batch)
     if mesh is not None:
         batch = shard_batch(batch, mesh)
-    return _batched_screen_jit(batch, max_claims, passes)
+    return _batched_screen_jit(batch, max_claims, passes, max_run)
 
 
 def default_mesh(min_devices: int = 2) -> Optional[Mesh]:

@@ -92,10 +92,39 @@ class EncodedProblem:
     meta: ProblemMeta
 
 
+# longest run one scan step commits; bounds the per-step ordinal-mapping
+# tensors ([C, MAX_RUN] in ops/ffd.py) and the output-window scratch
+MAX_RUN_LEN = 512
+
+
+def constraint_signature(p: Pod) -> str:
+    """Deterministic digest of everything that can distinguish two pods'
+    encoded rows besides their resource requests. Used as an FFD sort
+    tie-break so identical pods become *consecutive* queue rows and compress
+    into runs (ops/ffd.py). Purely an ordering heuristic — run formation
+    itself re-checks byte-identical encodings — so an imprecise digest can
+    only cost compression, never correctness."""
+    spec = p.spec
+    parts = [
+        p.namespace,
+        repr(sorted(spec.node_selector.items())),
+        repr(spec.affinity),
+        repr(spec.tolerations),
+        repr(sorted((p.metadata.labels or {}).items())),
+        repr(spec.topology_spread_constraints),
+        repr([(c.ports or []) for c in spec.containers]),
+    ]
+    return "|".join(parts)
+
+
 def ffd_order(pods: Sequence[Pod]) -> List[int]:
-    """The FFD queue order: cpu desc, memory desc, creation time, creation
-    sequence (queue.go:76-111). Shared by every backend — parity depends on a
-    single definition."""
+    """The FFD queue order: cpu desc, memory desc, then a constraint-signature
+    tie-break, then creation time / sequence. The primary keys mirror the
+    reference queue sort (queue.go:76-111); the signature tie-break is this
+    framework's own refinement — the reference breaks resource ties purely by
+    age, which is arbitrary for placement quality, while grouping
+    equal-signature pods lets the device solver commit whole runs per scan
+    step. Shared by every backend — parity depends on a single definition."""
     keys = []
     for i, p in enumerate(pods):
         requests = res.pod_requests(p)
@@ -103,6 +132,7 @@ def ffd_order(pods: Sequence[Pod]) -> List[int]:
             (
                 -requests.get(res.CPU, 0.0),
                 -requests.get(res.MEMORY, 0.0),
+                constraint_signature(p),
                 p.metadata.creation_timestamp or 0.0,
                 p.metadata.creation_seq,
                 i,
@@ -510,6 +540,60 @@ class Encoder:
             [vocab.values[hostname_k][h] for h in claim_hostnames], dtype=np.int32
         )
 
+        # -- 10. run segmentation: consecutive queue rows with identical
+        # encodings and zero topology interaction commit as one analytic scan
+        # step (ops/ffd.py run solver). Eligibility is re-checked on a
+        # 128-bit digest of the encoded rows, so the sort-signature heuristic
+        # above cannot cause false merges (collision odds are negligible).
+        P = len(pods)
+        interacts = (
+            pod_grp_match.any(axis=1)
+            | pod_grp_selects.any(axis=1)
+            | pod_grp_owned.any(axis=1)
+        ) if G else np.zeros(P, dtype=bool)
+        import hashlib
+
+        def _fingerprint(pi: int) -> bytes:
+            # fixed-size digest, not the raw row bytes: a 10k-pod batch's
+            # rows are ~100KB each and re-fingerprinted every relax pass
+            h = hashlib.blake2b(digest_size=16)
+            for a in (
+                pod_reqs.admitted, pod_reqs.comp, pod_reqs.gt, pod_reqs.lt,
+                pod_reqs.defined, pod_strict_reqs.admitted,
+                pod_strict_reqs.comp, pod_strict_reqs.gt,
+                pod_strict_reqs.lt, pod_strict_reqs.defined,
+                pod_requests, pod_tol_tpl, pod_tol_node,
+                pod_ports, pod_port_conflict, pod_vol_counts,
+            ):
+                h.update(a[pi].tobytes())
+            return h.digest()
+
+        fingerprints = [_fingerprint(pi) for pi in range(P)]
+        run_start_l: List[int] = []
+        run_len_l: List[int] = []
+        run_multi_l: List[bool] = []
+        i = 0
+        while i < P:
+            j = i + 1
+            if not interacts[i]:
+                while (
+                    j < P
+                    and j - i < MAX_RUN_LEN
+                    and not interacts[j]
+                    and fingerprints[j] == fingerprints[i]
+                ):
+                    j += 1
+            run_start_l.append(i)
+            run_len_l.append(j - i)
+            # length-1 runs go through the battle-tested per-pod step; the
+            # analytic commit is only entered when it actually pays
+            run_multi_l.append(j - i > 1)
+            i = j
+        run_start = np.array(run_start_l, dtype=np.int32)
+        run_len = np.array(run_len_l, dtype=np.int32)
+        run_multi = np.array(run_multi_l, dtype=bool)
+        pod_active = np.ones(P, dtype=bool)
+
         problem = SchedulingProblem(
             lane_valid=lane_valid,
             lane_numeric=lane_numeric,
@@ -554,6 +638,10 @@ class Encoder:
             pod_grp_selects=pod_grp_selects,
             pod_grp_owned=pod_grp_owned,
             claim_hostname_lane=claim_hostname_lane,
+            pod_active=pod_active,
+            run_start=run_start,
+            run_len=run_len,
+            run_multi=run_multi,
         )
         meta = ProblemMeta(
             keys=list(vocab.keys),

@@ -47,7 +47,14 @@ from karpenter_tpu.ops.ffd import (
     KIND_NODE,
     KIND_NO_SLOT,
     solve_ffd,
+    solve_ffd_runs,
 )
+
+# run-compressed scan (ops/ffd.py) is the production path; the per-pod scan
+# remains available for cross-checks and as an escape hatch
+import os as _os
+
+_USE_RUNS = _os.environ.get("KARPENTER_TPU_RUNS", "1") != "0"
 
 
 class _SlotOverflow(Exception):
@@ -193,7 +200,8 @@ class JaxSolver(SolverBackend):
                 # census, exactly like the reference's countDomains on Update
                 state = _remap_group_state(state, prev_group_keys, group_keys, problem)
             prev_group_keys = group_keys
-            result = solve_ffd(problem, max_claims, init=state)
+            solve = solve_ffd_runs if _USE_RUNS else solve_ffd
+            result = solve(problem, max_claims, init=state)
             state = result.state
             kinds = np.asarray(result.kind)
             indices = np.asarray(result.index)

@@ -1,0 +1,300 @@
+"""Run-length-compressed FFD solver (ops/ffd.py _solve_ffd_runs_jit).
+
+The run solver must be indistinguishable from the per-pod scan — same
+per-pod (kind, index) in temporal order — on every workload. These tests pin
+the analytic commit's tricky paths: node first-fit fill, fewest-pods claim
+waterfill with capacity limits and index tie-breaks, sequential template
+opens with limit-headroom burn, host-port cap-1 runs, volume-limit capacity,
+claim-slot overflow, and pod_active masking.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodepool import (
+    NodeClaimSpec,
+    NodeClaimTemplateSpec,
+    NodePool,
+    NodePoolSpec,
+)
+from karpenter_tpu.apis.objects import (
+    Container,
+    ContainerPort,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+)
+from karpenter_tpu.cloudprovider.fake import (
+    FAKE_WELL_KNOWN_LABELS,
+    instance_types,
+)
+from karpenter_tpu.ops.ffd import (
+    KIND_CLAIM,
+    KIND_FAIL,
+    KIND_NEW_CLAIM,
+    KIND_NODE,
+    initial_state,
+    solve_ffd,
+    solve_ffd_runs,
+)
+from karpenter_tpu.ops.padding import pad_problem
+from karpenter_tpu.scheduling import Taints
+from karpenter_tpu.scheduling.requirements import label_requirements
+from karpenter_tpu.solver.encode import Encoder, NodeInfo, template_from_nodepool
+from karpenter_tpu.solver.jax_backend import JaxSolver
+from karpenter_tpu.solver.oracle import OracleSolver
+
+
+def make_pod(i, cpu=0.5, mem=1e8, ports=None, labels=None):
+    containers = [
+        Container(
+            requests={"cpu": cpu, "memory": mem},
+            ports=[ContainerPort(host_port=p) for p in (ports or [])],
+        )
+    ]
+    return Pod(
+        metadata=ObjectMeta(name=f"p{i}", labels=labels or {}),
+        spec=PodSpec(containers=containers),
+    )
+
+
+def make_node(name, cpu=4.0, mem=8e9, pods=110.0, zone="test-zone-1"):
+    labels = {
+        wk.LABEL_HOSTNAME: name,
+        wk.LABEL_TOPOLOGY_ZONE: zone,
+        wk.CAPACITY_TYPE_LABEL_KEY: wk.CAPACITY_TYPE_ON_DEMAND,
+    }
+    return NodeInfo(
+        name=name,
+        requirements=label_requirements(labels),
+        taints=Taints([]),
+        available={"cpu": cpu, "memory": mem, "pods": pods},
+        daemon_overhead={},
+    )
+
+
+def simple_template(its, name="pool"):
+    pool = NodePool(
+        metadata=ObjectMeta(name=name),
+        spec=NodePoolSpec(
+            template=NodeClaimTemplateSpec(spec=NodeClaimSpec())
+        ),
+    )
+    return template_from_nodepool(pool, its, range(len(its)))
+
+
+def solve_both_raw(pods, its, templates, nodes=(), max_claims=8):
+    """Run the padded problem through both device solvers and return
+    (runs_result, legacy_result) as numpy (kind, index) pairs."""
+    enc = Encoder(FAKE_WELL_KNOWN_LABELS).encode(
+        pods, its, templates, nodes=nodes, num_claim_slots=max_claims
+    )
+    problem = pad_problem(enc.problem)
+    r_runs = solve_ffd_runs(problem, max_claims)
+    r_legacy = solve_ffd(problem, max_claims)
+    return (
+        (np.asarray(r_runs.kind), np.asarray(r_runs.index)),
+        (np.asarray(r_legacy.kind), np.asarray(r_legacy.index)),
+        enc,
+        r_runs,
+        r_legacy,
+    )
+
+
+def assert_step_parity(pods, its, templates, nodes=(), max_claims=8):
+    (rk, ri), (lk, li), enc, r_runs, r_legacy = solve_both_raw(
+        pods, its, templates, nodes, max_claims
+    )
+    P = len(pods)
+    np.testing.assert_array_equal(rk[:P], lk[:P])
+    np.testing.assert_array_equal(ri[:P], li[:P])
+    # final bin state must agree too (it seeds later relax passes)
+    np.testing.assert_array_equal(
+        np.asarray(r_runs.state.claim_open), np.asarray(r_legacy.state.claim_open)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(r_runs.state.claim_npods), np.asarray(r_legacy.state.claim_npods)
+    )
+    np.testing.assert_allclose(
+        np.asarray(r_runs.state.claim_requests),
+        np.asarray(r_legacy.state.claim_requests),
+        rtol=1e-6,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(r_runs.state.node_npods), np.asarray(r_legacy.state.node_npods)
+    )
+    return (rk, ri)
+
+
+class TestRunCommitParity:
+    def test_identical_pods_open_claims(self):
+        """A run larger than one claim's capacity opens several claims; the
+        opener of each slot reads KIND_NEW_CLAIM, joiners KIND_CLAIM."""
+        its = instance_types(6)
+        pods = [make_pod(i, cpu=0.5) for i in range(24)]
+        kinds, _ = assert_step_parity(pods, its, [simple_template(its)])
+        assert (kinds[:24] == KIND_NEW_CLAIM).sum() >= 1
+        assert (kinds[:24] < KIND_FAIL).all()
+
+    def test_nodes_fill_first_in_order(self):
+        its = instance_types(4)
+        nodes = [make_node("n-a", cpu=1.2), make_node("n-b", cpu=2.2)]
+        pods = [make_pod(i, cpu=0.5) for i in range(10)]
+        kinds, idx = assert_step_parity(pods, its, [simple_template(its)], nodes)
+        # first two pods land on n-a (capacity 2), next four on n-b
+        assert list(kinds[:6]) == [KIND_NODE] * 6
+        assert list(idx[:2]) == [0, 0] and list(idx[2:6]) == [1, 1, 1, 1]
+
+    def test_waterfill_matches_sequential_mixed_runs(self):
+        """Alternating pod sizes create several runs that land on the same
+        claims; claim levels must waterfill exactly as the per-pod argmin."""
+        its = instance_types(8)
+        pods = [make_pod(i, cpu=[0.3, 0.7, 1.1][i % 3]) for i in range(30)]
+        assert_step_parity(pods, its, [simple_template(its)])
+
+    def test_host_port_run_caps_one_per_bin(self):
+        its = instance_types(6)
+        pods = [make_pod(i, cpu=0.1, ports=[8080]) for i in range(4)]
+        kinds, idx = assert_step_parity(pods, its, [simple_template(its)])
+        placed = [
+            (k, i) for k, i in zip(kinds[:4], idx[:4]) if k < KIND_FAIL
+        ]
+        # every placed pod must sit in its own bin
+        assert len({i for _, i in placed}) == len(placed)
+
+    def test_volume_limits_bound_run_capacity(self):
+        its = instance_types(4)
+        node = make_node("n-vol", cpu=32.0)
+        node.volume_limits = {"csi.test": 3}
+        node.volume_used = {"csi.test": 1}
+        pods = [make_pod(i, cpu=0.1) for i in range(6)]
+        vols = [{"csi.test": frozenset({f"vol-{i}"})} for i in range(6)]
+        enc = Encoder(FAKE_WELL_KNOWN_LABELS).encode(
+            pods, instance_types(4), [simple_template(its)], nodes=[node],
+            num_claim_slots=8, pod_volumes=vols,
+        )
+        problem = pad_problem(enc.problem)
+        r_runs = solve_ffd_runs(problem, 8)
+        r_legacy = solve_ffd(problem, 8)
+        np.testing.assert_array_equal(
+            np.asarray(r_runs.kind)[:6], np.asarray(r_legacy.kind)[:6]
+        )
+        kinds = np.asarray(r_runs.kind)[:6]
+        idx = np.asarray(r_runs.index)[:6]
+        # exactly 2 more volume-bearing pods fit on the node (limit 3, used 1)
+        assert ((kinds == KIND_NODE) & (idx == 0)).sum() == 2
+
+    def test_pod_active_masks_run_members(self):
+        its = instance_types(4)
+        pods = [make_pod(i, cpu=0.5) for i in range(8)]
+        enc = Encoder(FAKE_WELL_KNOWN_LABELS).encode(
+            pods, its, [simple_template(its)], num_claim_slots=8
+        )
+        problem = pad_problem(enc.problem)
+        import dataclasses
+
+        active = np.array(problem.pod_active)
+        active[[1, 3, 5]] = False
+        problem2 = dataclasses.replace(problem, pod_active=active)
+        r = solve_ffd_runs(problem2, 8)
+        kinds = np.asarray(r.kind)[:8]
+        assert (kinds[[1, 3, 5]] == KIND_FAIL).all()
+        assert (kinds[[0, 2, 4, 6, 7]] < KIND_FAIL).all()
+        # masked pods must not consume capacity
+        assert int(np.asarray(r.state.claim_npods).sum()) == 5
+
+    def test_slot_overflow_retries_through_backend(self):
+        """Each pod is too big to share a claim; more pods than initial slots
+        forces the backend's slot-doubling retry through the run path."""
+        its = instance_types(4)
+        pods = [make_pod(i, cpu=0.9, mem=2e9) for i in range(12)]
+        solver = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS, initial_claim_slots=4)
+        result = solver.solve(pods, its, [simple_template(its)])
+        oracle = OracleSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(
+            pods, its, [simple_template(its)]
+        )
+        assert result.num_scheduled() == oracle.num_scheduled()
+        assert len(result.new_claims) == len(oracle.new_claims)
+
+    def test_zero_request_pods_reject_removed_node(self):
+        """Best-effort pods (zero cpu/mem requests) must still fail a node
+        whose avail is the -1 removed/padded sentinel — fits() gates every
+        resource dim, including ones the pod doesn't request."""
+        import dataclasses
+
+        its = instance_types(4)
+        node = make_node("n-gone", cpu=4.0)
+        pods = [
+            Pod(metadata=ObjectMeta(name=f"be{i}"), spec=PodSpec(containers=[Container()]))
+            for i in range(3)
+        ]
+        enc = Encoder(FAKE_WELL_KNOWN_LABELS).encode(
+            pods, its, [simple_template(its)], nodes=[node], num_claim_slots=8
+        )
+        problem = pad_problem(enc.problem)
+        removed = dataclasses.replace(
+            problem, node_avail=np.full_like(np.asarray(problem.node_avail), -1.0)
+        )
+        r_runs = solve_ffd_runs(removed, 8)
+        r_legacy = solve_ffd(removed, 8)
+        np.testing.assert_array_equal(
+            np.asarray(r_runs.kind)[:3], np.asarray(r_legacy.kind)[:3]
+        )
+        assert not (np.asarray(r_runs.kind)[:3] == KIND_NODE).any()
+
+    def test_over_limit_volume_state_reads_zero_capacity(self):
+        """A node already above its CSI attach limit must contribute zero run
+        capacity, not negative (which would corrupt the cumulative fill)."""
+        its = instance_types(4)
+        node = make_node("n-over", cpu=32.0)
+        node.volume_limits = {"csi.test": 2}
+        node.volume_used = {"csi.test": 5}
+        pods = [make_pod(i, cpu=0.1) for i in range(4)]
+        vols = [{"csi.test": frozenset({f"v{i}"})} for i in range(4)]
+        enc = Encoder(FAKE_WELL_KNOWN_LABELS).encode(
+            pods, its, [simple_template(its)], nodes=[node],
+            num_claim_slots=8, pod_volumes=vols,
+        )
+        problem = pad_problem(enc.problem)
+        r_runs = solve_ffd_runs(problem, 8)
+        r_legacy = solve_ffd(problem, 8)
+        np.testing.assert_array_equal(
+            np.asarray(r_runs.kind)[:4], np.asarray(r_legacy.kind)[:4]
+        )
+        assert not (np.asarray(r_runs.kind)[:4] == KIND_NODE).any()
+        np.testing.assert_array_equal(
+            np.asarray(r_runs.state.node_npods), np.asarray(r_legacy.state.node_npods)
+        )
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_fuzz_runs_vs_legacy_vs_oracle(self, seed):
+        """Random identical-pod-heavy workloads: the run solver, the per-pod
+        scan, and the host oracle must agree pod by pod."""
+        rng = random.Random(seed)
+        its = instance_types(rng.randint(3, 12))
+        tpl = simple_template(its)
+        nodes = [
+            make_node(f"n-{i}", cpu=rng.choice([0.5, 1.0, 4.0]))
+            for i in range(rng.randint(0, 3))
+        ]
+        pods = []
+        for i in range(rng.randint(10, 60)):
+            pods.append(
+                make_pod(
+                    i,
+                    cpu=rng.choice([0.1, 0.25, 0.5, 1.0]),
+                    mem=rng.choice([1e8, 5e8, 1e9]),
+                    ports=[8080] if rng.random() < 0.1 else None,
+                )
+            )
+        assert_step_parity(pods, its, [tpl], nodes)
+        o = OracleSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(pods, its, [tpl], nodes)
+        j = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(pods, its, [tpl], nodes)
+        assert o.node_pods == j.node_pods
+        assert len(o.new_claims) == len(j.new_claims)
+        for oc, jc in zip(o.new_claims, j.new_claims):
+            assert sorted(oc.pod_indices) == sorted(jc.pod_indices)
+        assert set(o.failures) == set(j.failures)
