@@ -116,6 +116,11 @@ class KubeletConfiguration:
     kube_reserved: Dict[str, float] = field(default_factory=dict)
     eviction_hard: Dict[str, str] = field(default_factory=dict)
     eviction_soft: Dict[str, str] = field(default_factory=dict)
+    eviction_soft_grace_period: Dict[str, str] = field(default_factory=dict)
+    eviction_max_pod_grace_period: Optional[int] = None
+    image_gc_high_threshold_percent: Optional[int] = None
+    image_gc_low_threshold_percent: Optional[int] = None
+    cpu_cfs_quota: Optional[bool] = None
     cluster_dns: List[str] = field(default_factory=list)
 
 

@@ -997,15 +997,20 @@ def _solve_ffd_runs_jit(problem: SchedulingProblem, init: FFDState, max_run: int
     return FFDResult(kind=kinds[:P], index=idxs[:P], state=final_state)
 
 
+def max_run_bucket(problem: SchedulingProblem) -> int:
+    """Static max-run window bucket for a (possibly stacked) problem —
+    single definition shared with parallel/mesh.py."""
+    import numpy as np
+
+    from karpenter_tpu.ops.padding import pow2_bucket
+
+    return pow2_bucket(int(np.max(np.asarray(problem.run_len), initial=1)), lo=1)
+
+
 def solve_ffd_runs(
     problem: SchedulingProblem, max_claims: int, init: Optional[FFDState] = None
 ) -> FFDResult:
     """Run one pack pass through the run-compressed solver."""
-    import numpy as np
-
     if init is None:
         init = initial_state(problem, max_claims)
-    max_run = int(np.max(np.asarray(problem.run_len), initial=1))
-    from karpenter_tpu.ops.padding import pow2_bucket
-
-    return _solve_ffd_runs_jit(problem, init, pow2_bucket(max_run, lo=1))
+    return _solve_ffd_runs_jit(problem, init, max_run_bucket(problem))

@@ -32,15 +32,10 @@ from karpenter_tpu.ops.ffd import (
     _solve_ffd_jit,
     _solve_ffd_runs_jit,
     initial_state,
+    max_run_bucket as _max_run_bucket,
 )
-from karpenter_tpu.ops.padding import pow2_bucket
 
 CANDIDATE_AXIS = "candidates"
-
-
-def _max_run_bucket(batch: SchedulingProblem) -> int:
-    """Static max-run window for a (possibly stacked) problem."""
-    return pow2_bucket(int(np.max(np.asarray(batch.run_len), initial=1)), lo=1)
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = CANDIDATE_AXIS) -> Mesh:

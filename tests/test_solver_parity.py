@@ -184,12 +184,12 @@ class TestBasicParity:
 
 
 class TestExistingNodesParity:
-    def make_node(self, name, cpu=8.0, labels=None, taints=None):
+    def make_node(self, name, cpu=8.0, labels=None, taints=None, zone="test-zone-1"):
         reqs = Requirements.from_labels(
             {
                 **(labels or {}),
                 wk.LABEL_HOSTNAME: name,
-                wk.LABEL_TOPOLOGY_ZONE: "test-zone-1",
+                wk.LABEL_TOPOLOGY_ZONE: zone,
                 wk.CAPACITY_TYPE_LABEL_KEY: "on-demand",
             }
         )
@@ -283,4 +283,131 @@ class TestRandomizedParity:
             nodes.append(
                 TestExistingNodesParity().make_node(f"node-{n}", cpu=rng.choice([2.0, 4.0, 8.0]))
             )
+        run_both(pods, its, templates, nodes)
+
+
+class TestRandomizedTopologyParity:
+    """Fuzzed workloads over the hardest semantic area: topology spread
+    (zone + hostname, maxSkew, minDomains, ScheduleAnyway relaxation), pod
+    affinity/anti-affinity (required + preferred, inverse anti-affinity),
+    mixed with selectors, taints, ports, and existing nodes — 64 seeds, up
+    to ~200 pods (reference surface: topology_test.go's 2,437 LoC matrix,
+    topologygroup.go:163-256)."""
+
+    ZONES = ["test-zone-1", "test-zone-2", "test-zone-3"]
+
+    def _spread(self, rng, key):
+        from karpenter_tpu.apis.objects import (
+            DO_NOT_SCHEDULE,
+            LabelSelector,
+            SCHEDULE_ANYWAY,
+            TopologySpreadConstraint,
+        )
+
+        return TopologySpreadConstraint(
+            max_skew=rng.choice([1, 1, 2]),
+            topology_key=key,
+            when_unsatisfiable=(
+                SCHEDULE_ANYWAY if rng.random() < 0.3 else DO_NOT_SCHEDULE
+            ),
+            label_selector=LabelSelector(
+                match_labels={"grp": rng.choice(["g0", "g1", "g2"])}
+            ),
+            min_domains=rng.choice([None, None, 2, 3]),
+        )
+
+    def _aff_term(self, rng, key):
+        from karpenter_tpu.apis.objects import LabelSelector, PodAffinityTerm
+
+        return PodAffinityTerm(
+            topology_key=key,
+            label_selector=LabelSelector(
+                match_labels={"aff": rng.choice(["a0", "a1", "a2"])}
+            ),
+        )
+
+    def _make_topology_pod(self, rng, i):
+        from karpenter_tpu.apis.objects import (
+            Affinity,
+            PodAffinity,
+            PodAntiAffinity,
+            WeightedPodAffinityTerm,
+        )
+
+        labels = {
+            "grp": rng.choice(["g0", "g1", "g2"]),
+            "aff": rng.choice(["a0", "a1", "a2"]),
+        }
+        pod = make_pod(
+            i,
+            cpu=rng.choice([0.1, 0.25, 0.5, 1.0]),
+            mem=rng.choice([1e8, 2.5e8, 1e9]),
+        )
+        pod.metadata.labels = labels
+        roll = rng.random()
+        key = rng.choice([wk.LABEL_TOPOLOGY_ZONE, wk.LABEL_HOSTNAME])
+        if roll < 0.25:
+            pod.spec.topology_spread_constraints = [self._spread(rng, key)]
+            if rng.random() < 0.2:  # stacked constraints (zone + hostname)
+                other = (
+                    wk.LABEL_HOSTNAME
+                    if key == wk.LABEL_TOPOLOGY_ZONE
+                    else wk.LABEL_TOPOLOGY_ZONE
+                )
+                pod.spec.topology_spread_constraints.append(self._spread(rng, other))
+        elif roll < 0.45:
+            pod.spec.affinity = Affinity(
+                pod_affinity=PodAffinity(required=[self._aff_term(rng, key)])
+            )
+        elif roll < 0.60:
+            pod.spec.affinity = Affinity(
+                pod_anti_affinity=PodAntiAffinity(required=[self._aff_term(rng, key)])
+            )
+        elif roll < 0.72:
+            pod.spec.affinity = Affinity(
+                pod_affinity=PodAffinity(
+                    preferred=[
+                        WeightedPodAffinityTerm(
+                            weight=rng.randint(1, 100),
+                            pod_affinity_term=self._aff_term(rng, key),
+                        )
+                    ]
+                )
+            )
+        elif roll < 0.82:
+            pod.spec.affinity = Affinity(
+                pod_anti_affinity=PodAntiAffinity(
+                    preferred=[
+                        WeightedPodAffinityTerm(
+                            weight=rng.randint(1, 100),
+                            pod_affinity_term=self._aff_term(rng, key),
+                        )
+                    ]
+                )
+            )
+        # remainder: plain pods that still carry the group labels (they feed
+        # other pods' selectors — the Record side of the engine)
+        if rng.random() < 0.2:
+            pod.spec.node_selector = {wk.LABEL_TOPOLOGY_ZONE: rng.choice(self.ZONES)}
+        return pod
+
+    @pytest.mark.parametrize("seed", range(64))
+    def test_fuzz_topology(self, seed):
+        rng = random.Random(1000 + seed)
+        its = instance_types(rng.choice([6, 10]))
+        templates = [simple_template(its, name="a")]
+        taint = Taint(key="team", value="x", effect="NoSchedule")
+        if rng.random() < 0.3:
+            templates.append(simple_template(its, name="b", taints=[taint]))
+        # most seeds stay small for shape-bucket reuse; every 4th goes big
+        n = rng.randint(10, 60) if seed % 4 else rng.randint(100, 200)
+        pods = [self._make_topology_pod(rng, i) for i in range(n)]
+        nodes = [
+            TestExistingNodesParity().make_node(
+                f"node-{j}",
+                cpu=rng.choice([2.0, 4.0, 8.0]),
+                zone=rng.choice(self.ZONES),
+            )
+            for j in range(rng.randint(0, 4))
+        ]
         run_both(pods, its, templates, nodes)

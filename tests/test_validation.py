@@ -36,7 +36,9 @@ def test_valid_nodepool_passes():
     (NodeSelectorRequirement("zone", "In", []), "at least one value"),
     (NodeSelectorRequirement("zone", "Exists", ["a"]), "must not have values"),
     (NodeSelectorRequirement("cpu", "Gt", ["a", "b"]), "exactly one value"),
-    (NodeSelectorRequirement("cpu", "Gt", ["abc"]), "must be an integer"),
+    (NodeSelectorRequirement("cpu", "Gt", ["abc"]), "non-negative integer"),
+    (NodeSelectorRequirement("cpu", "Gt", ["-5"]), "non-negative integer"),
+    (NodeSelectorRequirement("cpu", "Lt", ["-1"]), "non-negative integer"),
     (NodeSelectorRequirement(wk.LABEL_HOSTNAME, "In", ["x"]), "restricted"),
     (NodeSelectorRequirement("bad key!", "In", ["x"]), "invalid label key"),
 ])
@@ -67,7 +69,7 @@ def test_consolidate_after_policy_coupling():
 def test_budget_rules():
     bad = validate_nodepool(make_nodepool(disruption=DisruptionPolicy(
         budgets=[Budget(nodes="150%")])))
-    assert any("percentage" in e for e in bad)
+    assert any("0-100%" in e for e in bad)
     bad = validate_nodepool(make_nodepool(disruption=DisruptionPolicy(
         budgets=[Budget(nodes="10", schedule="0 9 * * 1-5")])))
     assert any("together" in e for e in bad)
@@ -106,3 +108,260 @@ def test_provisioner_skips_invalid_pool():
     assert len(claims) == 1
     assert claims[0].metadata.labels[wk.NODEPOOL_LABEL_KEY] == "good"
     assert env.recorder.count("FailedValidation") == 1
+
+
+# ---------------------------------------------------------------------------
+# CEL rule matrix — one table row per reference CEL case
+# (nodepool_validation_cel_test.go / nodeclaim.go + nodepool.go markers)
+# ---------------------------------------------------------------------------
+
+from karpenter_tpu.apis.nodepool import KubeletConfiguration
+from karpenter_tpu.apis.validation import (
+    MAX_BUDGETS,
+    MAX_REQUIREMENTS,
+    validate_kubelet_configuration,
+)
+
+
+class TestCELDurations:
+    """nodepool.go:69,85 duration patterns (cel_test.go:65-104)."""
+
+    @pytest.mark.parametrize("value,ok", [
+        ("30s", True), ("1h30m", True), ("720h", True), ("Never", True),
+        ("-1s", False), ("30", False), ("1.5h", False), ("1d", False),
+        ("", False),
+    ])
+    def test_expire_after_pattern(self, value, ok):
+        errs = validate_nodepool(make_nodepool(
+            disruption=DisruptionPolicy(expire_after=value)))
+        assert (errs == []) == ok, (value, errs)
+
+    @pytest.mark.parametrize("value,ok", [
+        ("30s", True), ("Never", True), ("-1s", False), ("90", False),
+    ])
+    def test_consolidate_after_pattern(self, value, ok):
+        errs = validate_nodepool(make_nodepool(disruption=DisruptionPolicy(
+            consolidation_policy="WhenEmpty", consolidate_after=value)))
+        assert (errs == []) == ok, (value, errs)
+
+    def test_never_allowed_with_when_underutilized(self):
+        # cel_test.go:95-104: set-but-Never passes, set-to-duration fails
+        assert validate_nodepool(make_nodepool(disruption=DisruptionPolicy(
+            consolidation_policy="WhenUnderutilized", consolidate_after="Never"))) == []
+        assert validate_nodepool(make_nodepool(disruption=DisruptionPolicy(
+            consolidation_policy="WhenUnderutilized", consolidate_after="30s")))
+
+
+class TestCELBudgets:
+    """nodepool.go:94-126 budget rules (cel_test.go:105-205)."""
+
+    def _pool(self, *budgets):
+        return make_nodepool(disruption=DisruptionPolicy(budgets=list(budgets)))
+
+    @pytest.mark.parametrize("nodes,ok", [
+        ("10", True), ("0", True), ("10%", True), ("100%", True), ("0%", True),
+        ("-10", False), ("-10%", False), ("1000%", False), ("101%", False),
+        ("x", False),
+    ])
+    def test_nodes_pattern(self, nodes, ok):
+        errs = validate_nodepool(self._pool(Budget(nodes=nodes)))
+        assert (errs == []) == ok, (nodes, errs)
+
+    @pytest.mark.parametrize("schedule,duration,ok", [
+        ("* * * * *", "20m", True),
+        ("@daily", "8h", True),          # special-cased crons succeed
+        ("@midnight", "1h30m0s", False), # 30m0s? pattern requires m|h then optional 0s
+        ("*", "20m", False),             # <5 fields
+        ("* * * *", "20m", False),       # <5 fields
+        ("@unknown", "20m", False),
+        ("* * * * *", "-20m", False),    # negative window
+        ("* * * * *", "30s", False),     # seconds granularity
+        ("* * * * *", "20mh", False),    # passes the CEL pattern quirk but
+                                         # not duration decoding (the
+                                         # reference rejects it at unmarshal)
+        ("* * * * *", None, False),      # cron without duration
+        (None, "20m", False),            # duration without cron
+        (None, None, True),
+    ])
+    def test_schedule_duration_rules(self, schedule, duration, ok):
+        errs = validate_nodepool(
+            self._pool(Budget(nodes="10", schedule=schedule, duration=duration))
+        )
+        assert (errs == []) == ok, (schedule, duration, errs)
+
+    def test_one_invalid_budget_fails_the_pool(self):
+        errs = validate_nodepool(self._pool(
+            Budget(nodes="10"), Budget(nodes="-10"),
+        ))
+        assert errs
+
+    def test_budget_count_cap(self):
+        errs = validate_nodepool(self._pool(
+            *[Budget(nodes="10") for _ in range(MAX_BUDGETS + 1)]
+        ))
+        assert any("at most" in e for e in errs)
+
+
+class TestCELRequirements:
+    """nodeclaim.go:37-39 + restricted-domain rules (cel_test.go:536-676)."""
+
+    def _pool(self, *reqs):
+        return make_nodepool(requirements=list(reqs))
+
+    def test_requirement_count_cap(self):
+        reqs = [
+            NodeSelectorRequirement(f"key-{i}", "In", ["v"])
+            for i in range(MAX_REQUIREMENTS + 1)
+        ]
+        errs = validate_nodepool(self._pool(*reqs))
+        assert any("at most" in e for e in errs)
+
+    @pytest.mark.parametrize("key,ok", [
+        ("Test", True), ("test.com/Test", True),
+        ("test.com.com/test", True), ("key-only", True),
+        ("test.com.com]/test", False), ("test.com.com/test{}", False),
+        ("Test.com/test", False),       # uppercase domain prefix
+        ("test/test/test", False),      # two slashes
+        ("test.com/", False), ("/test", False),
+        ("a" * 254 + "/test", False),   # prefix too long
+    ])
+    def test_requirement_keys(self, key, ok):
+        errs = validate_nodepool(
+            self._pool(NodeSelectorRequirement(key, "In", ["v"]))
+        )
+        assert (errs == []) == ok, (key, errs)
+
+    def test_nodepool_label_restricted(self):
+        errs = validate_nodepool(
+            self._pool(NodeSelectorRequirement(wk.NODEPOOL_LABEL_KEY, "In", ["x"]))
+        )
+        assert errs
+
+    @pytest.mark.parametrize("op,values,ok", [
+        ("In", ["v"], True), ("NotIn", ["v"], True),
+        ("Exists", [], True), ("DoesNotExist", [], True),
+        ("Gt", ["1"], True), ("Lt", ["2"], True),
+        ("Unknown", ["v"], False), ("VeryUnknown", ["v"], False),
+    ])
+    def test_operators(self, op, values, ok):
+        errs = validate_nodepool(
+            self._pool(NodeSelectorRequirement("test.com/test", op, values))
+        )
+        assert (errs == []) == ok, (op, errs)
+
+    def test_restricted_domains_and_exceptions(self):
+        # the framework's own label domain is restricted...
+        assert validate_nodepool(self._pool(
+            NodeSelectorRequirement(f"{wk.GROUP}/custom", "In", ["v"])
+        ))
+        # ...but the well-known exceptions pass
+        for key in [wk.CAPACITY_TYPE_LABEL_KEY, wk.LABEL_TOPOLOGY_ZONE,
+                    wk.LABEL_INSTANCE_TYPE_STABLE, wk.LABEL_ARCH_STABLE,
+                    wk.LABEL_OS_STABLE]:
+            errs = validate_nodepool(self._pool(
+                NodeSelectorRequirement(key, "In", ["v"])
+            ))
+            assert errs == [], (key, errs)
+
+    def test_kubernetes_io_subdomains_allowed(self):
+        errs = validate_nodepool(self._pool(
+            NodeSelectorRequirement("subdomain.kubernetes.io/node-restriction", "In", ["v"])
+        ))
+        # kubernetes.io restricted core, but node-restriction.kubernetes.io
+        # style exceptions per labels.py — unrecognized bare domains pass
+        errs2 = validate_nodepool(self._pool(
+            NodeSelectorRequirement("mycompany.io/team", "In", ["v"])
+        ))
+        assert errs2 == []
+
+
+class TestCELLabels:
+    """Template label rules (cel_test.go:677-773)."""
+
+    def _pool(self, labels):
+        return make_nodepool(labels=labels)
+
+    def test_unrecognized_labels_allowed(self):
+        assert validate_nodepool(self._pool({"foo": "bar"})) == []
+
+    @pytest.mark.parametrize("key", [
+        wk.NODEPOOL_LABEL_KEY, "kubernetes.io/hostname", "bad key!",
+    ])
+    def test_bad_label_keys(self, key):
+        assert validate_nodepool(self._pool({key: "v"}))
+
+    def test_bad_label_value(self):
+        assert validate_nodepool(self._pool({"ok-key": "bad value!"}))
+
+
+class TestCELKubelet:
+    """KubeletConfiguration rules (nodeclaim.go:48-126; cel_test.go:207-468)."""
+
+    def test_reserved_keys(self):
+        kc = KubeletConfiguration(system_reserved={"cpu": 1.0, "memory": 1e9})
+        assert validate_kubelet_configuration(kc) == []
+        kc = KubeletConfiguration(system_reserved={"gpu": 1.0})
+        assert any("systemReserved" in e for e in validate_kubelet_configuration(kc))
+        kc = KubeletConfiguration(kube_reserved={"nvidia.com/gpu": 1.0})
+        assert any("kubeReserved" in e for e in validate_kubelet_configuration(kc))
+
+    def test_reserved_negative_values(self):
+        kc = KubeletConfiguration(kube_reserved={"cpu": -1.0})
+        assert any("negative" in e for e in validate_kubelet_configuration(kc))
+
+    @pytest.mark.parametrize("value,ok", [
+        ("5%", True), ("100%", True), ("10Gi", True), ("100Mi", True),
+        ("0.3", True),
+        ("5%3", False), ("120%", False), ("-10%", False), ("abc", False),
+    ])
+    def test_eviction_hard_values(self, value, ok):
+        kc = KubeletConfiguration(eviction_hard={"memory.available": value})
+        errs = validate_kubelet_configuration(kc)
+        assert (errs == []) == ok, (value, errs)
+
+    def test_eviction_signal_keys(self):
+        kc = KubeletConfiguration(eviction_hard={"memory": "5%"})
+        assert any("invalid signal" in e for e in validate_kubelet_configuration(kc))
+        kc = KubeletConfiguration(
+            eviction_soft={"memory.availabe": "5%"},
+            eviction_soft_grace_period={"memory.availabe": "1m"},
+        )
+        assert any("invalid signal" in e for e in validate_kubelet_configuration(kc))
+
+    def test_eviction_soft_grace_period_pairing(self):
+        kc = KubeletConfiguration(eviction_soft={"memory.available": "5%"})
+        assert any(
+            "matching evictionSoftGracePeriod" in e
+            for e in validate_kubelet_configuration(kc)
+        )
+        kc = KubeletConfiguration(eviction_soft_grace_period={"memory.available": "1m"})
+        assert any(
+            "matching evictionSoft" in e for e in validate_kubelet_configuration(kc)
+        )
+        kc = KubeletConfiguration(
+            eviction_soft={"memory.available": "5%"},
+            eviction_soft_grace_period={"memory.available": "1m"},
+        )
+        assert validate_kubelet_configuration(kc) == []
+
+    def test_image_gc_thresholds(self):
+        kc = KubeletConfiguration(
+            image_gc_high_threshold_percent=65, image_gc_low_threshold_percent=60
+        )
+        assert validate_kubelet_configuration(kc) == []
+        kc = KubeletConfiguration(
+            image_gc_high_threshold_percent=60, image_gc_low_threshold_percent=65
+        )
+        assert any("greater than" in e for e in validate_kubelet_configuration(kc))
+        kc = KubeletConfiguration(image_gc_high_threshold_percent=101)
+        assert any("0 and 100" in e for e in validate_kubelet_configuration(kc))
+
+    def test_kubelet_wired_into_nodepool_and_nodeclaim(self):
+        pool = make_nodepool()
+        pool.spec.template.spec.kubelet = KubeletConfiguration(
+            eviction_hard={"bogus.signal": "5%"}
+        )
+        assert validate_nodepool(pool)
+        claim = make_nodeclaim()
+        claim.spec.kubelet = KubeletConfiguration(system_reserved={"gpu": 1})
+        assert validate_nodeclaim(claim)
