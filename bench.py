@@ -125,7 +125,10 @@ def make_diverse_pods(count: int, rng: random.Random):
 def _grid():
     if os.environ.get("BENCH_QUICK"):
         return [10, 100, 500]
-    return [10, 100, 500, 1000, 1500, 2000, 2500]
+    # the reference profiling grid (10..2500, scheduling_benchmark_test.go:101)
+    # plus the BASELINE north-star shape (10k pods x 400+ instance types) so
+    # every round records the p50-relevant latency trend
+    return [10, 100, 500, 1000, 1500, 2000, 2500, 10000]
 
 
 # ---------------------------------------------------------------------------
@@ -373,6 +376,11 @@ def main():
             for e in shapes
         },
     }
+    north = next((e for e in shapes if e["pods"] == 10000), None)
+    if north is not None:
+        # the BASELINE north star: 10k pods x 400+ ITs Solve() latency
+        out["solve_10k_pods_s"] = round(north["solve_s"], 3)
+        out["solve_10k_vs_100ms_target"] = round(0.1 / max(north["solve_s"], 1e-9), 4)
     if consol:
         rate = lambda e: e["candidates"] / max(e["solve_s"], 1e-9)
         best = max(consol, key=rate)

@@ -59,6 +59,11 @@ ZONE_KEY = 0
 CT_KEY = 1
 HOSTNAME_KEY = 2
 
+# run commit modes (SchedulingProblem.run_mode)
+RUN_SINGLE = 0  # per-pod step, one pod per scan step
+RUN_ANALYTIC = 1  # closed-form multi-pod commit (no topology interaction)
+RUN_TOPO = 2  # light per-pod inner loop (topology-interacting identical pods)
+
 
 @jax.tree_util.register_dataclass
 @dataclass
@@ -210,7 +215,9 @@ class SchedulingProblem:
     pod_active: Any = None  # bool[P]
     run_start: Any = None  # i32[RN] first queue row of each run
     run_len: Any = None  # i32[RN] rows in the run (0 = padding run)
-    run_multi: Any = None  # bool[RN] eligible for the analytic multi-pod commit
+    # RUN_SINGLE per-pod step / RUN_ANALYTIC closed-form commit /
+    # RUN_TOPO light per-pod inner loop over topology counters
+    run_mode: Any = None  # i32[RN]
 
     @property
     def num_runs(self) -> int:

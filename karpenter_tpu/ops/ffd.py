@@ -939,16 +939,25 @@ def _make_run_commit(problem: SchedulingProblem, statics, C: int, max_run: int):
     return commit
 
 
-@functools.partial(jax.jit, static_argnums=(2,))
-def _solve_ffd_runs_jit(problem: SchedulingProblem, init: FFDState, max_run: int) -> FFDResult:
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _solve_ffd_runs_jit(
+    problem: SchedulingProblem, init: FFDState, max_run: int, with_topo: bool = False
+) -> FFDResult:
     """Run-compressed scan: one step per run of identical pods (encode.py
-    segmentation). Multi-pod runs take the analytic commit; length-1 runs take
-    the per-pod step. 10k diverse pods collapse to a few hundred steps."""
+    segmentation). Topology-inert runs take the closed-form analytic commit,
+    topology-interacting runs the light inner loop (ops/topo_runs.py), and
+    length-1 runs the per-pod step. 10k diverse pods collapse to a few
+    hundred steps. ``with_topo=False`` compiles the two-branch program —
+    topology-free batches (the whole consolidation path) skip the topo
+    branch's compile cost."""
+    from karpenter_tpu.ops.topo_runs import make_topo_run_commit
+
     problem, init = _lane_align(problem, init)
     C = init.claim_open.shape[0]
     statics = _statics(problem)
     step = _make_step(problem, statics, C)
     commit = _make_run_commit(problem, statics, C, max_run)
+    topo_commit = make_topo_run_commit(problem, statics, C, max_run) if with_topo else None
     P = problem.num_pods
     pods_xs = _pod_xs(problem)
     rep_xs = jax.tree_util.tree_map(lambda a: a[problem.run_start], pods_xs)
@@ -958,10 +967,7 @@ def _solve_ffd_runs_jit(problem: SchedulingProblem, init: FFDState, max_run: int
     )
 
     def outer(state, xs):
-        rep, start, length, multi = xs
-
-        def analytic(_):
-            return commit(state, rep, start, length, active_arr)
+        rep, start, length, mode = xs
 
         def single(_):
             new_state, (kind, index) = step(state, rep)
@@ -969,14 +975,22 @@ def _solve_ffd_runs_jit(problem: SchedulingProblem, init: FFDState, max_run: int
             index_row = jnp.full((max_run,), -1, jnp.int32).at[0].set(index)
             return new_state, (kind_row, index_row)
 
-        return lax.cond(multi, analytic, single, None)
+        def analytic(_):
+            return commit(state, rep, start, length, active_arr)
+
+        if with_topo:
+            def topo(_):
+                return topo_commit(state, rep, start, length, active_arr)
+
+            return lax.switch(mode, (single, analytic, topo), None)
+        return lax.switch(mode, (single, analytic), None)
 
     run_start = jnp.asarray(problem.run_start)
     run_len = jnp.asarray(problem.run_len)
     final_state, (kind_ys, index_ys) = lax.scan(
         outer,
         init,
-        (rep_xs, run_start, run_len, jnp.asarray(problem.run_multi)),
+        (rep_xs, run_start, run_len, jnp.asarray(problem.run_mode)),
     )
     # scatter the per-run windows back into queue order; rows no run covers
     # (padding pods) keep KIND_FAIL. Windows are disjoint, so the masked

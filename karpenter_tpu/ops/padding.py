@@ -130,7 +130,8 @@ def pad_problem(p: SchedulingProblem, min_pods: int = 0) -> SchedulingProblem:
         pod_active=_pad(p.pod_active, (P,), False),
         run_start=_pad(p.run_start, (pow2_bucket(p.num_runs, lo=4),), 0),
         run_len=_pad(p.run_len, (pow2_bucket(p.num_runs, lo=4),), 0),
-        run_multi=_pad(p.run_multi, (pow2_bucket(p.num_runs, lo=4),), True),
+        # padding runs are length-0 analytic commits (pure no-ops)
+        run_mode=_pad(p.run_mode, (pow2_bucket(p.num_runs, lo=4),), 1),
     )
 
 

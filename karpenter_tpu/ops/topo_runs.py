@@ -1,0 +1,408 @@
+"""Topology-run commit: one scan step places a whole run of identical
+topology-interacting pods through a light per-pod inner loop.
+
+The per-pod FFD step (ops/ffd.py _make_step) evaluates EVERY bin with full
+[bins, K, V] requirement algebra and [bins, T] instance-type products per
+pod — the right shape for arbitrary pods, but wasteful for a run of
+identical ones where the merges, compatibilities, and static gates are
+loop-invariant. This kernel hoists those and keeps per pod only:
+
+  - the node-side topo_gate over the PRECOMPUTED merged node rows (dynamic
+    only through the topology counters) + integer fill capacities;
+  - a fewest-pods retry loop for claims: candidates are tried in rank order
+    and each is VERIFIED with the real topo_gate / it_gate at B=1 before
+    committing — the per-pod step evaluates the same gates for every claim
+    and takes the argmin passing one, so the first passing candidate in
+    rank order is the identical choice;
+  - the fresh-template phase (same helpers as the step);
+  - Topology.Record via the shared record kernel.
+
+What makes this cheaper than the step: no [C, K, V] claim merges, no
+[C, T] / [TPL, T] instance-type products for every pod — only the chosen
+claim pays [T]-sized verification, and the template block only runs when no
+claim accepts.
+
+Eligibility is decided by the encoder (solver/encode.py RUN_TOPO): identical
+rows, match == selects == owned for every group, no spread node-filters, no
+host ports, no CSI volumes. Anything else stays on the per-pod step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax, vmap
+
+from karpenter_tpu.models.problem import (
+    HOSTNAME_KEY,
+    ReqTensor,
+    SchedulingProblem,
+)
+from karpenter_tpu.ops import masks
+from karpenter_tpu.ops.ffd import (
+    KIND_CLAIM,
+    KIND_FAIL,
+    KIND_NEW_CLAIM,
+    KIND_NODE,
+    KIND_NO_SLOT,
+    FFDState,
+    _capacity,
+    _first_true,
+    _fresh_template_rows,
+    _intersect_rows,
+    _make_it_gate,
+    _mix_req_rows,
+)
+from karpenter_tpu.ops.topology_kernels import PodTopoStatics, record, topo_gate
+
+_BIG = 2**30
+
+
+def _bcast_req(row: ReqTensor, E: int, K: int, V: int) -> ReqTensor:
+    return ReqTensor(
+        admitted=jnp.broadcast_to(row.admitted, (E, K, V)),
+        comp=jnp.broadcast_to(row.comp, (E, K)),
+        gt=jnp.broadcast_to(row.gt, (E, K)),
+        lt=jnp.broadcast_to(row.lt, (E, K)),
+        defined=jnp.broadcast_to(row.defined, (E, K)),
+    )
+
+
+def make_topo_run_commit(problem: SchedulingProblem, statics, C: int, max_run: int):
+    lv, ln, wellknown, no_allow, it_packed, it_neg = statics
+    it_gate = _make_it_gate(problem, statics)
+    N = problem.num_nodes
+    T = problem.num_instance_types
+    TPL = problem.num_templates
+    K = problem.num_keys
+    V = problem.num_lanes
+
+    def commit(state: FFDState, pod, start, length, active_arr):
+        (
+            pod_req,
+            pod_strict,
+            pod_requests,
+            tol_tpl,
+            tol_node,
+            pod_ports,
+            pod_conflict,
+            grp_match,
+            grp_selects,
+            grp_owned,
+            _pod_vols,
+            _pa,
+        ) = pod
+        topo_pod = PodTopoStatics(
+            strict_admitted=pod_strict.admitted,
+            grp_match=grp_match,
+            grp_selects=grp_selects,
+            grp_owned=grp_owned,
+        )
+        win = jnp.arange(max_run)
+        act = lax.dynamic_slice(active_arr, (start,), (max_run,)) & (win < length)
+
+        # ---- loop-invariant statics (the step pays these per pod) --------
+        if N > 0:
+            # resource capacity and port conflicts are invariant across the
+            # run (identical pods; eligibility excludes host ports), but the
+            # requirement-side merge/compat must read the FRESH node rows
+            # inside the loop — an earlier pod of this run can narrow a
+            # node's row (complement-key merges, topology collapse) in ways
+            # later pods must observe, exactly as the per-pod step does
+            node_port_ok = ~jnp.any(
+                state.node_used_ports & pod_conflict[None, :], axis=-1
+            )
+            node_res_cap = _capacity(
+                problem.node_avail, state.node_requests, pod_requests[None, :]
+            )
+
+        # ---- per-pod loop -------------------------------------------------
+        def body(carry):
+            i, taken_nodes, st, kind_row, index_row = carry
+            is_active = act[i]
+
+            def place(args):
+                taken_nodes, st, kind_row, index_row = args
+
+                # -- 1. existing nodes: the step's node phase on fresh rows
+                if N > 0:
+                    node_merged = _intersect_rows(st.node_req, pod_req)
+                    node_compat = vmap(
+                        lambda nr: masks.compatible_ok(nr, pod_req, lv, ln, no_allow)
+                    )(st.node_req)
+                    node_topo_ok, node_final = topo_gate(
+                        problem,
+                        st.grp_counts,
+                        st.grp_registered,
+                        topo_pod,
+                        node_merged,
+                        no_allow,
+                    )
+                    n_ok = (
+                        tol_node
+                        & node_compat
+                        & node_port_ok
+                        & (node_res_cap - taken_nodes > 0)
+                        & node_topo_ok
+                    )
+                    node_pick = _first_true(n_ok)
+                    any_node = jnp.any(n_ok)
+                else:
+                    any_node = jnp.bool_(False)
+
+                def commit_node(a):
+                    taken_nodes, st, kind_row, index_row = a
+                    hot = jnp.arange(N) == node_pick
+                    final = node_final.row(jnp.minimum(node_pick, N - 1))
+                    counts, registered = record(
+                        problem, st.grp_counts, st.grp_registered, topo_pod,
+                        final, no_allow, jnp.bool_(True), lv, ln,
+                    )
+                    st2 = dataclasses.replace(
+                        st,
+                        node_req=_mix_req_rows(st.node_req, node_final, hot),
+                        grp_counts=counts,
+                        grp_registered=registered,
+                    )
+                    return (
+                        taken_nodes + hot.astype(jnp.int32),
+                        st2,
+                        kind_row.at[i].set(KIND_NODE),
+                        index_row.at[i].set(node_pick.astype(jnp.int32)),
+                    )
+
+                # -- 2. claims: fewest-pods retry with exact B=1 verification
+                def try_claims(a):
+                    taken_nodes, st, kind_row, index_row = a
+                    opt = (
+                        st.claim_open
+                        & tol_tpl[st.claim_tpl]
+                        & ~jnp.any(
+                            st.claim_used_ports & pod_conflict[None, :], axis=-1
+                        )
+                    )
+                    zero_final = st.claim_req.row(0)
+
+                    def c_cond(cc):
+                        cand, found = cc[0], cc[1]
+                        return jnp.any(cand) & ~found
+
+                    def c_body(cc):
+                        cand, _found, _pick, f_keep, itok_keep = cc
+                        rank = jnp.where(
+                            cand, st.claim_npods * C + jnp.arange(C), _BIG
+                        )
+                        c = jnp.argmin(rank)
+                        row = st.claim_req.row(c)
+                        merged = masks.intersect(row, pod_req)
+                        compat = masks.compatible_ok(row, pod_req, lv, ln, wellknown)
+                        merged1 = _bcast_req(merged, 1, K, V)
+                        ok_t, final1 = topo_gate(
+                            problem, st.grp_counts, st.grp_registered, topo_pod,
+                            merged1, wellknown,
+                        )
+                        requests2 = st.claim_requests[c] + pod_requests
+                        itok2 = it_gate(
+                            final1, requests2[None, :], st.claim_it_ok[c][None, :]
+                        )[0]
+                        ok = compat & ok_t[0] & jnp.any(itok2)
+                        final = jax.tree_util.tree_map(lambda x: x[0], final1)
+                        f2 = jax.tree_util.tree_map(
+                            lambda keep, new: jnp.where(ok, new, keep), f_keep, final
+                        )
+                        return (
+                            cand & (jnp.arange(C) != c),
+                            ok,
+                            jnp.where(ok, c, 0).astype(jnp.int32),
+                            f2,
+                            jnp.where(ok, itok2, itok_keep),
+                        )
+
+                    _cand, found, pick, final, itok2 = lax.while_loop(
+                        c_cond,
+                        c_body,
+                        (opt, jnp.bool_(False), jnp.int32(0), zero_final,
+                         st.claim_it_ok[0]),
+                    )
+
+                    def commit_claim(a2):
+                        taken_nodes, st, kind_row, index_row = a2
+                        hot = jnp.arange(C) == pick
+                        counts, registered = record(
+                            problem, st.grp_counts, st.grp_registered, topo_pod,
+                            final, wellknown, jnp.bool_(True), lv, ln,
+                        )
+                        st2 = dataclasses.replace(
+                            st,
+                            claim_req=_mix_req_rows(
+                                st.claim_req, _bcast_req(final, C, K, V), hot
+                            ),
+                            claim_requests=st.claim_requests
+                            + hot[:, None] * pod_requests[None, :],
+                            claim_it_ok=jnp.where(
+                                hot[:, None], itok2[None, :], st.claim_it_ok
+                            ),
+                            claim_npods=st.claim_npods + hot.astype(jnp.int32),
+                            claim_used_ports=st.claim_used_ports
+                            | (hot[:, None] & pod_ports[None, :]),
+                            grp_counts=counts,
+                            grp_registered=registered,
+                        )
+                        return (
+                            taken_nodes,
+                            st2,
+                            kind_row.at[i].set(KIND_CLAIM),
+                            index_row.at[i].set(pick),
+                        )
+
+                    # -- 3. fresh template claim (step phase 3, B=TPL bins)
+                    def try_templates(a2):
+                        taken_nodes, st, kind_row, index_row = a2
+                        free_slot = _first_true(~st.claim_open)
+                        has_slot = jnp.any(~st.claim_open)
+                        tpl_merged, tpl_compat, host_onehot = _fresh_template_rows(
+                            problem, lv, ln, wellknown, pod_req, free_slot
+                        )
+                        mint = problem.claim_hostname_lane.shape[0] > 0
+                        reg_for_tpl = st.grp_registered | (
+                            mint
+                            & (problem.grp_key == HOSTNAME_KEY)[:, None]
+                            & host_onehot[None, :]
+                        )
+                        tpl_ok_t, tpl_final = topo_gate(
+                            problem, st.grp_counts, reg_for_tpl, topo_pod,
+                            tpl_merged, wellknown,
+                        )
+                        tpl_requests2 = problem.tpl_overhead + pod_requests[None, :]
+                        within = masks.fits(
+                            problem.it_cap[None, :, :], st.remaining[:, None, :]
+                        )
+                        tpl_it_ok2 = it_gate(
+                            tpl_final, tpl_requests2, problem.tpl_it_ok & within
+                        )
+                        tpl_ok = (
+                            tol_tpl
+                            & tpl_compat
+                            & tpl_ok_t
+                            & jnp.any(tpl_it_ok2, axis=-1)
+                        )
+                        tpick = _first_true(tpl_ok)
+                        any_tpl = jnp.any(tpl_ok)
+                        tpick_c = jnp.minimum(tpick, TPL - 1)
+
+                        def open_claim(a3):
+                            taken_nodes, st, kind_row, index_row = a3
+                            hot = jnp.arange(C) == free_slot
+                            slot_req = tpl_final.row(tpick_c)
+                            row_itok = tpl_it_ok2[tpick_c]
+                            max_cap = jnp.max(
+                                jnp.where(row_itok[:, None], problem.it_cap, 0.0),
+                                axis=0,
+                            )
+                            opened_tpl_hot = jnp.arange(TPL) == tpick_c
+                            counts, registered = record(
+                                problem, st.grp_counts, reg_for_tpl, topo_pod,
+                                slot_req, wellknown, jnp.bool_(True), lv, ln,
+                            )
+                            st2 = dataclasses.replace(
+                                st,
+                                claim_req=_mix_req_rows(
+                                    st.claim_req, _bcast_req(slot_req, C, K, V), hot
+                                ),
+                                claim_requests=jnp.where(
+                                    hot[:, None],
+                                    tpl_requests2[tpick_c][None, :],
+                                    st.claim_requests,
+                                ),
+                                claim_it_ok=jnp.where(
+                                    hot[:, None], row_itok[None, :], st.claim_it_ok
+                                ),
+                                claim_open=st.claim_open | hot,
+                                claim_npods=st.claim_npods + hot.astype(jnp.int32),
+                                claim_tpl=jnp.where(
+                                    hot, tpick_c.astype(jnp.int32), st.claim_tpl
+                                ),
+                                claim_used_ports=st.claim_used_ports
+                                | (hot[:, None] & pod_ports[None, :]),
+                                remaining=jnp.where(
+                                    opened_tpl_hot[:, None],
+                                    st.remaining - max_cap[None, :],
+                                    st.remaining,
+                                ),
+                                grp_counts=counts,
+                                grp_registered=registered,
+                            )
+                            return (
+                                taken_nodes,
+                                st2,
+                                kind_row.at[i].set(KIND_NEW_CLAIM),
+                                index_row.at[i].set(free_slot.astype(jnp.int32)),
+                            )
+
+                        def no_open(a3):
+                            taken_nodes, st, kind_row, index_row = a3
+                            fail = jnp.where(
+                                any_tpl, KIND_NO_SLOT, KIND_FAIL
+                            ).astype(jnp.int32)
+                            return (
+                                taken_nodes,
+                                st,
+                                kind_row.at[i].set(fail),
+                                index_row.at[i].set(-1),
+                            )
+
+                        return lax.cond(any_tpl & has_slot, open_claim, no_open, a2)
+
+                    return lax.cond(found, commit_claim, try_templates, a)
+
+                if N > 0:
+                    return lax.cond(any_node, commit_node, try_claims, args)
+                return try_claims(args)
+
+            def skip_pod(args):
+                taken_nodes, st, kind_row, index_row = args
+                return (
+                    taken_nodes,
+                    st,
+                    kind_row.at[i].set(KIND_FAIL),
+                    index_row.at[i].set(-1),
+                )
+
+            args = (taken_nodes, st, kind_row, index_row)
+            taken_nodes, st, kind_row, index_row = lax.cond(
+                is_active, place, skip_pod, args
+            )
+            return (i + 1, taken_nodes, st, kind_row, index_row)
+
+        def cond(carry):
+            return carry[0] < jnp.minimum(length, max_run)
+
+        (_i, taken_nodes, st, kind_row, index_row) = lax.while_loop(
+            cond,
+            body,
+            (
+                jnp.int32(0),
+                jnp.zeros((N,), jnp.int32),
+                state,
+                jnp.full((max_run,), KIND_FAIL, jnp.int32),
+                jnp.full((max_run,), -1, jnp.int32),
+            ),
+        )
+
+        # bulk-apply node resource fills (requirement rows were committed
+        # in-loop with their topo-narrowed finals)
+        if N > 0:
+            took = taken_nodes > 0
+            st = dataclasses.replace(
+                st,
+                node_requests=st.node_requests
+                + taken_nodes[:, None] * pod_requests[None, :],
+                node_npods=st.node_npods + taken_nodes,
+                node_used_ports=st.node_used_ports
+                | (took[:, None] & pod_ports[None, :]),
+            )
+        return st, (kind_row, index_row)
+
+    return commit

@@ -541,16 +541,28 @@ class Encoder:
         )
 
         # -- 10. run segmentation: consecutive queue rows with identical
-        # encodings and zero topology interaction commit as one analytic scan
-        # step (ops/ffd.py run solver). Eligibility is re-checked on a
-        # 128-bit digest of the encoded rows, so the sort-signature heuristic
-        # above cannot cause false merges (collision odds are negligible).
+        # encodings commit as one scan step (ops/ffd.py run solver):
+        # topology-inert runs take the closed-form analytic commit; runs that
+        # interact with topology groups take the light per-pod inner loop
+        # (ops/topo_runs.py) unless they carry host ports or CSI volumes
+        # (whose within-run interactions the closed node-capacity form does
+        # not model — those stay on the per-pod step). Eligibility is
+        # re-checked on a 128-bit digest of the encoded rows, so the
+        # sort-signature heuristic above cannot cause false merges
+        # (collision odds are negligible).
+        from karpenter_tpu.models.problem import RUN_ANALYTIC, RUN_SINGLE, RUN_TOPO
+
         P = len(pods)
         interacts = (
             pod_grp_match.any(axis=1)
             | pod_grp_selects.any(axis=1)
             | pod_grp_owned.any(axis=1)
         ) if G else np.zeros(P, dtype=bool)
+        has_ports = pod_ports.any(axis=1) if pod_ports.size else np.zeros(P, dtype=bool)
+        has_vols = (
+            pod_vol_counts.any(axis=1) if pod_vol_counts.size else np.zeros(P, dtype=bool)
+        )
+        mergeable = ~(interacts & (has_ports | has_vols))
         import hashlib
 
         def _fingerprint(pi: int) -> bytes:
@@ -564,6 +576,7 @@ class Encoder:
                 pod_strict_reqs.lt, pod_strict_reqs.defined,
                 pod_requests, pod_tol_tpl, pod_tol_node,
                 pod_ports, pod_port_conflict, pod_vol_counts,
+                pod_grp_match, pod_grp_selects, pod_grp_owned,
             ):
                 h.update(a[pi].tobytes())
             return h.digest()
@@ -571,27 +584,32 @@ class Encoder:
         fingerprints = [_fingerprint(pi) for pi in range(P)]
         run_start_l: List[int] = []
         run_len_l: List[int] = []
-        run_multi_l: List[bool] = []
+        run_mode_l: List[int] = []
         i = 0
         while i < P:
             j = i + 1
-            if not interacts[i]:
+            if mergeable[i]:
                 while (
                     j < P
                     and j - i < MAX_RUN_LEN
-                    and not interacts[j]
+                    and mergeable[j]
                     and fingerprints[j] == fingerprints[i]
                 ):
                     j += 1
             run_start_l.append(i)
             run_len_l.append(j - i)
             # length-1 runs go through the battle-tested per-pod step; the
-            # analytic commit is only entered when it actually pays
-            run_multi_l.append(j - i > 1)
+            # run commits are only entered when they actually pay
+            if j - i == 1:
+                run_mode_l.append(RUN_SINGLE)
+            elif interacts[i]:
+                run_mode_l.append(RUN_TOPO)
+            else:
+                run_mode_l.append(RUN_ANALYTIC)
             i = j
         run_start = np.array(run_start_l, dtype=np.int32)
         run_len = np.array(run_len_l, dtype=np.int32)
-        run_multi = np.array(run_multi_l, dtype=bool)
+        run_mode = np.array(run_mode_l, dtype=np.int32)
         pod_active = np.ones(P, dtype=bool)
 
         problem = SchedulingProblem(
@@ -641,7 +659,7 @@ class Encoder:
             pod_active=pod_active,
             run_start=run_start,
             run_len=run_len,
-            run_multi=run_multi,
+            run_mode=run_mode,
         )
         meta = ProblemMeta(
             keys=list(vocab.keys),

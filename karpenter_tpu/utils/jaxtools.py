@@ -8,10 +8,20 @@ import os
 def enable_compilation_cache(path: str = "/root/repo/.jax_cache") -> None:
     """Persist compiled executables on disk: the FFD kernel's shape buckets
     recompile identically across processes and rounds, and on a tunneled TPU
-    each compile costs tens of seconds."""
+    each compile costs tens of seconds.
+
+    TPU-only: the CPU backend persists executables through XLA:CPU AOT
+    serialization, which in this jaxlib build segfaults on the run-solver's
+    nested control flow (put_executable_and_time -> SIGSEGV) and re-loads
+    entries with machine-feature mismatches ("could lead to SIGILL"). CPU
+    callers (tests, bench fallback) rely on the in-process jit cache instead.
+    """
     try:
         import jax
 
+        platforms = str(getattr(jax.config, "jax_platforms", "") or "")
+        if platforms and "axon" not in platforms and "tpu" not in platforms:
+            return
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
