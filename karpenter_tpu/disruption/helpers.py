@@ -20,6 +20,8 @@ from karpenter_tpu.disruption.types import Candidate, IneligibleError, new_candi
 from karpenter_tpu.kube.client import KubeClient
 from karpenter_tpu.provisioning.provisioner import Provisioner, SchedulerInputs
 from karpenter_tpu.solver.backend import SolveResult
+from karpenter_tpu.metrics.registry import measure
+from karpenter_tpu.provisioning.provisioner import SCHEDULING_SIMULATION_DURATION
 from karpenter_tpu.state.cluster import Cluster
 from karpenter_tpu.utils.clock import Clock
 
@@ -151,15 +153,16 @@ def simulate_scheduling(
     if inputs is None:
         return None
     inputs.nodes = [n for n in inputs.nodes if n.name not in candidate_names]
-    result = provisioner.solver.solve(
-        inputs.pods,
-        inputs.instance_types,
-        inputs.templates,
-        nodes=inputs.nodes,
-        cluster_pods=inputs.cluster_pods,
-        domains=inputs.domains,
-        pod_volumes=inputs.pod_volumes,
-    )
+    with measure(SCHEDULING_SIMULATION_DURATION):
+        result = provisioner.solver.solve(
+            inputs.pods,
+            inputs.instance_types,
+            inputs.templates,
+            nodes=inputs.nodes,
+            cluster_pods=inputs.cluster_pods,
+            domains=inputs.domains,
+            pod_volumes=inputs.pod_volumes,
+        )
     return SimulationResults(
         result=result,
         inputs=inputs,

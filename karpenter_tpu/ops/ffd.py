@@ -941,7 +941,7 @@ def _make_run_commit(problem: SchedulingProblem, statics, C: int, max_run: int):
 
 @functools.partial(jax.jit, static_argnums=(2, 3))
 def _solve_ffd_runs_jit(
-    problem: SchedulingProblem, init: FFDState, max_run: int, with_topo: bool = False
+    problem: SchedulingProblem, init: FFDState, max_run: int, with_topo: bool
 ) -> FFDResult:
     """Run-compressed scan: one step per run of identical pods (encode.py
     segmentation). Topology-inert runs take the closed-form analytic commit,
@@ -1021,10 +1021,25 @@ def max_run_bucket(problem: SchedulingProblem) -> int:
     return pow2_bucket(int(np.max(np.asarray(problem.run_len), initial=1)), lo=1)
 
 
+def has_topo_runs(problem: SchedulingProblem) -> bool:
+    """Whether any run needs the topology inner-loop commit. MUST be threaded
+    into _solve_ffd_runs_jit's static with_topo: lax.switch clamps an
+    out-of-range mode index, so a RUN_TOPO run fed to the two-branch program
+    silently takes the topology-ignoring analytic commit (the round-2
+    21/64-seed parity regression)."""
+    import numpy as np
+
+    from karpenter_tpu.models.problem import RUN_TOPO
+
+    return bool(np.any(np.asarray(problem.run_mode) == RUN_TOPO))
+
+
 def solve_ffd_runs(
     problem: SchedulingProblem, max_claims: int, init: Optional[FFDState] = None
 ) -> FFDResult:
     """Run one pack pass through the run-compressed solver."""
     if init is None:
         init = initial_state(problem, max_claims)
-    return _solve_ffd_runs_jit(problem, init, max_run_bucket(problem))
+    return _solve_ffd_runs_jit(
+        problem, init, max_run_bucket(problem), has_topo_runs(problem)
+    )

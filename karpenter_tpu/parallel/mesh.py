@@ -31,6 +31,7 @@ from karpenter_tpu.ops.ffd import (
     FFDResult,
     _solve_ffd_jit,
     _solve_ffd_runs_jit,
+    has_topo_runs as _has_topo_runs,
     initial_state,
     max_run_bucket as _max_run_bucket,
 )
@@ -57,12 +58,14 @@ def shard_batch(batch: SchedulingProblem, mesh: Mesh, axis: str = CANDIDATE_AXIS
     return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
 def _batched_solve_jit(
-    batch: SchedulingProblem, max_claims: int, max_run: int
+    batch: SchedulingProblem, max_claims: int, max_run: int, with_topo: bool
 ) -> FFDResult:
     return jax.vmap(
-        lambda p: _solve_ffd_runs_jit.__wrapped__(p, initial_state(p, max_claims), max_run)
+        lambda p: _solve_ffd_runs_jit.__wrapped__(
+            p, initial_state(p, max_claims), max_run, with_topo
+        )
     )(batch)
 
 
@@ -73,14 +76,16 @@ def batched_solve(
     mesh, the candidate axis is sharded across devices and each device runs
     its slice of the scan batch."""
     max_run = _max_run_bucket(batch)
+    with_topo = _has_topo_runs(batch)
     if mesh is not None:
         batch = shard_batch(batch, mesh)
-    return _batched_solve_jit(batch, max_claims, max_run)
+    return _batched_solve_jit(batch, max_claims, max_run, with_topo)
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
 def _batched_screen_jit(
-    batch: SchedulingProblem, max_claims: int, passes: int, max_run: int
+    batch: SchedulingProblem, max_claims: int, passes: int, max_run: int,
+    with_topo: bool,
 ) -> FFDResult:
     """Multi-pass batched solve: after each pass, pods that placed are masked
     out via pod_active (preserving the run structure) and the scan re-runs
@@ -93,12 +98,14 @@ def _batched_screen_jit(
     from karpenter_tpu.ops.ffd import KIND_FAIL
 
     def one(p: SchedulingProblem) -> FFDResult:
-        r = _solve_ffd_runs_jit.__wrapped__(p, initial_state(p, max_claims), max_run)
+        r = _solve_ffd_runs_jit.__wrapped__(
+            p, initial_state(p, max_claims), max_run, with_topo
+        )
         kind, index = r.kind, r.index
         for _ in range(passes - 1):
             placed = kind < KIND_FAIL
             p2 = dataclasses.replace(p, pod_active=p.pod_active & ~placed)
-            r = _solve_ffd_runs_jit.__wrapped__(p2, r.state, max_run)
+            r = _solve_ffd_runs_jit.__wrapped__(p2, r.state, max_run, with_topo)
             kind = jnp.where(placed, kind, r.kind)
             index = jnp.where(placed, index, r.index)
         return FFDResult(kind=kind, index=index, state=r.state)
@@ -115,9 +122,10 @@ def batched_screen(
     """batched_solve with ``passes`` placement passes per problem (see
     _batched_screen_jit) — the consolidation scorer's workhorse."""
     max_run = _max_run_bucket(batch)
+    with_topo = _has_topo_runs(batch)
     if mesh is not None:
         batch = shard_batch(batch, mesh)
-    return _batched_screen_jit(batch, max_claims, passes, max_run)
+    return _batched_screen_jit(batch, max_claims, passes, max_run, with_topo)
 
 
 def default_mesh(min_devices: int = 2) -> Optional[Mesh]:

@@ -59,6 +59,15 @@ SCHEDULING_DURATION = REGISTRY.histogram(
     subsystem="provisioner",
 )
 
+# Observed around every Solve — provisioning passes and disruption's
+# simulated ones alike, like the reference's defer inside Scheduler.Solve
+# (scheduling/scheduler.go:141, scheduling/metrics.go:29-40).
+SCHEDULING_SIMULATION_DURATION = REGISTRY.histogram(
+    "scheduling_simulation_duration_seconds",
+    "Duration of scheduling simulations used for deprovisioning and provisioning",
+    subsystem="provisioner",
+)
+
 
 @dataclass
 class SchedulerInputs:
@@ -365,7 +374,7 @@ class Provisioner:
         inputs = self.build_inputs(pods)
         if inputs is None:
             return SolveResult(failures={i: "no nodepools" for i in range(len(pods))}), None
-        with measure(SCHEDULING_DURATION):
+        with measure(SCHEDULING_DURATION), measure(SCHEDULING_SIMULATION_DURATION):
             result = self.solver.solve(
                 inputs.pods,
                 inputs.instance_types,

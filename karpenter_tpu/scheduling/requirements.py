@@ -327,7 +327,10 @@ class Requirements:
         for key in incoming.keys() - allow_undefined:
             if self.has(key) or incoming.get(key).operator() in _NEGATIVE_POLARITY:
                 continue
-            errs.append(f'label "{key}" does not have known values')
+            errs.append(
+                f'label "{key}" does not have known values'
+                + _label_hint(self, key, allow_undefined)
+            )
         errs.extend(self.intersects(incoming))
         return errs
 
@@ -352,6 +355,41 @@ class Requirements:
 
     def __eq__(self, other) -> bool:
         return isinstance(other, Requirements) and self._reqs == other._reqs
+
+
+def _edit_distance(s: str, t: str) -> int:
+    """Levenshtein distance, two-row DP (requirements.go:177-213)."""
+    if not s:
+        return len(t)
+    if not t:
+        return len(s)
+    prev = list(range(len(t) + 1))
+    for i, cs in enumerate(s):
+        cur = [i + 1]
+        for j, ct in enumerate(t):
+            cur.append(min(prev[j + 1] + 1, cur[j] + 1, prev[j] + (cs != ct)))
+        prev = cur
+    return prev[-1]
+
+
+def _get_suffix(key: str) -> str:
+    """The part after the domain slash, or the whole key (requirements.go:215-218)."""
+    before, sep, after = key.partition("/")
+    return after if sep else before
+
+
+def _label_hint(reqs: "Requirements", key: str, allow_undefined: frozenset) -> str:
+    """' (typo of "...") ?' suggestion for an unknown label key, matched
+    against the allowed-undefined set and the defined keys by containment,
+    edit distance (< len/5), or domain-suffix equality
+    (requirements.go:220-239)."""
+    for candidates in (sorted(allow_undefined), sorted(reqs.keys())):
+        for known in candidates:
+            if key in known or _edit_distance(key, known) < len(known) // 5:
+                return f' (typo of "{known}"?)'
+            if known.endswith(_get_suffix(key)):
+                return f' (typo of "{known}"?)'
+    return ""
 
 
 ALLOW_UNDEFINED_WELL_KNOWN_LABELS = frozenset(wk.WELL_KNOWN_LABELS)

@@ -378,3 +378,17 @@ def test_od_to_spot_replacement_is_allowed_and_pinned():
         r for r in rep.spec.requirements if r.key == wk.CAPACITY_TYPE_LABEL_KEY
     ]
     assert ct_reqs and list(ct_reqs[0].values) == [wk.CAPACITY_TYPE_SPOT], ct_reqs
+
+
+def test_simulation_duration_metric_observed():
+    """Every consolidation probe's simulated Solve lands one observation in
+    scheduling_simulation_duration_seconds (scheduling/metrics.go:29-40)."""
+    from karpenter_tpu.disruption.helpers import SCHEDULING_SIMULATION_DURATION
+
+    env = Env()
+    env.create(make_underutilized_pool())
+    env.create_candidate_node("n1", pods=[make_pod(name="p1", cpu=0.1)])
+    before = SCHEDULING_SIMULATION_DURATION.count()
+    cmd = env.reconcile_disruption()
+    assert cmd is not None
+    assert SCHEDULING_SIMULATION_DURATION.count() > before

@@ -311,3 +311,66 @@ class TestPropertyParity:
                 assert got == expected, (
                     f"{a!r} ∩ {b!r} = {inter!r}: has({v}) = {got}, want {expected}"
                 )
+
+
+class TestLabelHints:
+    """editDistance typo suggestions in Compatible error strings
+    (requirements.go:177-239)."""
+
+    def test_typo_of_well_known_label(self):
+        from karpenter_tpu.apis import labels as wk
+        from karpenter_tpu.apis.objects import IN
+        from karpenter_tpu.scheduling.requirements import (
+            ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
+            Requirement,
+            Requirements,
+        )
+
+        node = Requirements()
+        # one character off topology.kubernetes.io/zone; _raw keeps the
+        # normalizer from silently fixing what we claim is a typo
+        incoming = Requirements(
+            Requirement("topology.kubernetes.io/zne", IN, ["z1"], _raw=True)
+        )
+        errs = node.compatible(incoming, ALLOW_UNDEFINED_WELL_KNOWN_LABELS)
+        assert errs and "does not have known values" in errs[0]
+        assert f'typo of "{wk.LABEL_TOPOLOGY_ZONE}"?' in errs[0]
+
+    def test_typo_of_existing_key(self):
+        from karpenter_tpu.apis.objects import IN
+        from karpenter_tpu.scheduling.requirements import Requirement, Requirements
+
+        node = Requirements(Requirement("example.com/team-name", IN, ["infra"]))
+        incoming = Requirements(Requirement("example.com/team-nmae", IN, ["infra"]))
+        errs = node.compatible(incoming)
+        assert errs and "typo of" in errs[0]
+
+    def test_suffix_match_hint(self):
+        from karpenter_tpu.apis.objects import IN
+        from karpenter_tpu.scheduling.requirements import (
+            ALLOW_UNDEFINED_WELL_KNOWN_LABELS,
+            Requirement,
+            Requirements,
+        )
+
+        # wrong domain, right suffix: acme.io/zone -> .../zone
+        node = Requirements()
+        incoming = Requirements(Requirement("acme.io/zone", IN, ["z1"]))
+        errs = node.compatible(incoming, ALLOW_UNDEFINED_WELL_KNOWN_LABELS)
+        assert errs and "typo of" in errs[0]
+
+    def test_unrelated_key_gets_no_hint(self):
+        from karpenter_tpu.apis.objects import IN
+        from karpenter_tpu.scheduling.requirements import Requirement, Requirements
+
+        node = Requirements()
+        errs = node.compatible(Requirements(Requirement("qqqq-xyzzy", IN, ["v"])))
+        assert errs and "typo of" not in errs[0]
+
+    def test_edit_distance(self):
+        from karpenter_tpu.scheduling.requirements import _edit_distance
+
+        assert _edit_distance("", "abc") == 3
+        assert _edit_distance("abc", "") == 3
+        assert _edit_distance("kitten", "sitting") == 3
+        assert _edit_distance("zone", "zone") == 0

@@ -411,3 +411,99 @@ class TestRandomizedTopologyParity:
             for j in range(rng.randint(0, 4))
         ]
         run_both(pods, its, templates, nodes)
+
+
+class TestRunCompressionDifferential:
+    """Standing differential: the run-compressed scan (solve_ffd_runs, the
+    production default) against the per-pod scan (solve_ffd, the semantic
+    anchor) — pod-for-pod (kind, index) equality at the FFD layer, on fuzzed
+    topology workloads whose segmentation exercises all three run modes
+    (RUN_SINGLE / RUN_ANALYTIC / RUN_TOPO). This is the guard the round-2
+    regression (topo runs silently clamped onto the analytic branch by
+    lax.switch) shipped without."""
+
+    @pytest.mark.parametrize("seed", [0, 7, 21, 33, 48])
+    def test_per_pod_vs_runs(self, seed):
+        import numpy as np
+
+        from karpenter_tpu.models.problem import RUN_ANALYTIC, RUN_TOPO
+        from karpenter_tpu.ops.ffd import solve_ffd, solve_ffd_runs
+        from karpenter_tpu.ops.padding import pad_problem
+        from karpenter_tpu.provisioning.topology import Topology
+        from karpenter_tpu.solver.encode import Encoder
+        from karpenter_tpu.solver.jax_backend import domains_from_instance_types
+        from karpenter_tpu.cloudprovider.fake import FAKE_WELL_KNOWN_LABELS
+
+        t = TestRandomizedTopologyParity()
+        rng = random.Random(1000 + seed)
+        its = instance_types(rng.choice([6, 10]))
+        templates = [simple_template(its, name="a")]
+        taint = Taint(key="team", value="x", effect="NoSchedule")
+        if rng.random() < 0.3:
+            templates.append(simple_template(its, name="b", taints=[taint]))
+        n = rng.randint(10, 60) if seed % 4 else rng.randint(100, 200)
+        pods = [t._make_topology_pod(rng, i) for i in range(n)]
+        nodes = [
+            TestExistingNodesParity().make_node(
+                f"node-{j}", cpu=rng.choice([2.0, 4.0, 8.0]), zone=rng.choice(t.ZONES)
+            )
+            for j in range(rng.randint(0, 4))
+        ]
+        domains = domains_from_instance_types(its, templates)
+        topo = Topology(domains, batch_pods=pods, cluster_pods=[])
+        for node in nodes:
+            topo.register(wk.LABEL_HOSTNAME, node.name)
+        encoded = Encoder(FAKE_WELL_KNOWN_LABELS).encode(
+            pods, its, templates, nodes, topology=topo, num_claim_slots=256,
+            vocab_pods=pods,
+        )
+        problem = pad_problem(encoded.problem)
+        rm = np.asarray(problem.run_mode)
+        r_pp = solve_ffd(problem, 256)
+        r_rc = solve_ffd_runs(problem, 256)
+        P = len(encoded.meta.pod_order)
+        k1, i1 = np.asarray(r_pp.kind)[:P], np.asarray(r_pp.index)[:P]
+        k2, i2 = np.asarray(r_rc.kind)[:P], np.asarray(r_rc.index)[:P]
+        bad = [
+            (r, (int(k1[r]), int(i1[r])), (int(k2[r]), int(i2[r])))
+            for r in range(P)
+            if (k1[r], i1[r]) != (k2[r], i2[r])
+        ]
+        assert not bad, f"seed {seed}: {len(bad)} diverging rows, first: {bad[:5]}"
+        # the differential only means something if compression actually ran
+        assert (rm == RUN_ANALYTIC).any() or (rm == RUN_TOPO).any()
+
+
+class TestBenchSmallBatchFraction:
+    def test_10_pod_diverse_mix_schedules_8(self):
+        """Pins BENCH's pods=10 row at scheduled=8: with rng seed 42 the two
+        required-pod-affinity pods draw selectors (my-affininity in {d, b})
+        that match no pod in the batch — not even their own labels (e, a) —
+        so they are legitimately unschedulable. The reference benchmark has
+        the same behavior: makePodAffinityPods draws selector and own labels
+        independently (scheduling_benchmark_test.go:199-218) and Solve only
+        reports, never asserts, round-1 scheduled counts
+        (scheduling_benchmark_test.go:139-167)."""
+        from bench import make_diverse_pods
+
+        rng = random.Random(42)
+        its = instance_types(400)
+        from karpenter_tpu.apis.nodepool import NodePool
+        from karpenter_tpu.apis.objects import ObjectMeta
+        from karpenter_tpu.solver.encode import template_from_nodepool
+        from karpenter_tpu.solver.oracle import OracleSolver
+
+        tpl = template_from_nodepool(
+            NodePool(metadata=ObjectMeta(name="default")), its, range(len(its))
+        )
+        pods = make_diverse_pods(10, rng)
+        result = OracleSolver().solve(pods, its, [tpl])
+        assert set(result.failures) == {3, 4}
+        assert result.num_scheduled() == 8
+        # the failures are the affinity pods whose selector matches nobody
+        for i in (3, 4):
+            sel = pods[i].spec.affinity.pod_affinity.required[0].label_selector
+            assert not any(
+                all(p.metadata.labels.get(k) == v for k, v in sel.match_labels.items())
+                for p in pods
+            )
