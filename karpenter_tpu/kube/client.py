@@ -232,6 +232,10 @@ class KubeClient:
                     self._emit(kind, MODIFIED, stored)
             else:
                 del coll[k]
+                # a delete is a write: the DELETED event carries a fresh
+                # resource_version, as the apiserver's etcd revision would
+                self._rv += 1
+                stored.metadata.resource_version = self._rv
                 self._emit(kind, DELETED, stored)
 
     def delete_opt(self, obj_or_kind, name: str = None, namespace: str = "default"):

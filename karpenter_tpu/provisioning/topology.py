@@ -36,6 +36,7 @@ from karpenter_tpu.scheduling import (
     Requirements,
     label_requirements,
 )
+from karpenter_tpu.utils import pod as podutil
 
 TOPOLOGY_TYPE_SPREAD = 0
 TOPOLOGY_TYPE_POD_AFFINITY = 1
@@ -361,11 +362,23 @@ class Topology:
 
     def _count_domains(self, tg: TopologyGroup) -> None:
         """Seed counts from pods already running in the cluster
-        (topology.go:238-291)."""
+        (topology.go:238-291). Census semantics differ from ``selects``: a
+        nil selector lists everything (TopologyListOptions, topology.go:381-
+        384, labels.Everything()), while selects() treats nil as Nothing —
+        both quirks are the reference's own. Unscheduled, terminal, and
+        terminating pods are ignored (IgnoredForTopology, topology.go:419-421)
+        even when a caller hands census pods straight to the solver without
+        the provisioner's pre-filtering."""
         for pod, node_labels in self.cluster_pods:
             if pod.namespace not in tg.namespaces:
                 continue
-            if tg.selector is None or not tg.selector.matches(pod.metadata.labels):
+            if tg.selector is not None and not tg.selector.matches(pod.metadata.labels):
+                continue
+            if (
+                not pod.spec.node_name
+                or podutil.is_terminal(pod)
+                or podutil.is_terminating(pod)
+            ):
                 continue
             domain = node_labels.get(tg.key)
             if domain is None:

@@ -124,12 +124,20 @@ def requests_for_pods(*pods) -> ResourceList:
     return out
 
 
+def container_effective_requests(container) -> ResourceList:
+    """A container's requests with limits defaulted in for resources that
+    declare a limit but no request (reference:
+    resources.MergeResourceLimitsIntoRequests, resources.go:128-135)."""
+    return {**(container.limits or {}), **(container.requests or {})}
+
+
 def pod_requests(pod) -> ResourceList:
     """Effective requests of one pod per the k8s resource model: the elementwise
     max of the summed app-container requests and each init container's requests,
-    plus pod overhead."""
-    app = merge(*(c.requests for c in pod.spec.containers))
-    inits = [c.requests for c in pod.spec.init_containers]
+    with per-container limits-into-requests defaulting, plus pod overhead
+    (reference: resources.Ceiling, resources.go:99-113)."""
+    app = merge(*(container_effective_requests(c) for c in pod.spec.containers))
+    inits = [container_effective_requests(c) for c in pod.spec.init_containers]
     out = max_resources(app, *inits)
     if pod.spec.overhead:
         out = merge(out, pod.spec.overhead)

@@ -246,18 +246,29 @@ def make_daemonset(
     namespace: str = "default",
     cpu: float = 0.0,
     memory: float = 0.0,
+    requests: Optional[Dict[str, float]] = None,
+    limits: Optional[Dict[str, float]] = None,
+    init_requests: Optional[Dict[str, float]] = None,
+    init_limits: Optional[Dict[str, float]] = None,
     node_selector: Optional[Dict[str, str]] = None,
     tolerations: Sequence[Toleration] = (),
 ) -> DaemonSet:
-    reqs = {}
+    reqs = dict(requests or {})
+    # the legacy cpu=/memory= shorthands never override an explicit requests=
     if cpu:
-        reqs["cpu"] = cpu
+        reqs.setdefault("cpu", cpu)
     if memory:
-        reqs["memory"] = memory
+        reqs.setdefault("memory", memory)
+    init_containers = []
+    if init_requests is not None or init_limits is not None:
+        init_containers.append(
+            Container(requests=dict(init_requests or {}), limits=dict(init_limits or {}))
+        )
     return DaemonSet(
         metadata=ObjectMeta(name=_name("daemonset", name), namespace=namespace),
         pod_template_spec=PodSpec(
-            containers=[Container(requests=reqs)],
+            containers=[Container(requests=reqs, limits=dict(limits or {}))],
+            init_containers=init_containers,
             node_selector=dict(node_selector or {}),
             tolerations=list(tolerations),
         ),

@@ -1,0 +1,157 @@
+"""Daemonset / node-overhead edge cases.
+
+Behavioral ports of the reference's "Daemonsets and Node Overhead" block
+(pkg/controllers/provisioning/suite_test.go:428-620): requests-vs-limits
+defaulting (resources.MergeResourceLimitsIntoRequests, resources.go:128-135),
+init-container ceilings (resources.Ceiling, resources.go:99-113), startup
+taints not gating overhead (getDaemonOverhead uses only spec.taints,
+scheduler.go:324-341), and toleration filtering.
+"""
+
+from karpenter_tpu.apis.objects import Taint, Toleration
+from karpenter_tpu.cloudprovider.fake import GI
+from karpenter_tpu.utils import resources as res
+
+from tests.factories import make_daemonset, make_nodepool, make_pod
+from tests.harness import Env
+
+
+def one_claim(env):
+    claims = env.nodeclaims()
+    assert len(claims) == 1
+    return claims[0]
+
+
+def test_overhead_accounted():
+    # suite_test.go:429-446 — pod 1cpu/1Gi + daemonset 1cpu/1Gi reserve both
+    env = Env()
+    env.create(make_nodepool())
+    env.create(make_daemonset(cpu=1.0, memory=1 * GI))
+    pod = make_pod(cpu=1.0, memory=1 * GI)
+    env.expect_provisioned(pod)
+    env.expect_scheduled(pod)
+    claim = one_claim(env)
+    assert claim.spec.resource_requests["cpu"] >= 2.0
+    assert claim.spec.resource_requests["memory"] >= 2 * GI
+
+
+def test_overhead_accounted_with_startup_taint():
+    # suite_test.go:447-473 — startup taints do NOT filter daemonsets out of
+    # the overhead (only spec.taints do, scheduler.go:324-341)
+    env = Env()
+    env.create(
+        make_nodepool(startup_taints=[Taint(key="foo.com/taint", effect="NoSchedule")])
+    )
+    env.create(make_daemonset(cpu=1.0, memory=1 * GI))
+    pod = make_pod(cpu=1.0, memory=1 * GI)
+    env.expect_provisioned(pod)
+    env.expect_scheduled(pod)
+    claim = one_claim(env)
+    assert claim.spec.resource_requests["cpu"] >= 2.0
+
+
+def test_overhead_too_large_blocks_scheduling():
+    # suite_test.go:474-484
+    env = Env()
+    env.create(make_nodepool())
+    env.create(make_daemonset(cpu=10000.0, memory=10000 * GI))
+    pod = make_pod(cpu=0.1)
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+    assert env.nodeclaims() == []
+
+
+def test_limits_default_into_requests():
+    # suite_test.go:523-536 — a daemonset declaring only limits for memory
+    # gets that limit as its effective memory request
+    env = Env()
+    env.create(make_nodepool())
+    env.create(
+        make_daemonset(
+            requests={"cpu": 1.0},
+            limits={"cpu": 10000.0, "memory": 10000 * GI},
+        )
+    )
+    pod = make_pod(cpu=0.1)
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_max_of_containers_and_init_containers():
+    # suite_test.go:537-561 — effective daemonset request is
+    # max(app ceiling, init ceiling) = max((2cpu,1Gi), (1cpu,2Gi)) = (2cpu,2Gi)
+    env = Env()
+    env.create(make_nodepool())
+    env.create(
+        make_daemonset(
+            requests={"cpu": 2.0},
+            limits={"cpu": 2.0, "memory": 1 * GI},
+            init_requests={"cpu": 1.0},
+            init_limits={"cpu": 10000.0, "memory": 2 * GI},
+        )
+    )
+    pod = make_pod(cpu=1.0)
+    env.expect_provisioned(pod)
+    env.expect_scheduled(pod)
+    claim = one_claim(env)
+    assert claim.spec.resource_requests["cpu"] >= 3.0
+    assert claim.spec.resource_requests["memory"] >= 2 * GI
+
+
+def test_combined_max_too_large_blocks_scheduling():
+    # suite_test.go:562-581 — the init container's limit-defaulted memory
+    # dominates the ceiling and nothing fits
+    env = Env()
+    env.create(make_nodepool())
+    env.create(
+        make_daemonset(
+            requests={"cpu": 1.0},
+            limits={"cpu": 10000.0, "memory": 1 * GI},
+            init_requests={"cpu": 1.0},
+            init_limits={"cpu": 10000.0, "memory": 10000 * GI},
+        )
+    )
+    pod = make_pod(cpu=0.1)
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_init_container_requests_too_large_blocks_scheduling():
+    # suite_test.go:582-594
+    env = Env()
+    env.create(make_nodepool())
+    env.create(make_daemonset(init_requests={"cpu": 10000.0, "memory": 10000 * GI}))
+    pod = make_pod(cpu=0.1)
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_no_requests_or_limits_schedules():
+    # suite_test.go:595-602
+    env = Env()
+    env.create(make_nodepool())
+    env.create(make_daemonset())
+    pod = make_pod(cpu=0.1)
+    env.expect_provisioned(pod)
+    env.expect_scheduled(pod)
+
+
+def test_ignores_daemonset_without_matching_toleration():
+    # suite_test.go:603-620 — tainted pool: a daemonset that doesn't tolerate
+    # the taint never lands, so its requests are not overhead
+    env = Env()
+    env.create(make_nodepool(taints=[Taint(key="foo", value="bar", effect="NoSchedule")]))
+    env.create(make_daemonset(cpu=1.0, memory=1 * GI))
+    pod = make_pod(cpu=1.0, tolerations=[Toleration(operator="Exists")])
+    env.expect_provisioned(pod)
+    env.expect_scheduled(pod)
+    claim = one_claim(env)
+    assert claim.spec.resource_requests["cpu"] < 2.0
+
+
+def test_container_effective_requests_unit():
+    # resources.go:128-135 — request wins where both exist; limits fill gaps
+    from karpenter_tpu.apis.objects import Container
+
+    c = Container(requests={"cpu": 1.0}, limits={"cpu": 4.0, "memory": 2.0})
+    assert res.container_effective_requests(c) == {"cpu": 1.0, "memory": 2.0}

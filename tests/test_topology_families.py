@@ -1,0 +1,543 @@
+"""Reference topology/scheduling test families the round-2 suite lacked.
+
+Direct ports (behavioral, not textual) of the named blocks from
+pkg/controllers/provisioning/scheduling/topology_test.go:
+  - CapacityType spread (:637-800): balance, NodePool constraints,
+    DoNotSchedule vs ScheduleAnyway skew, census filtering, no-selector,
+    interdependent selectors
+  - Combined Topology and Node Affinity (:1196-1313): nodeSelector /
+    node requirements / required affinity limiting spread domains;
+    preferred affinity NOT limiting them
+  - MinDomains (:467-530): unsatisfied forces min=0, satisfied-equal and
+    satisfied-greater allow expected scheduling
+  - arch spread (:880) via a mixed-arch catalog
+  - spread x taints: a tainted pool's zone still sits in the domain
+    universe (domainMinCount has no taint gate, topologygroup.go:193-215)
+
+Every case runs oracle AND jax solver and asserts pod-for-pod parity via
+run_both, then pins the reference's expected skew/failure counts.
+"""
+
+import collections
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.objects import (
+    Affinity,
+    Container,
+    DO_NOT_SCHEDULE,
+    IN,
+    LabelSelector,
+    NodeAffinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    NOT_IN,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    PreferredSchedulingTerm,
+    SCHEDULE_ANYWAY,
+    Taint,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.cloudprovider.fake import (
+    FAKE_WELL_KNOWN_LABELS,
+    GI,
+    instance_types,
+    make_instance_type,
+)
+from karpenter_tpu.scheduling import Requirements, Taints
+from karpenter_tpu.solver.encode import NodeInfo
+from karpenter_tpu.solver.jax_backend import JaxSolver
+from karpenter_tpu.solver.oracle import OracleSolver
+from karpenter_tpu.utils import resources as res
+from tests.test_solver_parity import assert_same, simple_template
+
+LABELS = {"test": "test"}
+ZONES = ("test-zone-1", "test-zone-2", "test-zone-3")
+
+
+def spread(key, max_skew=1, when=DO_NOT_SCHEDULE, selector=LABELS, min_domains=None):
+    return TopologySpreadConstraint(
+        max_skew=max_skew,
+        topology_key=key,
+        when_unsatisfiable=when,
+        label_selector=(
+            LabelSelector(match_labels=selector) if selector is not None else None
+        ),
+        min_domains=min_domains,
+    )
+
+
+def pod(i, labels=LABELS, constraints=(), selector=None, requirements=None,
+        preferences=None, cpu=0.1, tolerations=()):
+    affinity = None
+    if requirements or preferences:
+        affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required=(
+                    [NodeSelectorTerm([NodeSelectorRequirement(*r) for r in requirements])]
+                    if requirements
+                    else []
+                ),
+                preferred=(
+                    [
+                        PreferredSchedulingTerm(
+                            weight=1,
+                            preference=NodeSelectorTerm(
+                                [NodeSelectorRequirement(*r) for r in preferences]
+                            ),
+                        )
+                    ]
+                    if preferences
+                    else []
+                ),
+            )
+        )
+    return Pod(
+        metadata=ObjectMeta(name=f"p{i}", labels=dict(labels)),
+        spec=PodSpec(
+            containers=[Container(requests={"cpu": cpu})],
+            topology_spread_constraints=list(constraints),
+            node_selector=dict(selector or {}),
+            affinity=affinity,
+            tolerations=list(tolerations),
+        ),
+    )
+
+
+def run_both(pods, its, templates, nodes=(), cluster_pods=()):
+    o = OracleSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(
+        pods, its, templates, nodes, cluster_pods=cluster_pods
+    )
+    j = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(
+        pods, its, templates, nodes, cluster_pods=cluster_pods
+    )
+    assert_same(o, j)
+    return o
+
+
+def skew(result, key, nodes=()):
+    """Pods per pinned domain of ``key`` across new claims and existing-node
+    placements — the ExpectSkew equivalent (expectations.go:479)."""
+    node_domain = {}
+    for n in nodes:
+        r = n.requirements.get(key)
+        if r is not None and not r.complement and len(r.values) == 1:
+            node_domain[n.name] = next(iter(sorted(r.values)))
+    counts = collections.Counter()
+    for c in result.new_claims:
+        r = c.requirements.get(key)
+        assert r is not None and not r.complement, f"{key} not narrowed on claim"
+        vals = sorted(r.values)
+        assert len(vals) == 1, f"{key} not pinned: {vals}"
+        counts[vals[0]] += len(c.pod_indices)
+    for node_name, pods_on in result.node_pods.items():
+        counts[node_domain[node_name]] += len(pods_on)
+    return sorted(counts.values())
+
+
+class TestCapacityTypeSpread:
+    """topology_test.go:637-800 Context("CapacityType")."""
+
+    def test_balance_across_capacity_types(self):
+        its = instance_types(4)
+        pods = [pod(i, constraints=[spread(wk.CAPACITY_TYPE_LABEL_KEY)]) for i in range(4)]
+        o = run_both(pods, its, [simple_template(its)])
+        assert not o.failures
+        assert skew(o, wk.CAPACITY_TYPE_LABEL_KEY) == [2, 2]
+
+    def test_nodepool_capacity_type_constraint_respected(self):
+        its = instance_types(4)
+        tpl = simple_template(
+            its,
+            requirements=[
+                NodeSelectorRequirement(
+                    wk.CAPACITY_TYPE_LABEL_KEY, IN,
+                    [wk.CAPACITY_TYPE_SPOT, wk.CAPACITY_TYPE_ON_DEMAND],
+                )
+            ],
+        )
+        pods = [pod(i, constraints=[spread(wk.CAPACITY_TYPE_LABEL_KEY)]) for i in range(4)]
+        o = run_both(pods, its, [tpl])
+        assert not o.failures
+        assert skew(o, wk.CAPACITY_TYPE_LABEL_KEY) == [2, 2]
+
+    def _spot_node_with_pod(self):
+        """An existing spot node carrying one selected pod (census seed), too
+        full to take more pods — the topology_test.go:666 setup where the
+        first provisioning round pinned one pod onto spot."""
+        node = NodeInfo(
+            name="spot-node",
+            requirements=Requirements.from_labels(
+                {
+                    wk.LABEL_HOSTNAME: "spot-node",
+                    wk.LABEL_TOPOLOGY_ZONE: "test-zone-1",
+                    wk.CAPACITY_TYPE_LABEL_KEY: wk.CAPACITY_TYPE_SPOT,
+                }
+            ),
+            taints=Taints([]),
+            available={res.CPU: 0.0, res.MEMORY: 0.0, res.PODS: 0.0},
+            daemon_overhead={},
+        )
+        bound = pod("bound")
+        bound.spec.node_name = "spot-node"
+        cluster_pods = [(bound, {
+            wk.CAPACITY_TYPE_LABEL_KEY: wk.CAPACITY_TYPE_SPOT,
+            wk.LABEL_HOSTNAME: "spot-node",
+        })]
+        return node, cluster_pods
+
+    def test_do_not_schedule_respects_skew_across_rounds(self):
+        # spot already has 1 selected pod; the pool now only allows on-demand.
+        # maxSkew 1 lets on-demand reach 2 pods; the rest must fail
+        # (topology_test.go:666-700)
+        its = instance_types(4)
+        node, cluster_pods = self._spot_node_with_pod()
+        tpl = simple_template(
+            its,
+            requirements=[
+                NodeSelectorRequirement(
+                    wk.CAPACITY_TYPE_LABEL_KEY, IN, [wk.CAPACITY_TYPE_ON_DEMAND]
+                )
+            ],
+        )
+        pods = [pod(i, constraints=[spread(wk.CAPACITY_TYPE_LABEL_KEY)]) for i in range(5)]
+        o = run_both(pods, its, [tpl], nodes=[node], cluster_pods=cluster_pods)
+        assert len(o.failures) == 3
+        assert skew(o, wk.CAPACITY_TYPE_LABEL_KEY) == [2]
+
+    def test_schedule_anyway_violates_skew(self):
+        # same shape but ScheduleAnyway: all five pods land on on-demand
+        # (topology_test.go:701-731)
+        its = instance_types(4)
+        node, cluster_pods = self._spot_node_with_pod()
+        tpl = simple_template(
+            its,
+            requirements=[
+                NodeSelectorRequirement(
+                    wk.CAPACITY_TYPE_LABEL_KEY, IN, [wk.CAPACITY_TYPE_ON_DEMAND]
+                )
+            ],
+        )
+        pods = [
+            pod(i, constraints=[spread(wk.CAPACITY_TYPE_LABEL_KEY, when=SCHEDULE_ANYWAY)])
+            for i in range(5)
+        ]
+        o = run_both(pods, its, [tpl], nodes=[node], cluster_pods=cluster_pods)
+        assert not o.failures
+        assert skew(o, wk.CAPACITY_TYPE_LABEL_KEY) == [5]
+
+    def test_census_ignores_unmatching_cluster_pods(self):
+        # only running pods with matching labels scheduled to nodes with the
+        # domain label count (topology_test.go:732-764, IgnoredForTopology
+        # topology.go:419-421): the census below seeds spot=2, on-demand=1.
+        # Four new pods land od, spot, od, spot ([2,2] batch skew; skew ties
+        # break by lane order where the reference's Go map order is random) —
+        # if any of the seven ignored pods were wrongly counted into spot,
+        # the min-count would track on-demand and all four would stack there
+        # ([4])
+        its = instance_types(4)
+        spot_labels = {wk.CAPACITY_TYPE_LABEL_KEY: wk.CAPACITY_TYPE_SPOT}
+        od_labels = {wk.CAPACITY_TYPE_LABEL_KEY: wk.CAPACITY_TYPE_ON_DEMAND}
+
+        def scheduled(p):
+            p.spec.node_name = "census-node"
+            return p
+
+        wrong_ns = scheduled(pod("wrong-ns"))
+        wrong_ns.metadata.namespace = "other"
+        terminating = scheduled(pod("terminating"))
+        terminating.metadata.deletion_timestamp = 1.0
+        failed = scheduled(pod("failed"))
+        failed.status.phase = "Failed"
+        succeeded = scheduled(pod("succeeded"))
+        succeeded.status.phase = "Succeeded"
+        cluster_pods = [
+            (scheduled(pod("unlabeled", labels={})), spot_labels),  # no matching labels
+            (scheduled(pod("no-domain")), {}),           # node lacks the domain
+            (pod("pending"), spot_labels),               # unscheduled (pending)
+            (wrong_ns, spot_labels),                     # wrong namespace
+            (terminating, spot_labels),                  # terminating
+            (failed, spot_labels),                       # phase Failed
+            (succeeded, spot_labels),                    # phase Succeeded
+            (scheduled(pod("s1")), spot_labels),
+            (scheduled(pod("s2")), spot_labels),
+            (scheduled(pod("o1")), od_labels),
+        ]
+        pods = [pod(i, constraints=[spread(wk.CAPACITY_TYPE_LABEL_KEY)]) for i in range(4)]
+        o = run_both(pods, its, [simple_template(its)], cluster_pods=cluster_pods)
+        assert not o.failures
+        assert skew(o, wk.CAPACITY_TYPE_LABEL_KEY) == [2, 2]
+
+    def test_no_label_selector_selects_all(self):
+        # labelSelector omitted: the constraint still applies and counts the
+        # owning pod itself (topology_test.go:765-776)
+        its = instance_types(4)
+        p = pod(0, constraints=[spread(wk.CAPACITY_TYPE_LABEL_KEY, selector=None)])
+        o = run_both([p], its, [simple_template(its)])
+        assert not o.failures
+        assert skew(o, wk.CAPACITY_TYPE_LABEL_KEY) == [1]
+
+    def test_interdependent_selectors_pack_together(self):
+        # hostname spread whose selector matches none of the spread pods:
+        # skew never increases, so all five pack onto one claim
+        # (topology_test.go:777-799)
+        its = instance_types(4)
+        pods = [
+            pod(i, labels={}, constraints=[spread(wk.LABEL_HOSTNAME)])
+            for i in range(5)
+        ]
+        o = run_both(pods, its, [simple_template(its)])
+        assert not o.failures
+        assert len(o.new_claims) == 1
+
+
+class TestArchSpread:
+    def test_balance_across_arch(self):
+        # topology_test.go:880 — mixed-arch catalog, spread over arch
+        its = [
+            make_instance_type("amd-1", architecture="amd64"),
+            make_instance_type("arm-1", architecture="arm64"),
+        ]
+        pods = [pod(i, constraints=[spread(wk.LABEL_ARCH_STABLE)]) for i in range(4)]
+        o = run_both(pods, its, [simple_template(its)])
+        assert not o.failures
+        assert skew(o, wk.LABEL_ARCH_STABLE) == [2, 2]
+
+
+class TestSpreadNodeAffinityInteraction:
+    """topology_test.go:1196-1313 Context("Combined Topology and Node
+    Affinity") — nodeSelector / requirements limit a pod's spread domains;
+    preferred affinity does not."""
+
+    def test_node_selector_limits_domains(self):
+        its = instance_types(4)
+        zc = spread(wk.LABEL_TOPOLOGY_ZONE)
+        pods = [
+            pod(i, constraints=[zc], selector={wk.LABEL_TOPOLOGY_ZONE: "test-zone-1"})
+            for i in range(5)
+        ] + [
+            pod(5 + i, constraints=[zc], selector={wk.LABEL_TOPOLOGY_ZONE: "test-zone-2"})
+            for i in range(10)
+        ]
+        o = run_both(pods, its, [simple_template(its)])
+        assert not o.failures
+        assert skew(o, wk.LABEL_TOPOLOGY_ZONE) == [5, 10]
+
+    def test_node_requirements_limit_domains(self):
+        its = instance_types(4)
+        pods = [
+            pod(
+                i,
+                constraints=[spread(wk.LABEL_TOPOLOGY_ZONE)],
+                requirements=[
+                    (wk.LABEL_TOPOLOGY_ZONE, IN, ["test-zone-1", "test-zone-2"])
+                ],
+            )
+            for i in range(10)
+        ]
+        o = run_both(pods, its, [simple_template(its)])
+        assert not o.failures
+        assert skew(o, wk.LABEL_TOPOLOGY_ZONE) == [5, 5]
+
+    def test_required_affinity_then_open(self):
+        # 6 pods limited to two zones spread [3,3]; a 7th allowed into the
+        # empty third zone takes it (improves skew); 5 unconstrained pods
+        # level everything to [4,4,4] (topology_test.go:1244-1287)
+        its = instance_types(4)
+        zc = spread(wk.LABEL_TOPOLOGY_ZONE)
+        pods = (
+            [
+                pod(i, constraints=[zc],
+                    requirements=[(wk.LABEL_TOPOLOGY_ZONE, IN, ["test-zone-1", "test-zone-2"])])
+                for i in range(6)
+            ]
+            + [
+                pod(6, constraints=[zc],
+                    requirements=[(wk.LABEL_TOPOLOGY_ZONE, IN, ["test-zone-2", "test-zone-3"])])
+            ]
+            + [pod(7 + i, constraints=[zc]) for i in range(5)]
+        )
+        o = run_both(pods, its, [simple_template(its)])
+        assert not o.failures
+        assert skew(o, wk.LABEL_TOPOLOGY_ZONE) == [4, 4, 4]
+
+    def test_preferred_affinity_does_not_limit(self):
+        its = instance_types(4)
+        pods = [
+            pod(
+                i,
+                constraints=[spread(wk.LABEL_TOPOLOGY_ZONE)],
+                preferences=[
+                    (wk.LABEL_TOPOLOGY_ZONE, IN, ["test-zone-1", "test-zone-2"])
+                ],
+            )
+            for i in range(6)
+        ]
+        o = run_both(pods, its, [simple_template(its)])
+        assert not o.failures
+        assert skew(o, wk.LABEL_TOPOLOGY_ZONE) == [2, 2, 2]
+
+    def test_capacity_type_node_selector_limits_domains(self):
+        # topology_test.go:1313-1336 — ScheduleAnyway spread over capacity
+        # type with each half pinned by nodeSelector
+        its = instance_types(4)
+        ct = spread(wk.CAPACITY_TYPE_LABEL_KEY, when=SCHEDULE_ANYWAY)
+        pods = [
+            pod(i, constraints=[ct],
+                selector={wk.CAPACITY_TYPE_LABEL_KEY: wk.CAPACITY_TYPE_SPOT})
+            for i in range(5)
+        ] + [
+            pod(5 + i, constraints=[ct],
+                selector={wk.CAPACITY_TYPE_LABEL_KEY: wk.CAPACITY_TYPE_ON_DEMAND})
+            for i in range(5)
+        ]
+        o = run_both(pods, its, [simple_template(its)])
+        assert not o.failures
+        assert skew(o, wk.CAPACITY_TYPE_LABEL_KEY) == [5, 5]
+
+    def test_capacity_type_required_affinity_staged(self):
+        # topology_test.go:1337-1380 — 3 pods pinned to spot stack to [3]
+        # (on-demand unreachable keeps it out of the min); a 4th allowed both
+        # takes the empty on-demand; 5 unconstrained level to [5,4]
+        its = instance_types(4)
+        ct = spread(wk.CAPACITY_TYPE_LABEL_KEY)
+        pods = (
+            [
+                pod(i, constraints=[ct],
+                    requirements=[(wk.CAPACITY_TYPE_LABEL_KEY, IN, [wk.CAPACITY_TYPE_SPOT])])
+                for i in range(3)
+            ]
+            + [
+                pod(3, constraints=[ct],
+                    requirements=[(wk.CAPACITY_TYPE_LABEL_KEY, IN,
+                                   [wk.CAPACITY_TYPE_ON_DEMAND, wk.CAPACITY_TYPE_SPOT])])
+            ]
+            + [pod(4 + i, constraints=[ct]) for i in range(5)]
+        )
+        o = run_both(pods, its, [simple_template(its)])
+        assert not o.failures
+        assert skew(o, wk.CAPACITY_TYPE_LABEL_KEY) == [4, 5]
+
+
+class TestMinDomainsFamilies:
+    """topology_test.go:467-530."""
+
+    def test_unsatisfiable_min_domains_forces_min_zero(self):
+        # pool restricted to 2 zones but minDomains=3: min stays 0, so with
+        # maxSkew 1 only one pod per zone schedules ([1,1], third fails)
+        its = instance_types(4)
+        tpl = simple_template(
+            its,
+            requirements=[
+                NodeSelectorRequirement(
+                    wk.LABEL_TOPOLOGY_ZONE, IN, ["test-zone-1", "test-zone-2"]
+                )
+            ],
+        )
+        pods = [
+            pod(i, constraints=[spread(wk.LABEL_TOPOLOGY_ZONE, min_domains=3)])
+            for i in range(3)
+        ]
+        o = run_both(pods, its, [tpl])
+        assert len(o.failures) == 1
+        assert skew(o, wk.LABEL_TOPOLOGY_ZONE) == [1, 1]
+
+    @pytest.mark.parametrize("min_domains", [3, 2])
+    def test_satisfied_min_domains_allows_expected_scheduling(self, min_domains):
+        # satisfied (equal or below the domain count): normal maxSkew
+        # balancing, 11 pods over 3 zones -> [4,4,3]
+        its = instance_types(4)
+        tpl = simple_template(
+            its,
+            requirements=[
+                NodeSelectorRequirement(wk.LABEL_TOPOLOGY_ZONE, IN, list(ZONES))
+            ],
+        )
+        pods = [
+            pod(i, constraints=[spread(wk.LABEL_TOPOLOGY_ZONE, min_domains=min_domains)])
+            for i in range(11)
+        ]
+        o = run_both(pods, its, [tpl])
+        assert not o.failures
+        assert skew(o, wk.LABEL_TOPOLOGY_ZONE) == [3, 4, 4]
+
+
+class TestSpreadTaintAndNotInInteraction:
+    """The families VERDICT r2 called out as untested: NotIn-zone spreads and
+    spreads whose domain universe includes a tainted pool's zone."""
+
+    def test_not_in_zone_limits_spread_domains(self):
+        its = instance_types(4)
+        pods = [
+            pod(
+                i,
+                constraints=[spread(wk.LABEL_TOPOLOGY_ZONE)],
+                requirements=[(wk.LABEL_TOPOLOGY_ZONE, NOT_IN, ["test-zone-3"])],
+            )
+            for i in range(6)
+        ]
+        o = run_both(pods, its, [simple_template(its)])
+        assert not o.failures
+        assert skew(o, wk.LABEL_TOPOLOGY_ZONE) == [3, 3]
+
+    def test_tainted_pool_zone_still_counts_in_min(self):
+        # pool B exclusively offers zone-3 behind a taint the pods don't
+        # tolerate. zone-3 still enters the domain universe and podDomains
+        # (taints are bin-level, not requirement-level: domainMinCount,
+        # topologygroup.go:193-215), so min sticks at 0 and only one pod per
+        # reachable zone schedules
+        its = instance_types(4)
+        tpl_a = simple_template(
+            its, name="a",
+            requirements=[
+                NodeSelectorRequirement(
+                    wk.LABEL_TOPOLOGY_ZONE, IN, ["test-zone-1", "test-zone-2"]
+                )
+            ],
+        )
+        tpl_b = simple_template(
+            its, name="b",
+            taints=[Taint(key="team", value="x", effect="NoSchedule")],
+            requirements=[
+                NodeSelectorRequirement(wk.LABEL_TOPOLOGY_ZONE, IN, ["test-zone-3"])
+            ],
+        )
+        pods = [pod(i, constraints=[spread(wk.LABEL_TOPOLOGY_ZONE)]) for i in range(6)]
+        o = run_both(pods, its, [tpl_a, tpl_b])
+        assert len(o.failures) == 4
+        assert skew(o, wk.LABEL_TOPOLOGY_ZONE) == [1, 1]
+
+    def test_tolerating_pods_reach_the_tainted_zone(self):
+        # the same universe with tolerating pods balances all three zones
+        from karpenter_tpu.apis.objects import Toleration
+
+        its = instance_types(4)
+        tpl_a = simple_template(
+            its, name="a",
+            requirements=[
+                NodeSelectorRequirement(
+                    wk.LABEL_TOPOLOGY_ZONE, IN, ["test-zone-1", "test-zone-2"]
+                )
+            ],
+        )
+        tpl_b = simple_template(
+            its, name="b",
+            taints=[Taint(key="team", value="x", effect="NoSchedule")],
+            requirements=[
+                NodeSelectorRequirement(wk.LABEL_TOPOLOGY_ZONE, IN, ["test-zone-3"])
+            ],
+        )
+        pods = [
+            pod(
+                i,
+                constraints=[spread(wk.LABEL_TOPOLOGY_ZONE)],
+                tolerations=[Toleration(key="team", operator="Equal", value="x")],
+            )
+            for i in range(6)
+        ]
+        o = run_both(pods, its, [tpl_a, tpl_b])
+        assert not o.failures
+        assert skew(o, wk.LABEL_TOPOLOGY_ZONE) == [2, 2, 2]
