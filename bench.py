@@ -205,6 +205,7 @@ def run_child():
                     "solve_s": round(solve_s, 4),
                     "compile_s": round(max(warm_s - solve_s, 0.0), 2),
                     "consolidatable": stats.get("consolidatable", -1),
+                    "mesh_devices": stats.get("mesh_devices", 1),
                 }
             )
     except ImportError:

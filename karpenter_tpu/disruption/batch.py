@@ -532,10 +532,18 @@ def bench_candidate_scoring(n_candidates: int = 100, mesh="auto") -> Dict[str, i
     )
     scorer = UnionScorer(inputs, candidates)
     subsets = [list(range(k + 1)) for k in range(n_candidates)]
+    if mesh == "auto":
+        mesh = default_mesh()
     verdicts = scorer.score_subsets(subsets, mesh=mesh)
     consolidatable = sum(
         1
         for v, s in zip(verdicts, subsets)
         if v.consolidatable_with([candidates[i] for i in s], its)
     )
-    return {"candidates": n_candidates, "consolidatable": consolidatable}
+    return {
+        "candidates": n_candidates,
+        "consolidatable": consolidatable,
+        # the subset axis shards across this mesh when devices > 1
+        # (parallel/mesh.py batched_screen); 1x means vmap on a single device
+        "mesh_devices": 1 if mesh is None else int(mesh.devices.size),
+    }

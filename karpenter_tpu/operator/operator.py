@@ -208,6 +208,10 @@ class Operator:
             self._servers.append(serving.serve(self.options.health_probe_port))
         if self.options.enable_profiling:
             serving.start_profiler()
+        if self.options.solver_backend == "jax":
+            from karpenter_tpu.solver.warmup import maybe_prewarm_in_background
+
+            maybe_prewarm_in_background(self.options)
 
         def loop(name, reconcile, period):
             while not self._stop.is_set():

@@ -46,6 +46,10 @@ class Options:
     log_level: str = "info"
     # solver backend for the scheduling cores: "jax" or "oracle"
     solver_backend: str = "jax"
+    # pre-compile the standard solver shape buckets at startup (TPU only,
+    # where the persistent compile cache makes the warm outlive the process;
+    # solver/warmup.py)
+    prewarm_solver: bool = True
 
     def drift_enabled(self) -> bool:
         return self.feature_gates.get("Drift", True)

@@ -240,35 +240,48 @@ def _mix_req_rows(cur: ReqTensor, upd: ReqTensor, hot) -> ReqTensor:
     )
 
 
+def _mint_host_onehot(problem: SchedulingProblem, free_slot):
+    """One-hot of the hostname lane minted for the prospective slot
+    (nodeclaim.go:46-63); all-False when the encoder allotted no lanes."""
+    V = problem.num_lanes
+    if problem.claim_hostname_lane.shape[0] == 0:
+        return jnp.zeros((V,), dtype=bool)
+    host_lane = problem.claim_hostname_lane[
+        jnp.minimum(free_slot, problem.claim_hostname_lane.shape[0] - 1)
+    ]
+    return jnp.arange(V) == host_lane
+
+
+def _pin_hostname(row: ReqTensor, host_onehot) -> ReqTensor:
+    """Pin requirement row(s) ([K, V] or [E, K, V]) to the minted hostname:
+    admitted lanes collapse to the mint, the key becomes a defined concrete
+    set. Shared by the per-pod step's template rows and the run commit so the
+    pin semantics can never diverge between them."""
+    return ReqTensor(
+        admitted=row.admitted.at[..., HOSTNAME_KEY, :].set(
+            row.admitted[..., HOSTNAME_KEY, :] & host_onehot
+        ),
+        comp=row.comp.at[..., HOSTNAME_KEY].set(False),
+        gt=row.gt,
+        lt=row.lt,
+        defined=row.defined.at[..., HOSTNAME_KEY].set(True),
+    )
+
+
 def _fresh_template_rows(problem: SchedulingProblem, lv, ln, wellknown, pod_req, free_slot):
     """Fresh-claim template evaluation shared by the per-pod step and the run
     commit: the prospective slot's hostname is minted and pinned into the
     merged template rows before any gate sees them (nodeclaim.go:46-63), and
     template compatibility uses the well-known allowance. Returns
     (tpl_merged, tpl_compat, host_onehot)."""
-    V = problem.num_lanes
     mint_hostnames = problem.claim_hostname_lane.shape[0] > 0
-    if mint_hostnames:
-        host_lane = problem.claim_hostname_lane[
-            jnp.minimum(free_slot, problem.claim_hostname_lane.shape[0] - 1)
-        ]
-        host_onehot = jnp.arange(V) == host_lane  # [V]
-    else:
-        host_onehot = jnp.zeros((V,), dtype=bool)
+    host_onehot = _mint_host_onehot(problem, free_slot)
     tpl_compat = vmap(
         lambda tr: masks.compatible_ok(tr, pod_req, lv, ln, wellknown)
     )(problem.tpl_reqs)
     tpl_merged = _intersect_rows(problem.tpl_reqs, pod_req)
     if mint_hostnames:
-        tpl_merged = ReqTensor(
-            admitted=tpl_merged.admitted.at[:, HOSTNAME_KEY, :].set(
-                tpl_merged.admitted[:, HOSTNAME_KEY, :] & host_onehot[None, :]
-            ),
-            comp=tpl_merged.comp.at[:, HOSTNAME_KEY].set(False),
-            gt=tpl_merged.gt,
-            lt=tpl_merged.lt,
-            defined=tpl_merged.defined.at[:, HOSTNAME_KEY].set(True),
-        )
+        tpl_merged = _pin_hostname(tpl_merged, host_onehot)
     return tpl_merged, tpl_compat, host_onehot
 
 
@@ -389,6 +402,12 @@ def _make_step(problem: SchedulingProblem, statics, C: int):
         tpl_pick = _first_true(tpl_ok)
         any_tpl = jnp.any(tpl_ok)
 
+        # with every slot taken, free_slot clamps to slot 0 and the template
+        # phase evaluated a USED hostname — its verdict is meaningless, so the
+        # no-slot case must classify as KIND_NO_SLOT unconditionally (the
+        # backend's doubled-slot retry then produces the true answer); mapping
+        # it through any_tpl misread "slot 0's hostname is taken" as a
+        # permanent KIND_FAIL and starved the slot-growth path
         kind = jnp.where(
             any_node,
             KIND_NODE,
@@ -396,9 +415,9 @@ def _make_step(problem: SchedulingProblem, statics, C: int):
                 any_claim,
                 KIND_CLAIM,
                 jnp.where(
-                    any_tpl,
-                    jnp.where(has_slot, KIND_NEW_CLAIM, KIND_NO_SLOT),
-                    KIND_FAIL,
+                    ~has_slot,
+                    KIND_NO_SLOT,
+                    jnp.where(any_tpl, KIND_NEW_CLAIM, KIND_FAIL),
                 ),
             ),
         ).astype(jnp.int32)
@@ -745,7 +764,36 @@ def _make_run_commit(problem: SchedulingProblem, statics, C: int, max_run: int):
             jnp.int32
         )
 
-        # ---- 3. fresh template claims, one open at a time
+        # ---- 3. fresh template claims, one open at a time. The heavy
+        # template-side products are loop-invariant and hoisted out of the
+        # open-loop: the merged rows, compat mask, [TPL, T] pairwise
+        # instance-type compat, offerings, and per-pod capacities depend only
+        # on (pod_req, pod_requests) — the minted-hostname pin (the one
+        # free_slot-dependent piece of _fresh_template_rows) cannot change
+        # them because instance types never constrain the hostname key (the
+        # claim mints a fresh name precisely because nothing else names it,
+        # nodeclaim.go:46-63); only the committed slot row must carry the pin
+        tpl_merged_u = _intersect_rows(problem.tpl_reqs, pod_req)
+        tpl_compat = vmap(
+            lambda tr: masks.compatible_ok(tr, pod_req, lv, ln, wellknown)
+        )(problem.tpl_reqs)
+        t_packed = masks.pack_lanes(tpl_merged_u.admitted)
+        t_neg = vmap(lambda r: masks.negative_polarity(r, lv, ln))(tpl_merged_u)
+        itc_t = masks.packed_pairwise_compat(
+            tpl_merged_u, t_packed, t_neg, problem.it_reqs, it_packed, it_neg
+        )  # [TPL, T]
+        cap_tt = _capacity(
+            problem.it_alloc[None, :, :],
+            problem.tpl_overhead[:, None, :],
+            pod_requests[None, None, :],
+        )  # [TPL, T]
+        itok_t_static = (
+            problem.tpl_it_ok
+            & itc_t
+            & has_offering_rows(tpl_merged_u.admitted)
+            & (cap_tt >= 1)
+        )
+
         def nc_cond(c):
             return c[0] & (c[1] > 0)
 
@@ -767,27 +815,9 @@ def _make_run_commit(problem: SchedulingProblem, statics, C: int, max_run: int):
             ) = c
             free_slot = _first_true(~c_open)
             has_slot = jnp.any(~c_open)
-            tpl_merged, tpl_compat, host_onehot = _fresh_template_rows(
-                problem, lv, ln, wellknown, pod_req, free_slot
-            )
+            host_onehot = _mint_host_onehot(problem, free_slot)
             within = masks.fits(problem.it_cap[None, :, :], c_remaining[:, None, :])
-            t_packed = masks.pack_lanes(tpl_merged.admitted)
-            t_neg = vmap(lambda r: masks.negative_polarity(r, lv, ln))(tpl_merged)
-            itc_t = masks.packed_pairwise_compat(
-                tpl_merged, t_packed, t_neg, problem.it_reqs, it_packed, it_neg
-            )  # [TPL, T]
-            cap_tt = _capacity(
-                problem.it_alloc[None, :, :],
-                problem.tpl_overhead[:, None, :],
-                pod_requests[None, None, :],
-            )  # [TPL, T]
-            itok_t = (
-                problem.tpl_it_ok
-                & within
-                & itc_t
-                & has_offering_rows(tpl_merged.admitted)
-                & (cap_tt >= 1)
-            )
+            itok_t = itok_t_static & within
             q_t = jnp.max(jnp.where(itok_t, cap_tt, 0), axis=-1)  # [TPL]
             tpl_ok = tol_tpl & tpl_compat & (q_t >= 1)
             pick = _first_true(tpl_ok)
@@ -796,7 +826,12 @@ def _make_run_commit(problem: SchedulingProblem, statics, C: int, max_run: int):
             can = any_tpl & has_slot
             take = jnp.where(can, jnp.minimum(c_rem, jnp.minimum(q_t[pick_c], port_cap)), 0)
             slot_hot = (jnp.arange(C) == free_slot) & (take > 0)
-            slot_req = tpl_merged.row(pick_c)
+            slot_req_u = tpl_merged_u.row(pick_c)
+            # the committed claim row carries its minted hostname
+            # (nodeclaim.go:46-63), exactly as _fresh_template_rows pins it
+            slot_req = (
+                _pin_hostname(slot_req_u, host_onehot) if mint_hostnames else slot_req_u
+            )
             new_req = _mix_req_rows(
                 c_req,
                 ReqTensor(
@@ -842,7 +877,10 @@ def _make_run_commit(problem: SchedulingProblem, statics, C: int, max_run: int):
                 new_remaining,
                 new_registered,
                 c_newtake + slot_hot * take,
-                c_noslot | (any_tpl & ~has_slot),
+                # ~has_slot alone: with no free slot the template verdict is
+                # unreliable (see the step's kind classification) — always
+                # signal NO_SLOT so the backend's slot-growth retry decides
+                c_noslot | ~has_slot,
             )
 
         nc0 = (

@@ -343,8 +343,13 @@ def make_topo_run_commit(problem: SchedulingProblem, statics, C: int, max_run: i
 
                         def no_open(a3):
                             taken_nodes, st, kind_row, index_row = a3
+                            # ~has_slot => NO_SLOT regardless of any_tpl: the
+                            # prospective row evaluated a clamped (used) slot
+                            # hostname, so its verdict can't distinguish
+                            # "unplaceable" from "out of slots" (ops/ffd.py
+                            # step classification)
                             fail = jnp.where(
-                                any_tpl, KIND_NO_SLOT, KIND_FAIL
+                                ~has_slot, KIND_NO_SLOT, KIND_FAIL
                             ).astype(jnp.int32)
                             return (
                                 taken_nodes,

@@ -117,17 +117,21 @@ def constraint_signature(p: Pod) -> str:
     return "|".join(parts)
 
 
-def ffd_order(pods: Sequence[Pod]) -> List[int]:
+def ffd_order(pods: Sequence[Pod], requests_of=None) -> List[int]:
     """The FFD queue order: cpu desc, memory desc, then a constraint-signature
     tie-break, then creation time / sequence. The primary keys mirror the
     reference queue sort (queue.go:76-111); the signature tie-break is this
     framework's own refinement — the reference breaks resource ties purely by
     age, which is arbitrary for placement quality, while grouping
     equal-signature pods lets the device solver commit whole runs per scan
-    step. Shared by every backend — parity depends on a single definition."""
+    step. Shared by every backend — parity depends on a single definition.
+    ``requests_of`` lets callers share a memoized pod_requests (the encoder
+    computes requests for several tensors; pods are immutable within a call)."""
+    if requests_of is None:
+        requests_of = res.pod_requests
     keys = []
     for i, p in enumerate(pods):
-        requests = res.pod_requests(p)
+        requests = requests_of(p)
         keys.append(
             (
                 -requests.get(res.CPU, 0.0),
@@ -229,7 +233,18 @@ class Encoder:
                 for p, r in zip(pods, pod_reqs_list)
             ]
         )
-        order = ffd_order(pods)
+        # requests are re-read for several tensors below; pods never mutate
+        # within one encode call, so memoize by object identity
+        _req_memo: Dict[int, Dict[str, float]] = {}
+
+        def preq(p):
+            r = _req_memo.get(id(p))
+            if r is None:
+                r = res.pod_requests(p)
+                _req_memo[id(p)] = r
+            return r
+
+        order = ffd_order(pods, requests_of=preq)
         pods = [pods[i] for i in order]
         pod_reqs_list = [pod_reqs_list[i] for i in order]
         pod_strict_list = [pod_strict_list[i] for i in order]
@@ -338,7 +353,7 @@ class Encoder:
                     resource_names.append(name)
 
         for p in pods:
-            note_resources(res.pod_requests(p))
+            note_resources(preq(p))
         for it in instance_types:
             note_resources(it.capacity)
         for t in templates:
@@ -389,7 +404,7 @@ class Encoder:
             return np.array(res.to_dense(rl, resource_names), dtype=np.float32)
 
         pod_requests = np.stack(
-            [dense({**res.pod_requests(p), res.PODS: 1.0}) for p in pods]
+            [dense({**preq(p), res.PODS: 1.0}) for p in pods]
         ) if pods else np.zeros((0, len(resource_names)), dtype=np.float32)
         it_alloc = np.stack([dense(it.allocatable()) for it in instance_types]) if instance_types else np.zeros((0, len(resource_names)), dtype=np.float32)
         it_cap = np.stack([dense(it.capacity) for it in instance_types]) if instance_types else np.zeros((0, len(resource_names)), dtype=np.float32)
@@ -526,16 +541,28 @@ class Encoder:
             lt=np.zeros((0, F, K), dtype=np.int32),
             defined=np.zeros((0, F, K), dtype=bool),
         )
-        pod_grp_match = np.zeros((len(pods), G), dtype=bool)
         pod_grp_selects = np.zeros((len(pods), G), dtype=bool)
         pod_grp_owned = np.zeros((len(pods), G), dtype=bool)
+        # selects() depends only on (namespace, labels) — a large batch has
+        # few distinct label sets, so cache rows instead of P x G matching;
+        # ownership inverts each group's owner set instead of P x G lookups
+        uid_to_pi = {p.uid: pi for pi, p in enumerate(pods)}
+        for gi, tg in enumerate(groups):
+            for uid in tg.owners:
+                pi = uid_to_pi.get(uid)
+                if pi is not None:
+                    pod_grp_owned[pi, gi] = True
+        sel_cache: Dict[Tuple, np.ndarray] = {}
         for pi, p in enumerate(pods):
-            for gi, tg in enumerate(groups):
-                selects = tg.selects(p)
-                owned = tg.is_owned_by(p.uid)
-                pod_grp_selects[pi, gi] = selects
-                pod_grp_owned[pi, gi] = owned
-                pod_grp_match[pi, gi] = selects if grp_inverse[gi] else owned
+            lk = (p.namespace, tuple(sorted(p.metadata.labels.items())))
+            row = sel_cache.get(lk)
+            if row is None:
+                row = np.fromiter((tg.selects(p) for tg in groups), bool, G)
+                sel_cache[lk] = row
+            pod_grp_selects[pi] = row
+        pod_grp_match = np.where(
+            grp_inverse[None, :], pod_grp_selects, pod_grp_owned
+        ) if G else np.zeros((len(pods), G), dtype=bool)
         claim_hostname_lane = np.array(
             [vocab.values[hostname_k][h] for h in claim_hostnames], dtype=np.int32
         )
@@ -547,9 +574,8 @@ class Encoder:
         # (ops/topo_runs.py) unless they carry host ports or CSI volumes
         # (whose within-run interactions the closed node-capacity form does
         # not model — those stay on the per-pod step). Eligibility is
-        # re-checked on a 128-bit digest of the encoded rows, so the
-        # sort-signature heuristic above cannot cause false merges
-        # (collision odds are negligible).
+        # re-checked on byte equality of the encoded rows themselves, so the
+        # sort-signature heuristic above can never cause a false merge.
         from karpenter_tpu.models.problem import RUN_ANALYTIC, RUN_SINGLE, RUN_TOPO
 
         P = len(pods)
@@ -563,12 +589,13 @@ class Encoder:
             pod_vol_counts.any(axis=1) if pod_vol_counts.size else np.zeros(P, dtype=bool)
         )
         mergeable = ~(interacts & (has_ports | has_vols))
-        import hashlib
-
-        def _fingerprint(pi: int) -> bytes:
-            # fixed-size digest, not the raw row bytes: a 10k-pod batch's
-            # rows are ~100KB each and re-fingerprinted every relax pass
-            h = hashlib.blake2b(digest_size=16)
+        # run formation needs only CONSECUTIVE-row equality of the encoded
+        # lanes, which vectorizes to one elementwise comparison per array —
+        # no hashing. Equal rows have equal interacts/ports/vols, so checking
+        # mergeable[i] for the run head covers every member.
+        if P > 1:
+            same_as_prev = np.ones(P, dtype=bool)
+            same_as_prev[0] = False
             for a in (
                 pod_reqs.admitted, pod_reqs.comp, pod_reqs.gt, pod_reqs.lt,
                 pod_reqs.defined, pod_strict_reqs.admitted,
@@ -578,10 +605,11 @@ class Encoder:
                 pod_ports, pod_port_conflict, pod_vol_counts,
                 pod_grp_match, pod_grp_selects, pod_grp_owned,
             ):
-                h.update(a[pi].tobytes())
-            return h.digest()
-
-        fingerprints = [_fingerprint(pi) for pi in range(P)]
+                if a.size:
+                    flat = a.reshape(P, -1)
+                    same_as_prev[1:] &= (flat[1:] == flat[:-1]).all(axis=1)
+        else:
+            same_as_prev = np.zeros(P, dtype=bool)
         run_start_l: List[int] = []
         run_len_l: List[int] = []
         run_mode_l: List[int] = []
@@ -589,12 +617,7 @@ class Encoder:
         while i < P:
             j = i + 1
             if mergeable[i]:
-                while (
-                    j < P
-                    and j - i < MAX_RUN_LEN
-                    and mergeable[j]
-                    and fingerprints[j] == fingerprints[i]
-                ):
+                while j < P and j - i < MAX_RUN_LEN and same_as_prev[j]:
                     j += 1
             run_start_l.append(i)
             run_len_l.append(j - i)

@@ -55,6 +55,27 @@ from karpenter_tpu.ops.ffd import (
 import os as _os
 
 _USE_RUNS = _os.environ.get("KARPENTER_TPU_RUNS", "1") != "0"
+_TIMING = _os.environ.get("KARPENTER_TPU_TIMING", "") == "1"
+
+if _TIMING:
+    import sys as _sys
+    import time as _time
+
+    def _now():
+        return _time.perf_counter()
+
+    def _t(label, t0):
+        _sys.stderr.write(
+            f"  [timing] {label}: {_time.perf_counter() - t0:.4f}s\n"
+        )
+        return _time.perf_counter()
+else:  # zero-cost when diagnostics are off
+
+    def _now():
+        return 0.0
+
+    def _t(label, t0):
+        return 0.0
 
 
 class _SlotOverflow(Exception):
@@ -159,6 +180,7 @@ class JaxSolver(SolverBackend):
         prev_group_keys = None
         queue = list(range(len(work)))
         while queue:
+            t0 = _now()
             encoded = encoder.encode(
                 [work[i] for i in queue],
                 instance_types,
@@ -184,10 +206,12 @@ class JaxSolver(SolverBackend):
                     else None
                 ),
             )
+            t0 = _t(f"encode q={len(queue)}", t0)
             # each pass pads to its own queue's pow2 bucket: a retry pass over
             # the failed minority scans far fewer steps than the full batch,
             # at the cost of at most log2(P) cached compiles per shape family
             problem, meta = pad_problem(encoded.problem), encoded.meta
+            t0 = _t("pad", t0)
             group_keys = [
                 tg.hash_key()
                 for tg in list(topo.topologies.values())
@@ -200,11 +224,13 @@ class JaxSolver(SolverBackend):
                 # census, exactly like the reference's countDomains on Update
                 state = _remap_group_state(state, prev_group_keys, group_keys, problem)
             prev_group_keys = group_keys
+            t0 = _t("group-remap", t0)
             solve = solve_ffd_runs if _USE_RUNS else solve_ffd
             result = solve(problem, max_claims, init=state)
             state = result.state
             kinds = np.asarray(result.kind)
             indices = np.asarray(result.index)
+            t0 = _t("device-solve", t0)
             if (kinds[: len(queue)] == KIND_NO_SLOT).any():
                 raise _SlotOverflow()
 
@@ -226,6 +252,7 @@ class JaxSolver(SolverBackend):
                 if prefs.relax(work[orig]) is not None:
                     relaxed_any = True
                     topo.update(work[orig])
+            t0 = _t("decode+relax", t0)
             if not progress and not relaxed_any:
                 for orig in failed:
                     out.failures[orig] = FAIL_INCOMPATIBLE

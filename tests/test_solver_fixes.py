@@ -121,3 +121,52 @@ class TestCallerStateIsolation:
             for p, snap in zip(pods, snapshots):
                 assert len(p.spec.affinity.pod_affinity.preferred) == 1
                 assert p.spec.affinity.pod_affinity.preferred[0].weight == snap.spec.affinity.pod_affinity.preferred[0].weight
+
+
+class TestClaimSlotExhaustionClassification:
+    """When every claim slot is open, the step's template phase evaluates a
+    clamped (already-used) slot-0 hostname, so its verdict cannot distinguish
+    'unplaceable' from 'out of slots' — it must classify KIND_NO_SLOT so the
+    backend's doubled-slot retry decides (the r3 701-failure bug: hostname
+    spread pods need one fresh hostname each, far more than the initial slot
+    bucket, and were silently dropped as FAIL without ever growing slots)."""
+
+    def _spread_pod(self, i):
+        from karpenter_tpu.apis.objects import (
+            DO_NOT_SCHEDULE,
+            LabelSelector,
+            TopologySpreadConstraint,
+        )
+
+        return Pod(
+            metadata=ObjectMeta(name=f"hs{i}", labels={"a": "x"}),
+            spec=PodSpec(
+                containers=[Container(requests={"cpu": 0.1})],
+                topology_spread_constraints=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=wk.LABEL_HOSTNAME,
+                        when_unsatisfiable=DO_NOT_SCHEDULE,
+                        label_selector=LabelSelector(match_labels={"a": "x"}),
+                    )
+                ],
+            ),
+        )
+
+    def test_hostname_spread_grows_claim_slots(self):
+        from karpenter_tpu.cloudprovider.fake import FAKE_WELL_KNOWN_LABELS
+
+        its = instance_types(4)
+        tpl = template_from_nodepool(
+            NodePool(metadata=ObjectMeta(name="d")), its, range(len(its))
+        )
+        # 80 spread pods need 80 distinct fresh hostnames: far beyond the
+        # 32-slot initial bucket, reachable only through NO_SLOT overflows
+        pods = [self._spread_pod(i) for i in range(80)]
+        o = OracleSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(pods, its, [tpl])
+        assert not o.failures and len(o.new_claims) == 80
+        s = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS)
+        j = s.solve(pods, its, [tpl])
+        assert not j.failures and len(j.new_claims) == 80
+        assert s.claim_slots >= 80
+        assert all(len(c.pod_indices) == 1 for c in j.new_claims)
