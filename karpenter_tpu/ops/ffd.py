@@ -53,7 +53,11 @@ from karpenter_tpu.models.problem import CT_KEY, HOSTNAME_KEY, ZONE_KEY  # noqa:
 _BIG = 2**30
 
 # scan unroll factor: amortizes per-iteration dispatch overhead on
-# accelerators at the cost of a proportionally bigger program to compile
+# accelerators at the cost of a proportionally bigger program to compile.
+# Measured on TPU v5e at the 2500-pod bench shape (r3): unroll=4 left steady
+# solve time unchanged (1.38s vs 1.39s) and 2.3x'd compile time — the step
+# body is large enough that dispatch overhead is negligible, so 1 stays the
+# default on both backends
 import os as _os  # noqa: E402
 
 _UNROLL = int(_os.environ.get("KARPENTER_TPU_SCAN_UNROLL", "1"))
@@ -1029,6 +1033,7 @@ def _solve_ffd_runs_jit(
         outer,
         init,
         (rep_xs, run_start, run_len, jnp.asarray(problem.run_mode)),
+        unroll=_UNROLL,
     )
     # scatter the per-run windows back into queue order; rows no run covers
     # (padding pods) keep KIND_FAIL. Windows are disjoint, so the masked
