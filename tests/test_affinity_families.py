@@ -1,0 +1,210 @@
+"""Pod self-affinity and anti-affinity ordering families.
+
+Behavioral ports of the remaining named blocks of
+pkg/controllers/provisioning/scheduling/topology_test.go the suite lacked:
+self pod affinity on hostname/zone (:1469-1633), the first-empty-domain-only
+bootstrap rule incl. its capacity cliff (:1493-1577), anti-affinity where the
+plain pod schedules first (:1761-1782), arch anti-affinity (:1783-1800), and
+preferred (violable) anti-affinity (:1667-1699, 1827-1866).
+
+Every case runs oracle AND jax and asserts pod-for-pod parity (run_both).
+"""
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.objects import (
+    Affinity,
+    Container,
+    LabelSelector,
+    ObjectMeta,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PodSpec,
+    WeightedPodAffinityTerm,
+)
+from karpenter_tpu.cloudprovider.fake import (
+    GI,
+    instance_types,
+    make_instance_type,
+)
+from karpenter_tpu.scheduling import Requirements, Taints
+from karpenter_tpu.solver.encode import NodeInfo
+from karpenter_tpu.utils import resources as res
+from tests.test_solver_parity import simple_template
+from tests.test_topology_families import run_both
+
+AFF = {"security": "s2"}
+
+
+def aff_pod(i, labels=AFF, match=AFF, key=wk.LABEL_HOSTNAME, anti=False,
+            preferred=False, cpu=0.1):
+    term = PodAffinityTerm(
+        topology_key=key, label_selector=LabelSelector(match_labels=dict(match))
+    )
+    if anti:
+        aff = Affinity(pod_anti_affinity=PodAntiAffinity(
+            required=[] if preferred else [term],
+            preferred=[WeightedPodAffinityTerm(50, term)] if preferred else [],
+        ))
+    else:
+        aff = Affinity(pod_affinity=PodAffinity(
+            required=[] if preferred else [term],
+            preferred=[WeightedPodAffinityTerm(50, term)] if preferred else [],
+        ))
+    return Pod(
+        metadata=ObjectMeta(name=f"ap{i}", labels=dict(labels)),
+        spec=PodSpec(containers=[Container(requests={"cpu": cpu})], affinity=aff),
+    )
+
+
+class TestSelfAffinity:
+    def test_self_affinity_hostname_single_node(self):
+        # topology_test.go:1469-1492 — 10 self-affinity pods co-locate on one
+        # fresh hostname (bootstrap picks the first empty domain, then every
+        # follower must join it)
+        its = instance_types(4)
+        pods = [aff_pod(i) for i in range(10)]
+        o = run_both(pods, its, [simple_template(its)])
+        assert not o.failures
+        assert len(o.new_claims) == 1
+        assert len(o.new_claims[0].pod_indices) == 10
+
+    def test_self_affinity_first_empty_domain_capacity_cliff(self):
+        # topology_test.go:1493-1534 — the chosen hostname's capacity caps the
+        # group: a 5-pod instance type leaves 5 of 10 pods unschedulable (they
+        # may only join the ONE domain that already has matching pods)
+        its = [make_instance_type(
+            "five-pods", resources={res.CPU: 16.0, res.MEMORY: 32 * GI, res.PODS: 5.0}
+        )]
+        pods = [aff_pod(i) for i in range(10)]
+        o = run_both(pods, its, [simple_template(its)])
+        assert len(o.new_claims) == 1
+        assert len(o.new_claims[0].pod_indices) == 5
+        assert len(o.failures) == 5
+
+    def test_self_affinity_blocked_by_full_existing_domain(self):
+        # topology_test.go:1528-1533 (second batch) — matching pods already
+        # run on a FULL node: later self-affinity pods must join that hostname
+        # and cannot, and the bootstrap rule no longer applies (the domain
+        # universe isn't empty), so every one fails
+        its = instance_types(4)
+        node = NodeInfo(
+            name="full-node",
+            requirements=Requirements.from_labels({
+                wk.LABEL_HOSTNAME: "full-node",
+                wk.LABEL_TOPOLOGY_ZONE: "test-zone-1",
+                wk.CAPACITY_TYPE_LABEL_KEY: wk.CAPACITY_TYPE_ON_DEMAND,
+            }),
+            taints=Taints([]),
+            available={res.CPU: 0.0, res.MEMORY: 0.0, res.PODS: 0.0},
+            daemon_overhead={},
+        )
+        bound = aff_pod("bound")
+        bound.spec.node_name = "full-node"
+        census = [(bound, {
+            wk.LABEL_HOSTNAME: "full-node",
+            wk.CAPACITY_TYPE_LABEL_KEY: wk.CAPACITY_TYPE_ON_DEMAND,
+        })]
+        pods = [aff_pod(i) for i in range(4)]
+        o = run_both(pods, its, [simple_template(its)], nodes=[node],
+                     cluster_pods=census)
+        assert len(o.failures) == 4
+
+    def test_self_affinity_zone_single_zone(self):
+        # topology_test.go:1579-1602 — zone-keyed self affinity: every pod
+        # lands in one zone (possibly across several claims)
+        its = instance_types(4)
+        pods = [aff_pod(i, key=wk.LABEL_TOPOLOGY_ZONE) for i in range(10)]
+        o = run_both(pods, its, [simple_template(its)])
+        assert not o.failures
+        zones = set()
+        for c in o.new_claims:
+            r = c.requirements.get(wk.LABEL_TOPOLOGY_ZONE)
+            assert not r.complement and len(r.values) == 1
+            zones |= set(r.values)
+        assert len(zones) == 1
+
+
+class TestAntiAffinityOrdering:
+    def test_anti_affinity_zone_other_schedules_first(self):
+        # topology_test.go:1761-1782 — the plain labeled pod schedules first
+        # onto a claim whose zone never collapses, so "we don't know where it
+        # landed": anti-affinity must block EVERY possible zone and the anti
+        # pod does NOT schedule (Record blocks all domain values for
+        # anti-affinity, topology.go:130-133)
+        its = instance_types(4)
+        plain = Pod(
+            metadata=ObjectMeta(name="plain", labels=AFF),
+            spec=PodSpec(containers=[Container(requests={"cpu": 2.0})]),
+        )
+        anti = aff_pod("anti", labels={}, match=AFF,
+                       key=wk.LABEL_TOPOLOGY_ZONE, anti=True, cpu=0.1)
+        o = run_both([plain, anti], its, [simple_template(its)])
+        assert len(o.new_claims) == 1
+        assert o.new_claims[0].pod_indices == [0]
+        assert set(o.failures) == {1}
+
+    def test_anti_affinity_arch_pinned_target(self):
+        # topology_test.go:1783-1826 — the first pod's arch is PINNED by a
+        # node selector, so only that arch is blocked and the anti pod lands
+        # on the other one; both schedule on different architectures
+        from karpenter_tpu.apis.objects import TopologySpreadConstraint, DO_NOT_SCHEDULE
+
+        its = [
+            make_instance_type("amd-1", architecture="amd64"),
+            make_instance_type("arm-1", architecture="arm64"),
+        ]
+        tsc = TopologySpreadConstraint(
+            max_skew=1,
+            topology_key=wk.LABEL_HOSTNAME,
+            when_unsatisfiable=DO_NOT_SCHEDULE,
+            label_selector=LabelSelector(match_labels=dict(AFF)),
+        )
+        p1 = Pod(
+            metadata=ObjectMeta(name="p1", labels=dict(AFF)),
+            spec=PodSpec(
+                containers=[Container(requests={"cpu": 2.0})],
+                node_selector={wk.LABEL_ARCH_STABLE: "arm64"},
+                topology_spread_constraints=[tsc],
+            ),
+        )
+        p2 = aff_pod("p2", key=wk.LABEL_ARCH_STABLE, anti=True, cpu=1.0)
+        p2.spec.topology_spread_constraints = [tsc]
+        o = run_both([p1, p2], its, [simple_template(its)])
+        assert not o.failures
+        archs = {}
+        for c in o.new_claims:
+            r = c.requirements.get(wk.LABEL_ARCH_STABLE)
+            assert not r.complement and len(r.values) == 1
+            archs[min(c.pod_indices)] = next(iter(r.values))
+        assert archs[0] == "arm64" and archs[1] == "amd64"
+
+    def test_preferred_anti_affinity_violable(self):
+        # topology_test.go:1667-1699 — preferred anti-affinity relaxes rather
+        # than blocking: more self-anti pods than zones still all schedule
+        its = instance_types(4)
+        pods = [
+            aff_pod(i, key=wk.LABEL_TOPOLOGY_ZONE, anti=True, preferred=True)
+            for i in range(6)
+        ]
+        o = run_both(pods, its, [simple_template(its)])
+        assert not o.failures
+
+    def test_preferred_inverse_anti_affinity_violable(self):
+        # topology_test.go:1827-1866 — an existing pod's PREFERRED
+        # anti-affinity never blocks later pods (inverse direction is
+        # advisory), unlike the required inverse guard
+        its = instance_types(4)
+        guard = aff_pod("guard", labels={"app": "g"}, match=AFF,
+                        key=wk.LABEL_TOPOLOGY_ZONE, anti=True, preferred=True,
+                        cpu=1.0)
+        victims = [
+            Pod(
+                metadata=ObjectMeta(name=f"v{i}", labels=dict(AFF)),
+                spec=PodSpec(containers=[Container(requests={"cpu": 0.1})]),
+            )
+            for i in range(3)
+        ]
+        o = run_both([guard] + victims, its, [simple_template(its)])
+        assert not o.failures
