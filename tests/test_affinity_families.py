@@ -14,6 +14,7 @@ from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.apis.objects import (
     Affinity,
     Container,
+    DO_NOT_SCHEDULE,
     LabelSelector,
     ObjectMeta,
     Pod,
@@ -21,6 +22,7 @@ from karpenter_tpu.apis.objects import (
     PodAffinityTerm,
     PodAntiAffinity,
     PodSpec,
+    TopologySpreadConstraint,
     WeightedPodAffinityTerm,
 )
 from karpenter_tpu.cloudprovider.fake import (
@@ -149,8 +151,6 @@ class TestAntiAffinityOrdering:
         # topology_test.go:1783-1826 — the first pod's arch is PINNED by a
         # node selector, so only that arch is blocked and the anti pod lands
         # on the other one; both schedule on different architectures
-        from karpenter_tpu.apis.objects import TopologySpreadConstraint, DO_NOT_SCHEDULE
-
         its = [
             make_instance_type("amd-1", architecture="amd64"),
             make_instance_type("arm-1", architecture="arm64"),

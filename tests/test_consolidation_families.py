@@ -1,0 +1,172 @@
+"""Consolidation behavior families from the reference's consolidation suite.
+
+Behavioral ports of named blocks of
+pkg/controllers/disruption/consolidation_test.go the round-2 suite lacked:
+multiple empty nodes (:125), pending pods consuming simulated capacity
+(:148), PDB blocking (:1253) / namespace scoping (:471) / max-unavailable
+budget shape (:382), non-Karpenter capacity absorbing evicted pods (:1196),
+ownerless pods being evictable (:1530), and refusing deletes that would
+leave pods pending (:1842).
+"""
+
+from karpenter_tpu.apis.nodeclaim import NodeClaim
+from karpenter_tpu.apis.objects import LabelSelector, PodDisruptionBudget, ObjectMeta
+from karpenter_tpu.disruption.types import DECISION_DELETE
+
+from tests.factories import make_node, make_pod
+from tests.harness import Env
+from tests.test_disruption import make_underutilized_pool
+
+
+def test_delete_multiple_empty_nodes():
+    # consolidation_test.go:125-147 — every empty candidate goes in one pass
+    env = Env()
+    env.create(make_underutilized_pool())
+    env.create_candidate_node("e1")
+    env.create_candidate_node("e2")
+    cmd = env.reconcile_disruption()
+    assert cmd is not None and cmd.decision == DECISION_DELETE
+    assert {c.name for c in cmd.candidates} == {"e1", "e2"}
+    env.disruption_controller().queue.reconcile()
+    assert env.kube.get_opt(NodeClaim, "claim-e1", "") is None
+    assert env.kube.get_opt(NodeClaim, "claim-e2", "") is None
+
+
+def test_pending_pods_consume_simulated_capacity():
+    # consolidation_test.go:148-208 — a pending pod claims the host's free
+    # room inside the simulation, so the candidate's pods no longer fit and
+    # nothing is disrupted. The control run (same cluster, no pending pod)
+    # must consolidate, or the negative case proves nothing.
+    def build(with_pending):
+        env = Env()
+        env.create(make_underutilized_pool())
+        env.create_candidate_node(
+            "n-move", it_name="small-instance-type",
+            pods=[make_pod(name="m1", cpu=0.3), make_pod(name="m2", cpu=0.3)],
+        )
+        env.create_candidate_node(
+            "n-host", it_name="default-instance-type",
+            pods=[make_pod(name="h1", cpu=3.0)],
+        )
+        if with_pending:
+            env.create(make_pod(name="pending", cpu=0.7))
+        return env
+
+    control = build(with_pending=False).reconcile_disruption()
+    assert control is not None, "control case must consolidate"
+    cmd = build(with_pending=True).reconcile_disruption()
+    assert cmd is None
+
+
+def test_blocking_pdb_prevents_delete():
+    # consolidation_test.go:1253-1318 — a PDB with no remaining disruption
+    # allowance makes the candidate ineligible
+    env = Env()
+    env.create(make_underutilized_pool())
+    env.create(PodDisruptionBudget(
+        metadata=ObjectMeta(name="pdb"),
+        selector=LabelSelector(match_labels={"app": "guarded"}),
+        min_available=2,
+    ))
+    env.create_candidate_node(
+        "n1", it_name="small-instance-type",
+        pods=[make_pod(name="g1", cpu=0.1, labels={"app": "guarded"}),
+              make_pod(name="g2", cpu=0.1, labels={"app": "guarded"})],
+    )
+    env.create_candidate_node("n-host", pods=[make_pod(name="h1", cpu=0.5)])
+    cmd = env.reconcile_disruption()
+    assert cmd is None or all(c.name != "n1" for c in cmd.candidates)
+
+
+def test_pdb_namespace_must_match():
+    # consolidation_test.go:471-535 — a PDB in another namespace does not
+    # gate eviction
+    env = Env()
+    env.create(make_underutilized_pool())
+    pdb = PodDisruptionBudget(
+        metadata=ObjectMeta(name="pdb", namespace="other"),
+        selector=LabelSelector(match_labels={"app": "guarded"}),
+        min_available=2,
+    )
+    env.create(pdb)
+    env.create_candidate_node(
+        "n1", it_name="small-instance-type",
+        pods=[make_pod(name="g1", cpu=0.1, labels={"app": "guarded"}),
+              make_pod(name="g2", cpu=0.1, labels={"app": "guarded"})],
+    )
+    env.create_candidate_node("n-host", pods=[make_pod(name="h1", cpu=0.5)])
+    cmd = env.reconcile_disruption()
+    # the out-of-namespace PDB must not shield n1 from disruption (here the
+    # multi-node pass folds both candidates into one cheaper replacement)
+    assert cmd is not None
+    assert any(c.name == "n1" for c in cmd.candidates)
+
+
+def test_pdb_max_unavailable_budget_shape():
+    # consolidation_test.go:382-470 — max-unavailable budgets count the same
+    # way: allowance 1 cannot cover evicting two covered pods at once
+    env = Env()
+    env.create(make_underutilized_pool())
+    env.create(PodDisruptionBudget(
+        metadata=ObjectMeta(name="pdb"),
+        selector=LabelSelector(match_labels={"app": "guarded"}),
+        max_unavailable=1,
+    ))
+    env.create_candidate_node(
+        "n1", it_name="small-instance-type",
+        pods=[make_pod(name="g1", cpu=0.1, labels={"app": "guarded"}),
+              make_pod(name="g2", cpu=0.1, labels={"app": "guarded"})],
+    )
+    env.create_candidate_node("n-host", pods=[make_pod(name="h1", cpu=0.5)])
+    cmd = env.reconcile_disruption()
+    assert cmd is None or all(c.name != "n1" for c in cmd.candidates)
+
+
+def test_unmanaged_capacity_absorbs_evicted_pods():
+    # consolidation_test.go:1196-1252 — pods may simulate onto capacity this
+    # framework does not manage (no nodepool label); the empty-enough
+    # candidate still deletes
+    env = Env()
+    env.create(make_underutilized_pool())
+    env.create_candidate_node(
+        "n-move", it_name="small-instance-type",
+        pods=[make_pod(name="m1", cpu=0.3)],
+    )
+    unmanaged = make_node(
+        name="byo-node", provider_id="byo:///1", registered=True, initialized=True,
+        capacity={"cpu": 16.0, "memory": 64 * 1024.0**3, "pods": 110.0},
+    )
+    env.create(unmanaged)
+    cmd = env.reconcile_disruption()
+    assert cmd is not None and cmd.decision == DECISION_DELETE
+    assert [c.name for c in cmd.candidates] == ["n-move"]
+
+
+def test_ownerless_pods_are_evictable():
+    # consolidation_test.go:1530-1581 — pods without an ownerRef do not block
+    # consolidation (they are evicted; recreation is the user's problem)
+    env = Env()
+    env.create(make_underutilized_pool())
+    env.create_candidate_node(
+        "n-move", it_name="small-instance-type",
+        pods=[make_pod(name="orphan", cpu=0.2)],  # factories add no ownerRef
+    )
+    env.create_candidate_node("n-host", pods=[make_pod(name="h1", cpu=0.5)])
+    cmd = env.reconcile_disruption()
+    # the ownerless pod does not shield its node: consolidation disrupts it
+    # (folded with n-host into one cheaper replacement by the multi-node pass)
+    assert cmd is not None
+    assert any(c.name == "n-move" for c in cmd.candidates)
+
+
+def test_wont_delete_when_pods_would_go_pending():
+    # consolidation_test.go:1842-1887 — a lone candidate whose pods have
+    # nowhere else to go (and no cheaper replacement exists) is left alone
+    env = Env()
+    env.create(make_underutilized_pool())
+    env.create_candidate_node(
+        "only", it_name="small-instance-type",
+        pods=[make_pod(name="p1", cpu=1.5)],
+    )
+    cmd = env.reconcile_disruption()
+    assert cmd is None
