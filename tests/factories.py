@@ -63,6 +63,7 @@ def make_pod(
     cpu: float = 0.0,
     memory: float = 0.0,
     requests: Optional[Dict[str, float]] = None,
+    limits: Optional[Dict[str, float]] = None,
     labels: Optional[Dict[str, str]] = None,
     annotations: Optional[Dict[str, str]] = None,
     node_name: str = "",
@@ -86,6 +87,7 @@ def make_pod(
     containers = [
         Container(
             requests=reqs,
+            limits=dict(limits or {}),
             ports=[ContainerPort(container_port=p, host_port=p) for p in host_ports],
         )
     ]
@@ -251,6 +253,7 @@ def make_daemonset(
     init_requests: Optional[Dict[str, float]] = None,
     init_limits: Optional[Dict[str, float]] = None,
     node_selector: Optional[Dict[str, str]] = None,
+    node_requirements: Sequence[NodeSelectorRequirement] = (),
     tolerations: Sequence[Toleration] = (),
 ) -> DaemonSet:
     reqs = dict(requests or {})
@@ -264,12 +267,22 @@ def make_daemonset(
         init_containers.append(
             Container(requests=dict(init_requests or {}), limits=dict(init_limits or {}))
         )
+    affinity = None
+    if node_requirements:
+        from karpenter_tpu.apis.objects import NodeAffinity, NodeSelectorTerm
+
+        affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required=[NodeSelectorTerm(list(node_requirements))]
+            )
+        )
     return DaemonSet(
         metadata=ObjectMeta(name=_name("daemonset", name), namespace=namespace),
         pod_template_spec=PodSpec(
             containers=[Container(requests=reqs, limits=dict(limits or {}))],
             init_containers=init_containers,
             node_selector=dict(node_selector or {}),
+            affinity=affinity,
             tolerations=list(tolerations),
         ),
     )

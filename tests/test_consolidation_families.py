@@ -58,67 +58,59 @@ def test_pending_pods_consume_simulated_capacity():
     assert cmd is None
 
 
-def test_blocking_pdb_prevents_delete():
-    # consolidation_test.go:1253-1318 — a PDB with no remaining disruption
-    # allowance makes the candidate ineligible
+def _guarded_cluster(pdb=None):
+    """Two candidates, n1 carrying two 'guarded' pods; optionally a PDB over
+    them. The no-PDB control must disrupt n1, making the gated variants'
+    negative assertions meaningful."""
     env = Env()
     env.create(make_underutilized_pool())
-    env.create(PodDisruptionBudget(
-        metadata=ObjectMeta(name="pdb"),
-        selector=LabelSelector(match_labels={"app": "guarded"}),
-        min_available=2,
-    ))
+    if pdb is not None:
+        env.create(pdb)
     env.create_candidate_node(
         "n1", it_name="small-instance-type",
         pods=[make_pod(name="g1", cpu=0.1, labels={"app": "guarded"}),
               make_pod(name="g2", cpu=0.1, labels={"app": "guarded"})],
     )
     env.create_candidate_node("n-host", pods=[make_pod(name="h1", cpu=0.5)])
-    cmd = env.reconcile_disruption()
+    return env.reconcile_disruption()
+
+
+def test_blocking_pdb_prevents_delete():
+    # consolidation_test.go:1253-1318 — a PDB with no remaining disruption
+    # allowance makes the candidate ineligible
+    control = _guarded_cluster(pdb=None)
+    assert control is not None and any(c.name == "n1" for c in control.candidates)
+    cmd = _guarded_cluster(PodDisruptionBudget(
+        metadata=ObjectMeta(name="pdb"),
+        selector=LabelSelector(match_labels={"app": "guarded"}),
+        min_available=2,
+    ))
     assert cmd is None or all(c.name != "n1" for c in cmd.candidates)
 
 
 def test_pdb_namespace_must_match():
     # consolidation_test.go:471-535 — a PDB in another namespace does not
-    # gate eviction
-    env = Env()
-    env.create(make_underutilized_pool())
-    pdb = PodDisruptionBudget(
+    # gate eviction: n1 is still disrupted (the multi-node pass folds both
+    # candidates into one cheaper replacement)
+    cmd = _guarded_cluster(PodDisruptionBudget(
         metadata=ObjectMeta(name="pdb", namespace="other"),
         selector=LabelSelector(match_labels={"app": "guarded"}),
         min_available=2,
-    )
-    env.create(pdb)
-    env.create_candidate_node(
-        "n1", it_name="small-instance-type",
-        pods=[make_pod(name="g1", cpu=0.1, labels={"app": "guarded"}),
-              make_pod(name="g2", cpu=0.1, labels={"app": "guarded"})],
-    )
-    env.create_candidate_node("n-host", pods=[make_pod(name="h1", cpu=0.5)])
-    cmd = env.reconcile_disruption()
-    # the out-of-namespace PDB must not shield n1 from disruption (here the
-    # multi-node pass folds both candidates into one cheaper replacement)
+    ))
     assert cmd is not None
     assert any(c.name == "n1" for c in cmd.candidates)
 
 
 def test_pdb_max_unavailable_budget_shape():
     # consolidation_test.go:382-470 — max-unavailable budgets count the same
-    # way: allowance 1 cannot cover evicting two covered pods at once
-    env = Env()
-    env.create(make_underutilized_pool())
-    env.create(PodDisruptionBudget(
+    # way: allowance 1 cannot cover evicting two covered pods at once (the
+    # no-PDB control in test_blocking_pdb_prevents_delete proves the cluster
+    # shape itself consolidates)
+    cmd = _guarded_cluster(PodDisruptionBudget(
         metadata=ObjectMeta(name="pdb"),
         selector=LabelSelector(match_labels={"app": "guarded"}),
         max_unavailable=1,
     ))
-    env.create_candidate_node(
-        "n1", it_name="small-instance-type",
-        pods=[make_pod(name="g1", cpu=0.1, labels={"app": "guarded"}),
-              make_pod(name="g2", cpu=0.1, labels={"app": "guarded"})],
-    )
-    env.create_candidate_node("n-host", pods=[make_pod(name="h1", cpu=0.5)])
-    cmd = env.reconcile_disruption()
     assert cmd is None or all(c.name != "n1" for c in cmd.candidates)
 
 

@@ -11,9 +11,11 @@ from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.apis.nodeclaim import NodeClaim
 from karpenter_tpu.apis.objects import (
     Affinity,
+    IN,
     NodeAffinity,
     NodeSelectorRequirement,
     NodeSelectorTerm,
+    NOT_IN,
     Pod,
     PreferredSchedulingTerm,
     Taint,
@@ -282,3 +284,94 @@ def test_second_reconcile_is_idempotent():
     pass2 = env.provisioner.reconcile()
     assert pass2.created == []
     assert len(env.nodeclaims()) == 1
+
+
+def test_provisions_accelerators_from_limits_only_requests():
+    # suite_test.go:203-217 — GPU pods declare only LIMITS; the per-container
+    # limits-into-requests defaulting makes them schedulable onto the
+    # GPU-carrying instance types
+    from karpenter_tpu.cloudprovider.fake import (
+        RESOURCE_GPU_VENDOR_A,
+        RESOURCE_GPU_VENDOR_B,
+    )
+
+    env = Env()
+    env.create(make_nodepool())
+    pa = make_pod(name="gpu-a", limits={RESOURCE_GPU_VENDOR_A: 1.0})
+    pb = make_pod(name="gpu-b", limits={RESOURCE_GPU_VENDOR_B: 1.0})
+    env.expect_provisioned(pa, pb)
+    env.expect_scheduled(pa)
+    env.expect_scheduled(pb)
+    # the two vendors live on different instance types -> two claims
+    assert len(env.nodeclaims()) == 2
+
+
+def test_multiple_nodes_when_max_pods_is_one():
+    # suite_test.go:218-247 — a single-pod instance type forces one claim per
+    # pod (the fake catalog's pods=1 resource, fake/instancetype.go parity)
+    env = Env()
+    env.create(make_nodepool(requirements=[
+        NodeSelectorRequirement(
+            wk.LABEL_INSTANCE_TYPE_STABLE, IN, ["single-pod-instance-type"]
+        )
+    ]))
+    pods = [make_pod(cpu=0.1) for _ in range(3)]
+    env.expect_provisioned(*pods)
+    assert len(env.nodeclaims()) == 3
+    for p in pods:
+        env.expect_scheduled(p)
+
+
+def test_partial_schedule_when_limits_exceeded():
+    # suite_test.go:320-367 — hostname anti-affinity keeps the pods on
+    # separate claims; the pool's cpu limit only covers the first, so exactly
+    # one schedules
+    from tests.factories import make_anti_affinity_pod
+
+    env = Env()
+    env.create(make_nodepool(limits={"cpu": 2.0}))
+    p1 = make_anti_affinity_pod(name="a1", cpu=1.0)
+    p2 = make_anti_affinity_pod(name="a2", cpu=1.0)
+    env.expect_provisioned(p1, p2)
+    scheduled = [p for p in (p1, p2) if env.node_of(p)]
+    assert len(scheduled) == 1
+    assert len(env.nodeclaims()) == 1
+
+
+def test_daemonset_notin_unspecified_key_counts_as_overhead():
+    # suite_test.go:642-660 — a daemonset whose node requirement is
+    # NotIn on a key no template defines still lands everywhere, so its
+    # requests count toward overhead
+    env = Env()
+    env.create(make_nodepool())
+    env.create(make_daemonset(
+        name="ds-notin", cpu=1.0,
+        node_requirements=[NodeSelectorRequirement("foo", NOT_IN, ["bar"])],
+    ))
+    pod = make_pod(name="w", cpu=1.0,
+                   node_selector={wk.LABEL_TOPOLOGY_ZONE: "test-zone-2"})
+    env.expect_provisioned(pod)
+    env.expect_scheduled(pod)
+    claim = env.nodeclaims()[0]
+    assert claim.spec.resource_requests["cpu"] >= 2.0
+
+
+def test_daemonset_spec_affinity_filters_per_template():
+    # suite_test.go:661-740 — a daemonset with required node affinity only
+    # counts toward templates whose labels satisfy it
+    env = Env()
+    env.create(make_nodepool(labels={"foo": "voo"}))
+    env.create(make_daemonset(
+        name="ds-match", cpu=1.0,
+        node_requirements=[NodeSelectorRequirement("foo", IN, ["voo"])],
+    ))
+    env.create(make_daemonset(
+        name="ds-nomatch", cpu=10.0,
+        node_requirements=[NodeSelectorRequirement("foo", IN, ["nope"])],
+    ))
+    pod = make_pod(name="w", cpu=1.0)
+    env.expect_provisioned(pod)
+    env.expect_scheduled(pod)
+    claim = env.nodeclaims()[0]
+    # matching daemonset counted (>= pod + 1), unmatching's 10 cpu was not
+    assert 2.0 <= claim.spec.resource_requests["cpu"] < 10.0
