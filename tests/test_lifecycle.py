@@ -429,3 +429,40 @@ def test_eviction_queue_backoff_grows_and_caps():
     q.reconcile()
     assert len(q) == 0
     assert env.kube.get_opt(Pod, "web-1") is None
+
+
+def test_requirements_drift_when_pool_narrows():
+    # drift.go:123 (NodeRequirementDrifted): the pool's requirements narrow
+    # so the claim's labels fall outside them; the hash is kept in sync so
+    # only the requirements check can fire
+    from karpenter_tpu.apis.objects import IN, NodeSelectorRequirement
+
+    env = Env()
+    env.cloud_provider.drifted = ""
+    pool = make_nodepool()
+    env.create(pool)
+    _, claim = env.create_candidate_node("n1", zone="test-zone-1")
+    stored = env.kube.get(NodeClaim, claim.metadata.name, "")
+    stored.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] = pool.hash()
+    env.kube.update(stored)
+    marker(env).reconcile_all()
+    assert not env.kube.get(
+        NodeClaim, claim.metadata.name, ""
+    ).status.conditions.is_true(DRIFTED)
+
+    # the pool now excludes the claim's zone; requirements are not part of
+    # the static hash (nodepool.py hash()), so this is requirement drift
+    stored_pool = env.kube.get(make_nodepool().__class__, "default", "")
+    stored_pool.spec.template.spec.requirements = [
+        NodeSelectorRequirement(wk.LABEL_TOPOLOGY_ZONE, IN, ["test-zone-2"])
+    ]
+    env.kube.update(stored_pool)
+    stored = env.kube.get(NodeClaim, claim.metadata.name, "")
+    stored.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] = (
+        env.kube.get(make_nodepool().__class__, "default", "").hash()
+    )
+    env.kube.update(stored)
+    marker(env).reconcile_all()
+    got = env.kube.get(NodeClaim, claim.metadata.name, "")
+    assert got.status.conditions.is_true(DRIFTED)
+    assert got.status.conditions.get(DRIFTED).reason == "RequirementsDrifted"
