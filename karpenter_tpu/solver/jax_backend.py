@@ -133,6 +133,12 @@ class JaxSolver(SolverBackend):
         if domains is None:
             domains = domains_from_instance_types(instance_types, templates)
 
+        # long-lived processes accumulate compiled executables across shape
+        # buckets; bound their mmap footprint before it hits vm.max_map_count
+        # (utils/jaxtools.py)
+        from karpenter_tpu.utils.jaxtools import bound_executable_maps
+
+        bound_executable_maps()
         max_claims = min(self.claim_slots, pow2_bucket(len(pods)))
         while True:
             try:

@@ -91,8 +91,8 @@ def prewarm_solver(
 
 
 def persistent_cache_enabled() -> bool:
-    """Whether the cross-process compile cache is active (TPU backends only —
-    XLA:CPU AOT serialization segfaults in this jaxlib, utils/jaxtools.py)."""
+    """Whether the cross-process compile cache is active
+    (utils/jaxtools.py enable_compilation_cache)."""
     try:
         import jax
 
@@ -101,19 +101,37 @@ def persistent_cache_enabled() -> bool:
         return False
 
 
+def _on_accelerator() -> bool:
+    try:
+        import jax
+
+        return jax.devices()[0].platform != "cpu"
+    except Exception:
+        return False
+
+
 def maybe_prewarm_in_background(options) -> Optional["object"]:
-    """Operator.start() hook: warm in a daemon thread when enabled and the
-    persistent cache is active (i.e. on TPU; CPU tests/dev runs skip — they
-    would pay full compiles twice on the shared jit cache for no
-    cross-process benefit)."""
+    """Operator.start() hook: warm in a daemon thread when enabled, the
+    persistent cache is active, and an accelerator backend is attached. CPU
+    runs skip — production CPU operators still benefit from the on-disk cache
+    populated by their first real solve, while test/dev CPU runs (the only
+    place start() runs on CPU today) must not burn the single-core host on
+    background compiles. The platform probe (jax.devices() forces PJRT
+    backend init, seconds on a tunneled TPU) runs INSIDE the daemon thread so
+    start() never blocks on it."""
     import threading
 
     if not getattr(options, "prewarm_solver", True):
         return None
     if not persistent_cache_enabled():
         return None
+
+    def probe_then_warm():
+        if _on_accelerator():
+            prewarm_solver()
+
     t = threading.Thread(
-        target=prewarm_solver, daemon=True, name="karpenter-tpu/solver-prewarm"
+        target=probe_then_warm, daemon=True, name="karpenter-tpu/solver-prewarm"
     )
     t.start()
     return t

@@ -27,26 +27,13 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
-# Every XLA:CPU executable holds several mmap'd code regions; a full-suite
-# run compiles hundreds of solver shape buckets and can exhaust the kernel's
-# vm.max_map_count (default 65530), at which point a failed mmap inside
-# backend_compile_and_load takes the process down with SIGSEGV mid-suite
-# (observed at ~58k maps). Dropping the executable caches when the map count
-# nears the limit trades a few recompiles for survival — and is a no-op on
-# machines with a raised limit.
-_MAPS_SOFT_LIMIT = 40_000
-
-
-def _map_count() -> int:
-    try:
-        with open("/proc/self/maps", "rb") as f:
-            return sum(1 for _ in f)
-    except OSError:  # non-Linux: the limit doesn't exist either
-        return 0
+from karpenter_tpu.utils.jaxtools import bound_executable_maps  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
 def _bounded_xla_executable_maps():
-    if _map_count() > _MAPS_SOFT_LIMIT:
-        jax.clear_caches()
+    # a full-suite run compiles hundreds of solver shape buckets and would
+    # otherwise exhaust vm.max_map_count mid-suite (SIGSEGV inside
+    # backend_compile_and_load); see utils/jaxtools.py bound_executable_maps
+    bound_executable_maps()
     yield

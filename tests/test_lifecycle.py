@@ -452,15 +452,15 @@ def test_requirements_drift_when_pool_narrows():
 
     # the pool now excludes the claim's zone; requirements are not part of
     # the static hash (nodepool.py hash()), so this is requirement drift
-    stored_pool = env.kube.get(make_nodepool().__class__, "default", "")
+    from karpenter_tpu.apis.nodepool import NodePool
+
+    stored_pool = env.kube.get(NodePool, "default", "")
     stored_pool.spec.template.spec.requirements = [
         NodeSelectorRequirement(wk.LABEL_TOPOLOGY_ZONE, IN, ["test-zone-2"])
     ]
     env.kube.update(stored_pool)
     stored = env.kube.get(NodeClaim, claim.metadata.name, "")
-    stored.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] = (
-        env.kube.get(make_nodepool().__class__, "default", "").hash()
-    )
+    stored.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] = stored_pool.hash()
     env.kube.update(stored)
     marker(env).reconcile_all()
     got = env.kube.get(NodeClaim, claim.metadata.name, "")
