@@ -34,6 +34,9 @@ from karpenter_tpu.utils.jaxtools import bound_executable_maps  # noqa: E402
 def _bounded_xla_executable_maps():
     # a full-suite run compiles hundreds of solver shape buckets and would
     # otherwise exhaust vm.max_map_count mid-suite (SIGSEGV inside
-    # backend_compile_and_load); see utils/jaxtools.py bound_executable_maps
+    # backend_compile_and_load); see utils/jaxtools.py bound_executable_maps.
+    # JaxSolver.solve() guards itself, but many suites compile through the
+    # kernels directly (solve_ffd/solve_ffd_runs/batched_screen), so the
+    # harness needs its own bound
     bound_executable_maps()
     yield

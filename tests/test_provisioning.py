@@ -375,3 +375,18 @@ def test_daemonset_spec_affinity_filters_per_template():
     claim = env.nodeclaims()[0]
     # matching daemonset counted (>= pod + 1), unmatching's 10 cpu was not
     assert 2.0 <= claim.spec.resource_requests["cpu"] < 10.0
+
+
+def test_ignores_deleting_nodepools():
+    # suite_test.go:112-122 — a NodePool mid-deletion (finalizer holding it
+    # in the store with deletion_timestamp set) provisions nothing
+    env = Env()
+    pool = make_nodepool()
+    pool.metadata.finalizers = ["keep"]
+    env.create(pool)
+    env.kube.delete(pool.__class__, "default", "")
+    assert env.kube.get(pool.__class__, "default", "").metadata.deletion_timestamp
+    pod = make_pod(name="p1", cpu=1.0)
+    env.expect_provisioned(pod)
+    assert env.nodeclaims() == []
+    env.expect_not_scheduled(pod)
