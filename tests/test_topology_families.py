@@ -541,3 +541,31 @@ class TestSpreadTaintAndNotInInteraction:
         o = run_both(pods, its, [tpl_a, tpl_b])
         assert not o.failures
         assert skew(o, wk.LABEL_TOPOLOGY_ZONE) == [2, 2, 2]
+
+
+class TestMinDomainsRelaxationInterplay:
+    def test_schedule_anyway_min_domains_relaxes(self):
+        # the VERDICT-r2-named interplay family: a ScheduleAnyway spread
+        # whose minDomains can never be satisfied (2 reachable zones,
+        # minDomains=3 keeps min=0, so stacking violates skew) is dropped by
+        # the relaxation ladder (preferences.go ScheduleAnyway step) and all
+        # pods schedule anyway
+        its = instance_types(4)
+        tpl = simple_template(
+            its,
+            requirements=[
+                NodeSelectorRequirement(
+                    wk.LABEL_TOPOLOGY_ZONE, IN, ["test-zone-1", "test-zone-2"]
+                )
+            ],
+        )
+        pods = [
+            pod(i, constraints=[
+                spread(wk.LABEL_TOPOLOGY_ZONE, when=SCHEDULE_ANYWAY, min_domains=3)
+            ])
+            for i in range(6)
+        ]
+        o = run_both(pods, its, [tpl])
+        assert not o.failures
+        # the DoNotSchedule twin (which never relaxes and keeps failing) is
+        # TestMinDomainsFamilies.test_unsatisfiable_min_domains_forces_min_zero
