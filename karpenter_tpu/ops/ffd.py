@@ -557,9 +557,10 @@ def _make_step(problem: SchedulingProblem, statics, C: int):
 
 @jax.jit
 def _solve_ffd_jit(problem: SchedulingProblem, init: FFDState) -> FFDResult:
-    """Reference per-pod scan: one pod per step. Kept as the semantic anchor
-    the run-compressed solver is fuzz-checked against, and as the fallback
-    when KARPENTER_TPU_RUNS=0."""
+    """Reference per-pod scan: one pod per step — the provisioning
+    production default (faster than the run-compressed scan on diverse
+    workloads, see solver/jax_backend.py) and the semantic anchor the
+    run-compressed solver is fuzz-checked against."""
     problem, init = _lane_align(problem, init)
     step = _make_step(problem, _statics(problem), init.claim_open.shape[0])
     final_state, (kinds, indices) = lax.scan(step, init, _pod_xs(problem), unroll=_UNROLL)

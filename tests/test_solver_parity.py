@@ -415,8 +415,9 @@ class TestRandomizedTopologyParity:
 
 class TestRunCompressionDifferential:
     """Standing differential: the run-compressed scan (solve_ffd_runs, the
-    production default) against the per-pod scan (solve_ffd, the semantic
-    anchor) — pod-for-pod (kind, index) equality at the FFD layer, on fuzzed
+    consolidation screen's engine and the KARPENTER_TPU_RUNS=1 opt-in)
+    against the per-pod scan (solve_ffd, the provisioning default and
+    semantic anchor) — pod-for-pod (kind, index) equality at the FFD layer, on fuzzed
     topology workloads whose segmentation exercises all three run modes
     (RUN_SINGLE / RUN_ANALYTIC / RUN_TOPO). This is the guard the round-2
     regression (topo runs silently clamped onto the analytic branch by
