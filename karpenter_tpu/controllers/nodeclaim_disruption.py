@@ -100,12 +100,18 @@ class DisruptionMarkerController:
         claim_hash = claim.metadata.annotations.get(wk.NODEPOOL_HASH_ANNOTATION_KEY)
         if claim_hash is not None and claim_hash != nodepool.hash():
             return "NodePoolStaticDrifted"
-        # requirements drift: claim labels fall outside the pool's requirements
+        # requirements drift: the claim's labels no longer satisfy the pool's
+        # requirements. Direction matters (areRequirementsDrifted,
+        # drift.go:123-133): the CLAIM label set is the receiver and the pool
+        # requirements the incoming side — so pool requirement keys the claim
+        # doesn't label are drift, while provider-specific claim label keys
+        # the pool never constrained are NOT (reversed, every custom-label
+        # provider claim would false-drift and churn-replace forever)
         pool_reqs = Requirements.from_node_selector_requirements(
             *nodepool.spec.template.spec.requirements
         )
         claim_reqs = label_requirements(claim.metadata.labels)
-        if not pool_reqs.is_compatible(claim_reqs, wk.WELL_KNOWN_LABELS):
+        if not claim_reqs.is_compatible(pool_reqs, wk.WELL_KNOWN_LABELS):
             return "RequirementsDrifted"
         cloud_reason = self.cloud_provider.is_drifted(claim)
         if cloud_reason:

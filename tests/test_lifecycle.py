@@ -466,3 +466,44 @@ def test_requirements_drift_when_pool_narrows():
     got = env.kube.get(NodeClaim, claim.metadata.name, "")
     assert got.status.conditions.is_true(DRIFTED)
     assert got.status.conditions.get(DRIFTED).reason == "RequirementsDrifted"
+
+
+def test_provider_specific_labels_are_not_requirements_drift():
+    # direction regression (drift.go:123-133): the CLAIM label set is the
+    # Compatible receiver and the pool requirements the incoming side, so
+    # provider-specific claim label keys (here the fake catalog's extras,
+    # e.g. "integer") under an unconstrained pool are NOT drift; reversed,
+    # every such claim would false-drift and churn-replace forever
+    env = Env()
+    env.cloud_provider.drifted = ""
+    pool = make_nodepool()
+    env.create(pool)
+    _, claim = env.create_candidate_node("n1")
+    stored = env.kube.get(NodeClaim, claim.metadata.name, "")
+    stored.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] = pool.hash()
+    stored.metadata.labels["integer"] = "4"
+    stored.metadata.labels["fake.io/custom"] = "anything"
+    env.kube.update(stored)
+    marker(env).reconcile_all()
+    got = env.kube.get(NodeClaim, claim.metadata.name, "")
+    assert not got.status.conditions.is_true(DRIFTED)
+
+
+def test_missing_pool_required_label_is_requirements_drift():
+    # the other half of the direction fix: a pool requirement on a custom
+    # (non-well-known) key the claim never labeled IS drift — the claim
+    # cannot satisfy the pool's current shape
+    from karpenter_tpu.apis.objects import IN, NodeSelectorRequirement
+
+    env = Env()
+    env.cloud_provider.drifted = ""
+    pool = make_nodepool(requirements=[NodeSelectorRequirement("team", IN, ["ml"])])
+    env.create(pool)
+    _, claim = env.create_candidate_node("n1")
+    stored = env.kube.get(NodeClaim, claim.metadata.name, "")
+    stored.metadata.annotations[wk.NODEPOOL_HASH_ANNOTATION_KEY] = pool.hash()
+    env.kube.update(stored)
+    marker(env).reconcile_all()
+    got = env.kube.get(NodeClaim, claim.metadata.name, "")
+    assert got.status.conditions.is_true(DRIFTED)
+    assert got.status.conditions.get(DRIFTED).reason == "RequirementsDrifted"

@@ -132,3 +132,56 @@ def test_step_respects_periods():
     clock.step(10.5)
     ran = set(op.step())
     assert "disruption" in ran
+
+
+def test_end_to_end_drift_replacement():
+    """Provision -> initialize -> cloud marks the machine drifted -> the
+    marker sets Drifted -> disruption replaces it through orchestration (new
+    claim launched and initialized, old claim + node torn down) — the full
+    3.1->3.2->3.3->3.4 call-stack loop (SURVEY.md §3) in cooperative mode."""
+    op, clock = make_operator()
+    op.kube.create(make_nodepool(disruption=DisruptionPolicy(
+        consolidation_policy="WhenEmpty", consolidate_after="1h",
+        budgets=[Budget(nodes="100%")],
+    )))
+    op.kube.create(make_pod(name="p1", cpu=1.0))
+    op.step()
+    op.run_until_settled()
+    kubelet_registers(op)
+    # bind the pod (the scheduler/kubelet's job): the node must not read as
+    # empty, or WhenEmpty consolidation would delete it before drift does
+    node = op.kube.list(Node)[0]
+    pod = op.kube.get(Pod, "p1")
+    pod.spec.node_name = node.metadata.name
+    pod.status.phase = "Running"
+    op.kube.update(pod)
+    op.run_until_settled()
+    old_claim = op.kube.list(NodeClaim)[0]
+    assert old_claim.is_initialized()
+
+    # the cloud now reports the machine drifted; marker picks it up.
+    # op.cloud_provider is the metrics decorator — the knob lives on the
+    # wrapped fake (attribute writes on the decorator would silently miss it)
+    op.cloud_provider._inner.drifted = "CloudDrifted"
+    clock.step(16)
+    op.run_until_settled()
+    assert op.kube.get(
+        NodeClaim, old_claim.metadata.name, ""
+    ).status.conditions.is_true("Drifted")
+    # only the old machine is drifted — the fake's blanket knob would
+    # otherwise mark every replacement drifted too and cascade deletes
+    op.cloud_provider._inner.drifted = ""
+
+    # disruption computes the replace, revalidates after the TTL, launches
+    # the replacement; the kubelet registers it; orchestration then deletes
+    # the drifted claim and node termination drains it away
+    for _ in range(6):
+        clock.step(16)
+        op.run_until_settled(max_steps=80)
+        kubelet_registers(op)
+        names = {c.metadata.name for c in op.kube.list(NodeClaim)}
+        if old_claim.metadata.name not in names:
+            break
+    names = {c.metadata.name for c in op.kube.list(NodeClaim)}
+    assert old_claim.metadata.name not in names, "drifted claim not replaced"
+    assert len(names) == 1, f"expected exactly the replacement, got {names}"
