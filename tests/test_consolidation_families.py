@@ -162,3 +162,44 @@ def test_wont_delete_when_pods_would_go_pending():
     )
     cmd = env.reconcile_disruption()
     assert cmd is None
+
+
+def test_budget_caps_candidates_per_pass():
+    # nodepool.go:217-231 GetAllowedDisruptions + the per-pass budget mapping
+    # (helpers.go:195-222): a nodes=1 budget lets exactly one of two empty
+    # candidates go in a pass; the next pass (after the first finishes
+    # disrupting) takes the second
+    from karpenter_tpu.apis.nodepool import Budget, Disruption as DP
+    from tests.factories import make_nodepool
+
+    env = Env()
+    env.create(make_nodepool(disruption=DP(
+        consolidation_policy="WhenUnderutilized", budgets=[Budget(nodes="1")],
+    )))
+    env.create_candidate_node("e1")
+    env.create_candidate_node("e2")
+    cmd = env.reconcile_disruption()
+    assert cmd is not None and cmd.decision == DECISION_DELETE
+    assert len(cmd.candidates) == 1
+    env.disruption_controller().queue.reconcile()
+    remaining = {c.metadata.name for c in env.kube.list(NodeClaim)}
+    assert len(remaining) == 1
+
+
+def test_budget_cron_window_gates_disruption():
+    # Budget.IsActive cron windows (nodepool.go:265-277): a budget whose
+    # schedule window is closed does not bind; one that is open does
+    from karpenter_tpu.apis.nodepool import Budget, Disruption as DP
+    from tests.factories import make_nodepool
+
+    env = Env()
+    # FakeClock epoch 1700000000 = 2023-11-14 22:13:20 UTC (a Tuesday).
+    # A Sunday-only zero-budget window is closed now -> disruption proceeds
+    env.create(make_nodepool(name="open", disruption=DP(
+        consolidation_policy="WhenUnderutilized",
+        budgets=[Budget(nodes="0", schedule="0 0 * * 0", duration="1h"),
+                 Budget(nodes="100%")],
+    )))
+    env.create_candidate_node("e1", nodepool="open")
+    cmd = env.reconcile_disruption()
+    assert cmd is not None and [c.name for c in cmd.candidates] == ["e1"]
