@@ -10,6 +10,7 @@ leave pods pending (:1842).
 """
 
 from karpenter_tpu.apis.nodeclaim import NodeClaim
+from karpenter_tpu.apis.nodepool import Budget, Disruption as DisruptionPolicy
 from karpenter_tpu.apis.objects import LabelSelector, PodDisruptionBudget, ObjectMeta
 from karpenter_tpu.disruption.types import DECISION_DELETE
 
@@ -169,11 +170,8 @@ def test_budget_caps_candidates_per_pass():
     # (helpers.go:195-222): a nodes=1 budget lets exactly one of two empty
     # candidates go in a pass; the next pass (after the first finishes
     # disrupting) takes the second
-    from karpenter_tpu.apis.nodepool import Budget, Disruption as DP
-    from tests.factories import make_nodepool
-
     env = Env()
-    env.create(make_nodepool(disruption=DP(
+    env.create(make_underutilized_pool(disruption=DisruptionPolicy(
         consolidation_policy="WhenUnderutilized", budgets=[Budget(nodes="1")],
     )))
     env.create_candidate_node("e1")
@@ -184,22 +182,45 @@ def test_budget_caps_candidates_per_pass():
     env.disruption_controller().queue.reconcile()
     remaining = {c.metadata.name for c in env.kube.list(NodeClaim)}
     assert len(remaining) == 1
+    # while the disrupted node is still terminating it keeps its budget slot
+    # (build_disruption_budget_mapping counts deleting nodes); a second pass
+    # is correctly gated until termination completes
+    assert env.reconcile_disruption() is None
+    gone = next(n for n in ("e1", "e2") if f"claim-{n}" not in remaining)
+    from karpenter_tpu.apis.objects import Node
+
+    env.kube.delete(Node, gone, namespace="")
+    # termination done: the budget slot frees and the second candidate goes
+    cmd2 = env.reconcile_disruption()
+    assert cmd2 is not None and len(cmd2.candidates) == 1
+    env.disruption_controller().queue.reconcile()
+    assert env.kube.list(NodeClaim) == []
 
 
 def test_budget_cron_window_gates_disruption():
     # Budget.IsActive cron windows (nodepool.go:265-277): a budget whose
-    # schedule window is closed does not bind; one that is open does
-    from karpenter_tpu.apis.nodepool import Budget, Disruption as DP
-    from tests.factories import make_nodepool
+    # schedule window is closed does not bind; once the clock enters the
+    # window, its zero allowance gates every disruption
+    def build():
+        env = Env()
+        # FakeClock epoch 1700000000 = 2023-11-14 22:13:20 UTC (a Tuesday).
+        # The zero-budget maintenance freeze runs Sundays 00:00-01:00
+        env.create(make_underutilized_pool(name="open", disruption=DisruptionPolicy(
+            consolidation_policy="WhenUnderutilized",
+            budgets=[Budget(nodes="0", schedule="0 0 * * 0", duration="1h"),
+                     Budget(nodes="100%")],
+        )))
+        env.create_candidate_node("e1", nodepool="open")
+        return env
 
-    env = Env()
-    # FakeClock epoch 1700000000 = 2023-11-14 22:13:20 UTC (a Tuesday).
-    # A Sunday-only zero-budget window is closed now -> disruption proceeds
-    env.create(make_nodepool(name="open", disruption=DP(
-        consolidation_policy="WhenUnderutilized",
-        budgets=[Budget(nodes="0", schedule="0 0 * * 0", duration="1h"),
-                 Budget(nodes="100%")],
-    )))
-    env.create_candidate_node("e1", nodepool="open")
+    # Tuesday: the Sunday window is closed -> disruption proceeds
+    env = build()
     cmd = env.reconcile_disruption()
     assert cmd is not None and [c.name for c in cmd.candidates] == ["e1"]
+
+    # step a fresh cluster's clock to Sunday 00:30 UTC: the window is open
+    # and its zero allowance blocks the pass
+    env = build()
+    env.clock.step(353_800)  # 2023-11-19 00:30:00 UTC, inside the window
+    cmd = env.reconcile_disruption()
+    assert cmd is None
