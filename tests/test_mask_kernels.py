@@ -114,3 +114,34 @@ class TestKernelParity:
         avail = np.array([2.0, 2.0], dtype=np.float32)
         out = np.asarray(masks.fits(req, avail))
         assert out.tolist() == [True, False]
+
+
+class TestPodAxisBucket:
+    def test_matches_pow2_up_to_1024(self):
+        from karpenter_tpu.ops.padding import pod_axis_bucket, pow2_bucket
+
+        for n in list(range(1, 40)) + [255, 256, 257, 1000, 1024]:
+            assert pod_axis_bucket(n) == pow2_bucket(n)
+
+    def test_mantissa_steps_bound_waste(self):
+        from karpenter_tpu.ops.padding import pod_axis_bucket
+
+        # brute-force property: bucket >= n, monotone, and padding waste
+        # stays under 25% above the pow2 region
+        prev = 0
+        for n in range(1025, 70000, 37):
+            b = pod_axis_bucket(n)
+            assert b >= n
+            assert b >= prev
+            assert b / n <= 1.25 + 1e-9, (n, b)
+            prev = b
+
+    def test_exact_steps(self):
+        from karpenter_tpu.ops.padding import pod_axis_bucket
+
+        assert pod_axis_bucket(1025) == 1280
+        assert pod_axis_bucket(1280) == 1280
+        assert pod_axis_bucket(1281) == 1536
+        assert pod_axis_bucket(10000) == 10240
+        assert pod_axis_bucket(16384) == 16384
+        assert pod_axis_bucket(16385) == 20480
