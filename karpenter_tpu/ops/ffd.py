@@ -62,6 +62,12 @@ import os as _os  # noqa: E402
 
 _UNROLL = int(_os.environ.get("KARPENTER_TPU_SCAN_UNROLL", "1"))
 
+# dev-only cost-attribution knob: comma-set of step phases to stub out
+# (results become WRONG — never set outside tools/profile_step.py)
+_ABLATE = frozenset(
+    p for p in _os.environ.get("KARPENTER_TPU_ABLATE", "").split(",") if p
+)
+
 
 @jax.tree_util.register_dataclass
 @dataclass
@@ -137,9 +143,13 @@ def solve_ffd(
     """Run one pack pass. Shapes are static per bucket; XLA caches the
     compiled executable across batches. ``init`` carries bin + topology state
     between relax-and-retry passes (the queue requeue of scheduler.go:150-170).
-    """
+
+    A fresh solve builds the initial state *inside* the jit: each eager
+    device op outside a jit is a separate launch through the (possibly
+    remote) TPU runtime, and initial_state's ~13 of them cost more than the
+    whole small-batch scan."""
     if init is None:
-        init = initial_state(problem, max_claims)
+        return _solve_ffd_fresh_jit(problem, max_claims)
     return _solve_ffd_jit(problem, init)
 
 
@@ -361,11 +371,17 @@ def _make_step(problem: SchedulingProblem, statics, C: int):
             lambda cr: masks.compatible_ok(cr, pod_req, lv, ln, wellknown)
         )(state.claim_req)
         claim_merged = _intersect_rows(state.claim_req, pod_req)
-        claim_topo_ok, claim_final = topo_gate(
-            problem, state.grp_counts, state.grp_registered, topo_pod, claim_merged, wellknown
-        )
+        if "ctopo" in _ABLATE:
+            claim_topo_ok, claim_final = jnp.ones((C,), bool), claim_merged
+        else:
+            claim_topo_ok, claim_final = topo_gate(
+                problem, state.grp_counts, state.grp_registered, topo_pod, claim_merged, wellknown
+            )
         claim_requests2 = state.claim_requests + pod_requests[None, :]
-        claim_it_ok2 = it_gate(claim_final, claim_requests2, state.claim_it_ok)
+        if "citgate" in _ABLATE:
+            claim_it_ok2 = state.claim_it_ok
+        else:
+            claim_it_ok2 = it_gate(claim_final, claim_requests2, state.claim_it_ok)
         claim_port_ok = ~jnp.any(state.claim_used_ports & pod_conflict[None, :], axis=-1)
         claim_ok = (
             state.claim_open
@@ -394,14 +410,20 @@ def _make_step(problem: SchedulingProblem, statics, C: int):
         reg_for_tpl = state.grp_registered | (
             (problem.grp_key == HOSTNAME_KEY)[:, None] & host_onehot[None, :]
         )
-        tpl_topo_ok, tpl_final = topo_gate(
-            problem, state.grp_counts, reg_for_tpl, topo_pod, tpl_merged, wellknown
-        )
+        if "ttopo" in _ABLATE:
+            tpl_topo_ok, tpl_final = jnp.ones((TPL,), bool), tpl_merged
+        else:
+            tpl_topo_ok, tpl_final = topo_gate(
+                problem, state.grp_counts, reg_for_tpl, topo_pod, tpl_merged, wellknown
+            )
         tpl_requests2 = problem.tpl_overhead + pod_requests[None, :]
         within_limits = masks.fits(
             problem.it_cap[None, :, :], state.remaining[:, None, :]
         )  # [TPL, T]
-        tpl_it_ok2 = it_gate(tpl_final, tpl_requests2, problem.tpl_it_ok & within_limits)
+        if "titgate" in _ABLATE:
+            tpl_it_ok2 = problem.tpl_it_ok & within_limits
+        else:
+            tpl_it_ok2 = it_gate(tpl_final, tpl_requests2, problem.tpl_it_ok & within_limits)
         tpl_ok = tol_tpl & tpl_compat & tpl_topo_ok & jnp.any(tpl_it_ok2, axis=-1)
         tpl_pick = _first_true(tpl_ok)
         any_tpl = jnp.any(tpl_ok)
@@ -515,17 +537,20 @@ def _make_step(problem: SchedulingProblem, statics, C: int):
         if N > 0:
             rec_row = pick_rows(chosen_final, rec_row, kind == KIND_NODE)
         rec_allow = jnp.where(kind == KIND_NODE, no_allow, wellknown)
-        new_counts, new_registered = record(
-            problem,
-            state.grp_counts,
-            new_registered,
-            topo_pod,
-            rec_row,
-            rec_allow,
-            committed,
-            lv,
-            ln,
-        )
+        if "record" in _ABLATE:
+            new_counts = state.grp_counts
+        else:
+            new_counts, new_registered = record(
+                problem,
+                state.grp_counts,
+                new_registered,
+                topo_pod,
+                rec_row,
+                rec_allow,
+                committed,
+                lv,
+                ln,
+            )
 
         index = jnp.where(
             kind == KIND_NODE,
@@ -565,6 +590,109 @@ def _solve_ffd_jit(problem: SchedulingProblem, init: FFDState) -> FFDResult:
     step = _make_step(problem, _statics(problem), init.claim_open.shape[0])
     final_state, (kinds, indices) = lax.scan(step, init, _pod_xs(problem), unroll=_UNROLL)
     return FFDResult(kind=kinds, index=indices, state=final_state)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _solve_ffd_fresh_jit(problem: SchedulingProblem, max_claims: int) -> FFDResult:
+    """Fresh-state variant: initial_state is traced into the program so a
+    first-pass solve is a single device launch."""
+    problem = _pad_lanes_mult32(problem)
+    return _solve_ffd_jit.__wrapped__(problem, initial_state(problem, max_claims))
+
+
+def _sweeps_impl(problem: SchedulingProblem, init: FFDState, C: int) -> FFDResult:
+    """All retry passes of a solve in ONE device program.
+
+    The reference's Solve loop requeues failed pods and retries while any
+    placement makes progress (scheduler.go:150-170) — a pod whose required
+    pod-affinity peers were placed later in the queue succeeds on the next
+    pass. The host loop used to pay one device roundtrip per pass; here the
+    requeue-until-no-progress loop IS the program: an outer while over
+    sweeps, an inner while over a compact queue of still-unplaced pods.
+    Relaxation (preferences.py) stays host-side — it mutates pod specs and
+    re-encodes — so a solve with relaxable pods costs one launch per ladder
+    rung, and the common no-relaxation solve costs exactly one.
+
+    Exactness vs the pass-per-launch loop: each sweep steps the SAME pod rows
+    in the same order as a re-encoded retry pass would (a subset of an
+    FFD-sorted queue, in order, is still FFD-sorted), against the same carried
+    state; KIND_NO_SLOT stops sweeping so the backend's slot-doubling retry
+    sees it at the same pass boundary it used to.
+    """
+    P = problem.num_pods
+    pods_xs = _pod_xs(problem)
+    step = _make_step(problem, _statics(problem), C)
+    active = jnp.asarray(problem.pod_active)
+    # compact initial queue: active rows first, original (FFD) order kept —
+    # padding rows are never stepped at all, so bucket padding costs compile
+    # cache entries but zero runtime
+    queue0 = jnp.argsort(~active, stable=True).astype(jnp.int32)
+    qlen0 = jnp.sum(active).astype(jnp.int32)
+    kinds0 = jnp.full((P,), KIND_FAIL, jnp.int32)
+    idxs0 = jnp.full((P,), -1, jnp.int32)
+
+    def sweep_cond(c):
+        _state, _queue, qlen, _kinds, _idxs, progress, noslot = c
+        return progress & (qlen > 0) & ~noslot
+
+    def sweep_body(c):
+        state, queue, qlen, kinds, idxs, _progress, noslot0 = c
+
+        def inner_cond(ic):
+            i = ic[0]
+            return i < qlen
+
+        def inner_body(ic):
+            i, state, nq, nqlen, kinds, idxs, noslot = ic
+            p = queue[i]
+            pod = jax.tree_util.tree_map(lambda a: a[p], pods_xs)
+            state, (k, idx) = step(state, pod)
+            kinds = kinds.at[p].set(k)
+            idxs = idxs.at[p].set(idx)
+            requeue = k == KIND_FAIL
+            nq = nq.at[nqlen].set(jnp.where(requeue, p, nq[nqlen]))
+            nqlen = nqlen + requeue.astype(jnp.int32)
+            noslot = noslot | (k == KIND_NO_SLOT)
+            return i + jnp.int32(1), state, nq, nqlen, kinds, idxs, noslot
+
+        i0 = (
+            jnp.int32(0),
+            state,
+            jnp.zeros((P,), jnp.int32),
+            jnp.int32(0),
+            kinds,
+            idxs,
+            noslot0,
+        )
+        _i, state, nq, nqlen, kinds, idxs, noslot = lax.while_loop(
+            inner_cond, inner_body, i0
+        )
+        progress = nqlen < qlen
+        return state, nq, nqlen, kinds, idxs, progress, noslot
+
+    state, _queue, _qlen, kinds, idxs, _prog, _noslot = lax.while_loop(
+        sweep_cond,
+        sweep_body,
+        (init, queue0, qlen0, kinds0, idxs0, jnp.bool_(True), jnp.bool_(False)),
+    )
+    return FFDResult(kind=kinds, index=idxs, state=state)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _solve_ffd_sweeps_fresh_jit(problem: SchedulingProblem, max_claims: int) -> FFDResult:
+    problem = _pad_lanes_mult32(problem)
+    return _sweeps_impl(problem, initial_state(problem, max_claims), max_claims)
+
+
+def solve_ffd_sweeps(
+    problem: SchedulingProblem, max_claims: int, init: Optional[FFDState] = None
+) -> FFDResult:
+    """Run ALL retry passes to convergence in one device launch (see
+    _sweeps_impl). The production provisioning entrypoint. Always starts from
+    a fresh state: the backend's sweeps mode never carries state across
+    launches (nothing is relaxable, so there is no second launch)."""
+    assert init is None, "sweeps mode always runs a whole solve in one launch"
+    return _solve_ffd_sweeps_fresh_jit(problem, max_claims)
 
 
 # integer "unbounded" sentinel for analytic pod-count capacities; large enough
@@ -1083,7 +1211,19 @@ def solve_ffd_runs(
 ) -> FFDResult:
     """Run one pack pass through the run-compressed solver."""
     if init is None:
-        init = initial_state(problem, max_claims)
+        return _solve_ffd_runs_fresh_jit(
+            problem, max_claims, max_run_bucket(problem), has_topo_runs(problem)
+        )
     return _solve_ffd_runs_jit(
         problem, init, max_run_bucket(problem), has_topo_runs(problem)
     )
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def _solve_ffd_runs_fresh_jit(
+    problem: SchedulingProblem, max_claims: int, max_run: int, with_topo: bool
+) -> FFDResult:
+    """Fresh-state runs variant: initial_state traced into the program (one
+    launch per solve; see _solve_ffd_fresh_jit)."""
+    init = initial_state(_pad_lanes_mult32(problem), max_claims)
+    return _solve_ffd_runs_jit(problem, init, max_run, with_topo)

@@ -20,6 +20,7 @@ from __future__ import annotations
 import copy
 from typing import Dict, Optional, Sequence
 
+import jax
 import numpy as np
 
 from karpenter_tpu.apis import labels as wk
@@ -48,6 +49,7 @@ from karpenter_tpu.ops.ffd import (
     KIND_NO_SLOT,
     solve_ffd,
     solve_ffd_runs,
+    solve_ffd_sweeps,
 )
 
 # The per-pod scan is the production default. Measured on the reference's
@@ -145,7 +147,9 @@ class JaxSolver(SolverBackend):
         # (utils/jaxtools.py)
         from karpenter_tpu.utils.jaxtools import bound_executable_maps
 
+        t0 = _now()
         bound_executable_maps()
+        t0 = _t("maps-guard", t0)
         max_claims = min(self.claim_slots, pow2_bucket(len(pods)))
         while True:
             try:
@@ -165,6 +169,7 @@ class JaxSolver(SolverBackend):
         pod_requirements_override, topology, cluster_pods, domains, max_claims,
         pod_volumes=None,
     ) -> SolveResult:
+        t_init = _now()
         # copy-on-write: pods are only copied when relaxation is about to
         # mutate them — the common all-scheduled case pays no deepcopy
         work = list(pods)
@@ -186,10 +191,26 @@ class JaxSolver(SolverBackend):
         )
         encoder = Encoder(self.well_known)
 
+        # When nothing in the batch can relax, the retry passes are pure
+        # requeue-until-no-progress — fused into ONE device program
+        # (solve_ffd_sweeps): attempt order, carried state, and NO_SLOT
+        # timing are identical to the pass-per-launch loop, so this is an
+        # exact fast path, not an approximation. Any relaxable pod (or a
+        # PreferNoSchedule blanket, which makes every pod relaxable once)
+        # keeps the per-pass loop: the reference relaxes one notch per
+        # failed attempt (scheduler.go:157-168) and that requires host
+        # re-encoding between passes.
+        use_sweeps = (
+            not _USE_RUNS
+            and not prefs.tolerate_prefer_no_schedule
+            and not any(Preferences.is_relaxable(p) for p in work)
+        )
+        _t("pre-loop-init", t_init)
         out = SolveResult()
         pod_kinds: Dict[int, tuple] = {}  # original index -> (kind, bin index)
         state = None
         meta = None
+        np_final = None
         prev_group_keys = None
         queue = list(range(len(work)))
         while queue:
@@ -238,11 +259,33 @@ class JaxSolver(SolverBackend):
                 state = _remap_group_state(state, prev_group_keys, group_keys, problem)
             prev_group_keys = group_keys
             t0 = _t("group-remap", t0)
-            solve = solve_ffd_runs if _USE_RUNS else solve_ffd
+            if _USE_RUNS:
+                solve = solve_ffd_runs
+            elif use_sweeps:
+                solve = solve_ffd_sweeps
+            else:
+                solve = solve_ffd
             result = solve(problem, max_claims, init=state)
             state = result.state
-            kinds = np.asarray(result.kind)
-            indices = np.asarray(result.index)
+            # one batched fetch: device_get issues async copies for all
+            # buffers before waiting, so the pass pays a single runtime
+            # roundtrip instead of one per array. The sweeps fast path always
+            # exits after this pass, so the final-decode state rides the same
+            # roundtrip.
+            if use_sweeps:
+                kinds, indices, *np_final = jax.device_get(
+                    (
+                        result.kind,
+                        result.index,
+                        state.claim_open,
+                        state.claim_tpl,
+                        state.claim_it_ok,
+                        state.claim_requests,
+                    )
+                )
+            else:
+                kinds, indices = jax.device_get((result.kind, result.index))
+                np_final = None
             t0 = _t("device-solve", t0)
             if (kinds[: len(queue)] == KIND_NO_SLOT).any():
                 raise _SlotOverflow()
@@ -258,25 +301,31 @@ class JaxSolver(SolverBackend):
                 else:
                     failed.append(orig)
             relaxed_any = False
-            for orig in failed:
-                if orig not in copied:
-                    work[orig] = copy.deepcopy(work[orig])
-                    copied.add(orig)
-                if prefs.relax(work[orig]) is not None:
-                    relaxed_any = True
-                    topo.update(work[orig])
+            if not use_sweeps:  # sweeps imply nothing is relaxable
+                for orig in failed:
+                    if orig not in copied:
+                        work[orig] = copy.deepcopy(work[orig])
+                        copied.add(orig)
+                    if prefs.relax(work[orig]) is not None:
+                        relaxed_any = True
+                        topo.update(work[orig])
             t0 = _t("decode+relax", t0)
-            if not progress and not relaxed_any:
+            if use_sweeps or (not progress and not relaxed_any):
                 for orig in failed:
                     out.failures[orig] = FAIL_INCOMPATIBLE
                 break
             queue = failed
 
-        # -- decode final bin state
-        claim_open = np.asarray(state.claim_open) if state is not None else np.zeros(0)
-        claim_tpl = np.asarray(state.claim_tpl) if state is not None else None
-        claim_it_ok = np.asarray(state.claim_it_ok) if state is not None else None
-        claim_requests = np.asarray(state.claim_requests) if state is not None else None
+        # -- decode final bin state (single batched fetch, see device_get note)
+        t_dec = _now()
+        if state is not None and np_final is not None:
+            claim_open, claim_tpl, claim_it_ok, claim_requests = np_final
+        elif state is not None:
+            claim_open, claim_tpl, claim_it_ok, claim_requests = jax.device_get(
+                (state.claim_open, state.claim_tpl, state.claim_it_ok, state.claim_requests)
+            )
+        else:
+            claim_open, claim_tpl, claim_it_ok, claim_requests = np.zeros(0), None, None, None
         slot_to_claim = {}
         for slot in range(max_claims):
             if slot < len(claim_open) and claim_open[slot]:
@@ -302,4 +351,5 @@ class JaxSolver(SolverBackend):
                 out.node_pods.setdefault(meta.node_names[index], []).append(orig)
             else:
                 slot_to_claim[index].pod_indices.append(orig)
+        _t("final-decode", t_dec)
         return out

@@ -1,0 +1,72 @@
+"""Dev tool: does per-launch overhead scale with the number of in/out buffers
+through the axon tunnel?"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+import __graft_entry__
+
+__graft_entry__._respect_platform_env()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+print(f"platform: {jax.devices()[0].platform}", file=sys.stderr)
+
+
+def timeit(label, fn, n=8):
+    fn()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    per = (time.perf_counter() - t0) / n
+    print(f"{label}: {per*1e3:.1f} ms")
+
+
+for n_in, n_out in [(2, 1), (40, 1), (2, 20), (40, 20), (60, 40)]:
+    ins = [np.full((8, 8), i, np.float32) for i in range(n_in)]
+
+    def make(n_out):
+        @jax.jit
+        def f(*xs):
+            s = sum(jnp.sum(x) for x in xs)
+            return tuple(s + i for i in range(n_out))
+
+        return f
+
+    f = make(n_out)
+
+    def run(f=f, ins=ins):
+        out = f(*ins)
+        return np.asarray(out[0])
+
+    timeit(f"jit {n_in} inputs -> {n_out} outputs", run)
+
+# device-resident inputs variant
+ins_dev = [jax.device_put(np.full((8, 8), i, np.float32)) for i in range(40)]
+f40 = None
+
+
+@jax.jit
+def g(*xs):
+    s = sum(jnp.sum(x) for x in xs)
+    return tuple(s + i for i in range(20))
+
+
+def run_dev():
+    out = g(*ins_dev)
+    return np.asarray(out[0])
+
+
+timeit("jit 40 dev inputs -> 20 outputs", run_dev)
+
+# chained: do launches with many buffers pipeline?
+def chained():
+    out = g(*ins_dev)
+    out2 = g(*[o.reshape(1) * jnp.ones((8, 8)) for o in out[:40 // 2] * 2])
+    return np.asarray(out2[0])
+
+
+timeit("2 chained 40-buffer launches + 1 fetch", chained)

@@ -1,0 +1,79 @@
+"""Dev tool: measure axon tunnel roundtrip costs precisely.
+
+block_until_ready on axon may not truly wait; np.asarray / device_get is the
+ground truth for host-visible completion.
+"""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+import __graft_entry__
+
+__graft_entry__._respect_platform_env()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+print(f"platform: {jax.devices()[0].platform}  jax {jax.__version__}", file=sys.stderr)
+
+
+def timeit(label, fn, n=10):
+    fn()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    per = (time.perf_counter() - t0) / n
+    print(f"{label}: {per*1e3:.1f} ms")
+    return per
+
+
+# 1. pure fetch RTT: tiny device-resident array
+tiny = jax.device_put(np.ones((4,), np.float32))
+timeit("fetch tiny device array (np.asarray)", lambda: np.asarray(tiny))
+
+# 2. fetch of 4 separate tiny arrays vs one device_get of a tuple
+arrs = [jax.device_put(np.ones((i + 4,), np.float32)) for i in range(4)]
+timeit("fetch 4 tiny arrays sequentially", lambda: [np.asarray(a) for a in arrs])
+timeit("jax.device_get tuple of 4", lambda: jax.device_get(tuple(arrs)))
+
+# 3. tiny jit execute + fetch (1 roundtrip? 2?)
+@jax.jit
+def inc(x):
+    return x + 1
+
+
+timeit("jit(tiny) + fetch", lambda: np.asarray(inc(tiny)))
+
+# 4. medium fetch (1 MB)
+med = jax.device_put(np.ones((256, 1024), np.float32))
+timeit("fetch 1MB array", lambda: np.asarray(med))
+
+# 5. H2D then execute then fetch (full cycle with host input)
+host_in = np.ones((512, 4, 128), bool)
+
+
+@jax.jit
+def reduce_it(x):
+    return jnp.sum(x)
+
+
+timeit("H2D 256KB + jit + fetch scalar", lambda: np.asarray(reduce_it(host_in)))
+
+# 6. execute-only cost estimation: launch K chained jits then one fetch
+@jax.jit
+def chain(x):
+    for _ in range(8):
+        x = x + 1
+    return x
+
+
+def chained():
+    y = tiny
+    for _ in range(8):
+        y = inc(y)
+    return np.asarray(y)
+
+
+timeit("8 chained tiny jit calls + 1 fetch", chained)

@@ -1,0 +1,94 @@
+"""Dev tool: isolate the fixed per-call cost of the compiled FFD scan.
+
+Encodes one small problem, then times repeated solve_ffd calls (same shapes,
+cached executable) and a few synthetic scans of varying body size.
+"""
+
+import random
+import sys
+import time
+
+sys.path.insert(0, ".")
+import __graft_entry__
+
+__graft_entry__._respect_platform_env()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+print(f"platform: {jax.devices()[0].platform}", file=sys.stderr)
+
+from bench import make_diverse_pods
+from karpenter_tpu.apis.nodepool import NodePool
+from karpenter_tpu.apis.objects import ObjectMeta
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.ops.ffd import initial_state, solve_ffd
+from karpenter_tpu.ops.padding import pad_problem
+from karpenter_tpu.solver.encode import (
+    Encoder,
+    domains_from_instance_types,
+    template_from_nodepool,
+)
+from karpenter_tpu.provisioning.topology import Topology
+
+rng = random.Random(42)
+its = instance_types(400)
+tpl = template_from_nodepool(
+    NodePool(metadata=ObjectMeta(name="default")), its, range(len(its))
+)
+pods = make_diverse_pods(10, rng)
+domains = domains_from_instance_types(its, [tpl])
+topo = Topology(domains, batch_pods=pods, cluster_pods=[])
+enc = Encoder(None)
+from karpenter_tpu.apis import labels as wk
+
+enc = Encoder(wk.WELL_KNOWN_LABELS)
+encoded = enc.encode(pods, its, [tpl], [], topology=topo, num_claim_slots=16)
+problem = pad_problem(encoded.problem)
+print(
+    f"P={problem.num_pods} T={problem.num_instance_types} K={problem.num_keys} "
+    f"V={problem.num_lanes} G={problem.grp_key.shape[0]} N={problem.num_nodes}",
+    file=sys.stderr,
+)
+
+r = solve_ffd(problem, 16)
+jax.block_until_ready(r.kind)
+N = 5
+t0 = time.perf_counter()
+for _ in range(N):
+    r = solve_ffd(problem, 16)
+    jax.block_until_ready(r.kind)
+per = (time.perf_counter() - t0) / N
+print(f"solve_ffd per-call (16 slots, P={problem.num_pods}): {per*1e3:.1f} ms")
+
+# wait on kind only vs full state
+t0 = time.perf_counter()
+for _ in range(N):
+    r = solve_ffd(problem, 16)
+    np.asarray(r.kind)
+per = (time.perf_counter() - t0) / N
+print(f"solve_ffd per-call, np.asarray(kind): {per*1e3:.1f} ms")
+
+# synthetic scans: body = [C,T] product like the claim phase
+for steps, C, T in [(16, 16, 512), (128, 16, 512), (16, 128, 512), (10240, 128, 512)]:
+    a = jnp.asarray(np.random.default_rng(0).random((C, 4, 16)).astype(np.float32))
+    b = jnp.asarray(np.random.default_rng(1).random((T, 4, 16)).astype(np.float32))
+    xs = jnp.asarray(np.random.default_rng(2).random((steps, 4, 16)).astype(np.float32))
+
+    @jax.jit
+    def scan_fn(a, b, xs):
+        def step(carry, x):
+            m = jnp.einsum("ckv,tkv->ct", carry + x[None], b)
+            carry = carry + 1e-6 * jnp.sum(m) + 1e-9 * jnp.sum(x)
+            return carry, jnp.sum(m)
+
+        carry, ys = jax.lax.scan(step, a, xs)
+        return ys
+
+    jax.block_until_ready(scan_fn(a, b, xs))
+    t0 = time.perf_counter()
+    for _ in range(3):
+        jax.block_until_ready(scan_fn(a, b, xs))
+    per = (time.perf_counter() - t0) / 3
+    print(f"synthetic scan steps={steps} C={C} T={T}: {per*1e3:.1f} ms")

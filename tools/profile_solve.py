@@ -1,0 +1,49 @@
+"""Dev tool: per-pass timing breakdown of JaxSolver.solve on chosen shapes.
+
+Usage: KARPENTER_TPU_TIMING=1 python tools/profile_solve.py [pods ...]
+Runs each shape twice (warm compile, then steady) against the bench workload
+(400 fake instance types, makeDiversePods mix) and prints the pass structure.
+"""
+
+import random
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+import __graft_entry__  # noqa: F401  (respects JAX_PLATFORMS)
+
+__graft_entry__._respect_platform_env()
+
+import jax
+
+print(f"platform: {jax.devices()[0].platform}", file=sys.stderr)
+
+from bench import make_diverse_pods
+from karpenter_tpu.apis.nodepool import NodePool
+from karpenter_tpu.apis.objects import ObjectMeta
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.solver.encode import template_from_nodepool
+from karpenter_tpu.solver.jax_backend import JaxSolver
+
+shapes = [int(a) for a in sys.argv[1:]] or [10, 100, 10000]
+rng = random.Random(42)
+its = instance_types(400)
+tpl = template_from_nodepool(
+    NodePool(metadata=ObjectMeta(name="default")), its, range(len(its))
+)
+solver = JaxSolver()
+
+for pods_n in shapes:
+    pods = make_diverse_pods(pods_n, rng)
+    t0 = time.perf_counter()
+    solver.solve(pods, its, [tpl])
+    warm = time.perf_counter() - t0
+    print(f"=== shape pods={pods_n} warm={warm:.3f}s; steady pass:", file=sys.stderr)
+    t0 = time.perf_counter()
+    r = solver.solve(pods, its, [tpl])
+    steady = time.perf_counter() - t0
+    print(
+        f"=== shape pods={pods_n} steady={steady:.3f}s scheduled={r.num_scheduled()}",
+        file=sys.stderr,
+    )

@@ -1,0 +1,83 @@
+"""Dev tool: attribute per-step scan cost by ablating step phases.
+
+Runs itself as a subprocess per KARPENTER_TPU_ABLATE config (the flag is read
+at module import). Times ONE scan pass (solve_ffd) over the 10k bench problem
+at its production bucket — ablated results are semantically wrong; only the
+timing matters.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+CONFIGS = [
+    "",
+    "citgate",
+    "ctopo",
+    "ttopo",
+    "titgate",
+    "record",
+    "citgate,ctopo",
+    "citgate,ctopo,ttopo,titgate,record",
+]
+
+if os.environ.get("_PROFILE_STEP_CHILD") != "1":
+    for cfg in CONFIGS:
+        env = dict(os.environ)
+        env["_PROFILE_STEP_CHILD"] = "1"
+        env["KARPENTER_TPU_ABLATE"] = cfg
+        subprocess.run([sys.executable, __file__], env=env)
+    sys.exit(0)
+
+sys.path.insert(0, ".")
+import __graft_entry__
+
+__graft_entry__._respect_platform_env()
+
+import random
+
+import jax
+import numpy as np
+
+from bench import make_diverse_pods
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodepool import NodePool
+from karpenter_tpu.apis.objects import ObjectMeta
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.ops.ffd import solve_ffd
+from karpenter_tpu.ops.padding import pad_problem
+from karpenter_tpu.provisioning.topology import Topology
+from karpenter_tpu.solver.encode import (
+    Encoder,
+    domains_from_instance_types,
+    template_from_nodepool,
+)
+
+rng = random.Random(42)
+its = instance_types(400)
+tpl = template_from_nodepool(
+    NodePool(metadata=ObjectMeta(name="default")), its, range(len(its))
+)
+pods = make_diverse_pods(10000, rng)
+domains = domains_from_instance_types(its, [tpl])
+topo = Topology(domains, batch_pods=pods, cluster_pods=[])
+enc = Encoder(wk.WELL_KNOWN_LABELS)
+encoded = enc.encode(pods, its, [tpl], [], topology=topo, num_claim_slots=128)
+problem = pad_problem(encoded.problem)
+
+t0 = time.perf_counter()
+r = solve_ffd(problem, 128)
+np.asarray(r.kind)
+compile_s = time.perf_counter() - t0
+t0 = time.perf_counter()
+r = solve_ffd(problem, 128)
+np.asarray(r.kind)
+steady = time.perf_counter() - t0
+P = problem.num_pods
+print(
+    f"ablate={os.environ.get('KARPENTER_TPU_ABLATE', '')!r:40s} "
+    f"steps={P} steady={steady:.3f}s per_step={steady / P * 1e6:.1f}us "
+    f"(compile {compile_s:.1f}s)",
+    flush=True,
+)

@@ -1,0 +1,74 @@
+"""Dev tool: isolate per-call dispatch/transfer overhead through the TPU
+tunnel — a jitted reduction over a problem-sized pytree, called with (a) fresh
+numpy arrays each time, (b) device-resident arrays."""
+
+import sys
+import time
+
+sys.path.insert(0, ".")
+import __graft_entry__
+
+__graft_entry__._respect_platform_env()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+print(f"platform: {jax.devices()[0].platform}", file=sys.stderr)
+
+# ~problem-shaped inputs: T=512 it-side lanes + pod-side smalls
+T, K, V, O, R, P, C = 512, 4, 128, 8, 8, 16, 16
+rng = np.random.default_rng(0)
+arrays = {
+    "it_adm": rng.random((T, K, V)) < 0.5,
+    "it_alloc": rng.random((T, R)).astype(np.float32),
+    "it_cap": rng.random((T, R)).astype(np.float32),
+    "offer_zone": rng.integers(0, V, (T, O)).astype(np.int32),
+    "offer_ct": rng.integers(0, V, (T, O)).astype(np.int32),
+    "offer_ok": rng.random((T, O)) < 0.5,
+    **{f"pod_{i}": rng.random((P, K, V)) < 0.5 for i in range(4)},
+    **{f"small_{i}": rng.random((P, R)).astype(np.float32) for i in range(20)},
+}
+
+
+@jax.jit
+def f(d):
+    return sum(jnp.sum(v) for v in d.values())
+
+
+# warm
+jax.block_until_ready(f(arrays))
+
+N = 10
+t0 = time.perf_counter()
+for _ in range(N):
+    jax.block_until_ready(f(arrays))
+host_t = (time.perf_counter() - t0) / N
+
+dev = jax.device_put(arrays)
+jax.block_until_ready(f(dev))
+t0 = time.perf_counter()
+for _ in range(N):
+    jax.block_until_ready(f(dev))
+dev_t = (time.perf_counter() - t0) / N
+
+# single big array of same total bytes
+total = sum(v.nbytes for v in arrays.values())
+big = rng.random(total // 4).astype(np.float32)
+
+
+@jax.jit
+def g(x):
+    return jnp.sum(x)
+
+
+jax.block_until_ready(g(big))
+t0 = time.perf_counter()
+for _ in range(N):
+    jax.block_until_ready(g(big))
+big_t = (time.perf_counter() - t0) / N
+
+print(f"total input bytes: {total/1e6:.2f} MB over {len(arrays)} arrays")
+print(f"per-call with numpy inputs   : {host_t*1e3:.1f} ms")
+print(f"per-call with device inputs  : {dev_t*1e3:.1f} ms")
+print(f"per-call one big numpy array : {big_t*1e3:.1f} ms")
