@@ -218,6 +218,10 @@ class SchedulingProblem:
     # RUN_SINGLE per-pod step / RUN_ANALYTIC closed-form commit /
     # RUN_TOPO light per-pod inner loop over topology counters
     run_mode: Any = None  # i32[RN]
+    # dense (zone-lane x ct-lane) availability bool[T, Zb, Cb] — the
+    # MXU-matmul form of has_offering (masks.has_offering_zc); None when a
+    # sub-vocabulary exceeds the 32-lane window (fallback: lane gathers)
+    offer_zc: Any = None
 
     @property
     def num_runs(self) -> int:

@@ -232,14 +232,22 @@ def _make_it_gate(problem, statics):
             state_rows, state_packed, state_neg, problem.it_reqs, it_packed, it_neg
         )  # [B, T]
         fit = masks.fits(requests[:, None, :], problem.it_alloc[None, :, :])  # [B, T]
-        offer = vmap(
-            lambda adm: masks.has_offering(
-                adm, ZONE_KEY, CT_KEY, problem.offer_zone, problem.offer_ct, problem.offer_ok
-            )
-        )(state_rows.admitted)  # [B, T]
+        offer = _offer_rows(problem, state_rows.admitted)  # [B, T]
         return prior_ok & compat & fit & offer
 
     return it_gate
+
+
+def _offer_rows(problem: SchedulingProblem, admitted) -> jnp.ndarray:
+    """[B, T] has_offering over a batch of bin states — MXU matmul when the
+    dense offer_zc table exists, per-offering lane gathers otherwise."""
+    if problem.offer_zc is not None:
+        return masks.has_offering_zc(admitted, ZONE_KEY, CT_KEY, problem.offer_zc)
+    return vmap(
+        lambda adm: masks.has_offering(
+            adm, ZONE_KEY, CT_KEY, problem.offer_zone, problem.offer_ct, problem.offer_ok
+        )
+    )(admitted)
 
 
 def _mix_req_rows(cur: ReqTensor, upd: ReqTensor, hot) -> ReqTensor:
@@ -346,6 +354,17 @@ def _make_step(problem: SchedulingProblem, statics, C: int):
             grp_selects=grp_selects,
             grp_owned=grp_owned,
         )
+        # NOTE on lax.cond here: conditionals only pay off when branch
+        # outputs are small — a cond whose identity branch passes [B, K, V]
+        # requirement tensors through forces per-step copies that cost more
+        # than the gate it skips (measured +0.15s on the 10k bench). So the
+        # topo gates stay unconditional; only the template phase (small
+        # row outputs) and record (two [G, V] outputs) are conditional.
+
+        def gated(merged, allow, registered):
+            return topo_gate(
+                problem, state.grp_counts, registered, topo_pod, merged, allow
+            )
 
         # -- 1. existing nodes (scheduler.go:240-244; existingnode.go:64-124)
         node_requests2 = state.node_requests + pod_requests[None, :]
@@ -359,9 +378,7 @@ def _make_step(problem: SchedulingProblem, statics, C: int):
             state.node_vol_used + pod_vols[None, :] <= problem.node_vol_limits, axis=-1
         )
         node_merged = _intersect_rows(state.node_req, pod_req)
-        node_topo_ok, node_final = topo_gate(
-            problem, state.grp_counts, state.grp_registered, topo_pod, node_merged, no_allow
-        )
+        node_topo_ok, node_final = gated(node_merged, no_allow, state.grp_registered)
         node_ok = tol_node & node_fit & node_compat & node_port_ok & node_vol_ok & node_topo_ok
         node_pick = _first_true(node_ok)
         any_node = jnp.any(node_ok)
@@ -374,8 +391,8 @@ def _make_step(problem: SchedulingProblem, statics, C: int):
         if "ctopo" in _ABLATE:
             claim_topo_ok, claim_final = jnp.ones((C,), bool), claim_merged
         else:
-            claim_topo_ok, claim_final = topo_gate(
-                problem, state.grp_counts, state.grp_registered, topo_pod, claim_merged, wellknown
+            claim_topo_ok, claim_final = gated(
+                claim_merged, wellknown, state.grp_registered
             )
         claim_requests2 = state.claim_requests + pod_requests[None, :]
         if "citgate" in _ABLATE:
@@ -397,36 +414,85 @@ def _make_step(problem: SchedulingProblem, statics, C: int):
 
         # -- 3. fresh claim from templates, weight order (scheduler.go:256-283);
         # the prospective slot's hostname is minted before evaluation
-        # (nodeclaim.go:46-63) and its lane registered for topology if opened
+        # (nodeclaim.go:46-63) and its lane registered for topology if opened.
+        # The whole phase runs under lax.cond: it can only influence the
+        # outcome when the node and claim phases both failed and a slot is
+        # free, which on large packs is a small minority of steps (opens +
+        # terminal failures).
         free_slot = _first_true(~state.claim_open)
         has_slot = jnp.any(~state.claim_open)
         # hostname minting is active only when the encoder allotted claim
         # hostname lanes (static shape decision)
         mint_hostnames = problem.claim_hostname_lane.shape[0] > 0
-        tpl_merged, tpl_compat, host_onehot = _fresh_template_rows(
-            problem, lv, ln, wellknown, pod_req, free_slot
-        )
-        # the new hostname is registered before the gate evaluates
-        reg_for_tpl = state.grp_registered | (
-            (problem.grp_key == HOSTNAME_KEY)[:, None] & host_onehot[None, :]
-        )
-        if "ttopo" in _ABLATE:
-            tpl_topo_ok, tpl_final = jnp.ones((TPL,), bool), tpl_merged
-        else:
-            tpl_topo_ok, tpl_final = topo_gate(
-                problem, state.grp_counts, reg_for_tpl, topo_pod, tpl_merged, wellknown
+        need_tpl = (~any_node) & (~any_claim) & has_slot & pod_is_active
+
+        def eval_tpl():
+            tpl_requests2 = problem.tpl_overhead + pod_requests[None, :]
+            tpl_merged, tpl_compat, host_onehot = _fresh_template_rows(
+                problem, lv, ln, wellknown, pod_req, free_slot
             )
-        tpl_requests2 = problem.tpl_overhead + pod_requests[None, :]
-        within_limits = masks.fits(
-            problem.it_cap[None, :, :], state.remaining[:, None, :]
-        )  # [TPL, T]
-        if "titgate" in _ABLATE:
-            tpl_it_ok2 = problem.tpl_it_ok & within_limits
-        else:
-            tpl_it_ok2 = it_gate(tpl_final, tpl_requests2, problem.tpl_it_ok & within_limits)
-        tpl_ok = tol_tpl & tpl_compat & tpl_topo_ok & jnp.any(tpl_it_ok2, axis=-1)
-        tpl_pick = _first_true(tpl_ok)
-        any_tpl = jnp.any(tpl_ok)
+            # the new hostname is registered before the gate evaluates
+            reg_for_tpl = state.grp_registered | (
+                (problem.grp_key == HOSTNAME_KEY)[:, None] & host_onehot[None, :]
+            )
+            if "ttopo" in _ABLATE:
+                tpl_topo_ok, tpl_final = jnp.ones((TPL,), bool), tpl_merged
+            else:
+                tpl_topo_ok, tpl_final = gated(tpl_merged, wellknown, reg_for_tpl)
+            within_limits = masks.fits(
+                problem.it_cap[None, :, :], state.remaining[:, None, :]
+            )  # [TPL, T]
+            if "titgate" in _ABLATE:
+                tpl_it_ok2 = problem.tpl_it_ok & within_limits
+            else:
+                tpl_it_ok2 = it_gate(
+                    tpl_final, tpl_requests2, problem.tpl_it_ok & within_limits
+                )
+            tpl_ok = tol_tpl & tpl_compat & tpl_topo_ok & jnp.any(tpl_it_ok2, axis=-1)
+            tpl_pick = _first_true(tpl_ok)
+            pick_c = jnp.minimum(tpl_pick, TPL - 1)
+            slot_req = tpl_final.row(pick_c)
+            tpl_row_it_ok = tpl_it_ok2[pick_c]
+            max_cap = jnp.max(
+                jnp.where(tpl_row_it_ok[:, None], problem.it_cap, 0.0), axis=0
+            )  # [R]
+            return (
+                jnp.any(tpl_ok),
+                tpl_pick.astype(jnp.int32),
+                slot_req,
+                tpl_requests2[pick_c],
+                tpl_row_it_ok,
+                max_cap,
+                host_onehot,
+            )
+
+        def skip_tpl():
+            R = problem.tpl_overhead.shape[1]
+            return (
+                jnp.bool_(False),
+                jnp.int32(0),
+                ReqTensor(
+                    admitted=jnp.zeros((K, V), bool),
+                    comp=jnp.zeros((K,), bool),
+                    gt=jnp.zeros((K,), jnp.int32),
+                    lt=jnp.zeros((K,), jnp.int32),
+                    defined=jnp.zeros((K,), bool),
+                ),
+                jnp.zeros((R,), problem.tpl_overhead.dtype),
+                jnp.zeros((T,), bool),
+                jnp.zeros((R,), problem.it_cap.dtype),
+                jnp.zeros((V,), bool),
+            )
+
+        (
+            any_tpl,
+            tpl_pick,
+            slot_req,
+            tpl_row_requests,
+            tpl_row_it_ok,
+            max_cap,
+            host_onehot,
+        ) = lax.cond(need_tpl, eval_tpl, skip_tpl)
 
         # with every slot taken, free_slot clamps to slot 0 and the template
         # phase evaluated a USED hostname — its verdict is meaningless, so the
@@ -469,8 +535,8 @@ def _make_step(problem: SchedulingProblem, statics, C: int):
         new_node_used_ports = state.node_used_ports | (node_hot[:, None] & pod_ports[None, :])
         new_node_vol_used = state.node_vol_used + node_hot[:, None].astype(jnp.int32) * pod_vols[None, :]
 
-        # claim commit (nodeclaim.go:111-118)
-        slot_req = gather_row(tpl_final, tpl_pick, TPL)
+        # claim commit (nodeclaim.go:111-118); slot_req / tpl_row_* come from
+        # the conditional template phase above
         new_claim_req = mix_req(
             mix_req(state.claim_req, claim_final, claim_hot),
             ReqTensor(
@@ -482,13 +548,11 @@ def _make_step(problem: SchedulingProblem, statics, C: int):
             ),
             slot_hot,
         )
-        tpl_row_requests = tpl_requests2[jnp.minimum(tpl_pick, TPL - 1)]
         new_claim_requests = jnp.where(
             claim_hot[:, None],
             claim_requests2,
             jnp.where(slot_hot[:, None], tpl_row_requests[None, :], state.claim_requests),
         )
-        tpl_row_it_ok = tpl_it_ok2[jnp.minimum(tpl_pick, TPL - 1)]
         new_claim_it_ok = jnp.where(
             claim_hot[:, None],
             claim_it_ok2,
@@ -505,9 +569,6 @@ def _make_step(problem: SchedulingProblem, statics, C: int):
         # registers its hostname lane for hostname topologies
         opened = kind == KIND_NEW_CLAIM
         opened_tpl_hot = (jnp.arange(TPL) == tpl_pick) & opened
-        max_cap = jnp.max(
-            jnp.where(tpl_row_it_ok[:, None], problem.it_cap, 0.0), axis=0
-        )  # [R]
         new_remaining = jnp.where(
             opened_tpl_hot[:, None], state.remaining - max_cap[None, :], state.remaining
         )
@@ -518,29 +579,34 @@ def _make_step(problem: SchedulingProblem, statics, C: int):
             & host_onehot[None, :]
         )
 
-        # topology record for the chosen bin (topology.go:125-148)
+        # topology record for the chosen bin (topology.go:125-148) — an
+        # identity unless a placement happened AND some group selects or is
+        # owned by this pod, so it runs under lax.cond (generic pods with
+        # labels no selector matches skip it entirely)
         committed = (kind == KIND_NODE) | (kind == KIND_CLAIM) | (kind == KIND_NEW_CLAIM)
-        chosen_final = gather_row(node_final, node_pick, N) if N > 0 else None
-        claim_row = gather_row(claim_final, claim_pick, C)
-        slot_row = slot_req
+        should_record = committed & (
+            jnp.any(topo_pod.grp_selects) | jnp.any(topo_pod.grp_owned)
+        )
 
-        def pick_rows(a, b, cond):
-            return jax.tree_util.tree_map(
-                lambda x, y: jnp.where(
-                    jnp.reshape(cond, (1,) * x.ndim), x, y
-                ),
-                a,
-                b,
-            )
+        def do_record():
+            chosen_final = gather_row(node_final, node_pick, N) if N > 0 else None
+            claim_row = gather_row(claim_final, claim_pick, C)
+            slot_row = slot_req
 
-        rec_row = pick_rows(claim_row, slot_row, kind == KIND_CLAIM)
-        if N > 0:
-            rec_row = pick_rows(chosen_final, rec_row, kind == KIND_NODE)
-        rec_allow = jnp.where(kind == KIND_NODE, no_allow, wellknown)
-        if "record" in _ABLATE:
-            new_counts = state.grp_counts
-        else:
-            new_counts, new_registered = record(
+            def pick_rows(a, b, cond):
+                return jax.tree_util.tree_map(
+                    lambda x, y: jnp.where(
+                        jnp.reshape(cond, (1,) * x.ndim), x, y
+                    ),
+                    a,
+                    b,
+                )
+
+            rec_row = pick_rows(claim_row, slot_row, kind == KIND_CLAIM)
+            if N > 0:
+                rec_row = pick_rows(chosen_final, rec_row, kind == KIND_NODE)
+            rec_allow = jnp.where(kind == KIND_NODE, no_allow, wellknown)
+            return record(
                 problem,
                 state.grp_counts,
                 new_registered,
@@ -550,6 +616,13 @@ def _make_step(problem: SchedulingProblem, statics, C: int):
                 committed,
                 lv,
                 ln,
+            )
+
+        if "record" in _ABLATE:
+            new_counts = state.grp_counts
+        else:
+            new_counts, new_registered = lax.cond(
+                should_record, do_record, lambda: (state.grp_counts, new_registered)
             )
 
         index = jnp.where(
@@ -769,11 +842,7 @@ def _make_run_commit(problem: SchedulingProblem, statics, C: int, max_run: int):
     mint_hostnames = problem.claim_hostname_lane.shape[0] > 0
 
     def has_offering_rows(admitted):
-        return vmap(
-            lambda adm: masks.has_offering(
-                adm, ZONE_KEY, CT_KEY, problem.offer_zone, problem.offer_ct, problem.offer_ok
-            )
-        )(admitted)
+        return _offer_rows(problem, admitted)
 
     def commit(state: FFDState, pod, start, length, active_arr):
         (

@@ -139,6 +139,26 @@ def packed_pairwise_compat(
     return jnp.all(~both_defined | ne | both_neg, axis=-1)  # [A, B]
 
 
+def has_offering_zc(
+    state_admitted: jnp.ndarray,  # bool[B, K, V] — bin states' admitted lanes
+    zone_key: int,
+    ct_key: int,
+    offer_zc: jnp.ndarray,  # bool[T, Zb, Cb] dense availability
+) -> jnp.ndarray:
+    """[B, T] has_offering as one MXU matmul: count the available offerings
+    whose (zone lane, ct lane) pair the bin state admits —
+    sum_{z,c} zone_adm[b,z] * ct_adm[b,c] * offer_zc[t,z,c] — and test > 0.
+    Exact vs the gather formulation (inputs are 0/1; f32 accumulation), and
+    far cheaper on TPU, where per-offering lane gathers dominate the step."""
+    T, Zb, Cb = offer_zc.shape
+    z = state_admitted[..., zone_key, :Zb].astype(jnp.float32)  # [B, Zb]
+    c = state_admitted[..., ct_key, :Cb].astype(jnp.float32)  # [B, Cb]
+    pairs = (z[..., :, None] * c[..., None, :]).reshape(*z.shape[:-1], Zb * Cb)
+    m = offer_zc.reshape(T, Zb * Cb).astype(jnp.float32)
+    hits = jnp.matmul(pairs, m.T, preferred_element_type=jnp.float32)
+    return hits > 0.5
+
+
 def has_offering(
     state_admitted: jnp.ndarray,  # bool[K, V] — the claim state's admitted lanes
     zone_key: int,

@@ -95,7 +95,13 @@ def pad_problem(p: SchedulingProblem, min_pods: int = 0) -> SchedulingProblem:
     PT = pow2_bucket(p.pod_ports.shape[1], lo=8)
     # G=0 stays 0: the topology kernels early-exit statically
     G = pow2_bucket(p.num_groups, lo=8) if p.num_groups else 0
-    F = pow2_bucket(p.grp_filter_valid.shape[1], lo=2) if p.num_groups else p.grp_filter_valid.shape[1]
+    # F=0 stays 0 (no node filters anywhere): record()'s filter product
+    # vanishes statically
+    F = (
+        pow2_bucket(p.grp_filter_valid.shape[1], lo=2)
+        if p.num_groups and p.grp_filter_valid.shape[1]
+        else p.grp_filter_valid.shape[1]
+    )
 
     return SchedulingProblem(
         lane_valid=_pad(p.lane_valid, (K, V), False),
@@ -152,6 +158,12 @@ def pad_problem(p: SchedulingProblem, min_pods: int = 0) -> SchedulingProblem:
         run_len=_pad(p.run_len, (pow2_bucket(p.num_runs, lo=4),), 0),
         # padding runs are length-0 analytic commits (pure no-ops)
         run_mode=_pad(p.run_mode, (pow2_bucket(p.num_runs, lo=4),), 1),
+        # padded instance-type rows have no offerings at all
+        offer_zc=(
+            _pad(p.offer_zc, (T,) + p.offer_zc.shape[1:], False)
+            if p.offer_zc is not None
+            else None
+        ),
     )
 
 

@@ -140,21 +140,27 @@ def topo_gate(
     # forced all-True for non-matching groups inside allowed_domains)
     grp_sat = jnp.any(allowed, axis=-1) | ~match[None, :]  # [B, G]
 
-    # combine per key: AND (scatter-min with duplicate key indices) of all
-    # matching groups' allowed lanes into a [B, K, V] limit mask
+    # combine per key: AND of all matching groups' allowed lanes into a
+    # [B, K, V] limit mask. Formulated as an MXU matmul over the group axis
+    # (count the matching groups that DISALLOW each lane) — a TPU scatter-min
+    # with duplicate indices costs more than the whole product
     B, K, V = bin_rows.admitted.shape
-    masked = jnp.where(match[None, :, None], allowed, True).astype(jnp.uint8)
-    limit = (
-        jnp.ones((B, K, V), dtype=jnp.uint8)
-        .at[:, problem.grp_key, :]
-        .min(masked)
-        .astype(bool)
+    K_onehot = (
+        (problem.grp_key[:, None] == jnp.arange(K)[None, :])
+    ).astype(jnp.float32)  # [G, K]
+    disallow = (match[None, :, None] & ~allowed).astype(jnp.float32)  # [B, G, V]
+    viol = jnp.einsum(
+        "bgv,gk->bkv", disallow, K_onehot, preferred_element_type=jnp.float32
     )
+    limit = viol < 0.5  # no matching group on this key disallows the lane
     touched = (
-        jnp.zeros((K,), dtype=jnp.uint8)
-        .at[problem.grp_key]
-        .max(match.astype(jnp.uint8))
-        .astype(bool)
+        jnp.einsum(
+            "g,gk->k",
+            match.astype(jnp.float32),
+            K_onehot,
+            preferred_element_type=jnp.float32,
+        )
+        > 0.5
     )
 
     new_admitted = bin_rows.admitted & jnp.where(touched[None, :, None], limit, True)

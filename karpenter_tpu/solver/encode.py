@@ -33,6 +33,7 @@ from karpenter_tpu.models.problem import (
     SchedulingProblem,
     ZONE_KEY,
 )
+from karpenter_tpu.ops.padding import pow2_bucket
 from karpenter_tpu.provisioning.topology import Topology, TOPOLOGY_TYPE_SPREAD
 from karpenter_tpu.scheduling import (
     Requirement,
@@ -425,6 +426,25 @@ class Encoder:
                 offer_ct[ti, oi] = vocab.values[ct_k][o.capacity_type]
                 offer_ok[ti, oi] = o.available
                 offer_price[ti, oi] = o.price
+        # dense (zone-lane x ct-lane) availability per instance type: lets the
+        # solver's has_offering run as one MXU matmul over the bin batch
+        # instead of per-offering lane gathers (TPU gathers cost more than the
+        # whole packed compat product — see masks.has_offering_zc). Only built
+        # when both sub-vocabularies fit the fixed 32-lane window; otherwise
+        # None and the kernels fall back to the gather formulation.
+        n_zone = len(vocab.values[zone_k])
+        n_ct = len(vocab.values[ct_k])
+        if n_zone <= 32 and n_ct <= 32:
+            zb = int(pow2_bucket(max(n_zone, 1), lo=8))
+            cb = int(pow2_bucket(max(n_ct, 1), lo=8))
+            offer_zc = np.zeros((T, zb, cb), dtype=bool)
+            np.logical_or.at(
+                offer_zc,
+                (np.arange(T)[:, None].repeat(O, 1), offer_zone, offer_ct),
+                offer_ok,
+            )
+        else:
+            offer_zc = None
 
         # -- 7. templates' instance-type universes + taints + limit headroom
         TPL = len(templates)
@@ -499,7 +519,20 @@ class Encoder:
 
         # -- 9. topology groups (regular first, then inverse)
         G = len(groups)
-        F = max((len(tg.node_filter.terms) for tg in groups), default=1) or 1
+        # F=0 when no group carries a real node filter (the common case): the
+        # record() filter product then vmaps over an empty axis and compiles
+        # away entirely. A filter containing an EMPTY term matches every node
+        # (OR semantics, and an empty Requirements is Compatible with
+        # anything), so such a filter is equivalent to no filter at all —
+        # TopologyNodeFilter.for_pod emits exactly that for pods without node
+        # affinity.
+        def _real_terms(tg):
+            terms = list(tg.node_filter.terms)
+            if any(len(t) == 0 for t in terms):
+                return []
+            return terms
+
+        F = max((len(_real_terms(tg)) for tg in groups), default=0)
         grp_type = np.zeros(G, dtype=np.int32)
         grp_key = np.zeros(G, dtype=np.int32)
         grp_max_skew = np.full(G, 2**31 - 1, dtype=np.int32)
@@ -521,12 +554,11 @@ class Encoder:
                 lane = vocab.values[grp_key[gi]][domain]
                 grp_registered0[gi, lane] = True
                 grp_counts0[gi, lane] = count
-            grp_has_filter[gi] = bool(tg.node_filter.terms)
-            for fi, term in enumerate(tg.node_filter.terms):
+            terms = _real_terms(tg)
+            grp_has_filter[gi] = bool(terms)
+            for fi, term in enumerate(terms):
                 grp_filter_valid[gi, fi] = True
-            filter_rows.extend(
-                list(tg.node_filter.terms) + [Requirements()] * (F - len(tg.node_filter.terms))
-            )
+            filter_rows.extend(terms + [Requirements()] * (F - len(terms)))
         grp_filter_flat = encode_reqs(filter_rows)  # [(G*F), K, V]
         grp_filter = ReqTensor(
             admitted=grp_filter_flat.admitted.reshape(G, F, K, V),
@@ -654,6 +686,7 @@ class Encoder:
             offer_ct=offer_ct,
             offer_ok=offer_ok,
             offer_price=offer_price,
+            offer_zc=offer_zc,
             tpl_reqs=tpl_reqs,
             tpl_overhead=tpl_overhead,
             tpl_it_ok=tpl_it_ok,

@@ -1,0 +1,83 @@
+"""Dev tool: per-kernel time attribution for one FFD scan pass via
+jax.profiler trace -> perfetto json parsing (no tensorboard needed)."""
+
+import glob
+import gzip
+import json
+import os
+import random
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, ".")
+import __graft_entry__
+
+__graft_entry__._respect_platform_env()
+
+import jax
+import numpy as np
+
+from bench import make_diverse_pods
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodepool import NodePool
+from karpenter_tpu.apis.objects import ObjectMeta
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.ops.ffd import solve_ffd
+from karpenter_tpu.ops.padding import pad_problem
+from karpenter_tpu.provisioning.topology import Topology
+from karpenter_tpu.solver.encode import (
+    Encoder,
+    domains_from_instance_types,
+    template_from_nodepool,
+)
+
+PODS = int(sys.argv[1]) if len(sys.argv) > 1 else 10000
+
+rng = random.Random(42)
+its = instance_types(400)
+tpl = template_from_nodepool(
+    NodePool(metadata=ObjectMeta(name="default")), its, range(len(its))
+)
+pods = make_diverse_pods(PODS, rng)
+domains = domains_from_instance_types(its, [tpl])
+topo = Topology(domains, batch_pods=pods, cluster_pods=[])
+enc = Encoder(wk.WELL_KNOWN_LABELS)
+encoded = enc.encode(pods, its, [tpl], [], topology=topo, num_claim_slots=128)
+problem = pad_problem(encoded.problem)
+
+r = solve_ffd(problem, 128)
+np.asarray(r.kind)  # warm
+
+trace_dir = "/tmp/jaxtrace"
+os.system(f"rm -rf {trace_dir}")
+with jax.profiler.trace(trace_dir):
+    r = solve_ffd(problem, 128)
+    np.asarray(r.kind)
+
+# find the trace json
+paths = glob.glob(f"{trace_dir}/**/*.trace.json.gz", recursive=True)
+print("trace files:", paths, file=sys.stderr)
+buckets = defaultdict(float)
+counts = defaultdict(int)
+total = 0.0
+for path in paths:
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    for ev in data.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name", "")
+        dur = ev.get("dur", 0) / 1e6  # us -> s
+        # keep device-side compute events only (heuristic: pid/tid naming is
+        # messy; filter by typical XLA op-name shapes)
+        if not name or name.startswith(("$", "process_")):
+            continue
+        buckets[name] += dur
+        counts[name] += 1
+        total += dur
+
+top = sorted(buckets.items(), key=lambda kv: -kv[1])[:45]
+print(f"total traced exclusive time (all threads) {total:.3f}s")
+for name, t in top:
+    print(f"{t:8.4f}s  n={counts[name]:6d}  {name[:140]}")
