@@ -222,6 +222,14 @@ class SchedulingProblem:
     # MXU-matmul form of has_offering (masks.has_offering_zc); None when a
     # sub-vocabulary exceeds the 32-lane window (fallback: lane gathers)
     offer_zc: Any = None
+    # bool[P] queue row is byte-identical to the previous row (the run
+    # segmentation's same_as_prev) — the stride commit's identical-pod
+    # verdict-batching test
+    pod_eqprev: Any = None
+    # bool[P] row equals the previous row on every GATE-relevant array and
+    # both rows are topology-blind (no matched/owned groups; labels and
+    # select-sides may differ) — the stride's analytic-chain test
+    pod_eqprev_gate: Any = None
 
     @property
     def num_runs(self) -> int:

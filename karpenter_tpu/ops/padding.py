@@ -164,6 +164,15 @@ def pad_problem(p: SchedulingProblem, min_pods: int = 0) -> SchedulingProblem:
             if p.offer_zc is not None
             else None
         ),
+        # padded pod rows are never identical to their predecessor
+        pod_eqprev=(
+            _pad(p.pod_eqprev, (P,), False) if p.pod_eqprev is not None else None
+        ),
+        pod_eqprev_gate=(
+            _pad(p.pod_eqprev_gate, (P,), False)
+            if p.pod_eqprev_gate is not None
+            else None
+        ),
     )
 
 

@@ -186,10 +186,8 @@ def topo_gate(
     return ok, final
 
 
-def record(
+def record_delta(
     problem: SchedulingProblem,
-    counts: jnp.ndarray,
-    registered: jnp.ndarray,
     pod: PodTopoStatics,
     final_row: ReqTensor,  # [K, V...] the chosen bin's final state
     wellknown_allow: jnp.ndarray,
@@ -197,18 +195,10 @@ def record(
     lv: jnp.ndarray,
     ln: jnp.ndarray,
 ) -> jnp.ndarray:
-    """(counts', registered') — Topology.Record (topology.go:125-148).
-
-    Regular groups count the pod when the selector selects it and the spread
-    node-filter accepts the final bin state; spread/affinity record only a
-    collapsed single domain, anti-affinity blocks every admitted domain.
-    Inverse groups record the pod's possible domains when the pod owns them.
-    Complement sets record nothing (see provisioning/topology.py on the
-    Values() quirk). Recording a lane also registers it — the reference's
-    domains map gains previously-unknown domains on increment."""
-    G = counts.shape[0]
-    if G == 0:
-        return counts, registered
+    """bool[G, V] — the domain lanes this placement records (see record()).
+    Pure in the carried counters, so deltas for independent placements are
+    additive and a wide-window commit can sum them."""
+    G = problem.grp_key.shape[0]
     key = problem.grp_key
     dom = final_row.admitted[key]  # [G, V] candidate record lanes
     concrete = ~final_row.comp[key]  # [G]
@@ -232,6 +222,34 @@ def record(
     inverse_rec = problem.grp_inverse & pod.grp_owned & concrete
 
     rec = (regular_rec | inverse_rec) & committed
-    recorded = rec[:, None] & dom
+    return rec[:, None] & dom
+
+
+def record(
+    problem: SchedulingProblem,
+    counts: jnp.ndarray,
+    registered: jnp.ndarray,
+    pod: PodTopoStatics,
+    final_row: ReqTensor,  # [K, V...] the chosen bin's final state
+    wellknown_allow: jnp.ndarray,
+    committed: jnp.ndarray,  # bool scalar: a placement actually happened
+    lv: jnp.ndarray,
+    ln: jnp.ndarray,
+) -> jnp.ndarray:
+    """(counts', registered') — Topology.Record (topology.go:125-148).
+
+    Regular groups count the pod when the selector selects it and the spread
+    node-filter accepts the final bin state; spread/affinity record only a
+    collapsed single domain, anti-affinity blocks every admitted domain.
+    Inverse groups record the pod's possible domains when the pod owns them.
+    Complement sets record nothing (see provisioning/topology.py on the
+    Values() quirk). Recording a lane also registers it — the reference's
+    domains map gains previously-unknown domains on increment."""
+    G = counts.shape[0]
+    if G == 0:
+        return counts, registered
+    recorded = record_delta(
+        problem, pod, final_row, wellknown_allow, committed, lv, ln
+    )
     return counts + recorded.astype(jnp.int32), registered | recorded
 

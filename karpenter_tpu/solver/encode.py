@@ -106,6 +106,11 @@ def constraint_signature(p: Pod) -> str:
     itself re-checks byte-identical encodings — so an imprecise digest can
     only cost compression, never correctness."""
     spec = p.spec
+    # PERF-SENSITIVE ordering: moving labels to the end (to lengthen
+    # gate-identity chains) was measured to DOUBLE the 10k bench's device
+    # time — reordering pod CLASSES within a size tier changes the claim
+    # landscape every later pod packs against (docs/PERF_NOTES.md item 5).
+    # A/B any change to this list on the bench before landing it.
     parts = [
         p.namespace,
         repr(sorted(spec.node_selector.items())),
@@ -611,10 +616,16 @@ class Encoder:
         from karpenter_tpu.models.problem import RUN_ANALYTIC, RUN_SINGLE, RUN_TOPO
 
         P = len(pods)
+        # gate_interacts: some group GATES this pod's placement (matched
+        # regular groups / victim of an inverse group). selects-only pods are
+        # merely COUNTED by other pods' groups — their placement decisions
+        # are topology-blind, and their record deltas aggregate per bin, so
+        # the analytic run commit handles them exactly (its record sum).
+        gate_interacts = (
+            pod_grp_match.any(axis=1) | pod_grp_owned.any(axis=1)
+        ) if G else np.zeros(P, dtype=bool)
         interacts = (
-            pod_grp_match.any(axis=1)
-            | pod_grp_selects.any(axis=1)
-            | pod_grp_owned.any(axis=1)
+            gate_interacts | pod_grp_selects.any(axis=1)
         ) if G else np.zeros(P, dtype=bool)
         has_ports = pod_ports.any(axis=1) if pod_ports.size else np.zeros(P, dtype=bool)
         has_vols = (
@@ -642,6 +653,30 @@ class Encoder:
                     same_as_prev[1:] &= (flat[1:] == flat[:-1]).all(axis=1)
         else:
             same_as_prev = np.zeros(P, dtype=bool)
+        pod_eqprev = same_as_prev.copy()  # byte-identity with the previous row
+        # gate-identity: equality over only the arrays that can influence a
+        # topology-blind pod's own placement (labels/selectors may differ —
+        # they only change who counts whom, which the analytic commit's
+        # record sum aggregates exactly). Only meaningful between rows that
+        # are NOT gate-interacting and carry no ports/volumes when records
+        # are in play (mirroring `mergeable`).
+        if P > 1:
+            gate_same = np.ones(P, dtype=bool)
+            gate_same[0] = False
+            for a in (
+                pod_reqs.admitted, pod_reqs.comp, pod_reqs.gt, pod_reqs.lt,
+                pod_reqs.defined, pod_requests, pod_tol_tpl, pod_tol_node,
+                pod_ports, pod_port_conflict, pod_vol_counts,
+            ):
+                if a.size:
+                    flat = a.reshape(P, -1)
+                    gate_same[1:] &= (flat[1:] == flat[:-1]).all(axis=1)
+            eligible = ~gate_interacts & mergeable
+            gate_same &= eligible
+            gate_same[1:] &= eligible[:-1]
+        else:
+            gate_same = np.zeros(P, dtype=bool)
+        pod_eqprev_gate = gate_same
         run_start_l: List[int] = []
         run_len_l: List[int] = []
         run_mode_l: List[int] = []
@@ -657,7 +692,7 @@ class Encoder:
             # run commits are only entered when they actually pay
             if j - i == 1:
                 run_mode_l.append(RUN_SINGLE)
-            elif interacts[i]:
+            elif gate_interacts[i]:
                 run_mode_l.append(RUN_TOPO)
             else:
                 run_mode_l.append(RUN_ANALYTIC)
@@ -716,6 +751,8 @@ class Encoder:
             run_start=run_start,
             run_len=run_len,
             run_mode=run_mode,
+            pod_eqprev=pod_eqprev,
+            pod_eqprev_gate=pod_eqprev_gate,
         )
         meta = ProblemMeta(
             keys=list(vocab.keys),

@@ -421,9 +421,15 @@ class TestRunCompressionDifferential:
     topology workloads whose segmentation exercises all three run modes
     (RUN_SINGLE / RUN_ANALYTIC / RUN_TOPO). This is the guard the round-2
     regression (topo runs silently clamped onto the analytic branch by
-    lax.switch) shipped without."""
+    lax.switch) shipped without.
 
-    @pytest.mark.parametrize("seed", [0, 7, 21, 33, 48])
+    Full 64-seed corpus (round-4): the analytic commit now also serves
+    selects-active runs (topology-blind pods other pods' groups count) and
+    aggregates their record deltas per bin — divergence in the record sum
+    corrupts later placements and shows up here as (kind, index)
+    mismatches."""
+
+    @pytest.mark.parametrize("seed", list(range(64)))
     def test_per_pod_vs_runs(self, seed):
         import numpy as np
 
@@ -471,8 +477,11 @@ class TestRunCompressionDifferential:
             if (k1[r], i1[r]) != (k2[r], i2[r])
         ]
         assert not bad, f"seed {seed}: {len(bad)} diverging rows, first: {bad[:5]}"
-        # the differential only means something if compression actually ran
-        assert (rm == RUN_ANALYTIC).any() or (rm == RUN_TOPO).any()
+        # the differential only means something when compression actually ran;
+        # over the 64-seed corpus most seeds form runs, a few draw workloads
+        # of all-distinct pods — flag those as skips, not failures
+        if not ((rm == RUN_ANALYTIC).any() or (rm == RUN_TOPO).any()):
+            pytest.skip("no compressible runs formed for this seed")
 
 
 class TestBenchSmallBatchFraction:
