@@ -369,6 +369,10 @@ class PersistentVolume:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     node_affinity_required: List[NodeSelectorTerm] = field(default_factory=list)
     csi_driver: str = ""
+    # in-tree volume source plugin name (e.g. "kubernetes.io/aws-ebs"); CSI
+    # migration translates it to the CSI driver for attach-limit accounting
+    # (scheduling/volumeusage.py IN_TREE_DRIVER_MIGRATIONS)
+    in_tree_plugin: str = ""
 
 
 @dataclass

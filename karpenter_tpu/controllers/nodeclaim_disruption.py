@@ -111,7 +111,10 @@ class DisruptionMarkerController:
             *nodepool.spec.template.spec.requirements
         )
         claim_reqs = label_requirements(claim.metadata.labels)
-        if not claim_reqs.is_compatible(pool_reqs, wk.WELL_KNOWN_LABELS):
+        # NO allow-undefined set: the reference calls Compatible with the
+        # default (empty) CompatibilityOptions here (drift.go:129), so a pool
+        # requirement on a well-known key the claim doesn't label IS drift
+        if not claim_reqs.is_compatible(pool_reqs):
             return "RequirementsDrifted"
         cloud_reason = self.cloud_provider.is_drifted(claim)
         if cloud_reason:

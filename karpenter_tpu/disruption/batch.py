@@ -41,9 +41,9 @@ from karpenter_tpu.apis.objects import Pod
 from karpenter_tpu.ops.ffd import KIND_FAIL
 from karpenter_tpu.ops.padding import pad_problem
 from karpenter_tpu.parallel.mesh import (
-    batched_screen,
+    ScreenVariants,
     default_mesh,
-    stack_problems,
+    lean_screen,
 )
 from karpenter_tpu.provisioning.topology import Topology
 from karpenter_tpu.solver.encode import Encoder, NodeInfo
@@ -298,8 +298,6 @@ class UnionScorer:
         sequential requeue loop, scheduler.go:150-170, only helps pods whose
         failure involved topology counters or a not-yet-placed affinity
         target) — and the screen drops to a single exact pass."""
-        import dataclasses
-
         if not subsets:
             return []
         if mesh == "auto":
@@ -333,47 +331,59 @@ class UnionScorer:
         all_cand_rows = (
             np.concatenate(self.cand_rows) if self.cand_rows else np.zeros(0, dtype=np.int64)
         )
-        variants = []
-        for subset in subsets:
-            s = list(subset)
-            node_avail = np.array(base.node_avail)
-            counts = all_counts.copy()
-            reg_int = all_reg_int.copy()
-            # other candidates' pods are masked out via pod_active — the run
-            # structure stays intact and the variant costs two small arrays
-            pod_active = np.array(base.pod_active)
-            pod_active[all_cand_rows] = False
-            for ci in s:
-                counts -= delta_counts[ci]
-                reg_int -= delta_reg_int[ci]
-                ni = self._node_idx.get(self.candidates[ci].name)
-                if ni is not None:
-                    node_avail[ni, :] = -1.0
-                pod_active[self.cand_rows[ci]] = True
-            variants.append(
-                dataclasses.replace(
-                    base,
-                    node_avail=node_avail,
-                    pod_active=pod_active,
-                    grp_counts0=counts,
-                    grp_registered0=base.grp_registered0 | (reg_int > 0),
-                )
-            )
-        B = len(variants)
-        pad_to = B
+        # per-subset variant arrays only (the base problem is shared and
+        # uploaded once) — see parallel/mesh.py ScreenVariants. The subset
+        # axis pads to a quarter-pow2 bucket so a reconcile pass with a
+        # varying candidate count reuses compiled screens (prewarmable,
+        # solver/warmup.py prewarm_screen) instead of recompiling per B.
+        from karpenter_tpu.ops.padding import quarter_bucket
+
+        B = len(subsets)
+        pad_to = quarter_bucket(B)
         if mesh is not None:
             n_dev = mesh.devices.size
-            pad_to = ((B + n_dev - 1) // n_dev) * n_dev
-        while len(variants) < pad_to:
-            variants.append(variants[0])
-        batch = stack_problems(variants)
-        result = batched_screen(
-            batch, self.num_claim_slots, mesh=mesh, passes=passes
+            pad_to = ((pad_to + n_dev - 1) // n_dev) * n_dev
+        node_avail_b = np.broadcast_to(
+            np.asarray(base.node_avail), (pad_to,) + base.node_avail.shape
+        ).copy()
+        counts_b = np.broadcast_to(
+            all_counts, (pad_to,) + all_counts.shape
+        ).copy()
+        reg_int_b = np.broadcast_to(
+            all_reg_int, (pad_to,) + all_reg_int.shape
+        ).copy()
+        pod_active_b = np.broadcast_to(
+            np.asarray(base.pod_active), (pad_to,) + base.pod_active.shape
+        ).copy()
+        pod_active_b[:, all_cand_rows] = False
+        for bi, subset in enumerate(subsets):
+            for ci in subset:
+                counts_b[bi] -= delta_counts[ci]
+                reg_int_b[bi] -= delta_reg_int[ci]
+                ni = self._node_idx.get(self.candidates[ci].name)
+                if ni is not None:
+                    node_avail_b[bi, ni, :] = -1.0
+                pod_active_b[bi, self.cand_rows[ci]] = True
+        variants = ScreenVariants(
+            node_avail=node_avail_b,
+            pod_active=pod_active_b,
+            grp_counts0=counts_b,
+            grp_registered0=np.asarray(base.grp_registered0)[None] | (reg_int_b > 0),
         )
-        kinds = np.asarray(result.kind)  # [B, P]
-        claim_open = np.asarray(result.state.claim_open)  # [B, C]
-        claim_it_ok = np.asarray(result.state.claim_it_ok)  # [B, C, T]
-        claim_adm = np.asarray(result.state.claim_req.admitted)  # [B, C, K, V]
+        result = lean_screen(
+            base, variants, self.num_claim_slots, mesh=mesh, passes=passes
+        )
+        # single roundtrip: device_get issues all copies before waiting
+        import jax
+
+        kinds, claim_open, claim_it_ok, claim_adm = jax.device_get(
+            (
+                result.kind,  # [B, P]
+                result.state.claim_open,  # [B, C]
+                result.state.claim_it_ok,  # [B, C, T]
+                result.state.claim_req.admitted,  # [B, C, K, V]
+            )
+        )
 
         T_real = len(self.meta.instance_type_names)
         zone_k = self.meta.zone_key_idx

@@ -50,6 +50,14 @@ class Options:
     # where the persistent compile cache makes the warm outlive the process;
     # solver/warmup.py)
     prewarm_solver: bool = True
+    # largest pod batch to pre-compile solver buckets for (0 = only the
+    # small standard buckets). A fleet that sees 10k-pod bursts should set
+    # this to 10000 so the big scan executables compile at startup, not on
+    # the first burst (solver/warmup.py walks the bucket ladder up to it).
+    prewarm_max_pods: int = 0
+    # candidate-subset counts to pre-compile the consolidation screen for
+    # (solver/warmup.py prewarm_screen); 0 disables
+    prewarm_screen_candidates: int = 0
 
     def drift_enabled(self) -> bool:
         return self.feature_gates.get("Drift", True)

@@ -36,7 +36,18 @@ def pod_axis_bucket(n: int) -> int:
     widths, where padding costs bandwidth, not latency)."""
     if n <= 1024:
         return pow2_bucket(n)
-    base = 1024
+    return quarter_bucket(n, lo=1024)
+
+
+def quarter_bucket(n: int, lo: int = 8) -> int:
+    """Quarter-pow2 bucket (1.25/1.5/1.75/2.0 x 2^k steps above ``lo``): caps
+    padding waste at 25% for ~2x the bucket count. Used for axes where
+    padding costs real compute per padded element — the pod scan axis above
+    1024 (pod_axis_bucket) and the consolidation screen's candidate-subset
+    axis (every padded variant is a full dummy solve)."""
+    if n <= lo:
+        return lo
+    base = lo
     while base * 2 < n:
         base *= 2
     # base < n <= base*2 here; the smallest quarter step at or above n wins

@@ -128,6 +128,84 @@ def batched_screen(
     return _batched_screen_jit(batch, max_claims, passes, max_run, with_topo)
 
 
+class ScreenVariants:
+    """The four arrays a consolidation subset variant actually changes on the
+    shared union problem — batching these (leading [B] axis) instead of
+    stacking the whole SchedulingProblem B times cuts the screen's host
+    stacking, upload, and per-variant statics recompute to the variant data
+    itself."""
+
+    def __init__(self, node_avail, pod_active, grp_counts0, grp_registered0):
+        self.node_avail = node_avail
+        self.pod_active = pod_active
+        self.grp_counts0 = grp_counts0
+        self.grp_registered0 = grp_registered0
+
+    def tree(self):
+        return (self.node_avail, self.pod_active, self.grp_counts0, self.grp_registered0)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
+def _lean_screen_jit(
+    base: SchedulingProblem,
+    variants,  # 4-tuple of [B, ...] arrays (ScreenVariants.tree())
+    max_claims: int,
+    passes: int,
+    max_run: int,
+    with_topo: bool,
+) -> FFDResult:
+    import dataclasses
+
+    from karpenter_tpu.ops.ffd import KIND_FAIL
+
+    # vmap over ONLY the variant arrays; the base problem rides along
+    # un-batched (XLA broadcasts it, statics are computed once)
+    def one(node_avail, pod_active, grp_counts0, grp_registered0) -> FFDResult:
+        p = dataclasses.replace(
+            base,
+            node_avail=node_avail,
+            pod_active=pod_active,
+            grp_counts0=grp_counts0,
+            grp_registered0=grp_registered0,
+        )
+        r = _solve_ffd_runs_jit.__wrapped__(
+            p, initial_state(p, max_claims), max_run, with_topo
+        )
+        kind, index = r.kind, r.index
+        for _ in range(passes - 1):
+            placed = kind < KIND_FAIL
+            p2 = dataclasses.replace(p, pod_active=p.pod_active & ~placed)
+            r = _solve_ffd_runs_jit.__wrapped__(p2, r.state, max_run, with_topo)
+            kind = jnp.where(placed, kind, r.kind)
+            index = jnp.where(placed, index, r.index)
+        return FFDResult(kind=kind, index=index, state=r.state)
+
+    return jax.vmap(one)(*variants)
+
+
+def lean_screen(
+    base: SchedulingProblem,
+    variants: ScreenVariants,
+    max_claims: int,
+    mesh: Optional[Mesh] = None,
+    passes: int = 3,
+) -> FFDResult:
+    """The consolidation screen on a shared base problem + per-subset variant
+    arrays (see ScreenVariants). With a mesh, the variant axis is sharded and
+    the base is replicated."""
+    max_run = _max_run_bucket(base)
+    with_topo = _has_topo_runs(base)
+    tree = variants.tree()
+    if mesh is not None:
+        sharding = NamedSharding(mesh, P(CANDIDATE_AXIS))
+        tree = tuple(jax.device_put(a, sharding) for a in tree)
+        replicate = NamedSharding(mesh, P())
+        base = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, replicate), base
+        )
+    return _lean_screen_jit(base, tree, max_claims, passes, max_run, with_topo)
+
+
 def default_mesh(min_devices: int = 2) -> Optional[Mesh]:
     """A 1-D candidate mesh over every local device, or None on a single
     device (vmap alone already uses the whole chip)."""

@@ -410,10 +410,12 @@ class Provisioner:
                     )
                 )
         for pi, reason in result.failures.items():
+            # reference event text (scheduling/events.go:52-56) with the
+            # per-criterion forensics rendered by solver/forensics.py
             self.recorder.publish(
                 object_event(
                     inputs.pods[pi], "Warning", "FailedScheduling",
-                    f"incompatible with all available node shapes: {reason}",
+                    f"Failed to schedule pod, {reason}",
                 )
             )
         return ProvisioningPass(

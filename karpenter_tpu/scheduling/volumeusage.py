@@ -25,6 +25,27 @@ from karpenter_tpu.scheduling.storageclass import resolve_storage_class
 # its real driver's limit once the PVC resolves and the next pass runs.
 UNKNOWN_DRIVER = "unknown"
 
+# CSI migration (the reference goes through k8s csi-translation-lib,
+# scheduling/volumeusage.go:96-118): volumes provisioned by an in-tree
+# plugin count against the MIGRATED CSI driver's attach limits, whether the
+# plugin name arrives via a StorageClass provisioner or a PV's in-tree
+# volume source.
+IN_TREE_DRIVER_MIGRATIONS = {
+    "kubernetes.io/aws-ebs": "ebs.csi.aws.com",
+    "kubernetes.io/gce-pd": "pd.csi.storage.gke.io",
+    "kubernetes.io/azure-disk": "disk.csi.azure.com",
+    "kubernetes.io/azure-file": "file.csi.azure.com",
+    "kubernetes.io/cinder": "cinder.csi.openstack.org",
+    "kubernetes.io/vsphere-volume": "csi.vsphere.vmware.com",
+}
+
+
+def migrate_in_tree_driver(name: str) -> str:
+    """Translate an in-tree plugin/provisioner name to its CSI driver;
+    unknown names pass through unchanged."""
+    return IN_TREE_DRIVER_MIGRATIONS.get(name, name)
+
+
 VolumeSet = Dict[str, FrozenSet[str]]  # driver -> unique volume ids
 
 
@@ -81,12 +102,18 @@ class VolumeResolver:
             pv = self._pv[pvc.volume_name]
             if pv is not None and pv.csi_driver:
                 return pv.csi_driver
+            if pv is not None and pv.in_tree_plugin:
+                return migrate_in_tree_driver(pv.in_tree_plugin)
         return self._sc(pvc.storage_class_name)
 
     def _sc(self, name: Optional[str]) -> str:
         if name not in self._sc_driver:
             sc = resolve_storage_class(self.kube, name)
-            self._sc_driver[name] = sc.provisioner if sc is not None else UNKNOWN_DRIVER
+            self._sc_driver[name] = (
+                migrate_in_tree_driver(sc.provisioner)
+                if sc is not None
+                else UNKNOWN_DRIVER
+            )
         return self._sc_driver[name]
 
 

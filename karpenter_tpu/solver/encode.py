@@ -402,6 +402,15 @@ class Encoder:
         pod_reqs = encode_reqs(pod_reqs_list)
         pod_strict_reqs = encode_reqs(pod_strict_list)
         it_reqs = encode_reqs([it.requirements for it in instance_types])
+        # the run commit hoists its template x instance-type product out of
+        # the claim-open loop on the invariant that instance types never
+        # define the hostname key (a fresh claim's minted hostname exists
+        # precisely because nothing else names it, nodeclaim.go:46-63);
+        # enforce the hoist's precondition here rather than assuming it
+        if it_reqs.defined[:, HOSTNAME_KEY].any():  # survive python -O
+            raise AssertionError(
+                "instance type requirements must not define the hostname key"
+            )
         tpl_reqs = encode_reqs([t.requirements for t in templates])
         node_reqs = encode_reqs([n.requirements for n in nodes])
 
@@ -583,6 +592,11 @@ class Encoder:
         # selects() depends only on (namespace, labels) — a large batch has
         # few distinct label sets, so cache rows instead of P x G matching;
         # ownership inverts each group's owner set instead of P x G lookups
+        # one row per uid: the queue is deduplicated upstream, so a uid maps
+        # to exactly one batch row — if that ever changes, ownership marking
+        # must mark EVERY row of the uid, not just the last
+        if len({p.uid for p in pods}) != len(pods):  # survive python -O
+            raise AssertionError("duplicate pod uid in batch")
         uid_to_pi = {p.uid: pi for pi, p in enumerate(pods)}
         for gi, tg in enumerate(groups):
             for uid in tg.owners:

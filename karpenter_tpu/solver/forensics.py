@@ -1,0 +1,202 @@
+"""Scheduling-failure forensics — why a pod could not be placed.
+
+The reference deliberately does NOT short-circuit instance-type filtering so
+it can tell the operator which criteria eliminated every instance type:
+filterInstanceTypesByRequirements tracks per-criterion and pairwise results
+(nodeclaim.go:225-260) and FailureReason() renders them (nodeclaim.go:161-221);
+the scheduler wraps each template's failure with the nodepool name and
+daemonset overhead (scheduler.go:268-283) and the event carries the message
+(scheduling/events.go:52-56).
+
+The tensor solver reduces a failed pod to one flag; these helpers reconstruct
+the reference's forensics HOST-SIDE at decode time — failed pods are rare, so
+a straight-line Python pass over the (price-capped) instance-type lists costs
+microseconds and keeps the device program lean. Both backends call the same
+function, so the rendered reasons are backend-independent.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from karpenter_tpu.apis.objects import Pod
+from karpenter_tpu.cloudprovider.types import InstanceType
+from karpenter_tpu.scheduling import Requirements, pod_requirements
+from karpenter_tpu.solver.encode import TemplateInfo
+from karpenter_tpu.utils import resources as res
+
+
+@dataclass
+class FilterResults:
+    """filterInstanceTypesByRequirements' accumulator (nodeclaim.go:225-260):
+    which single criteria and which pairs some instance type satisfied."""
+
+    requirements_met: bool = False
+    fits: bool = False
+    has_offering: bool = False
+    requirements_and_fits: bool = False
+    requirements_and_offering: bool = False
+    fits_and_offering: bool = False
+    remaining: List[int] = field(default_factory=list)
+    requests: Dict[str, float] = field(default_factory=dict)
+
+    def failure_reason(self) -> str:
+        """FailureReason (nodeclaim.go:161-221), string-for-string."""
+        if self.remaining:
+            return ""
+        r, f, o = self.requirements_met, self.fits, self.has_offering
+        if not r and not f and not o:
+            return (
+                "no instance type met the scheduling requirements or had "
+                "enough resources or had a required offering"
+            )
+        if not r and not f:
+            return "no instance type met the scheduling requirements or had enough resources"
+        if not r and not o:
+            return "no instance type met the scheduling requirements or had a required offering"
+        if not f and not o:
+            return "no instance type had enough resources or had a required offering"
+        if not r:
+            return "no instance type met all requirements"
+        if not f:
+            msg = "no instance type has enough resources"
+            # the reference's special case for a user typo (m vs M)
+            if self.requests.get(res.CPU, 0.0) >= 1_000_000:
+                msg += " (CPU request >= 1 Million, m vs M typo?)"
+            return msg
+        if not o:
+            return "no instance type has the required offering"
+        if self.requirements_and_fits:
+            return (
+                "no instance type which met the scheduling requirements and "
+                "had enough resources, had a required offering"
+            )
+        if self.fits_and_offering:
+            return (
+                "no instance type which had enough resources and the required "
+                "offering met the scheduling requirements"
+            )
+        if self.requirements_and_offering:
+            return (
+                "no instance type which met the scheduling requirements and "
+                "the required offering had the required resources"
+            )
+        return "no instance type met the requirements/resources/offering tuple"
+
+
+def _it_fits(it: InstanceType, requests: Dict[str, float]) -> bool:
+    alloc = it.allocatable()
+    for name, q in requests.items():
+        avail = alloc.get(name, 0.0)
+        if q > avail + 1e-6 + 1e-6 * abs(avail):
+            return False
+    return True
+
+
+def filter_instance_types(
+    instance_types: Sequence[InstanceType],
+    indices: Sequence[int],
+    reqs: Requirements,
+    requests: Dict[str, float],
+) -> FilterResults:
+    """The non-short-circuiting filter (nodeclaim.go:225-260) over a
+    template's instance-type universe."""
+    results = FilterResults(requests=dict(requests))
+    for ti in indices:
+        it = instance_types[ti]
+        it_compat = not it.requirements.intersects(reqs)  # empty = intersects
+        it_fits = _it_fits(it, requests)
+        it_offer = len(it.offerings.available().requirements(reqs)) > 0
+        results.requirements_met = results.requirements_met or it_compat
+        results.fits = results.fits or it_fits
+        results.has_offering = results.has_offering or it_offer
+        results.requirements_and_fits = results.requirements_and_fits or (
+            it_compat and it_fits and not it_offer
+        )
+        results.requirements_and_offering = results.requirements_and_offering or (
+            it_compat and it_offer and not it_fits
+        )
+        results.fits_and_offering = results.fits_and_offering or (
+            it_fits and it_offer and not it_compat
+        )
+        if it_compat and it_fits and it_offer:
+            results.remaining.append(ti)
+    return results
+
+
+def failure_reason(
+    pod: Pod,
+    instance_types: Sequence[InstanceType],
+    templates: Sequence[TemplateInfo],
+    pod_reqs: Optional[Requirements] = None,
+    well_known=None,
+) -> str:
+    """Render the reference's per-template failure forensics for one
+    unschedulable pod (scheduler.go:268-283 error chain + FailureReason).
+    The device solver already decided the pod fails; this explains why."""
+    from karpenter_tpu.apis import labels as wk
+
+    if well_known is None:
+        well_known = wk.WELL_KNOWN_LABELS
+    reqs = pod_reqs if pod_reqs is not None else pod_requirements(pod)
+    requests = {**res.pod_requests(pod), res.PODS: 1.0}
+    parts: List[str] = []
+    for tpl in templates:
+        # NodeClaim.Add's gate order (nodeclaim.go:65-119)
+        untolerated = tpl.taints.tolerates(pod)  # error strings, empty = ok
+        if untolerated:
+            parts.append(
+                f'incompatible with nodepool "{tpl.nodepool_name}", '
+                f"{'; '.join(untolerated)}"
+            )
+            continue
+        if not tpl.requirements.is_compatible(reqs, well_known):
+            errs = tpl.requirements.compatible(reqs, well_known)
+            parts.append(
+                f'incompatible with nodepool "{tpl.nodepool_name}", '
+                f"incompatible requirements, {'; '.join(errs)}"
+            )
+            continue
+        merged = tpl.requirements.copy()
+        merged.add(*reqs.values())
+        overhead = dict(tpl.daemon_overhead)
+        total = dict(requests)
+        for k, v in overhead.items():
+            total[k] = total.get(k, 0.0) + v
+        fr = filter_instance_types(
+            instance_types, tpl.instance_type_indices, merged, total
+        )
+        reason = fr.failure_reason()
+        if not reason:
+            # every per-IT criterion passes on this template, so the solver's
+            # verdict came from the stateful gates the replayed filter cannot
+            # see (topology counters, limits headroom, port/volume usage)
+            reason = (
+                "did not fit topology/limit constraints against current state"
+            )
+        parts.append(
+            f'incompatible with nodepool "{tpl.nodepool_name}", '
+            f"daemonset overhead={_fmt_resources(overhead)}, {reason}"
+        )
+    if not parts:
+        return "no nodepools available"
+    return "; ".join(parts)
+
+
+def _fmt_resources(requests: Dict[str, float]) -> str:
+    if not requests:
+        return "{}"
+    inner = ",".join(f'"{k}":"{_fmt_qty(k, v)}"' for k, v in sorted(requests.items()))
+    return "{" + inner + "}"
+
+
+def _fmt_qty(name: str, v: float) -> str:
+    if name == res.MEMORY or name == res.EPHEMERAL_STORAGE:
+        if v >= 1024**3 and v % 1024**3 == 0:
+            return f"{int(v // 1024**3)}Gi"
+        if v >= 1024**2 and v % 1024**2 == 0:
+            return f"{int(v // 1024**2)}Mi"
+    if v == int(v):
+        return str(int(v))
+    return f"{v:g}"

@@ -311,8 +311,23 @@ class JaxSolver(SolverBackend):
                         topo.update(work[orig])
             t0 = _t("decode+relax", t0)
             if use_sweeps or (not progress and not relaxed_any):
+                # terminal failures: reconstruct the reference's per-template
+                # forensics host-side (solver/forensics.py) — failed pods are
+                # rare, and the rendered reason matches the oracle's exactly
+                from karpenter_tpu.solver.forensics import failure_reason
+
                 for orig in failed:
-                    out.failures[orig] = FAIL_INCOMPATIBLE
+                    out.failures[orig] = failure_reason(
+                        work[orig],
+                        instance_types,
+                        templates,
+                        pod_reqs=(
+                            pod_requirements_override[orig]
+                            if pod_requirements_override is not None
+                            else None
+                        ),
+                        well_known=self.well_known,
+                    ) or FAIL_INCOMPATIBLE
                 break
             queue = failed
 

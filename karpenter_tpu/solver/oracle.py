@@ -203,8 +203,21 @@ class OracleSolver(SolverBackend):
                     relaxed_any = True
                     topo.update(work[pi])
             if not progress and not relaxed_any:
+                # same host-side forensics as the jax backend (forensics.py)
+                from karpenter_tpu.solver.forensics import failure_reason
+
                 for pi in failed:
-                    result.failures[pi] = FAIL_INCOMPATIBLE
+                    result.failures[pi] = failure_reason(
+                        work[pi],
+                        instance_types,
+                        templates,
+                        pod_reqs=(
+                            pod_requirements_override[pi]
+                            if pod_requirements_override is not None
+                            else None
+                        ),
+                        well_known=self.well_known,
+                    ) or FAIL_INCOMPATIBLE
                 break
             queue = failed
 

@@ -20,15 +20,38 @@ from __future__ import annotations
 from typing import Optional, Sequence
 
 
+def bucket_ladder(max_pods: int) -> list:
+    """Every pod-axis bucket (ops/padding.py pod_axis_bucket) up to
+    ``max_pods`` — the shapes a workload that grows to max_pods will compile
+    along the way."""
+    from karpenter_tpu.ops.padding import pod_axis_bucket
+
+    out, n = [], 9
+    while n <= max_pods:
+        b = pod_axis_bucket(n)
+        out.append(b)
+        n = b + 1
+    return out
+
+
 def prewarm_solver(
     solver=None,
     pod_buckets: Sequence[int] = (9, 33),
     instance_types_n: int = 100,
+    max_pods: int = 0,
 ) -> int:
     """Compile the small standard buckets (pow2 pads: 16 and 64 pods) with
-    and without topology interaction. Returns the number of batches solved.
-    Safe to call from a background thread; failures are swallowed — warming
-    is an optimization, never a liveness dependency."""
+    and without topology interaction, plus — when ``max_pods`` is set (the
+    operator's --prewarm-max-pods) — every pod bucket up to it. Returns the
+    number of batches solved. Safe to call from a background thread; failures
+    are swallowed — warming is an optimization, never a liveness dependency.
+
+    The warm uses a synthetic instance-type catalog and pod family, so it
+    covers exactly the synthetic shape buckets: a production batch whose
+    padded lane/type buckets differ still compiles its own executables on
+    first contact (the persistent cache then keeps them across processes).
+    Pass the live catalog via ``instance_types_n``-shaped data when exactness
+    matters more than startup cost."""
     import random
 
     from karpenter_tpu.apis import labels as wk
@@ -79,15 +102,51 @@ def prewarm_solver(
     solved = 0
     # the topology-free and topology programs are distinct executables
     # (G=0 early-exits statically; has_topo_runs is a static argument), and
-    # each pod bucket is its own shape — warm the cross product
-    for n in pod_buckets:
+    # each pod bucket is its own shape — warm the cross product. The large
+    # ladder warms topology shapes only (the expensive family; topology-free
+    # large batches reuse most of the work via the persistent cache).
+    from karpenter_tpu.ops.padding import pod_axis_bucket
+
+    buckets = list(pod_buckets)
+    warmed_shapes = {pod_axis_bucket(b) for b in buckets}
+    ladder = [b for b in bucket_ladder(max_pods) if b not in warmed_shapes]
+    for n in buckets:
         for topo in (False, True):
             try:
                 solver.solve(make(n, topo), its, [tpl])
                 solved += 1
             except Exception:
                 return solved
+    for n in ladder:
+        try:
+            solver.solve(make(n, True), its, [tpl])
+            solved += 1
+        except Exception:
+            return solved
     return solved
+
+
+def prewarm_screen(n_candidates: int) -> bool:
+    """Compile the consolidation screen program for the quarter-pow2
+    candidate buckets up to ``n_candidates`` (disruption/batch.py pads the
+    subset axis with ops/padding.quarter_bucket, so these are the executables
+    a reconcile pass will request). Synthetic-shape caveat as in
+    prewarm_solver."""
+    from karpenter_tpu.disruption.batch import bench_candidate_scoring
+    from karpenter_tpu.ops.padding import quarter_bucket
+
+    try:
+        n = 8
+        while n <= n_candidates:
+            b = quarter_bucket(n)
+            # mesh="auto" matches production score_subsets: on multi-device
+            # hosts the sharded program (and its device-rounded B) is the
+            # executable a reconcile pass will actually request
+            bench_candidate_scoring(b, mesh="auto")
+            n = b + 1
+        return True
+    except Exception:
+        return False
 
 
 def persistent_cache_enabled() -> bool:
@@ -128,7 +187,12 @@ def maybe_prewarm_in_background(options) -> Optional["object"]:
 
     def probe_then_warm():
         if _on_accelerator():
-            prewarm_solver()
+            prewarm_solver(
+                max_pods=getattr(options, "prewarm_max_pods", 0)
+            )
+            n_screen = getattr(options, "prewarm_screen_candidates", 0)
+            if n_screen:
+                prewarm_screen(n_screen)
 
     t = threading.Thread(
         target=probe_then_warm, daemon=True, name="karpenter-tpu/solver-prewarm"

@@ -1,0 +1,197 @@
+"""Scheduling-failure forensics (solver/forensics.py) — the reference's
+non-short-circuit filter results and FailureReason rendering
+(nodeclaim.go:161-260), surfaced through both solver backends and the
+provisioner's FailedScheduling event (scheduling/events.go:52-56)."""
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodepool import NodePool
+from karpenter_tpu.apis.objects import (
+    Container,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    Toleration,
+)
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.solver.encode import template_from_nodepool
+from karpenter_tpu.solver.forensics import failure_reason, filter_instance_types
+from karpenter_tpu.solver.jax_backend import JaxSolver
+from karpenter_tpu.solver.oracle import OracleSolver
+from karpenter_tpu.scheduling import Requirements, pod_requirements
+
+
+def make_pod(name="p", cpu=0.5, memory=128 * 1024.0**2, node_selector=None):
+    return Pod(
+        metadata=ObjectMeta(name=name),
+        spec=PodSpec(
+            containers=[Container(requests={"cpu": cpu, "memory": memory})],
+            node_selector=node_selector or {},
+        ),
+    )
+
+
+@pytest.fixture(scope="module")
+def universe():
+    its = instance_types(20)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="default")), its, range(len(its))
+    )
+    return its, tpl
+
+
+class TestFilterResults:
+    def test_resources_only(self, universe):
+        """Every instance type passes requirements/offering but none fits
+        -> 'no instance type has enough resources' (nodeclaim.go:196-203)."""
+        its, tpl = universe
+        pod = make_pod(cpu=10_000.0)
+        fr = filter_instance_types(
+            its, range(len(its)), pod_requirements(pod),
+            {"cpu": 10_000.0, "pods": 1.0},
+        )
+        assert not fr.remaining
+        assert fr.failure_reason() == "no instance type has enough resources"
+
+    def test_cpu_millions_typo_hint(self, universe):
+        """The reference's m-vs-M typo hint (nodeclaim.go:198-201)."""
+        its, _ = universe
+        pod = make_pod(cpu=2_000_000.0)
+        fr = filter_instance_types(
+            its, range(len(its)), pod_requirements(pod),
+            {"cpu": 2_000_000.0, "pods": 1.0},
+        )
+        assert (
+            fr.failure_reason()
+            == "no instance type has enough resources (CPU request >= 1 Million, m vs M typo?)"
+        )
+
+    def test_requirements_only(self, universe):
+        """A label requirement no instance type defines compatibly."""
+        its, _ = universe
+        pod = make_pod(node_selector={wk.LABEL_INSTANCE_TYPE_STABLE: "no-such-type"})
+        fr = filter_instance_types(
+            its, range(len(its)), pod_requirements(pod), {"cpu": 0.5, "pods": 1.0}
+        )
+        assert not fr.remaining
+        assert fr.failure_reason() == "no instance type met all requirements"
+
+    def test_offering_only(self, universe):
+        """Zone that exists on no offering: requirements stay satisfiable
+        (zone is not an instance-type requirement key in the fake provider)
+        but no offering matches."""
+        its, _ = universe
+        pod = make_pod(node_selector={wk.LABEL_TOPOLOGY_ZONE: "mars"})
+        fr = filter_instance_types(
+            its, range(len(its)), pod_requirements(pod), {"cpu": 0.5, "pods": 1.0}
+        )
+        assert not fr.remaining
+        reason = fr.failure_reason()
+        assert "offering" in reason
+
+    def test_remaining_renders_empty(self, universe):
+        its, _ = universe
+        pod = make_pod()
+        fr = filter_instance_types(
+            its, range(len(its)), pod_requirements(pod), {"cpu": 0.5, "pods": 1.0}
+        )
+        assert fr.remaining
+        assert fr.failure_reason() == ""
+
+
+class TestFailureReason:
+    def test_untolerated_taints(self, universe):
+        from karpenter_tpu.apis.objects import Taint
+        from karpenter_tpu.scheduling import Taints
+
+        its, tpl = universe
+        import dataclasses
+
+        tainted = dataclasses.replace(
+            tpl, taints=Taints([Taint(key="team", value="x", effect="NoSchedule")])
+        )
+        reason = failure_reason(make_pod(), its, [tainted])
+        assert 'incompatible with nodepool "default"' in reason
+        assert "did not tolerate team=x:NoSchedule" in reason
+
+    def test_per_template_reasons_join(self, universe):
+        from karpenter_tpu.apis.objects import Taint
+        from karpenter_tpu.scheduling import Taints
+
+        its, tpl = universe
+        import dataclasses
+
+        tainted = dataclasses.replace(
+            tpl,
+            nodepool_name="tainted-pool",
+            taints=Taints([Taint(key="team", value="x", effect="NoSchedule")]),
+        )
+        pod = make_pod(cpu=10_000.0)
+        reason = failure_reason(pod, its, [tpl, tainted])
+        assert 'incompatible with nodepool "default"' in reason
+        assert "no instance type has enough resources" in reason
+        assert 'incompatible with nodepool "tainted-pool"' in reason
+        assert "did not tolerate" in reason
+
+    def test_daemonset_overhead_rendered(self, universe):
+        its, tpl = universe
+        import dataclasses
+
+        loaded = dataclasses.replace(
+            tpl, daemon_overhead={"cpu": 1.0, "memory": 256 * 1024.0**2}
+        )
+        reason = failure_reason(make_pod(cpu=10_000.0), its, [loaded])
+        assert 'daemonset overhead={"cpu":"1","memory":"256Mi"}' in reason
+
+    def test_no_templates(self, universe):
+        its, _ = universe
+        assert failure_reason(make_pod(), its, []) == "no nodepools available"
+
+
+class TestBackendsRenderForensics:
+    @pytest.mark.parametrize("solver_cls", [JaxSolver, OracleSolver])
+    def test_resource_failure_through_solver(self, universe, solver_cls):
+        its, tpl = universe
+        pods = [make_pod(name="big", cpu=10_000.0), make_pod(name="ok")]
+        result = solver_cls().solve(pods, its, [tpl])
+        assert result.num_scheduled() == 1
+        assert 0 in result.failures
+        assert "no instance type has enough resources" in result.failures[0]
+        assert 'incompatible with nodepool "default"' in result.failures[0]
+
+    def test_backends_render_identically(self, universe):
+        its, tpl = universe
+        pods = [
+            make_pod(name="big", cpu=10_000.0),
+            make_pod(name="mars", node_selector={wk.LABEL_TOPOLOGY_ZONE: "mars"}),
+        ]
+        jr = JaxSolver().solve(pods, its, [tpl])
+        orr = OracleSolver().solve(pods, its, [tpl])
+        assert jr.failures == orr.failures
+        assert set(jr.failures) == {0, 1}
+
+
+class TestProvisionerEvent:
+    def test_failed_scheduling_event_carries_forensics(self):
+        """FailedScheduling events carry the per-criterion reason
+        (events.go:52-56)."""
+        from tests.factories import make_nodepool, make_pod as factory_pod
+        from tests.harness import Env
+
+        env = Env()
+        env.create(make_nodepool())
+        env.expect_provisioned(factory_pod(name="huge", cpu=50_000.0))
+        events = [
+            e
+            for e in env.recorder.events
+            if e.reason == "FailedScheduling" and e.involved_name == "huge"
+        ]
+        assert events, [
+            (e.reason, e.involved_name) for e in env.recorder.events
+        ]
+        assert any(
+            "Failed to schedule pod," in e.message
+            and "no instance type has enough resources" in e.message
+            for e in events
+        ), [e.message for e in events]

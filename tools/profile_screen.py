@@ -1,0 +1,53 @@
+"""Dev tool: time + kernel-trace the consolidation screen (B=100)."""
+
+import glob
+import gzip
+import json
+import os
+import sys
+import time
+from collections import defaultdict
+
+sys.path.insert(0, ".")
+import __graft_entry__
+
+__graft_entry__._respect_platform_env()
+
+import jax
+
+print(f"platform: {jax.devices()[0].platform}", file=sys.stderr)
+
+from karpenter_tpu.disruption.batch import bench_candidate_scoring
+
+t0 = time.perf_counter()
+bench_candidate_scoring(100)
+print(f"warm: {time.perf_counter() - t0:.2f}s")
+t0 = time.perf_counter()
+bench_candidate_scoring(100)
+print(f"steady: {time.perf_counter() - t0:.2f}s")
+
+trace_dir = "/tmp/jaxtrace_screen"
+os.system(f"rm -rf {trace_dir}")
+with jax.profiler.trace(trace_dir):
+    bench_candidate_scoring(100)
+
+paths = glob.glob(f"{trace_dir}/**/*.trace.json.gz", recursive=True)
+buckets = defaultdict(float)
+counts = defaultdict(int)
+samples = {}
+for path in paths:
+    with gzip.open(path, "rt") as f:
+        data = json.load(f)
+    for ev in data.get("traceEvents", []):
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name", "")
+        if not name or name.startswith(("$", "process_")):
+            continue
+        buckets[name] += ev.get("dur", 0) / 1e6
+        counts[name] += 1
+        samples[name] = ev.get("args", {})
+for name, t in sorted(buckets.items(), key=lambda kv: -kv[1])[:20]:
+    a = samples[name]
+    src = a.get("source", "")
+    print(f"{t:8.4f}s n={counts[name]:6d} {name[:60]} {src}")
