@@ -18,8 +18,18 @@ timeout, the bench reruns on CPU with the platform clearly labeled.
 Baseline: the reference enforces >= 100 pods/sec on >100-pod batches
 (scheduling_benchmark_test.go:51,177-181); vs_baseline is pods/sec / 100.
 
+Variance discipline (the round-4 lesson): a single sample per shape let one
+tunnel stall publish a 16x-wrong number (2500 pods: 23.9 s in the driver
+capture vs 0.32 s an hour earlier, compile_s 0.0 — i.e. the measured rep
+stalled, not the compile). Each shape now runs >=3 measured reps after the
+compile warmup and reports {median, min, max, reps}; the aggregate uses
+medians. If max > 3x median the shape reruns extra reps so one stall can
+never be the headline — mirroring Go's repeated-iteration benchmark
+discipline (scheduling_benchmark_test.go:57-77).
+
 Env knobs:
   BENCH_QUICK=1         small grid (10/100/500 pods)
+  BENCH_REPS=n          measured reps per shape (default 3)
   BENCH_DEADLINE=secs   global budget for the child (default 2400)
   BENCH_STALL=secs      per-line stall timeout (default 600; first TPU
                         compile of the biggest bucket can take minutes)
@@ -135,6 +145,31 @@ def _grid():
 # child: the actual measurement. Emits one JSON line per event on stdout.
 # ---------------------------------------------------------------------------
 
+def _measure(fn, reps: int):
+    """reps timed calls of fn, plus up to 3 extra whenever max > 3x median
+    (a tunnel stall must never be the published number). Returns
+    (sorted_samples, median, last_result)."""
+    import statistics
+
+    samples = []
+    result = None
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        result = fn()
+        samples.append(time.perf_counter() - t0)
+    samples.sort()
+    median = statistics.median(samples)
+    extra = 0
+    while samples[-1] > 3 * median and extra < 3:
+        t0 = time.perf_counter()
+        result = fn()
+        samples.append(time.perf_counter() - t0)
+        samples.sort()
+        median = statistics.median(samples)
+        extra += 1
+    return samples, median, result
+
+
 def run_child():
     import __graft_entry__
 
@@ -164,28 +199,40 @@ def run_child():
     )
     solver = JaxSolver()
 
+    reps = max(int(os.environ.get("BENCH_REPS", "3")), 1)
+    first_solve = None
     for pod_count in _grid():
-        # warm and measure the SAME workload: the warmup compiles every
-        # shape bucket this problem hits (incl. retry-pass buckets), the
-        # repeat measures steady-state solve time — Go's b.ResetTimer
-        # discipline (scheduling_benchmark_test.go:176)
+        # warm once (compiles every shape bucket this problem hits, incl.
+        # retry-pass buckets — Go's b.ResetTimer discipline,
+        # scheduling_benchmark_test.go:176), then take >=reps measured
+        # samples. One stalled rep must never become the shape's number.
         pods = make_diverse_pods(pod_count, rng)
         t0 = time.perf_counter()
-        solver.solve(pods, its, [tpl])
-        warm_s = time.perf_counter() - t0
-
-        t0 = time.perf_counter()
         result = solver.solve(pods, its, [tpl])
-        solve_s = time.perf_counter() - t0
+        warm_s = time.perf_counter() - t0
+        if first_solve is None:
+            # first solve after process start, compile included — the
+            # restart-blindness number for an already-warm compile cache
+            first_solve = {"pods": pod_count, "s": round(warm_s, 4)}
+
+        samples, median, result = _measure(
+            lambda: solver.solve(pods, its, [tpl]), reps
+        )
         emit(
             {
                 "event": "shape",
                 "pods": pod_count,
-                "solve_s": round(solve_s, 4),
-                "compile_s": round(max(warm_s - solve_s, 0.0), 2),
+                "solve_s": round(median, 4),
+                "solve_min_s": round(samples[0], 4),
+                "solve_max_s": round(samples[-1], 4),
+                "reps": len(samples),
+                "samples": [round(s, 4) for s in samples],
+                "compile_s": round(max(warm_s - median, 0.0), 2),
                 "scheduled": result.num_scheduled(),
             }
         )
+    if first_solve is not None:
+        emit({"event": "first_solve", **first_solve})
 
     # cold-process latency: how long a FRESH process (persistent compile
     # cache populated by the grid above) takes from exec to a completed
@@ -247,15 +294,18 @@ def run_child():
             t0 = time.perf_counter()
             bench_candidate_scoring(n_candidates)  # compile warmup
             warm_s = time.perf_counter() - t0
-            t0 = time.perf_counter()
-            stats = bench_candidate_scoring(n_candidates)
-            solve_s = time.perf_counter() - t0
+            samples, median, stats = _measure(
+                lambda: bench_candidate_scoring(n_candidates), reps
+            )
             emit(
                 {
                     "event": "consolidation",
                     "candidates": n_candidates,
-                    "solve_s": round(solve_s, 4),
-                    "compile_s": round(max(warm_s - solve_s, 0.0), 2),
+                    "solve_s": round(median, 4),
+                    "solve_min_s": round(samples[0], 4),
+                    "solve_max_s": round(samples[-1], 4),
+                    "reps": len(samples),
+                    "compile_s": round(max(warm_s - median, 0.0), 2),
                     "consolidatable": stats.get("consolidatable", -1),
                     "mesh_devices": stats.get("mesh_devices", 1),
                 }
@@ -428,7 +478,22 @@ def main():
             str(e["pods"]): round(e["pods"] / max(e["solve_s"], 1e-9), 1)
             for e in shapes
         },
+        # solve_s is the per-shape MEDIAN of >=3 reps (VERDICT r4 #1);
+        # min/max/reps expose the variance a single sample used to hide
+        "per_shape_stats": {
+            str(e["pods"]): {
+                "median_s": e["solve_s"],
+                "min_s": e.get("solve_min_s", e["solve_s"]),
+                "max_s": e.get("solve_max_s", e["solve_s"]),
+                "reps": e.get("reps", 1),
+            }
+            for e in shapes
+        },
     }
+    first = next((e for e in events if e.get("event") == "first_solve"), None)
+    if first is not None:
+        out["first_solve_after_start_s"] = first["s"]
+        out["first_solve_after_start_pods"] = first["pods"]
     north = next((e for e in shapes if e["pods"] == 10000), None)
     if north is not None:
         # the BASELINE north star: 10k pods x 400+ ITs Solve() latency
@@ -442,6 +507,15 @@ def main():
         best = max(consol, key=rate)
         out["consolidation_candidates_per_sec"] = round(rate(best), 1)
         out["consolidation_vs_target_1k"] = round(rate(best) / 1000.0, 3)
+        out["consolidation_stats"] = {
+            str(e["candidates"]): {
+                "median_s": e["solve_s"],
+                "min_s": e.get("solve_min_s", e["solve_s"]),
+                "max_s": e.get("solve_max_s", e["solve_s"]),
+                "reps": e.get("reps", 1),
+            }
+            for e in consol
+        }
     if scheduled_frac < 0.95:
         # a solver that drops pods must not read as a throughput win
         # (reference asserts full schedulability of the diverse mix)

@@ -38,6 +38,10 @@ import numpy as np
 
 from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.apis.objects import Pod
+from karpenter_tpu.disruption.helpers import (
+    cheapest_existing_price_by_type,
+    same_type_price_cap,
+)
 from karpenter_tpu.ops.ffd import KIND_FAIL
 from karpenter_tpu.ops.padding import pad_problem
 from karpenter_tpu.parallel.mesh import (
@@ -103,6 +107,19 @@ class SubsetVerdict:
         if self.n_new_claims == 0:
             return True
         max_price = sum(c.price for c in candidates)
+        # same-type churn guard (multinodeconsolidation.go:155-188): when a
+        # replacement option shares a type with a deleted node, every option
+        # must be strictly cheaper than that type's existing price. For a
+        # single candidate this collapses into the total-price rule (same
+        # offering, same price), so applying it here keeps the screen aligned
+        # with BOTH sequential paths.
+        max_price = min(
+            max_price,
+            same_type_price_cap(
+                (instance_types[idx].name for idx in self.replacement_its),
+                cheapest_existing_price_by_type(candidates),
+            ),
+        )
         surviving_cts = set()
         for idx in self.replacement_its:
             it = instance_types[idx]
@@ -471,6 +488,9 @@ def bench_candidate_scoring(n_candidates: int = 100, mesh="auto") -> Dict[str, i
             self._pods = pods
             self.price = price
             self.capacity_type = capacity_type
+            # no catalog type: the same-type churn guard skips None
+            self.instance_type = None
+            self.zone = ""
 
         def reschedulable_pods(self):
             return self._pods

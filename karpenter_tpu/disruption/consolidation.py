@@ -19,6 +19,7 @@ from karpenter_tpu.apis.nodepool import (
     CONSOLIDATION_POLICY_WHEN_UNDERUTILIZED,
 )
 from karpenter_tpu.disruption.helpers import (
+    filter_out_same_type,
     filter_replacement_instance_types,
     get_candidates,
     simulate_scheduling,
@@ -106,7 +107,7 @@ class ConsolidationBase:
         if len(sim.result.new_claims) > 1:
             # a multi-replacement trade is never a consolidation win
             return Command(method=self.method_name)
-        if not filter_replacement_instance_types(sim, candidates):
+        if not self._filter_replacement(sim, candidates):
             return Command(method=self.method_name)
         replacements = []
         for placement in sim.result.new_claims:
@@ -122,6 +123,11 @@ class ConsolidationBase:
             method=self.method_name,
             consolidation_type=self.consolidation_type,
         )
+
+    def _filter_replacement(self, sim, candidates) -> bool:
+        """Price rules applied to the replacement claim; methods layer extra
+        filters on top (multi-node adds the same-type churn guard)."""
+        return filter_replacement_instance_types(sim, candidates)
 
     # -- validation (validation.go:68-110) ------------------------------------
 
@@ -215,6 +221,15 @@ class MultiNodeConsolidation(ConsolidationBase):
 
     method_name = "multi-node-consolidation"
     consolidation_type = "multi"
+
+    def _filter_replacement(self, sim, candidates) -> bool:
+        """Multi-node adds filterOutSameType (multinodeconsolidation.go:121-125,
+        155-188): replacing N nodes with one of the SAME types only counts as
+        consolidation below the existing type's price — otherwise deleting
+        alone is the right command and the replace is churn."""
+        if not filter_replacement_instance_types(sim, candidates):
+            return False
+        return filter_out_same_type(sim, candidates)
 
     def compute_command(
         self, budgets: Dict[str, int], candidates: Sequence[Candidate]

@@ -175,6 +175,73 @@ def candidate_total_price(candidates: Sequence[Candidate]) -> float:
     return sum(c.price for c in candidates)
 
 
+def cheapest_existing_price_by_type(
+    candidates: Sequence[Candidate],
+) -> Dict[str, float]:
+    """Cheapest current offering price per instance-type name among the
+    candidates (multinodeconsolidation.go:160-172). Shared by the sequential
+    filter below and the batched screen's verdict so the two paths can never
+    desynchronize on the same-type rule."""
+    prices: Dict[str, float] = {}
+    for c in candidates:
+        if c.instance_type is None:
+            continue
+        of = c.instance_type.offerings.get(c.capacity_type, c.zone)
+        if of is None:
+            continue
+        prev = prices.get(c.instance_type.name)
+        if prev is None or of.price < prev:
+            prices[c.instance_type.name] = of.price
+    return prices
+
+
+def same_type_price_cap(
+    replacement_names, existing_prices: Dict[str, float]
+) -> float:
+    """The maximum allowed replacement price once a type is shared between
+    the replacement options and the deleted nodes (inf when none shared)."""
+    return min(
+        (existing_prices[n] for n in replacement_names if n in existing_prices),
+        default=float("inf"),
+    )
+
+
+def filter_out_same_type(
+    sim: SimulationResults, candidates: Sequence[Candidate]
+) -> bool:
+    """Multi-node churn guard (multinodeconsolidation.go:155-188): when the
+    replacement's instance-type options include a type that one of the
+    deleted nodes already is, replacing is only a win below that type's
+    price — [2xlarge, 2xlarge, small] -> small is just deleting the two
+    2xlarges with extra churn, so every option >= the small's price is
+    dropped. The cap is the cheapest existing price among shared types;
+    options are kept only when their cheapest compatible offering is
+    strictly cheaper. Returns False when nothing survives (the command
+    becomes a rejection, not a pointless replace)."""
+    if not sim.result.new_claims:
+        return True
+    placement = sim.result.new_claims[0]
+    max_price = same_type_price_cap(
+        (sim.inputs.instance_types[i].name for i in placement.instance_type_indices),
+        cheapest_existing_price_by_type(candidates),
+    )
+    if max_price == float("inf"):
+        return True
+    reqs = placement.requirements
+    surviving = []
+    for idx in placement.instance_type_indices:
+        offerings = sim.inputs.instance_types[idx].offerings.available()
+        if reqs is not None:
+            offerings = offerings.requirements(reqs)
+        cheapest = offerings.cheapest()
+        if cheapest is not None and cheapest.price < max_price:
+            surviving.append(idx)
+    if not surviving:
+        return False
+    placement.instance_type_indices = surviving
+    return True
+
+
 def _replacement_capacity_types(sim, placement, surviving) -> set:
     """The capacity types the replacement claim could launch as: its explicit
     capacity-type requirement when concrete, else everything its surviving

@@ -62,13 +62,17 @@ def test_pending_pods_consume_simulated_capacity():
 def _guarded_cluster(pdb=None):
     """Two candidates, n1 carrying two 'guarded' pods; optionally a PDB over
     them. The no-PDB control must disrupt n1, making the gated variants'
-    negative assertions meaningful."""
+    negative assertions meaningful. Both nodes are default-instance-type so
+    the multi-node fold into one small IS strictly cheaper — a small node
+    among the candidates would trip the same-type churn guard
+    (multinodeconsolidation.go:155-188) and turn the control into a plain
+    delete that never touches n1."""
     env = Env()
     env.create(make_underutilized_pool())
     if pdb is not None:
         env.create(pdb)
     env.create_candidate_node(
-        "n1", it_name="small-instance-type",
+        "n1", it_name="default-instance-type",
         pods=[make_pod(name="g1", cpu=0.1, labels={"app": "guarded"}),
               make_pod(name="g2", cpu=0.1, labels={"app": "guarded"})],
     )
@@ -224,3 +228,60 @@ def test_budget_cron_window_gates_disruption():
     env.clock.step(353_800)  # 2023-11-19 00:30:00 UTC, inside the window
     cmd = env.reconcile_disruption()
     assert cmd is None
+
+
+def _same_type_catalog(with_nano: bool):
+    """[xlarge, xlarge, small] cluster over a catalog where 'small' is (or is
+    not) the cheapest type — the two filterOutSameType comment scenarios
+    (multinodeconsolidation.go:157-172)."""
+    from karpenter_tpu.cloudprovider.fake import GI, make_instance_type
+    from karpenter_tpu.utils import resources as res
+
+    env = Env()
+    catalog = [
+        make_instance_type("small-it", resources={res.CPU: 2.0, res.MEMORY: 2 * GI}),
+        make_instance_type("xlarge-it", resources={res.CPU: 8.0, res.MEMORY: 16 * GI}),
+    ]
+    if with_nano:
+        catalog.insert(
+            0, make_instance_type("nano-it", resources={res.CPU: 1.0, res.MEMORY: GI})
+        )
+    env.cloud_provider.instance_types = catalog
+    env.create(make_underutilized_pool())
+    env.create_candidate_node("x1", it_name="xlarge-it", pods=[make_pod(name="p1", cpu=0.1)])
+    env.create_candidate_node("x2", it_name="xlarge-it", pods=[make_pod(name="p2", cpu=0.1)])
+    env.create_candidate_node("s1", it_name="small-it", pods=[make_pod(name="p3", cpu=0.1)])
+    return env
+
+
+def test_multi_node_filter_out_same_type_rejects_churn():
+    # multinodeconsolidation.go:160-164 — [2xlarge, 2xlarge, small] must NOT
+    # be replaced by another small: that is deleting the two 2xlarges with
+    # extra churn. The filter empties the replacement options, the search
+    # walks down, and the command becomes a delete whose pods land on a
+    # surviving node.
+    env = _same_type_catalog(with_nano=False)
+    cmd = env.reconcile_disruption()
+    assert cmd is not None and cmd.decision == DECISION_DELETE
+    assert not cmd.replacements
+    assert len(cmd.candidates) < 3
+
+
+def test_multi_node_filter_out_same_type_keeps_strictly_cheaper():
+    # multinodeconsolidation.go:166-172 — with a nano in the catalog, the
+    # same-type cap (small's price) still admits the strictly cheaper type:
+    # [2xlarge, 2xlarge, small] -> 1 nano is a valid consolidation, and the
+    # replacement claim must offer ONLY types under the small's price.
+    from karpenter_tpu.apis import labels as wk
+    from karpenter_tpu.disruption.types import DECISION_REPLACE
+
+    env = _same_type_catalog(with_nano=True)
+    cmd = env.reconcile_disruption()
+    assert cmd is not None and cmd.decision == DECISION_REPLACE
+    assert {c.name for c in cmd.candidates} == {"x1", "x2", "s1"}
+    assert len(cmd.replacements) == 1
+    it_req = next(
+        r for r in cmd.replacements[0].spec.requirements
+        if r.key == wk.LABEL_INSTANCE_TYPE_STABLE
+    )
+    assert set(it_req.values) == {"nano-it"}
