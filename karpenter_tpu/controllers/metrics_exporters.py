@@ -2,9 +2,10 @@
 
 Equivalent of reference pkg/controllers/metrics/{node,nodepool,pod}: periodic
 scans publishing allocatable/requests per node (node/controller.go:47-190),
-limits/usage per nodepool, and pod phase counts + scheduling latency
-(pod/controller.go:58-190), all through the diffing metrics.Store so series
-for deleted objects disappear.
+limits/usage per nodepool, pod phase counts, and the pod startup-time
+histogram — creation to the Ready condition's transition, observed once per
+pod first seen Pending (pod/controller.go:68-75, 146-160) — all through the
+diffing metrics.Store so series for deleted objects disappear.
 """
 
 from __future__ import annotations
@@ -33,12 +34,22 @@ NODEPOOL_USAGE = REGISTRY.gauge(
 POD_STATE = REGISTRY.gauge(
     "pod_state", "Pods by phase", subsystem="pods"
 )
+POD_STARTUP_TIME = REGISTRY.histogram(
+    "startup_time_seconds",
+    "The time from pod creation until the pod is running",
+    subsystem="pods",
+)
 
 
 class MetricsExporter:
     def __init__(self, kube: KubeClient):
         self.kube = kube
         self.store = Store()
+        # pods seen Pending whose startup time has not been recorded yet
+        # (pod/controller.go pendingPods set); the observation fires exactly
+        # once, at the first scan where the pod has left Pending and carries
+        # a Ready condition
+        self._pending_pods: set = set()
 
     def reconcile(self) -> None:
         series: Dict[str, List[Tuple]] = {}
@@ -73,8 +84,35 @@ class MetricsExporter:
         phase_counts: Dict[str, int] = {}
         for p in pods:
             phase_counts[p.status.phase] = phase_counts.get(p.status.phase, 0) + 1
+            self._record_pod_startup(p)
+        live = {f"{p.metadata.namespace}/{p.metadata.name}" for p in pods}
+        self._pending_pods &= live
         series["pods"] = [
             (POD_STATE, {"phase": phase}, float(count))
             for phase, count in phase_counts.items()
         ]
         self.store.replace_all(series)
+
+    def _record_pod_startup(self, p: Pod) -> None:
+        """pod/controller.go:146-160: a pod is tracked while Pending; when it
+        has left Pending AND has a Ready condition, observe Ready transition
+        minus creation, once."""
+        key = f"{p.metadata.namespace}/{p.metadata.name}"
+        if p.status.phase == "Pending":
+            self._pending_pods.add(key)
+            return
+        if key not in self._pending_pods:
+            return
+        ready = next(
+            (
+                c
+                for c in p.status.conditions
+                if c.type == "Ready" and c.status == "True"
+            ),
+            None,
+        )
+        if ready is None:
+            return
+        created = p.metadata.creation_timestamp or 0.0
+        POD_STARTUP_TIME.observe(max(ready.last_transition_time - created, 0.0))
+        self._pending_pods.discard(key)

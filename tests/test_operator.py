@@ -185,3 +185,56 @@ def test_end_to_end_drift_replacement():
     names = {c.metadata.name for c in op.kube.list(NodeClaim)}
     assert old_claim.metadata.name not in names, "drifted claim not replaced"
     assert len(names) == 1, f"expected exactly the replacement, got {names}"
+
+
+def test_pod_startup_time_histogram_observed_once():
+    """pod/controller.go:146-160 — startup time = Ready transition minus
+    creation, observed exactly once per pod first seen Pending; pods never
+    seen Pending are not observed."""
+    from karpenter_tpu.apis.objects import PodCondition
+    from karpenter_tpu.controllers.metrics_exporters import (
+        POD_STARTUP_TIME,
+        MetricsExporter,
+    )
+    from karpenter_tpu.kube import KubeClient
+
+    clock = FakeClock()
+    kube = KubeClient(clock=clock)
+    exporter = MetricsExporter(kube)
+    count0, sum0 = POD_STARTUP_TIME.count(), POD_STARTUP_TIME.sum()
+
+    seen = make_pod(name="seen")
+    seen.metadata.creation_timestamp = clock.now()
+    kube.create(seen)
+    # never-Pending control: already Running at first scan
+    ghost = make_pod(name="ghost", phase="Running", node_name="n1")
+    ghost.status.conditions.append(
+        PodCondition(type="Ready", last_transition_time=clock.now())
+    )
+    kube.create(ghost)
+    exporter.reconcile()
+    assert POD_STARTUP_TIME.count() == count0
+
+    # left Pending but NOT ready yet: Ready=False must not observe (and the
+    # pod stays tracked for the real transition)
+    clock.step(30.0)
+    stored = kube.get(Pod, "seen", "default")
+    stored.status.phase = "Running"
+    stored.spec.node_name = "n1"
+    stored.status.conditions.append(
+        PodCondition(type="Ready", status="False", last_transition_time=clock.now())
+    )
+    kube.update(stored)
+    exporter.reconcile()
+    assert POD_STARTUP_TIME.count() == count0
+
+    clock.step(12.0)
+    stored = kube.get(Pod, "seen", "default")
+    stored.status.conditions = [
+        PodCondition(type="Ready", status="True", last_transition_time=clock.now())
+    ]
+    kube.update(stored)
+    exporter.reconcile()
+    exporter.reconcile()  # second scan must not re-observe
+    assert POD_STARTUP_TIME.count() == count0 + 1
+    assert abs(POD_STARTUP_TIME.sum() - sum0 - 42.0) < 1e-6
