@@ -211,7 +211,7 @@ class Operator:
         if self.options.solver_backend == "jax":
             from karpenter_tpu.solver.warmup import maybe_prewarm_in_background
 
-            maybe_prewarm_in_background(self.options)
+            maybe_prewarm_in_background(self.options, self.cloud_provider)
 
         def loop(name, reconcile, period):
             while not self._stop.is_set():

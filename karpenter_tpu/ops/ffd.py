@@ -1,4 +1,4 @@
-"""First-fit-decreasing bin-pack as a lax.scan.
+"""First-fit-decreasing bin-pack as a lax.scan — import facade.
 
 TPU-native re-design of the reference's Scheduler.Solve pod loop
 (scheduler.go:140-189, :238-285): pods arrive pre-sorted by the FFD queue
@@ -6,1956 +6,68 @@ order; one scan step places one pod. Placement *scoring* — which existing
 nodes / open claims / fresh template claims could accept the pod, including
 the topology domain selection — is computed for every candidate at once with
 the vectorized mask kernels (the reference walks them one by one,
-O(candidates × instanceTypes) set intersections per pod); the *commit* stays
+O(candidates x instanceTypes) set intersections per pod); the *commit* stays
 sequential inside the scan because every placement narrows the chosen bin's
 requirement state and shifts the topology counters.
 
-Placement priority per pod (scheduler.go:238-285):
-  1. first existing node (pre-sorted initialized-first) that tolerates, fits,
-     has no host-port conflict, is requirement-compatible, and satisfies
-     topology (existingnode.go:64-124, strict Compatible);
-  2. open claim with the fewest pods whose topology-narrowed state keeps >= 1
-     instance type satisfying requirements + resources + offerings
-     (nodeclaim.go:65-119);
-  3. first template (weight order) whose fresh claim — minted hostname
-     included — accepts the pod, subject to nodepool limit headroom
-     (filterByRemainingResources / subtractMax, scheduler.go:343-383);
-  4. otherwise the pod fails this pass (relaxation happens host-side between
-     passes, the carried FFDState preserving earlier placements).
+Module map (split round-5 from the former 2k-line monolith):
+  ffd_core.py   — FFDState/FFDResult, constants, initial state, lane
+                  padding/alignment, shared per-pod gate builders, and the
+                  closed-form capacity/water-level math
+  ffd_step.py   — the narrow per-pod scan step + the plain one-pass entry
+                  (solve_ffd)
+  ffd_sweeps.py — ALL relax-and-retry passes in one device launch with
+                  stride commits over strict-identical chains
+                  (solve_ffd_sweeps, the production provisioning entry)
+  ffd_runs.py   — run-compressed scan committing whole identical-pod runs
+                  by waterfill (solve_ffd_runs, fuzz-anchored to the
+                  per-pod step)
+
+Every public (and test-visible private) name re-exports here so callers
+keep one import surface.
 """
 
-from __future__ import annotations
-
-import functools
-from dataclasses import dataclass
-from typing import Any, Optional
-
-import jax
-import jax.numpy as jnp
-from jax import lax, vmap
-
-from karpenter_tpu.models.problem import ReqTensor, SchedulingProblem
-from karpenter_tpu.ops import masks
-from karpenter_tpu.ops.topology_kernels import (
-    PodTopoStatics,
-    record,
-    record_delta,
-    topo_gate,
+from karpenter_tpu.ops.ffd_core import (  # noqa: F401
+    FFDResult,
+    FFDState,
+    KIND_CLAIM,
+    KIND_FAIL,
+    KIND_NEW_CLAIM,
+    KIND_NODE,
+    KIND_NO_SLOT,
+    _capacity,
+    _first_true,
+    _fresh_template_rows,
+    _intersect_rows,
+    _lane_align,
+    _make_it_gate,
+    _mint_host_onehot,
+    _mix_req_rows,
+    _offer_rows,
+    _pad_lanes_mult32,
+    _pin_hostname,
+    _pod_xs,
+    _statics,
+    _water_level,
+    initial_state,
 )
-
-# placement kinds emitted per pod
-KIND_NODE = 0
-KIND_CLAIM = 1
-KIND_NEW_CLAIM = 2
-KIND_FAIL = 3
-KIND_NO_SLOT = 4  # a fresh claim would accept the pod, but slots ran out
-
-# vocab key indices the encoder pins (single source: models/problem.py)
-from karpenter_tpu.models.problem import CT_KEY, HOSTNAME_KEY, ZONE_KEY  # noqa: E402
-
-# plain int: a module-level jnp scalar would initialize the JAX backend at
-# import time (and block on the TPU tunnel in processes that never use it)
-_BIG = 2**30
-
-# scan unroll factor: amortizes per-iteration dispatch overhead on
-# accelerators at the cost of a proportionally bigger program to compile.
-# Measured on TPU v5e at the 2500-pod bench shape (r3): unroll=4 left steady
-# solve time unchanged (1.38s vs 1.39s) and 2.3x'd compile time — the step
-# body is large enough that dispatch overhead is negligible, so 1 stays the
-# default on both backends
-import os as _os  # noqa: E402
-
-_UNROLL = int(_os.environ.get("KARPENTER_TPU_SCAN_UNROLL", "1"))
-
-# dev-only cost-attribution knob: comma-set of step phases to stub out
-# (results become WRONG — never set outside tools/profile_step.py)
-_ABLATE = frozenset(
-    p for p in _os.environ.get("KARPENTER_TPU_ABLATE", "").split(",") if p
+from karpenter_tpu.ops.ffd_step import (  # noqa: F401
+    _make_step,
+    _solve_ffd_fresh_jit,
+    _solve_ffd_jit,
+    solve_ffd,
 )
-
-
-@jax.tree_util.register_dataclass
-@dataclass
-class FFDState:
-    claim_req: ReqTensor  # [C, K, V] narrowed requirement state per claim
-    claim_requests: Any  # f32[C, R] accumulated requests (incl daemon overhead)
-    claim_it_ok: Any  # bool[C, T] surviving instance types
-    claim_open: Any  # bool[C]
-    claim_npods: Any  # i32[C]
-    claim_tpl: Any  # i32[C]
-    claim_used_ports: Any  # bool[C, PT] reserved host-port lanes
-    node_req: ReqTensor  # [N, K, V] narrowed existing-node requirements
-    node_requests: Any  # f32[N, R] accumulated requests (incl daemon overhead)
-    node_npods: Any  # i32[N]
-    node_used_ports: Any  # bool[N, PT]
-    node_vol_used: Any  # i32[N, D] CSI attach counts per limited driver
-    remaining: Any  # f32[TPL, R] nodepool limits headroom (+inf unlimited)
-    grp_counts: Any  # i32[G, V] topology domain counts
-    grp_registered: Any  # bool[G, V] known topology domains
-
-
-@jax.tree_util.register_dataclass
-@dataclass
-class FFDResult:
-    kind: Any  # i32[P]
-    index: Any  # i32[P] node index / claim slot (meaning depends on kind)
-    state: FFDState  # final bin state
-
-
-def _first_true(mask: jnp.ndarray) -> jnp.ndarray:
-    """Index of the first True (or len(mask) when none)."""
-    return jnp.argmax(jnp.concatenate([mask, jnp.array([True])]))
-
-
-def _intersect_rows(reqs: ReqTensor, row: ReqTensor) -> ReqTensor:
-    return vmap(lambda r: masks.intersect(r, row))(reqs)
-
-
-def initial_state(problem: SchedulingProblem, max_claims: int) -> FFDState:
-    K, V = problem.num_keys, problem.num_lanes
-    T, R = problem.num_instance_types, problem.num_resources
-    N, C = problem.num_nodes, max_claims
-    PT = problem.pod_ports.shape[1]
-    lv = jnp.asarray(problem.lane_valid)
-    return FFDState(
-        claim_req=ReqTensor(
-            admitted=jnp.broadcast_to(lv, (C, K, V)),
-            comp=jnp.ones((C, K), dtype=bool),
-            gt=jnp.full((C, K), -(2**31) + 1, dtype=jnp.int32),
-            lt=jnp.full((C, K), 2**31 - 1, dtype=jnp.int32),
-            defined=jnp.zeros((C, K), dtype=bool),
-        ),
-        claim_requests=jnp.zeros((C, R), dtype=jnp.float32),
-        claim_it_ok=jnp.zeros((C, T), dtype=bool),
-        claim_open=jnp.zeros((C,), dtype=bool),
-        claim_npods=jnp.zeros((C,), dtype=jnp.int32),
-        claim_tpl=jnp.zeros((C,), dtype=jnp.int32),
-        claim_used_ports=jnp.zeros((C, PT), dtype=bool),
-        node_req=jax.tree_util.tree_map(jnp.asarray, problem.node_reqs),
-        node_requests=jnp.asarray(problem.node_overhead),
-        node_npods=jnp.zeros((N,), dtype=jnp.int32),
-        node_used_ports=jnp.asarray(problem.node_used_ports),
-        node_vol_used=jnp.asarray(problem.node_vol_used),
-        remaining=jnp.asarray(problem.tpl_remaining),
-        grp_counts=jnp.asarray(problem.grp_counts0),
-        grp_registered=jnp.asarray(problem.grp_registered0),
-    )
-
-
-def solve_ffd(
-    problem: SchedulingProblem, max_claims: int, init: Optional[FFDState] = None
-) -> FFDResult:
-    """Run one pack pass. Shapes are static per bucket; XLA caches the
-    compiled executable across batches. ``init`` carries bin + topology state
-    between relax-and-retry passes (the queue requeue of scheduler.go:150-170).
-
-    A fresh solve builds the initial state *inside* the jit: each eager
-    device op outside a jit is a separate launch through the (possibly
-    remote) TPU runtime, and initial_state's ~13 of them cost more than the
-    whole small-batch scan."""
-    if init is None:
-        return _solve_ffd_fresh_jit(problem, max_claims)
-    return _solve_ffd_jit(problem, init)
-
-
-def _pad_lanes_mult32(problem: SchedulingProblem) -> SchedulingProblem:
-    """Pad the value-lane axis to a multiple of 32 for bitpacking. Shape-static
-    (plain Python under trace); ops/padding.py already does this for bucketed
-    callers, so this is a no-op on the production path."""
-    V = problem.num_lanes
-    pad = (-V) % 32
-    if pad == 0:
-        return problem
-    import dataclasses
-
-    def pad_req(r: ReqTensor) -> ReqTensor:
-        return dataclasses.replace(
-            r, admitted=jnp.pad(r.admitted, [(0, 0)] * (r.admitted.ndim - 1) + [(0, pad)])
-        )
-
-    lane_pad = [(0, 0), (0, pad)]
-    return dataclasses.replace(
-        problem,
-        lane_valid=jnp.pad(problem.lane_valid, lane_pad),
-        lane_numeric=jnp.pad(problem.lane_numeric, lane_pad, constant_values=jnp.nan),
-        lane_lex_rank=jnp.pad(problem.lane_lex_rank, lane_pad, constant_values=2**30),
-        pod_reqs=pad_req(problem.pod_reqs),
-        pod_strict_reqs=pad_req(problem.pod_strict_reqs),
-        it_reqs=pad_req(problem.it_reqs),
-        tpl_reqs=pad_req(problem.tpl_reqs),
-        node_reqs=pad_req(problem.node_reqs),
-        grp_filter=pad_req(problem.grp_filter),
-        grp_counts0=jnp.pad(problem.grp_counts0, lane_pad),
-        grp_registered0=jnp.pad(problem.grp_registered0, lane_pad),
-    )
-
-
-def _lane_align(problem: SchedulingProblem, init: FFDState):
-    problem = _pad_lanes_mult32(problem)
-    V = problem.num_lanes
-    # lane-pad carried state to match (no-op when init came from initial_state)
-    if init.grp_counts.shape[-1] != V:
-        pad = V - init.grp_counts.shape[-1]
-        import dataclasses
-
-        def pad_adm(r):
-            return dataclasses.replace(
-                r, admitted=jnp.pad(r.admitted, [(0, 0)] * (r.admitted.ndim - 1) + [(0, pad)])
-            )
-
-        init = dataclasses.replace(
-            init,
-            claim_req=pad_adm(init.claim_req),
-            node_req=pad_adm(init.node_req),
-            grp_counts=jnp.pad(init.grp_counts, [(0, 0), (0, pad)]),
-            grp_registered=jnp.pad(init.grp_registered, [(0, 0), (0, pad)]),
-        )
-    return problem, init
-
-
-def _statics(problem: SchedulingProblem):
-    """Per-solve invariants shared by the per-pod step and the run commit."""
-    lv, ln = jnp.asarray(problem.lane_valid), jnp.asarray(problem.lane_numeric)
-    wellknown = jnp.asarray(problem.key_wellknown)
-    no_allow = jnp.zeros_like(wellknown)
-    # instance-type side of the hot compat product: packed lanes + polarity,
-    # computed once per solve (instance types never change during a pack)
-    it_packed = masks.pack_lanes(jnp.asarray(problem.it_reqs.admitted))  # [T, K, W]
-    it_neg = vmap(lambda r: masks.negative_polarity(r, lv, ln))(problem.it_reqs)
-    return lv, ln, wellknown, no_allow, it_packed, it_neg
-
-
-def _make_it_gate(problem, statics):
-    lv, ln, wellknown, no_allow, it_packed, it_neg = statics
-
-    def it_gate(state_rows: ReqTensor, requests: jnp.ndarray, prior_ok: jnp.ndarray):
-        """[B, T] mask of instance types surviving a narrowed state +
-        accumulated requests (nodeclaim.go:225-260)."""
-        state_packed = masks.pack_lanes(state_rows.admitted)  # [B, K, W]
-        state_neg = vmap(lambda r: masks.negative_polarity(r, lv, ln))(state_rows)
-        compat = masks.packed_pairwise_compat(
-            state_rows, state_packed, state_neg, problem.it_reqs, it_packed, it_neg
-        )  # [B, T]
-        fit = masks.fits(requests[:, None, :], problem.it_alloc[None, :, :])  # [B, T]
-        offer = _offer_rows(problem, state_rows.admitted)  # [B, T]
-        return prior_ok & compat & fit & offer
-
-    return it_gate
-
-
-def _offer_rows(problem: SchedulingProblem, admitted) -> jnp.ndarray:
-    """[B, T] has_offering over a batch of bin states — MXU matmul when the
-    dense offer_zc table exists, per-offering lane gathers otherwise."""
-    if problem.offer_zc is not None:
-        return masks.has_offering_zc(admitted, ZONE_KEY, CT_KEY, problem.offer_zc)
-    return vmap(
-        lambda adm: masks.has_offering(
-            adm, ZONE_KEY, CT_KEY, problem.offer_zone, problem.offer_ct, problem.offer_ok
-        )
-    )(admitted)
-
-
-def _mix_req_rows(cur: ReqTensor, upd: ReqTensor, hot) -> ReqTensor:
-    """Commit updated requirement rows where ``hot`` (bool[E]) is set."""
-    sel2, sel3 = hot[:, None], hot[:, None, None]
-    return ReqTensor(
-        admitted=jnp.where(sel3, upd.admitted, cur.admitted),
-        comp=jnp.where(sel2, upd.comp, cur.comp),
-        gt=jnp.where(sel2, upd.gt, cur.gt),
-        lt=jnp.where(sel2, upd.lt, cur.lt),
-        defined=jnp.where(sel2, upd.defined, cur.defined),
-    )
-
-
-def _mint_host_onehot(problem: SchedulingProblem, free_slot):
-    """One-hot of the hostname lane minted for the prospective slot
-    (nodeclaim.go:46-63); all-False when the encoder allotted no lanes."""
-    V = problem.num_lanes
-    if problem.claim_hostname_lane.shape[0] == 0:
-        return jnp.zeros((V,), dtype=bool)
-    host_lane = problem.claim_hostname_lane[
-        jnp.minimum(free_slot, problem.claim_hostname_lane.shape[0] - 1)
-    ]
-    return jnp.arange(V) == host_lane
-
-
-def _pin_hostname(row: ReqTensor, host_onehot) -> ReqTensor:
-    """Pin requirement row(s) ([K, V] or [E, K, V]) to the minted hostname:
-    admitted lanes collapse to the mint, the key becomes a defined concrete
-    set. Shared by the per-pod step's template rows and the run commit so the
-    pin semantics can never diverge between them."""
-    return ReqTensor(
-        admitted=row.admitted.at[..., HOSTNAME_KEY, :].set(
-            row.admitted[..., HOSTNAME_KEY, :] & host_onehot
-        ),
-        comp=row.comp.at[..., HOSTNAME_KEY].set(False),
-        gt=row.gt,
-        lt=row.lt,
-        defined=row.defined.at[..., HOSTNAME_KEY].set(True),
-    )
-
-
-def _fresh_template_rows(problem: SchedulingProblem, lv, ln, wellknown, pod_req, free_slot):
-    """Fresh-claim template evaluation shared by the per-pod step and the run
-    commit: the prospective slot's hostname is minted and pinned into the
-    merged template rows before any gate sees them (nodeclaim.go:46-63), and
-    template compatibility uses the well-known allowance. Returns
-    (tpl_merged, tpl_compat, host_onehot)."""
-    mint_hostnames = problem.claim_hostname_lane.shape[0] > 0
-    host_onehot = _mint_host_onehot(problem, free_slot)
-    tpl_compat = vmap(
-        lambda tr: masks.compatible_ok(tr, pod_req, lv, ln, wellknown)
-    )(problem.tpl_reqs)
-    tpl_merged = _intersect_rows(problem.tpl_reqs, pod_req)
-    if mint_hostnames:
-        tpl_merged = _pin_hostname(tpl_merged, host_onehot)
-    return tpl_merged, tpl_compat, host_onehot
-
-
-def _pod_xs(problem: SchedulingProblem):
-    return (
-        problem.pod_reqs,
-        problem.pod_strict_reqs,
-        jnp.asarray(problem.pod_requests),
-        jnp.asarray(problem.pod_tol_tpl),
-        jnp.asarray(problem.pod_tol_node),
-        jnp.asarray(problem.pod_ports),
-        jnp.asarray(problem.pod_port_conflict),
-        jnp.asarray(problem.pod_grp_match),
-        jnp.asarray(problem.pod_grp_selects),
-        jnp.asarray(problem.pod_grp_owned),
-        jnp.asarray(problem.pod_vol_counts),
-        jnp.asarray(problem.pod_active),
-    )
-
-
-def _make_step(problem: SchedulingProblem, statics, C: int):
-    lv, ln, wellknown, no_allow, it_packed, it_neg = statics
-    N = problem.num_nodes
-    T = problem.num_instance_types
-    TPL = problem.num_templates
-    K = problem.num_keys
-    V = problem.num_lanes
-    it_gate = _make_it_gate(problem, statics)
-
-    def step(state: FFDState, pod):
-        (
-            pod_req,
-            pod_strict,
-            pod_requests,
-            tol_tpl,
-            tol_node,
-            pod_ports,
-            pod_conflict,
-            grp_match,
-            grp_selects,
-            grp_owned,
-            pod_vols,
-            pod_is_active,
-        ) = pod
-        topo_pod = PodTopoStatics(
-            strict_admitted=pod_strict.admitted,
-            grp_match=grp_match,
-            grp_selects=grp_selects,
-            grp_owned=grp_owned,
-        )
-        # NOTE on lax.cond here: conditionals only pay off when branch
-        # outputs are small — a cond whose identity branch passes [B, K, V]
-        # requirement tensors through forces per-step copies that cost more
-        # than the gate it skips (measured +0.15s on the 10k bench). So the
-        # topo gates stay unconditional; only the template phase (small
-        # row outputs) and record (two [G, V] outputs) are conditional.
-
-        def gated(merged, allow, registered):
-            return topo_gate(
-                problem, state.grp_counts, registered, topo_pod, merged, allow
-            )
-
-        # -- 1. existing nodes (scheduler.go:240-244; existingnode.go:64-124)
-        node_requests2 = state.node_requests + pod_requests[None, :]
-        node_fit = masks.fits(node_requests2, problem.node_avail)
-        node_compat = vmap(
-            lambda nr: masks.compatible_ok(nr, pod_req, lv, ln, no_allow)
-        )(state.node_req)
-        node_port_ok = ~jnp.any(state.node_used_ports & pod_conflict[None, :], axis=-1)
-        # CSI attach limits gate existing nodes only (existingnode.go:100-106)
-        node_vol_ok = jnp.all(
-            state.node_vol_used + pod_vols[None, :] <= problem.node_vol_limits, axis=-1
-        )
-        node_merged = _intersect_rows(state.node_req, pod_req)
-        node_topo_ok, node_final = gated(node_merged, no_allow, state.grp_registered)
-        node_ok = tol_node & node_fit & node_compat & node_port_ok & node_vol_ok & node_topo_ok
-        node_pick = _first_true(node_ok)
-        any_node = jnp.any(node_ok)
-
-        # -- 2. open claims, fewest pods first (scheduler.go:247-254)
-        claim_compat = vmap(
-            lambda cr: masks.compatible_ok(cr, pod_req, lv, ln, wellknown)
-        )(state.claim_req)
-        claim_merged = _intersect_rows(state.claim_req, pod_req)
-        if "ctopo" in _ABLATE:
-            claim_topo_ok, claim_final = jnp.ones((C,), bool), claim_merged
-        else:
-            claim_topo_ok, claim_final = gated(
-                claim_merged, wellknown, state.grp_registered
-            )
-        claim_requests2 = state.claim_requests + pod_requests[None, :]
-        if "citgate" in _ABLATE:
-            claim_it_ok2 = state.claim_it_ok
-        else:
-            claim_it_ok2 = it_gate(claim_final, claim_requests2, state.claim_it_ok)
-        claim_port_ok = ~jnp.any(state.claim_used_ports & pod_conflict[None, :], axis=-1)
-        claim_ok = (
-            state.claim_open
-            & tol_tpl[state.claim_tpl]
-            & claim_port_ok
-            & claim_compat
-            & claim_topo_ok
-            & jnp.any(claim_it_ok2, axis=-1)
-        )
-        claim_rank = jnp.where(claim_ok, state.claim_npods * C + jnp.arange(C), _BIG)
-        claim_pick = jnp.argmin(claim_rank)
-        any_claim = jnp.any(claim_ok)
-
-        # -- 3. fresh claim from templates, weight order (scheduler.go:256-283);
-        # the prospective slot's hostname is minted before evaluation
-        # (nodeclaim.go:46-63) and its lane registered for topology if opened.
-        # The whole phase runs under lax.cond: it can only influence the
-        # outcome when the node and claim phases both failed and a slot is
-        # free, which on large packs is a small minority of steps (opens +
-        # terminal failures).
-        free_slot = _first_true(~state.claim_open)
-        has_slot = jnp.any(~state.claim_open)
-        # hostname minting is active only when the encoder allotted claim
-        # hostname lanes (static shape decision)
-        mint_hostnames = problem.claim_hostname_lane.shape[0] > 0
-        need_tpl = (~any_node) & (~any_claim) & has_slot & pod_is_active
-
-        def eval_tpl():
-            tpl_requests2 = problem.tpl_overhead + pod_requests[None, :]
-            tpl_merged, tpl_compat, host_onehot = _fresh_template_rows(
-                problem, lv, ln, wellknown, pod_req, free_slot
-            )
-            # the new hostname is registered before the gate evaluates
-            reg_for_tpl = state.grp_registered | (
-                (problem.grp_key == HOSTNAME_KEY)[:, None] & host_onehot[None, :]
-            )
-            if "ttopo" in _ABLATE:
-                tpl_topo_ok, tpl_final = jnp.ones((TPL,), bool), tpl_merged
-            else:
-                tpl_topo_ok, tpl_final = gated(tpl_merged, wellknown, reg_for_tpl)
-            within_limits = masks.fits(
-                problem.it_cap[None, :, :], state.remaining[:, None, :]
-            )  # [TPL, T]
-            if "titgate" in _ABLATE:
-                tpl_it_ok2 = problem.tpl_it_ok & within_limits
-            else:
-                tpl_it_ok2 = it_gate(
-                    tpl_final, tpl_requests2, problem.tpl_it_ok & within_limits
-                )
-            tpl_ok = tol_tpl & tpl_compat & tpl_topo_ok & jnp.any(tpl_it_ok2, axis=-1)
-            tpl_pick = _first_true(tpl_ok)
-            pick_c = jnp.minimum(tpl_pick, TPL - 1)
-            slot_req = tpl_final.row(pick_c)
-            tpl_row_it_ok = tpl_it_ok2[pick_c]
-            max_cap = jnp.max(
-                jnp.where(tpl_row_it_ok[:, None], problem.it_cap, 0.0), axis=0
-            )  # [R]
-            return (
-                jnp.any(tpl_ok),
-                tpl_pick.astype(jnp.int32),
-                slot_req,
-                tpl_requests2[pick_c],
-                tpl_row_it_ok,
-                max_cap,
-                host_onehot,
-            )
-
-        def skip_tpl():
-            R = problem.tpl_overhead.shape[1]
-            return (
-                jnp.bool_(False),
-                jnp.int32(0),
-                ReqTensor(
-                    admitted=jnp.zeros((K, V), bool),
-                    comp=jnp.zeros((K,), bool),
-                    gt=jnp.zeros((K,), jnp.int32),
-                    lt=jnp.zeros((K,), jnp.int32),
-                    defined=jnp.zeros((K,), bool),
-                ),
-                jnp.zeros((R,), problem.tpl_overhead.dtype),
-                jnp.zeros((T,), bool),
-                jnp.zeros((R,), problem.it_cap.dtype),
-                jnp.zeros((V,), bool),
-            )
-
-        (
-            any_tpl,
-            tpl_pick,
-            slot_req,
-            tpl_row_requests,
-            tpl_row_it_ok,
-            max_cap,
-            host_onehot,
-        ) = lax.cond(need_tpl, eval_tpl, skip_tpl)
-
-        # with every slot taken, free_slot clamps to slot 0 and the template
-        # phase evaluated a USED hostname — its verdict is meaningless, so the
-        # no-slot case must classify as KIND_NO_SLOT unconditionally (the
-        # backend's doubled-slot retry then produces the true answer); mapping
-        # it through any_tpl misread "slot 0's hostname is taken" as a
-        # permanent KIND_FAIL and starved the slot-growth path
-        kind = jnp.where(
-            any_node,
-            KIND_NODE,
-            jnp.where(
-                any_claim,
-                KIND_CLAIM,
-                jnp.where(
-                    ~has_slot,
-                    KIND_NO_SLOT,
-                    jnp.where(any_tpl, KIND_NEW_CLAIM, KIND_FAIL),
-                ),
-            ),
-        ).astype(jnp.int32)
-        # masked-out rows (pod_active=False: padding, or a consolidation
-        # variant's inert candidate pods) fail without touching state — all
-        # one-hot commits below derive from kind
-        kind = jnp.where(pod_is_active, kind, KIND_FAIL)
-
-        # -- commit via one-hot masks
-        node_hot = (jnp.arange(N) == node_pick) & (kind == KIND_NODE)
-        claim_hot = (jnp.arange(C) == claim_pick) & (kind == KIND_CLAIM)
-        slot_hot = (jnp.arange(C) == free_slot) & (kind == KIND_NEW_CLAIM)
-
-        mix_req = _mix_req_rows
-
-        def gather_row(rows: ReqTensor, idx, cap) -> ReqTensor:
-            return rows.row(jnp.minimum(idx, cap - 1))
-
-        # node commit (existingnode.go:116-123)
-        new_node_req = mix_req(state.node_req, node_final, node_hot)
-        new_node_requests = jnp.where(node_hot[:, None], node_requests2, state.node_requests)
-        new_node_npods = state.node_npods + node_hot.astype(jnp.int32)
-        new_node_used_ports = state.node_used_ports | (node_hot[:, None] & pod_ports[None, :])
-        new_node_vol_used = state.node_vol_used + node_hot[:, None].astype(jnp.int32) * pod_vols[None, :]
-
-        # claim commit (nodeclaim.go:111-118); slot_req / tpl_row_* come from
-        # the conditional template phase above
-        new_claim_req = mix_req(
-            mix_req(state.claim_req, claim_final, claim_hot),
-            ReqTensor(
-                admitted=jnp.broadcast_to(slot_req.admitted, (C, K, V)),
-                comp=jnp.broadcast_to(slot_req.comp, (C, K)),
-                gt=jnp.broadcast_to(slot_req.gt, (C, K)),
-                lt=jnp.broadcast_to(slot_req.lt, (C, K)),
-                defined=jnp.broadcast_to(slot_req.defined, (C, K)),
-            ),
-            slot_hot,
-        )
-        new_claim_requests = jnp.where(
-            claim_hot[:, None],
-            claim_requests2,
-            jnp.where(slot_hot[:, None], tpl_row_requests[None, :], state.claim_requests),
-        )
-        new_claim_it_ok = jnp.where(
-            claim_hot[:, None],
-            claim_it_ok2,
-            jnp.where(slot_hot[:, None], tpl_row_it_ok[None, :], state.claim_it_ok),
-        )
-        new_claim_open = state.claim_open | slot_hot
-        new_claim_npods = state.claim_npods + claim_hot.astype(jnp.int32) + slot_hot.astype(jnp.int32)
-        new_claim_tpl = jnp.where(slot_hot, tpl_pick.astype(jnp.int32), state.claim_tpl)
-        new_claim_used_ports = state.claim_used_ports | (
-            (claim_hot | slot_hot)[:, None] & pod_ports[None, :]
-        )
-
-        # opening a claim burns pessimistic headroom (subtractMax) and
-        # registers its hostname lane for hostname topologies
-        opened = kind == KIND_NEW_CLAIM
-        opened_tpl_hot = (jnp.arange(TPL) == tpl_pick) & opened
-        new_remaining = jnp.where(
-            opened_tpl_hot[:, None], state.remaining - max_cap[None, :], state.remaining
-        )
-        new_registered = state.grp_registered | (
-            opened
-            & mint_hostnames
-            & (problem.grp_key == HOSTNAME_KEY)[:, None]
-            & host_onehot[None, :]
-        )
-
-        # topology record for the chosen bin (topology.go:125-148) — an
-        # identity unless a placement happened AND some group selects or is
-        # owned by this pod, so it runs under lax.cond (generic pods with
-        # labels no selector matches skip it entirely)
-        committed = (kind == KIND_NODE) | (kind == KIND_CLAIM) | (kind == KIND_NEW_CLAIM)
-        should_record = committed & (
-            jnp.any(topo_pod.grp_selects) | jnp.any(topo_pod.grp_owned)
-        )
-
-        def do_record():
-            chosen_final = gather_row(node_final, node_pick, N) if N > 0 else None
-            claim_row = gather_row(claim_final, claim_pick, C)
-            slot_row = slot_req
-
-            def pick_rows(a, b, cond):
-                return jax.tree_util.tree_map(
-                    lambda x, y: jnp.where(
-                        jnp.reshape(cond, (1,) * x.ndim), x, y
-                    ),
-                    a,
-                    b,
-                )
-
-            rec_row = pick_rows(claim_row, slot_row, kind == KIND_CLAIM)
-            if N > 0:
-                rec_row = pick_rows(chosen_final, rec_row, kind == KIND_NODE)
-            rec_allow = jnp.where(kind == KIND_NODE, no_allow, wellknown)
-            return record(
-                problem,
-                state.grp_counts,
-                new_registered,
-                topo_pod,
-                rec_row,
-                rec_allow,
-                committed,
-                lv,
-                ln,
-            )
-
-        if "record" in _ABLATE:
-            new_counts = state.grp_counts
-        else:
-            new_counts, new_registered = lax.cond(
-                should_record, do_record, lambda: (state.grp_counts, new_registered)
-            )
-
-        index = jnp.where(
-            kind == KIND_NODE,
-            node_pick,
-            jnp.where(kind == KIND_CLAIM, claim_pick, jnp.where(kind == KIND_NEW_CLAIM, free_slot, -1)),
-        ).astype(jnp.int32)
-
-        new_state = FFDState(
-            claim_req=new_claim_req,
-            claim_requests=new_claim_requests,
-            claim_it_ok=new_claim_it_ok,
-            claim_open=new_claim_open,
-            claim_npods=new_claim_npods,
-            claim_tpl=new_claim_tpl,
-            claim_used_ports=new_claim_used_ports,
-            node_req=new_node_req,
-            node_requests=new_node_requests,
-            node_npods=new_node_npods,
-            node_used_ports=new_node_used_ports,
-            node_vol_used=new_node_vol_used,
-            remaining=new_remaining,
-            grp_counts=new_counts,
-            grp_registered=new_registered,
-        )
-        return new_state, (kind, index)
-
-    return step
-
-
-@jax.jit
-def _solve_ffd_jit(problem: SchedulingProblem, init: FFDState) -> FFDResult:
-    """Reference per-pod scan: one pod per step — the provisioning
-    production default (faster than the run-compressed scan on diverse
-    workloads, see solver/jax_backend.py) and the semantic anchor the
-    run-compressed solver is fuzz-checked against."""
-    problem, init = _lane_align(problem, init)
-    step = _make_step(problem, _statics(problem), init.claim_open.shape[0])
-    final_state, (kinds, indices) = lax.scan(step, init, _pod_xs(problem), unroll=_UNROLL)
-    return FFDResult(kind=kinds, index=indices, state=final_state)
-
-
-@functools.partial(jax.jit, static_argnums=(1,))
-def _solve_ffd_fresh_jit(problem: SchedulingProblem, max_claims: int) -> FFDResult:
-    """Fresh-state variant: initial_state is traced into the program so a
-    first-pass solve is a single device launch."""
-    problem = _pad_lanes_mult32(problem)
-    return _solve_ffd_jit.__wrapped__(problem, initial_state(problem, max_claims))
-
-
-# max pods committed per sweep iteration by the stride commit (see
-# _make_stride); identical consecutive pods beyond this window simply take
-# another iteration
-_STRIDE = int(_os.environ.get("KARPENTER_TPU_STRIDE", "64"))
-# experimental chain-dispatch sweep structure (see _sweeps_impl)
-_CHAIN_DISPATCH = _os.environ.get("KARPENTER_TPU_CHAIN_DISPATCH", "") == "1"
-
-
-def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
-    """One sweep iteration: evaluate ONE pod exactly (the narrow per-pod
-    gates), then commit it together with up to S-1 byte-identical consecutive
-    queue successors in closed form — bit-identical to stepping them one at a
-    time:
-
-      - identical pods against unchanged state get identical verdicts, so a
-        FAIL (or NO_SLOT) verdict extends to the whole identical chain at
-        zero cost — one iteration requeues (or flags) all of them;
-      - a placed pod's chain may stack into its chosen bin while j such pods
-        still fit (the per-pod fit gate's closed form over instance types /
-        node capacity, ports and CSI limits included) and, for claims, while
-        the bin remains the fewest-pods pick with j-1 stack-mates aboard
-        (rank stays below the second-best eligible rank — competitors' ranks
-        never improve, so the bound is exact);
-      - stacking is allowed only when the pod's own record set cannot feed
-        back into its own gate set: no matched group is recorded into,
-        EXCEPT regular affinity groups, whose gate is monotone in the
-        counters — the first pod's narrowed row makes every successor's
-        merge, gate verdict, and record delta identical (the allowed-domain
-        set only grows, and the bin state is already narrowed inside it);
-      - record deltas are then identical per stack member: counts += k*delta.
-
-    A claim-open commits alone (it moves free_slot, limits headroom, and the
-    fewest-pods ranking). Every iteration consumes >= 1 pod.
-    """
-    lv, ln, wellknown, no_allow, it_packed, it_neg = statics
-    N = problem.num_nodes
-    T = problem.num_instance_types
-    TPL = problem.num_templates
-    K = problem.num_keys
-    V = problem.num_lanes
-    R = problem.pod_requests.shape[1]
-    it_gate = _make_it_gate(problem, statics)
-    mint_hostnames = problem.claim_hostname_lane.shape[0] > 0
-    G = problem.grp_key.shape[0]
-    P = problem.num_pods
-    eqprev_arr = (
-        jnp.asarray(problem.pod_eqprev)
-        if problem.pod_eqprev is not None
-        else jnp.zeros((P,), bool)
-    )
-    eqgate_arr = (
-        jnp.asarray(problem.pod_eqprev_gate)
-        if problem.pod_eqprev_gate is not None
-        else jnp.zeros((P,), bool)
-    )
-    # the analytic waterfill commit consumes whole gate-identical chains
-    # (record sum included); scratch tail so a window near P never clamps
-    run_commit = _make_run_commit(problem, statics, C, S)
-    active_concat = jnp.concatenate(
-        [jnp.asarray(problem.pod_active), jnp.zeros((S,), bool)]
-    )
-    Srange = jnp.arange(S)
-
-    def topo_of(pod):
-        return PodTopoStatics(
-            strict_admitted=pod[1].admitted,
-            grp_match=pod[7],
-            grp_selects=pod[8],
-            grp_owned=pod[9],
-        )
-
-    def _zeros_row():
-        return ReqTensor(
-            admitted=jnp.zeros((K, V), bool),
-            comp=jnp.zeros((K,), bool),
-            gt=jnp.zeros((K,), jnp.int32),
-            lt=jnp.zeros((K,), jnp.int32),
-            defined=jnp.zeros((K,), bool),
-        )
-
-    def eval_base(state: FFDState, pod):
-        # NOTE: the node/claim gate phases below intentionally mirror
-        # _make_step's — _make_step stays the scan-path anchor the
-        # randomized-parity fuzz cross-checks this path against (and both
-        # are anchored to the host oracle). Any gate change must land in
-        # BOTH, and the 64-seed fuzz is the guard that they did.
-        (
-            pod_req,
-            _pod_strict,
-            pod_requests,
-            tol_tpl,
-            tol_node,
-            pod_ports,
-            pod_conflict,
-            _gm,
-            _gs,
-            _go,
-            pod_vols,
-            pod_is_active,
-        ) = pod
-        topo_pod = topo_of(pod)
-        port_cap = jnp.where(jnp.any(pod_ports), 1, _BIG_CAP).astype(jnp.int32)
-
-        # -- existing nodes (same gates as _make_step)
-        node_requests2 = state.node_requests + pod_requests[None, :]
-        node_fit = masks.fits(node_requests2, problem.node_avail)
-        node_compat = vmap(
-            lambda nr: masks.compatible_ok(nr, pod_req, lv, ln, no_allow)
-        )(state.node_req)
-        node_port_ok = ~jnp.any(state.node_used_ports & pod_conflict[None, :], axis=-1)
-        node_vol_ok = jnp.all(
-            state.node_vol_used + pod_vols[None, :] <= problem.node_vol_limits, axis=-1
-        )
-        node_merged = _intersect_rows(state.node_req, pod_req)
-        node_topo_ok, node_final = topo_gate(
-            problem, state.grp_counts, state.grp_registered, topo_pod, node_merged, no_allow
-        )
-        node_ok = tol_node & node_fit & node_compat & node_port_ok & node_vol_ok & node_topo_ok
-        node_pick = _first_true(node_ok)
-        any_node = jnp.any(node_ok)
-        if N > 0:
-            pick_n = jnp.minimum(node_pick, N - 1)
-            node_final_row = node_final.row(pick_n)
-            res_cap = _capacity(
-                problem.node_avail[pick_n], state.node_requests[pick_n], pod_requests
-            )
-            if problem.pod_vol_counts.shape[1] > 0:
-                vol_room = jnp.maximum(
-                    (problem.node_vol_limits[pick_n] - state.node_vol_used[pick_n])
-                    // jnp.maximum(pod_vols, 1),
-                    0,
-                )
-                vol_cap = jnp.min(
-                    jnp.where(pod_vols > 0, vol_room, _BIG_CAP)
-                ).astype(jnp.int32)
-            else:
-                vol_cap = jnp.int32(_BIG_CAP)
-            node_fit_count = jnp.minimum(jnp.minimum(res_cap, vol_cap), port_cap)
-        else:
-            node_final_row = _zeros_row()
-            node_fit_count = jnp.int32(0)
-
-        # -- open claims (same gates as _make_step)
-        claim_compat = vmap(
-            lambda cr: masks.compatible_ok(cr, pod_req, lv, ln, wellknown)
-        )(state.claim_req)
-        claim_merged = _intersect_rows(state.claim_req, pod_req)
-        claim_topo_ok, claim_final = topo_gate(
-            problem, state.grp_counts, state.grp_registered, topo_pod, claim_merged, wellknown
-        )
-        claim_requests2 = state.claim_requests + pod_requests[None, :]
-        claim_it_ok2 = it_gate(claim_final, claim_requests2, state.claim_it_ok)
-        claim_port_ok = ~jnp.any(state.claim_used_ports & pod_conflict[None, :], axis=-1)
-        claim_ok = (
-            state.claim_open
-            & tol_tpl[state.claim_tpl]
-            & claim_port_ok
-            & claim_compat
-            & claim_topo_ok
-            & jnp.any(claim_it_ok2, axis=-1)
-        )
-        claim_rank = jnp.where(claim_ok, state.claim_npods * C + jnp.arange(C), _BIG)
-        claim_pick = jnp.argmin(claim_rank)
-        any_claim = jnp.any(claim_ok)
-        rank2 = jnp.min(jnp.where(jnp.arange(C) == claim_pick, _BIG, claim_rank))
-        claim_final_row = claim_final.row(claim_pick)
-        itok_row = claim_it_ok2[claim_pick]
-        cap_ct = _capacity(
-            problem.it_alloc,
-            state.claim_requests[claim_pick][None, :],
-            pod_requests[None, :],
-        )  # [T]
-        claim_fit_count = jnp.minimum(
-            jnp.max(jnp.where(itok_row, cap_ct, 0)), port_cap
-        ).astype(jnp.int32)
-        claim_npods0 = state.claim_npods[claim_pick]
-
-        return (
-            any_node,
-            node_pick.astype(jnp.int32),
-            node_final_row,
-            node_fit_count,
-            any_claim,
-            claim_pick.astype(jnp.int32),
-            rank2.astype(jnp.int32),
-            claim_final_row,
-            itok_row,
-            cap_ct,
-            claim_fit_count,
-            claim_npods0,
-            pod_is_active,
-        )
-
-    def eval_tpl_one(state: FFDState, free_slot, host_onehot, pod):
-        pod_req, pod_requests, tol_tpl = pod[0], pod[2], pod[3]
-        topo_pod = topo_of(pod)
-        reg_for_tpl = state.grp_registered | (
-            (problem.grp_key == HOSTNAME_KEY)[:, None] & host_onehot[None, :]
-        )
-        tpl_requests2 = problem.tpl_overhead + pod_requests[None, :]
-        # shared helper so the mint/pin semantics can never diverge between
-        # the per-pod step, the run commit, and this sweeps path
-        tpl_merged, tpl_compat, _host = _fresh_template_rows(
-            problem, lv, ln, wellknown, pod_req, free_slot
-        )
-        tpl_topo_ok, tpl_final = topo_gate(
-            problem, state.grp_counts, reg_for_tpl, topo_pod, tpl_merged, wellknown
-        )
-        within_limits = masks.fits(
-            problem.it_cap[None, :, :], state.remaining[:, None, :]
-        )
-        tpl_it_ok2 = it_gate(tpl_final, tpl_requests2, problem.tpl_it_ok & within_limits)
-        tpl_ok = tol_tpl & tpl_compat & tpl_topo_ok & jnp.any(tpl_it_ok2, axis=-1)
-        tpl_pick = _first_true(tpl_ok)
-        pick_c = jnp.minimum(tpl_pick, TPL - 1)
-        tpl_row_it_ok = tpl_it_ok2[pick_c]
-        max_cap = jnp.max(
-            jnp.where(tpl_row_it_ok[:, None], problem.it_cap, 0.0), axis=0
-        )
-        return (
-            jnp.any(tpl_ok),
-            tpl_pick.astype(jnp.int32),
-            tpl_final.row(pick_c),
-            tpl_requests2[pick_c],
-            tpl_row_it_ok,
-            max_cap,
-        )
-
-    def chain_ahead(queue, i, qlen, p):
-        """True when the NEXT queue entry extends a gate-identical chain from
-        the cursor — the narrow loop's exit test (cheap: three gathers)."""
-        nxt_in = (i + 1) < qlen
-        qn = queue[jnp.clip(i + 1, 0, P - 1)]
-        return nxt_in & (qn == p + 1) & eqgate_arr[jnp.clip(p + 1, 0, P - 1)]
-
-    def analytic_iter(state, queue, i, qlen, kinds, idxs, nq, nqlen):
-        """Commit one whole gate-identical chain (>= 1 pods) via the
-        closed-form waterfill run commit (record sum included)."""
-        p = queue[jnp.clip(i, 0, P - 1)]
-        pod = jax.tree_util.tree_map(lambda a: a[p], pods_xs)
-        ahead = queue[jnp.clip(i + Srange, 0, P - 1)]  # [S]
-        adj = (ahead == p + Srange) & ((i + Srange) < qlen)
-        succ = jnp.clip(p + Srange, 0, P - 1)
-        gate_chain = lax.cummin(
-            (adj & ((Srange == 0) | eqgate_arr[succ])).astype(jnp.int32)
-        ).astype(bool)
-        k_gate = gate_chain.sum().astype(jnp.int32)
-        state, (kind_row, index_row) = run_commit(
-            state, pod, p, k_gate, active_concat
-        )
-        covered = Srange < k_gate
-        rows = p + Srange
-        out_idx = jnp.where(covered, rows, P + 1)
-        kinds = kinds.at[out_idx].set(kind_row, mode="drop")
-        idxs = idxs.at[out_idx].set(index_row, mode="drop")
-        requeue = covered & (kind_row == KIND_FAIL)
-        frank = jnp.cumsum(requeue.astype(jnp.int32)) - 1
-        nq_idx = jnp.where(requeue, nqlen + frank, P + 1)
-        nq = nq.at[nq_idx].set(rows, mode="drop")
-        nqlen = nqlen + requeue.sum().astype(jnp.int32)
-        noslot = jnp.any(covered & (kind_row == KIND_NO_SLOT))
-        return state, kinds, idxs, nq, nqlen, k_gate, noslot
-
-    def narrow_iter(state, queue, i, qlen, kinds, idxs, nq, nqlen):
-        """One exact narrow step, batched over the strict-identical chain
-        where verdict replication is provable (FAIL/NO_SLOT always;
-        placements while capacity and fewest-pods rank hold and no
-        record->gate feedback is possible)."""
-        p = queue[jnp.clip(i, 0, P - 1)]
-        pod = jax.tree_util.tree_map(lambda a: a[p], pods_xs)
-        ahead = queue[jnp.clip(i + Srange, 0, P - 1)]
-        adj = (ahead == p + Srange) & ((i + Srange) < qlen)
-        succ = jnp.clip(p + Srange, 0, P - 1)
-        strict_chain = lax.cummin(
-            (adj & ((Srange == 0) | eqprev_arr[succ])).astype(jnp.int32)
-        ).astype(bool)
-        k_strict = strict_chain.sum().astype(jnp.int32)
-
-        (
-            any_node,
-            node_pick,
-            node_row,
-            node_fit_count,
-            any_claim,
-            claim_pick,
-            rank2,
-            claim_row,
-            itok_row,
-            cap_ct,
-            claim_fit_count,
-            claim_npods0,
-            active,
-        ) = eval_base(state, pod)
-
-        free_slot = _first_true(~state.claim_open)
-        has_slot = jnp.any(~state.claim_open)
-        host_onehot = _mint_host_onehot(problem, free_slot)
-        need_tpl = (~any_node) & (~any_claim) & has_slot & active
-
-        def do_tpl():
-            return eval_tpl_one(state, free_slot, host_onehot, pod)
-
-        def skip_tpl():
-            return (
-                jnp.bool_(False),
-                jnp.int32(0),
-                _zeros_row(),
-                jnp.zeros((R,), problem.tpl_overhead.dtype),
-                jnp.zeros((T,), bool),
-                jnp.zeros((R,), problem.it_cap.dtype),
-            )
-
-        any_tpl, tpl_pick, slot_req, tpl_req_row, tpl_itok, max_cap = lax.cond(
-            need_tpl, do_tpl, skip_tpl
-        )
-
-        kind = jnp.where(
-            any_node,
-            KIND_NODE,
-            jnp.where(
-                any_claim,
-                KIND_CLAIM,
-                jnp.where(
-                    ~has_slot,
-                    KIND_NO_SLOT,
-                    jnp.where(any_tpl, KIND_NEW_CLAIM, KIND_FAIL),
-                ),
-            ),
-        ).astype(jnp.int32)
-        kind = jnp.where(active, kind, KIND_FAIL)
-        index = jnp.where(
-            kind == KIND_NODE,
-            node_pick,
-            jnp.where(
-                kind == KIND_CLAIM,
-                claim_pick,
-                jnp.where(kind == KIND_NEW_CLAIM, free_slot, -1),
-            ),
-        ).astype(jnp.int32)
-        placed = kind < KIND_FAIL
-        is_open = kind == KIND_NEW_CLAIM
-
-        # stacking within a strict-identical chain: FAIL / NO_SLOT verdicts
-        # replicate for free; placed pods stack into the chosen bin while
-        # capacity and (for claims) the fewest-pods rank hold, and only when
-        # record->gate feedback is impossible (regular affinity groups are
-        # monotone-safe; see _make_stride docstring)
-        match, selects, owned = pod[7], pod[8], pod[9]
-        if G > 0:
-            aff_safe = (problem.grp_type == 1) & ~problem.grp_inverse
-            stack_safe = ~jnp.any(match & (selects | owned) & ~aff_safe)
-        else:
-            stack_safe = jnp.bool_(True)
-        j_rank = jnp.where(
-            kind == KIND_CLAIM,
-            (rank2 - 1 - index) // C - claim_npods0 + 1,
-            jnp.int32(_BIG_CAP),
-        ).astype(jnp.int32)
-        fitc = jnp.where(kind == KIND_NODE, node_fit_count, claim_fit_count)
-        k_placed = jnp.where(
-            is_open,
-            1,
-            jnp.where(stack_safe, jnp.minimum(fitc, j_rank), 1),
-        )
-        k = jnp.maximum(
-            jnp.minimum(k_strict, jnp.where(placed, k_placed, _BIG_CAP)),
-            1,
-        ).astype(jnp.int32)
-
-        # ---- commit k pods into the one chosen bin
-        pod_requests = pod[2]
-        pod_ports = pod[5]
-        pod_vols = pod[10]
-        kf = k.astype(jnp.float32)
-
-        is_claim = kind == KIND_CLAIM
-        cidx = jnp.where(is_claim, index, C + 1)
-        new_claim_req = ReqTensor(
-            admitted=state.claim_req.admitted.at[cidx].set(claim_row.admitted, mode="drop"),
-            comp=state.claim_req.comp.at[cidx].set(claim_row.comp, mode="drop"),
-            gt=state.claim_req.gt.at[cidx].set(claim_row.gt, mode="drop"),
-            lt=state.claim_req.lt.at[cidx].set(claim_row.lt, mode="drop"),
-            defined=state.claim_req.defined.at[cidx].set(claim_row.defined, mode="drop"),
-        )
-        new_claim_requests = state.claim_requests.at[cidx].add(
-            kf * pod_requests, mode="drop"
-        )
-        new_claim_it_ok = state.claim_it_ok.at[cidx].set(
-            itok_row & (cap_ct >= k), mode="drop"
-        )
-        new_claim_npods = state.claim_npods.at[cidx].add(k, mode="drop")
-        new_claim_ports = state.claim_used_ports.at[cidx].max(pod_ports, mode="drop")
-
-        if N > 0:
-            is_node = kind == KIND_NODE
-            nodex = jnp.where(is_node, index, N + 1)
-            new_node_req = ReqTensor(
-                admitted=state.node_req.admitted.at[nodex].set(node_row.admitted, mode="drop"),
-                comp=state.node_req.comp.at[nodex].set(node_row.comp, mode="drop"),
-                gt=state.node_req.gt.at[nodex].set(node_row.gt, mode="drop"),
-                lt=state.node_req.lt.at[nodex].set(node_row.lt, mode="drop"),
-                defined=state.node_req.defined.at[nodex].set(node_row.defined, mode="drop"),
-            )
-            new_node_requests = state.node_requests.at[nodex].add(
-                kf * pod_requests, mode="drop"
-            )
-            new_node_npods = state.node_npods.at[nodex].add(k, mode="drop")
-            new_node_ports = state.node_used_ports.at[nodex].max(pod_ports, mode="drop")
-            new_node_vol = state.node_vol_used.at[nodex].add(k * pod_vols, mode="drop")
-        else:
-            new_node_req = state.node_req
-            new_node_requests = state.node_requests
-            new_node_npods = state.node_npods
-            new_node_ports = state.node_used_ports
-            new_node_vol = state.node_vol_used
-
-        # the (alone-committing) claim-open
-        sidx = jnp.where(is_open, free_slot, C + 1)
-        new_claim_req = ReqTensor(
-            admitted=new_claim_req.admitted.at[sidx].set(slot_req.admitted, mode="drop"),
-            comp=new_claim_req.comp.at[sidx].set(slot_req.comp, mode="drop"),
-            gt=new_claim_req.gt.at[sidx].set(slot_req.gt, mode="drop"),
-            lt=new_claim_req.lt.at[sidx].set(slot_req.lt, mode="drop"),
-            defined=new_claim_req.defined.at[sidx].set(slot_req.defined, mode="drop"),
-        )
-        new_claim_requests = new_claim_requests.at[sidx].set(tpl_req_row, mode="drop")
-        new_claim_it_ok = new_claim_it_ok.at[sidx].set(tpl_itok, mode="drop")
-        new_claim_open = state.claim_open.at[sidx].set(True, mode="drop")
-        new_claim_npods = new_claim_npods.at[sidx].add(1, mode="drop")
-        new_claim_tpl = state.claim_tpl.at[sidx].set(tpl_pick, mode="drop")
-        new_claim_ports = new_claim_ports.at[sidx].max(pod_ports, mode="drop")
-        opened_tpl_hot = (jnp.arange(TPL) == tpl_pick) & is_open
-        new_remaining = jnp.where(
-            opened_tpl_hot[:, None],
-            state.remaining - max_cap[None, :],
-            state.remaining,
-        )
-        new_registered = state.grp_registered | (
-            is_open
-            & mint_hostnames
-            & (problem.grp_key == HOSTNAME_KEY)[:, None]
-            & host_onehot[None, :]
-        )
-
-        # topology record: identical stack members record identical deltas
-        if G > 0:
-            rec_needed = placed & (jnp.any(selects) | jnp.any(owned))
-
-            def do_record():
-                rec_row = claim_row
-                rec_row = jax.tree_util.tree_map(
-                    lambda s, c: jnp.where(is_open, s, c), slot_req, rec_row
-                )
-                if N > 0:
-                    rec_row = jax.tree_util.tree_map(
-                        lambda n, c: jnp.where(kind == KIND_NODE, n, c),
-                        node_row,
-                        rec_row,
-                    )
-                allow = jnp.where(kind == KIND_NODE, no_allow, wellknown)
-                delta = record_delta(
-                    problem, topo_of(pod), rec_row, allow, jnp.bool_(True), lv, ln
-                )
-                return k * delta.astype(jnp.int32), delta
-
-            counts_add, reg_add = lax.cond(
-                rec_needed,
-                do_record,
-                lambda: (
-                    jnp.zeros((G, V), jnp.int32),
-                    jnp.zeros((G, V), bool),
-                ),
-            )
-            new_counts = state.grp_counts + counts_add
-            new_registered = new_registered | reg_add
-        else:
-            new_counts = state.grp_counts
-
-        new_state = FFDState(
-            claim_req=new_claim_req,
-            claim_requests=new_claim_requests,
-            claim_it_ok=new_claim_it_ok,
-            claim_open=new_claim_open,
-            claim_npods=new_claim_npods,
-            claim_tpl=new_claim_tpl,
-            claim_used_ports=new_claim_ports,
-            node_req=new_node_req,
-            node_requests=new_node_requests,
-            node_npods=new_node_npods,
-            node_used_ports=new_node_ports,
-            node_vol_used=new_node_vol,
-            remaining=new_remaining,
-            grp_counts=new_counts,
-            grp_registered=new_registered,
-        )
-        covered = Srange < k
-        kind_row = jnp.where(covered, kind, KIND_FAIL)
-        index_row = jnp.where(covered, index, -1)
-        rows = p + Srange
-        out_idx = jnp.where(covered, rows, P + 1)
-        kinds = kinds.at[out_idx].set(kind_row, mode="drop")
-        idxs = idxs.at[out_idx].set(index_row, mode="drop")
-        requeue = covered & (kind_row == KIND_FAIL)
-        frank = jnp.cumsum(requeue.astype(jnp.int32)) - 1
-        nq_idx = jnp.where(requeue, nqlen + frank, P + 1)
-        nq = nq.at[nq_idx].set(rows, mode="drop")
-        nqlen = nqlen + requeue.sum().astype(jnp.int32)
-        noslot = jnp.any(covered & (kind_row == KIND_NO_SLOT))
-        return new_state, kinds, idxs, nq, nqlen, k, noslot
-
-    return narrow_iter, analytic_iter, chain_ahead
-
-
-def _sweeps_impl(problem: SchedulingProblem, init: FFDState, C: int) -> FFDResult:
-    """All retry passes of a solve in ONE device program.
-
-    The reference's Solve loop requeues failed pods and retries while any
-    placement makes progress (scheduler.go:150-170) — a pod whose required
-    pod-affinity peers were placed later in the queue succeeds on the next
-    pass. The host loop used to pay one device roundtrip per pass; here the
-    requeue-until-no-progress loop IS the program: an outer while over
-    sweeps; inside a sweep, a narrow-step loop walks the compact queue of
-    still-unplaced pods and EXITS at every gate-identical chain boundary,
-    where the closed-form analytic commit (_make_stride's analytic_iter)
-    consumes the whole chain at once. Splitting the two at loop level keeps
-    the narrow body free of a large-state conditional — a per-step
-    lax.cond carrying the full FFDState measured ~80us/step in copies.
-    Relaxation (preferences.py) stays host-side — it mutates pod specs and
-    re-encodes — so a solve with relaxable pods costs one launch per ladder
-    rung, and the common no-relaxation solve costs exactly one.
-
-    Exactness vs the pass-per-launch loop: pods are processed in exactly the
-    sequential queue order — the chain commits are provably equivalent to
-    stepping their members one at a time (waterfill + record sum for
-    topology-blind identical pods; verdict replication for strict-identical
-    pods); KIND_NO_SLOT stops sweeping so the backend's slot-doubling retry
-    sees it at the same pass boundary it used to.
-    """
-    P = problem.num_pods
-    pods_xs = _pod_xs(problem)
-    narrow_iter, analytic_iter, chain_ahead = _make_stride(
-        problem, _statics(problem), C, _STRIDE, pods_xs
-    )
-    active = jnp.asarray(problem.pod_active)
-    # compact initial queue: active rows first, original (FFD) order kept —
-    # padding rows are never stepped at all, so bucket padding costs compile
-    # cache entries but zero runtime
-    queue0 = jnp.argsort(~active, stable=True).astype(jnp.int32)
-    qlen0 = jnp.sum(active).astype(jnp.int32)
-    kinds0 = jnp.full((P,), KIND_FAIL, jnp.int32)
-    idxs0 = jnp.full((P,), -1, jnp.int32)
-
-    def sweep_cond(c):
-        _state, _queue, qlen, _kinds, _idxs, progress, noslot = c
-        return progress & (qlen > 0) & ~noslot
-
-    def sweep_body(c):
-        state, queue, qlen, kinds, idxs, _progress, noslot0 = c
-        i0 = (
-            jnp.int32(0),
-            state,
-            jnp.zeros((P,), jnp.int32),
-            jnp.int32(0),
-            kinds,
-            idxs,
-            noslot0,
-        )
-
-        if _CHAIN_DISPATCH:
-            # EXPERIMENTAL two-level structure: a narrow-step loop that
-            # exits at gate-identical chain boundaries, with the analytic
-            # waterfill commit consuming each whole chain. Measured on TPU
-            # v5e (10k bench): the extra control flow costs MORE than the
-            # chain commits save — XLA stops keeping the carried FFDState
-            # in place across the nested while/cond boundaries and copies
-            # it per iteration (flat loop 1.03s, this structure 1.43s, the
-            # same chains behind a per-step cond 1.49s). Kept behind
-            # KARPENTER_TPU_CHAIN_DISPATCH=1 for future XLA versions.
-            def seg_cond(sc):
-                i = sc[0]
-                return i < qlen
-
-            def seg_body(sc):
-                i, state, nq, nqlen, kinds, idxs, noslot = sc
-
-                def ncond(nc):
-                    i = nc[0]
-                    p = queue[jnp.clip(i, 0, P - 1)]
-                    return (i < qlen) & ~chain_ahead(queue, i, qlen, p)
-
-                def nbody(nc):
-                    i, state, nq, nqlen, kinds, idxs, noslot = nc
-                    state, kinds, idxs, nq, nqlen, k, nosl = narrow_iter(
-                        state, queue, i, qlen, kinds, idxs, nq, nqlen
-                    )
-                    return i + k, state, nq, nqlen, kinds, idxs, noslot | nosl
-
-                i, state, nq, nqlen, kinds, idxs, noslot = lax.while_loop(
-                    ncond, nbody, (i, state, nq, nqlen, kinds, idxs, noslot)
-                )
-
-                def do_chain():
-                    st, kk, ii, q, ql, k, nosl = analytic_iter(
-                        state, queue, i, qlen, kinds, idxs, nq, nqlen
-                    )
-                    return i + k, st, q, ql, kk, ii, noslot | nosl
-
-                def no_chain():
-                    return i, state, nq, nqlen, kinds, idxs, noslot
-
-                return lax.cond(i < qlen, do_chain, no_chain)
-
-            _i, state, nq, nqlen, kinds, idxs, noslot = lax.while_loop(
-                seg_cond, seg_body, i0
-            )
-        else:
-            # flat production loop: ONE iteration shape, no in-loop
-            # branching over the carried state — XLA keeps every FFDState
-            # buffer in place across iterations
-            def inner_cond(ic):
-                i = ic[0]
-                return i < qlen
-
-            def inner_body(ic):
-                i, state, nq, nqlen, kinds, idxs, noslot = ic
-                state, kinds, idxs, nq, nqlen, k, nosl = narrow_iter(
-                    state, queue, i, qlen, kinds, idxs, nq, nqlen
-                )
-                return i + k, state, nq, nqlen, kinds, idxs, noslot | nosl
-
-            _i, state, nq, nqlen, kinds, idxs, noslot = lax.while_loop(
-                inner_cond, inner_body, i0
-            )
-        progress = nqlen < qlen
-        return state, nq, nqlen, kinds, idxs, progress, noslot
-
-    state, _queue, _qlen, kinds, idxs, _prog, _noslot = lax.while_loop(
-        sweep_cond,
-        sweep_body,
-        (init, queue0, qlen0, kinds0, idxs0, jnp.bool_(True), jnp.bool_(False)),
-    )
-    return FFDResult(kind=kinds, index=idxs, state=state)
-
-
-@functools.partial(jax.jit, static_argnums=(1,))
-def _solve_ffd_sweeps_fresh_jit(problem: SchedulingProblem, max_claims: int) -> FFDResult:
-    problem = _pad_lanes_mult32(problem)
-    return _sweeps_impl(problem, initial_state(problem, max_claims), max_claims)
-
-
-def solve_ffd_sweeps(
-    problem: SchedulingProblem, max_claims: int, init: Optional[FFDState] = None
-) -> FFDResult:
-    """Run ALL retry passes to convergence in one device launch (see
-    _sweeps_impl). The production provisioning entrypoint. Always starts from
-    a fresh state: the backend's sweeps mode never carries state across
-    launches (nothing is relaxable, so there is no second launch)."""
-    assert init is None, "sweeps mode always runs a whole solve in one launch"
-    return _solve_ffd_sweeps_fresh_jit(problem, max_claims)
-
-
-# integer "unbounded" sentinel for analytic pod-count capacities; large enough
-# to never bind, small enough that int32 level arithmetic can't overflow
-_BIG_CAP = 2**20
-
-
-def _capacity(avail, used, req):
-    """Integer count of additional identical pods with requests ``req`` that
-    fit in ``avail - used`` (trailing resource axis), honoring fits()'s float
-    tolerance: max j with used + j*req <= avail + eps — the closed form of
-    iterating the per-pod fit check. Zero-request dims still gate: fits()
-    fails on an already-overcommitted dim even when the pod adds nothing to
-    it (and the -1 removed/padded-bin sentinel must reject every pod)."""
-    eps = 1e-6 + 1e-6 * jnp.abs(avail)
-    room = avail + eps - used
-    roomf = room / jnp.where(req > 0, req, 1.0)
-    per_r = jnp.where(req > 0, jnp.floor(roomf), jnp.float32(_BIG_CAP))
-    zero_ok = jnp.all((req > 0) | (room >= 0), axis=-1)
-    cap = jnp.clip(jnp.min(per_r, axis=-1), 0, _BIG_CAP).astype(jnp.int32)
-    return jnp.where(zero_ok, cap, 0)
-
-
-def _water_level(levels, caps, units, iters=22):
-    """Largest integer L with sum(clip(L - levels, 0, caps)) <= units — the
-    common fill level after pouring ``units`` one-by-one into the bin with the
-    lowest level (argmin with index tie-break), each bin bounded by its cap.
-    ``levels``/``caps`` are 1-D [C]; ``units`` may be any shape (the search
-    runs elementwise over it)."""
-    lo = jnp.zeros_like(units)
-    hi = jnp.full_like(units, 2 * _BIG_CAP)
-
-    def bs(_, lohi):
-        lo, hi = lohi
-        mid = (lo + hi + 1) // 2
-        used = jnp.sum(jnp.clip(mid[..., None] - levels, 0, caps), axis=-1)
-        ok = used <= units
-        return jnp.where(ok, mid, lo), jnp.where(ok, hi, mid - 1)
-
-    lo, hi = lax.fori_loop(0, iters, bs, (lo, hi))
-    return lo
-
-
-def _make_run_commit(problem: SchedulingProblem, statics, C: int, max_run: int):
-    """The analytic multi-pod commit: one scan step places an entire run of
-    identical, topology-inert pods, reproducing the per-pod step's outcome
-    (including each pod's (kind, index) in temporal order) in closed form.
-
-    Correctness argument, phase by phase (all against _make_step's semantics):
-      nodes   — a pod takes the FIRST node that passes the static gates with
-                room, so k pods fill nodes in index order up to each node's
-                integer capacity: cumsum fill. Narrowing commits are
-                idempotent for identical pods.
-      claims  — a pod takes the open claim with the FEWEST pods (index
-                tie-break), i.e. pods waterfill claim levels bounded by each
-                claim's capacity (max over surviving instance types of how
-                many more such pods fit). The temporal order of assignments
-                is (level-before, claim index) lexicographic — recovered per
-                ordinal to keep exact per-pod parity with the oracle.
-      opens   — pods that exhaust claim capacity open fresh template claims
-                one at a time; each opened claim absorbs pods up to its own
-                capacity before the next opens (it is the unique unsaturated
-                claim), so openings assign consecutive ordinal blocks in
-                slot order. Limit headroom burns once per open (subtractMax,
-                scheduler.go:347-364).
-    """
-    lv, ln, wellknown, no_allow, it_packed, it_neg = statics
-    N = problem.num_nodes
-    T = problem.num_instance_types
-    TPL = problem.num_templates
-    K = problem.num_keys
-    V = problem.num_lanes
-    D = problem.pod_vol_counts.shape[1]
-    mint_hostnames = problem.claim_hostname_lane.shape[0] > 0
-
-    def has_offering_rows(admitted):
-        return _offer_rows(problem, admitted)
-
-    def commit(state: FFDState, pod, start, length, active_arr):
-        (
-            pod_req,
-            _pod_strict,
-            pod_requests,
-            tol_tpl,
-            tol_node,
-            pod_ports,
-            pod_conflict,
-            _gm,
-            _gs,
-            _go,
-            pod_vols,
-            _pa,
-        ) = pod
-        win = jnp.arange(max_run)
-        act = lax.dynamic_slice(active_arr, (start,), (max_run,)) & (win < length)
-        k = act.sum().astype(jnp.int32)
-        ordinal = (jnp.cumsum(act) - 1).astype(jnp.int32)  # [MR]
-        port_cap = jnp.where(jnp.any(pod_ports), 1, _BIG_CAP).astype(jnp.int32)
-
-        # ---- 1. existing nodes: first-fit fill in node order
-        if N > 0:
-            node_merged = _intersect_rows(state.node_req, pod_req)
-            node_compat = vmap(
-                lambda nr: masks.compatible_ok(nr, pod_req, lv, ln, no_allow)
-            )(state.node_req)
-            node_port_ok = ~jnp.any(state.node_used_ports & pod_conflict[None, :], axis=-1)
-            if D > 0:
-                # clamp: pre-existing over-limit attach counts read as 0
-                # capacity, not negative (the per-pod gate simply fails)
-                vol_room = jnp.maximum(
-                    (problem.node_vol_limits - state.node_vol_used)
-                    // jnp.maximum(pod_vols[None, :], 1),
-                    0,
-                )
-                vol_cap = jnp.min(
-                    jnp.where(pod_vols[None, :] > 0, vol_room, _BIG_CAP), axis=-1
-                ).astype(jnp.int32)
-            else:
-                vol_cap = jnp.full((N,), _BIG_CAP, jnp.int32)
-            res_cap = _capacity(
-                problem.node_avail, state.node_requests, pod_requests[None, :]
-            )
-            node_ok = tol_node & node_compat & node_port_ok
-            ncap = jnp.where(node_ok, jnp.minimum(jnp.minimum(res_cap, vol_cap), port_cap), 0)
-            ncum = jnp.cumsum(ncap)
-            placed_n = jnp.minimum(k, ncum[-1])
-            node_take = jnp.clip(k - (ncum - ncap), 0, ncap)
-            took_n = node_take > 0
-            new_node_req = _mix_req_rows(state.node_req, node_merged, took_n)
-            new_node_requests = state.node_requests + node_take[:, None] * pod_requests[None, :]
-            new_node_npods = state.node_npods + node_take
-            new_node_ports = state.node_used_ports | (took_n[:, None] & pod_ports[None, :])
-            new_node_vol = state.node_vol_used + node_take[:, None] * pod_vols[None, :]
-            node_of = jnp.searchsorted(ncum, ordinal, side="right").astype(jnp.int32)
-        else:
-            placed_n = jnp.int32(0)
-            node_of = jnp.zeros((max_run,), jnp.int32)
-            new_node_req = state.node_req
-            new_node_requests = state.node_requests
-            new_node_npods = state.node_npods
-            new_node_ports = state.node_used_ports
-            new_node_vol = state.node_vol_used
-        rem = k - placed_n
-
-        # ---- 2. open claims: fewest-pods waterfill bounded by capacity
-        claim_merged = _intersect_rows(state.claim_req, pod_req)
-        claim_compat = vmap(
-            lambda cr: masks.compatible_ok(cr, pod_req, lv, ln, wellknown)
-        )(state.claim_req)
-        claim_port_ok = ~jnp.any(state.claim_used_ports & pod_conflict[None, :], axis=-1)
-        m_packed = masks.pack_lanes(claim_merged.admitted)
-        m_neg = vmap(lambda r: masks.negative_polarity(r, lv, ln))(claim_merged)
-        itc = masks.packed_pairwise_compat(
-            claim_merged, m_packed, m_neg, problem.it_reqs, it_packed, it_neg
-        )  # [C, T]
-        itok = state.claim_it_ok & itc & has_offering_rows(claim_merged.admitted)
-        cap_ct = _capacity(
-            problem.it_alloc[None, :, :],
-            state.claim_requests[:, None, :],
-            pod_requests[None, None, :],
-        )  # [C, T]
-        cap_c = jnp.max(jnp.where(itok, cap_ct, 0), axis=-1)
-        elig = (
-            state.claim_open
-            & tol_tpl[state.claim_tpl]
-            & claim_compat
-            & claim_port_ok
-        )
-        cap_c = jnp.where(elig, jnp.minimum(cap_c, port_cap), 0)
-        p_lvl = state.claim_npods
-        m = jnp.minimum(rem, cap_c.sum())
-        L = _water_level(p_lvl, cap_c, m)
-        take0 = jnp.clip(L - p_lvl, 0, cap_c)
-        leftover = m - take0.sum()
-        at_level = (p_lvl + take0 == L) & (take0 < cap_c)
-        extra = at_level & (jnp.cumsum(at_level) <= leftover)
-        claim_take = take0 + extra.astype(jnp.int32)
-        tookc = claim_take > 0
-        i_claim_req = _mix_req_rows(state.claim_req, claim_merged, tookc)
-        i_requests = state.claim_requests + claim_take[:, None] * pod_requests[None, :]
-        i_npods = state.claim_npods + claim_take
-        i_itok = jnp.where(tookc[:, None], itok & (cap_ct >= claim_take[:, None]), state.claim_it_ok)
-        i_ports = state.claim_used_ports | (tookc[:, None] & pod_ports[None, :])
-        rem2 = rem - claim_take.sum()
-
-        # temporal ordinal -> claim: assignments sort by (level-before, claim)
-        jj = ordinal - placed_n
-        lev = _water_level(p_lvl, claim_take, jnp.maximum(jj, 0))
-        before = jnp.sum(
-            jnp.clip(lev[:, None] - p_lvl[None, :], 0, claim_take[None, :]), axis=-1
-        )
-        pos = jnp.maximum(jj, 0) - before
-        at_lev = (p_lvl[None, :] <= lev[:, None]) & (
-            lev[:, None] < (p_lvl + claim_take)[None, :]
-        )  # [MR, C]
-        lev_cum = jnp.cumsum(at_lev, axis=-1)
-        claim_of = jnp.argmax(at_lev & (lev_cum == (pos + 1)[:, None]), axis=-1).astype(
-            jnp.int32
-        )
-
-        # ---- 3. fresh template claims, one open at a time. The heavy
-        # template-side products are loop-invariant and hoisted out of the
-        # open-loop: the merged rows, compat mask, [TPL, T] pairwise
-        # instance-type compat, offerings, and per-pod capacities depend only
-        # on (pod_req, pod_requests) — the minted-hostname pin (the one
-        # free_slot-dependent piece of _fresh_template_rows) cannot change
-        # them because instance types never constrain the hostname key (the
-        # claim mints a fresh name precisely because nothing else names it,
-        # nodeclaim.go:46-63); only the committed slot row must carry the pin
-        tpl_merged_u = _intersect_rows(problem.tpl_reqs, pod_req)
-        tpl_compat = vmap(
-            lambda tr: masks.compatible_ok(tr, pod_req, lv, ln, wellknown)
-        )(problem.tpl_reqs)
-        t_packed = masks.pack_lanes(tpl_merged_u.admitted)
-        t_neg = vmap(lambda r: masks.negative_polarity(r, lv, ln))(tpl_merged_u)
-        itc_t = masks.packed_pairwise_compat(
-            tpl_merged_u, t_packed, t_neg, problem.it_reqs, it_packed, it_neg
-        )  # [TPL, T]
-        cap_tt = _capacity(
-            problem.it_alloc[None, :, :],
-            problem.tpl_overhead[:, None, :],
-            pod_requests[None, None, :],
-        )  # [TPL, T]
-        itok_t_static = (
-            problem.tpl_it_ok
-            & itc_t
-            & has_offering_rows(tpl_merged_u.admitted)
-            & (cap_tt >= 1)
-        )
-
-        def nc_cond(c):
-            return c[0] & (c[1] > 0)
-
-        def nc_body(c):
-            (
-                _keep,
-                c_rem,
-                c_req,
-                c_requests,
-                c_itok,
-                c_open,
-                c_npods,
-                c_tpl,
-                c_ports,
-                c_remaining,
-                c_registered,
-                c_newtake,
-                c_noslot,
-            ) = c
-            free_slot = _first_true(~c_open)
-            has_slot = jnp.any(~c_open)
-            host_onehot = _mint_host_onehot(problem, free_slot)
-            within = masks.fits(problem.it_cap[None, :, :], c_remaining[:, None, :])
-            itok_t = itok_t_static & within
-            q_t = jnp.max(jnp.where(itok_t, cap_tt, 0), axis=-1)  # [TPL]
-            tpl_ok = tol_tpl & tpl_compat & (q_t >= 1)
-            pick = _first_true(tpl_ok)
-            any_tpl = jnp.any(tpl_ok)
-            pick_c = jnp.minimum(pick, TPL - 1)
-            can = any_tpl & has_slot
-            take = jnp.where(can, jnp.minimum(c_rem, jnp.minimum(q_t[pick_c], port_cap)), 0)
-            slot_hot = (jnp.arange(C) == free_slot) & (take > 0)
-            slot_req_u = tpl_merged_u.row(pick_c)
-            # the committed claim row carries its minted hostname
-            # (nodeclaim.go:46-63), exactly as _fresh_template_rows pins it
-            slot_req = (
-                _pin_hostname(slot_req_u, host_onehot) if mint_hostnames else slot_req_u
-            )
-            new_req = _mix_req_rows(
-                c_req,
-                ReqTensor(
-                    admitted=jnp.broadcast_to(slot_req.admitted, (C, K, V)),
-                    comp=jnp.broadcast_to(slot_req.comp, (C, K)),
-                    gt=jnp.broadcast_to(slot_req.gt, (C, K)),
-                    lt=jnp.broadcast_to(slot_req.lt, (C, K)),
-                    defined=jnp.broadcast_to(slot_req.defined, (C, K)),
-                ),
-                slot_hot,
-            )
-            surv1 = itok_t[pick_c]  # [T] survivors with the first pod aboard
-            new_itok = jnp.where(
-                slot_hot[:, None], surv1[None, :] & (cap_tt[pick_c][None, :] >= take), c_itok
-            )
-            new_requests = jnp.where(
-                slot_hot[:, None],
-                (problem.tpl_overhead[pick_c] + take * pod_requests)[None, :],
-                c_requests,
-            )
-            opened = take > 0
-            opened_tpl_hot = (jnp.arange(TPL) == pick_c) & opened
-            max_cap = jnp.max(jnp.where(surv1[:, None], problem.it_cap, 0.0), axis=0)
-            new_remaining = jnp.where(
-                opened_tpl_hot[:, None], c_remaining - max_cap[None, :], c_remaining
-            )
-            new_registered = c_registered | (
-                opened
-                & mint_hostnames
-                & (problem.grp_key == HOSTNAME_KEY)[:, None]
-                & host_onehot[None, :]
-            )
-            return (
-                can,
-                c_rem - take,
-                new_req,
-                new_requests,
-                new_itok,
-                c_open | slot_hot,
-                c_npods + slot_hot * take,
-                jnp.where(slot_hot, pick_c.astype(jnp.int32), c_tpl),
-                c_ports | (slot_hot[:, None] & pod_ports[None, :]),
-                new_remaining,
-                new_registered,
-                c_newtake + slot_hot * take,
-                # ~has_slot alone: with no free slot the template verdict is
-                # unreliable (see the step's kind classification) — always
-                # signal NO_SLOT so the backend's slot-growth retry decides
-                c_noslot | ~has_slot,
-            )
-
-        nc0 = (
-            jnp.bool_(True),
-            rem2,
-            i_claim_req,
-            i_requests,
-            i_itok,
-            state.claim_open,
-            i_npods,
-            state.claim_tpl,
-            i_ports,
-            state.remaining,
-            state.grp_registered,
-            jnp.zeros((C,), jnp.int32),
-            jnp.bool_(False),
-        )
-        (
-            _keep,
-            rem3,
-            f_claim_req,
-            f_requests,
-            f_itok,
-            f_open,
-            f_npods,
-            f_tpl,
-            f_ports,
-            f_remaining,
-            f_registered,
-            new_take,
-            noslot,
-        ) = lax.while_loop(nc_cond, nc_body, nc0)
-        placed_new = rem2 - rem3
-        new_cum = jnp.cumsum(new_take)  # slot order == temporal opening order
-        nc_ord = ordinal - placed_n - m  # ordinal within the new-claim phase
-        newclaim_of = jnp.searchsorted(new_cum, nc_ord, side="right").astype(jnp.int32)
-        # the pod that OPENS a slot reads KIND_NEW_CLAIM, later joiners
-        # KIND_CLAIM — matching the per-pod step's labels exactly
-        opens_slot = nc_ord == (new_cum - new_take)[jnp.minimum(newclaim_of, C - 1)]
-
-        # ---- 4. per-row outputs, written into the run's queue window
-        fail_kind = jnp.where(noslot, KIND_NO_SLOT, KIND_FAIL).astype(jnp.int32)
-        kind_row = jnp.where(
-            ~act,
-            KIND_FAIL,
-            jnp.where(
-                ordinal < placed_n,
-                KIND_NODE,
-                jnp.where(
-                    ordinal < placed_n + m,
-                    KIND_CLAIM,
-                    jnp.where(
-                        ordinal < placed_n + m + placed_new,
-                        jnp.where(opens_slot, KIND_NEW_CLAIM, KIND_CLAIM),
-                        fail_kind,
-                    ),
-                ),
-            ),
-        ).astype(jnp.int32)
-        # index by PHASE (new-phase joiners are labeled KIND_CLAIM but their
-        # slot comes from the opening partition, not the waterfill)
-        index_row = jnp.where(
-            ~act,
-            -1,
-            jnp.where(
-                ordinal < placed_n,
-                node_of,
-                jnp.where(
-                    ordinal < placed_n + m,
-                    claim_of,
-                    jnp.where(ordinal < placed_n + m + placed_new, newclaim_of, -1),
-                ),
-            ),
-        ).astype(jnp.int32)
-
-        # ---- 5. record aggregation (Topology.Record, topology.go:125-148).
-        # Run members are topology-BLIND (no matched/owned groups — run mode
-        # rule in solver/encode.py) but may still be SELECTED by other pods'
-        # groups; each placed member records its select mask against the
-        # dom-lanes of the bin it landed on. Deltas never feed back into any
-        # member's own gates, so they sum: member-per-bin counts contract
-        # against per-bin dom masks. Identical to applying record() per pod.
-        G = problem.grp_key.shape[0]
-        new_counts = state.grp_counts
-        if G > 0:
-            sel_arr = jnp.concatenate(
-                [jnp.asarray(problem.pod_grp_selects), jnp.zeros((max_run, G), bool)]
-            )
-            sel = lax.dynamic_slice(sel_arr, (start, 0), (max_run, G))  # [MR, G]
-            placed_row = kind_row < KIND_FAIL
-            B = N + C
-            bin_of = jnp.where(kind_row == KIND_NODE, index_row, N + index_row)
-            ob = placed_row[:, None] & (
-                jnp.clip(bin_of, 0, B - 1)[:, None] == jnp.arange(B)[None, :]
-            )  # [MR, B]
-            cnt_bg = jnp.matmul(
-                ob.astype(jnp.float32).T,
-                sel.astype(jnp.float32),
-                preferred_element_type=jnp.float32,
-            )  # [B, G]
-            if N > 0:
-                radm = jnp.concatenate(
-                    [new_node_req.admitted, f_claim_req.admitted], axis=0
-                )
-                rcomp = jnp.concatenate([new_node_req.comp, f_claim_req.comp], axis=0)
-            else:
-                radm, rcomp = f_claim_req.admitted, f_claim_req.comp
-            dom = radm[:, problem.grp_key, :]  # [B, G, V]
-            concrete = ~rcomp[:, problem.grp_key]  # [B, G]
-            single = dom.sum(axis=-1) == 1
-            spread_or_aff = (problem.grp_type == 0) | (problem.grp_type == 1)
-            F = problem.grp_filter_valid.shape[1]
-            if F > 0:
-                if N > 0:
-                    bin_rows = ReqTensor(
-                        admitted=radm,
-                        comp=rcomp,
-                        gt=jnp.concatenate([new_node_req.gt, f_claim_req.gt], axis=0),
-                        lt=jnp.concatenate([new_node_req.lt, f_claim_req.lt], axis=0),
-                        defined=jnp.concatenate(
-                            [new_node_req.defined, f_claim_req.defined], axis=0
-                        ),
-                    )
-                    allow_b = jnp.concatenate(
-                        [
-                            jnp.zeros((N, no_allow.shape[0]), bool),
-                            jnp.broadcast_to(wellknown, (C, wellknown.shape[0])),
-                        ]
-                    )
-                else:
-                    bin_rows = f_claim_req
-                    allow_b = jnp.broadcast_to(wellknown, (C, wellknown.shape[0]))
-
-                def bin_filt(row, allow):
-                    def grp_filt(g):
-                        terms = problem.grp_filter.row(g)
-                        term_ok = vmap(
-                            lambda t: masks.compatible_ok(row, t, lv, ln, allow)
-                        )(terms)
-                        return ~problem.grp_has_filter[g] | jnp.any(
-                            problem.grp_filter_valid[g] & term_ok
-                        )
-
-                    return vmap(grp_filt)(jnp.arange(G))
-
-                filt = vmap(bin_filt)(bin_rows, allow_b)  # [B, G]
-            else:
-                filt = jnp.ones((B, G), bool)
-            dom_ok = (
-                concrete
-                & jnp.where(spread_or_aff[None, :], single, True)
-                & filt
-                & ~problem.grp_inverse[None, :]
-            )
-            dom_final = dom & dom_ok[:, :, None]  # [B, G, V]
-            recorded = jnp.einsum(
-                "bg,bgv->gv",
-                cnt_bg,
-                dom_final.astype(jnp.float32),
-                preferred_element_type=jnp.float32,
-            )
-            new_counts = state.grp_counts + jnp.round(recorded).astype(jnp.int32)
-            f_registered = f_registered | jnp.any(
-                (cnt_bg[:, :, None] > 0.5) & dom_final, axis=0
-            )
-
-        new_state = FFDState(
-            claim_req=f_claim_req,
-            claim_requests=f_requests,
-            claim_it_ok=f_itok,
-            claim_open=f_open,
-            claim_npods=f_npods,
-            claim_tpl=f_tpl,
-            claim_used_ports=f_ports,
-            node_req=new_node_req,
-            node_requests=new_node_requests,
-            node_npods=new_node_npods,
-            node_used_ports=new_node_ports,
-            node_vol_used=new_node_vol,
-            remaining=f_remaining,
-            grp_counts=new_counts,
-            grp_registered=f_registered,
-        )
-        return new_state, (kind_row, index_row)
-
-    return commit
-
-
-@functools.partial(jax.jit, static_argnums=(2, 3))
-def _solve_ffd_runs_jit(
-    problem: SchedulingProblem, init: FFDState, max_run: int, with_topo: bool
-) -> FFDResult:
-    """Run-compressed scan: one step per run of identical pods (encode.py
-    segmentation). Topology-inert runs take the closed-form analytic commit,
-    topology-interacting runs the light inner loop (ops/topo_runs.py), and
-    length-1 runs the per-pod step. 10k diverse pods collapse to a few
-    hundred steps. ``with_topo=False`` compiles the two-branch program —
-    topology-free batches (the whole consolidation path) skip the topo
-    branch's compile cost."""
-    from karpenter_tpu.ops.topo_runs import make_topo_run_commit
-
-    problem, init = _lane_align(problem, init)
-    C = init.claim_open.shape[0]
-    statics = _statics(problem)
-    step = _make_step(problem, statics, C)
-    commit = _make_run_commit(problem, statics, C, max_run)
-    topo_commit = make_topo_run_commit(problem, statics, C, max_run) if with_topo else None
-    P = problem.num_pods
-    pods_xs = _pod_xs(problem)
-    rep_xs = jax.tree_util.tree_map(lambda a: a[problem.run_start], pods_xs)
-    # scratch tail so a window starting near P never clamps backwards
-    active_arr = jnp.concatenate(
-        [jnp.asarray(problem.pod_active), jnp.zeros((max_run,), dtype=bool)]
-    )
-
-    def outer(state, xs):
-        rep, start, length, mode = xs
-
-        def single(_):
-            new_state, (kind, index) = step(state, rep)
-            kind_row = jnp.full((max_run,), KIND_FAIL, jnp.int32).at[0].set(kind)
-            index_row = jnp.full((max_run,), -1, jnp.int32).at[0].set(index)
-            return new_state, (kind_row, index_row)
-
-        def analytic(_):
-            return commit(state, rep, start, length, active_arr)
-
-        if with_topo:
-            def topo(_):
-                return topo_commit(state, rep, start, length, active_arr)
-
-            return lax.switch(mode, (single, analytic, topo), None)
-        return lax.switch(mode, (single, analytic), None)
-
-    run_start = jnp.asarray(problem.run_start)
-    run_len = jnp.asarray(problem.run_len)
-    final_state, (kind_ys, index_ys) = lax.scan(
-        outer,
-        init,
-        (rep_xs, run_start, run_len, jnp.asarray(problem.run_mode)),
-        unroll=_UNROLL,
-    )
-    # scatter the per-run windows back into queue order; rows no run covers
-    # (padding pods) keep KIND_FAIL. Windows are disjoint, so the masked
-    # scatter writes each real row exactly once.
-    RN = run_start.shape[0]
-    win = jnp.arange(max_run)
-    rows = run_start[:, None] + win[None, :]  # [RN, MR]
-    valid = win[None, :] < run_len[:, None]
-    target = jnp.where(valid, rows, P + max_run - 1)  # dump padding in scratch
-    kinds = (
-        jnp.full((P + max_run,), KIND_FAIL, jnp.int32)
-        .at[target.ravel()]
-        .set(kind_ys.ravel())
-    )
-    idxs = (
-        jnp.full((P + max_run,), -1, jnp.int32).at[target.ravel()].set(index_ys.ravel())
-    )
-    return FFDResult(kind=kinds[:P], index=idxs[:P], state=final_state)
-
-
-def max_run_bucket(problem: SchedulingProblem) -> int:
-    """Static max-run window bucket for a (possibly stacked) problem —
-    single definition shared with parallel/mesh.py."""
-    import numpy as np
-
-    from karpenter_tpu.ops.padding import pow2_bucket
-
-    return pow2_bucket(int(np.max(np.asarray(problem.run_len), initial=1)), lo=1)
-
-
-def has_topo_runs(problem: SchedulingProblem) -> bool:
-    """Whether any run needs the topology inner-loop commit. MUST be threaded
-    into _solve_ffd_runs_jit's static with_topo: lax.switch clamps an
-    out-of-range mode index, so a RUN_TOPO run fed to the two-branch program
-    silently takes the topology-ignoring analytic commit (the round-2
-    21/64-seed parity regression)."""
-    import numpy as np
-
-    from karpenter_tpu.models.problem import RUN_TOPO
-
-    return bool(np.any(np.asarray(problem.run_mode) == RUN_TOPO))
-
-
-def solve_ffd_runs(
-    problem: SchedulingProblem, max_claims: int, init: Optional[FFDState] = None
-) -> FFDResult:
-    """Run one pack pass through the run-compressed solver."""
-    if init is None:
-        return _solve_ffd_runs_fresh_jit(
-            problem, max_claims, max_run_bucket(problem), has_topo_runs(problem)
-        )
-    return _solve_ffd_runs_jit(
-        problem, init, max_run_bucket(problem), has_topo_runs(problem)
-    )
-
-
-@functools.partial(jax.jit, static_argnums=(1, 2, 3))
-def _solve_ffd_runs_fresh_jit(
-    problem: SchedulingProblem, max_claims: int, max_run: int, with_topo: bool
-) -> FFDResult:
-    """Fresh-state runs variant: initial_state traced into the program (one
-    launch per solve; see _solve_ffd_fresh_jit)."""
-    init = initial_state(_pad_lanes_mult32(problem), max_claims)
-    return _solve_ffd_runs_jit(problem, init, max_run, with_topo)
+from karpenter_tpu.ops.ffd_sweeps import (  # noqa: F401
+    _make_stride,
+    _solve_ffd_sweeps_fresh_jit,
+    _sweeps_impl,
+    solve_ffd_sweeps,
+)
+from karpenter_tpu.ops.ffd_runs import (  # noqa: F401
+    _make_run_commit,
+    _solve_ffd_runs_fresh_jit,
+    _solve_ffd_runs_jit,
+    has_topo_runs,
+    max_run_bucket,
+    solve_ffd_runs,
+)

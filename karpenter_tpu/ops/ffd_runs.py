@@ -1,0 +1,631 @@
+"""Run-compressed FFD: one scan step commits a RUN of identical pods
+
+via closed-form waterfill over claims/domains. Fuzz-checked against the
+per-pod scan (tests/test_runs_solver.py); see ops/ffd.py for the map.
+"""
+
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax, vmap
+
+from karpenter_tpu.models.problem import (
+    HOSTNAME_KEY,
+    ReqTensor,
+    SchedulingProblem,
+)
+from karpenter_tpu.ops import masks
+from karpenter_tpu.ops.topology_kernels import (
+    record,
+)
+
+
+from karpenter_tpu.ops.ffd_core import (  # noqa: F401
+    FFDResult,
+    FFDState,
+    KIND_CLAIM,
+    KIND_FAIL,
+    KIND_NEW_CLAIM,
+    KIND_NODE,
+    KIND_NO_SLOT,
+    _BIG_CAP,
+    _UNROLL,
+    _capacity,
+    _first_true,
+    _fresh_template_rows,
+    _intersect_rows,
+    _lane_align,
+    _mint_host_onehot,
+    _mix_req_rows,
+    _offer_rows,
+    _pad_lanes_mult32,
+    _pin_hostname,
+    _pod_xs,
+    _statics,
+    _water_level,
+    initial_state,
+)
+from karpenter_tpu.ops.ffd_step import _make_step  # noqa: F401
+
+def _make_run_commit(problem: SchedulingProblem, statics, C: int, max_run: int):
+    """The analytic multi-pod commit: one scan step places an entire run of
+    identical, topology-inert pods, reproducing the per-pod step's outcome
+    (including each pod's (kind, index) in temporal order) in closed form.
+
+    Correctness argument, phase by phase (all against _make_step's semantics):
+      nodes   — a pod takes the FIRST node that passes the static gates with
+                room, so k pods fill nodes in index order up to each node's
+                integer capacity: cumsum fill. Narrowing commits are
+                idempotent for identical pods.
+      claims  — a pod takes the open claim with the FEWEST pods (index
+                tie-break), i.e. pods waterfill claim levels bounded by each
+                claim's capacity (max over surviving instance types of how
+                many more such pods fit). The temporal order of assignments
+                is (level-before, claim index) lexicographic — recovered per
+                ordinal to keep exact per-pod parity with the oracle.
+      opens   — pods that exhaust claim capacity open fresh template claims
+                one at a time; each opened claim absorbs pods up to its own
+                capacity before the next opens (it is the unique unsaturated
+                claim), so openings assign consecutive ordinal blocks in
+                slot order. Limit headroom burns once per open (subtractMax,
+                scheduler.go:347-364).
+    """
+    lv, ln, wellknown, no_allow, it_packed, it_neg = statics
+    N = problem.num_nodes
+    T = problem.num_instance_types
+    TPL = problem.num_templates
+    K = problem.num_keys
+    V = problem.num_lanes
+    D = problem.pod_vol_counts.shape[1]
+    mint_hostnames = problem.claim_hostname_lane.shape[0] > 0
+
+    def has_offering_rows(admitted):
+        return _offer_rows(problem, admitted)
+
+    def commit(state: FFDState, pod, start, length, active_arr):
+        (
+            pod_req,
+            _pod_strict,
+            pod_requests,
+            tol_tpl,
+            tol_node,
+            pod_ports,
+            pod_conflict,
+            _gm,
+            _gs,
+            _go,
+            pod_vols,
+            _pa,
+        ) = pod
+        win = jnp.arange(max_run)
+        act = lax.dynamic_slice(active_arr, (start,), (max_run,)) & (win < length)
+        k = act.sum().astype(jnp.int32)
+        ordinal = (jnp.cumsum(act) - 1).astype(jnp.int32)  # [MR]
+        port_cap = jnp.where(jnp.any(pod_ports), 1, _BIG_CAP).astype(jnp.int32)
+
+        # ---- 1. existing nodes: first-fit fill in node order
+        if N > 0:
+            node_merged = _intersect_rows(state.node_req, pod_req)
+            node_compat = vmap(
+                lambda nr: masks.compatible_ok(nr, pod_req, lv, ln, no_allow)
+            )(state.node_req)
+            node_port_ok = ~jnp.any(state.node_used_ports & pod_conflict[None, :], axis=-1)
+            if D > 0:
+                # clamp: pre-existing over-limit attach counts read as 0
+                # capacity, not negative (the per-pod gate simply fails)
+                vol_room = jnp.maximum(
+                    (problem.node_vol_limits - state.node_vol_used)
+                    // jnp.maximum(pod_vols[None, :], 1),
+                    0,
+                )
+                vol_cap = jnp.min(
+                    jnp.where(pod_vols[None, :] > 0, vol_room, _BIG_CAP), axis=-1
+                ).astype(jnp.int32)
+            else:
+                vol_cap = jnp.full((N,), _BIG_CAP, jnp.int32)
+            res_cap = _capacity(
+                problem.node_avail, state.node_requests, pod_requests[None, :]
+            )
+            node_ok = tol_node & node_compat & node_port_ok
+            ncap = jnp.where(node_ok, jnp.minimum(jnp.minimum(res_cap, vol_cap), port_cap), 0)
+            ncum = jnp.cumsum(ncap)
+            placed_n = jnp.minimum(k, ncum[-1])
+            node_take = jnp.clip(k - (ncum - ncap), 0, ncap)
+            took_n = node_take > 0
+            new_node_req = _mix_req_rows(state.node_req, node_merged, took_n)
+            new_node_requests = state.node_requests + node_take[:, None] * pod_requests[None, :]
+            new_node_npods = state.node_npods + node_take
+            new_node_ports = state.node_used_ports | (took_n[:, None] & pod_ports[None, :])
+            new_node_vol = state.node_vol_used + node_take[:, None] * pod_vols[None, :]
+            node_of = jnp.searchsorted(ncum, ordinal, side="right").astype(jnp.int32)
+        else:
+            placed_n = jnp.int32(0)
+            node_of = jnp.zeros((max_run,), jnp.int32)
+            new_node_req = state.node_req
+            new_node_requests = state.node_requests
+            new_node_npods = state.node_npods
+            new_node_ports = state.node_used_ports
+            new_node_vol = state.node_vol_used
+        rem = k - placed_n
+
+        # ---- 2. open claims: fewest-pods waterfill bounded by capacity
+        claim_merged = _intersect_rows(state.claim_req, pod_req)
+        claim_compat = vmap(
+            lambda cr: masks.compatible_ok(cr, pod_req, lv, ln, wellknown)
+        )(state.claim_req)
+        claim_port_ok = ~jnp.any(state.claim_used_ports & pod_conflict[None, :], axis=-1)
+        m_packed = masks.pack_lanes(claim_merged.admitted)
+        m_neg = vmap(lambda r: masks.negative_polarity(r, lv, ln))(claim_merged)
+        itc = masks.packed_pairwise_compat(
+            claim_merged, m_packed, m_neg, problem.it_reqs, it_packed, it_neg
+        )  # [C, T]
+        itok = state.claim_it_ok & itc & has_offering_rows(claim_merged.admitted)
+        cap_ct = _capacity(
+            problem.it_alloc[None, :, :],
+            state.claim_requests[:, None, :],
+            pod_requests[None, None, :],
+        )  # [C, T]
+        cap_c = jnp.max(jnp.where(itok, cap_ct, 0), axis=-1)
+        elig = (
+            state.claim_open
+            & tol_tpl[state.claim_tpl]
+            & claim_compat
+            & claim_port_ok
+        )
+        cap_c = jnp.where(elig, jnp.minimum(cap_c, port_cap), 0)
+        p_lvl = state.claim_npods
+        m = jnp.minimum(rem, cap_c.sum())
+        L = _water_level(p_lvl, cap_c, m)
+        take0 = jnp.clip(L - p_lvl, 0, cap_c)
+        leftover = m - take0.sum()
+        at_level = (p_lvl + take0 == L) & (take0 < cap_c)
+        extra = at_level & (jnp.cumsum(at_level) <= leftover)
+        claim_take = take0 + extra.astype(jnp.int32)
+        tookc = claim_take > 0
+        i_claim_req = _mix_req_rows(state.claim_req, claim_merged, tookc)
+        i_requests = state.claim_requests + claim_take[:, None] * pod_requests[None, :]
+        i_npods = state.claim_npods + claim_take
+        i_itok = jnp.where(tookc[:, None], itok & (cap_ct >= claim_take[:, None]), state.claim_it_ok)
+        i_ports = state.claim_used_ports | (tookc[:, None] & pod_ports[None, :])
+        rem2 = rem - claim_take.sum()
+
+        # temporal ordinal -> claim: assignments sort by (level-before, claim)
+        jj = ordinal - placed_n
+        lev = _water_level(p_lvl, claim_take, jnp.maximum(jj, 0))
+        before = jnp.sum(
+            jnp.clip(lev[:, None] - p_lvl[None, :], 0, claim_take[None, :]), axis=-1
+        )
+        pos = jnp.maximum(jj, 0) - before
+        at_lev = (p_lvl[None, :] <= lev[:, None]) & (
+            lev[:, None] < (p_lvl + claim_take)[None, :]
+        )  # [MR, C]
+        lev_cum = jnp.cumsum(at_lev, axis=-1)
+        claim_of = jnp.argmax(at_lev & (lev_cum == (pos + 1)[:, None]), axis=-1).astype(
+            jnp.int32
+        )
+
+        # ---- 3. fresh template claims, one open at a time. The heavy
+        # template-side products are loop-invariant and hoisted out of the
+        # open-loop: the merged rows, compat mask, [TPL, T] pairwise
+        # instance-type compat, offerings, and per-pod capacities depend only
+        # on (pod_req, pod_requests) — the minted-hostname pin (the one
+        # free_slot-dependent piece of _fresh_template_rows) cannot change
+        # them because instance types never constrain the hostname key (the
+        # claim mints a fresh name precisely because nothing else names it,
+        # nodeclaim.go:46-63); only the committed slot row must carry the pin
+        tpl_merged_u = _intersect_rows(problem.tpl_reqs, pod_req)
+        tpl_compat = vmap(
+            lambda tr: masks.compatible_ok(tr, pod_req, lv, ln, wellknown)
+        )(problem.tpl_reqs)
+        t_packed = masks.pack_lanes(tpl_merged_u.admitted)
+        t_neg = vmap(lambda r: masks.negative_polarity(r, lv, ln))(tpl_merged_u)
+        itc_t = masks.packed_pairwise_compat(
+            tpl_merged_u, t_packed, t_neg, problem.it_reqs, it_packed, it_neg
+        )  # [TPL, T]
+        cap_tt = _capacity(
+            problem.it_alloc[None, :, :],
+            problem.tpl_overhead[:, None, :],
+            pod_requests[None, None, :],
+        )  # [TPL, T]
+        itok_t_static = (
+            problem.tpl_it_ok
+            & itc_t
+            & has_offering_rows(tpl_merged_u.admitted)
+            & (cap_tt >= 1)
+        )
+
+        def nc_cond(c):
+            return c[0] & (c[1] > 0)
+
+        def nc_body(c):
+            (
+                _keep,
+                c_rem,
+                c_req,
+                c_requests,
+                c_itok,
+                c_open,
+                c_npods,
+                c_tpl,
+                c_ports,
+                c_remaining,
+                c_registered,
+                c_newtake,
+                c_noslot,
+            ) = c
+            free_slot = _first_true(~c_open)
+            has_slot = jnp.any(~c_open)
+            host_onehot = _mint_host_onehot(problem, free_slot)
+            within = masks.fits(problem.it_cap[None, :, :], c_remaining[:, None, :])
+            itok_t = itok_t_static & within
+            q_t = jnp.max(jnp.where(itok_t, cap_tt, 0), axis=-1)  # [TPL]
+            tpl_ok = tol_tpl & tpl_compat & (q_t >= 1)
+            pick = _first_true(tpl_ok)
+            any_tpl = jnp.any(tpl_ok)
+            pick_c = jnp.minimum(pick, TPL - 1)
+            can = any_tpl & has_slot
+            take = jnp.where(can, jnp.minimum(c_rem, jnp.minimum(q_t[pick_c], port_cap)), 0)
+            slot_hot = (jnp.arange(C) == free_slot) & (take > 0)
+            slot_req_u = tpl_merged_u.row(pick_c)
+            # the committed claim row carries its minted hostname
+            # (nodeclaim.go:46-63), exactly as _fresh_template_rows pins it
+            slot_req = (
+                _pin_hostname(slot_req_u, host_onehot) if mint_hostnames else slot_req_u
+            )
+            new_req = _mix_req_rows(
+                c_req,
+                ReqTensor(
+                    admitted=jnp.broadcast_to(slot_req.admitted, (C, K, V)),
+                    comp=jnp.broadcast_to(slot_req.comp, (C, K)),
+                    gt=jnp.broadcast_to(slot_req.gt, (C, K)),
+                    lt=jnp.broadcast_to(slot_req.lt, (C, K)),
+                    defined=jnp.broadcast_to(slot_req.defined, (C, K)),
+                ),
+                slot_hot,
+            )
+            surv1 = itok_t[pick_c]  # [T] survivors with the first pod aboard
+            new_itok = jnp.where(
+                slot_hot[:, None], surv1[None, :] & (cap_tt[pick_c][None, :] >= take), c_itok
+            )
+            new_requests = jnp.where(
+                slot_hot[:, None],
+                (problem.tpl_overhead[pick_c] + take * pod_requests)[None, :],
+                c_requests,
+            )
+            opened = take > 0
+            opened_tpl_hot = (jnp.arange(TPL) == pick_c) & opened
+            max_cap = jnp.max(jnp.where(surv1[:, None], problem.it_cap, 0.0), axis=0)
+            new_remaining = jnp.where(
+                opened_tpl_hot[:, None], c_remaining - max_cap[None, :], c_remaining
+            )
+            new_registered = c_registered | (
+                opened
+                & mint_hostnames
+                & (problem.grp_key == HOSTNAME_KEY)[:, None]
+                & host_onehot[None, :]
+            )
+            return (
+                can,
+                c_rem - take,
+                new_req,
+                new_requests,
+                new_itok,
+                c_open | slot_hot,
+                c_npods + slot_hot * take,
+                jnp.where(slot_hot, pick_c.astype(jnp.int32), c_tpl),
+                c_ports | (slot_hot[:, None] & pod_ports[None, :]),
+                new_remaining,
+                new_registered,
+                c_newtake + slot_hot * take,
+                # ~has_slot alone: with no free slot the template verdict is
+                # unreliable (see the step's kind classification) — always
+                # signal NO_SLOT so the backend's slot-growth retry decides
+                c_noslot | ~has_slot,
+            )
+
+        nc0 = (
+            jnp.bool_(True),
+            rem2,
+            i_claim_req,
+            i_requests,
+            i_itok,
+            state.claim_open,
+            i_npods,
+            state.claim_tpl,
+            i_ports,
+            state.remaining,
+            state.grp_registered,
+            jnp.zeros((C,), jnp.int32),
+            jnp.bool_(False),
+        )
+        (
+            _keep,
+            rem3,
+            f_claim_req,
+            f_requests,
+            f_itok,
+            f_open,
+            f_npods,
+            f_tpl,
+            f_ports,
+            f_remaining,
+            f_registered,
+            new_take,
+            noslot,
+        ) = lax.while_loop(nc_cond, nc_body, nc0)
+        placed_new = rem2 - rem3
+        new_cum = jnp.cumsum(new_take)  # slot order == temporal opening order
+        nc_ord = ordinal - placed_n - m  # ordinal within the new-claim phase
+        newclaim_of = jnp.searchsorted(new_cum, nc_ord, side="right").astype(jnp.int32)
+        # the pod that OPENS a slot reads KIND_NEW_CLAIM, later joiners
+        # KIND_CLAIM — matching the per-pod step's labels exactly
+        opens_slot = nc_ord == (new_cum - new_take)[jnp.minimum(newclaim_of, C - 1)]
+
+        # ---- 4. per-row outputs, written into the run's queue window
+        fail_kind = jnp.where(noslot, KIND_NO_SLOT, KIND_FAIL).astype(jnp.int32)
+        kind_row = jnp.where(
+            ~act,
+            KIND_FAIL,
+            jnp.where(
+                ordinal < placed_n,
+                KIND_NODE,
+                jnp.where(
+                    ordinal < placed_n + m,
+                    KIND_CLAIM,
+                    jnp.where(
+                        ordinal < placed_n + m + placed_new,
+                        jnp.where(opens_slot, KIND_NEW_CLAIM, KIND_CLAIM),
+                        fail_kind,
+                    ),
+                ),
+            ),
+        ).astype(jnp.int32)
+        # index by PHASE (new-phase joiners are labeled KIND_CLAIM but their
+        # slot comes from the opening partition, not the waterfill)
+        index_row = jnp.where(
+            ~act,
+            -1,
+            jnp.where(
+                ordinal < placed_n,
+                node_of,
+                jnp.where(
+                    ordinal < placed_n + m,
+                    claim_of,
+                    jnp.where(ordinal < placed_n + m + placed_new, newclaim_of, -1),
+                ),
+            ),
+        ).astype(jnp.int32)
+
+        # ---- 5. record aggregation (Topology.Record, topology.go:125-148).
+        # Run members are topology-BLIND (no matched/owned groups — run mode
+        # rule in solver/encode.py) but may still be SELECTED by other pods'
+        # groups; each placed member records its select mask against the
+        # dom-lanes of the bin it landed on. Deltas never feed back into any
+        # member's own gates, so they sum: member-per-bin counts contract
+        # against per-bin dom masks. Identical to applying record() per pod.
+        G = problem.grp_key.shape[0]
+        new_counts = state.grp_counts
+        if G > 0:
+            sel_arr = jnp.concatenate(
+                [jnp.asarray(problem.pod_grp_selects), jnp.zeros((max_run, G), bool)]
+            )
+            sel = lax.dynamic_slice(sel_arr, (start, 0), (max_run, G))  # [MR, G]
+            placed_row = kind_row < KIND_FAIL
+            B = N + C
+            bin_of = jnp.where(kind_row == KIND_NODE, index_row, N + index_row)
+            ob = placed_row[:, None] & (
+                jnp.clip(bin_of, 0, B - 1)[:, None] == jnp.arange(B)[None, :]
+            )  # [MR, B]
+            cnt_bg = jnp.matmul(
+                ob.astype(jnp.float32).T,
+                sel.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )  # [B, G]
+            if N > 0:
+                radm = jnp.concatenate(
+                    [new_node_req.admitted, f_claim_req.admitted], axis=0
+                )
+                rcomp = jnp.concatenate([new_node_req.comp, f_claim_req.comp], axis=0)
+            else:
+                radm, rcomp = f_claim_req.admitted, f_claim_req.comp
+            dom = radm[:, problem.grp_key, :]  # [B, G, V]
+            concrete = ~rcomp[:, problem.grp_key]  # [B, G]
+            single = dom.sum(axis=-1) == 1
+            spread_or_aff = (problem.grp_type == 0) | (problem.grp_type == 1)
+            F = problem.grp_filter_valid.shape[1]
+            if F > 0:
+                if N > 0:
+                    bin_rows = ReqTensor(
+                        admitted=radm,
+                        comp=rcomp,
+                        gt=jnp.concatenate([new_node_req.gt, f_claim_req.gt], axis=0),
+                        lt=jnp.concatenate([new_node_req.lt, f_claim_req.lt], axis=0),
+                        defined=jnp.concatenate(
+                            [new_node_req.defined, f_claim_req.defined], axis=0
+                        ),
+                    )
+                    allow_b = jnp.concatenate(
+                        [
+                            jnp.zeros((N, no_allow.shape[0]), bool),
+                            jnp.broadcast_to(wellknown, (C, wellknown.shape[0])),
+                        ]
+                    )
+                else:
+                    bin_rows = f_claim_req
+                    allow_b = jnp.broadcast_to(wellknown, (C, wellknown.shape[0]))
+
+                def bin_filt(row, allow):
+                    def grp_filt(g):
+                        terms = problem.grp_filter.row(g)
+                        term_ok = vmap(
+                            lambda t: masks.compatible_ok(row, t, lv, ln, allow)
+                        )(terms)
+                        return ~problem.grp_has_filter[g] | jnp.any(
+                            problem.grp_filter_valid[g] & term_ok
+                        )
+
+                    return vmap(grp_filt)(jnp.arange(G))
+
+                filt = vmap(bin_filt)(bin_rows, allow_b)  # [B, G]
+            else:
+                filt = jnp.ones((B, G), bool)
+            dom_ok = (
+                concrete
+                & jnp.where(spread_or_aff[None, :], single, True)
+                & filt
+                & ~problem.grp_inverse[None, :]
+            )
+            dom_final = dom & dom_ok[:, :, None]  # [B, G, V]
+            recorded = jnp.einsum(
+                "bg,bgv->gv",
+                cnt_bg,
+                dom_final.astype(jnp.float32),
+                preferred_element_type=jnp.float32,
+            )
+            new_counts = state.grp_counts + jnp.round(recorded).astype(jnp.int32)
+            f_registered = f_registered | jnp.any(
+                (cnt_bg[:, :, None] > 0.5) & dom_final, axis=0
+            )
+
+        new_state = FFDState(
+            claim_req=f_claim_req,
+            claim_requests=f_requests,
+            claim_it_ok=f_itok,
+            claim_open=f_open,
+            claim_npods=f_npods,
+            claim_tpl=f_tpl,
+            claim_used_ports=f_ports,
+            node_req=new_node_req,
+            node_requests=new_node_requests,
+            node_npods=new_node_npods,
+            node_used_ports=new_node_ports,
+            node_vol_used=new_node_vol,
+            remaining=f_remaining,
+            grp_counts=new_counts,
+            grp_registered=f_registered,
+        )
+        return new_state, (kind_row, index_row)
+
+    return commit
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _solve_ffd_runs_jit(
+    problem: SchedulingProblem, init: FFDState, max_run: int, with_topo: bool
+) -> FFDResult:
+    """Run-compressed scan: one step per run of identical pods (encode.py
+    segmentation). Topology-inert runs take the closed-form analytic commit,
+    topology-interacting runs the light inner loop (ops/topo_runs.py), and
+    length-1 runs the per-pod step. 10k diverse pods collapse to a few
+    hundred steps. ``with_topo=False`` compiles the two-branch program —
+    topology-free batches (the whole consolidation path) skip the topo
+    branch's compile cost."""
+    from karpenter_tpu.ops.topo_runs import make_topo_run_commit
+
+    problem, init = _lane_align(problem, init)
+    C = init.claim_open.shape[0]
+    statics = _statics(problem)
+    step = _make_step(problem, statics, C)
+    commit = _make_run_commit(problem, statics, C, max_run)
+    topo_commit = make_topo_run_commit(problem, statics, C, max_run) if with_topo else None
+    P = problem.num_pods
+    pods_xs = _pod_xs(problem)
+    rep_xs = jax.tree_util.tree_map(lambda a: a[problem.run_start], pods_xs)
+    # scratch tail so a window starting near P never clamps backwards
+    active_arr = jnp.concatenate(
+        [jnp.asarray(problem.pod_active), jnp.zeros((max_run,), dtype=bool)]
+    )
+
+    def outer(state, xs):
+        rep, start, length, mode = xs
+
+        def single(_):
+            new_state, (kind, index) = step(state, rep)
+            kind_row = jnp.full((max_run,), KIND_FAIL, jnp.int32).at[0].set(kind)
+            index_row = jnp.full((max_run,), -1, jnp.int32).at[0].set(index)
+            return new_state, (kind_row, index_row)
+
+        def analytic(_):
+            return commit(state, rep, start, length, active_arr)
+
+        if with_topo:
+            def topo(_):
+                return topo_commit(state, rep, start, length, active_arr)
+
+            return lax.switch(mode, (single, analytic, topo), None)
+        return lax.switch(mode, (single, analytic), None)
+
+    run_start = jnp.asarray(problem.run_start)
+    run_len = jnp.asarray(problem.run_len)
+    final_state, (kind_ys, index_ys) = lax.scan(
+        outer,
+        init,
+        (rep_xs, run_start, run_len, jnp.asarray(problem.run_mode)),
+        unroll=_UNROLL,
+    )
+    # scatter the per-run windows back into queue order; rows no run covers
+    # (padding pods) keep KIND_FAIL. Windows are disjoint, so the masked
+    # scatter writes each real row exactly once.
+    RN = run_start.shape[0]
+    win = jnp.arange(max_run)
+    rows = run_start[:, None] + win[None, :]  # [RN, MR]
+    valid = win[None, :] < run_len[:, None]
+    target = jnp.where(valid, rows, P + max_run - 1)  # dump padding in scratch
+    kinds = (
+        jnp.full((P + max_run,), KIND_FAIL, jnp.int32)
+        .at[target.ravel()]
+        .set(kind_ys.ravel())
+    )
+    idxs = (
+        jnp.full((P + max_run,), -1, jnp.int32).at[target.ravel()].set(index_ys.ravel())
+    )
+    return FFDResult(kind=kinds[:P], index=idxs[:P], state=final_state)
+
+
+def max_run_bucket(problem: SchedulingProblem) -> int:
+    """Static max-run window bucket for a (possibly stacked) problem —
+    single definition shared with parallel/mesh.py."""
+    import numpy as np
+
+    from karpenter_tpu.ops.padding import pow2_bucket
+
+    return pow2_bucket(int(np.max(np.asarray(problem.run_len), initial=1)), lo=1)
+
+
+def has_topo_runs(problem: SchedulingProblem) -> bool:
+    """Whether any run needs the topology inner-loop commit. MUST be threaded
+    into _solve_ffd_runs_jit's static with_topo: lax.switch clamps an
+    out-of-range mode index, so a RUN_TOPO run fed to the two-branch program
+    silently takes the topology-ignoring analytic commit (the round-2
+    21/64-seed parity regression)."""
+    import numpy as np
+
+    from karpenter_tpu.models.problem import RUN_TOPO
+
+    return bool(np.any(np.asarray(problem.run_mode) == RUN_TOPO))
+
+
+def solve_ffd_runs(
+    problem: SchedulingProblem, max_claims: int, init: Optional[FFDState] = None
+) -> FFDResult:
+    """Run one pack pass through the run-compressed solver."""
+    if init is None:
+        return _solve_ffd_runs_fresh_jit(
+            problem, max_claims, max_run_bucket(problem), has_topo_runs(problem)
+        )
+    return _solve_ffd_runs_jit(
+        problem, init, max_run_bucket(problem), has_topo_runs(problem)
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
+def _solve_ffd_runs_fresh_jit(
+    problem: SchedulingProblem, max_claims: int, max_run: int, with_topo: bool
+) -> FFDResult:
+    """Fresh-state runs variant: initial_state traced into the program (one
+    launch per solve; see _solve_ffd_fresh_jit)."""
+    init = initial_state(_pad_lanes_mult32(problem), max_claims)
+    return _solve_ffd_runs_jit(problem, init, max_run, with_topo)

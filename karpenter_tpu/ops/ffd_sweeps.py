@@ -1,0 +1,811 @@
+"""Sweeps driver: ALL relax-and-retry passes in one device launch.
+
+An outer while over sweeps with an inner while over a compact queue; the
+stride commit consumes whole strict-identical pod chains per iteration
+(scheduler.go:150-170 requeue semantics, re-designed for XLA).
+"""
+
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax, vmap
+
+from karpenter_tpu.models.problem import (
+    HOSTNAME_KEY,
+    ReqTensor,
+    SchedulingProblem,
+)
+from karpenter_tpu.ops import masks
+from karpenter_tpu.ops.topology_kernels import (
+    PodTopoStatics,
+    record,
+    record_delta,
+    topo_gate,
+)
+
+
+import os as _os
+
+from karpenter_tpu.ops.ffd_core import (  # noqa: F401
+    FFDResult,
+    FFDState,
+    KIND_CLAIM,
+    KIND_FAIL,
+    KIND_NEW_CLAIM,
+    KIND_NODE,
+    KIND_NO_SLOT,
+    _BIG,
+    _BIG_CAP,
+    _capacity,
+    _first_true,
+    _fresh_template_rows,
+    _intersect_rows,
+    _make_it_gate,
+    _mint_host_onehot,
+    _pad_lanes_mult32,
+    _pod_xs,
+    _statics,
+    _water_level,
+    initial_state,
+)
+from karpenter_tpu.ops.ffd_runs import _make_run_commit  # noqa: F401
+
+_STRIDE = int(_os.environ.get("KARPENTER_TPU_STRIDE", "64"))
+# experimental chain-dispatch sweep structure (see _sweeps_impl)
+_CHAIN_DISPATCH = _os.environ.get("KARPENTER_TPU_CHAIN_DISPATCH", "") == "1"
+
+
+def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
+    """One sweep iteration: evaluate ONE pod exactly (the narrow per-pod
+    gates), then commit it together with up to S-1 byte-identical consecutive
+    queue successors in closed form — bit-identical to stepping them one at a
+    time:
+
+      - identical pods against unchanged state get identical verdicts, so a
+        FAIL (or NO_SLOT) verdict extends to the whole identical chain at
+        zero cost — one iteration requeues (or flags) all of them;
+      - a placed pod's chain may stack into its chosen bin while j such pods
+        still fit (the per-pod fit gate's closed form over instance types /
+        node capacity, ports and CSI limits included) and, for claims, while
+        the bin remains the fewest-pods pick with j-1 stack-mates aboard
+        (rank stays below the second-best eligible rank — competitors' ranks
+        never improve, so the bound is exact);
+      - stacking is allowed only when the pod's own record set cannot feed
+        back into its own gate set: no matched group is recorded into,
+        EXCEPT regular affinity groups, whose gate is monotone in the
+        counters — the first pod's narrowed row makes every successor's
+        merge, gate verdict, and record delta identical (the allowed-domain
+        set only grows, and the bin state is already narrowed inside it);
+      - record deltas are then identical per stack member: counts += k*delta.
+
+    A claim-open commits alone (it moves free_slot, limits headroom, and the
+    fewest-pods ranking). Every iteration consumes >= 1 pod.
+    """
+    lv, ln, wellknown, no_allow, it_packed, it_neg = statics
+    N = problem.num_nodes
+    T = problem.num_instance_types
+    TPL = problem.num_templates
+    K = problem.num_keys
+    V = problem.num_lanes
+    R = problem.pod_requests.shape[1]
+    it_gate = _make_it_gate(problem, statics)
+    mint_hostnames = problem.claim_hostname_lane.shape[0] > 0
+    G = problem.grp_key.shape[0]
+    P = problem.num_pods
+    eqprev_arr = (
+        jnp.asarray(problem.pod_eqprev)
+        if problem.pod_eqprev is not None
+        else jnp.zeros((P,), bool)
+    )
+    eqgate_arr = (
+        jnp.asarray(problem.pod_eqprev_gate)
+        if problem.pod_eqprev_gate is not None
+        else jnp.zeros((P,), bool)
+    )
+    # the analytic waterfill commit consumes whole gate-identical chains
+    # (record sum included); scratch tail so a window near P never clamps
+    run_commit = _make_run_commit(problem, statics, C, S)
+    active_concat = jnp.concatenate(
+        [jnp.asarray(problem.pod_active), jnp.zeros((S,), bool)]
+    )
+    Srange = jnp.arange(S)
+
+    def topo_of(pod):
+        return PodTopoStatics(
+            strict_admitted=pod[1].admitted,
+            grp_match=pod[7],
+            grp_selects=pod[8],
+            grp_owned=pod[9],
+        )
+
+    def _zeros_row():
+        return ReqTensor(
+            admitted=jnp.zeros((K, V), bool),
+            comp=jnp.zeros((K,), bool),
+            gt=jnp.zeros((K,), jnp.int32),
+            lt=jnp.zeros((K,), jnp.int32),
+            defined=jnp.zeros((K,), bool),
+        )
+
+    def eval_base(state: FFDState, pod):
+        # NOTE: the node/claim gate phases below intentionally mirror
+        # _make_step's — _make_step stays the scan-path anchor the
+        # randomized-parity fuzz cross-checks this path against (and both
+        # are anchored to the host oracle). Any gate change must land in
+        # BOTH, and the 64-seed fuzz is the guard that they did.
+        (
+            pod_req,
+            _pod_strict,
+            pod_requests,
+            tol_tpl,
+            tol_node,
+            pod_ports,
+            pod_conflict,
+            _gm,
+            _gs,
+            _go,
+            pod_vols,
+            pod_is_active,
+        ) = pod
+        topo_pod = topo_of(pod)
+        port_cap = jnp.where(jnp.any(pod_ports), 1, _BIG_CAP).astype(jnp.int32)
+
+        # -- existing nodes (same gates as _make_step)
+        node_requests2 = state.node_requests + pod_requests[None, :]
+        node_fit = masks.fits(node_requests2, problem.node_avail)
+        node_compat = vmap(
+            lambda nr: masks.compatible_ok(nr, pod_req, lv, ln, no_allow)
+        )(state.node_req)
+        node_port_ok = ~jnp.any(state.node_used_ports & pod_conflict[None, :], axis=-1)
+        node_vol_ok = jnp.all(
+            state.node_vol_used + pod_vols[None, :] <= problem.node_vol_limits, axis=-1
+        )
+        node_merged = _intersect_rows(state.node_req, pod_req)
+        node_topo_ok, node_final = topo_gate(
+            problem, state.grp_counts, state.grp_registered, topo_pod, node_merged, no_allow
+        )
+        node_ok = tol_node & node_fit & node_compat & node_port_ok & node_vol_ok & node_topo_ok
+        node_pick = _first_true(node_ok)
+        any_node = jnp.any(node_ok)
+        if N > 0:
+            pick_n = jnp.minimum(node_pick, N - 1)
+            node_final_row = node_final.row(pick_n)
+            res_cap = _capacity(
+                problem.node_avail[pick_n], state.node_requests[pick_n], pod_requests
+            )
+            if problem.pod_vol_counts.shape[1] > 0:
+                vol_room = jnp.maximum(
+                    (problem.node_vol_limits[pick_n] - state.node_vol_used[pick_n])
+                    // jnp.maximum(pod_vols, 1),
+                    0,
+                )
+                vol_cap = jnp.min(
+                    jnp.where(pod_vols > 0, vol_room, _BIG_CAP)
+                ).astype(jnp.int32)
+            else:
+                vol_cap = jnp.int32(_BIG_CAP)
+            node_fit_count = jnp.minimum(jnp.minimum(res_cap, vol_cap), port_cap)
+        else:
+            node_final_row = _zeros_row()
+            node_fit_count = jnp.int32(0)
+
+        # -- open claims (same gates as _make_step)
+        claim_compat = vmap(
+            lambda cr: masks.compatible_ok(cr, pod_req, lv, ln, wellknown)
+        )(state.claim_req)
+        claim_merged = _intersect_rows(state.claim_req, pod_req)
+        claim_topo_ok, claim_final = topo_gate(
+            problem, state.grp_counts, state.grp_registered, topo_pod, claim_merged, wellknown
+        )
+        claim_requests2 = state.claim_requests + pod_requests[None, :]
+        claim_it_ok2 = it_gate(claim_final, claim_requests2, state.claim_it_ok)
+        claim_port_ok = ~jnp.any(state.claim_used_ports & pod_conflict[None, :], axis=-1)
+        claim_ok = (
+            state.claim_open
+            & tol_tpl[state.claim_tpl]
+            & claim_port_ok
+            & claim_compat
+            & claim_topo_ok
+            & jnp.any(claim_it_ok2, axis=-1)
+        )
+        claim_rank = jnp.where(claim_ok, state.claim_npods * C + jnp.arange(C), _BIG)
+        claim_pick = jnp.argmin(claim_rank)
+        any_claim = jnp.any(claim_ok)
+        rank2 = jnp.min(jnp.where(jnp.arange(C) == claim_pick, _BIG, claim_rank))
+        # full [C, T] per-pod capacities: the take-vector commit waterfills
+        # the whole identical chain across EVERY eligible claim, so each
+        # claim's integer capacity is needed, not just the pick's
+        cap_ct_all = _capacity(
+            problem.it_alloc[None, :, :],
+            state.claim_requests[:, None, :],
+            pod_requests[None, None, :],
+        )  # [C, T]
+        cap_c = jnp.max(jnp.where(claim_it_ok2, cap_ct_all, 0), axis=-1)
+        cap_c = jnp.where(claim_ok, jnp.minimum(cap_c, port_cap), 0).astype(jnp.int32)
+        claim_fit_count = cap_c[claim_pick]
+        claim_npods0 = state.claim_npods[claim_pick]
+
+        return (
+            any_node,
+            node_pick.astype(jnp.int32),
+            node_final_row,
+            node_fit_count,
+            any_claim,
+            claim_pick.astype(jnp.int32),
+            rank2.astype(jnp.int32),
+            claim_final,
+            claim_it_ok2,
+            cap_ct_all,
+            cap_c,
+            claim_fit_count,
+            claim_npods0,
+            pod_is_active,
+        )
+
+    def eval_tpl_one(state: FFDState, free_slot, host_onehot, pod):
+        pod_req, pod_requests, tol_tpl = pod[0], pod[2], pod[3]
+        topo_pod = topo_of(pod)
+        reg_for_tpl = state.grp_registered | (
+            (problem.grp_key == HOSTNAME_KEY)[:, None] & host_onehot[None, :]
+        )
+        tpl_requests2 = problem.tpl_overhead + pod_requests[None, :]
+        # shared helper so the mint/pin semantics can never diverge between
+        # the per-pod step, the run commit, and this sweeps path
+        tpl_merged, tpl_compat, _host = _fresh_template_rows(
+            problem, lv, ln, wellknown, pod_req, free_slot
+        )
+        tpl_topo_ok, tpl_final = topo_gate(
+            problem, state.grp_counts, reg_for_tpl, topo_pod, tpl_merged, wellknown
+        )
+        within_limits = masks.fits(
+            problem.it_cap[None, :, :], state.remaining[:, None, :]
+        )
+        tpl_it_ok2 = it_gate(tpl_final, tpl_requests2, problem.tpl_it_ok & within_limits)
+        tpl_ok = tol_tpl & tpl_compat & tpl_topo_ok & jnp.any(tpl_it_ok2, axis=-1)
+        tpl_pick = _first_true(tpl_ok)
+        pick_c = jnp.minimum(tpl_pick, TPL - 1)
+        tpl_row_it_ok = tpl_it_ok2[pick_c]
+        max_cap = jnp.max(
+            jnp.where(tpl_row_it_ok[:, None], problem.it_cap, 0.0), axis=0
+        )
+        return (
+            jnp.any(tpl_ok),
+            tpl_pick.astype(jnp.int32),
+            tpl_final.row(pick_c),
+            tpl_requests2[pick_c],
+            tpl_row_it_ok,
+            max_cap,
+        )
+
+    def chain_ahead(queue, i, qlen, p):
+        """True when the NEXT queue entry extends a gate-identical chain from
+        the cursor — the narrow loop's exit test (cheap: three gathers)."""
+        nxt_in = (i + 1) < qlen
+        qn = queue[jnp.clip(i + 1, 0, P - 1)]
+        return nxt_in & (qn == p + 1) & eqgate_arr[jnp.clip(p + 1, 0, P - 1)]
+
+    def analytic_iter(state, queue, i, qlen, kinds, idxs, nq, nqlen):
+        """Commit one whole gate-identical chain (>= 1 pods) via the
+        closed-form waterfill run commit (record sum included)."""
+        p = queue[jnp.clip(i, 0, P - 1)]
+        pod = jax.tree_util.tree_map(lambda a: a[p], pods_xs)
+        ahead = queue[jnp.clip(i + Srange, 0, P - 1)]  # [S]
+        adj = (ahead == p + Srange) & ((i + Srange) < qlen)
+        succ = jnp.clip(p + Srange, 0, P - 1)
+        gate_chain = lax.cummin(
+            (adj & ((Srange == 0) | eqgate_arr[succ])).astype(jnp.int32)
+        ).astype(bool)
+        k_gate = gate_chain.sum().astype(jnp.int32)
+        state, (kind_row, index_row) = run_commit(
+            state, pod, p, k_gate, active_concat
+        )
+        covered = Srange < k_gate
+        rows = p + Srange
+        out_idx = jnp.where(covered, rows, P + 1)
+        kinds = kinds.at[out_idx].set(kind_row, mode="drop")
+        idxs = idxs.at[out_idx].set(index_row, mode="drop")
+        requeue = covered & (kind_row == KIND_FAIL)
+        frank = jnp.cumsum(requeue.astype(jnp.int32)) - 1
+        nq_idx = jnp.where(requeue, nqlen + frank, P + 1)
+        nq = nq.at[nq_idx].set(rows, mode="drop")
+        nqlen = nqlen + requeue.sum().astype(jnp.int32)
+        noslot = jnp.any(covered & (kind_row == KIND_NO_SLOT))
+        return state, kinds, idxs, nq, nqlen, k_gate, noslot
+
+    def narrow_iter(state, queue, i, qlen, kinds, idxs, nq, nqlen):
+        """One exact narrow step, batched over the strict-identical chain
+        where verdict replication is provable (FAIL/NO_SLOT always;
+        placements while capacity and fewest-pods rank hold and no
+        record->gate feedback is possible)."""
+        p = queue[jnp.clip(i, 0, P - 1)]
+        pod = jax.tree_util.tree_map(lambda a: a[p], pods_xs)
+        ahead = queue[jnp.clip(i + Srange, 0, P - 1)]
+        adj = (ahead == p + Srange) & ((i + Srange) < qlen)
+        succ = jnp.clip(p + Srange, 0, P - 1)
+        strict_chain = lax.cummin(
+            (adj & ((Srange == 0) | eqprev_arr[succ])).astype(jnp.int32)
+        ).astype(bool)
+        k_strict = strict_chain.sum().astype(jnp.int32)
+
+        (
+            any_node,
+            node_pick,
+            node_row,
+            node_fit_count,
+            any_claim,
+            claim_pick,
+            rank2,
+            claim_final,
+            claim_it_ok2,
+            cap_ct_all,
+            cap_c,
+            claim_fit_count,
+            claim_npods0,
+            active,
+        ) = eval_base(state, pod)
+        claim_row = claim_final.row(claim_pick)
+
+        free_slot = _first_true(~state.claim_open)
+        has_slot = jnp.any(~state.claim_open)
+        host_onehot = _mint_host_onehot(problem, free_slot)
+        need_tpl = (~any_node) & (~any_claim) & has_slot & active
+
+        def do_tpl():
+            return eval_tpl_one(state, free_slot, host_onehot, pod)
+
+        def skip_tpl():
+            return (
+                jnp.bool_(False),
+                jnp.int32(0),
+                _zeros_row(),
+                jnp.zeros((R,), problem.tpl_overhead.dtype),
+                jnp.zeros((T,), bool),
+                jnp.zeros((R,), problem.it_cap.dtype),
+            )
+
+        any_tpl, tpl_pick, slot_req, tpl_req_row, tpl_itok, max_cap = lax.cond(
+            need_tpl, do_tpl, skip_tpl
+        )
+
+        kind = jnp.where(
+            any_node,
+            KIND_NODE,
+            jnp.where(
+                any_claim,
+                KIND_CLAIM,
+                jnp.where(
+                    ~has_slot,
+                    KIND_NO_SLOT,
+                    jnp.where(any_tpl, KIND_NEW_CLAIM, KIND_FAIL),
+                ),
+            ),
+        ).astype(jnp.int32)
+        kind = jnp.where(active, kind, KIND_FAIL)
+        index = jnp.where(
+            kind == KIND_NODE,
+            node_pick,
+            jnp.where(
+                kind == KIND_CLAIM,
+                claim_pick,
+                jnp.where(kind == KIND_NEW_CLAIM, free_slot, -1),
+            ),
+        ).astype(jnp.int32)
+        placed = kind < KIND_FAIL
+        is_open = kind == KIND_NEW_CLAIM
+
+        # stacking within a strict-identical chain: FAIL / NO_SLOT verdicts
+        # replicate for free; placed pods stack while record->gate feedback
+        # is impossible (regular affinity groups are monotone-safe; see
+        # _make_stride docstring). Claim placements go further: when no
+        # matched group is positive-empty (no bootstrap in play), the gate
+        # verdicts, capacities, and record deltas of EVERY claim are
+        # invariant across the chain — counts only grow inside domains that
+        # are already positive — so the whole chain waterfills across claims
+        # in closed form (the run commit's fewest-pods math), not just into
+        # the rank-held pick. This is what collapses retried affinity chains
+        # and level-claim generic chains from one iteration per pod to one
+        # per chain.
+        match, selects, owned = pod[7], pod[8], pod[9]
+        if G > 0:
+            aff_safe = (problem.grp_type == 1) & ~problem.grp_inverse
+            sel = match & (selects | owned)
+            stack_safe = ~jnp.any(sel & ~aff_safe)
+            pod_dom = pod[1].admitted[problem.grp_key]  # [G, V] strict pod domains
+            positive_any = jnp.any(
+                state.grp_registered & (state.grp_counts > 0) & pod_dom, axis=-1
+            )
+            fill_safe = stack_safe & jnp.all(~sel | positive_any)
+        else:
+            stack_safe = jnp.bool_(True)
+            fill_safe = jnp.bool_(True)
+        j_rank = jnp.where(
+            kind == KIND_CLAIM,
+            (rank2 - 1 - index) // C - claim_npods0 + 1,
+            jnp.int32(_BIG_CAP),
+        ).astype(jnp.int32)
+        fitc = jnp.where(kind == KIND_NODE, node_fit_count, claim_fit_count)
+        is_claim = kind == KIND_CLAIM
+        use_fill = is_claim & fill_safe & (k_strict > 1)
+
+        def fill_take():
+            """Whole-chain waterfill across all eligible claims — identical
+            math to the run commit's claim phase (and fuzz-anchored through
+            it): pour m pods into the lowest-npods claims bounded by each
+            claim's capacity, index tie-break, then map each ordinal to its
+            temporal claim for the per-pod output rows."""
+            p_lvl = state.claim_npods
+            m = jnp.minimum(k_strict, cap_c.sum()).astype(jnp.int32)
+            L = _water_level(p_lvl, cap_c, m)
+            take0 = jnp.clip(L - p_lvl, 0, cap_c)
+            leftover = m - take0.sum()
+            at_level = (p_lvl + take0 == L) & (take0 < cap_c)
+            extra = at_level & (jnp.cumsum(at_level) <= leftover)
+            take = (take0 + extra.astype(jnp.int32)).astype(jnp.int32)
+            lev = _water_level(p_lvl, take, Srange)
+            before = jnp.sum(
+                jnp.clip(lev[:, None] - p_lvl[None, :], 0, take[None, :]), axis=-1
+            )
+            pos = Srange - before
+            at_lev = (p_lvl[None, :] <= lev[:, None]) & (
+                lev[:, None] < (p_lvl + take)[None, :]
+            )  # [S, C]
+            lev_cum = jnp.cumsum(at_lev, axis=-1)
+            claim_of = jnp.argmax(
+                at_lev & (lev_cum == (pos + 1)[:, None]), axis=-1
+            ).astype(jnp.int32)
+            return take, claim_of, m
+
+        def single_take():
+            k_placed = jnp.where(
+                is_open,
+                1,
+                jnp.where(stack_safe, jnp.minimum(fitc, j_rank), 1),
+            )
+            k1 = jnp.maximum(
+                jnp.minimum(k_strict, jnp.where(placed, k_placed, _BIG_CAP)),
+                1,
+            ).astype(jnp.int32)
+            hot = (jnp.arange(C) == claim_pick) & is_claim
+            take = hot.astype(jnp.int32) * k1
+            claim_of = jnp.full((S,), claim_pick, jnp.int32)
+            return take, claim_of, k1
+
+        claim_take, claim_of, k = lax.cond(use_fill, fill_take, single_take)
+        tookc = claim_take > 0
+
+        # ---- commit k pods across the take-vector of claims (one-hot for
+        # the single-bin case — bit-identical to the former .at[cidx] writes)
+        pod_requests = pod[2]
+        pod_ports = pod[5]
+        pod_vols = pod[10]
+        kf = k.astype(jnp.float32)
+
+        new_claim_req = ReqTensor(
+            admitted=jnp.where(tookc[:, None, None], claim_final.admitted, state.claim_req.admitted),
+            comp=jnp.where(tookc[:, None], claim_final.comp, state.claim_req.comp),
+            gt=jnp.where(tookc[:, None], claim_final.gt, state.claim_req.gt),
+            lt=jnp.where(tookc[:, None], claim_final.lt, state.claim_req.lt),
+            defined=jnp.where(tookc[:, None], claim_final.defined, state.claim_req.defined),
+        )
+        new_claim_requests = (
+            state.claim_requests + claim_take[:, None].astype(jnp.float32) * pod_requests[None, :]
+        )
+        new_claim_it_ok = jnp.where(
+            tookc[:, None],
+            claim_it_ok2 & (cap_ct_all >= claim_take[:, None]),
+            state.claim_it_ok,
+        )
+        new_claim_npods = state.claim_npods + claim_take
+        new_claim_ports = state.claim_used_ports | (
+            tookc[:, None] & pod_ports[None, :]
+        )
+
+        if N > 0:
+            is_node = kind == KIND_NODE
+            nodex = jnp.where(is_node, index, N + 1)
+            new_node_req = ReqTensor(
+                admitted=state.node_req.admitted.at[nodex].set(node_row.admitted, mode="drop"),
+                comp=state.node_req.comp.at[nodex].set(node_row.comp, mode="drop"),
+                gt=state.node_req.gt.at[nodex].set(node_row.gt, mode="drop"),
+                lt=state.node_req.lt.at[nodex].set(node_row.lt, mode="drop"),
+                defined=state.node_req.defined.at[nodex].set(node_row.defined, mode="drop"),
+            )
+            new_node_requests = state.node_requests.at[nodex].add(
+                kf * pod_requests, mode="drop"
+            )
+            new_node_npods = state.node_npods.at[nodex].add(k, mode="drop")
+            new_node_ports = state.node_used_ports.at[nodex].max(pod_ports, mode="drop")
+            new_node_vol = state.node_vol_used.at[nodex].add(k * pod_vols, mode="drop")
+        else:
+            new_node_req = state.node_req
+            new_node_requests = state.node_requests
+            new_node_npods = state.node_npods
+            new_node_ports = state.node_used_ports
+            new_node_vol = state.node_vol_used
+
+        # the (alone-committing) claim-open
+        sidx = jnp.where(is_open, free_slot, C + 1)
+        new_claim_req = ReqTensor(
+            admitted=new_claim_req.admitted.at[sidx].set(slot_req.admitted, mode="drop"),
+            comp=new_claim_req.comp.at[sidx].set(slot_req.comp, mode="drop"),
+            gt=new_claim_req.gt.at[sidx].set(slot_req.gt, mode="drop"),
+            lt=new_claim_req.lt.at[sidx].set(slot_req.lt, mode="drop"),
+            defined=new_claim_req.defined.at[sidx].set(slot_req.defined, mode="drop"),
+        )
+        new_claim_requests = new_claim_requests.at[sidx].set(tpl_req_row, mode="drop")
+        new_claim_it_ok = new_claim_it_ok.at[sidx].set(tpl_itok, mode="drop")
+        new_claim_open = state.claim_open.at[sidx].set(True, mode="drop")
+        new_claim_npods = new_claim_npods.at[sidx].add(1, mode="drop")
+        new_claim_tpl = state.claim_tpl.at[sidx].set(tpl_pick, mode="drop")
+        new_claim_ports = new_claim_ports.at[sidx].max(pod_ports, mode="drop")
+        opened_tpl_hot = (jnp.arange(TPL) == tpl_pick) & is_open
+        new_remaining = jnp.where(
+            opened_tpl_hot[:, None],
+            state.remaining - max_cap[None, :],
+            state.remaining,
+        )
+        new_registered = state.grp_registered | (
+            is_open
+            & mint_hostnames
+            & (problem.grp_key == HOSTNAME_KEY)[:, None]
+            & host_onehot[None, :]
+        )
+
+        # topology record: identical stack members record identical deltas;
+        # the take-vector commit sums each touched claim's own delta (rows
+        # differ only through the claim state they merged into)
+        if G > 0:
+            rec_needed = placed & (jnp.any(selects) | jnp.any(owned))
+
+            def do_record():
+                def fill_deltas():
+                    deltas = vmap(
+                        lambda row: record_delta(
+                            problem, topo_of(pod), row, wellknown, jnp.bool_(True), lv, ln
+                        )
+                    )(claim_final)  # [C, G, V]
+                    counts = jnp.sum(
+                        claim_take[:, None, None] * deltas.astype(jnp.int32), axis=0
+                    )
+                    reg = jnp.any(tookc[:, None, None] & deltas, axis=0)
+                    return counts, reg
+
+                def single_delta():
+                    rec_row = claim_row
+                    rec_row = jax.tree_util.tree_map(
+                        lambda s, c: jnp.where(is_open, s, c), slot_req, rec_row
+                    )
+                    if N > 0:
+                        rec_row = jax.tree_util.tree_map(
+                            lambda n, c: jnp.where(kind == KIND_NODE, n, c),
+                            node_row,
+                            rec_row,
+                        )
+                    allow = jnp.where(kind == KIND_NODE, no_allow, wellknown)
+                    delta = record_delta(
+                        problem, topo_of(pod), rec_row, allow, jnp.bool_(True), lv, ln
+                    )
+                    return k * delta.astype(jnp.int32), delta
+
+                return lax.cond(use_fill, fill_deltas, single_delta)
+
+            counts_add, reg_add = lax.cond(
+                rec_needed,
+                do_record,
+                lambda: (
+                    jnp.zeros((G, V), jnp.int32),
+                    jnp.zeros((G, V), bool),
+                ),
+            )
+            new_counts = state.grp_counts + counts_add
+            new_registered = new_registered | reg_add
+        else:
+            new_counts = state.grp_counts
+
+        new_state = FFDState(
+            claim_req=new_claim_req,
+            claim_requests=new_claim_requests,
+            claim_it_ok=new_claim_it_ok,
+            claim_open=new_claim_open,
+            claim_npods=new_claim_npods,
+            claim_tpl=new_claim_tpl,
+            claim_used_ports=new_claim_ports,
+            node_req=new_node_req,
+            node_requests=new_node_requests,
+            node_npods=new_node_npods,
+            node_used_ports=new_node_ports,
+            node_vol_used=new_node_vol,
+            remaining=new_remaining,
+            grp_counts=new_counts,
+            grp_registered=new_registered,
+        )
+        covered = Srange < k
+        kind_row = jnp.where(covered, kind, KIND_FAIL)
+        # claim placements report each ordinal's own claim (the take-vector
+        # temporal mapping); other kinds share the single chosen index
+        index_row = jnp.where(
+            covered, jnp.where(is_claim, claim_of, index), -1
+        )
+        rows = p + Srange
+        out_idx = jnp.where(covered, rows, P + 1)
+        kinds = kinds.at[out_idx].set(kind_row, mode="drop")
+        idxs = idxs.at[out_idx].set(index_row, mode="drop")
+        requeue = covered & (kind_row == KIND_FAIL)
+        frank = jnp.cumsum(requeue.astype(jnp.int32)) - 1
+        nq_idx = jnp.where(requeue, nqlen + frank, P + 1)
+        nq = nq.at[nq_idx].set(rows, mode="drop")
+        nqlen = nqlen + requeue.sum().astype(jnp.int32)
+        noslot = jnp.any(covered & (kind_row == KIND_NO_SLOT))
+        return new_state, kinds, idxs, nq, nqlen, k, noslot
+
+    return narrow_iter, analytic_iter, chain_ahead
+
+
+def _sweeps_impl(problem: SchedulingProblem, init: FFDState, C: int) -> FFDResult:
+    """All retry passes of a solve in ONE device program.
+
+    The reference's Solve loop requeues failed pods and retries while any
+    placement makes progress (scheduler.go:150-170) — a pod whose required
+    pod-affinity peers were placed later in the queue succeeds on the next
+    pass. The host loop used to pay one device roundtrip per pass; here the
+    requeue-until-no-progress loop IS the program: an outer while over
+    sweeps; inside a sweep, a narrow-step loop walks the compact queue of
+    still-unplaced pods and EXITS at every gate-identical chain boundary,
+    where the closed-form analytic commit (_make_stride's analytic_iter)
+    consumes the whole chain at once. Splitting the two at loop level keeps
+    the narrow body free of a large-state conditional — a per-step
+    lax.cond carrying the full FFDState measured ~80us/step in copies.
+    Relaxation (preferences.py) stays host-side — it mutates pod specs and
+    re-encodes — so a solve with relaxable pods costs one launch per ladder
+    rung, and the common no-relaxation solve costs exactly one.
+
+    Exactness vs the pass-per-launch loop: pods are processed in exactly the
+    sequential queue order — the chain commits are provably equivalent to
+    stepping their members one at a time (waterfill + record sum for
+    topology-blind identical pods; verdict replication for strict-identical
+    pods); KIND_NO_SLOT stops sweeping so the backend's slot-doubling retry
+    sees it at the same pass boundary it used to.
+    """
+    P = problem.num_pods
+    pods_xs = _pod_xs(problem)
+    narrow_iter, analytic_iter, chain_ahead = _make_stride(
+        problem, _statics(problem), C, _STRIDE, pods_xs
+    )
+    active = jnp.asarray(problem.pod_active)
+    # compact initial queue: active rows first, original (FFD) order kept —
+    # padding rows are never stepped at all, so bucket padding costs compile
+    # cache entries but zero runtime
+    queue0 = jnp.argsort(~active, stable=True).astype(jnp.int32)
+    qlen0 = jnp.sum(active).astype(jnp.int32)
+    kinds0 = jnp.full((P,), KIND_FAIL, jnp.int32)
+    idxs0 = jnp.full((P,), -1, jnp.int32)
+
+    def sweep_cond(c):
+        _state, _queue, qlen, _kinds, _idxs, progress, noslot, _it = c
+        return progress & (qlen > 0) & ~noslot
+
+    def sweep_body(c):
+        state, queue, qlen, kinds, idxs, _progress, noslot0, it_ct = c
+        i0 = (
+            jnp.int32(0),
+            state,
+            jnp.zeros((P,), jnp.int32),
+            jnp.int32(0),
+            kinds,
+            idxs,
+            noslot0,
+        )
+
+        if _CHAIN_DISPATCH:
+            # EXPERIMENTAL two-level structure: a narrow-step loop that
+            # exits at gate-identical chain boundaries, with the analytic
+            # waterfill commit consuming each whole chain. Measured on TPU
+            # v5e (10k bench): the extra control flow costs MORE than the
+            # chain commits save — XLA stops keeping the carried FFDState
+            # in place across the nested while/cond boundaries and copies
+            # it per iteration (flat loop 1.03s, this structure 1.43s, the
+            # same chains behind a per-step cond 1.49s). Kept behind
+            # KARPENTER_TPU_CHAIN_DISPATCH=1 for future XLA versions.
+            def seg_cond(sc):
+                i = sc[0]
+                return i < qlen
+
+            def seg_body(sc):
+                i, state, nq, nqlen, kinds, idxs, noslot = sc
+
+                def ncond(nc):
+                    i = nc[0]
+                    p = queue[jnp.clip(i, 0, P - 1)]
+                    return (i < qlen) & ~chain_ahead(queue, i, qlen, p)
+
+                def nbody(nc):
+                    i, state, nq, nqlen, kinds, idxs, noslot = nc
+                    state, kinds, idxs, nq, nqlen, k, nosl = narrow_iter(
+                        state, queue, i, qlen, kinds, idxs, nq, nqlen
+                    )
+                    return i + k, state, nq, nqlen, kinds, idxs, noslot | nosl
+
+                i, state, nq, nqlen, kinds, idxs, noslot = lax.while_loop(
+                    ncond, nbody, (i, state, nq, nqlen, kinds, idxs, noslot)
+                )
+
+                def do_chain():
+                    st, kk, ii, q, ql, k, nosl = analytic_iter(
+                        state, queue, i, qlen, kinds, idxs, nq, nqlen
+                    )
+                    return i + k, st, q, ql, kk, ii, noslot | nosl
+
+                def no_chain():
+                    return i, state, nq, nqlen, kinds, idxs, noslot
+
+                return lax.cond(i < qlen, do_chain, no_chain)
+
+            _i, state, nq, nqlen, kinds, idxs, noslot = lax.while_loop(
+                seg_cond, seg_body, i0
+            )
+            it_ct = it_ct + 1  # per-sweep granularity only on this path
+        else:
+            # flat production loop: ONE iteration shape, no in-loop
+            # branching over the carried state — XLA keeps every FFDState
+            # buffer in place across iterations
+            def inner_cond(ic):
+                i = ic[0]
+                return i < qlen
+
+            def inner_body(ic):
+                i, state, nq, nqlen, kinds, idxs, noslot, n_it = ic
+                state, kinds, idxs, nq, nqlen, k, nosl = narrow_iter(
+                    state, queue, i, qlen, kinds, idxs, nq, nqlen
+                )
+                return i + k, state, nq, nqlen, kinds, idxs, noslot | nosl, n_it + 1
+
+            _i, state, nq, nqlen, kinds, idxs, noslot, it_ct = lax.while_loop(
+                inner_cond, inner_body, i0 + (it_ct,)
+            )
+        progress = nqlen < qlen
+        # iters[1] counts sweeps in the low bits: encode as it_ct plus a
+        # sweep counter carried in the same scalar is not worth the reshape —
+        # carry the pair explicitly instead
+        return state, nq, nqlen, kinds, idxs, progress, noslot, it_ct
+
+    n_sweeps0 = jnp.int32(0)
+
+    def sweep_cond2(c):
+        return sweep_cond(c[:-1])
+
+    def sweep_body2(c):
+        out = sweep_body(c[:-1])
+        return out + (c[-1] + 1,)
+
+    state, _queue, _qlen, kinds, idxs, _prog, _noslot, n_iters, n_sweeps = (
+        lax.while_loop(
+            sweep_cond2,
+            sweep_body2,
+            (init, queue0, qlen0, kinds0, idxs0, jnp.bool_(True), jnp.bool_(False),
+             jnp.int32(0), n_sweeps0),
+        )
+    )
+    return FFDResult(
+        kind=kinds, index=idxs, state=state,
+        iters=jnp.stack([n_iters, n_sweeps]),
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _solve_ffd_sweeps_fresh_jit(problem: SchedulingProblem, max_claims: int) -> FFDResult:
+    problem = _pad_lanes_mult32(problem)
+    return _sweeps_impl(problem, initial_state(problem, max_claims), max_claims)
+
+
+def solve_ffd_sweeps(
+    problem: SchedulingProblem, max_claims: int, init: Optional[FFDState] = None
+) -> FFDResult:
+    """Run ALL retry passes to convergence in one device launch (see
+    _sweeps_impl). The production provisioning entrypoint. Always starts from
+    a fresh state: the backend's sweeps mode never carries state across
+    launches (nothing is relaxable, so there is no second launch)."""
+    assert init is None, "sweeps mode always runs a whole solve in one launch"
+    return _solve_ffd_sweeps_fresh_jit(problem, max_claims)

@@ -118,6 +118,10 @@ class JaxSolver(SolverBackend):
         from karpenter_tpu.utils.jaxtools import enable_compilation_cache
 
         enable_compilation_cache()
+        # [narrow iterations, sweeps] of the LAST sweeps-mode solve; None
+        # before any, and reset by non-sweeps solves so stale counts are
+        # never misattributed
+        self.last_iters = None
         self.well_known = (
             well_known if well_known is not None else wk.WELL_KNOWN_LABELS
         )
@@ -273,19 +277,24 @@ class JaxSolver(SolverBackend):
             # exits after this pass, so the final-decode state rides the same
             # roundtrip.
             if use_sweeps:
-                kinds, indices, *np_final = jax.device_get(
+                kinds, indices, _iters, *np_final = jax.device_get(
                     (
                         result.kind,
                         result.index,
+                        result.iters,
                         state.claim_open,
                         state.claim_tpl,
                         state.claim_it_ok,
                         state.claim_requests,
                     )
                 )
+                # [narrow iterations, sweeps] — the device-cost diagnostic
+                # (rides the same roundtrip; see FFDResult.iters)
+                self.last_iters = (int(_iters[0]), int(_iters[1]))
             else:
                 kinds, indices = jax.device_get((result.kind, result.index))
                 np_final = None
+                self.last_iters = None
             t0 = _t("device-solve", t0)
             if (kinds[: len(queue)] == KIND_NO_SLOT).any():
                 raise _SlotOverflow()

@@ -39,6 +39,7 @@ def prewarm_solver(
     pod_buckets: Sequence[int] = (9, 33),
     instance_types_n: int = 100,
     max_pods: int = 0,
+    catalog=None,
 ) -> int:
     """Compile the small standard buckets (pow2 pads: 16 and 64 pods) with
     and without topology interaction, plus — when ``max_pods`` is set (the
@@ -46,12 +47,13 @@ def prewarm_solver(
     number of batches solved. Safe to call from a background thread; failures
     are swallowed — warming is an optimization, never a liveness dependency.
 
-    The warm uses a synthetic instance-type catalog and pod family, so it
-    covers exactly the synthetic shape buckets: a production batch whose
-    padded lane/type buckets differ still compiles its own executables on
-    first contact (the persistent cache then keeps them across processes).
-    Pass the live catalog via ``instance_types_n``-shaped data when exactness
-    matters more than startup cost."""
+    By default the warm uses a synthetic instance-type catalog, which covers
+    only the synthetic shape buckets: a production batch whose padded
+    lane/type buckets differ still compiles its own executables on first
+    contact. Pass ``catalog`` (the operator's LIVE instance types, as
+    maybe_prewarm_in_background does) to warm the exact lane/type buckets
+    production encodings will hit — the advisor-r3 gap where synthetic
+    warming missed the real workload's shapes."""
     import random
 
     from karpenter_tpu.apis import labels as wk
@@ -70,7 +72,7 @@ def prewarm_solver(
 
     if solver is None:
         solver = JaxSolver()
-    its = instance_types(instance_types_n)
+    its = catalog if catalog else instance_types(instance_types_n)
     tpl = template_from_nodepool(
         NodePool(metadata=ObjectMeta(name="prewarm")), its, range(len(its))
     )
@@ -169,7 +171,7 @@ def _on_accelerator() -> bool:
         return False
 
 
-def maybe_prewarm_in_background(options) -> Optional["object"]:
+def maybe_prewarm_in_background(options, cloud_provider=None) -> Optional["object"]:
     """Operator.start() hook: warm in a daemon thread when enabled, the
     persistent cache is active, and an accelerator backend is attached. CPU
     runs skip — production CPU operators still benefit from the on-disk cache
@@ -177,7 +179,10 @@ def maybe_prewarm_in_background(options) -> Optional["object"]:
     place start() runs on CPU today) must not burn the single-core host on
     background compiles. The platform probe (jax.devices() forces PJRT
     backend init, seconds on a tunneled TPU) runs INSIDE the daemon thread so
-    start() never blocks on it."""
+    start() never blocks on it.
+
+    When a ``cloud_provider`` is given, its live catalog drives the warm so
+    the compiled lane/type buckets match what production encodings request."""
     import threading
 
     if not getattr(options, "prewarm_solver", True):
@@ -187,8 +192,24 @@ def maybe_prewarm_in_background(options) -> Optional["object"]:
 
     def probe_then_warm():
         if _on_accelerator():
+            catalog = None
+            if cloud_provider is not None:
+                try:
+                    catalog = cloud_provider.get_instance_types(None)
+                except Exception:
+                    # synthetic shapes still warm the machinery, but the
+                    # production lane/type buckets will recompile on first
+                    # contact — make the downgrade visible
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "prewarm: live catalog unavailable, warming synthetic "
+                        "shape buckets only", exc_info=True
+                    )
+                    catalog = None
             prewarm_solver(
-                max_pods=getattr(options, "prewarm_max_pods", 0)
+                max_pods=getattr(options, "prewarm_max_pods", 0),
+                catalog=catalog,
             )
             n_screen = getattr(options, "prewarm_screen_candidates", 0)
             if n_screen:

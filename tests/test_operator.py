@@ -238,3 +238,57 @@ def test_pod_startup_time_histogram_observed_once():
     exporter.reconcile()  # second scan must not re-observe
     assert POD_STARTUP_TIME.count() == count0 + 1
     assert abs(POD_STARTUP_TIME.sum() - sum0 - 42.0) < 1e-6
+
+
+def test_prewarm_uses_live_catalog():
+    """prewarm_solver(catalog=...) warms the operator's real instance types
+    (advisor r3: synthetic warming missed production lane/type buckets), and
+    the operator hook passes its cloud provider's catalog through."""
+    from karpenter_tpu.cloudprovider.fake import make_instance_type
+    from karpenter_tpu.solver import warmup
+    from karpenter_tpu.utils import resources as res
+
+    seen = []
+
+    class CapturingSolver:
+        def solve(self, pods, its, tpls, **kw):
+            seen.append([it.name for it in its])
+
+            class R:
+                def num_scheduled(self):
+                    return len(pods)
+
+            return R()
+
+    live = [make_instance_type("live-it", resources={res.CPU: 3.0})]
+    warmup.prewarm_solver(solver=CapturingSolver(), catalog=live)
+    assert seen and all(names == ["live-it"] for names in seen)
+
+    # the operator hook end-to-end: its (metrics-decorated) cloud provider's
+    # catalog reaches prewarm_solver — guard the plumbing, not just the knob
+    captured = {}
+
+    def fake_prewarm(max_pods=0, catalog=None):
+        captured["catalog"] = catalog
+
+    op, _clock = make_operator()
+    orig_prewarm = warmup.prewarm_solver
+    orig_cache = warmup.persistent_cache_enabled
+    orig_accel = warmup._on_accelerator
+    warmup.prewarm_solver = fake_prewarm
+    warmup.persistent_cache_enabled = lambda: True
+    warmup._on_accelerator = lambda: True
+    try:
+        t = warmup.maybe_prewarm_in_background(
+            Options(solver_backend="jax"), op.cloud_provider
+        )
+        assert t is not None
+        t.join(timeout=10)
+    finally:
+        warmup.prewarm_solver = orig_prewarm
+        warmup.persistent_cache_enabled = orig_cache
+        warmup._on_accelerator = orig_accel
+    assert captured["catalog"] is not None
+    assert {it.name for it in captured["catalog"]} == {
+        it.name for it in op.cloud_provider.get_instance_types(None)
+    }
