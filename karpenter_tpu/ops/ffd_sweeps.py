@@ -45,6 +45,7 @@ from karpenter_tpu.ops.ffd_core import (  # noqa: F401
     _intersect_rows,
     _make_it_gate,
     _mint_host_onehot,
+    _offer_rows,
     _pad_lanes_mult32,
     _pod_xs,
     _statics,
@@ -228,22 +229,42 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
         claim_fit_count = cap_c[claim_pick]
         claim_npods0 = state.claim_npods[claim_pick]
 
-        return (
-            any_node,
-            node_pick.astype(jnp.int32),
-            node_final_row,
-            node_fit_count,
-            any_claim,
-            claim_pick.astype(jnp.int32),
-            rank2.astype(jnp.int32),
-            claim_final,
-            claim_it_ok2,
-            cap_ct_all,
-            cap_c,
-            claim_fit_count,
-            claim_npods0,
-            pod_is_active,
+        # pre-topology eligibility + whether ANY node passes its static
+        # (counter-independent) gates — the spread mini-fill needs both:
+        # topo-blocked claims can become eligible as counts shift mid-chain,
+        # and a single statically-eligible node forces the per-pod path
+        # (rising global-min can unblock a node's domain, and nodes outrank
+        # claims)
+        claim_ok_pre = (
+            state.claim_open
+            & tol_tpl[state.claim_tpl]
+            & claim_port_ok
+            & claim_compat
         )
+        node_static_any = jnp.any(
+            tol_node & node_fit & node_compat & node_port_ok & node_vol_ok
+        )
+
+        return {
+            "any_node": any_node,
+            "node_pick": node_pick.astype(jnp.int32),
+            "node_row": node_final_row,
+            "node_fit_count": node_fit_count,
+            "any_claim": any_claim,
+            "claim_pick": claim_pick.astype(jnp.int32),
+            "rank2": rank2.astype(jnp.int32),
+            "claim_final": claim_final,
+            "claim_merged": claim_merged,
+            "claim_it_ok2": claim_it_ok2,
+            "cap_ct_all": cap_ct_all,
+            "cap_c": cap_c,
+            "claim_fit_count": claim_fit_count,
+            "claim_npods0": claim_npods0,
+            "claim_ok_pre": claim_ok_pre,
+            "claim_topo_ok": claim_topo_ok,
+            "node_static_any": node_static_any,
+            "active": pod_is_active,
+        }
 
     def eval_tpl_one(state: FFDState, free_slot, host_onehot, pod):
         pod_req, pod_requests, tol_tpl = pod[0], pod[2], pod[3]
@@ -330,22 +351,21 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
         ).astype(bool)
         k_strict = strict_chain.sum().astype(jnp.int32)
 
-        (
-            any_node,
-            node_pick,
-            node_row,
-            node_fit_count,
-            any_claim,
-            claim_pick,
-            rank2,
-            claim_final,
-            claim_it_ok2,
-            cap_ct_all,
-            cap_c,
-            claim_fit_count,
-            claim_npods0,
-            active,
-        ) = eval_base(state, pod)
+        ev = eval_base(state, pod)
+        any_node = ev["any_node"]
+        node_pick = ev["node_pick"]
+        node_row = ev["node_row"]
+        node_fit_count = ev["node_fit_count"]
+        any_claim = ev["any_claim"]
+        claim_pick = ev["claim_pick"]
+        rank2 = ev["rank2"]
+        claim_final = ev["claim_final"]
+        claim_it_ok2 = ev["claim_it_ok2"]
+        cap_ct_all = ev["cap_ct_all"]
+        cap_c = ev["cap_c"]
+        claim_fit_count = ev["claim_fit_count"]
+        claim_npods0 = ev["claim_npods0"]
+        active = ev["active"]
         claim_row = claim_final.row(claim_pick)
 
         free_slot = _first_true(~state.claim_open)
@@ -418,9 +438,40 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
                 state.grp_registered & (state.grp_counts > 0) & pod_dom, axis=-1
             )
             fill_safe = stack_safe & jnp.all(~sel | positive_any)
+            # spread mini-fill preconditions: exactly ONE matched group, a
+            # regular spread with no node-filter, nothing owned — then the
+            # chain's own gates read only that group's counters and the
+            # (counts, npods, caps, pins) mini-state simulates the sequential
+            # loop exactly (see spread_take)
+            spread_pod = (
+                (match.sum() == 1)
+                & jnp.any(match & (problem.grp_type == 0))
+                & ~jnp.any(match & problem.grp_has_filter)
+                & ~jnp.any(match & problem.grp_inverse)
+                # owning the matched spread group is the normal case; what
+                # the mini-sim cannot model is ownership of anything ELSE
+                # (inverse anti-affinity groups record via owned)
+                & ~jnp.any(owned & ~match)
+                & ~jnp.any(owned & problem.grp_inverse)
+            )
+            key_onehot_g = (
+                (problem.grp_key[:, None] == jnp.arange(K)[None, :]) & match[:, None]
+            ).any(axis=0)  # [K]
+            reg_g = (match[:, None] & state.grp_registered).any(axis=0)  # [V]
+            counts_g0 = (match[:, None] * state.grp_counts).sum(axis=0)  # [V]
+            pod_dom_g = (match[:, None] & pod_dom).any(axis=0)  # [V]
+            lex_g = jnp.einsum(
+                "k,kv->v", key_onehot_g.astype(jnp.int32),
+                jnp.asarray(problem.lane_lex_rank), preferred_element_type=jnp.int32
+            )
+            skew_g = (match * problem.grp_max_skew).sum()
+            md_g = jnp.max(jnp.where(match, problem.grp_min_domains, -1))
+            s_gi = jnp.any(match & selects).astype(jnp.int32)
+            is_host_g = jnp.any(match & (problem.grp_key == HOSTNAME_KEY))
         else:
             stack_safe = jnp.bool_(True)
             fill_safe = jnp.bool_(True)
+            spread_pod = jnp.bool_(False)
         j_rank = jnp.where(
             kind == KIND_CLAIM,
             (rank2 - 1 - index) // C - claim_npods0 + 1,
@@ -429,6 +480,34 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
         fitc = jnp.where(kind == KIND_NODE, node_fit_count, claim_fit_count)
         is_claim = kind == KIND_CLAIM
         use_fill = is_claim & fill_safe & (k_strict > 1)
+        use_spread = (
+            is_claim
+            & spread_pod
+            & ~ev["node_static_any"]
+            & (k_strict > 1)
+            & ~use_fill
+        )
+
+        no_pin = jnp.full((C,), -1, jnp.int32)
+
+        def _single_outputs():
+            k_placed = jnp.where(
+                is_open,
+                1,
+                jnp.where(stack_safe, jnp.minimum(fitc, j_rank), 1),
+            )
+            k1 = jnp.maximum(
+                jnp.minimum(k_strict, jnp.where(placed, k_placed, _BIG_CAP)),
+                1,
+            ).astype(jnp.int32)
+            hot = (jnp.arange(C) == claim_pick) & is_claim
+            take = hot.astype(jnp.int32) * k1
+            claim_of = jnp.full((S,), claim_pick, jnp.int32)
+            return take, claim_of, k1
+
+        def single_take():
+            take, claim_of, k1 = _single_outputs()
+            return take, claim_of, k1, no_pin, jnp.bool_(False)
 
         def fill_take():
             """Whole-chain waterfill across all eligible claims — identical
@@ -456,24 +535,150 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
             claim_of = jnp.argmax(
                 at_lev & (lev_cum == (pos + 1)[:, None]), axis=-1
             ).astype(jnp.int32)
-            return take, claim_of, m
+            return take, claim_of, m, no_pin, jnp.bool_(True)
 
-        def single_take():
-            k_placed = jnp.where(
-                is_open,
-                1,
-                jnp.where(stack_safe, jnp.minimum(fitc, j_rank), 1),
+        def spread_take():
+            """Whole-chain commit for identical SPREAD pods: a mini-scan over
+            the chain simulates the sequential dynamics — per pod: recompute
+            the group's global min and within-skew set from the live counts,
+            each claim's best (lowest-count, lex tie-break) lane among its
+            own admitted lanes (topologygroup.go:163-213), fewest-pods pick
+            among passing claims, then count/pin/level updates — but carries
+            only (counts[V], npods[C], cap[C], lanes[C,V]) instead of the
+            full FFDState, so the flat loop's buffer reuse is untouched.
+
+            Exactness guards (any failure falls back to the single-pod
+            path): instance-type survival and capacity must be LANE-
+            INSENSITIVE — every relevant instance type admits & offers every
+            pinnable lane (checked against the same masks kernels via V
+            synthetic single-lane rows) — and the mini-sim's first pick must
+            agree with the full gate's pick."""
+            merged = ev["claim_merged"]
+            # only CHAIN-START-ELIGIBLE claims are filled (cap_c > 0 iff the
+            # full gate passed AND capacity remains), so the outer it-ok
+            # write (claim_it_ok2 & cap>=take) stays exact. Topo-BLOCKED
+            # claims that would become eligible as counts shift are handled
+            # by the prefix cut below: the sim stops just before the first
+            # pod a resurrected claim would win, and the next narrow
+            # iteration (the ground truth) places it.
+            cap0 = cap_c
+
+            # lane-insensitivity via the real kernels on V synthetic rows
+            eyeV = jnp.eye(V, dtype=bool)
+            syn = ReqTensor(
+                admitted=jnp.where(
+                    key_onehot_g[None, :, None],
+                    eyeV[:, None, :],
+                    jnp.asarray(problem.lane_valid)[None, :, :],
+                ),
+                comp=jnp.broadcast_to(~key_onehot_g, (V, K)),
+                gt=jnp.full((V, K), -(2**31) + 1, jnp.int32),
+                lt=jnp.full((V, K), 2**31 - 1, jnp.int32),
+                defined=jnp.broadcast_to(key_onehot_g, (V, K)),
             )
-            k1 = jnp.maximum(
-                jnp.minimum(k_strict, jnp.where(placed, k_placed, _BIG_CAP)),
-                1,
-            ).astype(jnp.int32)
-            hot = (jnp.arange(C) == claim_pick) & is_claim
-            take = hot.astype(jnp.int32) * k1
-            claim_of = jnp.full((S,), claim_pick, jnp.int32)
-            return take, claim_of, k1
+            syn_packed = masks.pack_lanes(syn.admitted)
+            syn_neg = vmap(lambda r: masks.negative_polarity(r, lv, ln))(syn)
+            kg_ok = masks.packed_pairwise_compat(
+                syn, syn_packed, syn_neg, problem.it_reqs, it_packed, it_neg
+            ) & _offer_rows(problem, syn.admitted)  # [V, T]
+            relevant_t = jnp.any(claim_it_ok2, axis=0)
+            pinnable = pod_dom_g & reg_g
+            insensitive = ~jnp.any(
+                relevant_t[None, :] & pinnable[:, None] & ~kg_ok
+            )
 
-        claim_take, claim_of, k = lax.cond(use_fill, fill_take, single_take)
+            sup_mask = reg_g & pod_dom_g
+            gmin_zero = is_host_g | (
+                (md_g >= 0) & (sup_mask.sum() < md_g)
+            )
+            lanes0 = (merged.admitted & key_onehot_g[None, :, None]).any(axis=1)
+            # claims the sim must WATCH but never fill: pre-gates pass, the
+            # topo gate failed at chain start, and a within-skew lane could
+            # appear (conservative: capacity unknown without the merged-row
+            # IT product, so any such claim winning the rank cuts the chain)
+            resurrect = ev["claim_ok_pre"] & ~ev["claim_topo_ok"]
+            idxC = jnp.arange(C)
+            MAXI = jnp.int32(2**31 - 1)
+            lexv = jnp.minimum(lex_g, V - 1)
+
+            # a while_loop, NOT a fixed-S scan: chains average a handful of
+            # pods and every mini-step is a burst of tiny kernels — running
+            # only the chain's own steps keeps the commit latency
+            # proportional to the chain, and the carry is small (no FFDState
+            # buffers cross this boundary)
+            def mini_cond(c):
+                s, _counts, _npods, _cap, _lanes, alive, _picks = c
+                return alive & (s < k_strict)
+
+            def mini_body(c):
+                s, counts, npods_c, cap, lanes, alive, picks = c
+                sup_counts = jnp.where(sup_mask, counts, MAXI)
+                gmin = jnp.where(gmin_zero, 0, jnp.min(sup_counts))
+                self_cnt = counts + s_gi
+                within = (self_cnt - gmin) <= skew_g
+                elig = lanes & (reg_g & within)[None, :]
+                any_lane = jnp.any(elig, axis=-1)
+                okc = any_lane & (cap > 0)
+                prio = jnp.where(okc, npods_c * C + idxC, _BIG)
+                pick = jnp.argmin(prio)
+                # a chain-start-blocked claim now has an allowed lane AND
+                # outranks every fillable claim: stop — the next narrow
+                # iteration re-evaluates it with full gates
+                res_prio = jnp.where(resurrect & any_lane, npods_c * C + idxC, _BIG)
+                cut = jnp.min(res_prio) < jnp.min(jnp.where(okc, prio, _BIG))
+                do = jnp.any(okc) & ~cut
+                rank = jnp.where(elig, self_cnt[None, :] * V + lexv[None, :], MAXI)
+                a = jnp.argmin(jnp.where(elig[pick], rank[pick], MAXI))
+                lane_onehot = jnp.arange(V) == a
+                counts = counts + jnp.where(do, s_gi, 0) * lane_onehot.astype(jnp.int32)
+                hot = (idxC == pick) & do
+                npods_c = npods_c + hot
+                cap = cap - hot
+                lanes = jnp.where(hot[:, None], lane_onehot[None, :], lanes)
+                picks = picks.at[s].set(jnp.where(do, pick, -1))
+                return (s + 1, counts, npods_c, cap, lanes, do, picks)
+
+            _s, _cf, _nf, _capf, lanes_f, _alive, picks = lax.while_loop(
+                mini_cond,
+                mini_body,
+                (
+                    jnp.int32(0),
+                    counts_g0,
+                    state.claim_npods,
+                    cap0,
+                    lanes0,
+                    jnp.bool_(True),
+                    jnp.full((S,), -1, jnp.int32),
+                ),
+            )
+            take = jnp.sum(
+                (picks[:, None] == idxC[None, :]) & (picks >= 0)[:, None], axis=0
+            ).astype(jnp.int32)
+            k_sp = (picks >= 0).sum().astype(jnp.int32)
+            pin = jnp.where(
+                take > 0, jnp.argmax(lanes_f, axis=-1).astype(jnp.int32), -1
+            )
+            fallback = ~insensitive | (k_sp == 0) | (picks[0] != claim_pick)
+            s_take, s_of, s_k = _single_outputs()
+            take = jnp.where(fallback, s_take, take)
+            claim_of = jnp.where(
+                fallback, s_of, jnp.maximum(picks, 0).astype(jnp.int32)
+            )
+            k_out = jnp.where(fallback, s_k, k_sp)
+            pin = jnp.where(fallback, no_pin, pin)
+            return take, claim_of, k_out, pin, ~fallback
+
+        if G > 0:
+            branch = use_fill.astype(jnp.int32) + 2 * use_spread.astype(jnp.int32)
+            claim_take, claim_of, k, claim_pin, multi_commit = lax.switch(
+                branch, (single_take, fill_take, spread_take)
+            )
+        else:
+            # no topology groups: spread_take's free variables don't exist
+            # (and the branch can never fire) — keep the two-way dispatch
+            claim_take, claim_of, k, claim_pin, multi_commit = lax.cond(
+                use_fill, fill_take, single_take
+            )
         tookc = claim_take > 0
 
         # ---- commit k pods across the take-vector of claims (one-hot for
@@ -483,12 +688,33 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
         pod_vols = pod[10]
         kf = k.astype(jnp.float32)
 
+        # committed rows: claim_final, except spread-pinned claims whose
+        # group-key row is replaced by the mini-sim's final lane (the gate
+        # narrowing the sequential loop would have applied at take time)
+        if G > 0:
+            pinned = claim_pin >= 0
+            pin_hot = jnp.arange(V)[None, :] == claim_pin[:, None]  # [C, V]
+            committed_admitted = jnp.where(
+                (pinned[:, None] & key_onehot_g[None, :])[:, :, None],
+                pin_hot[:, None, :],
+                claim_final.admitted,
+            )
+            committed = ReqTensor(
+                admitted=committed_admitted,
+                comp=claim_final.comp,
+                gt=claim_final.gt,
+                lt=claim_final.lt,
+                defined=claim_final.defined,
+            )
+        else:
+            committed = claim_final
+
         new_claim_req = ReqTensor(
-            admitted=jnp.where(tookc[:, None, None], claim_final.admitted, state.claim_req.admitted),
-            comp=jnp.where(tookc[:, None], claim_final.comp, state.claim_req.comp),
-            gt=jnp.where(tookc[:, None], claim_final.gt, state.claim_req.gt),
-            lt=jnp.where(tookc[:, None], claim_final.lt, state.claim_req.lt),
-            defined=jnp.where(tookc[:, None], claim_final.defined, state.claim_req.defined),
+            admitted=jnp.where(tookc[:, None, None], committed.admitted, state.claim_req.admitted),
+            comp=jnp.where(tookc[:, None], committed.comp, state.claim_req.comp),
+            gt=jnp.where(tookc[:, None], committed.gt, state.claim_req.gt),
+            lt=jnp.where(tookc[:, None], committed.lt, state.claim_req.lt),
+            defined=jnp.where(tookc[:, None], committed.defined, state.claim_req.defined),
         )
         new_claim_requests = (
             state.claim_requests + claim_take[:, None].astype(jnp.float32) * pod_requests[None, :]
@@ -566,7 +792,7 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
                         lambda row: record_delta(
                             problem, topo_of(pod), row, wellknown, jnp.bool_(True), lv, ln
                         )
-                    )(claim_final)  # [C, G, V]
+                    )(committed)  # [C, G, V]
                     counts = jnp.sum(
                         claim_take[:, None, None] * deltas.astype(jnp.int32), axis=0
                     )
@@ -590,7 +816,7 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
                     )
                     return k * delta.astype(jnp.int32), delta
 
-                return lax.cond(use_fill, fill_deltas, single_delta)
+                return lax.cond(multi_commit, fill_deltas, single_delta)
 
             counts_add, reg_add = lax.cond(
                 rec_needed,

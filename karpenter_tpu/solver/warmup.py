@@ -191,29 +191,36 @@ def maybe_prewarm_in_background(options, cloud_provider=None) -> Optional["objec
         return None
 
     def probe_then_warm():
-        if _on_accelerator():
-            catalog = None
-            if cloud_provider is not None:
-                try:
-                    catalog = cloud_provider.get_instance_types(None)
-                except Exception:
-                    # synthetic shapes still warm the machinery, but the
-                    # production lane/type buckets will recompile on first
-                    # contact — make the downgrade visible
-                    import logging
+        import logging
 
-                    logging.getLogger(__name__).warning(
-                        "prewarm: live catalog unavailable, warming synthetic "
-                        "shape buckets only", exc_info=True
-                    )
-                    catalog = None
+        log = logging.getLogger(__name__)
+        if not _on_accelerator():
+            return
+        catalog = None
+        if cloud_provider is not None:
+            try:
+                catalog = cloud_provider.get_instance_types(None)
+            except Exception:
+                # synthetic shapes still warm the machinery, but the
+                # production lane/type buckets will recompile on first
+                # contact — make the downgrade visible
+                log.warning(
+                    "prewarm: live catalog unavailable, warming synthetic "
+                    "shape buckets only", exc_info=True
+                )
+        try:
+            # warming is an optimization, never a liveness dependency — a
+            # catalog the encoder rejects must not kill the thread or skip
+            # the screen warm below
             prewarm_solver(
                 max_pods=getattr(options, "prewarm_max_pods", 0),
                 catalog=catalog,
             )
-            n_screen = getattr(options, "prewarm_screen_candidates", 0)
-            if n_screen:
-                prewarm_screen(n_screen)
+        except Exception:
+            log.warning("prewarm: solver warm failed", exc_info=True)
+        n_screen = getattr(options, "prewarm_screen_candidates", 0)
+        if n_screen:
+            prewarm_screen(n_screen)
 
     t = threading.Thread(
         target=probe_then_warm, daemon=True, name="karpenter-tpu/solver-prewarm"
