@@ -23,6 +23,7 @@ from karpenter_tpu.cloudprovider.types import CloudProvider, NodeClaimNotFoundEr
 from karpenter_tpu.events import Recorder
 from karpenter_tpu.kube.client import KubeClient
 from karpenter_tpu.metrics import REGISTRY
+from karpenter_tpu.scheduling.taints import Taints
 from karpenter_tpu.state.statenode import disruption_taint
 from karpenter_tpu.utils import pod as podutil
 from karpenter_tpu.utils.clock import Clock
@@ -77,10 +78,21 @@ class NodeTerminationController:
             return "skip"
         if wk.TERMINATION_FINALIZER not in node.metadata.finalizers:
             return "skip"
+        self._delete_node_claims(node)
         self._ensure_taint(node)
         if self._drain(node):
+            # a vanished instance can never finish draining: kubelet is gone,
+            # pods will never leave — take the finalizer off now
+            # (termination/controller.go:90-97)
+            if node.spec.provider_id and not self._instance_exists(node):
+                self._remove_finalizer(node)
+                return "done"
             return "draining"
         self._delete_instance(node)
+        self._remove_finalizer(node)
+        return "done"
+
+    def _remove_finalizer(self, node: Node) -> None:
         deleted_at = node.metadata.deletion_timestamp
         self.kube.patch(
             node,
@@ -90,12 +102,41 @@ class NodeTerminationController:
             ),
         )
         TERMINATION_DURATION.observe(self.clock.now() - deleted_at)
-        return "done"
+
+    def _delete_node_claims(self, node: Node) -> None:
+        """Deleting the node deletes its claims too, so the claim-side
+        finalizer runs in parallel (termination/controller.go:109-120)."""
+        if not node.spec.provider_id:
+            return
+        for claim in self.kube.list(
+            NodeClaim,
+            predicate=lambda c: c.status.provider_id == node.spec.provider_id,
+        ):
+            if claim.metadata.deletion_timestamp is None:
+                self.kube.delete(NodeClaim, claim.metadata.name, "")
+
+    def _instance_exists(self, node: Node) -> bool:
+        try:
+            self.cloud_provider.get(node.spec.provider_id)
+            return True
+        except NodeClaimNotFoundError:
+            return False
 
     def _ensure_taint(self, node: Node) -> None:
         taint = disruption_taint()
-        if not any(t.match(taint) for t in node.spec.taints):
-            self.kube.patch(node, lambda n: n.spec.taints.append(taint))
+
+        def apply(n):
+            if not any(t.match(taint) for t in n.spec.taints):
+                n.spec.taints.append(taint)
+            # pull the node out of load-balancer target groups while it
+            # drains (terminator.go:64-70)
+            n.metadata.labels[wk.LABEL_NODE_EXCLUDE_DISRUPTION] = "karpenter"
+
+        if (
+            not any(t.match(taint) for t in node.spec.taints)
+            or node.metadata.labels.get(wk.LABEL_NODE_EXCLUDE_DISRUPTION) != "karpenter"
+        ):
+            self.kube.patch(node, apply)
 
     def _drain(self, node: Node) -> bool:
         """One drain pass; True while pods remain (terminator.go:81-147).
@@ -107,16 +148,30 @@ class NodeTerminationController:
         pods = self.kube.list(
             Pod, predicate=lambda p: p.spec.node_name == node.metadata.name
         )
-        evictable: List[Pod] = []
+        waiting: List[Pod] = []
+        disruption_taints = Taints([disruption_taint()])
         for p in pods:
             if podutil.is_owned_by_node(p):  # static pods die with the node
                 continue
-            if podutil.is_terminal(p) or podutil.is_terminating(p):
+            if podutil.is_terminal(p):
                 continue
-            evictable.append(p)
-        if not evictable:
+            # pods tolerating the disruption taint opted in to riding the
+            # node down (terminator.go:91-92)
+            if not disruption_taints.tolerates(p):
+                continue
+            # kubelet partitioned: a pod a minute past its deletion stamp
+            # will never confirm — stop waiting on it (terminator.go:149-154)
+            if (
+                podutil.is_terminating(p)
+                and self.clock.now() > p.metadata.deletion_timestamp + 60.0
+            ):
+                continue
+            waiting.append(p)
+        if not waiting:
             return False
-        # ordered groups: the first non-empty group drains before later ones
+        evictable = [p for p in waiting if not podutil.is_terminating(p)]
+        # ordered groups: the first non-empty group drains before later ones;
+        # already-terminating pods keep the drain open without re-enqueueing
         groups = [
             [p for p in evictable if not _is_critical(p) and not _is_daemon(p)],
             [p for p in evictable if not _is_critical(p) and _is_daemon(p)],

@@ -163,6 +163,19 @@ def simulate_scheduling(
             domains=inputs.domains,
             pod_volumes=inputs.pod_volumes,
         )
+    # a delete assumes the candidate's pods move IMMEDIATELY; a placement on
+    # a not-yet-initialized or not-Ready node can't honor that, so those pods
+    # count as failures (helpers.go:116-124)
+    state_by_name = {sn.name: sn for sn in provisioner.cluster.nodes()}
+    for node_name in list(result.node_pods):
+        sn = state_by_name.get(node_name)
+        if sn is None:
+            continue
+        if not sn.initialized() or (sn.node is not None and not sn.node.is_ready()):
+            for pi in result.node_pods.pop(node_name):
+                result.failures[pi] = (
+                    f"would schedule against a non-initialized node {node_name}"
+                )
     return SimulationResults(
         result=result,
         inputs=inputs,

@@ -57,6 +57,8 @@ from karpenter_tpu.ops.ffd_runs import _make_run_commit  # noqa: F401
 _STRIDE = int(_os.environ.get("KARPENTER_TPU_STRIDE", "64"))
 # experimental chain-dispatch sweep structure (see _sweeps_impl)
 _CHAIN_DISPATCH = _os.environ.get("KARPENTER_TPU_CHAIN_DISPATCH", "") == "1"
+# whole-chain spread commits (mini-sim); kill switch for perf A/B
+_SPREAD_CHAIN = _os.environ.get("KARPENTER_TPU_SPREAD_CHAIN", "1") == "1"
 
 
 def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
@@ -486,6 +488,7 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
             & ~ev["node_static_any"]
             & (k_strict > 1)
             & ~use_fill
+            & _SPREAD_CHAIN
         )
 
         no_pin = jnp.full((C,), -1, jnp.int32)
@@ -668,14 +671,15 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
             pin = jnp.where(fallback, no_pin, pin)
             return take, claim_of, k_out, pin, ~fallback
 
-        if G > 0:
+        if G > 0 and _SPREAD_CHAIN:
             branch = use_fill.astype(jnp.int32) + 2 * use_spread.astype(jnp.int32)
             claim_take, claim_of, k, claim_pin, multi_commit = lax.switch(
                 branch, (single_take, fill_take, spread_take)
             )
         else:
-            # no topology groups: spread_take's free variables don't exist
-            # (and the branch can never fire) — keep the two-way dispatch
+            # no topology groups (spread_take's free variables don't exist
+            # and the branch can never fire), or spread chains disabled:
+            # the two-way dispatch
             claim_take, claim_of, k, claim_pin, multi_commit = lax.cond(
                 use_fill, fill_take, single_take
             )

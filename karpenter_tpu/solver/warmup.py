@@ -220,7 +220,10 @@ def maybe_prewarm_in_background(options, cloud_provider=None) -> Optional["objec
             log.warning("prewarm: solver warm failed", exc_info=True)
         n_screen = getattr(options, "prewarm_screen_candidates", 0)
         if n_screen:
-            prewarm_screen(n_screen)
+            try:
+                prewarm_screen(n_screen)
+            except Exception:
+                log.warning("prewarm: screen warm failed", exc_info=True)
 
     t = threading.Thread(
         target=probe_then_warm, daemon=True, name="karpenter-tpu/solver-prewarm"

@@ -77,6 +77,7 @@ def make_pod(
     phase: str = "Pending",
     conditions=(),
     priority: Optional[int] = None,
+    priority_class_name: str = "",
     deletion_timestamp: Optional[float] = None,
 ) -> Pod:
     reqs = dict(requests or {})
@@ -114,6 +115,7 @@ def make_pod(
             affinity=affinity,
             topology_spread_constraints=list(topology_spread),
             priority=priority,
+            priority_class_name=priority_class_name,
         ),
         status=PodStatus(phase=phase, conditions=list(conditions)),
     )

@@ -162,6 +162,9 @@ class Env:
         for cond, when in conditions:
             claim.status.conditions.set_true(cond, now=when)
         self.kube.create(claim)
+        # the fake cloud must know the instance exists: termination probes
+        # CloudProvider.Get for vanished instances (controller.go:90-97)
+        self.cloud_provider.created_nodeclaims[f"fake:///{name}"] = claim
         node = make_node(
             name=name, provider_id=f"fake:///{name}", capacity=dict(it.capacity),
             allocatable=dict(it.allocatable()), labels=dict(labels),

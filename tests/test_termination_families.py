@@ -1,0 +1,181 @@
+"""Node-termination drain families.
+
+Behavioral ports of pkg/controllers/node/termination/suite_test.go blocks the
+round-2 drain tests did not cover: the full four-group eviction order (:337),
+non-critical-first (:423), disruption-taint tolerations with Equal and Exists
+operators (:164,:192), static pods (:458), terminal pods (:278), waiting for
+already-terminating pods (:566) vs. ignoring kubelet-partitioned ones
+(terminator.go:149-154), deleting nodes whose instance vanished mid-drain
+(:536), nodeclaim cascade (:109), and the load-balancer exclusion label
+(:145).
+"""
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodeclaim import NodeClaim
+from karpenter_tpu.apis.objects import Node, Pod, Toleration
+from karpenter_tpu.controllers.node_termination import NodeTerminationController
+
+from tests.factories import make_nodepool, make_pod
+from tests.harness import Env
+
+
+def _terminating(env, name="n1", pods=()):
+    """A candidate node put into the deleting state with the finalizer on —
+    the suite's standard setup (suite_test.go:70-100)."""
+    env.create(make_nodepool())
+    env.create_candidate_node(name, pods=list(pods))
+    stored = env.kube.get(Node, name, "")
+    stored.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
+    env.kube.update(stored)
+    env.kube.delete(Node, name, "")
+    ctrl = NodeTerminationController(
+        env.kube, env.cloud_provider, env.clock, env.recorder
+    )
+    return ctrl, env.kube.get(Node, name, "")
+
+
+def _drain_step(env, ctrl, node):
+    status = ctrl.reconcile(node)
+    ctrl.eviction_queue.reconcile()
+    return status
+
+
+def test_evicts_in_four_group_order():
+    # suite_test.go:337-422 — non-critical app, non-critical daemon, critical
+    # app, critical daemon; each group fully drains before the next starts
+    env = Env()
+    pods = [
+        make_pod(name="app", cpu=0.1, owner_kind="ReplicaSet"),
+        make_pod(name="daemon", cpu=0.1, owner_kind="DaemonSet"),
+        make_pod(name="crit", cpu=0.1, owner_kind="ReplicaSet",
+                 priority_class_name="system-node-critical"),
+        make_pod(name="crit-daemon", cpu=0.1, owner_kind="DaemonSet",
+                 priority_class_name="system-cluster-critical"),
+    ]
+    ctrl, node = _terminating(env, pods=pods)
+    for expected_gone, still_there in [
+        ("app", ["daemon", "crit", "crit-daemon"]),
+        ("daemon", ["crit", "crit-daemon"]),
+        ("crit", ["crit-daemon"]),
+        ("crit-daemon", []),
+    ]:
+        assert _drain_step(env, ctrl, node) == "draining"
+        assert env.kube.get_opt(Pod, expected_gone) is None, expected_gone
+        for name in still_there:
+            assert env.kube.get_opt(Pod, name) is not None, name
+    assert ctrl.reconcile(node) == "done"
+    assert env.kube.get_opt(Node, "n1", "") is None
+
+
+def test_cluster_critical_waits_for_noncritical():
+    # suite_test.go:423-457 — both critical classes drain after non-critical
+    env = Env()
+    pods = [
+        make_pod(name="app", cpu=0.1, owner_kind="ReplicaSet"),
+        make_pod(name="crit-a", cpu=0.1, owner_kind="ReplicaSet",
+                 priority_class_name="system-node-critical"),
+        make_pod(name="crit-b", cpu=0.1, owner_kind="ReplicaSet",
+                 priority_class_name="system-cluster-critical"),
+    ]
+    ctrl, node = _terminating(env, pods=pods)
+    assert _drain_step(env, ctrl, node) == "draining"
+    assert env.kube.get_opt(Pod, "app") is None
+    assert env.kube.get_opt(Pod, "crit-a") is not None
+    assert env.kube.get_opt(Pod, "crit-b") is not None
+    # both criticals are the same group: one pass clears them together
+    assert _drain_step(env, ctrl, node) == "draining"
+    assert env.kube.get_opt(Pod, "crit-a") is None
+    assert env.kube.get_opt(Pod, "crit-b") is None
+    assert ctrl.reconcile(node) == "done"
+
+
+def test_pods_tolerating_disruption_taint_ride_the_node_down():
+    # suite_test.go:164-221 — Equal- and Exists-operator tolerations of the
+    # disruption taint both exempt the pod from eviction; the node still
+    # finishes terminating with them aboard
+    for tol in (
+        Toleration(key=wk.DISRUPTION_TAINT_KEY, operator="Equal",
+                   value=wk.DISRUPTING_NO_SCHEDULE_TAINT_VALUE,
+                   effect="NoSchedule"),
+        Toleration(key=wk.DISRUPTION_TAINT_KEY, operator="Exists"),
+    ):
+        env = Env()
+        pods = [
+            make_pod(name="rider", cpu=0.1, owner_kind="ReplicaSet",
+                     tolerations=[tol]),
+            make_pod(name="app", cpu=0.1, owner_kind="ReplicaSet"),
+        ]
+        ctrl, node = _terminating(env, pods=pods)
+        assert _drain_step(env, ctrl, node) == "draining"
+        assert env.kube.get_opt(Pod, "app") is None
+        assert env.kube.get_opt(Pod, "rider") is not None
+        # the rider never blocks completion
+        assert ctrl.reconcile(node) == "done"
+        assert env.kube.get_opt(Node, "n1", "") is None
+
+
+def test_static_and_terminal_pods_do_not_block():
+    # suite_test.go:278-294 and :458-502 — mirror pods and Succeeded/Failed
+    # pods neither get evicted nor keep the drain open
+    env = Env()
+    pods = [
+        make_pod(name="static", cpu=0.1, owner_kind="Node"),
+        make_pod(name="done-pod", cpu=0.1, owner_kind="ReplicaSet"),
+    ]
+    ctrl, node = _terminating(env, pods=pods)
+    finished = env.kube.get(Pod, "done-pod", "default")
+    finished.status.phase = "Succeeded"  # the harness binds pods as Running
+    env.kube.update(finished)
+    assert ctrl.reconcile(node) == "done"
+    assert env.kube.get_opt(Pod, "static") is not None
+    assert env.kube.get_opt(Pod, "done-pod") is not None
+
+
+def test_waits_for_terminating_pods_but_not_stuck_ones():
+    # suite_test.go:566-585 — a pod already terminating keeps the node in
+    # draining (without re-eviction) until it actually goes; terminator.go:
+    # 149-154 — one it has been a minute past its deletion stamp, the kubelet
+    # is presumed partitioned and the drain stops waiting
+    env = Env()
+    ctrl, node = _terminating(env, pods=[])
+    leaving = make_pod(name="leaving", cpu=0.1, owner_kind="ReplicaSet",
+                       deletion_timestamp=env.clock.now())
+    leaving.spec.node_name = "n1"
+    leaving.status.phase = "Running"
+    env.create(leaving)
+    assert ctrl.reconcile(node) == "draining"
+    assert env.kube.get_opt(Pod, "leaving") is not None, (
+        "terminating pods are awaited, not re-evicted"
+    )
+    env.clock.step(61.0)
+    assert ctrl.reconcile(node) == "done"
+
+
+def test_vanished_instance_unblocks_drain():
+    # suite_test.go:536-565 — when the cloud instance is gone, an undrainable
+    # node must not wait forever: the finalizer comes off immediately
+    env = Env()
+    blocker = make_pod(
+        name="blocker", cpu=0.1, owner_kind="ReplicaSet",
+        deletion_timestamp=None,
+    )
+    ctrl, node = _terminating(env, pods=[blocker])
+    # rip the instance out from under the node
+    env.cloud_provider.created_nodeclaims.clear()
+    assert ctrl.reconcile(node) == "done"
+    assert env.kube.get_opt(Node, "n1", "") is None
+
+
+def test_termination_deletes_nodeclaims_and_labels_for_lb_exclusion():
+    # suite_test.go:109-117 (claim cascade) and :145-163 (the node leaves
+    # load-balancer target groups while draining)
+    env = Env()
+    app = make_pod(name="app", cpu=0.1, owner_kind="ReplicaSet")
+    ctrl, node = _terminating(env, pods=[app])
+    assert ctrl.reconcile(node) == "draining"
+    tainted = env.kube.get(Node, "n1", "")
+    assert tainted.metadata.labels.get(wk.LABEL_NODE_EXCLUDE_DISRUPTION) == "karpenter"
+    claim = env.kube.get_opt(NodeClaim, "claim-n1", "")
+    assert claim is None or claim.metadata.deletion_timestamp is not None, (
+        "the node's claim must be deleted alongside it"
+    )
