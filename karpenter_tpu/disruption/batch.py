@@ -229,6 +229,17 @@ class UnionScorer:
             np.array([row_of[orig] for orig in range(start, end)], dtype=np.int64)
             for (start, end) in self.cand_slices
         ]
+        # [n_cand, P] row-membership masks: the vectorized variant build and
+        # verdict decode in score_subsets are matmuls over these instead of
+        # per-(subset, member) python loops
+        P = self.base_problem.pod_active.shape[0]
+        self._cand_row_mask = np.zeros((len(self.candidates), P), dtype=bool)
+        for ci, rows in enumerate(self.cand_rows):
+            self._cand_row_mask[ci, rows] = True
+        self._cand_row_mask_i32 = self._cand_row_mask.astype(np.int32)
+        self._cand_node_idx = np.array(
+            [self._node_idx.get(c.name, -1) for c in self.candidates], dtype=np.int64
+        )
         self.deltas = [self._delta_for(c, n) for c, n in zip(self.candidates, self.cand_nodes)]
 
     # -- census deltas --------------------------------------------------------
@@ -360,27 +371,28 @@ class UnionScorer:
         if mesh is not None:
             n_dev = mesh.devices.size
             pad_to = ((pad_to + n_dev - 1) // n_dev) * n_dev
-        node_avail_b = np.broadcast_to(
-            np.asarray(base.node_avail), (pad_to,) + base.node_avail.shape
-        ).copy()
-        counts_b = np.broadcast_to(
-            all_counts, (pad_to,) + all_counts.shape
-        ).copy()
-        reg_int_b = np.broadcast_to(
-            all_reg_int, (pad_to,) + all_reg_int.shape
-        ).copy()
-        pod_active_b = np.broadcast_to(
-            np.asarray(base.pod_active), (pad_to,) + base.pod_active.shape
-        ).copy()
-        pod_active_b[:, all_cand_rows] = False
+        # [pad_to, n_cand] membership matrix; every per-subset variant array
+        # is then one vectorized op over it (the former per-(subset, member)
+        # python loop was the screen's dominant host cost at B=100)
+        n_cand = len(self.candidates)
+        member = np.zeros((pad_to, n_cand), dtype=bool)
         for bi, subset in enumerate(subsets):
-            for ci in subset:
-                counts_b[bi] -= delta_counts[ci]
-                reg_int_b[bi] -= delta_reg_int[ci]
-                ni = self._node_idx.get(self.candidates[ci].name)
-                if ni is not None:
-                    node_avail_b[bi, ni, :] = -1.0
-                pod_active_b[bi, self.cand_rows[ci]] = True
+            member[bi, list(subset)] = True
+        m8 = member.astype(np.int32)
+        counts_b = all_counts[None] - np.tensordot(m8, delta_counts, axes=1)
+        reg_int_b = all_reg_int[None] - np.tensordot(m8, delta_reg_int, axes=1)
+        # subset members' nodes are deleted (capacity masked out)...
+        member_node = np.zeros((pad_to, base.node_avail.shape[0]), dtype=bool)
+        valid_ni = self._cand_node_idx >= 0
+        member_node[:, self._cand_node_idx[valid_ni]] = member[:, valid_ni]
+        node_avail_b = np.where(
+            member_node[:, :, None], -1.0, np.asarray(base.node_avail)[None]
+        )
+        # ...and their pods become active reschedule rows; everyone else's
+        # candidate pods stay inert
+        base_active = np.asarray(base.pod_active).copy()
+        base_active[all_cand_rows] = False
+        pod_active_b = base_active[None] | (m8 @ self._cand_row_mask_i32 > 0)
         variants = ScreenVariants(
             node_avail=node_avail_b,
             pod_active=pod_active_b,
@@ -405,13 +417,17 @@ class UnionScorer:
         T_real = len(self.meta.instance_type_names)
         zone_k = self.meta.zone_key_idx
         ct_k = self.meta.ct_key_idx
+        # vectorized verdicts: a subset passes iff none of its members' pod
+        # rows failed — one [B, P] x [P, n_cand] product instead of the
+        # O(B x |subset|) row-scan loop
+        fail_b = (kinds[:B] >= KIND_FAIL).astype(np.int32)
+        cand_failed = fail_b @ self._cand_row_mask_i32.T > 0
+        ok_b = ~np.any(cand_failed & member[:B], axis=1)
+        n_claims_b = claim_open[:B].sum(axis=1).astype(np.int64)
         verdicts = []
         for bi, subset in enumerate(subsets):
-            ok = all(
-                not np.any(kinds[bi, self.cand_rows[ci]] >= KIND_FAIL)
-                for ci in subset
-            )
-            n_claims = int(claim_open[bi].sum())
+            ok = bool(ok_b[bi])
+            n_claims = int(n_claims_b[bi])
             verdict = SubsetVerdict(all_pods_scheduled=ok, n_new_claims=n_claims)
             if ok and n_claims == 1:
                 slot = int(np.flatnonzero(claim_open[bi])[0])

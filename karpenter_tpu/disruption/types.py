@@ -112,6 +112,19 @@ def new_candidate(
         raise IneligibleError("node is deleting or already disrupting")
     if is_nominated:
         raise IneligibleError("node is nominated for pending pods")
+    # the node-level do-not-disrupt annotation blocks candidacy outright
+    # (types.go:78-81); distinct from the per-pod annotation below
+    if wk.DO_NOT_DISRUPT_ANNOTATION_KEY in state_node.annotations():
+        raise IneligibleError(
+            f"disruption is blocked through the "
+            f"{wk.DO_NOT_DISRUPT_ANNOTATION_KEY!r} annotation"
+        )
+    labels = state_node.labels()
+    # candidates must carry the offering labels (types.go:83-91): a node
+    # without them can't be priced, so it can't be consolidated
+    for required in (wk.CAPACITY_TYPE_LABEL_KEY, wk.LABEL_TOPOLOGY_ZONE):
+        if required not in labels:
+            raise IneligibleError(f"required label {required!r} doesn't exist")
     pool_name = state_node.nodepool_name
     if pool_name is None:
         raise IneligibleError("node has no nodepool label")
@@ -124,7 +137,6 @@ def new_candidate(
                 f"pod {pod.key()} has the do-not-disrupt annotation"
             )
 
-    labels = state_node.labels()
     it_name = labels.get(wk.LABEL_INSTANCE_TYPE_STABLE, "")
     zone = labels.get(wk.LABEL_TOPOLOGY_ZONE, "")
     capacity_type = labels.get(wk.CAPACITY_TYPE_LABEL_KEY, "")

@@ -66,6 +66,17 @@ import os as _os
 _USE_RUNS = _os.environ.get("KARPENTER_TPU_RUNS", "0").lower() in ("1", "true", "yes")
 _TIMING = _os.environ.get("KARPENTER_TPU_TIMING", "") == "1"
 
+# Adaptive dispatch: batches at or below this many pods (and existing nodes)
+# run the SAME XLA program on the host CPU backend instead of the accelerator.
+# A tunneled TPU pays a fixed ~70ms runtime roundtrip per solve, which
+# dominates end-to-end latency for interactive single-pod provisions; the
+# reference's in-process Go solver answers those in microseconds
+# (scheduling_benchmark_test.go's floor is throughput-only). Running the
+# identical jitted program on the CPU device keeps bit-exact semantics (the
+# 64-seed parity fuzz already exercises it on CPU) with no second solver
+# implementation. 0 disables.
+_HOST_SMALL_BATCH = int(_os.environ.get("KARPENTER_TPU_HOST_SMALL_BATCH", "32"))
+
 if _TIMING:
     import sys as _sys
     import time as _time
@@ -155,18 +166,34 @@ class JaxSolver(SolverBackend):
         bound_executable_maps()
         t0 = _t("maps-guard", t0)
         max_claims = min(self.claim_slots, pow2_bucket(len(pods)))
-        while True:
+        with self._dispatch_device(len(pods), len(nodes)):
+            while True:
+                try:
+                    return self._solve_with_slots(
+                        pods, instance_types, templates, nodes,
+                        pod_requirements_override, topology, cluster_pods, domains,
+                        max_claims, pod_volumes,
+                    )
+                except _SlotOverflow:
+                    if max_claims >= len(pods):
+                        raise RuntimeError("claim slots exhausted at pod count") from None
+                    max_claims = min(pow2_bucket(max_claims * 2), pow2_bucket(len(pods)))
+                    self.claim_slots = max(self.claim_slots, max_claims)
+
+    @staticmethod
+    def _dispatch_device(n_pods: int, n_nodes: int):
+        """Small problems run on the host CPU device (see _HOST_SMALL_BATCH);
+        everything else keeps the process default (TPU when present)."""
+        import contextlib
+
+        if 0 < _HOST_SMALL_BATCH and n_pods <= _HOST_SMALL_BATCH and n_nodes <= _HOST_SMALL_BATCH:
             try:
-                return self._solve_with_slots(
-                    pods, instance_types, templates, nodes,
-                    pod_requirements_override, topology, cluster_pods, domains,
-                    max_claims, pod_volumes,
-                )
-            except _SlotOverflow:
-                if max_claims >= len(pods):
-                    raise RuntimeError("claim slots exhausted at pod count") from None
-                max_claims = min(pow2_bucket(max_claims * 2), pow2_bucket(len(pods)))
-                self.claim_slots = max(self.claim_slots, max_claims)
+                cpu = jax.devices("cpu")[0]
+            except RuntimeError:
+                return contextlib.nullcontext()
+            if jax.default_backend() != "cpu":
+                return jax.default_device(cpu)
+        return contextlib.nullcontext()
 
     def _solve_with_slots(
         self, pods, instance_types, templates, nodes,

@@ -6,6 +6,13 @@ for the framework's own API types, default-disabled the same way
 a knative webhook server in front of the apiserver, this framework registers
 validators directly on the in-memory kube store's admission seam
 (KubeClient.admit) — same contract, no TLS plumbing.
+
+The reference's second webhook — CRD conversion between v1alpha5 and v1beta1
+(webhooks.go:57-99) — is deliberately not built: this framework has exactly
+one API version, so there is nothing to convert to or from. If a second API
+version is ever introduced, add a conversion hook on the same admission seam
+(a `kube.convert(FromType, ToType, fn)` registration) rather than a
+standalone server.
 """
 
 from __future__ import annotations

@@ -1,0 +1,331 @@
+"""Replace-family + method-fallback consolidation behaviors.
+
+Behavioral ports of reference consolidation suite blocks not covered by the
+earlier rounds (pkg/controllers/disruption/consolidation_test.go): broken
+sibling NodePools must not stop disruption (:267-327, :1888-1955), the
+node-level do-not-disrupt annotation (:536-693), permanently-pending pods
+(:1783-1841), expensive-replacement rejections (:851-1057), TTL-arrival
+guards on REPLACE commands (:2255-2403), and the method fallback ladder —
+emptiness failing validation must not stop consolidation (:2996-3161).
+
+The reference blocks a goroutine on the validation TTL; this controller parks
+the command and revalidates on a later pass (disruption/controller.py
+PendingCommand), so fallback takes one extra reconcile pass instead of
+continuing inside the same blocking call.
+"""
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodeclaim import NodeClaim
+from karpenter_tpu.apis.objects import LabelSelector, PodDisruptionBudget
+from karpenter_tpu.disruption.types import DECISION_DELETE, DECISION_REPLACE
+
+from tests.factories import make_nodepool, make_pod
+from tests.harness import Env
+from tests.test_disruption import make_underutilized_pool
+
+
+def _cheapest_it(env):
+    its = env.cloud_provider.get_instance_types(None)
+    return min(its, key=lambda it: it.offerings.cheapest().price)
+
+
+def _priciest_it(env):
+    its = env.cloud_provider.get_instance_types(None)
+    return max(its, key=lambda it: it.offerings.cheapest().price)
+
+
+# ---------------------------------------------------------------------------
+# broken sibling NodePools (consolidation_test.go:267-327, :1888-1955)
+# ---------------------------------------------------------------------------
+
+
+def test_replace_proceeds_when_other_pool_has_no_instance_types():
+    # consolidation_test.go:267-327 — a sibling pool whose provider returns no
+    # instance types must not stop the replace on the main pool
+    env = Env()
+    env.create(make_underutilized_pool())
+    env.create(make_underutilized_pool(name="empty-pool"))
+    env.cloud_provider.instance_types_for_nodepool["empty-pool"] = []
+    pricey = _priciest_it(env)
+    pod = make_pod(name="app", cpu=0.5, owner_kind="ReplicaSet")
+    env.create(pod)
+    env.create_candidate_node("n1", it_name=pricey.name, pods=[pod])
+    cmd = env.reconcile_disruption()
+    assert cmd is not None and cmd.decision == DECISION_REPLACE
+    # the replacement must not request the most expensive type
+    assert cmd.replacements, "replace must launch a replacement claim"
+    reqs = cmd.replacements[0].spec.requirements
+    it_req = next(r for r in reqs if r.key == wk.LABEL_INSTANCE_TYPE_STABLE)
+    assert pricey.name not in (it_req.values or [])
+
+
+def test_delete_proceeds_while_invalid_pool_errors():
+    # consolidation_test.go:1888-1955 — a pool whose GetInstanceTypes errors
+    # must not stop deleting a node of a healthy pool
+    env = Env()
+    env.create(make_underutilized_pool())
+    env.create(make_underutilized_pool(name="bad-pool"))
+    env.cloud_provider.errors_for_nodepool["bad-pool"] = RuntimeError(
+        "unable to fetch instance types"
+    )
+    # n-keep is nearly full (3.4 of 3.9 allocatable): a multi-node replace
+    # of both nodes would need >=3.5 cpu, i.e. the same type again — blocked
+    # by the same-type churn filter — so the only action is deleting n-drop
+    pods = [make_pod(name=f"p{i}", cpu=1.7, owner_kind="ReplicaSet") for i in range(2)]
+    for p in pods:
+        env.create(p)
+    env.create_candidate_node("n-keep", pods=pods)
+    lone = make_pod(name="lone", cpu=0.1, owner_kind="ReplicaSet")
+    env.create(lone)
+    env.create_candidate_node("n-drop", pods=[lone])
+    cmd = env.reconcile_disruption()
+    assert cmd is not None and cmd.decision == DECISION_DELETE
+    assert [c.name for c in cmd.candidates] == ["n-drop"]
+
+
+# ---------------------------------------------------------------------------
+# node-level do-not-disrupt annotation (consolidation_test.go:536-693,
+# types.go:78-81)
+# ---------------------------------------------------------------------------
+
+
+def test_node_do_not_disrupt_annotation_blocks_consolidation():
+    env = Env()
+    env.create(make_underutilized_pool())
+    node, _claim = env.create_candidate_node("n1")
+    node.metadata.annotations[wk.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+    env.kube.update(node)
+    assert env.reconcile_disruption() is None
+
+
+def test_node_do_not_disrupt_annotation_blocks_only_that_node():
+    env = Env()
+    env.create(make_underutilized_pool())
+    node, _ = env.create_candidate_node("n1")
+    node.metadata.annotations[wk.DO_NOT_DISRUPT_ANNOTATION_KEY] = "true"
+    env.kube.update(node)
+    env.create_candidate_node("n2")
+    cmd = env.reconcile_disruption()
+    assert cmd is not None and cmd.decision == DECISION_DELETE
+    assert [c.name for c in cmd.candidates] == ["n2"]
+
+
+def test_candidate_requires_offering_labels():
+    # types.go:83-91 — a node missing the zone / capacity-type labels cannot
+    # be priced and must never become a candidate
+    env = Env()
+    env.create(make_underutilized_pool())
+    node, claim = env.create_candidate_node("n1")
+    del node.metadata.labels[wk.LABEL_TOPOLOGY_ZONE]
+    env.kube.update(node)
+    del claim.metadata.labels[wk.LABEL_TOPOLOGY_ZONE]
+    env.kube.update(claim)
+    assert env.reconcile_disruption() is None
+
+
+# ---------------------------------------------------------------------------
+# permanently-pending pods (consolidation_test.go:1783-1841)
+# ---------------------------------------------------------------------------
+
+
+def test_delete_with_permanently_pending_pod():
+    # a pod no NodePool can ever host must not block deleting an
+    # underutilized node — and must still be pending afterwards
+    env = Env()
+    env.create(make_underutilized_pool())
+    stuck = make_pod(
+        name="stuck", cpu=0.1, node_selector={"non-existent": "node-label"}
+    )
+    env.create(stuck)
+    lone = make_pod(name="lone", cpu=0.1, owner_kind="ReplicaSet")
+    env.create(lone)
+    env.create_candidate_node("n-drop", pods=[lone])
+    pods = [make_pod(name=f"p{i}", cpu=1.7, owner_kind="ReplicaSet") for i in range(2)]
+    for p in pods:
+        env.create(p)
+    env.create_candidate_node("n-keep", pods=pods)
+    cmd = env.reconcile_disruption()
+    assert cmd is not None and cmd.decision == DECISION_DELETE
+    assert [c.name for c in cmd.candidates] == ["n-drop"]
+    env.expect_not_scheduled(stuck)
+
+
+# ---------------------------------------------------------------------------
+# expensive replacements (consolidation_test.go:851-1057)
+# ---------------------------------------------------------------------------
+
+
+def test_wont_replace_when_node_already_cheapest():
+    # consolidation_test.go:946-1057 — an on-demand node on the cheapest
+    # compatible instance type has no cheaper replacement; pods that fill it
+    # prevent a delete, so nothing happens
+    env = Env()
+    env.create(make_underutilized_pool())
+    cheap = _cheapest_it(env)
+    pod = make_pod(
+        name="big", cpu=cheap.allocatable().get("cpu", 1.0) * 0.8,
+        owner_kind="ReplicaSet",
+    )
+    env.create(pod)
+    env.create_candidate_node("n1", it_name=cheap.name, pods=[pod])
+    assert env.reconcile_disruption() is None
+
+
+def test_wont_replace_spot_when_replacement_not_cheaper():
+    # consolidation_test.go:851-945 + helpers.go:235-258 — a spot candidate
+    # blocks spot→spot churn: with the candidate already on the cheapest
+    # offering, no compatible replacement survives the price filter
+    env = Env()
+    env.create(make_underutilized_pool())
+    cheap = _cheapest_it(env)
+    pod = make_pod(
+        name="app", cpu=cheap.allocatable().get("cpu", 1.0) * 0.8,
+        owner_kind="ReplicaSet",
+    )
+    env.create(pod)
+    env.create_candidate_node(
+        "n1", it_name=cheap.name, capacity_type=wk.CAPACITY_TYPE_SPOT, pods=[pod]
+    )
+    assert env.reconcile_disruption() is None
+
+
+# ---------------------------------------------------------------------------
+# TTL-arrival guards on REPLACE commands (consolidation_test.go:2255-2403)
+# ---------------------------------------------------------------------------
+
+
+def _parked_replace(env):
+    ctrl = env.disruption_controller()
+    assert ctrl.reconcile() is None
+    assert ctrl.pending is not None
+    assert ctrl.pending.command.decision == DECISION_REPLACE
+    return ctrl
+
+
+def test_do_not_disrupt_pod_arriving_during_ttl_blocks_replace():
+    # consolidation_test.go:2303-2351 — a do-not-disrupt pod binding to the
+    # candidate during the replace TTL wait must invalidate the command
+    env = Env()
+    env.create(make_underutilized_pool())
+    pricey = _priciest_it(env)
+    pod = make_pod(name="app", cpu=0.5, owner_kind="ReplicaSet")
+    env.create(pod)
+    env.create_candidate_node("n1", it_name=pricey.name, pods=[pod])
+    ctrl = _parked_replace(env)
+    blocker = make_pod(
+        name="blocker", cpu=0.1,
+        annotations={wk.DO_NOT_DISRUPT_ANNOTATION_KEY: "true"},
+    )
+    env.create(blocker)
+    env.bind(blocker, "n1")
+    env.clock.step(ctrl.pending.method.validation_ttl + 0.1)
+    assert ctrl.reconcile() is None
+    assert env.kube.get_opt(NodeClaim, "claim-n1", "") is not None
+
+
+def test_blocking_pdb_arriving_during_ttl_blocks_replace():
+    # consolidation_test.go:2351-2403 — a PDB created during the replace TTL
+    # wait with no disruptions allowed must invalidate the command
+    env = Env()
+    env.create(make_underutilized_pool())
+    pricey = _priciest_it(env)
+    pod = make_pod(name="app", cpu=0.5, labels={"app": "guarded"},
+                   owner_kind="ReplicaSet")
+    env.create(pod)
+    env.create_candidate_node("n1", it_name=pricey.name, pods=[pod])
+    ctrl = _parked_replace(env)
+    env.create(
+        PodDisruptionBudget(
+            metadata=__import__(
+                "karpenter_tpu.apis.objects", fromlist=["ObjectMeta"]
+            ).ObjectMeta(name="guard", namespace="default"),
+            selector=LabelSelector(match_labels={"app": "guarded"}),
+            max_unavailable=0,
+        )
+    )
+    env.clock.step(ctrl.pending.method.validation_ttl + 0.1)
+    assert ctrl.reconcile() is None
+    assert env.kube.get_opt(NodeClaim, "claim-n1", "") is not None
+
+
+# ---------------------------------------------------------------------------
+# method fallback ladder (consolidation_test.go:2996-3161)
+# ---------------------------------------------------------------------------
+
+
+def test_emptiness_failing_validation_does_not_stop_consolidation():
+    # consolidation_test.go:2996-3068 — empty-node consolidation is computed,
+    # pods bind to its candidates during the TTL wait, revalidation rejects;
+    # a later pass must still consolidate via the non-empty methods instead
+    # of wedging on the parked command
+    env = Env()
+    env.create(make_underutilized_pool())
+    env.create_candidate_node("n1")
+    env.create_candidate_node("n2")
+    env.create_candidate_node("n3")
+    ctrl = env.disruption_controller()
+    assert ctrl.reconcile() is None
+    assert ctrl.pending is not None
+    assert ctrl.pending.command.method == "empty-node-consolidation"
+    # pods arrive on every candidate mid-wait: the empty delete is now wrong
+    for i, name in enumerate(("n1", "n2", "n3")):
+        p = make_pod(name=f"late{i}", cpu=0.4, owner_kind="ReplicaSet")
+        env.create(p)
+        env.bind(p, name)
+    env.clock.step(ctrl.pending.method.validation_ttl + 0.1)
+    assert ctrl.reconcile() is None  # revalidation rejects, nothing deleted
+    assert ctrl.pending is None
+    for name in ("n1", "n2", "n3"):
+        assert env.kube.get_opt(NodeClaim, f"claim-{name}", "") is not None
+    # the next pass finds the (now non-empty) nodes consolidatable the
+    # normal way: 3 lightly-loaded nodes fold down
+    cmd = ctrl.reconcile()
+    if cmd is None and ctrl.pending is not None:
+        env.clock.step(ctrl.pending.method.validation_ttl + 0.1)
+        cmd = ctrl.reconcile()
+    assert cmd is not None and cmd.decision in (DECISION_DELETE, DECISION_REPLACE)
+    assert cmd.method in ("multi-node-consolidation", "single-node-consolidation")
+
+
+def test_multi_failing_validation_falls_back_to_single():
+    # consolidation_test.go:3069-3161 — multi-node consolidation parks a
+    # 2-candidate command; one candidate becomes ineligible mid-wait
+    # (do-not-disrupt pod); revalidation rejects, and a later pass still
+    # consolidates the other node via single-node consolidation
+    env = Env()
+    env.create(make_underutilized_pool())
+    small = [make_pod(name=f"s{i}", cpu=0.1, owner_kind="ReplicaSet") for i in range(2)]
+    for p in small:
+        env.create(p)
+    env.create_candidate_node("n1", pods=[small[0]])
+    env.create_candidate_node("n2", pods=[small[1]])
+    # n-host is pinned: its pods fill the node's 3.9 allocatable exactly, so
+    # they fit nowhere else (together with n2's pod they exceed any single
+    # node) — the fallback must single out n2 alone
+    big = [make_pod(name=f"b{i}", cpu=1.95, owner_kind="ReplicaSet") for i in range(2)]
+    for p in big:
+        env.create(p)
+    env.create_candidate_node("n-host", pods=big)
+    ctrl = env.disruption_controller()
+    assert ctrl.reconcile() is None
+    assert ctrl.pending is not None
+    parked = ctrl.pending.command
+    assert parked.method == "multi-node-consolidation"
+    assert len(parked.candidates) >= 2
+    blocker = make_pod(
+        name="blocker", cpu=0.05,
+        annotations={wk.DO_NOT_DISRUPT_ANNOTATION_KEY: "true"},
+    )
+    env.create(blocker)
+    env.bind(blocker, "n1")
+    env.clock.step(ctrl.pending.method.validation_ttl + 0.1)
+    assert ctrl.reconcile() is None  # multi revalidation rejects
+    assert ctrl.pending is None
+    # later passes: single-node consolidation can still move n2's pod
+    cmd = ctrl.reconcile()
+    if cmd is None and ctrl.pending is not None:
+        env.clock.step(ctrl.pending.method.validation_ttl + 0.1)
+        cmd = ctrl.reconcile()
+    assert cmd is not None
+    assert [c.name for c in cmd.candidates] == ["n2"]
+    assert env.kube.get_opt(NodeClaim, "claim-n1", "") is not None

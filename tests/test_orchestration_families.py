@@ -1,0 +1,161 @@
+"""Orchestration queue behavior families.
+
+Behavioral ports of reference pkg/controllers/disruption/orchestration/
+suite_test.go cases the earlier rounds had not covered: nodes stay tainted
+while replacements initialize (:166-183), a command completes only when ALL
+its replacements are initialized (:235-272), commands with no replacements
+don't wait (:273-289), two queued commands finish independently as their own
+replacements come up (:290+), and a replacement NodeClaim that disappears
+mid-flight (failed launch, GC) rolls the command back (queue.go:214-274
+unrecoverable-error path).
+"""
+
+from karpenter_tpu.apis.nodeclaim import NodeClaim
+from karpenter_tpu.apis.objects import Node
+from karpenter_tpu.disruption.orchestration import Queue
+from karpenter_tpu.disruption.types import (
+    DECISION_DELETE,
+    DECISION_REPLACE,
+)
+from karpenter_tpu.state.statenode import disruption_taint
+
+from tests.factories import make_pod
+from tests.harness import Env
+from tests.test_disruption import make_underutilized_pool
+
+
+def _initialize(env, claim_name):
+    rep = env.kube.get(NodeClaim, claim_name, "")
+    for cond in ("Launched", "Registered", "Initialized"):
+        rep.status.conditions.set_true(cond)
+    env.kube.update(rep)
+
+
+def _replace_command(env, node_name, pod_cpu=0.5):
+    pod = make_pod(name=f"pod-{node_name}", cpu=pod_cpu, owner_kind="ReplicaSet")
+    env.create(pod)
+    env.create_candidate_node(node_name, pods=[pod])
+    cmd = env.reconcile_disruption()
+    assert cmd is not None and cmd.decision == DECISION_REPLACE
+    return cmd
+
+
+def test_nodes_stay_tainted_while_replacement_initializes():
+    # suite_test.go:166-183 — repeated queue passes before initialization
+    # must neither untaint nor delete the candidate
+    env = Env()
+    env.create(make_underutilized_pool())
+    cmd = _replace_command(env, "n1")
+    ctrl = env.disruption_controller()
+    for _ in range(3):
+        ctrl.queue.reconcile()
+        node = env.kube.get(Node, "n1", "")
+        assert any(t.match(disruption_taint()) for t in node.spec.taints)
+        assert env.kube.get_opt(NodeClaim, "claim-n1", "") is not None
+    assert ctrl.queue.has_any("fake:///n1")
+    # and handling the command before the timeout is not an error
+    env.clock.step(60.0)
+    ctrl.queue.reconcile()
+    assert env.kube.get_opt(NodeClaim, "claim-n1", "") is not None
+
+
+def test_command_without_replacements_finishes_immediately():
+    # suite_test.go:273-289 — a pure delete waits on nothing
+    env = Env()
+    env.create(make_underutilized_pool())
+    env.create_candidate_node("n1")
+    cmd = env.reconcile_disruption()
+    assert cmd is not None and cmd.decision == DECISION_DELETE
+    env.disruption_controller().queue.reconcile()
+    assert env.kube.get_opt(NodeClaim, "claim-n1", "") is None
+
+
+def test_two_commands_finish_independently():
+    # suite_test.go:290+ — each command is gated by its OWN replacements;
+    # initializing one command's replacement finishes that command only.
+    # Commands are hand-built and fed to the queue the way the reference
+    # suite does (its suite_test constructs orchestration.Commands directly):
+    # the controller itself would rightly refuse a second consolidation while
+    # the first replacement is uninitialized (helpers.go:116-124 — see
+    # test_wont_delete_when_pods_would_land_on_uninitialized_node).
+    from karpenter_tpu.disruption.helpers import (
+        build_nodepool_map,
+        get_candidates,
+    )
+    from karpenter_tpu.disruption.types import Command
+
+    env = Env()
+    env.create(make_underutilized_pool())
+    for name in ("n1", "n2"):
+        pod = make_pod(name=f"pod-{name}", cpu=0.5, owner_kind="ReplicaSet")
+        env.create(pod)
+        env.create_candidate_node(name, pods=[pod])
+    nm = build_nodepool_map(env.kube, env.cloud_provider)
+    cands = {
+        c.name: c
+        for c in get_candidates(
+            env.clock, env.kube, env.cluster, env.cloud_provider,
+            lambda c: True, nodepool_map=nm,
+        )
+    }
+    from tests.factories import make_nodeclaim
+
+    ctrl = env.disruption_controller()
+    reps = {}
+    for name in ("n1", "n2"):
+        rep = make_nodeclaim(name=f"rep-{name}", nodepool="default")
+        env.kube.create(rep)
+        reps[name] = rep
+        ctrl.queue.add(
+            Command(candidates=[cands[name]], replacements=[rep],
+                    method="multi-node-consolidation")
+        )
+    assert len(ctrl.queue.items) == 2
+    _initialize(env, "rep-n2")
+    ctrl.queue.reconcile()
+    assert env.kube.get_opt(NodeClaim, "claim-n2", "") is None  # cmd2 done
+    assert env.kube.get_opt(NodeClaim, "claim-n1", "") is not None  # cmd1 waits
+    _initialize(env, "rep-n1")
+    ctrl.queue.reconcile()
+    assert env.kube.get_opt(NodeClaim, "claim-n1", "") is None
+    assert not ctrl.queue.items
+
+
+def test_replacement_vanishing_rolls_back():
+    # queue.go:214-274 — a replacement that disappears (failed launch, GC'd)
+    # is unrecoverable: untaint, unmark, keep the candidate
+    env = Env()
+    env.create(make_underutilized_pool())
+    cmd = _replace_command(env, "n1")
+    ctrl = env.disruption_controller()
+    env.kube.delete(NodeClaim, cmd.replacements[0].metadata.name, "")
+    ctrl.queue.reconcile()
+    node = env.kube.get(Node, "n1", "")
+    assert not any(t.match(disruption_taint()) for t in node.spec.taints)
+    assert not env.cluster.node_for_name("n1").marked_for_deletion()
+    assert env.kube.get_opt(NodeClaim, "claim-n1", "") is not None
+    assert not ctrl.queue.items
+
+
+def test_command_waits_for_all_replacements():
+    # suite_test.go:235-272 — with two replacements, initializing one is not
+    # enough. Drive the Queue directly with a synthetic two-replacement
+    # command (multi-node replace shapes are covered elsewhere; the queue
+    # behavior is what's under test).
+    env = Env()
+    env.create(make_underutilized_pool())
+    cmd = _replace_command(env, "n1")
+    ctrl = env.disruption_controller()
+    item = ctrl.queue.items[0]
+    # add a second synthetic replacement to the in-flight command
+    from tests.factories import make_nodeclaim
+
+    extra = make_nodeclaim(name="extra-rep", nodepool="default")
+    env.kube.create(extra)
+    item.replacement_names.append("extra-rep")
+    _initialize(env, cmd.replacements[0].metadata.name)
+    ctrl.queue.reconcile()
+    assert env.kube.get_opt(NodeClaim, "claim-n1", "") is not None  # still waiting
+    _initialize(env, "extra-rep")
+    ctrl.queue.reconcile()
+    assert env.kube.get_opt(NodeClaim, "claim-n1", "") is None

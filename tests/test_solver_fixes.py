@@ -278,3 +278,65 @@ class TestSpreadChainFill:
         jx, jr, orr = self._solve_both(pods)
         self._assert_match(pods, jr, orr)
         assert jr.num_scheduled() == len(pods)
+
+
+class TestSmallBatchHostDispatch:
+    """Adaptive small-batch dispatch (jax_backend._dispatch_device): tiny
+    solves run the identical program on the host CPU device to skip the
+    accelerator's fixed launch roundtrip; big solves keep the default."""
+
+    def test_small_batch_routes_to_cpu_when_accelerator_default(self, monkeypatch):
+        import contextlib
+
+        import karpenter_tpu.solver.jax_backend as jb
+
+        sentinel = contextlib.nullcontext()
+        monkeypatch.setattr(jb.jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(jb.jax, "devices", lambda kind=None: [object()])
+        monkeypatch.setattr(jb.jax, "default_device", lambda dev: sentinel)
+        assert jb.JaxSolver._dispatch_device(10, 0) is sentinel
+        assert jb.JaxSolver._dispatch_device(jb._HOST_SMALL_BATCH, jb._HOST_SMALL_BATCH) is sentinel
+
+    def test_large_batch_keeps_default_device(self, monkeypatch):
+        import karpenter_tpu.solver.jax_backend as jb
+
+        monkeypatch.setattr(jb.jax, "default_backend", lambda: "tpu")
+        monkeypatch.setattr(
+            jb.jax, "default_device",
+            lambda dev: (_ for _ in ()).throw(AssertionError("must not route")),
+        )
+        ctx = jb.JaxSolver._dispatch_device(jb._HOST_SMALL_BATCH + 1, 0)
+        with ctx:
+            pass  # a null context — large batches stay on the accelerator
+
+    def test_cpu_default_backend_is_a_noop(self, monkeypatch):
+        import karpenter_tpu.solver.jax_backend as jb
+
+        monkeypatch.setattr(jb.jax, "default_backend", lambda: "cpu")
+        monkeypatch.setattr(
+            jb.jax, "default_device",
+            lambda dev: (_ for _ in ()).throw(AssertionError("must not route")),
+        )
+        with jb.JaxSolver._dispatch_device(1, 0):
+            pass
+
+    def test_solve_result_identical_through_dispatch(self):
+        # the routed path is the same program on another device; on a
+        # CPU-only test host this exercises the nullcontext branch end-to-end
+        from karpenter_tpu.apis.nodepool import NodePool
+        from karpenter_tpu.apis.objects import Container, ObjectMeta, Pod, PodSpec
+        from karpenter_tpu.cloudprovider.fake import default_instance_types
+        from karpenter_tpu.solver.encode import template_from_nodepool
+        from karpenter_tpu.solver.jax_backend import JaxSolver
+
+        its = default_instance_types()
+        tpl = template_from_nodepool(
+            NodePool(metadata=ObjectMeta(name="default")), its, range(len(its))
+        )
+        pods = [
+            Pod(metadata=ObjectMeta(name=f"p{i}"),
+                spec=PodSpec(containers=[Container(requests={"cpu": 0.5})]))
+            for i in range(4)
+        ]
+        result = JaxSolver().solve(pods, its, [tpl])
+        assert result.num_scheduled() == 4
