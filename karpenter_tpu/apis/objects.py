@@ -384,6 +384,14 @@ class StorageClass:
 
 
 @dataclass
+class Namespace:
+    """Namespace objects exist so pod-affinity namespaceSelectors can resolve
+    against their labels (topology.go buildNamespaceList)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+
+
+@dataclass
 class PodDisruptionBudget:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     selector: Optional[LabelSelector] = None

@@ -20,19 +20,19 @@ from karpenter_tpu.utils import pod as podutil
 from karpenter_tpu.utils import resources as res
 
 NODE_ALLOCATABLE = REGISTRY.gauge(
-    "node_allocatable", "Node allocatable by resource", subsystem="nodes"
+    "allocatable", "Node allocatable by resource", subsystem="nodes"
 )
 NODE_REQUESTS = REGISTRY.gauge(
-    "node_total_pod_requests", "Requested resources by node", subsystem="nodes"
+    "total_pod_requests", "Requested resources by node", subsystem="nodes"
 )
 NODEPOOL_LIMIT = REGISTRY.gauge(
-    "nodepool_limit", "NodePool resource limits", subsystem="nodepools"
+    "limit", "NodePool resource limits", subsystem="nodepools"
 )
 NODEPOOL_USAGE = REGISTRY.gauge(
-    "nodepool_usage", "NodePool resource usage", subsystem="nodepools"
+    "usage", "NodePool resource usage", subsystem="nodepools"
 )
 POD_STATE = REGISTRY.gauge(
-    "pod_state", "Pods by phase", subsystem="pods"
+    "state", "Pods by phase", subsystem="pods"
 )
 POD_STARTUP_TIME = REGISTRY.histogram(
     "startup_time_seconds",

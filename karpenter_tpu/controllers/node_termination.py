@@ -31,8 +31,12 @@ from karpenter_tpu.utils.clock import Clock
 SYSTEM_CRITICAL_PRIORITY = 2_000_000_000
 
 TERMINATION_DURATION = REGISTRY.histogram(
-    "node_termination_duration_seconds", "Time from delete to finalizer removal",
+    "termination_duration_seconds", "Time from delete to finalizer removal",
     subsystem="node",
+)
+# metrics.go:122-133 — nodes fully terminated, by owning pool
+NODES_TERMINATED = REGISTRY.counter(
+    "terminated_total", "Nodes fully terminated", subsystem="node"
 )
 
 
@@ -102,6 +106,9 @@ class NodeTerminationController:
             ),
         )
         TERMINATION_DURATION.observe(self.clock.now() - deleted_at)
+        NODES_TERMINATED.inc(
+            labels={"nodepool": node.metadata.labels.get(wk.NODEPOOL_LABEL_KEY, "")}
+        )
 
     def _delete_node_claims(self, node: Node) -> None:
         """Deleting the node deletes its claims too, so the claim-side

@@ -26,7 +26,7 @@ STUCK_TERMINATION_SECONDS = 600.0
 SHAPE_TOLERANCE = 0.10
 
 CONSISTENCY_ERRORS = REGISTRY.counter(
-    "nodeclaims_consistency_errors_total", "Invariant violations observed",
+    "consistency_errors_total", "Invariant violations observed",
     subsystem="nodeclaims",
 )
 

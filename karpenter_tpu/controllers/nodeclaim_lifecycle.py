@@ -36,16 +36,20 @@ from karpenter_tpu.utils.clock import Clock
 REGISTRATION_TTL_SECONDS = 15 * 60.0  # liveness.go
 
 CLAIMS_LAUNCHED = REGISTRY.counter(
-    "nodeclaims_launched_total", "NodeClaims launched", subsystem="nodeclaims"
+    "launched_total", "NodeClaims launched", subsystem="nodeclaims"
 )
 CLAIMS_REGISTERED = REGISTRY.counter(
-    "nodeclaims_registered_total", "NodeClaims registered", subsystem="nodeclaims"
+    "registered_total", "NodeClaims registered", subsystem="nodeclaims"
 )
 CLAIMS_INITIALIZED = REGISTRY.counter(
-    "nodeclaims_initialized_total", "NodeClaims initialized", subsystem="nodeclaims"
+    "initialized_total", "NodeClaims initialized", subsystem="nodeclaims"
+)
+# metrics.go:111-121 — a Node registering under a claim counts as created
+NODES_CREATED = REGISTRY.counter(
+    "created_total", "Nodes created (registered)", subsystem="node"
 )
 CLAIMS_TERMINATED_LIVENESS = REGISTRY.counter(
-    "nodeclaims_terminated_liveness_total",
+    "terminated_liveness_total",
     "NodeClaims deleted for failing to register",
     subsystem="nodeclaims",
 )
@@ -143,6 +147,9 @@ class LifecycleController:
             c.status.conditions.set_true(REGISTERED, now=self.clock.now())
         self.kube.patch(claim, apply_claim)
         CLAIMS_REGISTERED.inc()
+        NODES_CREATED.inc(
+            labels={"nodepool": claim.metadata.labels.get(wk.NODEPOOL_LABEL_KEY, "")}
+        )
 
     # -- initialization (initialization.go:46-89) -----------------------------
 

@@ -16,7 +16,7 @@ from karpenter_tpu.kube.client import KubeClient
 from karpenter_tpu.metrics import REGISTRY
 
 CLAIMS_TERMINATED = REGISTRY.counter(
-    "nodeclaims_terminated_total", "NodeClaims fully terminated",
+    "terminated_total", "NodeClaims fully terminated",
     subsystem="nodeclaims",
 )
 

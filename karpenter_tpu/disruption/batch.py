@@ -593,3 +593,45 @@ def bench_candidate_scoring(n_candidates: int = 100, mesh="auto") -> Dict[str, i
         # (parallel/mesh.py batched_screen); 1x means vmap on a single device
         "mesh_devices": 1 if mesh is None else int(mesh.devices.size),
     }
+
+
+class ScreenSession:
+    """One reconcile pass's shared screen: the union problem is encoded once
+    and every subset the Multi + Single methods ask about is scored in as few
+    device launches as possible (VERDICT: stack all probes of a pass into one
+    program). Sound because methods run back-to-back within a pass with no
+    command executed in between — the cluster state the scorer encoded cannot
+    change until the pass picks an action (controller.go:127-171, one action
+    per pass)."""
+
+    def __init__(self):
+        self._key = None
+        self._scorer: Optional[UnionScorer] = None
+        self._verdicts: Dict[tuple, SubsetVerdict] = {}
+
+    def scorer_for(self, provisioner, candidates) -> Optional[UnionScorer]:
+        key = tuple(c.name for c in candidates)
+        if self._key != key:
+            self._scorer = build_scorer(provisioner, candidates)
+            self._key = key
+            self._verdicts = {}
+        return self._scorer
+
+    def score(self, subsets, extra=()) -> List[SubsetVerdict]:
+        """Verdicts for ``subsets``; cache misses are batched into ONE device
+        launch together with ``extra`` speculative subsets (a later method's
+        expected queries — Multi passes the singleton probes Single will ask
+        for, so the whole pass usually costs one launch)."""
+        assert self._scorer is not None
+        want = [tuple(s) for s in subsets]
+        missing = [s for s in want if s not in self._verdicts]
+        missing += [
+            t for t in (tuple(s) for s in extra)
+            if t not in self._verdicts and t not in missing
+        ]
+        if missing:
+            for key, verdict in zip(
+                missing, self._scorer.score_subsets([list(s) for s in missing])
+            ):
+                self._verdicts[key] = verdict
+        return [self._verdicts[s] for s in want]

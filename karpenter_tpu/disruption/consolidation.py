@@ -75,6 +75,10 @@ class ConsolidationBase:
     def __init__(self, provisioner: Provisioner, clock):
         self.provisioner = provisioner
         self.clock = clock
+        # per-reconcile-pass shared screen (disruption/batch.py
+        # ScreenSession); the controller installs a fresh one each pass so
+        # Multi's and Single's probes share one encode + device launch
+        self.screen_session = None
 
     def _any_prefer_no_schedule(self) -> bool:
         """Whether any pool's template carries a PreferNoSchedule taint — the
@@ -87,6 +91,22 @@ class ConsolidationBase:
                 if t.effect == "PreferNoSchedule":
                     return True
         return False
+
+
+    def _session_scorer(self, ordered):
+        """(scorer, score_fn) through the pass's ScreenSession when one is
+        installed, else a one-shot scorer."""
+        from karpenter_tpu.disruption.batch import build_scorer
+
+        if self.screen_session is not None:
+            scorer = self.screen_session.scorer_for(self.provisioner, ordered)
+            return scorer, (
+                self.screen_session.score if scorer is not None else None
+            )
+        scorer = build_scorer(self.provisioner, ordered)
+        if scorer is None:
+            return None, None
+        return scorer, lambda subsets, extra=(): scorer.score_subsets(subsets)
 
     def should_disrupt(self, candidate: Candidate) -> bool:
         """Policy gate (consolidation.go ShouldDisrupt): only pools asking for
@@ -255,13 +275,15 @@ class MultiNodeConsolidation(ConsolidationBase):
     def _screen_best_prefix(self, ordered: Sequence[Candidate]) -> int:
         """Largest prefix size the batched screen accepts (0 = none)."""
         try:
-            from karpenter_tpu.disruption.batch import build_scorer
-
-            scorer = build_scorer(self.provisioner, ordered)
+            scorer, score = self._session_scorer(ordered)
             if scorer is None:
                 return 0
             subsets = [list(range(k + 1)) for k in range(len(ordered))]
-            verdicts = scorer.score_subsets(subsets)
+            # speculative singletons: SingleNodeConsolidation will probe the
+            # same candidates later this pass; batching its queries into this
+            # launch makes the whole pass one device program
+            singletons = [[i] for i in range(len(ordered))]
+            verdicts = score(subsets, extra=singletons)
             for k in range(len(ordered), 0, -1):
                 if verdicts[k - 1].consolidatable_with(
                     ordered[:k], scorer.inputs.instance_types
@@ -349,15 +371,15 @@ class SingleNodeConsolidation(ConsolidationBase):
 
     def _screen(self, ordered: Sequence[Candidate]):
         """Indices of screen-accepted candidates in priority order, or None
-        when the screen is unavailable (fall back to the linear scan)."""
+        when the screen is unavailable (fall back to the linear scan). When
+        MultiNodeConsolidation already ran this pass with the same candidate
+        list, the session returns cached verdicts with no new device launch."""
         try:
-            from karpenter_tpu.disruption.batch import build_scorer
-
-            scorer = build_scorer(self.provisioner, ordered)
+            scorer, score = self._session_scorer(ordered)
             if scorer is None:
                 return None
             subsets = [[i] for i in range(len(ordered))]
-            verdicts = scorer.score_subsets(subsets)
+            verdicts = score(subsets)
             return [
                 i
                 for i, v in enumerate(verdicts)

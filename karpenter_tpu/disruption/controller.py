@@ -38,12 +38,12 @@ from karpenter_tpu.utils.clock import Clock
 POLL_PERIOD_SECONDS = 10.0  # controller.go:56
 
 EVALUATION_DURATION = REGISTRY.histogram(
-    "disruption_evaluation_duration_seconds",
+    "evaluation_duration_seconds",
     "Duration of one disruption evaluation pass",
     subsystem="disruption",
 )
 ELIGIBLE_NODES = REGISTRY.gauge(
-    "disruption_eligible_nodes", "Eligible candidates at last pass",
+    "eligible_nodes", "Eligible candidates at last pass",
     subsystem="disruption",
 )
 
@@ -70,6 +70,7 @@ class Controller:
         clock: Clock,
         recorder: Recorder,
         queue: Optional[Queue] = None,
+        drift_enabled: bool = True,
     ):
         self.kube = kube
         self.cluster = cluster
@@ -80,7 +81,7 @@ class Controller:
         self.queue = queue if queue is not None else Queue(kube, cluster, clock, recorder)
         self.methods = [
             Expiration(provisioner, clock),
-            Drift(provisioner, clock),
+            Drift(provisioner, clock, enabled=drift_enabled),
             Emptiness(provisioner, clock),
             EmptyNodeConsolidation(provisioner, clock),
             MultiNodeConsolidation(provisioner, clock),
@@ -103,7 +104,14 @@ class Controller:
         nodepool_map = build_nodepool_map(self.kube, self.cloud_provider)
         nodepools = nodepool_map[0]
         evaluated_consolidation = False
+        # one shared screen per pass: Multi's prefix launch carries Single's
+        # singleton probes, so the pass usually costs one device program
+        # (disruption/batch.py ScreenSession)
+        from karpenter_tpu.disruption.batch import ScreenSession
+
+        session = ScreenSession()
         for method in self.methods:
+            method.screen_session = session
             if self._consolidated_gate(method):
                 continue
             if isinstance(

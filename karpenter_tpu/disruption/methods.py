@@ -121,6 +121,26 @@ class Drift(_ConditionReplacer):
     method_name = "drift"
     condition = nc.DRIFTED
 
+    def __init__(self, provisioner: Provisioner, clock, enabled: bool = True):
+        super().__init__(provisioner, clock)
+        # the Drift feature gate is checked at the method too, not only at
+        # the condition-stamping marker (drift.go:56-60): conditions stamped
+        # before a restart disabled the gate must not trigger disruption
+        self.enabled = enabled
+
+    def should_disrupt(self, candidate: Candidate) -> bool:
+        return self.enabled and super().should_disrupt(candidate)
+
+    def order(self, candidates: Sequence[Candidate]) -> List[Candidate]:
+        """Earliest-drifted first (drift.go:62-72)."""
+
+        def drifted_at(c: Candidate) -> float:
+            claim = c.node_claim
+            cond = claim.status.conditions.get(self.condition) if claim else None
+            return cond.last_transition_time if cond is not None else float("inf")
+
+        return sorted(candidates, key=drifted_at)
+
 
 class Expiration(_ConditionReplacer):
     method_name = "expiration"

@@ -26,10 +26,10 @@ from karpenter_tpu.utils.clock import Clock
 COMMAND_TIMEOUT_SECONDS = 600.0  # queue.go:52
 
 QUEUE_DEPTH = REGISTRY.gauge(
-    "disruption_queue_depth", "Commands waiting on replacements", subsystem="disruption"
+    "queue_depth", "Commands waiting on replacements", subsystem="disruption"
 )
 ACTIONS_PERFORMED = REGISTRY.counter(
-    "disruption_actions_performed_total", "Completed disruption commands",
+    "actions_performed_total", "Completed disruption commands",
     subsystem="disruption",
 )
 

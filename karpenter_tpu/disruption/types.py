@@ -112,8 +112,10 @@ def new_candidate(
         raise IneligibleError("node is deleting or already disrupting")
     if is_nominated:
         raise IneligibleError("node is nominated for pending pods")
-    # the node-level do-not-disrupt annotation blocks candidacy outright
-    # (types.go:78-81); distinct from the per-pod annotation below
+    # the node-level do-not-disrupt annotation blocks candidacy outright on
+    # KEY PRESENCE — the reference deliberately ignores the value here
+    # (types.go:78-81), unlike the per-pod check below which requires the
+    # value "true" (pod/scheduling.go:91)
     if wk.DO_NOT_DISRUPT_ANNOTATION_KEY in state_node.annotations():
         raise IneligibleError(
             f"disruption is blocked through the "

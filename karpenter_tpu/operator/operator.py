@@ -94,6 +94,7 @@ class Operator:
         self.disruption = DisruptionController(
             self.kube, self.cluster, self.provisioner, self.cloud_provider,
             self.clock, self.recorder,
+            drift_enabled=self.options.drift_enabled(),
         )
         self.lifecycle = LifecycleController(
             self.kube, self.cloud_provider, self.clock, self.recorder

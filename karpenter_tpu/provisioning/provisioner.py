@@ -18,7 +18,7 @@ from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.apis.nodeclaim import NodeClaim
 from karpenter_tpu.apis.nodepool import NodePool, order_by_weight
 from karpenter_tpu.apis.validation import validate_nodepool
-from karpenter_tpu.apis.objects import IN, ObjectMeta, Pod
+from karpenter_tpu.apis.objects import IN, ObjectMeta, OwnerReference, Pod
 from karpenter_tpu.cloudprovider.types import CloudProvider, InstanceType, order_by_price
 from karpenter_tpu.events import Recorder, object_event
 from karpenter_tpu.kube.client import KubeClient
@@ -53,6 +53,10 @@ from karpenter_tpu.utils.clock import Clock
 # 100 cheapest (nodeclaimtemplate.go:55-81).
 MAX_INSTANCE_TYPES_PER_CLAIM = 100
 
+# metrics.go:30-41 — claims created, by owning pool
+NODECLAIMS_CREATED = REGISTRY.counter(
+    "created_total", "NodeClaims created", subsystem="nodeclaims"
+)
 SCHEDULING_DURATION = REGISTRY.histogram(
     "scheduling_duration_seconds",
     "Duration of one scheduling pass",
@@ -131,6 +135,49 @@ def validate_pod(pod: Pod) -> None:
             raise ValidationError(f"maxSkew must be >= 1, got {cs.max_skew}")
 
 
+def resolve_affinity_namespaces(kube: KubeClient, pod: Pod, universe=None):
+    """Resolve each pod-(anti)affinity term's namespaceSelector into an
+    explicit namespace list against the live Namespace objects, at the kube
+    boundary — the solver core never needs an apiserver
+    (topology.go buildNamespaceList: the term's namespaces list is UNIONED
+    with the selector's matches; a non-nil empty selector matches ALL
+    namespaces). ``universe`` memoizes the Namespace listing across the pods
+    of one pass (cluster state is fixed within it); the possibly-updated
+    universe is returned."""
+    from karpenter_tpu.apis.objects import Namespace
+
+    aff = pod.spec.affinity
+    if aff is None:
+        return universe
+    terms = []
+    for src in (aff.pod_affinity, aff.pod_anti_affinity):
+        if src is None:
+            continue
+        terms.extend(src.required)
+        terms.extend(w.pod_affinity_term for w in src.preferred)
+    if not any(t.namespace_selector is not None for t in terms):
+        return universe
+    if universe is None:
+        universe = kube.list(Namespace)
+    for term in terms:
+        sel = term.namespace_selector
+        if sel is None:
+            continue
+        resolved = set(term.namespaces)
+        resolved |= {
+            ns.metadata.name
+            for ns in universe
+            if sel.matches(ns.metadata.labels)
+        }
+        # a selector that matched NOTHING must stay unsatisfiable — an empty
+        # list would read downstream as "the pod's own namespace"
+        # (topology.py _namespace_list). "" is not a legal namespace name, so
+        # no pod can ever match it.
+        term.namespaces = sorted(resolved) if resolved else [""]
+        term.namespace_selector = None
+    return universe
+
+
 class Provisioner:
     def __init__(
         self,
@@ -156,6 +203,10 @@ class Provisioner:
         for pod in self.kube.list(Pod, predicate=podutil.is_provisionable):
             try:
                 validate_pod(pod)
+                # storage that can never bind keeps the pod out of the solve
+                # (provisioner.go:416 -> volumetopology.go:144-183); other
+                # pods in the batch still provision
+                self.volume_topology.validate_persistent_volume_claims(pod)
             except (ValidationError, ValueError) as e:
                 self.recorder.publish(
                     object_event(pod, "Warning", "FailedValidation", str(e))
@@ -193,9 +244,11 @@ class Provisioner:
         # fold volume-implied topology into every pod entering the solve —
         # pending, drained-node, and consolidation-candidate pods alike
         # (provisioner.go:284 -> volumetopology.go:41)
+        ns_universe = None
         for pod in pods:
             if pod.spec.volumes:
                 self.volume_topology.inject(pod)
+            ns_universe = resolve_affinity_namespaces(self.kube, pod, ns_universe)
         nodepools = [
             np
             for np in self.kube.list(NodePool)
@@ -448,6 +501,7 @@ class Provisioner:
                     continue
             claim = self._to_node_claim(placement, inputs, np_obj)
             self.kube.create(claim)
+            NODECLAIMS_CREATED.inc(labels={"nodepool": np_obj.name})
             created.append(claim)
             claim_pods[claim.metadata.name] = list(placement.pod_indices)
             for pi in placement.pod_indices:
@@ -486,6 +540,14 @@ class Provisioner:
                 namespace="",
                 labels=labels,
                 annotations={wk.NODEPOOL_HASH_ANNOTATION_KEY: np_obj.hash()},
+                # the owning pool, as the reference stamps it
+                # (nodeclaimtemplate.go ToNodeClaim OwnerReferences;
+                # suite_test.go:1062-1079)
+                owner_references=[
+                    OwnerReference(
+                        kind="NodePool", name=np_obj.name, controller=True
+                    )
+                ],
                 # ages/TTLs are measured against the injected clock
                 creation_timestamp=self.clock.now(),
             ),

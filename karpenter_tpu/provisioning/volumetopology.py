@@ -49,6 +49,40 @@ class VolumeTopology:
             na.required = [NodeSelectorTerm(match_expressions=list(requirements))]
         return pod
 
+    def validate_persistent_volume_claims(self, pod: Pod) -> None:
+        """A pod whose storage can never bind must not reach the solver
+        (volumetopology.go:144-183): every PVC volume needs an existing PVC;
+        a bound PVC's PV must exist; an unbound PVC must name an existing
+        StorageClass. Raises ValueError with the failing object named."""
+        for volume in pod.spec.volumes:
+            if volume.persistent_volume_claim is None:
+                # ephemeral/hostPath/emptyDir etc. have no PVC to validate
+                continue
+            name = volume.persistent_volume_claim.claim_name
+            pvc = self.kube.get_opt(
+                PersistentVolumeClaim, name, pod.metadata.namespace
+            )
+            if pvc is None:
+                raise ValueError(f"pvc {name!r} not found")
+            if pvc.volume_name:
+                if self.kube.get_opt(PersistentVolume, pvc.volume_name, "") is None:
+                    raise ValueError(
+                        f"pvc {name!r} bound to missing volume {pvc.volume_name!r}"
+                    )
+                continue
+            if pvc.storage_class_name == "":
+                # explicitly classless and unbound: can never bind. A None
+                # (nil) class means "use the default" — real clusters stamp
+                # the default via admission defaulting before the provisioner
+                # ever sees the PVC; this store has no defaulting webhook, so
+                # the default resolves here instead.
+                raise ValueError(f"unbound pvc {name!r} must define a storage class")
+            if resolve_storage_class(self.kube, pvc.storage_class_name) is None:
+                raise ValueError(
+                    f"pvc {name!r} names missing storage class "
+                    f"{pvc.storage_class_name!r}"
+                )
+
     def _volume_requirements(self, pod: Pod, volume) -> List[NodeSelectorRequirement]:
         if volume.persistent_volume_claim is not None:
             pvc = self.kube.get_opt(

@@ -1,0 +1,158 @@
+"""Pod-affinity namespace filtering families.
+
+Behavioral ports of topology_test.go:2244-2366: a pod-affinity term only sees
+target pods in its own namespace unless the term names other namespaces
+explicitly or carries a namespaceSelector; a non-nil EMPTY selector matches
+ALL namespaces. The selector resolves to an explicit namespace list at the
+kube boundary (provisioner.resolve_affinity_namespaces) so the solver core
+stays apiserver-free.
+
+Also ports the dependent-affinity chains of :2114-2243: affinity to a pod
+that doesn't exist, multiple dependent affinities, and unsatisfiable
+dependency chains.
+"""
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.objects import (
+    Affinity,
+    LabelSelector,
+    Namespace,
+    ObjectMeta,
+    PodAffinity,
+    PodAffinityTerm,
+)
+
+from tests.factories import make_pod
+from tests.harness import Env
+from tests.factories import make_nodepool
+
+
+def _affine(name, target_labels, namespaces=(), ns_selector=None,
+            key=wk.LABEL_HOSTNAME, namespace="default", labels=None):
+    p = make_pod(name=name, cpu=0.1, namespace=namespace, labels=labels or {})
+    p.spec.affinity = Affinity(
+        pod_affinity=PodAffinity(
+            required=[
+                PodAffinityTerm(
+                    topology_key=key,
+                    label_selector=LabelSelector(match_labels=dict(target_labels)),
+                    namespaces=list(namespaces),
+                    namespace_selector=ns_selector,
+                )
+            ]
+        )
+    )
+    return p
+
+
+def test_affinity_ignores_other_namespace_without_list():
+    # topology_test.go:2244-2281 — the target lives in another namespace and
+    # the term names none, so the affinity can never be satisfied
+    env = Env()
+    env.create(make_nodepool())
+    env.create(Namespace(metadata=ObjectMeta(name="other-ns", namespace="")))
+    target = make_pod(name="target", cpu=0.1, namespace="other-ns",
+                      labels={"security": "s2"})
+    follower = _affine("follower", {"security": "s2"})
+    env.expect_provisioned(target, follower)
+    env.expect_scheduled(target)
+    env.expect_not_scheduled(follower)
+
+
+def test_affinity_namespace_list_reaches_other_namespace():
+    # topology_test.go:2282-2320
+    env = Env()
+    env.create(make_nodepool())
+    env.create(Namespace(metadata=ObjectMeta(name="other-ns", namespace="")))
+    target = make_pod(name="target", cpu=0.1, namespace="other-ns",
+                      labels={"security": "s2"})
+    follower = _affine("follower", {"security": "s2"}, namespaces=["other-ns"])
+    env.expect_provisioned(target, follower)
+    n1 = env.expect_scheduled(target)
+    n2 = env.expect_scheduled(follower)
+    assert n1 == n2
+
+
+def test_affinity_empty_namespace_selector_matches_all():
+    # topology_test.go:2321-2366 — a non-nil empty selector selects every
+    # namespace
+    env = Env()
+    env.create(make_nodepool())
+    env.create(Namespace(metadata=ObjectMeta(name="other-ns", namespace="")))
+    target = make_pod(name="target", cpu=0.1, namespace="other-ns",
+                      labels={"security": "s2"})
+    follower = _affine(
+        "follower", {"security": "s2"}, ns_selector=LabelSelector()
+    )
+    env.expect_provisioned(target, follower)
+    n1 = env.expect_scheduled(target)
+    n2 = env.expect_scheduled(follower)
+    assert n1 == n2
+
+
+def test_affinity_namespace_selector_by_labels():
+    # the labeled namespace matches; the unlabeled one does not
+    env = Env()
+    env.create(make_nodepool())
+    env.create(Namespace(metadata=ObjectMeta(
+        name="prod-ns", namespace="", labels={"tier": "prod"})))
+    env.create(Namespace(metadata=ObjectMeta(name="dev-ns", namespace="")))
+    target = make_pod(name="target", cpu=0.1, namespace="prod-ns",
+                      labels={"security": "s2"})
+    follower = _affine(
+        "follower", {"security": "s2"},
+        ns_selector=LabelSelector(match_labels={"tier": "prod"}),
+    )
+    env.expect_provisioned(target, follower)
+    assert env.expect_scheduled(target) == env.expect_scheduled(follower)
+
+
+def test_affinity_to_nonexistent_pod_fails():
+    # topology_test.go:2114-2130
+    env = Env()
+    env.create(make_nodepool())
+    follower = _affine("follower", {"security": "nobody"})
+    env.expect_provisioned(follower)
+    env.expect_not_scheduled(follower)
+
+
+def test_multiple_dependent_affinities_chain():
+    # topology_test.go:2193-2227 — a -> b -> c -> d chain all lands
+    env = Env()
+    env.create(make_nodepool())
+    a = make_pod(name="a", cpu=0.1, labels={"app": "a"})
+    b = _affine("b", {"app": "a"}, labels={"app": "b"})
+    c = _affine("c", {"app": "b"}, labels={"app": "c"})
+    d = _affine("d", {"app": "c"}, labels={"app": "d"})
+    env.expect_provisioned(a, b, c, d)
+    names = {env.expect_scheduled(p) for p in (a, b, c, d)}
+    assert len(names) == 1  # hostname affinity chains onto one node
+
+
+def test_unsatisfiable_dependency_chain_fails_only_the_dependents():
+    # topology_test.go:2228-2243 — the broken link fails; the root schedules
+    env = Env()
+    env.create(make_nodepool())
+    a = make_pod(name="a", cpu=0.1, labels={"app": "a"})
+    broken = _affine("broken", {"app": "missing"}, labels={"app": "b"})
+    dependent = _affine("dependent", {"app": "b"}, labels={"app": "c"})
+    env.expect_provisioned(a, broken, dependent)
+    env.expect_scheduled(a)
+    env.expect_not_scheduled(broken)
+    env.expect_not_scheduled(dependent)
+
+
+def test_affinity_selector_matching_nothing_stays_unsatisfiable():
+    # a namespaceSelector that matches zero namespaces must NOT collapse to
+    # "own namespace": the term is unsatisfiable even with a same-namespace
+    # target present
+    env = Env()
+    env.create(make_nodepool())
+    target = make_pod(name="target", cpu=0.1, labels={"security": "s2"})
+    follower = _affine(
+        "follower", {"security": "s2"},
+        ns_selector=LabelSelector(match_labels={"team": "nonexistent"}),
+    )
+    env.expect_provisioned(target, follower)
+    env.expect_scheduled(target)
+    env.expect_not_scheduled(follower)

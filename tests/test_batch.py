@@ -225,3 +225,48 @@ def test_single_node_screen_orders_by_disruption_cost():
     cmd = method.compute_command({"default": 100}, ordered)
     assert cmd.decision != DECISION_NONE
     assert [c.name for c in cmd.candidates] == ["cheap"]
+
+
+def test_screen_session_shares_one_scorer_across_methods(monkeypatch):
+    """One reconcile pass = one union encode + one device launch: Multi's
+    prefix screen carries Single's singleton probes (ScreenSession), so
+    Single's screen afterwards must hit the cache entirely."""
+    import karpenter_tpu.disruption.batch as bm
+    from tests.factories import make_pod
+    from tests.harness import Env
+    from tests.test_disruption import make_underutilized_pool
+
+    env = Env()
+    env.create(make_underutilized_pool())
+    # two candidates, deletable: pods fit on the big host
+    big = [make_pod(name=f"b{i}", cpu=1.2, owner_kind="ReplicaSet") for i in range(2)]
+    for p in big:
+        env.create(p)
+    env.create_candidate_node("n-host", pods=big)
+    for name in ("n1", "n2"):
+        p = make_pod(name=f"p-{name}", cpu=0.1, owner_kind="ReplicaSet")
+        env.create(p)
+        env.create_candidate_node(name, pods=[p])
+
+    builds = []
+    score_calls = []
+    orig_build = bm.build_scorer
+    orig_score = bm.UnionScorer.score_subsets
+
+    def counting_build(provisioner, candidates):
+        builds.append(tuple(c.name for c in candidates))
+        return orig_build(provisioner, candidates)
+
+    def counting_score(self, subsets, **kw):
+        score_calls.append(len(subsets))
+        return orig_score(self, subsets, **kw)
+
+    monkeypatch.setattr(bm, "build_scorer", counting_build)
+    monkeypatch.setattr(bm.UnionScorer, "score_subsets", counting_score)
+
+    ctrl = env.disruption_controller()
+    assert ctrl.reconcile() is None  # parks a pending command
+    assert ctrl.pending is not None
+    # the whole pass built ONE scorer and launched ONE batched screen
+    assert len(builds) == 1, builds
+    assert len(score_calls) == 1, score_calls

@@ -1,0 +1,157 @@
+"""Drift / Emptiness / Expiration method behavior families.
+
+Behavioral ports of the reference's per-method suites
+(pkg/controllers/disruption/{drift,emptiness,expiration}_test.go) beyond the
+basics the earlier rounds covered: the Drift feature gate at the method level
+(drift_test.go:76-93), skipping to the next marked node when the first can't
+reschedule its pods (drift_test.go:94-154, expiration_test.go:145-205),
+False-status conditions (drift_test.go:226), earliest-drift ordering
+(drift_test.go:502-560), parallel empty-marked deletion (drift_test.go:264),
+and untainting when a replacement launch fails (drift_test.go:361-404) —
+driven through the orchestration queue's vanished-replacement rollback.
+"""
+
+from karpenter_tpu.apis import labels as wk, nodeclaim as nc
+from karpenter_tpu.apis.nodeclaim import NodeClaim
+from karpenter_tpu.apis.objects import Node
+from karpenter_tpu.disruption.controller import Controller
+from karpenter_tpu.disruption.types import DECISION_DELETE, DECISION_REPLACE
+from karpenter_tpu.state.statenode import disruption_taint
+
+from tests.factories import make_pod
+from tests.harness import Env
+from tests.test_disruption import make_underutilized_pool
+
+
+def _mark(env, claim_name, condition, at=None):
+    claim = env.kube.get(NodeClaim, claim_name, "")
+    if at is None:
+        claim.status.conditions.set_true(condition)
+    else:
+        claim.status.conditions.set_true(condition, now=at)
+    env.kube.update(claim)
+
+
+def _drifted_controller(env, drift_enabled=True):
+    return Controller(
+        env.kube, env.cluster, env.provisioner, env.cloud_provider,
+        env.clock, env.recorder, drift_enabled=drift_enabled,
+    )
+
+
+def test_drift_feature_gate_disables_method():
+    # drift_test.go:76-93 — a Drifted condition stamped earlier must be
+    # ignored when the gate is off
+    env = Env()
+    env.create(make_underutilized_pool())
+    env.create_candidate_node("n1")
+    _mark(env, "claim-n1", nc.DRIFTED)
+    ctrl = _drifted_controller(env, drift_enabled=False)
+    assert ctrl.reconcile() is None
+    assert env.kube.get_opt(NodeClaim, "claim-n1", "") is not None
+    # same cluster, gate on: the empty drifted node is deleted
+    ctrl2 = _drifted_controller(env, drift_enabled=True)
+    cmd = ctrl2.reconcile()
+    assert cmd is not None and cmd.method == "drift"
+
+
+def test_false_conditions_are_ignored():
+    # drift_test.go:226-240 / emptiness_test.go:163 / expiration_test.go:206
+    env = Env()
+    env.create(make_underutilized_pool())
+    env.create_candidate_node("n1")
+    claim = env.kube.get(NodeClaim, "claim-n1", "")
+    for cond in (nc.DRIFTED, nc.EXPIRED, nc.EMPTY):
+        claim.status.conditions.set_false(cond)
+    env.kube.update(claim)
+    ctrl = _drifted_controller(env)
+    assert ctrl.reconcile() is None
+    assert env.kube.get_opt(NodeClaim, "claim-n1", "") is not None
+
+
+def test_drift_skips_to_next_when_pods_cannot_reschedule():
+    # drift_test.go:94-154 — n-stuck's pod fits nowhere else; the method must
+    # move on and replace n-next instead of wedging on the first candidate
+    env = Env()
+    env.create(make_underutilized_pool())
+    big = make_pod(name="big", cpu=64.0, owner_kind="ReplicaSet")
+    env.create(big)
+    env.create_candidate_node("n-stuck", pods=[big])
+    small = make_pod(name="small", cpu=0.5, owner_kind="ReplicaSet")
+    env.create(small)
+    env.create_candidate_node("n-next", pods=[small])
+    _mark(env, "claim-n-stuck", nc.DRIFTED)
+    _mark(env, "claim-n-next", nc.DRIFTED)
+    ctrl = _drifted_controller(env)
+    cmd = ctrl.reconcile()
+    assert cmd is not None and cmd.method == "drift"
+    assert [c.name for c in cmd.candidates] == ["n-next"]
+    assert cmd.decision == DECISION_REPLACE
+    assert env.kube.get_opt(NodeClaim, "claim-n-stuck", "") is not None
+
+
+def test_empty_marked_nodes_disrupt_in_parallel():
+    # drift_test.go:264-306 — ALL empty drifted nodes go in one command
+    env = Env()
+    env.create(make_underutilized_pool())
+    for name in ("n1", "n2", "n3"):
+        env.create_candidate_node(name)
+        _mark(env, f"claim-{name}", nc.DRIFTED)
+    ctrl = _drifted_controller(env)
+    cmd = ctrl.reconcile()
+    assert cmd is not None and cmd.decision == DECISION_DELETE
+    assert sorted(c.name for c in cmd.candidates) == ["n1", "n2", "n3"]
+
+
+def test_drift_handles_earliest_drifted_first():
+    # drift_test.go:502-560 — one occupied node per pass, earliest drift wins
+    env = Env()
+    env.create(make_underutilized_pool())
+    for name, when in (("n-late", 100.0), ("n-early", 50.0)):
+        pod = make_pod(name=f"pod-{name}", cpu=0.5, owner_kind="ReplicaSet")
+        env.create(pod)
+        env.create_candidate_node(name, pods=[pod])
+        _mark(env, f"claim-{name}", nc.DRIFTED, at=when)
+    ctrl = _drifted_controller(env)
+    cmd = ctrl.reconcile()
+    assert cmd is not None and cmd.method == "drift"
+    assert [c.name for c in cmd.candidates] == ["n-early"]
+
+
+def test_expiration_skips_to_next_when_pods_cannot_reschedule():
+    # expiration_test.go:145-205
+    env = Env()
+    env.create(make_underutilized_pool())
+    big = make_pod(name="big", cpu=64.0, owner_kind="ReplicaSet")
+    env.create(big)
+    env.create_candidate_node("n-stuck", pods=[big])
+    small = make_pod(name="small", cpu=0.5, owner_kind="ReplicaSet")
+    env.create(small)
+    env.create_candidate_node("n-next", pods=[small])
+    _mark(env, "claim-n-stuck", nc.EXPIRED)
+    _mark(env, "claim-n-next", nc.EXPIRED)
+    ctrl = _drifted_controller(env)
+    cmd = ctrl.reconcile()
+    assert cmd is not None and cmd.method == "expiration"
+    assert [c.name for c in cmd.candidates] == ["n-next"]
+
+
+def test_drift_replacement_failure_untaints():
+    # drift_test.go:361-404 — the replacement claim dies (launch failure /
+    # GC); the queue's rollback must untaint the candidate and keep it
+    env = Env()
+    env.create(make_underutilized_pool())
+    pod = make_pod(name="app", cpu=0.5, owner_kind="ReplicaSet")
+    env.create(pod)
+    env.create_candidate_node("n1", pods=[pod])
+    _mark(env, "claim-n1", nc.DRIFTED)
+    ctrl = _drifted_controller(env)
+    cmd = ctrl.reconcile()
+    assert cmd is not None and cmd.method == "drift" and cmd.replacements
+    # the launch fails: lifecycle would delete the claim; model that directly
+    env.kube.delete(NodeClaim, cmd.replacements[0].metadata.name, "")
+    ctrl.queue.reconcile()
+    node = env.kube.get(Node, "n1", "")
+    assert not any(t.match(disruption_taint()) for t in node.spec.taints)
+    assert env.kube.get_opt(NodeClaim, "claim-n1", "") is not None
+    assert not env.cluster.node_for_name("n1").marked_for_deletion()

@@ -390,3 +390,25 @@ def test_ignores_deleting_nodepools():
     env.expect_provisioned(pod)
     assert env.nodeclaims() == []
     env.expect_not_scheduled(pod)
+
+
+def test_created_claims_carry_owner_and_nodeclass_refs():
+    """Created NodeClaims reference their owning NodePool
+    (suite_test.go:1062-1079) and propagate the nodeClassRef
+    (suite_test.go:1080-1107)."""
+    from karpenter_tpu.apis.nodepool import NodeClassReference
+
+    env = Env()
+    pool = make_nodepool()
+    pool.spec.template.spec.node_class_ref = NodeClassReference(
+        name="test-class", kind="NodeClass", api_version="cloud/v1"
+    )
+    env.create(pool)
+    pass_ = env.expect_provisioned(make_pod(name="p1", cpu=0.5))
+    assert pass_.created
+    claim = pass_.created[0]
+    owners = claim.metadata.owner_references
+    assert len(owners) == 1 and owners[0].kind == "NodePool"
+    assert owners[0].name == "default" and owners[0].controller
+    ref = claim.spec.node_class_ref
+    assert ref is not None and ref.name == "test-class" and ref.kind == "NodeClass"

@@ -179,3 +179,52 @@ def test_termination_deletes_nodeclaims_and_labels_for_lb_exclusion():
     assert claim is None or claim.metadata.deletion_timestamp is not None, (
         "the node's claim must be deleted alongside it"
     )
+
+
+def test_lifecycle_metrics_fire_on_create_and_terminate():
+    """nodes_created / nodes_terminated / nodeclaims_created counters
+    (metrics.go:30-41,111-133; suite_test.go:587-597) fire at registration,
+    finalizer removal, and claim creation respectively."""
+    from karpenter_tpu.controllers.node_termination import NODES_TERMINATED
+    from karpenter_tpu.controllers.nodeclaim_lifecycle import (
+        LifecycleController,
+        NODES_CREATED,
+    )
+    from karpenter_tpu.provisioning.provisioner import NODECLAIMS_CREATED
+
+    env = Env()
+    env.create(make_nodepool())
+    labels = {"nodepool": "default"}
+    created0 = NODECLAIMS_CREATED.value(labels)
+    nodes0 = NODES_CREATED.value({"nodepool": "default"})
+    term0 = NODES_TERMINATED.value(labels)
+
+    # provision: the claim-created counter moves with the pool label
+    pod = make_pod(name="app", cpu=0.5)
+    pass_ = env.expect_provisioned(pod)
+    assert pass_.created
+    assert NODECLAIMS_CREATED.value(labels) == created0 + len(pass_.created)
+
+    # registration through the real lifecycle controller fires nodes_created
+    lc = LifecycleController(env.kube, env.cloud_provider, env.clock, env.recorder)
+    node2, claim_n2 = env.create_candidate_node("n-reg")
+    # strip the harness's pre-registration so the controller does it
+    claim_n2.status.conditions.set_false("Registered")
+    env.kube.update(claim_n2)
+    lc.reconcile(claim_n2)
+    assert NODES_CREATED.value({"nodepool": "default"}) == nodes0 + 1
+
+    # termination through the finalizer path fires nodes_terminated
+    lone = make_pod(name="lone", cpu=0.1)
+    node, _claim = env.create_candidate_node("n-term", pods=[lone])
+    stored = env.kube.get(Node, "n-term", "")
+    stored.metadata.finalizers.append(wk.TERMINATION_FINALIZER)
+    env.kube.update(stored)
+    env.kube.delete(Node, "n-term", "")
+    ctrl = NodeTerminationController(env.kube, env.cloud_provider, env.clock,
+                                     env.recorder)
+    for _ in range(5):
+        if ctrl.reconcile(stored) != "draining":
+            break
+        ctrl.eviction_queue.reconcile()
+    assert NODES_TERMINATED.value(labels) == term0 + 1

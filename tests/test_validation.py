@@ -365,3 +365,84 @@ class TestCELKubelet:
         claim = make_nodeclaim()
         claim.spec.kubelet = KubeletConfiguration(system_reserved={"gpu": 1})
         assert validate_nodeclaim(claim)
+
+
+class TestCelCorpusGaps:
+    """Remaining nodepool_validation_cel_test.go cases: runtime length caps
+    (:500,:563,:692), Gt/Lt value rules (:659-675), taint shape rules
+    (:511-534), and overlap-removal requirements (:646-653)."""
+
+    def test_requirement_key_too_long_fails_at_runtime(self):
+        # cel_test.go:563-573 — name segment is capped at 63 characters
+        long_key = "test.com.test.com/" + "a" * 64
+        assert validate_requirement(
+            NodeSelectorRequirement(key=long_key, operator="In", values=["v"])
+        )
+
+    def test_requirement_key_63_chars_is_valid(self):
+        key = "test.com/" + "a" * 63
+        assert validate_requirement(
+            NodeSelectorRequirement(key=key, operator="In", values=["v"])
+        ) == []
+
+    def test_label_prefix_too_long_fails(self):
+        # cel_test.go:692-702 — prefix (DNS subdomain) capped at 253 chars
+        prefix = ".".join(["a" * 63] * 5)  # 319 chars
+        assert validate_requirement(
+            NodeSelectorRequirement(key=f"{prefix}/name", operator="In", values=["v"])
+        )
+
+    @pytest.mark.parametrize("values,ok", [
+        (["1"], True),
+        (["0"], True),
+        (["-1"], False),        # cel_test.go:659 — negative
+        (["1.5"], False),       # non-integer
+        (["1", "2"], False),    # exactly one value
+        ([], False),
+    ])
+    def test_gt_lt_value_rules(self, values, ok):
+        for op in ("Gt", "Lt"):
+            errs = validate_requirement(
+                NodeSelectorRequirement(key="karpenter.test/x", operator=op,
+                                        values=list(values))
+            )
+            assert (errs == []) == ok, (op, values, errs)
+
+    def test_taint_missing_key_fails(self):
+        # cel_test.go:511-515
+        assert validate_taint(Taint(key="", value="v", effect="NoSchedule"))
+
+    def test_taint_invalid_value_fails(self):
+        # cel_test.go:516-520
+        assert validate_taint(Taint(key="ok", value="???", effect="NoSchedule"))
+
+    def test_taint_invalid_effect_fails(self):
+        # cel_test.go:521-525
+        assert validate_taint(Taint(key="ok", value="v", effect="NoShcedule"))
+
+    def test_same_taint_key_different_effects_allowed(self):
+        # cel_test.go:526-534
+        pool = make_nodepool()
+        pool.spec.template.spec.taints = [
+            Taint(key="a", value="b", effect="NoSchedule"),
+            Taint(key="a", value="b", effect="NoExecute"),
+        ]
+        assert validate_nodepool(pool) == []
+
+    def test_overlapped_value_removal_leaves_valid_set(self):
+        # cel_test.go:646-653 — In [a, b] plus NotIn [b] is a usable set
+        pool = make_nodepool()
+        pool.spec.template.spec.requirements = [
+            NodeSelectorRequirement(key="karpenter.test/x", operator="In",
+                                    values=["a", "b"]),
+            NodeSelectorRequirement(key="karpenter.test/x", operator="NotIn",
+                                    values=["b"]),
+        ]
+        assert validate_nodepool(pool) == []
+        # and the scheduling algebra agrees the set is non-empty
+        from karpenter_tpu.scheduling import Requirement
+
+        merged = Requirement("karpenter.test/x", "In", ["a", "b"]).intersection(
+            Requirement("karpenter.test/x", "NotIn", ["b"])
+        )
+        assert merged.has("a") and not merged.has("b") and len(merged) == 1

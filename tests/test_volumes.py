@@ -142,3 +142,118 @@ def test_volumeless_pods_unaffected_by_limits():
     pod = make_pod(name="p1", cpu=0.5)
     env.expect_provisioned(pod)
     assert env.expect_scheduled(pod) == "n1"
+
+
+# ---------------------------------------------------------------------------
+# PVC admission gate (provisioner.go:416 -> volumetopology.go:144-183;
+# provisioning suite_test.go:1160-1266)
+# ---------------------------------------------------------------------------
+
+
+def _pvc_pod(name, claim):
+    from karpenter_tpu.apis.objects import (
+        PersistentVolumeClaimVolume,
+        Volume,
+    )
+
+    p = make_pod(name=name, cpu=0.1)
+    p.spec.volumes = [
+        Volume(name="v0",
+               persistent_volume_claim=PersistentVolumeClaimVolume(claim_name=claim))
+    ]
+    return p
+
+
+def test_pod_with_missing_pvc_is_not_scheduled():
+    # suite_test.go:1160-1167
+    env = Env()
+    env.create(make_nodepool())
+    pod = _pvc_pod("invalid", "no-such-claim")
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_bound_pvc_with_empty_class_schedules_unbound_does_not():
+    # suite_test.go:1168-1197 — bound (volumeName set) is fine regardless of
+    # class; unbound with empty class cannot ever bind
+    from karpenter_tpu.apis.objects import (
+        ObjectMeta,
+        PersistentVolume,
+        PersistentVolumeClaim,
+    )
+
+    env = Env()
+    env.create(make_nodepool())
+    env.create(PersistentVolume(metadata=ObjectMeta(name="vol-1", namespace="")))
+    env.create(
+        PersistentVolumeClaim(
+            metadata=ObjectMeta(name="bound"), storage_class_name="",
+            volume_name="vol-1",
+        )
+    )
+    env.create(
+        PersistentVolumeClaim(metadata=ObjectMeta(name="unbound"),
+                              storage_class_name="")
+    )
+    ok = _pvc_pod("ok", "bound")
+    bad = _pvc_pod("bad", "unbound")
+    env.expect_provisioned(ok, bad)
+    env.expect_scheduled(ok)
+    env.expect_not_scheduled(bad)
+
+
+def test_missing_storage_class_gates_only_unbound_pvcs():
+    # suite_test.go:1198-1229
+    from karpenter_tpu.apis.objects import (
+        ObjectMeta,
+        PersistentVolume,
+        PersistentVolumeClaim,
+    )
+
+    env = Env()
+    env.create(make_nodepool())
+    env.create(PersistentVolume(metadata=ObjectMeta(name="vol-2", namespace="")))
+    env.create(
+        PersistentVolumeClaim(
+            metadata=ObjectMeta(name="bound"),
+            storage_class_name="missing-class", volume_name="vol-2",
+        )
+    )
+    env.create(
+        PersistentVolumeClaim(
+            metadata=ObjectMeta(name="unbound"),
+            storage_class_name="missing-class",
+        )
+    )
+    ok = _pvc_pod("ok", "bound")
+    bad = _pvc_pod("bad", "unbound")
+    env.expect_provisioned(ok, bad)
+    env.expect_scheduled(ok)
+    env.expect_not_scheduled(bad)
+
+
+def test_invalid_pvc_pod_does_not_poison_the_batch():
+    # suite_test.go:1230-1266 — valid pods schedule alongside the invalid one
+    env = Env()
+    env.create(make_nodepool())
+    bad = _pvc_pod("bad", "no-such-claim")
+    good = make_pod(name="good", cpu=0.1)
+    env.expect_provisioned(bad, good)
+    env.expect_not_scheduled(bad)
+    env.expect_scheduled(good)
+
+
+def test_pvc_bound_to_missing_volume_is_not_scheduled():
+    # volumetopology.go:155-159 — volumeName set but the PV is gone
+    from karpenter_tpu.apis.objects import ObjectMeta, PersistentVolumeClaim
+
+    env = Env()
+    env.create(make_nodepool())
+    env.create(
+        PersistentVolumeClaim(
+            metadata=ObjectMeta(name="dangling"), volume_name="gone-pv"
+        )
+    )
+    pod = _pvc_pod("bad", "dangling")
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
