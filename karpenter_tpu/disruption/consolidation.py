@@ -254,13 +254,13 @@ class MultiNodeConsolidation(ConsolidationBase):
     def compute_command(
         self, budgets: Dict[str, int], candidates: Sequence[Candidate]
     ) -> Command:
-        ordered = apply_budgets(sort_candidates(candidates), budgets)
-        ordered = ordered[:MULTI_NODE_MAX_CANDIDATES]
+        ordered_full = apply_budgets(sort_candidates(candidates), budgets)
+        ordered = ordered_full[:MULTI_NODE_MAX_CANDIDATES]
         if not ordered:
             return Command(method=self.method_name)
         deadline = self.clock.now() + MULTI_NODE_TIMEOUT_SECONDS
 
-        best_k = self._screen_best_prefix(ordered)
+        best_k = self._screen_best_prefix(ordered_full, len(ordered))
         # confirm screened prefixes sequentially, walking down on disagreement
         # (the sequential sim is the source of truth and builds the command)
         attempts = 0
@@ -272,21 +272,27 @@ class MultiNodeConsolidation(ConsolidationBase):
             attempts += 1
         return self._binary_search(ordered, deadline)
 
-    def _screen_best_prefix(self, ordered: Sequence[Candidate]) -> int:
-        """Largest prefix size the batched screen accepts (0 = none)."""
+    def _screen_best_prefix(
+        self, ordered_full: Sequence[Candidate], k_max: int
+    ) -> int:
+        """Largest prefix size (<= k_max, the reference's 100-candidate cap)
+        the batched screen accepts; 0 = none. The scorer is built over the
+        FULL candidate list so SingleNodeConsolidation's screen this pass
+        shares the same ScreenSession key — candidates beyond a prefix stay
+        live nodes in the union problem either way."""
         try:
-            scorer, score = self._session_scorer(ordered)
+            scorer, score = self._session_scorer(ordered_full)
             if scorer is None:
                 return 0
-            subsets = [list(range(k + 1)) for k in range(len(ordered))]
+            subsets = [list(range(k + 1)) for k in range(k_max)]
             # speculative singletons: SingleNodeConsolidation will probe the
             # same candidates later this pass; batching its queries into this
             # launch makes the whole pass one device program
-            singletons = [[i] for i in range(len(ordered))]
+            singletons = [[i] for i in range(len(ordered_full))]
             verdicts = score(subsets, extra=singletons)
-            for k in range(len(ordered), 0, -1):
+            for k in range(k_max, 0, -1):
                 if verdicts[k - 1].consolidatable_with(
-                    ordered[:k], scorer.inputs.instance_types
+                    ordered_full[:k], scorer.inputs.instance_types
                 ):
                     return k
             return 0

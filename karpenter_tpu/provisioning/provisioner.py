@@ -309,10 +309,16 @@ class Provisioner:
                         and not podutil.is_terminating(p):
                     bound_by_node.setdefault(p.spec.node_name, []).append(p)
         its_by_name = {it.name: it for it in instance_types}
+        # initialized nodes first, then by name (scheduler.go:311-322): in
+        # consolidation simulations pods must prefer nodes whose capacity is
+        # real over in-flight ones — the solver's first-fit picks the first
+        # eligible bin, so the order IS the preference
+        state_nodes = sorted(
+            (sn for sn in self.cluster.nodes() if not sn.marked_for_deletion()),
+            key=lambda sn: (not sn.initialized(), sn.name),
+        )
         nodes = []
-        for sn in self.cluster.nodes():
-            if sn.marked_for_deletion():
-                continue
+        for sn in state_nodes:
             nodes.append(
                 self._node_info(sn, daemon_pods, its_by_name, resolver,
                                 bound_by_node.get(sn.name, []))
@@ -411,6 +417,7 @@ class Provisioner:
     def _cluster_pods(self) -> List[Tuple[Pod, Dict[str, str]]]:
         node_labels = {sn.name: sn.labels() for sn in self.cluster.nodes()}
         pairs = []
+        ns_universe = None
         for p in self.kube.list(Pod):
             if not p.spec.node_name:
                 continue
@@ -418,6 +425,11 @@ class Provisioner:
                 continue
             labels = node_labels.get(p.spec.node_name)
             if labels is not None:
+                # existing pods' inverse anti-affinity terms need their
+                # namespaceSelectors resolved too (buildNamespaceList runs
+                # for census pods as well); the listing is a deep copy, so
+                # the mutation is pass-local
+                ns_universe = resolve_affinity_namespaces(self.kube, p, ns_universe)
                 pairs.append((p, labels))
         return pairs
 

@@ -56,7 +56,23 @@ class VolumeTopology:
         StorageClass. Raises ValueError with the failing object named."""
         for volume in pod.spec.volumes:
             if volume.persistent_volume_claim is None:
-                # ephemeral/hostPath/emptyDir etc. have no PVC to validate
+                # an ephemeral volume's PVC is generated at admission (the
+                # reference validates that generated claim, volume.go:28-44);
+                # this store has no ephemeral controller, so validate the one
+                # thing the spec itself pins: a NAMED storage class must exist
+                if (
+                    volume.ephemeral is not None
+                    and volume.ephemeral.storage_class_name
+                    and resolve_storage_class(
+                        self.kube, volume.ephemeral.storage_class_name
+                    )
+                    is None
+                ):
+                    raise ValueError(
+                        f"ephemeral volume {volume.name!r} names missing "
+                        f"storage class {volume.ephemeral.storage_class_name!r}"
+                    )
+                # hostPath/emptyDir etc. have no storage to validate
                 continue
             name = volume.persistent_volume_claim.claim_name
             pvc = self.kube.get_opt(

@@ -1,0 +1,176 @@
+"""Inverse anti-affinity with existing nodes + preference-conflict families.
+
+Behavioral ports of topology_test.go blocks not yet covered: required
+inverse anti-affinity projected from EXISTING cluster pods blocks a later
+batch (:1934-1983); preferred anti-affinity on existing pods does NOT
+(:1984-2033); a pod-affinity preference conflicting with a required spread
+constraint is violable (:2034-2068); and zone pod affinity with
+unconstrained / constrained targets (:2131-2192).
+"""
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.objects import (
+    Affinity,
+    DO_NOT_SCHEDULE,
+    LabelSelector,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+)
+
+from tests.factories import make_nodepool, make_pod
+from tests.harness import Env
+
+
+def _anti(name, target_labels, zone, required=True, cpu=2.0, labels=None):
+    term = PodAffinityTerm(
+        topology_key=wk.LABEL_TOPOLOGY_ZONE,
+        label_selector=LabelSelector(match_labels=dict(target_labels)),
+    )
+    anti = (
+        PodAntiAffinity(required=[term])
+        if required
+        else PodAntiAffinity(preferred=[WeightedPodAffinityTerm(weight=10, pod_affinity_term=term)])
+    )
+    return make_pod(
+        name=name, cpu=cpu, labels=labels or {},
+        node_selector={wk.LABEL_TOPOLOGY_ZONE: zone},
+        affinity=Affinity(pod_anti_affinity=anti),
+    )
+
+
+def test_required_inverse_anti_affinity_from_existing_pods_blocks():
+    # topology_test.go:1934-1983 — pods with required anti-affinity to
+    # "security: s2" hold every zone; a later plain s2 pod cannot land
+    env = Env()
+    env.create(make_nodepool())
+    guards = [
+        _anti(f"g{i}", {"security": "s2"}, zone)
+        for i, zone in enumerate(("test-zone-1", "test-zone-2", "test-zone-3"))
+    ]
+    env.expect_provisioned(*guards)
+    for g in guards:
+        env.expect_scheduled(g)
+    victim = make_pod(name="victim", cpu=0.1, labels={"security": "s2"})
+    env.expect_provisioned(victim)
+    env.expect_not_scheduled(victim)
+
+
+def test_preferred_inverse_anti_affinity_from_existing_pods_allows():
+    # topology_test.go:1984-2033 — the same shape with PREFERRED
+    # anti-affinity does not block the later pod
+    env = Env()
+    env.create(make_nodepool())
+    guards = [
+        _anti(f"g{i}", {"security": "s2"}, zone, required=False)
+        for i, zone in enumerate(("test-zone-1", "test-zone-2", "test-zone-3"))
+    ]
+    env.expect_provisioned(*guards)
+    for g in guards:
+        env.expect_scheduled(g)
+    victim = make_pod(name="victim", cpu=0.1, labels={"security": "s2"})
+    env.expect_provisioned(victim)
+    env.expect_scheduled(victim)
+
+
+def test_affinity_preference_conflicting_with_required_spread_is_violable():
+    # topology_test.go:2034-2068 — hostname spread (required) forces three
+    # nodes even though each pod PREFERS co-location with the target
+    env = Env()
+    env.create(make_nodepool())
+    target = make_pod(name="target", cpu=0.1, labels={"security": "s2"})
+    spread = TopologySpreadConstraint(
+        max_skew=1, topology_key=wk.LABEL_HOSTNAME,
+        when_unsatisfiable=DO_NOT_SCHEDULE,
+        label_selector=LabelSelector(match_labels={"app": "test"}),
+    )
+    pods = [
+        make_pod(
+            name=f"p{i}", cpu=0.1, labels={"app": "test"},
+            topology_spread=[spread],
+            affinity=Affinity(
+                pod_affinity=PodAffinity(
+                    preferred=[
+                        WeightedPodAffinityTerm(
+                            weight=50,
+                            pod_affinity_term=PodAffinityTerm(
+                                topology_key=wk.LABEL_HOSTNAME,
+                                label_selector=LabelSelector(
+                                    match_labels={"security": "s2"}
+                                ),
+                            ),
+                        )
+                    ]
+                )
+            ),
+        )
+        for i in range(3)
+    ]
+    env.expect_provisioned(target, *pods)
+    for p in (target, *pods):
+        env.expect_scheduled(p)
+    skew = env.expect_skew(wk.LABEL_HOSTNAME, label_selector={"app": "test"})
+    assert sorted(skew.values()) == [1, 1, 1]
+
+
+def test_zone_affinity_unconstrained_target_follows():
+    # topology_test.go:2131-2163 — while the target's zone is undetermined
+    # (first pass), the zone-affine follower must NOT schedule; once the
+    # target is bound to a concrete node, a second pass lands the follower in
+    # the same zone
+    env = Env()
+    env.create(make_nodepool())
+    target = make_pod(name="target", cpu=0.1, labels={"security": "s2"})
+    follower = make_pod(
+        name="follower", cpu=0.1,
+        affinity=Affinity(
+            pod_affinity=PodAffinity(
+                required=[
+                    PodAffinityTerm(
+                        topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                        label_selector=LabelSelector(match_labels={"security": "s2"}),
+                    )
+                ]
+            )
+        ),
+    )
+    env.expect_provisioned(target, follower)
+    env.expect_not_scheduled(follower)  # target zone not committed yet
+    env.expect_provisioned(follower)  # second pass: zone is concrete now
+    from karpenter_tpu.apis.objects import Node
+
+    zt = env.kube.get(Node, env.expect_scheduled(target), "").metadata.labels[wk.LABEL_TOPOLOGY_ZONE]
+    zf = env.kube.get(Node, env.expect_scheduled(follower), "").metadata.labels[wk.LABEL_TOPOLOGY_ZONE]
+    assert zt == zf
+
+
+def test_zone_affinity_constrained_target_pins_follower_zone():
+    # topology_test.go:2164-2192 — the target is pinned to zone-3, so the
+    # follower must land in zone-3 too
+    env = Env()
+    env.create(make_nodepool())
+    target = make_pod(
+        name="target", cpu=0.1, labels={"security": "s2"},
+        node_selector={wk.LABEL_TOPOLOGY_ZONE: "test-zone-3"},
+    )
+    follower = make_pod(
+        name="follower", cpu=0.1,
+        affinity=Affinity(
+            pod_affinity=PodAffinity(
+                required=[
+                    PodAffinityTerm(
+                        topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                        label_selector=LabelSelector(match_labels={"security": "s2"}),
+                    )
+                ]
+            )
+        ),
+    )
+    env.expect_provisioned(target, follower)
+    from karpenter_tpu.apis.objects import Node
+
+    for p in (target, follower):
+        node = env.kube.get(Node, env.expect_scheduled(p), "")
+        assert node.metadata.labels[wk.LABEL_TOPOLOGY_ZONE] == "test-zone-3"

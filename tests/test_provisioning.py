@@ -412,3 +412,131 @@ def test_created_claims_carry_owner_and_nodeclass_refs():
     assert owners[0].name == "default" and owners[0].controller
     ref = claim.spec.node_class_ref
     assert ref is not None and ref.name == "test-class" and ref.kind == "NodeClass"
+
+
+def test_nodepool_taints_flow_to_launched_nodes():
+    # topology_test.go:2385-2394 — template taints ride the claim to the node
+    from karpenter_tpu.apis.objects import Node, Taint, Toleration
+
+    env = Env()
+    env.create(make_nodepool(taints=[Taint(key="test", value="bar", effect="NoSchedule")]))
+    pod = make_pod(name="p", cpu=0.1,
+                   tolerations=[Toleration(operator="Exists", effect="NoSchedule")])
+    pass_ = env.expect_provisioned(pod)
+    node = env.kube.get(Node, env.expect_scheduled(pod), "")
+    assert any(t.key == "test" and t.value == "bar" and t.effect == "NoSchedule"
+               for t in node.spec.taints)
+
+
+def test_toleration_operator_matrix_against_pool_taints():
+    # topology_test.go:2395-2421 — OpExists / OpEqual tolerate; missing
+    # toleration, key mismatch, and value-less OpEqual do not
+    from karpenter_tpu.apis.objects import Taint, Toleration
+
+    env = Env()
+    env.create(make_nodepool(taints=[Taint(key="test-key", value="test-value",
+                                           effect="NoSchedule")]))
+    ok1 = make_pod(name="ok1", cpu=0.1, tolerations=[
+        Toleration(key="test-key", operator="Exists", effect="NoSchedule")])
+    ok2 = make_pod(name="ok2", cpu=0.1, tolerations=[
+        Toleration(key="test-key", value="test-value", operator="Equal",
+                   effect="NoSchedule")])
+    bad1 = make_pod(name="bad1", cpu=0.1)
+    bad2 = make_pod(name="bad2", cpu=0.1, tolerations=[
+        Toleration(key="invalid", operator="Exists")])
+    bad3 = make_pod(name="bad3", cpu=0.1, tolerations=[
+        Toleration(key="test-key", operator="Equal", effect="NoSchedule")])
+    env.expect_provisioned(ok1, ok2, bad1, bad2, bad3)
+    env.expect_scheduled(ok1)
+    env.expect_scheduled(ok2)
+    for p in (bad1, bad2, bad3):
+        env.expect_not_scheduled(p)
+
+
+def test_startup_taints_do_not_block_scheduling():
+    # topology_test.go:2422-2429 — startup taints are a kubelet-boot gate,
+    # not a scheduling constraint
+    from karpenter_tpu.apis.objects import Taint
+
+    env = Env()
+    env.create(make_nodepool(startup_taints=[
+        Taint(key="ignore-me", value="nothing-to-see-here", effect="NoSchedule")]))
+    pod = make_pod(name="p", cpu=0.1)
+    env.expect_provisioned(pod)
+    env.expect_scheduled(pod)
+
+
+def test_template_labels_and_domain_exceptions_reach_nodes():
+    # suite_test.go:760-839 — template labels (including restricted-domain
+    # EXCEPTION labels like kOps') flow claim -> node at registration
+    from karpenter_tpu.apis.objects import Node
+
+    env = Env()
+    env.create(make_nodepool(labels={
+        "app": "myapp", "kops.k8s.io/instancegroup": "workers",
+    }))
+    pod = make_pod(name="p", cpu=0.1)
+    env.expect_provisioned(pod)
+    node = env.kube.get(Node, env.expect_scheduled(pod), "")
+    assert node.metadata.labels.get("app") == "myapp"
+    assert node.metadata.labels.get("kops.k8s.io/instancegroup") == "workers"
+
+
+def test_schedules_to_existing_unowned_node():
+    # scheduling suite_test.go:2376-2426 — capacity this controller did not
+    # create still counts: pods land on a bare (non-Karpenter) ready node
+    from tests.factories import make_node
+
+    env = Env()
+    env.create(make_nodepool())
+    node = make_node(name="unowned", capacity={"cpu": 4.0, "memory": 8 * 1024.0**3,
+                                               "pods": 110.0},
+                     allocatable={"cpu": 4.0, "memory": 8 * 1024.0**3,
+                                  "pods": 110.0},
+                     registered=True, initialized=True)
+    # no nodepool label: unmanaged
+    node.metadata.labels.pop("karpenter.tpu/nodepool", None)
+    env.create(node)
+    pods = [make_pod(name=f"p{i}", cpu=0.5) for i in range(2)]
+    pass_ = env.expect_provisioned(*pods)
+    for p in pods:
+        assert env.expect_scheduled(p) == "unowned"
+    assert not pass_.created  # no claim needed
+
+
+def test_initialized_nodes_are_preferred_over_uninitialized():
+    # scheduler.go:311-322 — with two equal nodes, the initialized one wins
+    from tests.factories import make_node
+
+    env = Env()
+    env.create(make_nodepool())
+    caps = {"cpu": 4.0, "memory": 8 * 1024.0**3, "pods": 110.0}
+    raw = make_node(name="a-raw", capacity=dict(caps), allocatable=dict(caps),
+                    registered=True, initialized=False)
+    ready = make_node(name="b-ready", capacity=dict(caps), allocatable=dict(caps),
+                      registered=True, initialized=True)
+    env.create(raw)
+    env.create(ready)
+    pod = make_pod(name="p", cpu=0.5)
+    env.expect_provisioned(pod)
+    # name order alone would pick a-raw; initialization order must win
+    assert env.expect_scheduled(pod) == "b-ready"
+
+
+def test_pod_incompatible_with_existing_node_gets_new_claim():
+    # scheduling suite_test.go:2460-2492 — an existing node that cannot host
+    # the pod (zone mismatch) must not block a fresh claim
+    from tests.factories import make_node
+
+    env = Env()
+    env.create(make_nodepool())
+    caps = {"cpu": 4.0, "memory": 8 * 1024.0**3, "pods": 110.0}
+    node = make_node(name="z1", capacity=dict(caps), allocatable=dict(caps),
+                     registered=True, initialized=True,
+                     labels={"topology.kubernetes.io/zone": "test-zone-1"})
+    env.create(node)
+    pod = make_pod(name="p", cpu=0.5,
+                   node_selector={"topology.kubernetes.io/zone": "test-zone-2"})
+    pass_ = env.expect_provisioned(pod)
+    assert pass_.created, "expected a new claim for the zone-2 pod"
+    assert env.expect_scheduled(pod) != "z1"

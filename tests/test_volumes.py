@@ -257,3 +257,21 @@ def test_pvc_bound_to_missing_volume_is_not_scheduled():
     pod = _pvc_pod("bad", "dangling")
     env.expect_provisioned(pod)
     env.expect_not_scheduled(pod)
+
+
+def test_ephemeral_volume_with_missing_class_is_not_scheduled():
+    # volume.go:28-44 adaptation — an ephemeral volume naming a class that
+    # doesn't exist can never provision its storage
+    from karpenter_tpu.apis.objects import EphemeralVolume, Volume
+
+    env = Env()
+    env.create(make_nodepool())
+    pod = make_pod(name="bad", cpu=0.1)
+    pod.spec.volumes = [
+        Volume(name="scratch",
+               ephemeral=EphemeralVolume(storage_class_name="no-such-class"))
+    ]
+    good = make_pod(name="good", cpu=0.1)
+    env.expect_provisioned(pod, good)
+    env.expect_not_scheduled(pod)
+    env.expect_scheduled(good)
