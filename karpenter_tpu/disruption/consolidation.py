@@ -276,19 +276,28 @@ class MultiNodeConsolidation(ConsolidationBase):
         self, ordered_full: Sequence[Candidate], k_max: int
     ) -> int:
         """Largest prefix size (<= k_max, the reference's 100-candidate cap)
-        the batched screen accepts; 0 = none. The scorer is built over the
-        FULL candidate list so SingleNodeConsolidation's screen this pass
-        shares the same ScreenSession key — candidates beyond a prefix stay
-        live nodes in the union problem either way."""
+        the batched screen accepts; 0 = none.
+
+        With a ScreenSession installed and a sane candidate count, the scorer
+        is built over the FULL list so SingleNodeConsolidation's screen this
+        pass shares the session key (candidates beyond a prefix stay live
+        nodes either way), and Single's first k_max singleton probes ride
+        this launch speculatively. Without a session — or at a scale where
+        encoding everyone would swamp the device batch — only the capped
+        prefix is encoded, exactly as before the session existed."""
         try:
-            scorer, score = self._session_scorer(ordered_full)
+            use_full = (
+                self.screen_session is not None
+                and len(ordered_full) <= 2 * MULTI_NODE_MAX_CANDIDATES
+            )
+            basis = list(ordered_full) if use_full else list(ordered_full[:k_max])
+            scorer, score = self._session_scorer(basis)
             if scorer is None:
                 return 0
             subsets = [list(range(k + 1)) for k in range(k_max)]
-            # speculative singletons: SingleNodeConsolidation will probe the
-            # same candidates later this pass; batching its queries into this
-            # launch makes the whole pass one device program
-            singletons = [[i] for i in range(len(ordered_full))]
+            singletons = (
+                [[i] for i in range(min(len(basis), k_max))] if use_full else []
+            )
             verdicts = score(subsets, extra=singletons)
             for k in range(k_max, 0, -1):
                 if verdicts[k - 1].consolidatable_with(

@@ -60,18 +60,21 @@ class VolumeTopology:
                 # reference validates that generated claim, volume.go:28-44);
                 # this store has no ephemeral controller, so validate the one
                 # thing the spec itself pins: a NAMED storage class must exist
-                if (
-                    volume.ephemeral is not None
-                    and volume.ephemeral.storage_class_name
-                    and resolve_storage_class(
-                        self.kube, volume.ephemeral.storage_class_name
-                    )
-                    is None
-                ):
-                    raise ValueError(
-                        f"ephemeral volume {volume.name!r} names missing "
-                        f"storage class {volume.ephemeral.storage_class_name!r}"
-                    )
+                if volume.ephemeral is not None:
+                    sc_name = volume.ephemeral.storage_class_name
+                    if sc_name == "":
+                        # same rule as an unbound classless PVC below: dynamic
+                        # provisioning is off and nothing pre-binds ephemeral
+                        # claims, so this can never provision
+                        raise ValueError(
+                            f"ephemeral volume {volume.name!r} must define "
+                            f"a storage class"
+                        )
+                    if sc_name and resolve_storage_class(self.kube, sc_name) is None:
+                        raise ValueError(
+                            f"ephemeral volume {volume.name!r} names missing "
+                            f"storage class {sc_name!r}"
+                        )
                 # hostPath/emptyDir etc. have no storage to validate
                 continue
             name = volume.persistent_volume_claim.claim_name

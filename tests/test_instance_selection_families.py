@@ -1,0 +1,183 @@
+"""Instance-selection families.
+
+Behavioral ports of pkg/controllers/provisioning/scheduling/
+instance_selection_test.go: under every combination of pod / NodePool
+constraints over arch, os, zone, and capacity type, the launched node must
+land on one of the CHEAPEST instances compatible with the constraint, and
+every instance type offered to the cloud provider must satisfy it
+(:82-427); incompatible selectors launch nothing (:428-508); and a pool
+restricted to on-demand must order by on-demand price, not by the spot
+price that would rank other types first (:563-625).
+"""
+
+import random
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.objects import IN, Node, NodeSelectorRequirement
+from karpenter_tpu.cloudprovider.fake import (
+    GI,
+    instance_types_assorted,
+    make_instance_type,
+)
+from karpenter_tpu.cloudprovider.types import Offering
+
+from tests.factories import make_nodepool, make_pod
+from tests.harness import Env
+
+
+def _assorted_env(pool_requirements=()):
+    env = Env()
+    catalog = instance_types_assorted()
+    # the reference shuffles to prove price ordering happens everywhere
+    random.Random(7).shuffle(catalog)
+    env.cloud_provider.instance_types_for_nodepool["default"] = catalog
+    env.create(make_nodepool(requirements=list(pool_requirements)))
+    return env, catalog
+
+
+def _node_price(env, node_name, catalog):
+    node = env.kube.get(Node, node_name, "")
+    it = next(
+        i for i in catalog
+        if i.name == node.metadata.labels[wk.LABEL_INSTANCE_TYPE_STABLE]
+    )
+    o = it.offerings.get(
+        node.metadata.labels[wk.CAPACITY_TYPE_LABEL_KEY],
+        node.metadata.labels[wk.LABEL_TOPOLOGY_ZONE],
+    )
+    assert o is not None
+    return o.price
+
+
+def _min_price(catalog, predicate=lambda it, o: True):
+    return min(
+        o.price
+        for it in catalog
+        for o in it.offerings.available()
+        if predicate(it, o)
+    )
+
+
+def _arch_of(it):
+    r = it.requirements.get(wk.LABEL_ARCH_STABLE)
+    return sorted(r.values)[0]
+
+
+def _oses_of(it):
+    return set(it.requirements.get(wk.LABEL_OS_STABLE).values)
+
+
+CASES = [
+    # (name, pod node_selector, pool requirements, catalog predicate)
+    ("unconstrained", {}, (), lambda it, o: True),
+    ("pod-arch-amd64", {wk.LABEL_ARCH_STABLE: "amd64"}, (),
+     lambda it, o: _arch_of(it) == "amd64"),
+    ("pod-arch-arm64", {wk.LABEL_ARCH_STABLE: "arm64"}, (),
+     lambda it, o: _arch_of(it) == "arm64"),
+    ("pool-arch-amd64", {},
+     (NodeSelectorRequirement(key=wk.LABEL_ARCH_STABLE, operator=IN, values=["amd64"]),),
+     lambda it, o: _arch_of(it) == "amd64"),
+    ("pod-os-windows", {wk.LABEL_OS_STABLE: "windows"}, (),
+     lambda it, o: "windows" in _oses_of(it)),
+    ("pool-os-windows", {},
+     (NodeSelectorRequirement(key=wk.LABEL_OS_STABLE, operator=IN, values=["windows"]),),
+     lambda it, o: "windows" in _oses_of(it)),
+    ("pod-zone-2", {wk.LABEL_TOPOLOGY_ZONE: "test-zone-2"}, (),
+     lambda it, o: o.zone == "test-zone-2"),
+    ("pool-zone-2", {},
+     (NodeSelectorRequirement(key=wk.LABEL_TOPOLOGY_ZONE, operator=IN, values=["test-zone-2"]),),
+     lambda it, o: o.zone == "test-zone-2"),
+    ("pod-ct-spot", {wk.CAPACITY_TYPE_LABEL_KEY: wk.CAPACITY_TYPE_SPOT}, (),
+     lambda it, o: o.capacity_type == wk.CAPACITY_TYPE_SPOT),
+    ("pool-ct-spot", {},
+     (NodeSelectorRequirement(key=wk.CAPACITY_TYPE_LABEL_KEY, operator=IN,
+                              values=[wk.CAPACITY_TYPE_SPOT]),),
+     lambda it, o: o.capacity_type == wk.CAPACITY_TYPE_SPOT),
+    ("pod-ct-spot-zone-1",
+     {wk.CAPACITY_TYPE_LABEL_KEY: wk.CAPACITY_TYPE_SPOT,
+      wk.LABEL_TOPOLOGY_ZONE: "test-zone-1"},
+     (),
+     lambda it, o: o.capacity_type == wk.CAPACITY_TYPE_SPOT and o.zone == "test-zone-1"),
+    ("pool-od-zone1-arm64-windows", {},
+     (NodeSelectorRequirement(key=wk.CAPACITY_TYPE_LABEL_KEY, operator=IN,
+                              values=[wk.CAPACITY_TYPE_ON_DEMAND]),
+      NodeSelectorRequirement(key=wk.LABEL_TOPOLOGY_ZONE, operator=IN,
+                              values=["test-zone-1"]),
+      NodeSelectorRequirement(key=wk.LABEL_ARCH_STABLE, operator=IN, values=["arm64"]),
+      NodeSelectorRequirement(key=wk.LABEL_OS_STABLE, operator=IN, values=["windows"])),
+     lambda it, o: (o.capacity_type == wk.CAPACITY_TYPE_ON_DEMAND
+                    and o.zone == "test-zone-1" and _arch_of(it) == "arm64"
+                    and "windows" in _oses_of(it))),
+]
+
+
+@pytest.mark.parametrize("name,selector,pool_reqs,pred",
+                         CASES, ids=[c[0] for c in CASES])
+def test_schedules_on_cheapest_compatible_instance(name, selector, pool_reqs, pred):
+    env, catalog = _assorted_env(pool_reqs)
+    pod = make_pod(name="p", cpu=0.5, node_selector=dict(selector))
+    env.expect_provisioned(pod)
+    node_name = env.expect_scheduled(pod)
+    assert _node_price(env, node_name, catalog) == _min_price(catalog, pred)
+    # every instance type offered to the cloud provider satisfies the
+    # constraint (instance_selection_test.go's supportedInstanceTypes check)
+    node = env.kube.get(Node, node_name, "")
+    launched_it = next(
+        i for i in catalog
+        if i.name == node.metadata.labels[wk.LABEL_INSTANCE_TYPE_STABLE]
+    )
+    assert pred(launched_it, next(iter(launched_it.offerings.available())))
+
+
+@pytest.mark.parametrize("selector", [
+    {wk.LABEL_ARCH_STABLE: "arm"},  # no such arch in the catalog
+    {wk.LABEL_ARCH_STABLE: "arm", wk.LABEL_TOPOLOGY_ZONE: "test-zone-2"},
+])
+def test_no_instance_matches_selector(selector):
+    # instance_selection_test.go:428-508
+    env, _ = _assorted_env()
+    pod = make_pod(name="p", cpu=0.5, node_selector=dict(selector))
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_on_demand_pool_orders_by_on_demand_price():
+    # instance_selection_test.go:563-625 — with the pool pinned to
+    # on-demand, test-instance1 (OD $1.00) must beat test-instance2
+    # (OD $1.30) even though instance2's SPOT price would rank it first
+    env = Env()
+    catalog = [
+        make_instance_type(
+            "test-instance1",
+            resources={"cpu": 1.0, "memory": 1 * GI},
+            offerings=[
+                Offering(wk.CAPACITY_TYPE_ON_DEMAND, "test-zone-1", 1.0, True),
+                Offering(wk.CAPACITY_TYPE_SPOT, "test-zone-1", 0.2, True),
+            ],
+        ),
+        make_instance_type(
+            "test-instance2",
+            resources={"cpu": 1.0, "memory": 1 * GI},
+            offerings=[
+                Offering(wk.CAPACITY_TYPE_ON_DEMAND, "test-zone-1", 1.3, True),
+                Offering(wk.CAPACITY_TYPE_SPOT, "test-zone-1", 0.1, True),
+            ],
+        ),
+    ]
+    env.cloud_provider.instance_types_for_nodepool["default"] = catalog
+    env.create(
+        make_nodepool(
+            requirements=[
+                NodeSelectorRequirement(
+                    key=wk.CAPACITY_TYPE_LABEL_KEY, operator=IN,
+                    values=[wk.CAPACITY_TYPE_ON_DEMAND],
+                )
+            ]
+        )
+    )
+    pod = make_pod(name="p", cpu=0.5)
+    env.expect_provisioned(pod)
+    node = env.kube.get(Node, env.expect_scheduled(pod), "")
+    assert node.metadata.labels[wk.LABEL_INSTANCE_TYPE_STABLE] == "test-instance1"

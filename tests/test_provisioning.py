@@ -422,7 +422,7 @@ def test_nodepool_taints_flow_to_launched_nodes():
     env.create(make_nodepool(taints=[Taint(key="test", value="bar", effect="NoSchedule")]))
     pod = make_pod(name="p", cpu=0.1,
                    tolerations=[Toleration(operator="Exists", effect="NoSchedule")])
-    pass_ = env.expect_provisioned(pod)
+    env.expect_provisioned(pod)
     node = env.kube.get(Node, env.expect_scheduled(pod), "")
     assert any(t.key == "test" and t.value == "bar" and t.effect == "NoSchedule"
                for t in node.spec.taints)

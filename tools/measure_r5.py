@@ -1,0 +1,122 @@
+"""Round-5 TPU measurement batch — run when the axon tunnel is healthy.
+
+Measures, in one go (each in a fresh subprocess so a tunnel stall cannot
+poison the batch):
+  1. consolidation candidates/s with the vectorized host path (32/100)
+  2. small-batch latency with and without host dispatch (10 pods)
+  3. spread-chain A/B at 10k (KARPENTER_TPU_SPREAD_CHAIN 0 vs 1)
+  4. cold-process 2500-pod solve (persistent cache warm)
+
+Usage: python tools/measure_r5.py [--quick]
+Writes JSON lines to stdout; safe to rerun.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run(code, env=None, timeout=900):
+    e = dict(os.environ)
+    if env:
+        e.update(env)
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], env=e, capture_output=True,
+            text=True, timeout=timeout, cwd=REPO,
+        )
+        for line in reversed(out.stdout.splitlines()):
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+        return {"error": out.stderr[-400:]}
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout {timeout}s"}
+
+
+PRELUDE = (
+    "import time, json, random;"
+    "import __graft_entry__; __graft_entry__._respect_platform_env();"
+)
+
+CONSOL = PRELUDE + (
+    "from karpenter_tpu.disruption.batch import bench_candidate_scoring;"
+    "n = %d;"
+    "bench_candidate_scoring(n);"
+    "ts = [];"
+    "exec('for _ in range(3):\\n t0=time.perf_counter(); bench_candidate_scoring(n); ts.append(round(time.perf_counter()-t0,4))');"
+    "ts.sort();"
+    "print(json.dumps({'what': 'consolidation', 'n': n, 'median_s': ts[1], 'samples': ts, 'cand_per_s': round(n/ts[1],1)}))"
+)
+
+SMALL = PRELUDE + (
+    "from bench import make_diverse_pods;"
+    "from karpenter_tpu.apis.nodepool import NodePool;"
+    "from karpenter_tpu.apis.objects import ObjectMeta;"
+    "from karpenter_tpu.cloudprovider.fake import instance_types;"
+    "from karpenter_tpu.solver.encode import template_from_nodepool;"
+    "from karpenter_tpu.solver.jax_backend import JaxSolver;"
+    "its = instance_types(400);"
+    "tpl = template_from_nodepool(NodePool(metadata=ObjectMeta(name='d')), its, range(len(its)));"
+    "s = JaxSolver(); pods = make_diverse_pods(10, random.Random(42));"
+    "s.solve(pods, its, [tpl]);"
+    "ts = [];"
+    "exec('for _ in range(5):\\n t0=time.perf_counter(); s.solve(pods, its, [tpl]); ts.append(round(time.perf_counter()-t0,4))');"
+    "ts.sort();"
+    "import os;"
+    "print(json.dumps({'what': 'small-batch', 'host_dispatch': os.environ.get('KARPENTER_TPU_HOST_SMALL_BATCH','32'), 'median_s': ts[len(ts)//2], 'samples': ts, 'pods_per_s': round(10/ts[len(ts)//2],1)}))"
+)
+
+BIG = PRELUDE + (
+    "from bench import make_diverse_pods;"
+    "from karpenter_tpu.apis.nodepool import NodePool;"
+    "from karpenter_tpu.apis.objects import ObjectMeta;"
+    "from karpenter_tpu.cloudprovider.fake import instance_types;"
+    "from karpenter_tpu.solver.encode import template_from_nodepool;"
+    "from karpenter_tpu.solver.jax_backend import JaxSolver;"
+    "its = instance_types(400);"
+    "tpl = template_from_nodepool(NodePool(metadata=ObjectMeta(name='d')), its, range(len(its)));"
+    "s = JaxSolver(); pods = make_diverse_pods(10000, random.Random(42));"
+    "s.solve(pods, its, [tpl]);"
+    "ts = [];"
+    "exec('for _ in range(3):\\n t0=time.perf_counter(); r=s.solve(pods, its, [tpl]); ts.append(round(time.perf_counter()-t0,3))');"
+    "ts.sort();"
+    "import os;"
+    "print(json.dumps({'what': '10k', 'spread_chain': os.environ.get('KARPENTER_TPU_SPREAD_CHAIN','1'), 'median_s': ts[1], 'samples': ts, 'iters': s.last_iters}))"
+)
+
+COLD = (
+    "import time; t0=time.perf_counter();"
+    "import __graft_entry__; __graft_entry__._respect_platform_env();"
+    "import random, json; from bench import make_diverse_pods;"
+    "from karpenter_tpu.apis.nodepool import NodePool;"
+    "from karpenter_tpu.apis.objects import ObjectMeta;"
+    "from karpenter_tpu.cloudprovider.fake import instance_types;"
+    "from karpenter_tpu.solver.encode import template_from_nodepool;"
+    "from karpenter_tpu.solver.jax_backend import JaxSolver;"
+    "its = instance_types(400);"
+    "tpl = template_from_nodepool(NodePool(metadata=ObjectMeta(name='d')), its, range(len(its)));"
+    "r = JaxSolver().solve(make_diverse_pods(2500, random.Random(42)), its, [tpl]);"
+    "print(json.dumps({'what': 'coldstart-2500', 'cold_s': round(time.perf_counter()-t0, 2), 'scheduled': r.num_scheduled()}))"
+)
+
+
+def main():
+    quick = "--quick" in sys.argv
+    for n in (32, 100):
+        print(json.dumps(run(CONSOL % n)), flush=True)
+    for host in ("32", "0"):
+        print(json.dumps(run(SMALL, env={"KARPENTER_TPU_HOST_SMALL_BATCH": host})), flush=True)
+    if not quick:
+        for flag in ("0", "1", "0", "1"):
+            print(json.dumps(run(BIG, env={"KARPENTER_TPU_SPREAD_CHAIN": flag}, timeout=1200)), flush=True)
+        print(json.dumps(run(COLD, timeout=600)), flush=True)
+
+
+if __name__ == "__main__":
+    main()
