@@ -93,6 +93,15 @@ class ConsolidationBase:
         return False
 
 
+    # the shared screen encodes at most this many candidates; tails beyond it
+    # fall to the sequential probes (Single's deadline-bounded scan)
+    SCREEN_BASIS_CAP = 2 * MULTI_NODE_MAX_CANDIDATES
+
+    def _screen_basis(self, ordered):
+        """The candidate prefix both methods build their shared scorer over —
+        one bounded union encode per pass regardless of cluster size."""
+        return list(ordered[: self.SCREEN_BASIS_CAP])
+
     def _session_scorer(self, ordered):
         """(scorer, score_fn) through the pass's ScreenSession when one is
         installed, else a one-shot scorer."""
@@ -286,17 +295,23 @@ class MultiNodeConsolidation(ConsolidationBase):
         encoding everyone would swamp the device batch — only the capped
         prefix is encoded, exactly as before the session existed."""
         try:
-            use_full = (
-                self.screen_session is not None
-                and len(ordered_full) <= 2 * MULTI_NODE_MAX_CANDIDATES
+            with_session = self.screen_session is not None
+            # the session's shared basis keeps Single's screen on the same
+            # scorer key; without a session, encode only what this method
+            # scores
+            basis = (
+                self._screen_basis(ordered_full)
+                if with_session
+                else list(ordered_full[:k_max])
             )
-            basis = list(ordered_full) if use_full else list(ordered_full[:k_max])
             scorer, score = self._session_scorer(basis)
             if scorer is None:
                 return 0
             subsets = [list(range(k + 1)) for k in range(k_max)]
             singletons = (
-                [[i] for i in range(min(len(basis), k_max))] if use_full else []
+                [[i] for i in range(min(len(basis), k_max))]
+                if with_session
+                else []
             )
             verdicts = score(subsets, extra=singletons)
             for k in range(k_max, 0, -1):
@@ -355,27 +370,30 @@ class SingleNodeConsolidation(ConsolidationBase):
         if screened is None:
             probe_order = list(range(len(ordered)))  # screen unavailable
         else:
+            accepted_list, n_screened = screened
             # screen-accepted first (priority order), then every candidate
             # the fixed-pass relaxation-free screen may have been pessimistic
             # about: pods with relaxable preferences, pods with required
             # affinity chains deeper than the screen's pass count, and any
             # pod when a pool uses PreferNoSchedule taints (the blanket-
-            # toleration rung relaxes those only in the sequential solver)
+            # toleration rung relaxes those only in the sequential solver) —
+            # plus the tail beyond the screen basis, which was never screened
             prefer_no_schedule_pools = self._any_prefer_no_schedule()
-            accepted = set(screened)
+            accepted = set(accepted_list)
             maybe_pessimistic = [
                 i
                 for i, c in enumerate(ordered)
                 if i not in accepted
                 and (
-                    prefer_no_schedule_pools
+                    i >= n_screened
+                    or prefer_no_schedule_pools
                     or any(
                         Preferences.is_relaxable(p) or _has_required_pod_terms(p)
                         for p in c.reschedulable_pods()
                     )
                 )
             ]
-            probe_order = screened + maybe_pessimistic
+            probe_order = accepted_list + maybe_pessimistic
         for i in probe_order:
             if self.clock.now() >= deadline:
                 break
@@ -385,21 +403,26 @@ class SingleNodeConsolidation(ConsolidationBase):
         return Command(method=self.method_name)
 
     def _screen(self, ordered: Sequence[Candidate]):
-        """Indices of screen-accepted candidates in priority order, or None
-        when the screen is unavailable (fall back to the linear scan). When
-        MultiNodeConsolidation already ran this pass with the same candidate
-        list, the session returns cached verdicts with no new device launch."""
+        """(accepted indices in priority order, how many were screened), or
+        None when the screen is unavailable (fall back to the linear scan).
+        Screens the same bounded basis MultiNodeConsolidation used this pass,
+        so the session returns cached verdicts with no new scorer build; the
+        tail past the basis is left to the sequential probes."""
         try:
-            scorer, score = self._session_scorer(ordered)
+            basis = self._screen_basis(ordered)
+            scorer, score = self._session_scorer(basis)
             if scorer is None:
                 return None
-            subsets = [[i] for i in range(len(ordered))]
+            subsets = [[i] for i in range(len(basis))]
             verdicts = score(subsets)
-            return [
-                i
-                for i, v in enumerate(verdicts)
-                if v.consolidatable_with([ordered[i]], scorer.inputs.instance_types)
-            ]
+            return (
+                [
+                    i
+                    for i, v in enumerate(verdicts)
+                    if v.consolidatable_with([ordered[i]], scorer.inputs.instance_types)
+                ],
+                len(basis),
+            )
         except Exception:
             log.exception("batched single-node screen failed; using linear scan")
             return None

@@ -70,10 +70,16 @@ class VolumeTopology:
                             f"ephemeral volume {volume.name!r} must define "
                             f"a storage class"
                         )
-                    if sc_name and resolve_storage_class(self.kube, sc_name) is None:
+                    # None means "the default class" (same adaptation as the
+                    # PVC branch below): it must resolve, else the generated
+                    # claim can never provision
+                    if resolve_storage_class(self.kube, sc_name) is None:
                         raise ValueError(
-                            f"ephemeral volume {volume.name!r} names missing "
-                            f"storage class {sc_name!r}"
+                            f"ephemeral volume {volume.name!r} needs storage "
+                            f"class {sc_name!r}"
+                            if sc_name
+                            else f"ephemeral volume {volume.name!r} needs a "
+                                 f"default storage class"
                         )
                 # hostPath/emptyDir etc. have no storage to validate
                 continue

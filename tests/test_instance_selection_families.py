@@ -118,17 +118,22 @@ CASES = [
 def test_schedules_on_cheapest_compatible_instance(name, selector, pool_reqs, pred):
     env, catalog = _assorted_env(pool_reqs)
     pod = make_pod(name="p", cpu=0.5, node_selector=dict(selector))
-    env.expect_provisioned(pod)
+    pass_ = env.expect_provisioned(pod)
     node_name = env.expect_scheduled(pod)
     assert _node_price(env, node_name, catalog) == _min_price(catalog, pred)
-    # every instance type offered to the cloud provider satisfies the
-    # constraint (instance_selection_test.go's supportedInstanceTypes check)
-    node = env.kube.get(Node, node_name, "")
-    launched_it = next(
-        i for i in catalog
-        if i.name == node.metadata.labels[wk.LABEL_INSTANCE_TYPE_STABLE]
+    # EVERY instance type the claim offers to the cloud provider must
+    # satisfy the constraint in at least one offering — the reference's
+    # supportedInstanceTypes check over the create call's option list
+    assert pass_.created
+    by_name = {it.name: it for it in catalog}
+    it_req = next(
+        r for r in pass_.created[0].spec.requirements
+        if r.key == wk.LABEL_INSTANCE_TYPE_STABLE
     )
-    assert pred(launched_it, next(iter(launched_it.offerings.available())))
+    assert it_req.values
+    for name in it_req.values:
+        it = by_name[name]
+        assert any(pred(it, o) for o in it.offerings.available()), name
 
 
 @pytest.mark.parametrize("selector", [

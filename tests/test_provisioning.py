@@ -540,3 +540,38 @@ def test_pod_incompatible_with_existing_node_gets_new_claim():
     pass_ = env.expect_provisioned(pod)
     assert pass_.created, "expected a new claim for the zone-2 pod"
     assert env.expect_scheduled(pod) != "z1"
+
+
+def test_packs_in_flight_claims_before_launching_new_nodes():
+    # scheduling suite_test.go:2271-2333 — a launched-but-unregistered claim
+    # is usable capacity; a second pod fits there instead of a second claim
+    env = Env()
+    env.create(make_nodepool())
+    p1 = make_pod(name="p1", cpu=0.5)
+    env.kube.create(p1)
+    pass1 = env.provisioner.reconcile()
+    assert len(pass1.created) == 1
+    claim = pass1.created[0]
+    # fake the cloud launch only (no kubelet registration yet)
+    launched = env.cloud_provider.create(claim)
+    stored = env.kube.get(NodeClaim, claim.metadata.name, "")
+    stored.status.provider_id = launched.status.provider_id
+    stored.status.capacity = dict(launched.status.capacity)
+    stored.status.allocatable = dict(launched.status.allocatable)
+    stored.metadata.labels = dict(launched.metadata.labels)
+    stored.status.conditions.set_true("Launched")
+    env.kube.update(stored)
+    # bind p1 to the claim-backed state node so its reservation stays on
+    # the books (the in-flight StateNode is keyed by the claim's name)
+    env.bind(p1, claim.metadata.name)
+    p2 = make_pod(name="p2", cpu=0.5)
+    env.kube.create(p2)
+    pass2 = env.provisioner.reconcile()
+    assert not pass2.created, (
+        "second pod must pack into the in-flight claim's capacity"
+    )
+    # and it actually landed on the claim-backed state node, whose
+    # capacity still carries p1's reservation
+    assert pass2.result.node_pods == {claim.metadata.name: [0]}
+    sn = env.cluster.node_for_name(claim.metadata.name)
+    assert sn is not None and sn.available().get("cpu", 0.0) < 3.5

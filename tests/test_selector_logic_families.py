@@ -1,0 +1,172 @@
+"""Node-selector operator-matrix scheduling families.
+
+Behavioral ports of scheduling suite_test.go "Scheduling Logic" (:461-631):
+the In/NotIn/Exists/DoesNotExist operator matrix against defined and
+undefined label keys, compatible pods sharing a node, incompatible pods
+splitting nodes, and Exists not overwriting a concrete value.
+
+The "defined key" here is a NodePool template label ("test-key": "test-value")
+— the claim's requirement surface defines it; "undefined" keys appear on no
+pool or instance type.
+"""
+
+import pytest
+
+from karpenter_tpu.apis.objects import (
+    Affinity,
+    IN,
+    NodeAffinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    NOT_IN,
+    EXISTS,
+    DOES_NOT_EXIST,
+)
+
+from tests.factories import make_nodepool, make_pod
+from tests.harness import Env
+
+
+def _affinity_pod(name, key, op, values=()):
+    return make_pod(
+        name=name, cpu=0.1,
+        affinity=Affinity(
+            node_affinity=NodeAffinity(
+                required=[
+                    NodeSelectorTerm(
+                        match_expressions=[
+                            NodeSelectorRequirement(
+                                key=key, operator=op, values=list(values)
+                            )
+                        ]
+                    )
+                ]
+            )
+        ),
+    )
+
+
+MATRIX = [
+    # (id, key defined on pool?, operator, values, schedules?)
+    ("in-undefined", False, IN, ["test-value"], False),      # :462
+    ("notin-undefined", False, NOT_IN, ["test-value"], True),  # :471
+    ("exists-undefined", False, EXISTS, [], False),          # :481
+    ("doesnotexist-undefined", False, DOES_NOT_EXIST, [], True),  # :490
+    ("in-matching", True, IN, ["test-value"], True),         # :509
+    ("notin-matching", True, NOT_IN, ["test-value"], False),  # :521
+    ("exists-defined", True, EXISTS, [], True),              # :532
+    ("doesnotexist-defined", True, DOES_NOT_EXIST, [], False),  # :544
+    ("in-different", True, IN, ["other-value"], False),      # :556
+    ("notin-different", True, NOT_IN, ["other-value"], True),  # :567
+]
+
+
+@pytest.mark.parametrize("name,defined,op,values,ok", MATRIX,
+                         ids=[m[0] for m in MATRIX])
+def test_operator_matrix(name, defined, op, values, ok):
+    env = Env()
+    env.create(make_nodepool(
+        labels={"test-key": "test-value"} if defined else {}
+    ))
+    pod = _affinity_pod("p", "test-key", op, values)
+    env.expect_provisioned(pod)
+    if ok:
+        env.expect_scheduled(pod)
+    else:
+        env.expect_not_scheduled(pod)
+
+
+def test_compatible_pods_share_a_node():
+    # suite_test.go:579-598 — NotIn [unwanted] and In [test-value] overlap
+    env = Env()
+    env.create(make_nodepool(labels={"test-key": "test-value"}))
+    a = _affinity_pod("a", "test-key", IN, ["test-value"])
+    b = _affinity_pod("b", "test-key", NOT_IN, ["unwanted"])
+    env.expect_provisioned(a, b)
+    assert env.expect_scheduled(a) == env.expect_scheduled(b)
+
+
+def test_incompatible_pods_split_nodes():
+    # suite_test.go:599-618 — two pools define different values; pods pinned
+    # to each value land apart
+    env = Env()
+    env.create(make_nodepool(name="pool-a", labels={"test-key": "value-a"}))
+    env.create(make_nodepool(name="pool-b", labels={"test-key": "value-b"}))
+    a = _affinity_pod("a", "test-key", IN, ["value-a"])
+    b = _affinity_pod("b", "test-key", IN, ["value-b"])
+    env.expect_provisioned(a, b)
+    assert env.expect_scheduled(a) != env.expect_scheduled(b)
+
+
+def test_exists_does_not_overwrite_value():
+    # suite_test.go:619-631 — an Exists pod joining an In-pinned claim must
+    # keep the concrete value; both land together on the pinned node
+    from karpenter_tpu.apis.objects import Node
+
+    env = Env()
+    env.create(make_nodepool(labels={"test-key": "test-value"}))
+    pinned = _affinity_pod("pinned", "test-key", IN, ["test-value"])
+    exists = _affinity_pod("exists", "test-key", EXISTS)
+    env.expect_provisioned(pinned, exists)
+    n1, n2 = env.expect_scheduled(pinned), env.expect_scheduled(exists)
+    assert n1 == n2
+    node = env.kube.get(Node, n1, "")
+    assert node.metadata.labels.get("test-key") == "test-value"
+
+
+def test_different_archs_split_onto_different_instances():
+    # suite_test.go:1214-1236 — arm64 and amd64 pods cannot share a claim
+    from karpenter_tpu.apis import labels as wk
+    from karpenter_tpu.apis.objects import Node
+
+    env = Env()
+    env.create(make_nodepool())
+    a = make_pod(name="amd", cpu=0.1, node_selector={wk.LABEL_ARCH_STABLE: "amd64"})
+    b = make_pod(name="arm", cpu=0.1, node_selector={wk.LABEL_ARCH_STABLE: "arm64"})
+    env.expect_provisioned(a, b)
+    na, nb = env.expect_scheduled(a), env.expect_scheduled(b)
+    assert na != nb
+    assert env.kube.get(Node, na, "").metadata.labels[wk.LABEL_ARCH_STABLE] == "amd64"
+    assert env.kube.get(Node, nb, "").metadata.labels[wk.LABEL_ARCH_STABLE] == "arm64"
+
+
+def test_requesting_more_than_any_instance_fails():
+    # suite_test.go:1203-1213
+    env = Env()
+    env.create(make_nodepool())
+    pod = make_pod(name="huge", cpu=10_000.0)
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_disjoint_resources_split_onto_different_instances():
+    # suite_test.go:1358-1386 — a GPU-A pod and a GPU-B pod have no common
+    # instance type; each gets its own claim
+    from karpenter_tpu.cloudprovider.fake import (
+        RESOURCE_GPU_VENDOR_A,
+        RESOURCE_GPU_VENDOR_B,
+    )
+
+    env = Env()
+    env.create(make_nodepool())
+    a = make_pod(name="ga", requests={RESOURCE_GPU_VENDOR_A: 1.0})
+    b = make_pod(name="gb", requests={RESOURCE_GPU_VENDOR_B: 1.0})
+    env.expect_provisioned(a, b)
+    assert env.expect_scheduled(a) != env.expect_scheduled(b)
+
+
+def test_combined_disjoint_resources_in_one_pod_fail():
+    # suite_test.go:1387-1404 — one pod asking for both vendors' GPUs fits
+    # no single instance type
+    from karpenter_tpu.cloudprovider.fake import (
+        RESOURCE_GPU_VENDOR_A,
+        RESOURCE_GPU_VENDOR_B,
+    )
+
+    env = Env()
+    env.create(make_nodepool())
+    pod = make_pod(name="both", requests={
+        RESOURCE_GPU_VENDOR_A: 1.0, RESOURCE_GPU_VENDOR_B: 1.0,
+    })
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
