@@ -287,13 +287,14 @@ class MultiNodeConsolidation(ConsolidationBase):
         """Largest prefix size (<= k_max, the reference's 100-candidate cap)
         the batched screen accepts; 0 = none.
 
-        With a ScreenSession installed and a sane candidate count, the scorer
-        is built over the FULL list so SingleNodeConsolidation's screen this
-        pass shares the session key (candidates beyond a prefix stay live
-        nodes either way), and Single's first k_max singleton probes ride
-        this launch speculatively. Without a session — or at a scale where
-        encoding everyone would swamp the device batch — only the capped
-        prefix is encoded, exactly as before the session existed."""
+        With a ScreenSession installed the scorer is built over the shared
+        bounded basis (_screen_basis, the first 2x-cap candidates) so
+        SingleNodeConsolidation's screen this pass reuses the same scorer
+        key, and every basis singleton rides this launch speculatively —
+        one union encode and one device program per pass. Without a session
+        only the capped prefix is encoded, exactly as before the session
+        existed. Candidates beyond a scored prefix stay live nodes in the
+        union problem either way."""
         try:
             with_session = self.screen_session is not None
             # the session's shared basis keeps Single's screen on the same
@@ -308,10 +309,10 @@ class MultiNodeConsolidation(ConsolidationBase):
             if scorer is None:
                 return 0
             subsets = [list(range(k + 1)) for k in range(k_max)]
+            # Single screens every basis singleton later this pass; carrying
+            # ALL of them (bounded by SCREEN_BASIS_CAP) keeps it cache-only
             singletons = (
-                [[i] for i in range(min(len(basis), k_max))]
-                if with_session
-                else []
+                [[i] for i in range(len(basis))] if with_session else []
             )
             verdicts = score(subsets, extra=singletons)
             for k in range(k_max, 0, -1):

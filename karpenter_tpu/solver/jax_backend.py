@@ -102,6 +102,37 @@ class _SlotOverflow(Exception):
     pass
 
 
+def decode_claim_requirements(meta, adm_row, comp_row, gt_row, lt_row, defined_row):
+    """Invert encode_reqs for one claim row: the narrowed requirement state
+    the solve committed becomes the claim's Requirements — what the reference
+    puts on the launched NodeClaim (nodeclaimtemplate.go:55-81). The
+    hostname pin is dropped the way FinalizeScheduling does
+    (nodeclaim.go:123-127)."""
+    from karpenter_tpu.models.problem import GT_NONE, LT_NONE
+    from karpenter_tpu.scheduling.requirements import Requirement
+
+    out = Requirements()
+    for ki, key in enumerate(meta.keys):
+        if not defined_row[ki] or key == wk.LABEL_HOSTNAME:
+            continue
+        vals = meta.values_per_key[ki]
+        if not comp_row[ki]:
+            members = [v for vi, v in enumerate(vals) if adm_row[ki][vi]]
+            out.add(Requirement._make(key, False, members))
+        else:
+            excluded = [v for vi, v in enumerate(vals) if not adm_row[ki][vi]]
+            gt = int(gt_row[ki])
+            lt = int(lt_row[ki])
+            out.add(
+                Requirement._make(
+                    key, True, excluded,
+                    gt if gt != int(GT_NONE) else None,
+                    lt if lt != int(LT_NONE) else None,
+                )
+            )
+    return out
+
+
 def _remap_group_state(state, old_keys, new_keys, padded_problem):
     """Rebuild grp_counts/grp_registered for a changed group set: carried rows
     move to their new position (matched by group hash); new groups take their
@@ -313,6 +344,11 @@ class JaxSolver(SolverBackend):
                         state.claim_tpl,
                         state.claim_it_ok,
                         state.claim_requests,
+                        state.claim_req.admitted,
+                        state.claim_req.comp,
+                        state.claim_req.gt,
+                        state.claim_req.lt,
+                        state.claim_req.defined,
                     )
                 )
                 # [narrow iterations, sweeps] — the device-cost diagnostic
@@ -370,13 +406,19 @@ class JaxSolver(SolverBackend):
         # -- decode final bin state (single batched fetch, see device_get note)
         t_dec = _now()
         if state is not None and np_final is not None:
-            claim_open, claim_tpl, claim_it_ok, claim_requests = np_final
+            (claim_open, claim_tpl, claim_it_ok, claim_requests,
+             claim_adm, claim_comp, claim_gt, claim_lt, claim_def) = np_final
         elif state is not None:
-            claim_open, claim_tpl, claim_it_ok, claim_requests = jax.device_get(
-                (state.claim_open, state.claim_tpl, state.claim_it_ok, state.claim_requests)
+            (claim_open, claim_tpl, claim_it_ok, claim_requests,
+             claim_adm, claim_comp, claim_gt, claim_lt, claim_def) = jax.device_get(
+                (state.claim_open, state.claim_tpl, state.claim_it_ok,
+                 state.claim_requests, state.claim_req.admitted,
+                 state.claim_req.comp, state.claim_req.gt,
+                 state.claim_req.lt, state.claim_req.defined)
             )
         else:
             claim_open, claim_tpl, claim_it_ok, claim_requests = np.zeros(0), None, None, None
+            claim_adm = claim_comp = claim_gt = claim_lt = claim_def = None
         slot_to_claim = {}
         for slot in range(max_claims):
             if slot < len(claim_open) and claim_open[slot]:
@@ -389,6 +431,10 @@ class JaxSolver(SolverBackend):
                         for t in np.flatnonzero(claim_it_ok[slot])
                         if t < len(meta.instance_type_names)
                     ],
+                    requirements=decode_claim_requirements(
+                        meta, claim_adm[slot], claim_comp[slot],
+                        claim_gt[slot], claim_lt[slot], claim_def[slot],
+                    ),
                     requests={
                         name: float(claim_requests[slot, ri])
                         for ri, name in enumerate(meta.resource_names)

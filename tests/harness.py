@@ -33,6 +33,14 @@ class Env:
         start_informers(self.kube, self.cluster)
         self.recorder = Recorder(clock=self.clock)
         self.cloud_provider = FakeCloudProvider()
+        if solver is None:
+            # the reference's fake provider registers its extra label keys as
+            # well-known globally (fake/instancetype.go:42-48); the harness
+            # solver mirrors that so the fake catalog is fully addressable
+            from karpenter_tpu.cloudprovider.fake import FAKE_WELL_KNOWN_LABELS
+            from karpenter_tpu.solver.jax_backend import JaxSolver
+
+            solver = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS)
         self.provisioner = Provisioner(
             self.kube, self.cloud_provider, self.cluster, self.clock,
             self.recorder, solver=solver,

@@ -170,3 +170,73 @@ def test_combined_disjoint_resources_in_one_pod_fail():
     })
     env.expect_provisioned(pod)
     env.expect_not_scheduled(pod)
+
+
+def test_gt_lt_operators_select_instances_end_to_end():
+    # suite_test.go:245-264 — Gt/Lt over the integer instance-size label
+    from karpenter_tpu.apis import labels as wk
+    from karpenter_tpu.apis.objects import Node
+    from karpenter_tpu.cloudprovider.fake import (
+        INTEGER_INSTANCE_LABEL_KEY,
+        instance_types_assorted,
+    )
+
+    env = Env()
+    env.cloud_provider.instance_types_for_nodepool["default"] = (
+        instance_types_assorted()
+    )
+    env.create(make_nodepool())
+    gt = _affinity_pod("gt", INTEGER_INSTANCE_LABEL_KEY, "Gt", ["8"])
+    lt = _affinity_pod("lt", INTEGER_INSTANCE_LABEL_KEY, "Lt", ["2"])
+    env.expect_provisioned(gt, lt)
+    ngt = env.kube.get(Node, env.expect_scheduled(gt), "")
+    nlt = env.kube.get(Node, env.expect_scheduled(lt), "")
+    assert int(ngt.metadata.labels[INTEGER_INSTANCE_LABEL_KEY]) > 8
+    assert int(nlt.metadata.labels[INTEGER_INSTANCE_LABEL_KEY]) < 2
+
+
+def test_conflicting_preference_is_relaxed_not_fatal():
+    # suite_test.go:311-350 — a preference contradicting a requirement (or
+    # another preference) relaxes away; the pod still schedules within its
+    # REQUIRED constraints
+    from karpenter_tpu.apis import labels as wk
+    from karpenter_tpu.apis.objects import (
+        Affinity,
+        NodeAffinity,
+        NodeSelectorRequirement,
+        NodeSelectorTerm,
+        Node,
+        PreferredSchedulingTerm,
+    )
+
+    env = Env()
+    env.create(make_nodepool())
+    pod = make_pod(
+        name="p", cpu=0.1,
+        affinity=Affinity(
+            node_affinity=NodeAffinity(
+                required=[
+                    NodeSelectorTerm(match_expressions=[
+                        NodeSelectorRequirement(
+                            key=wk.LABEL_TOPOLOGY_ZONE, operator=IN,
+                            values=["test-zone-1"],
+                        )
+                    ])
+                ],
+                preferred=[
+                    PreferredSchedulingTerm(
+                        weight=1,
+                        preference=NodeSelectorTerm(match_expressions=[
+                            NodeSelectorRequirement(
+                                key=wk.LABEL_TOPOLOGY_ZONE, operator=IN,
+                                values=["test-zone-3"],
+                            )
+                        ]),
+                    )
+                ],
+            )
+        ),
+    )
+    env.expect_provisioned(pod)
+    node = env.kube.get(Node, env.expect_scheduled(pod), "")
+    assert node.metadata.labels[wk.LABEL_TOPOLOGY_ZONE] == "test-zone-1"

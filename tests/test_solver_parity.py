@@ -71,6 +71,24 @@ def simple_template(its, name="pool", taints=None, labels=None, requirements=Non
     return template_from_nodepool(pool, its, range(len(its)))
 
 
+def _same_requirements(oreqs, jreqs):
+    """Semantic equality of two claim Requirements: same keys, and for each
+    key the same admitted set (membership probed over both sides' value
+    universes), complement class, and bounds."""
+    if oreqs is None or jreqs is None:
+        assert oreqs is None and jreqs is None, (oreqs, jreqs)
+        return
+    okeys, jkeys = set(iter(oreqs)), set(iter(jreqs))
+    assert okeys == jkeys, f"requirement keys differ: {okeys ^ jkeys}"
+    for key in okeys:
+        ro, rj = oreqs.get(key), jreqs.get(key)
+        assert ro.complement == rj.complement, (key, ro, rj)
+        assert ro.greater_than == rj.greater_than, (key, ro, rj)
+        assert ro.less_than == rj.less_than, (key, ro, rj)
+        for v in set(ro.values) | set(rj.values):
+            assert ro.has(v) == rj.has(v), (key, v, ro, rj)
+
+
 def assert_same(oracle_result, jax_result):
     assert len(oracle_result.new_claims) == len(jax_result.new_claims), (
         f"claim count: oracle={len(oracle_result.new_claims)} jax={len(jax_result.new_claims)}"
@@ -79,6 +97,9 @@ def assert_same(oracle_result, jax_result):
         assert sorted(oc.pod_indices) == sorted(jc.pod_indices)
         assert sorted(oc.instance_type_indices) == sorted(jc.instance_type_indices)
         assert oc.template_index == jc.template_index
+        # the launched claim's narrowed requirements drive the cloud
+        # provider's offering choice — both backends must agree on them
+        _same_requirements(oc.requirements, jc.requirements)
     assert oracle_result.node_pods == jax_result.node_pods
     assert set(oracle_result.failures) == set(jax_result.failures)
 
