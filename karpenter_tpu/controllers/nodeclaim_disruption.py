@@ -32,12 +32,15 @@ from karpenter_tpu.utils.clock import Clock
 class DisruptionMarkerController:
     def __init__(
         self, kube: KubeClient, cloud_provider: CloudProvider, clock: Clock,
-        drift_enabled: bool = True,
+        drift_enabled: bool = True, cluster=None,
     ):
         self.kube = kube
         self.cloud_provider = cloud_provider
         self.clock = clock
         self.drift_enabled = drift_enabled  # --feature-gates Drift (options.go:97)
+        # optional cluster state: nominated nodes must not read as Empty
+        # (emptiness.go:126-140)
+        self.cluster = cluster
 
     def reconcile_all(self) -> None:
         pools = {np.name: np for np in self.kube.list(NodePool)}
@@ -58,6 +61,11 @@ class DisruptionMarkerController:
             self._mark_empty(c, nodepool, now)
             if self.drift_enabled:
                 self._mark_drifted(c, nodepool, now)
+            else:
+                # a disabled gate actively REMOVES the condition so stale
+                # pre-restart markers cannot drive disruption
+                # (nodeclaim/disruption/drift_test.go:105-115)
+                c.status.conditions.clear(DRIFTED)
             self._mark_expired(c, nodepool, now)
 
         # dry-run against a copy; only write when a condition actually
@@ -75,6 +83,13 @@ class DisruptionMarkerController:
         if not claim.is_initialized() or not claim.status.node_name:
             claim.status.conditions.clear(EMPTY)
             return
+        # a node nominated for pending pods is about to be non-empty
+        # (emptiness.go:126-140)
+        if self.cluster is not None and self.cluster.is_nominated(
+            claim.status.node_name
+        ):
+            claim.status.conditions.clear(EMPTY)
+            return
         pods = self.kube.list(
             Pod,
             predicate=lambda p: p.spec.node_name == claim.status.node_name
@@ -88,6 +103,11 @@ class DisruptionMarkerController:
     # -- drift (nodeclaim/disruption/drift.go) --------------------------------
 
     def _mark_drifted(self, claim: NodeClaim, nodepool: NodePool, now: float) -> None:
+        # an unlaunched claim has nothing to be drifted FROM; the condition
+        # comes off until Launched is true (drift_test.go:116-141)
+        if not claim.is_launched():
+            claim.status.conditions.clear(DRIFTED)
+            return
         reason = self._drift_reason(claim, nodepool)
         if reason:
             if not claim.status.conditions.is_true(DRIFTED):
@@ -129,6 +149,14 @@ class DisruptionMarkerController:
         if ttl == NEVER or created is None:
             claim.status.conditions.clear(EXPIRED)
             return
+        # an adopted node may predate its claim: whichever is older expires
+        # the pair (expiration_test.go:80-103)
+        if claim.status.node_name:
+            from karpenter_tpu.apis.objects import Node
+
+            node = self.kube.get_opt(Node, claim.status.node_name, "")
+            if node is not None and node.metadata.creation_timestamp is not None:
+                created = min(created, node.metadata.creation_timestamp)
         if now - created >= ttl:
             if not claim.status.conditions.is_true(EXPIRED):
                 claim.status.conditions.set_true(EXPIRED, now=now)

@@ -102,6 +102,7 @@ class Operator:
         self.markers = DisruptionMarkerController(
             self.kube, self.cloud_provider, self.clock,
             drift_enabled=self.options.drift_enabled(),
+            cluster=self.cluster,
         )
         self.claim_termination = TerminationController(self.kube, self.cloud_provider)
         from karpenter_tpu.controllers.eviction_queue import EvictionQueue

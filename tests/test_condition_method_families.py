@@ -155,3 +155,79 @@ def test_drift_replacement_failure_untaints():
     assert not any(t.match(disruption_taint()) for t in node.spec.taints)
     assert env.kube.get_opt(NodeClaim, "claim-n1", "") is not None
     assert not env.cluster.node_for_name("n1").marked_for_deletion()
+
+
+# ---------------------------------------------------------------------------
+# marker-controller condition clearing (nodeclaim/disruption suites)
+# ---------------------------------------------------------------------------
+
+
+def _marker(env, drift_enabled=True):
+    from karpenter_tpu.controllers.nodeclaim_disruption import (
+        DisruptionMarkerController,
+    )
+
+    return DisruptionMarkerController(
+        env.kube, env.cloud_provider, env.clock,
+        drift_enabled=drift_enabled, cluster=env.cluster,
+    )
+
+
+def test_disabled_drift_gate_clears_stale_condition():
+    # drift_test.go:105-115 — a pre-existing Drifted condition comes OFF when
+    # the gate is disabled, not just stops being stamped
+    env = Env()
+    env.create(make_underutilized_pool())
+    env.create_candidate_node("n1")
+    _mark(env, "claim-n1", nc.DRIFTED)
+    _marker(env, drift_enabled=False).reconcile_all()
+    claim = env.kube.get(NodeClaim, "claim-n1", "")
+    assert not claim.status.conditions.is_true(nc.DRIFTED)
+
+
+def test_unlaunched_claim_cannot_be_drifted():
+    # drift_test.go:116-141 — Launched=False removes/blocks the condition
+    from tests.factories import make_nodeclaim
+
+    env = Env()
+    env.create(make_underutilized_pool())
+    claim = make_nodeclaim(name="young", nodepool="default")
+    claim.status.conditions.set_true(nc.DRIFTED)
+    env.kube.create(claim)
+    _marker(env).reconcile_all()
+    got = env.kube.get(NodeClaim, "young", "")
+    assert not got.status.conditions.is_true(nc.DRIFTED)
+
+
+def test_nominated_node_is_not_marked_empty():
+    # emptiness_test.go:126-140
+    env = Env()
+    env.create(make_underutilized_pool())
+    env.create_candidate_node("n1")
+    env.cluster.nominate_node_for_pod("n1")
+    _marker(env).reconcile_all()
+    claim = env.kube.get(NodeClaim, "claim-n1", "")
+    assert not claim.status.conditions.is_true(nc.EMPTY)
+    # nomination expires -> empty marks on the next pass
+    env.clock.step(30.0)
+    _marker(env).reconcile_all()
+    claim = env.kube.get(NodeClaim, "claim-n1", "")
+    assert claim.status.conditions.is_true(nc.EMPTY)
+
+
+def test_adopted_node_age_drives_expiration():
+    # expiration_test.go:80-103 — the node predates the claim; the pair
+    # expires on the NODE's age
+    from karpenter_tpu.apis.nodepool import Disruption as DisruptionPolicy
+
+    env = Env()
+    env.create(make_underutilized_pool(
+        disruption=DisruptionPolicy(expire_after="60s"),
+    ))
+    env.clock.step(100.0)  # now=100
+    node, claim = env.create_candidate_node("n1", creation_timestamp=90.0)
+    node.metadata.creation_timestamp = 10.0  # adopted: node is 90s old
+    env.kube.update(node)
+    _marker(env).reconcile_all()
+    got = env.kube.get(NodeClaim, "claim-n1", "")
+    assert got.status.conditions.is_true(nc.EXPIRED)
