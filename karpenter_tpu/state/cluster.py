@@ -60,6 +60,11 @@ class Cluster:
         nodes = self._kube.list(Node)
         with self._lock:
             for claim in claims:
+                # a claim that hasn't resolved its providerID hasn't resolved
+                # its status: decisions on top of it would race the launch
+                # (cluster.go:106-110)
+                if not claim.status.provider_id:
+                    return False
                 if claim.metadata.name not in self._claim_name_to_key:
                     return False
             for node in nodes:

@@ -236,3 +236,32 @@ def test_node_deletion_drops_state_and_bindings():
     kube.delete(Node, "n1", "")
     assert cluster.nodes() == []
     assert cluster.pods_bound_to("n1") == []
+
+
+def test_synced_requires_resolved_provider_ids():
+    # state suite_test.go:1217-1233 — one claim with an unresolved providerID
+    # blocks sync; resolving it restores it
+    kube, _clock, cluster = harness()
+    kube.create(make_nodeclaim(name="pending-launch", nodepool="default"))
+    assert not cluster.synced()
+    stored = kube.get(NodeClaim, "pending-launch", "")
+    stored.status.provider_id = "fake:///resolved"
+    kube.update(stored)
+    assert cluster.synced()
+
+
+def test_synced_with_node_claim_combination():
+    # state suite_test.go:1164-1198 — a mix of tracked claims and nodes syncs
+    kube, _clock, cluster = harness()
+    kube.create(make_nodeclaim(name="c1", provider_id="fake:///c1"))
+    kube.create(make_node(name="n1", provider_id="fake:///c1"))
+    kube.create(make_node(name="bare", provider_id="fake:///bare"))
+    assert cluster.synced()
+
+
+def test_nodes_without_provider_id_do_not_block_sync():
+    # state suite_test.go:1126-1150 — Nodes (not claims) may lack provider
+    # ids (just-joined kubelets) without blocking
+    kube, _clock, cluster = harness()
+    kube.create(make_node(name="joining", provider_id=""))
+    assert cluster.synced()
