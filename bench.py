@@ -218,19 +218,29 @@ def run_child():
         samples, median, result = _measure(
             lambda: solver.solve(pods, its, [tpl]), reps
         )
-        emit(
-            {
-                "event": "shape",
-                "pods": pod_count,
-                "solve_s": round(median, 4),
-                "solve_min_s": round(samples[0], 4),
-                "solve_max_s": round(samples[-1], 4),
-                "reps": len(samples),
-                "samples": [round(s, 4) for s in samples],
-                "compile_s": round(max(warm_s - median, 0.0), 2),
-                "scheduled": result.num_scheduled(),
-            }
-        )
+        ev = {
+            "event": "shape",
+            "pods": pod_count,
+            "solve_s": round(median, 4),
+            "solve_min_s": round(samples[0], 4),
+            "solve_max_s": round(samples[-1], 4),
+            "reps": len(samples),
+            "samples": [round(s, 4) for s in samples],
+            "compile_s": round(max(warm_s - median, 0.0), 2),
+            "scheduled": result.num_scheduled(),
+        }
+        # device-cost diagnostics of the last solve (sweeps mode only):
+        # narrow iterations ARE the sequential depth, and the chain-commit
+        # hit rate says how much of the queue the round-6 batching consumed
+        if solver.last_iters is not None and len(solver.last_iters) >= 4:
+            n_it, _sweeps, n_cc, n_cp = solver.last_iters[:4]
+            ev["narrow_iterations"] = n_it
+            ev["chain_commit_hit_rate"] = (
+                round(n_cp / pod_count, 4) if pod_count else 0.0
+            )
+            ev["chain_commits"] = n_cc
+            ev["chain_committed_pods"] = n_cp
+        emit(ev)
     if first_solve is not None:
         emit({"event": "first_solve", **first_solve})
 
@@ -490,6 +500,19 @@ def main():
             for e in shapes
         },
     }
+    # round-6 chain telemetry: sequential depth per shape and how much of
+    # the queue the chain commits consumed (pods batched / pods total)
+    if any("narrow_iterations" in e for e in shapes):
+        out["per_shape_narrow_iterations"] = {
+            str(e["pods"]): e["narrow_iterations"]
+            for e in shapes
+            if "narrow_iterations" in e
+        }
+        out["per_shape_chain_commit_hit_rate"] = {
+            str(e["pods"]): e["chain_commit_hit_rate"]
+            for e in shapes
+            if "chain_commit_hit_rate" in e
+        }
     first = next((e for e in events if e.get("event") == "first_solve"), None)
     if first is not None:
         out["first_solve_after_start_s"] = first["s"]

@@ -188,6 +188,19 @@ class UnionScorer:
             assert pos == len(self.union_pods), "candidate slices misaligned"
             self.pod_volumes = inputs.pod_volumes
 
+        # survivor screen: a non-candidate node whose free capacity cannot
+        # fit ANY union pod (elementwise requests <= available, the implicit
+        # pods=1 resource included) can never take a reschedule row in any
+        # subset's solve, so dropping it up front shrinks the node axis of
+        # every stacked variant. Capacity-only and requirement-blind, hence
+        # conservative: a kept node may still fail its gates, a dropped node
+        # could never have passed the fit gate. Candidate nodes are always
+        # kept (they are capacity-masked per subset, and host reschedulable
+        # pods when OUTSIDE the subset). The topology census below still
+        # registers every node's hostname — the census is cluster state, not
+        # solver capacity.
+        self.enc_nodes = self._screen_survivors(inputs.nodes, cand_names)
+
         # topology over the union: batch pods (all candidates') are excluded
         # from the census, so this is the every-candidate-removed base;
         # per-candidate deltas restore the census of the ones that stay
@@ -209,7 +222,7 @@ class UnionScorer:
             self.union_pods,
             inputs.instance_types,
             inputs.templates,
-            nodes=inputs.nodes,
+            nodes=self.enc_nodes,
             topology=topo,
             num_claim_slots=num_claim_slots,
             pod_volumes=self.pod_volumes,
@@ -241,6 +254,43 @@ class UnionScorer:
             [self._node_idx.get(c.name, -1) for c in self.candidates], dtype=np.int64
         )
         self.deltas = [self._delta_for(c, n) for c, n in zip(self.candidates, self.cand_nodes)]
+
+    # -- survivor screen ------------------------------------------------------
+
+    def _screen_survivors(self, nodes, cand_names: Set[str]) -> List[NodeInfo]:
+        """Drop survivor (non-candidate) nodes that cannot fit any union pod.
+        Vectorized: one [N, P] broadcast compare over the union resource
+        vocabulary instead of a python double loop."""
+        if not nodes or not self.union_pods:
+            return list(nodes)
+        from karpenter_tpu.utils import resources as res
+
+        req_dicts = [dict(res.pod_requests(p)) for p in self.union_pods]
+        rnames = sorted({r for d in req_dicts for r in d})
+        if not rnames:
+            return list(nodes)
+        ridx = {r: i for i, r in enumerate(rnames)}
+        preq = np.zeros((len(req_dicts), len(rnames)), dtype=np.float64)
+        for pi, d in enumerate(req_dicts):
+            for r, v in d.items():
+                preq[pi, ridx[r]] = v
+        # unique request rows: union pods cluster into a handful of sizes,
+        # which keeps the [N, U, R] broadcast tiny regardless of pod count
+        preq = np.unique(preq, axis=0)
+        navail = np.zeros((len(nodes), len(rnames)), dtype=np.float64)
+        for ni, n in enumerate(nodes):
+            for r, v in (n.available or {}).items():
+                i = ridx.get(r)
+                if i is not None:
+                    navail[ni, i] = v
+        fits_any = np.any(
+            np.all(navail[:, None, :] >= preq[None, :, :], axis=-1), axis=-1
+        )
+        return [
+            n
+            for ni, n in enumerate(nodes)
+            if n.name in cand_names or bool(fits_any[ni])
+        ]
 
     # -- census deltas --------------------------------------------------------
 

@@ -99,7 +99,10 @@ class ConsolidationBase:
 
     def _screen_basis(self, ordered):
         """The candidate prefix both methods build their shared scorer over —
-        one bounded union encode per pass regardless of cluster size."""
+        one bounded union encode per pass regardless of cluster size. The
+        scorer additionally drops survivor nodes that cannot fit any union
+        pod (UnionScorer._screen_survivors), so the stacked screen's node
+        axis scales with the reschedulable load, not the cluster."""
         return list(ordered[: self.SCREEN_BASIS_CAP])
 
     def _session_scorer(self, ordered):

@@ -230,6 +230,14 @@ class SchedulingProblem:
     # both rows are topology-blind (no matched/owned groups; labels and
     # select-sides may differ) — the stride's analytic-chain test
     pod_eqprev_gate: Any = None
+    # bool[P] CHAIN-identity with the previous row: equal on everything that
+    # can influence the pod's own placement verdict — strict/effective reqs,
+    # requests, tolerations, ports, volumes, grp_match, grp_owned, and
+    # match∩selects (the only slice of the select side any gate reads) —
+    # while the full select side may differ (own labels). The stride's
+    # spread/affinity chain commits batch over these runs; records are
+    # summed per member (weighted record), so differing selects stay exact.
+    pod_eqprev_chain: Any = None
 
     @property
     def num_runs(self) -> int:

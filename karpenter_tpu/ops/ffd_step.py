@@ -2,6 +2,14 @@
 
 One lax.scan step places one pod (scheduler.go:238-285 priority order);
 see ops/ffd.py (facade) for the module map.
+
+This step is the PARITY ANCHOR for every batched commit: the sweeps path's
+chain commits (ffd_sweeps: waterfill, closed-form round, spread mini-sim —
+batched over pod_eqprev_chain runs whose members may differ on the select
+side) and the run solver's analytic commits must all be bit-identical to
+stepping pods one at a time through THIS body. The randomized fuzz suites
+(test_solver_parity, test_chain_parity) enforce that; gate changes must land
+here first and in the batched paths second.
 """
 
 

@@ -57,8 +57,12 @@ from karpenter_tpu.ops.ffd_runs import _make_run_commit  # noqa: F401
 _STRIDE = int(_os.environ.get("KARPENTER_TPU_STRIDE", "64"))
 # experimental chain-dispatch sweep structure (see _sweeps_impl)
 _CHAIN_DISPATCH = _os.environ.get("KARPENTER_TPU_CHAIN_DISPATCH", "") == "1"
-# whole-chain spread commits (mini-sim); kill switch for perf A/B
+# whole-chain spread commits (closed-form round + mini-sim fallback); kill
+# switch for perf A/B
 _SPREAD_CHAIN = _os.environ.get("KARPENTER_TPU_SPREAD_CHAIN", "1") == "1"
+# chain-identity batching (pod_eqprev_chain): 0 falls back to byte-identity
+# chains only (the pre-round-6 behavior) for A/B and bisection
+_TOPO_CHAIN = _os.environ.get("KARPENTER_TPU_TOPO_CHAIN", "1") == "1"
 
 
 def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
@@ -108,6 +112,24 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
         if problem.pod_eqprev_gate is not None
         else jnp.zeros((P,), bool)
     )
+    # chain-identity (pod_eqprev_chain ⊇ pod_eqprev): members share every
+    # gate-relevant array but may differ on the select side; the weighted
+    # record below keeps the commit bit-identical to per-pod stepping
+    chain_arr = (
+        jnp.asarray(problem.pod_eqprev_chain)
+        if (_TOPO_CHAIN and problem.pod_eqprev_chain is not None)
+        else eqprev_arr
+    )
+    G = problem.grp_key.shape[0]
+    if G > 0:
+        # per-member select/owned windows for the weighted record; scratch
+        # tail so a window starting near P never clamp-shifts
+        sel_concat = jnp.concatenate(
+            [jnp.asarray(problem.pod_grp_selects), jnp.zeros((S, G), bool)]
+        )
+        own_concat = jnp.concatenate(
+            [jnp.asarray(problem.pod_grp_owned), jnp.zeros((S, G), bool)]
+        )
     # the analytic waterfill commit consumes whole gate-identical chains
     # (record sum included); scratch tail so a window near P never clamps
     run_commit = _make_run_commit(problem, statics, C, S)
@@ -348,10 +370,16 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
         ahead = queue[jnp.clip(i + Srange, 0, P - 1)]
         adj = (ahead == p + Srange) & ((i + Srange) < qlen)
         succ = jnp.clip(p + Srange, 0, P - 1)
-        strict_chain = lax.cummin(
-            (adj & ((Srange == 0) | eqprev_arr[succ])).astype(jnp.int32)
+        # chain-identity run ahead of the cursor (pod_eqprev_chain ⊇ byte
+        # identity): members agree on every array any gate reads — including
+        # match∩selects, the only slice of the select side the topology gate
+        # sees — so ONE narrow verdict covers the chain; their FULL select
+        # sides may differ (own labels), which the weighted record below
+        # reconciles member-by-member
+        chain_run = lax.cummin(
+            (adj & ((Srange == 0) | chain_arr[succ])).astype(jnp.int32)
         ).astype(bool)
-        k_strict = strict_chain.sum().astype(jnp.int32)
+        k_chain = chain_run.sum().astype(jnp.int32)
 
         ev = eval_base(state, pod)
         any_node = ev["any_node"]
@@ -432,14 +460,26 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
         # per chain.
         match, selects, owned = pod[7], pod[8], pod[9]
         if G > 0:
+            # per-member select/owned rows of the chain window; the chain
+            # predicate guarantees match∩selects and owned are chain-equal,
+            # but the full select side differs per member (own labels)
+            sel_mem = lax.dynamic_slice(sel_concat, (p, jnp.int32(0)), (S, G))
+            own_mem = lax.dynamic_slice(own_concat, (p, jnp.int32(0)), (S, G))
             aff_safe = (problem.grp_type == 1) & ~problem.grp_inverse
-            sel = match & (selects | owned)
-            stack_safe = ~jnp.any(sel & ~aff_safe)
+            # groups that both GATE this pod and RECEIVE its records —
+            # record_delta's two disjoint parts: regular groups record via
+            # the select side, inverse groups via owned. A matched group the
+            # pod does not feed (e.g. a spread whose selector misses the
+            # pod's labels) cannot create record->gate feedback.
+            feedback = match & (
+                (selects & ~problem.grp_inverse) | (owned & problem.grp_inverse)
+            )
+            stack_safe = ~jnp.any(feedback & ~aff_safe)
             pod_dom = pod[1].admitted[problem.grp_key]  # [G, V] strict pod domains
             positive_any = jnp.any(
                 state.grp_registered & (state.grp_counts > 0) & pod_dom, axis=-1
             )
-            fill_safe = stack_safe & jnp.all(~sel | positive_any)
+            fill_safe = stack_safe & jnp.all(~feedback | positive_any)
             # spread mini-fill preconditions: exactly ONE matched group, a
             # regular spread with no node-filter, nothing owned — then the
             # chain's own gates read only that group's counters and the
@@ -470,6 +510,32 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
             md_g = jnp.max(jnp.where(match, problem.grp_min_domains, -1))
             s_gi = jnp.any(match & selects).astype(jnp.int32)
             is_host_g = jnp.any(match & (problem.grp_key == HOSTNAME_KEY))
+            # shared spread-chain statics (mini-sim AND closed-form round)
+            sup_mask = reg_g & pod_dom_g
+            gmin_zero = is_host_g | ((md_g >= 0) & (sup_mask.sum() < md_g))
+            MAXI = jnp.int32(2**31 - 1)
+            idxC = jnp.arange(C)
+            lexv = jnp.minimum(lex_g, V - 1)
+            # closed-form ROUND eligibility: with maxSkew 1 and a self-
+            # selecting pod, a round at the frozen global min is analytic
+            # PROVIDED every fillable claim is already pinned to a single
+            # in-support lane of the group key (claims cannot float between
+            # lanes, takes close lanes one-for-one, nothing resurrects)
+            lanes_cm = (
+                ev["claim_merged"].admitted & key_onehot_g[None, :, None]
+            ).any(axis=1)  # [C, V] claim lanes on the group key
+            fillable = cap_c > 0
+            lane_c = jnp.argmax(lanes_cm, axis=-1)
+            single_ok = (lanes_cm.sum(axis=-1) == 1) & sup_mask[lane_c]
+            ok_struct = jnp.all(~fillable | single_ok)
+            sup_counts = jnp.where(sup_mask, counts_g0, MAXI)
+            gmin0 = jnp.where(gmin_zero, 0, jnp.min(sup_counts))
+            open_lane = sup_mask & (counts_g0 == gmin0)
+            lane_open_claim = open_lane & jnp.any(
+                lanes_cm & fillable[:, None], axis=0
+            )  # [V] lane is open AND some fillable claim sits on it
+            n_win = lane_open_claim.sum().astype(jnp.int32)
+            round_pod = spread_pod & (skew_g == 1) & (s_gi == 1)
         else:
             stack_safe = jnp.bool_(True)
             fill_safe = jnp.bool_(True)
@@ -481,13 +547,31 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
         ).astype(jnp.int32)
         fitc = jnp.where(kind == KIND_NODE, node_fit_count, claim_fit_count)
         is_claim = kind == KIND_CLAIM
-        use_fill = is_claim & fill_safe & (k_strict > 1)
+        use_fill = is_claim & fill_safe & (k_chain > 1)
+        if G > 0:
+            # the round only fires when it swallows the WHOLE chain in one
+            # narrow iteration (n_win >= k): for short rounds the mini-sim
+            # is cheaper (one narrow iteration + k tiny steps beats
+            # ceil(k/n_win) full iterations). No node guard needed: within a
+            # round the global min is frozen and lane counts only grow, so
+            # a topo-blocked node can never unblock mid-round.
+            use_round = (
+                is_claim
+                & round_pod
+                & ok_struct
+                & (k_chain > 1)
+                & ~use_fill
+                & (n_win >= k_chain)
+            )
+        else:
+            use_round = jnp.bool_(False)
         use_spread = (
             is_claim
             & spread_pod
             & ~ev["node_static_any"]
-            & (k_strict > 1)
+            & (k_chain > 1)
             & ~use_fill
+            & ~use_round
             & _SPREAD_CHAIN
         )
 
@@ -500,7 +584,7 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
                 jnp.where(stack_safe, jnp.minimum(fitc, j_rank), 1),
             )
             k1 = jnp.maximum(
-                jnp.minimum(k_strict, jnp.where(placed, k_placed, _BIG_CAP)),
+                jnp.minimum(k_chain, jnp.where(placed, k_placed, _BIG_CAP)),
                 1,
             ).astype(jnp.int32)
             hot = (jnp.arange(C) == claim_pick) & is_claim
@@ -519,7 +603,7 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
             claim's capacity, index tie-break, then map each ordinal to its
             temporal claim for the per-pod output rows."""
             p_lvl = state.claim_npods
-            m = jnp.minimum(k_strict, cap_c.sum()).astype(jnp.int32)
+            m = jnp.minimum(k_chain, cap_c.sum()).astype(jnp.int32)
             L = _water_level(p_lvl, cap_c, m)
             take0 = jnp.clip(L - p_lvl, 0, cap_c)
             leftover = m - take0.sum()
@@ -590,19 +674,12 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
                 relevant_t[None, :] & pinnable[:, None] & ~kg_ok
             )
 
-            sup_mask = reg_g & pod_dom_g
-            gmin_zero = is_host_g | (
-                (md_g >= 0) & (sup_mask.sum() < md_g)
-            )
-            lanes0 = (merged.admitted & key_onehot_g[None, :, None]).any(axis=1)
+            lanes0 = lanes_cm
             # claims the sim must WATCH but never fill: pre-gates pass, the
             # topo gate failed at chain start, and a within-skew lane could
             # appear (conservative: capacity unknown without the merged-row
             # IT product, so any such claim winning the rank cuts the chain)
             resurrect = ev["claim_ok_pre"] & ~ev["claim_topo_ok"]
-            idxC = jnp.arange(C)
-            MAXI = jnp.int32(2**31 - 1)
-            lexv = jnp.minimum(lex_g, V - 1)
 
             # a while_loop, NOT a fixed-S scan: chains average a handful of
             # pods and every mini-step is a burst of tiny kernels — running
@@ -611,7 +688,7 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
             # buffers cross this boundary)
             def mini_cond(c):
                 s, _counts, _npods, _cap, _lanes, alive, _picks = c
-                return alive & (s < k_strict)
+                return alive & (s < k_chain)
 
             def mini_body(c):
                 s, counts, npods_c, cap, lanes, alive, picks = c
@@ -671,10 +748,55 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
             pin = jnp.where(fallback, no_pin, pin)
             return take, claim_of, k_out, pin, ~fallback
 
+        def round_take():
+            """Closed-form ONE-ROUND spread commit — the analytic fast path
+            for pinned-lane spread chains (maxSkew 1, self-selecting pod,
+            every fillable claim on a single in-support lane of the group
+            key). Within a round at the frozen global min each open lane
+            admits exactly one take — the take raises its lane to gmin+1 and
+            closes it — so no lane reopens (counts only grow, the min cannot
+            drop while an open lane remains), claims cannot float between
+            lanes (single lane) and blocked claims cannot resurrect. The
+            sequential pick order is therefore fewest-pods rank over each
+            lane's winning claim: a sort, not a simulation. Fires only when
+            the round swallows the whole chain (n_win >= k), so one narrow
+            iteration commits all k members."""
+            prio_c = jnp.where(fillable, state.claim_npods * C + idxC, _BIG)
+            claim_lane_prio = jnp.where(lanes_cm, prio_c[:, None], _BIG)  # [C, V]
+            lane_prio = jnp.where(
+                lane_open_claim, jnp.min(claim_lane_prio, axis=0), _BIG
+            )  # [V]
+            win_c = jnp.argmin(claim_lane_prio, axis=0).astype(jnp.int32)  # [V]
+            m = jnp.minimum(k_chain, n_win).astype(jnp.int32)
+            ofV = win_c[jnp.argsort(lane_prio)]  # winning claims, rank order
+            if V >= S:
+                of_s = ofV[:S]
+            else:
+                of_s = jnp.concatenate([ofV, jnp.zeros((S - V,), jnp.int32)])
+            in_round = Srange < m
+            of_s = jnp.where(in_round, of_s, claim_pick).astype(jnp.int32)
+            take = jnp.sum(
+                in_round[:, None] & (of_s[:, None] == idxC[None, :]), axis=0
+            ).astype(jnp.int32)
+            # the first sequential pick equals the full gate's pick by
+            # construction (fillable == gate-passing, and a gate-passing
+            # single-lane claim's lane is necessarily open); the check is a
+            # pure safety net
+            fallback = (m == 0) | (of_s[0] != claim_pick)
+            s_take, s_of, s_k = _single_outputs()
+            take = jnp.where(fallback, s_take, take)
+            claim_of = jnp.where(fallback, s_of, of_s)
+            k_out = jnp.where(fallback, s_k, m)
+            return take, claim_of, k_out, no_pin, ~fallback
+
         if G > 0 and _SPREAD_CHAIN:
-            branch = use_fill.astype(jnp.int32) + 2 * use_spread.astype(jnp.int32)
+            branch = (
+                use_fill.astype(jnp.int32)
+                + 2 * use_round.astype(jnp.int32)
+                + 3 * use_spread.astype(jnp.int32)
+            )
             claim_take, claim_of, k, claim_pin, multi_commit = lax.switch(
-                branch, (single_take, fill_take, spread_take)
+                branch, (single_take, fill_take, round_take, spread_take)
             )
         else:
             # no topology groups (spread_take's free variables don't exist
@@ -784,23 +906,43 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
             & host_onehot[None, :]
         )
 
-        # topology record: identical stack members record identical deltas;
-        # the take-vector commit sums each touched claim's own delta (rows
-        # differ only through the claim state they merged into)
+        # topology record: each chain member records ITS OWN delta. Members
+        # share every gate-relevant array but not the full select side, so
+        # the delta factorizes into (per-row UNIT delta) x (per-member
+        # select/owned weight): record_delta is linear in (selects, owned)
+        # and its regular/inverse parts live on disjoint groups, so ONE
+        # ones-weight call per committed row recovers every member's record
+        # exactly — bit-identical to stepping the members one at a time.
+        covered = Srange < k
         if G > 0:
-            rec_needed = placed & (jnp.any(selects) | jnp.any(owned))
+            rec_needed = placed & jnp.any(covered[:, None] & (sel_mem | own_mem))
 
             def do_record():
-                def fill_deltas():
-                    deltas = vmap(
+                unit_pod = PodTopoStatics(
+                    strict_admitted=pod[1].admitted,
+                    grp_match=match,
+                    grp_selects=jnp.ones((G,), bool),
+                    grp_owned=jnp.ones((G,), bool),
+                )
+
+                def multi_deltas():
+                    units = vmap(
                         lambda row: record_delta(
-                            problem, topo_of(pod), row, wellknown, jnp.bool_(True), lv, ln
+                            problem, unit_pod, row, wellknown, jnp.bool_(True), lv, ln
                         )
-                    )(committed)  # [C, G, V]
-                    counts = jnp.sum(
-                        claim_take[:, None, None] * deltas.astype(jnp.int32), axis=0
+                    )(committed)  # [C, G, V] unit deltas per claim row
+                    oh = covered[:, None] & (
+                        claim_of[:, None] == jnp.arange(C)[None, :]
+                    )  # [S, C] member -> its claim
+                    w_sel = jnp.einsum(
+                        "sc,sg->cg", oh.astype(jnp.int32), sel_mem.astype(jnp.int32)
                     )
-                    reg = jnp.any(tookc[:, None, None] & deltas, axis=0)
+                    w_own = jnp.einsum(
+                        "sc,sg->cg", oh.astype(jnp.int32), own_mem.astype(jnp.int32)
+                    )
+                    w_eff = jnp.where(problem.grp_inverse[None, :], w_own, w_sel)
+                    counts = jnp.einsum("cg,cgv->gv", w_eff, units.astype(jnp.int32))
+                    reg = jnp.any((w_eff > 0)[:, :, None] & units, axis=0)
                     return counts, reg
 
                 def single_delta():
@@ -815,12 +957,17 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
                             rec_row,
                         )
                     allow = jnp.where(kind == KIND_NODE, no_allow, wellknown)
-                    delta = record_delta(
-                        problem, topo_of(pod), rec_row, allow, jnp.bool_(True), lv, ln
+                    unit = record_delta(
+                        problem, unit_pod, rec_row, allow, jnp.bool_(True), lv, ln
                     )
-                    return k * delta.astype(jnp.int32), delta
+                    w_sel1 = jnp.sum(covered[:, None] & sel_mem, axis=0)
+                    w_own1 = jnp.sum(covered[:, None] & own_mem, axis=0)
+                    w1 = jnp.where(problem.grp_inverse, w_own1, w_sel1).astype(
+                        jnp.int32
+                    )
+                    return w1[:, None] * unit.astype(jnp.int32), (w1 > 0)[:, None] & unit
 
-                return lax.cond(multi_commit, fill_deltas, single_delta)
+                return lax.cond(multi_commit, multi_deltas, single_delta)
 
             counts_add, reg_add = lax.cond(
                 rec_needed,
@@ -852,7 +999,6 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
             grp_counts=new_counts,
             grp_registered=new_registered,
         )
-        covered = Srange < k
         kind_row = jnp.where(covered, kind, KIND_FAIL)
         # claim placements report each ordinal's own claim (the take-vector
         # temporal mapping); other kinds share the single chosen index
@@ -914,11 +1060,11 @@ def _sweeps_impl(problem: SchedulingProblem, init: FFDState, C: int) -> FFDResul
     idxs0 = jnp.full((P,), -1, jnp.int32)
 
     def sweep_cond(c):
-        _state, _queue, qlen, _kinds, _idxs, progress, noslot, _it = c
+        _state, _queue, qlen, _kinds, _idxs, progress, noslot = c[:7]
         return progress & (qlen > 0) & ~noslot
 
     def sweep_body(c):
-        state, queue, qlen, kinds, idxs, _progress, noslot0, it_ct = c
+        state, queue, qlen, kinds, idxs, _progress, noslot0, it_ct, cc_ct, cp_ct = c
         i0 = (
             jnp.int32(0),
             state,
@@ -986,20 +1132,34 @@ def _sweeps_impl(problem: SchedulingProblem, init: FFDState, C: int) -> FFDResul
                 return i < qlen
 
             def inner_body(ic):
-                i, state, nq, nqlen, kinds, idxs, noslot, n_it = ic
+                i, state, nq, nqlen, kinds, idxs, noslot, n_it, n_cc, n_cp = ic
                 state, kinds, idxs, nq, nqlen, k, nosl = narrow_iter(
                     state, queue, i, qlen, kinds, idxs, nq, nqlen
                 )
-                return i + k, state, nq, nqlen, kinds, idxs, noslot | nosl, n_it + 1
+                # chain-commit telemetry: iterations that consumed >1 pod,
+                # and how many pods those iterations consumed in total
+                multi = (k > 1).astype(jnp.int32)
+                return (
+                    i + k,
+                    state,
+                    nq,
+                    nqlen,
+                    kinds,
+                    idxs,
+                    noslot | nosl,
+                    n_it + 1,
+                    n_cc + multi,
+                    n_cp + k * multi,
+                )
 
-            _i, state, nq, nqlen, kinds, idxs, noslot, it_ct = lax.while_loop(
-                inner_cond, inner_body, i0 + (it_ct,)
+            _i, state, nq, nqlen, kinds, idxs, noslot, it_ct, cc_ct, cp_ct = (
+                lax.while_loop(inner_cond, inner_body, i0 + (it_ct, cc_ct, cp_ct))
             )
         progress = nqlen < qlen
         # iters[1] counts sweeps in the low bits: encode as it_ct plus a
         # sweep counter carried in the same scalar is not worth the reshape —
         # carry the pair explicitly instead
-        return state, nq, nqlen, kinds, idxs, progress, noslot, it_ct
+        return state, nq, nqlen, kinds, idxs, progress, noslot, it_ct, cc_ct, cp_ct
 
     n_sweeps0 = jnp.int32(0)
 
@@ -1010,17 +1170,19 @@ def _sweeps_impl(problem: SchedulingProblem, init: FFDState, C: int) -> FFDResul
         out = sweep_body(c[:-1])
         return out + (c[-1] + 1,)
 
-    state, _queue, _qlen, kinds, idxs, _prog, _noslot, n_iters, n_sweeps = (
+    state, _queue, _qlen, kinds, idxs, _prog, _noslot, n_iters, n_cc, n_cp, n_sweeps = (
         lax.while_loop(
             sweep_cond2,
             sweep_body2,
             (init, queue0, qlen0, kinds0, idxs0, jnp.bool_(True), jnp.bool_(False),
-             jnp.int32(0), n_sweeps0),
+             jnp.int32(0), jnp.int32(0), jnp.int32(0), n_sweeps0),
         )
     )
+    # [narrow iterations, sweeps, chain-commit iterations (k>1), pods those
+    # chain commits consumed] — the backend surfaces this as last_iters
     return FFDResult(
         kind=kinds, index=idxs, state=state,
-        iters=jnp.stack([n_iters, n_sweeps]),
+        iters=jnp.stack([n_iters, n_sweeps, n_cc, n_cp]),
     )
 
 

@@ -184,6 +184,11 @@ def pad_problem(p: SchedulingProblem, min_pods: int = 0) -> SchedulingProblem:
             if p.pod_eqprev_gate is not None
             else None
         ),
+        pod_eqprev_chain=(
+            _pad(p.pod_eqprev_chain, (P,), False)
+            if p.pod_eqprev_chain is not None
+            else None
+        ),
     )
 
 

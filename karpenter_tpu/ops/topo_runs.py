@@ -78,6 +78,19 @@ def make_topo_run_commit(problem: SchedulingProblem, statics, C: int, max_run: i
     TPL = problem.num_templates
     K = problem.num_keys
     V = problem.num_lanes
+    G = problem.grp_key.shape[0]
+    # chain-identical run members share every gate-relevant array with the
+    # head but may differ on the SELECT side (own labels) — gates only read
+    # selects through match∩selects (equal across the run by the encoder's
+    # chain predicate), while Topology.Record needs each member's own row.
+    # Scratch tail so a window starting near P never clamp-shifts.
+    sel_concat = (
+        jnp.concatenate(
+            [jnp.asarray(problem.pod_grp_selects), jnp.zeros((max_run, G), bool)]
+        )
+        if G > 0
+        else None
+    )
 
     def commit(state: FFDState, pod, start, length, active_arr):
         (
@@ -94,7 +107,7 @@ def make_topo_run_commit(problem: SchedulingProblem, statics, C: int, max_run: i
             _pod_vols,
             _pa,
         ) = pod
-        topo_pod = PodTopoStatics(
+        topo_pod_head = PodTopoStatics(
             strict_admitted=pod_strict.admitted,
             grp_match=grp_match,
             grp_selects=grp_selects,
@@ -102,6 +115,11 @@ def make_topo_run_commit(problem: SchedulingProblem, statics, C: int, max_run: i
         )
         win = jnp.arange(max_run)
         act = lax.dynamic_slice(active_arr, (start,), (max_run,)) & (win < length)
+        sel_win = (
+            lax.dynamic_slice(sel_concat, (start, 0), (max_run, G))
+            if G > 0
+            else None
+        )
 
         # ---- loop-invariant statics (the step pays these per pod) --------
         if N > 0:
@@ -122,6 +140,14 @@ def make_topo_run_commit(problem: SchedulingProblem, statics, C: int, max_run: i
         def body(carry):
             i, taken_nodes, st, kind_row, index_row = carry
             is_active = act[i]
+            # member-specific statics: only the select row varies across a
+            # chain-identical run (gates read it solely at matched groups,
+            # where it equals the head's; records read it everywhere)
+            topo_pod = (
+                topo_pod_head._replace(grp_selects=sel_win[i])
+                if G > 0
+                else topo_pod_head
+            )
 
             def place(args):
                 taken_nodes, st, kind_row, index_row = args

@@ -106,18 +106,32 @@ def constraint_signature(p: Pod) -> str:
     itself re-checks byte-identical encodings — so an imprecise digest can
     only cost compression, never correctness."""
     spec = p.spec
-    # PERF-SENSITIVE ordering: moving labels to the end (to lengthen
-    # gate-identity chains) was measured to DOUBLE the 10k bench's device
-    # time — reordering pod CLASSES within a size tier changes the claim
-    # landscape every later pod packs against (docs/PERF_NOTES.md item 5).
-    # A/B any change to this list on the bench before landing it.
+    # PERF-SENSITIVE ordering: moving labels all the way to the END (past
+    # ports) was measured to DOUBLE the 10k bench's device time — reordering
+    # pod CLASSES within a size tier changes the claim landscape every later
+    # pod packs against (docs/PERF_NOTES.md item 5). A/B any change to this
+    # list on the bench before landing it.
+    #
+    # Labels sit AFTER the spread constraints but BEHIND a 2-way label
+    # bucket (round-6 A/B'd). Pods sharing a spread/affinity shape but
+    # differing only in own labels become consecutive — exactly the
+    # adjacency the chain-identity commits (pod_eqprev_chain) batch over.
+    # The bucket caps that adjacency on purpose: a fully contiguous
+    # same-selector hostname-spread cohort opens a fresh claim per pod
+    # (each wants a zero-count domain), which blew the 10k bench past its
+    # 128-claim bucket (134 needed) and onto the 256-slot program — a
+    # 3.5x wall-time cliff. Interleaving two label halves bounds the
+    # consecutive same-selector demand (97 claims at 10k) while keeping
+    # runs long enough for the chain commits (93% of the queue batched).
+    labels = repr(sorted((p.metadata.labels or {}).items()))
     parts = [
         p.namespace,
         repr(sorted(spec.node_selector.items())),
         repr(spec.affinity),
         repr(spec.tolerations),
-        repr(sorted((p.metadata.labels or {}).items())),
+        str(sum(labels.encode()) % 2),
         repr(spec.topology_spread_constraints),
+        labels,
         repr([(c.ports or []) for c in spec.containers]),
     ]
     return "|".join(parts)
@@ -368,6 +382,23 @@ class Encoder:
             note_resources(n.available)
 
         # -- 4. requirement tensors
+        def _reqs_digest(reqs: Requirements):
+            """Canonical hashable form of a Requirements object — the fold
+            below is a pure function of it, so identical-class entities
+            (duplicated pods, repeated templates) share one fold."""
+            return tuple(
+                sorted(
+                    (
+                        key,
+                        r.complement,
+                        frozenset(r.values),
+                        r.greater_than,
+                        r.less_than,
+                    )
+                    for key, r in ((k, reqs.get(k)) for k in reqs)
+                )
+            )
+
         def encode_reqs(entities: List[Requirements]) -> ReqTensor:
             E = len(entities)
             admitted = np.zeros((E, K, V), dtype=bool)
@@ -375,7 +406,21 @@ class Encoder:
             gt = np.full((E, K), GT_NONE, dtype=np.int32)
             lt = np.full((E, K), LT_NONE, dtype=np.int32)
             defined = np.zeros((E, K), dtype=bool)
+            # per-call fold memo: at 10k diverse pods only a few hundred
+            # requirement classes exist, and the per-value has() probing is
+            # the dominant host cost of this section (PERF_NOTES item 4)
+            folded: Dict[tuple, int] = {}
             for e, reqs in enumerate(entities):
+                digest = _reqs_digest(reqs)
+                src = folded.get(digest)
+                if src is not None:
+                    admitted[e] = admitted[src]
+                    comp[e] = comp[src]
+                    gt[e] = gt[src]
+                    lt[e] = lt[src]
+                    defined[e] = defined[src]
+                    continue
+                folded[digest] = e
                 # undefined keys are identity elements: full-admit complements
                 admitted[e] = lane_valid
                 comp[e] = True
@@ -691,6 +736,38 @@ class Encoder:
         else:
             gate_same = np.zeros(P, dtype=bool)
         pod_eqprev_gate = gate_same
+        # CHAIN-identity: equality over every array that can influence a
+        # pod's OWN placement verdict. The full select side may differ (own
+        # labels) — no gate reads it except through match∩selects (spread
+        # self-count, affinity self-select bootstrap), which IS compared.
+        # Differing selects only change who records whom, and both chain
+        # consumers (the stride's weighted record, the run commits'
+        # per-member record gather) sum records per member, so a chain
+        # commit stays bit-identical to stepping its members one at a time.
+        if P > 1 and G:
+            chain_same = np.ones(P, dtype=bool)
+            chain_same[0] = False
+            for a in (
+                pod_reqs.admitted, pod_reqs.comp, pod_reqs.gt, pod_reqs.lt,
+                pod_reqs.defined, pod_strict_reqs.admitted,
+                pod_strict_reqs.comp, pod_strict_reqs.gt,
+                pod_strict_reqs.lt, pod_strict_reqs.defined,
+                pod_requests, pod_tol_tpl, pod_tol_node,
+                pod_ports, pod_port_conflict, pod_vol_counts,
+                pod_grp_match, pod_grp_owned,
+                pod_grp_match & pod_grp_selects,
+            ):
+                if a.size:
+                    flat = a.reshape(P, -1)
+                    chain_same[1:] &= (flat[1:] == flat[:-1]).all(axis=1)
+            # ports/volumes + topology interaction stays per-pod (mirrors
+            # `mergeable`): the chain commits don't model within-chain port
+            # and CSI interactions against shifting topology counters
+            chain_same &= mergeable
+            chain_same[1:] &= mergeable[:-1]
+            pod_eqprev_chain = pod_eqprev | chain_same
+        else:
+            pod_eqprev_chain = pod_eqprev.copy()
         run_start_l: List[int] = []
         run_len_l: List[int] = []
         run_mode_l: List[int] = []
@@ -698,7 +775,13 @@ class Encoder:
         while i < P:
             j = i + 1
             if mergeable[i]:
-                while j < P and j - i < MAX_RUN_LEN and same_as_prev[j]:
+                # runs extend over byte-identical rows AND chain-identical
+                # ones: the analytic commit (ops/ffd_runs.py) gathers each
+                # member's select row for its record sum, and the topo run
+                # commit (ops/topo_runs.py) rebuilds the per-member
+                # PodTopoStatics, so both stay exact when only the select
+                # side differs across the run
+                while j < P and j - i < MAX_RUN_LEN and pod_eqprev_chain[j]:
                     j += 1
             run_start_l.append(i)
             run_len_l.append(j - i)
@@ -767,6 +850,7 @@ class Encoder:
             run_mode=run_mode,
             pod_eqprev=pod_eqprev,
             pod_eqprev_gate=pod_eqprev_gate,
+            pod_eqprev_chain=pod_eqprev_chain,
         )
         meta = ProblemMeta(
             keys=list(vocab.keys),

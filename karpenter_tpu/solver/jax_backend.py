@@ -160,9 +160,9 @@ class JaxSolver(SolverBackend):
         from karpenter_tpu.utils.jaxtools import enable_compilation_cache
 
         enable_compilation_cache()
-        # [narrow iterations, sweeps] of the LAST sweeps-mode solve; None
-        # before any, and reset by non-sweeps solves so stale counts are
-        # never misattributed
+        # [narrow iterations, sweeps, chain-commit iterations, chain-committed
+        # pods] of the LAST sweeps-mode solve; None before any, and reset by
+        # non-sweeps solves so stale counts are never misattributed
         self.last_iters = None
         self.well_known = (
             well_known if well_known is not None else wk.WELL_KNOWN_LABELS
@@ -351,9 +351,10 @@ class JaxSolver(SolverBackend):
                         state.claim_req.defined,
                     )
                 )
-                # [narrow iterations, sweeps] — the device-cost diagnostic
-                # (rides the same roundtrip; see FFDResult.iters)
-                self.last_iters = (int(_iters[0]), int(_iters[1]))
+                # [narrow iterations, sweeps, chain-commit iterations,
+                # chain-committed pods] — the device-cost diagnostic (rides
+                # the same roundtrip; see FFDResult.iters)
+                self.last_iters = tuple(int(x) for x in _iters)
             else:
                 kinds, indices = jax.device_get((result.kind, result.index))
                 np_final = None

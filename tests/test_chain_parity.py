@@ -1,0 +1,180 @@
+"""Chain-commit parity fuzz — the round-6 guard.
+
+The sweeps solver batches CHAIN-identical pods (pod_eqprev_chain: equal on
+every gate-relevant array, select side free to differ) through four commit
+branches: single/rank-stacked, feedback-free waterfill, closed-form spread
+round, and the spread mini-sim. Every branch must be bit-identical to
+stepping the members one at a time. Two independent anchors:
+
+  1. oracle parity (run_both): end-to-end API-level equality against the
+     host oracle on bench-shaped mixed populations — zonal/hostname spread
+     (maxSkew 1..3, minDomains, both whenUnsatisfiable modes), zonal/
+     hostname pod-affinity with retry orderings, and label-diverse generic
+     pods that feed other pods' selectors;
+  2. runtime chain-disable differential: the SAME padded problem solved by
+     solve_ffd_sweeps with pod_eqprev_chain as encoded vs overwritten by
+     pod_eqprev (byte identity only — the pre-round-6 behavior, itself
+     anchored by the 64-seed fuzz). Exact (kind, index) equality, pod for
+     pod. This isolates the chain batching from every other moving part.
+"""
+
+import dataclasses
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.objects import (
+    Affinity,
+    Container,
+    DO_NOT_SCHEDULE,
+    LabelSelector,
+    ObjectMeta,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PodSpec,
+    SCHEDULE_ANYWAY,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.cloudprovider.fake import FAKE_WELL_KNOWN_LABELS, instance_types
+from karpenter_tpu.ops.ffd import solve_ffd_sweeps
+from karpenter_tpu.ops.padding import pad_problem
+from karpenter_tpu.provisioning.topology import Topology
+from karpenter_tpu.solver.encode import Encoder
+from karpenter_tpu.solver.jax_backend import domains_from_instance_types
+from tests.test_solver_parity import simple_template
+from tests.test_topology_families import run_both
+
+ZONES = ("test-zone-1", "test-zone-2", "test-zone-3")
+
+
+def _chain_pod(rng: random.Random, i: int) -> Pod:
+    """One pod of a bench-shaped mixed population. Families deliberately
+    produce LONG runs of chain-identical pods (same constraints and size,
+    labels free to differ) so every commit branch gets exercised."""
+    letter = rng.choice("abcdefg")
+    labels = {"my-label": letter}
+    spec_kw = {}
+    roll = rng.random()
+    if roll < 0.22:
+        # zonal spread; maxSkew > 1 and minDomains in the mix
+        spec_kw["topology_spread_constraints"] = [
+            TopologySpreadConstraint(
+                max_skew=rng.choice([1, 1, 2, 3]),
+                topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                when_unsatisfiable=(
+                    DO_NOT_SCHEDULE if rng.random() < 0.7 else SCHEDULE_ANYWAY
+                ),
+                label_selector=LabelSelector(match_labels={"my-label": letter}),
+                min_domains=rng.choice([None, None, 2, 3, 5]),
+            )
+        ]
+    elif roll < 0.40:
+        # hostname spread — the fresh-claim-per-pod family
+        spec_kw["topology_spread_constraints"] = [
+            TopologySpreadConstraint(
+                max_skew=1,
+                topology_key=wk.LABEL_HOSTNAME,
+                when_unsatisfiable=DO_NOT_SCHEDULE,
+                label_selector=LabelSelector(
+                    match_labels={"my-label": rng.choice("abcdefg")}
+                ),
+            )
+        ]
+    elif roll < 0.55:
+        # zonal / hostname pod-affinity: the retry-ordering family — the
+        # selector may target labels only carried by LATER queue rows, so
+        # the first sweep FAILs the whole chain and a later sweep places it
+        labels = {"my-affinity": letter}
+        spec_kw["affinity"] = Affinity(
+            pod_affinity=PodAffinity(
+                required=[
+                    PodAffinityTerm(
+                        label_selector=LabelSelector(
+                            match_labels={"my-affinity": letter}
+                        ),
+                        topology_key=(
+                            wk.LABEL_TOPOLOGY_ZONE
+                            if rng.random() < 0.5
+                            else wk.LABEL_HOSTNAME
+                        ),
+                    )
+                ]
+            )
+        )
+    # remainder: generic pods whose labels feed other pods' selectors
+    cpu = rng.choice([0.1, 0.1, 0.5, 1.0, 1.5])
+    return Pod(
+        metadata=ObjectMeta(name=f"p{i}", labels=labels),
+        spec=PodSpec(containers=[Container(requests={"cpu": cpu})], **spec_kw),
+    )
+
+
+def _population(seed: int):
+    rng = random.Random(seed)
+    its = instance_types(rng.choice([6, 10]))
+    templates = [simple_template(its, name="a")]
+    n = rng.randint(40, 140) if seed % 3 else rng.randint(150, 260)
+    pods = [_chain_pod(rng, i) for i in range(n)]
+    return pods, its, templates
+
+
+class TestChainOracleParity:
+    """End-to-end oracle parity on chain-heavy mixed populations."""
+
+    @pytest.mark.parametrize("seed", range(10))
+    def test_fuzz_chain_families(self, seed):
+        pods, its, templates = _population(2000 + seed)
+        run_both(pods, its, templates)
+
+
+class TestChainDisableDifferential:
+    """solve_ffd_sweeps with chain-identity batching vs the SAME problem with
+    pod_eqprev_chain overwritten by pod_eqprev (byte-identity chains only).
+    The overwrite is a pure runtime input change — same jit trace shape — so
+    any divergence is the chain batching itself."""
+
+    def _encode(self, seed: int):
+        pods, its, templates = _population(3000 + seed)
+        domains = domains_from_instance_types(its, templates)
+        topo = Topology(domains, batch_pods=pods, cluster_pods=[])
+        encoded = Encoder(FAKE_WELL_KNOWN_LABELS).encode(
+            pods, its, templates, (), topology=topo, num_claim_slots=128,
+        )
+        return pad_problem(encoded.problem)
+
+    @pytest.mark.parametrize("seed", range(10))
+    def test_chain_vs_byte_chains(self, seed):
+        problem = self._encode(seed)
+        assert problem.pod_eqprev_chain is not None
+        r_chain = solve_ffd_sweeps(problem, 128)
+        r_plain = solve_ffd_sweeps(
+            dataclasses.replace(problem, pod_eqprev_chain=problem.pod_eqprev),
+            128,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(r_chain.kind), np.asarray(r_plain.kind)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(r_chain.index), np.asarray(r_plain.index)
+        )
+
+    def test_chain_commits_fire_and_save_iterations(self):
+        """Coverage + perf guard: on a chain-heavy population the chain path
+        must actually batch (chain-commit iterations > 0) and must not need
+        MORE narrow iterations than byte-identity chains alone."""
+        fired = 0
+        for seed in range(4):
+            problem = self._encode(seed)
+            r_chain = solve_ffd_sweeps(problem, 128)
+            r_plain = solve_ffd_sweeps(
+                dataclasses.replace(problem, pod_eqprev_chain=problem.pod_eqprev),
+                128,
+            )
+            it_c = np.asarray(r_chain.iters)
+            it_p = np.asarray(r_plain.iters)
+            fired += int(it_c[2] > 0)
+            assert int(it_c[0]) <= int(it_p[0]), (it_c, it_p)
+        assert fired > 0, "no chain commit fired on any seed"

@@ -1,0 +1,91 @@
+"""Dev tool: measure what the chain-commit branches buy on the 10k bench shape.
+
+Runs itself as a subprocess per (KARPENTER_TPU_TOPO_CHAIN,
+KARPENTER_TPU_SPREAD_CHAIN, KARPENTER_TPU_STRIDE) config — the flags are read
+at module import. Times the sweeps solver twice (compile + steady) over the
+10k diverse bench problem and prints the 4-element iteration stack
+[narrow iterations, sweeps, chain-commit iterations, chain-committed pods],
+so the narrow-iteration floor and the hit rate are visible per config.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+# (topo_chain, spread_chain, stride)
+CONFIGS = [
+    ("1", "1", "64"),
+    ("1", "0", "64"),
+    ("0", "1", "64"),
+    ("0", "0", "64"),
+    ("1", "1", "32"),
+    ("1", "1", "128"),
+]
+
+if os.environ.get("_PROFILE_CHAIN_CHILD") != "1":
+    for topo, spread, stride in CONFIGS:
+        env = dict(os.environ)
+        env["_PROFILE_CHAIN_CHILD"] = "1"
+        env["KARPENTER_TPU_TOPO_CHAIN"] = topo
+        env["KARPENTER_TPU_SPREAD_CHAIN"] = spread
+        env["KARPENTER_TPU_STRIDE"] = stride
+        subprocess.run([sys.executable, __file__], env=env)
+    sys.exit(0)
+
+sys.path.insert(0, ".")
+import __graft_entry__
+
+__graft_entry__._respect_platform_env()
+
+import random
+
+import jax
+import numpy as np
+
+from bench import make_diverse_pods
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodepool import NodePool
+from karpenter_tpu.apis.objects import ObjectMeta
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.ops.ffd import solve_ffd_sweeps
+from karpenter_tpu.ops.padding import pad_problem
+from karpenter_tpu.provisioning.topology import Topology
+from karpenter_tpu.solver.encode import (
+    Encoder,
+    domains_from_instance_types,
+    template_from_nodepool,
+)
+
+rng = random.Random(42)
+its = instance_types(400)
+tpl = template_from_nodepool(
+    NodePool(metadata=ObjectMeta(name="default")), its, range(len(its))
+)
+pods = make_diverse_pods(10000, rng)
+domains = domains_from_instance_types(its, [tpl])
+topo = Topology(domains, batch_pods=pods, cluster_pods=[])
+enc = Encoder(wk.WELL_KNOWN_LABELS)
+encoded = enc.encode(pods, its, [tpl], [], topology=topo, num_claim_slots=128)
+problem = pad_problem(encoded.problem)
+
+t0 = time.perf_counter()
+r = solve_ffd_sweeps(problem, 128)
+np.asarray(r.kind)
+compile_s = time.perf_counter() - t0
+t0 = time.perf_counter()
+r = solve_ffd_sweeps(problem, 128)
+np.asarray(r.kind)
+steady = time.perf_counter() - t0
+iters = [int(x) for x in np.asarray(r.iters)]
+narrow, sweeps, cc, cp = iters
+P = problem.num_pods
+print(
+    f"topo_chain={os.environ['KARPENTER_TPU_TOPO_CHAIN']} "
+    f"spread_chain={os.environ['KARPENTER_TPU_SPREAD_CHAIN']} "
+    f"stride={os.environ['KARPENTER_TPU_STRIDE']:>3s} "
+    f"steady={steady:.3f}s narrow_iters={narrow} sweeps={sweeps} "
+    f"chain_commits={cc} chain_pods={cp} "
+    f"hit_rate={cp / P:.3f} (compile {compile_s:.1f}s)",
+    flush=True,
+)
