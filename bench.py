@@ -232,14 +232,17 @@ def run_child():
         # device-cost diagnostics of the last solve (sweeps mode only):
         # narrow iterations ARE the sequential depth, and the chain-commit
         # hit rate says how much of the queue the round-6 batching consumed
-        if solver.last_iters is not None and len(solver.last_iters) >= 4:
-            n_it, _sweeps, n_cc, n_cp = solver.last_iters[:4]
-            ev["narrow_iterations"] = n_it
+        if solver.last_iters is not None:
+            it = solver.last_iters
+            ev["narrow_iterations"] = it.narrow
             ev["chain_commit_hit_rate"] = (
-                round(n_cp / pod_count, 4) if pod_count else 0.0
+                round(it.chain_pods / pod_count, 4) if pod_count else 0.0
             )
-            ev["chain_commits"] = n_cc
-            ev["chain_committed_pods"] = n_cp
+            ev["chain_commits"] = it.chain_commits
+            ev["chain_committed_pods"] = it.chain_pods
+        # lifetime slot-overflow recompiles so far (claim-axis windowing
+        # keeps each one a quarter step instead of a doubling)
+        ev["claim_escalations"] = solver.claim_escalations
         emit(ev)
     if first_solve is not None:
         emit({"event": "first_solve", **first_solve})

@@ -30,11 +30,13 @@ keep one import surface.
 from karpenter_tpu.ops.ffd_core import (  # noqa: F401
     FFDResult,
     FFDState,
+    IterCounts,
     KIND_CLAIM,
     KIND_FAIL,
     KIND_NEW_CLAIM,
     KIND_NODE,
     KIND_NO_SLOT,
+    Statics,
     _capacity,
     _first_true,
     _fresh_template_rows,
@@ -47,9 +49,11 @@ from karpenter_tpu.ops.ffd_core import (  # noqa: F401
     _pad_lanes_mult32,
     _pin_hostname,
     _pod_xs,
+    _row_sentinel_bounds,
     _statics,
     _water_level,
     initial_state,
+    problem_bounds_free,
 )
 from karpenter_tpu.ops.ffd_step import (  # noqa: F401
     _make_step,

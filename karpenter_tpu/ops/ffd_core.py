@@ -9,13 +9,13 @@ scheduler.go:140-189 (Solve pod loop) and :238-285 (placement priority).
 
 
 from dataclasses import dataclass
-from typing import Any
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax, vmap
 
-from karpenter_tpu.models.problem import ReqTensor, SchedulingProblem
+from karpenter_tpu.models.problem import GT_NONE, LT_NONE, ReqTensor, SchedulingProblem
 from karpenter_tpu.ops import masks
 
 KIND_NODE = 0
@@ -40,6 +40,13 @@ _BIG = 2**30
 import os as _os  # noqa: E402
 
 _UNROLL = int(_os.environ.get("KARPENTER_TPU_SCAN_UNROLL", "1"))
+
+# gate kernel-count diet (round 7): when the problem carries no finite
+# integer Gt/Lt bound anywhere, the narrow step statically elides all bounds
+# math, fuses the duplicated state x pod intersections out of the gate
+# phases, and skips the loop-invariant gt/lt state writes. 0 restores the
+# pre-diet program exactly — the same-host A/B kill switch.
+_GATE_DIET = _os.environ.get("KARPENTER_TPU_PACKED_GATES", "1") == "1"
 
 # dev-only cost-attribution knob: comma-set of step phases to stub out
 # (results become WRONG — never set outside tools/profile_step.py)
@@ -68,15 +75,27 @@ class FFDState:
     grp_registered: Any  # bool[G, V] known topology domains
 
 
+class IterCounts(NamedTuple):
+    """Device-side loop counters of one sweeps-mode solve — one scalar add
+    per iteration, fetched with the result so perf work can see where the
+    device time goes without a profiler attach. A NamedTuple: field access
+    by NAME is the supported interface (the positional 4-tuple form already
+    caused a miscounted consumer once), while tuple compatibility keeps
+    ``last_iters[0]``-style diagnostics working."""
+
+    narrow: Any  # i32 exact narrow-step iterations
+    sweeps: Any  # i32 requeue sweeps over the queue
+    chain_commits: Any  # i32 closed-form chain commits (k > 1)
+    chain_pods: Any  # i32 pods consumed by those chain commits
+
+
 @jax.tree_util.register_dataclass
 @dataclass
 class FFDResult:
     kind: Any  # i32[P]
     index: Any  # i32[P] node index / claim slot (meaning depends on kind)
     state: FFDState  # final bin state
-    # i32[2] (sweeps path only): [narrow iterations, sweeps] — one scalar add
-    # per iteration, fetched with the result so perf work can see where the
-    # device time goes without a profiler attach
+    # IterCounts of i32 scalars (sweeps path only); None on the scan paths
     iters: Any = None
 
 
@@ -85,8 +104,49 @@ def _first_true(mask: jnp.ndarray) -> jnp.ndarray:
     return jnp.argmax(jnp.concatenate([mask, jnp.array([True])]))
 
 
-def _intersect_rows(reqs: ReqTensor, row: ReqTensor) -> ReqTensor:
-    return vmap(lambda r: masks.intersect(r, row))(reqs)
+def _intersect_rows(reqs: ReqTensor, row: ReqTensor, bounds_free: bool = False) -> ReqTensor:
+    return vmap(lambda r: masks.intersect(r, row, bounds_free))(reqs)
+
+
+def _row_sentinel_bounds(rows: ReqTensor, idx) -> ReqTensor:
+    """``rows.row(idx)`` under the bounds-free diet: every gt/lt in the
+    program is the no-bound sentinel, so materialize the row's bounds as
+    constants instead of spending two gather kernels on them."""
+    K = rows.comp.shape[-1]
+    return ReqTensor(
+        admitted=rows.admitted[idx],
+        comp=rows.comp[idx],
+        gt=jnp.full((K,), GT_NONE, jnp.int32),
+        lt=jnp.full((K,), LT_NONE, jnp.int32),
+        defined=rows.defined[idx],
+    )
+
+
+def problem_bounds_free(problem: SchedulingProblem) -> bool:
+    """Host-side (numpy, pre-jit) check: no requirement in the problem
+    carries a finite integer Gt/Lt bound, so gt/lt are sentinel-valued
+    everywhere and stay so through every intersection/narrowing for the
+    whole solve — the static precondition for the gate kernel-count diet
+    (see ops/masks.py). Claim state starts at sentinels (initial_state) and
+    only ever intersects these sources; topo_gate and _pin_hostname pass
+    gt/lt through untouched. Returns False when the diet kill switch
+    (KARPENTER_TPU_PACKED_GATES=0) is set."""
+    if not _GATE_DIET:
+        return False
+    import numpy as np
+
+    for r in (
+        problem.pod_reqs,
+        problem.pod_strict_reqs,
+        problem.it_reqs,
+        problem.tpl_reqs,
+        problem.node_reqs,
+        problem.grp_filter,
+    ):
+        gt, lt = np.asarray(r.gt), np.asarray(r.lt)
+        if gt.size and (np.any(gt != GT_NONE) or np.any(lt != LT_NONE)):
+            return False
+    return True
 
 
 def initial_state(problem: SchedulingProblem, max_claims: int) -> FFDState:
@@ -176,28 +236,50 @@ def _lane_align(problem: SchedulingProblem, init: FFDState):
     return problem, init
 
 
-def _statics(problem: SchedulingProblem):
-    """Per-solve invariants shared by the per-pod step and the run commit."""
+class Statics(NamedTuple):
+    """Per-solve invariants shared by the per-pod step and the run commit.
+    The first six fields keep their historical order (older paths unpack
+    ``statics[:6]``); ``tpl_neg`` and ``bounds_free`` feed the round-7 gate
+    diet. ``bounds_free`` is a plain Python bool — a STATIC trace-time
+    branch, never a traced value."""
+
+    lv: Any  # bool[K, V]
+    ln: Any  # f32[K, V]
+    wellknown: Any  # bool[K]
+    no_allow: Any  # bool[K]
+    it_packed: Any  # uint32[T, K, W]
+    it_neg: Any  # bool[T, K]
+    tpl_neg: Any  # bool[TPL, K] template-row polarity (static per solve)
+    bounds_free: bool
+
+
+def _statics(problem: SchedulingProblem, bounds_free: bool = False) -> Statics:
     lv, ln = jnp.asarray(problem.lane_valid), jnp.asarray(problem.lane_numeric)
     wellknown = jnp.asarray(problem.key_wellknown)
     no_allow = jnp.zeros_like(wellknown)
     # instance-type side of the hot compat product: packed lanes + polarity,
     # computed once per solve (instance types never change during a pack)
     it_packed = masks.pack_lanes(jnp.asarray(problem.it_reqs.admitted))  # [T, K, W]
-    it_neg = vmap(lambda r: masks.negative_polarity(r, lv, ln))(problem.it_reqs)
-    return lv, ln, wellknown, no_allow, it_packed, it_neg
+    it_neg = vmap(lambda r: masks.negative_polarity(r, lv, ln, bounds_free))(problem.it_reqs)
+    tpl_neg = vmap(lambda r: masks.negative_polarity(r, lv, ln, bounds_free))(problem.tpl_reqs)
+    return Statics(lv, ln, wellknown, no_allow, it_packed, it_neg, tpl_neg, bounds_free)
 
 
 def _make_it_gate(problem, statics):
-    lv, ln, wellknown, no_allow, it_packed, it_neg = statics
+    lv, ln = statics.lv, statics.ln
+    it_packed, it_neg = statics.it_packed, statics.it_neg
+    bounds_free = statics.bounds_free
 
     def it_gate(state_rows: ReqTensor, requests: jnp.ndarray, prior_ok: jnp.ndarray):
         """[B, T] mask of instance types surviving a narrowed state +
         accumulated requests (nodeclaim.go:225-260)."""
         state_packed = masks.pack_lanes(state_rows.admitted)  # [B, K, W]
-        state_neg = vmap(lambda r: masks.negative_polarity(r, lv, ln))(state_rows)
+        state_neg = vmap(
+            lambda r: masks.negative_polarity(r, lv, ln, bounds_free)
+        )(state_rows)
         compat = masks.packed_pairwise_compat(
-            state_rows, state_packed, state_neg, problem.it_reqs, it_packed, it_neg
+            state_rows, state_packed, state_neg,
+            problem.it_reqs, it_packed, it_neg, bounds_free,
         )  # [B, T]
         fit = masks.fits(requests[:, None, :], problem.it_alloc[None, :, :])  # [B, T]
         offer = _offer_rows(problem, state_rows.admitted)  # [B, T]
@@ -218,14 +300,22 @@ def _offer_rows(problem: SchedulingProblem, admitted) -> jnp.ndarray:
     )(admitted)
 
 
-def _mix_req_rows(cur: ReqTensor, upd: ReqTensor, hot) -> ReqTensor:
-    """Commit updated requirement rows where ``hot`` (bool[E]) is set."""
+def _mix_req_rows(cur: ReqTensor, upd: ReqTensor, hot, bounds_free: bool = False) -> ReqTensor:
+    """Commit updated requirement rows where ``hot`` (bool[E]) is set. Under
+    bounds_free gt/lt are sentinel-valued on both sides — the write is an
+    identity, so skipping it keeps the state arrays loop-invariant (XLA
+    hoists them out of the solve loop)."""
     sel2, sel3 = hot[:, None], hot[:, None, None]
+    if bounds_free:
+        gt, lt = cur.gt, cur.lt
+    else:
+        gt = jnp.where(sel2, upd.gt, cur.gt)
+        lt = jnp.where(sel2, upd.lt, cur.lt)
     return ReqTensor(
         admitted=jnp.where(sel3, upd.admitted, cur.admitted),
         comp=jnp.where(sel2, upd.comp, cur.comp),
-        gt=jnp.where(sel2, upd.gt, cur.gt),
-        lt=jnp.where(sel2, upd.lt, cur.lt),
+        gt=gt,
+        lt=lt,
         defined=jnp.where(sel2, upd.defined, cur.defined),
     )
 
@@ -258,24 +348,47 @@ def _pin_hostname(row: ReqTensor, host_onehot) -> ReqTensor:
     )
 
 
-def _fresh_template_rows(problem: SchedulingProblem, lv, ln, wellknown, pod_req, free_slot):
+def _fresh_template_rows(
+    problem: SchedulingProblem, lv, ln, wellknown, pod_req, free_slot,
+    bounds_free: bool = False, tpl_neg=None, pod_neg=None,
+):
     """Fresh-claim template evaluation shared by the per-pod step and the run
     commit: the prospective slot's hostname is minted and pinned into the
     merged template rows before any gate sees them (nodeclaim.go:46-63), and
     template compatibility uses the well-known allowance. Returns
-    (tpl_merged, tpl_compat, host_onehot)."""
+    (tpl_merged, tpl_compat, host_onehot).
+
+    Gate diet: when ``bounds_free`` with precomputed polarities, template
+    compatibility is derived from the merged rows the phase computes anyway
+    (masks.compatible_from_merged) instead of re-intersecting inside
+    compatible_ok."""
     mint_hostnames = problem.claim_hostname_lane.shape[0] > 0
     host_onehot = _mint_host_onehot(problem, free_slot)
-    tpl_compat = vmap(
-        lambda tr: masks.compatible_ok(tr, pod_req, lv, ln, wellknown)
-    )(problem.tpl_reqs)
-    tpl_merged = _intersect_rows(problem.tpl_reqs, pod_req)
+    tpl_merged_u = _intersect_rows(problem.tpl_reqs, pod_req, bounds_free)
+    if bounds_free and tpl_neg is not None and pod_neg is not None:
+        tpl_compat = masks.compatible_from_merged(
+            masks.nonempty(tpl_merged_u, bounds_free),
+            problem.tpl_reqs.defined, tpl_neg,
+            pod_req.defined, pod_neg, wellknown,
+        )
+    else:
+        tpl_compat = vmap(
+            lambda tr: masks.compatible_ok(tr, pod_req, lv, ln, wellknown, bounds_free)
+        )(problem.tpl_reqs)
+    tpl_merged = tpl_merged_u
     if mint_hostnames:
         tpl_merged = _pin_hostname(tpl_merged, host_onehot)
     return tpl_merged, tpl_compat, host_onehot
 
 
-def _pod_xs(problem: SchedulingProblem):
+def _pod_xs(problem: SchedulingProblem, bounds_free: bool = False):
+    # element 12: per-pod effective-requirement polarity [P, K], computed
+    # ONCE per solve — the narrow step shares it across its node/claim/
+    # template gate phases instead of re-deriving it per phase per iteration
+    lv, ln = jnp.asarray(problem.lane_valid), jnp.asarray(problem.lane_numeric)
+    pod_negs = vmap(
+        lambda r: masks.negative_polarity(r, lv, ln, bounds_free)
+    )(problem.pod_reqs)
     return (
         problem.pod_reqs,
         problem.pod_strict_reqs,
@@ -289,6 +402,7 @@ def _pod_xs(problem: SchedulingProblem):
         jnp.asarray(problem.pod_grp_owned),
         jnp.asarray(problem.pod_vol_counts),
         jnp.asarray(problem.pod_active),
+        pod_negs,
     )
 
 

@@ -73,7 +73,9 @@ def _make_run_commit(problem: SchedulingProblem, statics, C: int, max_run: int):
                 slot order. Limit headroom burns once per open (subtractMax,
                 scheduler.go:347-364).
     """
-    lv, ln, wellknown, no_allow, it_packed, it_neg = statics
+    # the run commits stay on the legacy (non-dieted) gate kernels; they
+    # consume only the first six statics fields
+    lv, ln, wellknown, no_allow, it_packed, it_neg = statics[:6]
     N = problem.num_nodes
     T = problem.num_instance_types
     TPL = problem.num_templates
@@ -99,6 +101,7 @@ def _make_run_commit(problem: SchedulingProblem, statics, C: int, max_run: int):
             _go,
             pod_vols,
             _pa,
+            _pod_neg,
         ) = pod
         win = jnp.arange(max_run)
         act = lax.dynamic_slice(active_arr, (start,), (max_run,)) & (win < length)

@@ -52,8 +52,10 @@ from karpenter_tpu.ops.ffd_core import (  # noqa: F401
     _mix_req_rows,
     _pad_lanes_mult32,
     _pod_xs,
+    _row_sentinel_bounds,
     _statics,
     initial_state,
+    problem_bounds_free,
 )
 
 def solve_ffd(
@@ -67,14 +69,19 @@ def solve_ffd(
     device op outside a jit is a separate launch through the (possibly
     remote) TPU runtime, and initial_state's ~13 of them cost more than the
     whole small-batch scan."""
+    bounds_free = problem_bounds_free(problem)
     if init is None:
-        return _solve_ffd_fresh_jit(problem, max_claims)
-    return _solve_ffd_jit(problem, init)
+        return _solve_ffd_fresh_jit(problem, max_claims, bounds_free)
+    return _solve_ffd_jit(problem, init, bounds_free)
 
 
 
 def _make_step(problem: SchedulingProblem, statics, C: int):
-    lv, ln, wellknown, no_allow, it_packed, it_neg = statics
+    lv, ln = statics.lv, statics.ln
+    wellknown, no_allow = statics.wellknown, statics.no_allow
+    # static gate-diet switch (ops/ffd_core.problem_bounds_free): True picks
+    # the fused bounds-free gate phases below; False is the pre-diet program
+    bounds_free = statics.bounds_free
     N = problem.num_nodes
     T = problem.num_instance_types
     TPL = problem.num_templates
@@ -96,6 +103,7 @@ def _make_step(problem: SchedulingProblem, statics, C: int):
             grp_owned,
             pod_vols,
             pod_is_active,
+            pod_neg,
         ) = pod
         topo_pod = PodTopoStatics(
             strict_admitted=pod_strict.admitted,
@@ -112,31 +120,70 @@ def _make_step(problem: SchedulingProblem, statics, C: int):
 
         def gated(merged, allow, registered):
             return topo_gate(
-                problem, state.grp_counts, registered, topo_pod, merged, allow
+                problem, state.grp_counts, registered, topo_pod, merged, allow,
+                fuse=bounds_free,
             )
 
         # -- 1. existing nodes (scheduler.go:240-244; existingnode.go:64-124)
-        node_requests2 = state.node_requests + pod_requests[None, :]
-        node_fit = masks.fits(node_requests2, problem.node_avail)
-        node_compat = vmap(
-            lambda nr: masks.compatible_ok(nr, pod_req, lv, ln, no_allow)
-        )(state.node_req)
-        node_port_ok = ~jnp.any(state.node_used_ports & pod_conflict[None, :], axis=-1)
-        # CSI attach limits gate existing nodes only (existingnode.go:100-106)
-        node_vol_ok = jnp.all(
-            state.node_vol_used + pod_vols[None, :] <= problem.node_vol_limits, axis=-1
-        )
-        node_merged = _intersect_rows(state.node_req, pod_req)
-        node_topo_ok, node_final = gated(node_merged, no_allow, state.grp_registered)
-        node_ok = tol_node & node_fit & node_compat & node_port_ok & node_vol_ok & node_topo_ok
-        node_pick = _first_true(node_ok)
-        any_node = jnp.any(node_ok)
+        if bounds_free and N == 0:
+            # static empty-node-set skip: provisioning-from-scratch problems
+            # carry zero-size node tensors, but the gates over them still
+            # trace (and launch) ~a dozen kernels per step; elide the phase
+            node_requests2 = state.node_requests
+            node_final = state.node_req
+            node_ok = jnp.zeros((0,), bool)
+            node_pick = jnp.int32(0)
+            any_node = jnp.bool_(False)
+        else:
+            node_requests2 = state.node_requests + pod_requests[None, :]
+            node_fit = masks.fits(node_requests2, problem.node_avail)
+            node_merged = _intersect_rows(state.node_req, pod_req, bounds_free)
+            if bounds_free:
+                # fused gate: compatible_ok re-derives the intersection we
+                # already hold, so feed it the merged rows instead
+                node_neg = vmap(
+                    lambda r: masks.negative_polarity(r, lv, ln, True)
+                )(state.node_req)
+                node_compat = masks.compatible_from_merged(
+                    masks.nonempty(node_merged, True),
+                    state.node_req.defined,
+                    node_neg,
+                    pod_req.defined,
+                    pod_neg,
+                    no_allow,
+                )
+            else:
+                node_compat = vmap(
+                    lambda nr: masks.compatible_ok(nr, pod_req, lv, ln, no_allow)
+                )(state.node_req)
+            node_port_ok = ~jnp.any(state.node_used_ports & pod_conflict[None, :], axis=-1)
+            # CSI attach limits gate existing nodes only (existingnode.go:100-106)
+            node_vol_ok = jnp.all(
+                state.node_vol_used + pod_vols[None, :] <= problem.node_vol_limits, axis=-1
+            )
+            node_topo_ok, node_final = gated(node_merged, no_allow, state.grp_registered)
+            node_ok = tol_node & node_fit & node_compat & node_port_ok & node_vol_ok & node_topo_ok
+            node_pick = _first_true(node_ok)
+            any_node = jnp.any(node_ok)
 
         # -- 2. open claims, fewest pods first (scheduler.go:247-254)
-        claim_compat = vmap(
-            lambda cr: masks.compatible_ok(cr, pod_req, lv, ln, wellknown)
-        )(state.claim_req)
-        claim_merged = _intersect_rows(state.claim_req, pod_req)
+        claim_merged = _intersect_rows(state.claim_req, pod_req, bounds_free)
+        if bounds_free:
+            claim_neg = vmap(
+                lambda r: masks.negative_polarity(r, lv, ln, True)
+            )(state.claim_req)
+            claim_compat = masks.compatible_from_merged(
+                masks.nonempty(claim_merged, True),
+                state.claim_req.defined,
+                claim_neg,
+                pod_req.defined,
+                pod_neg,
+                wellknown,
+            )
+        else:
+            claim_compat = vmap(
+                lambda cr: masks.compatible_ok(cr, pod_req, lv, ln, wellknown)
+            )(state.claim_req)
         if "ctopo" in _ABLATE:
             claim_topo_ok, claim_final = jnp.ones((C,), bool), claim_merged
         else:
@@ -159,7 +206,13 @@ def _make_step(problem: SchedulingProblem, statics, C: int):
         )
         claim_rank = jnp.where(claim_ok, state.claim_npods * C + jnp.arange(C), _BIG)
         claim_pick = jnp.argmin(claim_rank)
-        any_claim = jnp.any(claim_ok)
+        if bounds_free:
+            # ranks max out at npods*C + C << _BIG, so the min rank being a
+            # real rank is exactly "some claim passed" — a 1-element gather
+            # instead of another [C] reduction
+            any_claim = claim_rank[claim_pick] < _BIG
+        else:
+            any_claim = jnp.any(claim_ok)
 
         # -- 3. fresh claim from templates, weight order (scheduler.go:256-283);
         # the prospective slot's hostname is minted before evaluation
@@ -169,7 +222,12 @@ def _make_step(problem: SchedulingProblem, statics, C: int):
         # free, which on large packs is a small minority of steps (opens +
         # terminal failures).
         free_slot = _first_true(~state.claim_open)
-        has_slot = jnp.any(~state.claim_open)
+        if bounds_free:
+            # _first_true returns C when no slot is free — a scalar compare
+            # replaces the [C] any-reduction
+            has_slot = free_slot < C
+        else:
+            has_slot = jnp.any(~state.claim_open)
         # hostname minting is active only when the encoder allotted claim
         # hostname lanes (static shape decision)
         mint_hostnames = problem.claim_hostname_lane.shape[0] > 0
@@ -178,7 +236,15 @@ def _make_step(problem: SchedulingProblem, statics, C: int):
         def eval_tpl():
             tpl_requests2 = problem.tpl_overhead + pod_requests[None, :]
             tpl_merged, tpl_compat, host_onehot = _fresh_template_rows(
-                problem, lv, ln, wellknown, pod_req, free_slot
+                problem,
+                lv,
+                ln,
+                wellknown,
+                pod_req,
+                free_slot,
+                bounds_free=bounds_free,
+                tpl_neg=statics.tpl_neg,
+                pod_neg=pod_neg,
             )
             # the new hostname is registered before the gate evaluates
             reg_for_tpl = state.grp_registered | (
@@ -200,7 +266,10 @@ def _make_step(problem: SchedulingProblem, statics, C: int):
             tpl_ok = tol_tpl & tpl_compat & tpl_topo_ok & jnp.any(tpl_it_ok2, axis=-1)
             tpl_pick = _first_true(tpl_ok)
             pick_c = jnp.minimum(tpl_pick, TPL - 1)
-            slot_req = tpl_final.row(pick_c)
+            if bounds_free:
+                slot_req = _row_sentinel_bounds(tpl_final, pick_c)
+            else:
+                slot_req = tpl_final.row(pick_c)
             tpl_row_it_ok = tpl_it_ok2[pick_c]
             max_cap = jnp.max(
                 jnp.where(tpl_row_it_ok[:, None], problem.it_cap, 0.0), axis=0
@@ -272,7 +341,7 @@ def _make_step(problem: SchedulingProblem, statics, C: int):
         claim_hot = (jnp.arange(C) == claim_pick) & (kind == KIND_CLAIM)
         slot_hot = (jnp.arange(C) == free_slot) & (kind == KIND_NEW_CLAIM)
 
-        mix_req = _mix_req_rows
+        mix_req = functools.partial(_mix_req_rows, bounds_free=bounds_free)
 
         def gather_row(rows: ReqTensor, idx, cap) -> ReqTensor:
             return rows.row(jnp.minimum(idx, cap - 1))
@@ -402,21 +471,31 @@ def _make_step(problem: SchedulingProblem, statics, C: int):
     return step
 
 
-@jax.jit
-def _solve_ffd_jit(problem: SchedulingProblem, init: FFDState) -> FFDResult:
+@functools.partial(jax.jit, static_argnums=(2,))
+def _solve_ffd_jit(
+    problem: SchedulingProblem, init: FFDState, bounds_free: bool = False
+) -> FFDResult:
     """Reference per-pod scan: one pod per step — the provisioning
     production default (faster than the run-compressed scan on diverse
     workloads, see solver/jax_backend.py) and the semantic anchor the
     run-compressed solver is fuzz-checked against."""
     problem, init = _lane_align(problem, init)
-    step = _make_step(problem, _statics(problem), init.claim_open.shape[0])
-    final_state, (kinds, indices) = lax.scan(step, init, _pod_xs(problem), unroll=_UNROLL)
+    step = _make_step(
+        problem, _statics(problem, bounds_free), init.claim_open.shape[0]
+    )
+    final_state, (kinds, indices) = lax.scan(
+        step, init, _pod_xs(problem, bounds_free), unroll=_UNROLL
+    )
     return FFDResult(kind=kinds, index=indices, state=final_state)
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
-def _solve_ffd_fresh_jit(problem: SchedulingProblem, max_claims: int) -> FFDResult:
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _solve_ffd_fresh_jit(
+    problem: SchedulingProblem, max_claims: int, bounds_free: bool = False
+) -> FFDResult:
     """Fresh-state variant: initial_state is traced into the program so a
     first-pass solve is a single device launch."""
     problem = _pad_lanes_mult32(problem)
-    return _solve_ffd_jit.__wrapped__(problem, initial_state(problem, max_claims))
+    return _solve_ffd_jit.__wrapped__(
+        problem, initial_state(problem, max_claims), bounds_free
+    )

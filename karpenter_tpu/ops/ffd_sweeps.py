@@ -14,7 +14,9 @@ import jax.numpy as jnp
 from jax import lax, vmap
 
 from karpenter_tpu.models.problem import (
+    GT_NONE,
     HOSTNAME_KEY,
+    LT_NONE,
     ReqTensor,
     SchedulingProblem,
 )
@@ -32,6 +34,7 @@ import os as _os
 from karpenter_tpu.ops.ffd_core import (  # noqa: F401
     FFDResult,
     FFDState,
+    IterCounts,
     KIND_CLAIM,
     KIND_FAIL,
     KIND_NEW_CLAIM,
@@ -48,9 +51,11 @@ from karpenter_tpu.ops.ffd_core import (  # noqa: F401
     _offer_rows,
     _pad_lanes_mult32,
     _pod_xs,
+    _row_sentinel_bounds,
     _statics,
     _water_level,
     initial_state,
+    problem_bounds_free,
 )
 from karpenter_tpu.ops.ffd_runs import _make_run_commit  # noqa: F401
 
@@ -91,7 +96,12 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
     A claim-open commits alone (it moves free_slot, limits headroom, and the
     fewest-pods ranking). Every iteration consumes >= 1 pod.
     """
-    lv, ln, wellknown, no_allow, it_packed, it_neg = statics
+    lv, ln = statics.lv, statics.ln
+    wellknown, no_allow = statics.wellknown, statics.no_allow
+    it_packed, it_neg = statics.it_packed, statics.it_neg
+    # static gate-diet switch (ops/ffd_core.problem_bounds_free): True picks
+    # the fused bounds-free gate phases below; False is the pre-diet program
+    bounds_free = statics.bounds_free
     N = problem.num_nodes
     T = problem.num_instance_types
     TPL = problem.num_templates
@@ -138,6 +148,54 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
     )
     Srange = jnp.arange(S)
 
+    # -- packed per-pod gather: each iteration fetches ONE pod's row from
+    # every pods_xs leaf (~30 arrays), and a leafwise tree_map costs a
+    # dynamic-slice kernel per leaf. Stacking same-shape/same-dtype leaves
+    # once per solve (outside the loop) turns that into one gather per
+    # GROUP plus free static unstack slices — exact, since the leaves are
+    # stacked, gathered, and unstacked unchanged. Under the gate diet the
+    # pod-side gt/lt tables are all-sentinel, so their rows are replaced
+    # by constants outright instead of being gathered at all.
+    if bounds_free:
+        _pod_leaves, _pods_treedef = jax.tree_util.tree_flatten_with_path(pods_xs)
+        _const_rows = {}
+        _gather_groups = {}
+        for _li, (_path, _leaf) in enumerate(_pod_leaves):
+            _leaf = jnp.asarray(_leaf)
+            _name = getattr(_path[-1], "name", None)
+            if _name in ("gt", "lt"):
+                _const_rows[_li] = jnp.full(
+                    _leaf.shape[1:], GT_NONE if _name == "gt" else LT_NONE, _leaf.dtype
+                )
+                continue
+            _gather_groups.setdefault(
+                (_leaf.shape[1:], str(_leaf.dtype)), []
+            ).append((_li, _leaf))
+        _packed_tables = [
+            (
+                [li for li, _ in grp],
+                grp[0][1] if len(grp) == 1 else jnp.stack([l for _, l in grp], axis=1),
+            )
+            for grp in _gather_groups.values()
+        ]
+
+        def gather_pod(p):
+            out = [None] * len(_pod_leaves)
+            for li, row in _const_rows.items():
+                out[li] = row
+            for lis, table in _packed_tables:
+                if len(lis) == 1:
+                    out[lis[0]] = table[p]
+                else:
+                    blk = table[p]  # [n, ...]
+                    for j, li in enumerate(lis):
+                        out[li] = blk[j]
+            return jax.tree_util.tree_unflatten(_pods_treedef, out)
+    else:
+
+        def gather_pod(p):
+            return jax.tree_util.tree_map(lambda a: a[p], pods_xs)
+
     def topo_of(pod):
         return PodTopoStatics(
             strict_admitted=pod[1].admitted,
@@ -174,56 +232,104 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
             _go,
             pod_vols,
             pod_is_active,
+            pod_neg,
         ) = pod
         topo_pod = topo_of(pod)
         port_cap = jnp.where(jnp.any(pod_ports), 1, _BIG_CAP).astype(jnp.int32)
 
         # -- existing nodes (same gates as _make_step)
-        node_requests2 = state.node_requests + pod_requests[None, :]
-        node_fit = masks.fits(node_requests2, problem.node_avail)
-        node_compat = vmap(
-            lambda nr: masks.compatible_ok(nr, pod_req, lv, ln, no_allow)
-        )(state.node_req)
-        node_port_ok = ~jnp.any(state.node_used_ports & pod_conflict[None, :], axis=-1)
-        node_vol_ok = jnp.all(
-            state.node_vol_used + pod_vols[None, :] <= problem.node_vol_limits, axis=-1
-        )
-        node_merged = _intersect_rows(state.node_req, pod_req)
-        node_topo_ok, node_final = topo_gate(
-            problem, state.grp_counts, state.grp_registered, topo_pod, node_merged, no_allow
-        )
-        node_ok = tol_node & node_fit & node_compat & node_port_ok & node_vol_ok & node_topo_ok
-        node_pick = _first_true(node_ok)
-        any_node = jnp.any(node_ok)
-        if N > 0:
-            pick_n = jnp.minimum(node_pick, N - 1)
-            node_final_row = node_final.row(pick_n)
-            res_cap = _capacity(
-                problem.node_avail[pick_n], state.node_requests[pick_n], pod_requests
-            )
-            if problem.pod_vol_counts.shape[1] > 0:
-                vol_room = jnp.maximum(
-                    (problem.node_vol_limits[pick_n] - state.node_vol_used[pick_n])
-                    // jnp.maximum(pod_vols, 1),
-                    0,
-                )
-                vol_cap = jnp.min(
-                    jnp.where(pod_vols > 0, vol_room, _BIG_CAP)
-                ).astype(jnp.int32)
-            else:
-                vol_cap = jnp.int32(_BIG_CAP)
-            node_fit_count = jnp.minimum(jnp.minimum(res_cap, vol_cap), port_cap)
-        else:
+        if bounds_free and N == 0:
+            # static empty-node-set skip (mirrors _make_step): zero-size gate
+            # kernels still trace + launch, so elide the whole phase
+            any_node = jnp.bool_(False)
+            node_pick = jnp.int32(0)
             node_final_row = _zeros_row()
             node_fit_count = jnp.int32(0)
+            node_static_any = jnp.bool_(False)
+        else:
+            node_requests2 = state.node_requests + pod_requests[None, :]
+            node_fit = masks.fits(node_requests2, problem.node_avail)
+            node_merged = _intersect_rows(state.node_req, pod_req, bounds_free)
+            if bounds_free:
+                # fused gate: compatible_ok re-derives the intersection we
+                # already hold, so feed it the merged rows instead
+                node_neg = vmap(
+                    lambda r: masks.negative_polarity(r, lv, ln, True)
+                )(state.node_req)
+                node_compat = masks.compatible_from_merged(
+                    masks.nonempty(node_merged, True),
+                    state.node_req.defined,
+                    node_neg,
+                    pod_req.defined,
+                    pod_neg,
+                    no_allow,
+                )
+            else:
+                node_compat = vmap(
+                    lambda nr: masks.compatible_ok(nr, pod_req, lv, ln, no_allow)
+                )(state.node_req)
+            node_port_ok = ~jnp.any(state.node_used_ports & pod_conflict[None, :], axis=-1)
+            node_vol_ok = jnp.all(
+                state.node_vol_used + pod_vols[None, :] <= problem.node_vol_limits, axis=-1
+            )
+            node_topo_ok, node_final = topo_gate(
+                problem, state.grp_counts, state.grp_registered, topo_pod,
+                node_merged, no_allow, fuse=bounds_free,
+            )
+            node_ok = tol_node & node_fit & node_compat & node_port_ok & node_vol_ok & node_topo_ok
+            node_pick = _first_true(node_ok)
+            any_node = jnp.any(node_ok)
+            # whether ANY node passes its static (counter-independent)
+            # gates — the spread mini-fill's node guard
+            node_static_any = jnp.any(
+                tol_node & node_fit & node_compat & node_port_ok & node_vol_ok
+            )
+            if N > 0:
+                pick_n = jnp.minimum(node_pick, N - 1)
+                if bounds_free:
+                    node_final_row = _row_sentinel_bounds(node_final, pick_n)
+                else:
+                    node_final_row = node_final.row(pick_n)
+                res_cap = _capacity(
+                    problem.node_avail[pick_n], state.node_requests[pick_n], pod_requests
+                )
+                if problem.pod_vol_counts.shape[1] > 0:
+                    vol_room = jnp.maximum(
+                        (problem.node_vol_limits[pick_n] - state.node_vol_used[pick_n])
+                        // jnp.maximum(pod_vols, 1),
+                        0,
+                    )
+                    vol_cap = jnp.min(
+                        jnp.where(pod_vols > 0, vol_room, _BIG_CAP)
+                    ).astype(jnp.int32)
+                else:
+                    vol_cap = jnp.int32(_BIG_CAP)
+                node_fit_count = jnp.minimum(jnp.minimum(res_cap, vol_cap), port_cap)
+            else:
+                node_final_row = _zeros_row()
+                node_fit_count = jnp.int32(0)
 
         # -- open claims (same gates as _make_step)
-        claim_compat = vmap(
-            lambda cr: masks.compatible_ok(cr, pod_req, lv, ln, wellknown)
-        )(state.claim_req)
-        claim_merged = _intersect_rows(state.claim_req, pod_req)
+        claim_merged = _intersect_rows(state.claim_req, pod_req, bounds_free)
+        if bounds_free:
+            claim_neg = vmap(
+                lambda r: masks.negative_polarity(r, lv, ln, True)
+            )(state.claim_req)
+            claim_compat = masks.compatible_from_merged(
+                masks.nonempty(claim_merged, True),
+                state.claim_req.defined,
+                claim_neg,
+                pod_req.defined,
+                pod_neg,
+                wellknown,
+            )
+        else:
+            claim_compat = vmap(
+                lambda cr: masks.compatible_ok(cr, pod_req, lv, ln, wellknown)
+            )(state.claim_req)
         claim_topo_ok, claim_final = topo_gate(
-            problem, state.grp_counts, state.grp_registered, topo_pod, claim_merged, wellknown
+            problem, state.grp_counts, state.grp_registered, topo_pod,
+            claim_merged, wellknown, fuse=bounds_free,
         )
         claim_requests2 = state.claim_requests + pod_requests[None, :]
         claim_it_ok2 = it_gate(claim_final, claim_requests2, state.claim_it_ok)
@@ -238,7 +344,13 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
         )
         claim_rank = jnp.where(claim_ok, state.claim_npods * C + jnp.arange(C), _BIG)
         claim_pick = jnp.argmin(claim_rank)
-        any_claim = jnp.any(claim_ok)
+        if bounds_free:
+            # ranks max out at npods*C + C << _BIG, so the min rank being a
+            # real rank is exactly "some claim passed" — a 1-element gather
+            # instead of another [C] reduction
+            any_claim = claim_rank[claim_pick] < _BIG
+        else:
+            any_claim = jnp.any(claim_ok)
         rank2 = jnp.min(jnp.where(jnp.arange(C) == claim_pick, _BIG, claim_rank))
         # full [C, T] per-pod capacities: the take-vector commit waterfills
         # the whole identical chain across EVERY eligible claim, so each
@@ -253,20 +365,17 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
         claim_fit_count = cap_c[claim_pick]
         claim_npods0 = state.claim_npods[claim_pick]
 
-        # pre-topology eligibility + whether ANY node passes its static
-        # (counter-independent) gates — the spread mini-fill needs both:
-        # topo-blocked claims can become eligible as counts shift mid-chain,
-        # and a single statically-eligible node forces the per-pod path
-        # (rising global-min can unblock a node's domain, and nodes outrank
-        # claims)
+        # pre-topology claim eligibility — the spread mini-fill needs it:
+        # topo-blocked claims can become eligible as counts shift mid-chain
+        # (node_static_any, its node-side counterpart, is computed in the
+        # node phase above: a single statically-eligible node forces the
+        # per-pod path — rising global-min can unblock a node's domain, and
+        # nodes outrank claims)
         claim_ok_pre = (
             state.claim_open
             & tol_tpl[state.claim_tpl]
             & claim_port_ok
             & claim_compat
-        )
-        node_static_any = jnp.any(
-            tol_node & node_fit & node_compat & node_port_ok & node_vol_ok
         )
 
         return {
@@ -300,10 +409,19 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
         # shared helper so the mint/pin semantics can never diverge between
         # the per-pod step, the run commit, and this sweeps path
         tpl_merged, tpl_compat, _host = _fresh_template_rows(
-            problem, lv, ln, wellknown, pod_req, free_slot
+            problem,
+            lv,
+            ln,
+            wellknown,
+            pod_req,
+            free_slot,
+            bounds_free=bounds_free,
+            tpl_neg=statics.tpl_neg,
+            pod_neg=pod[12],
         )
         tpl_topo_ok, tpl_final = topo_gate(
-            problem, state.grp_counts, reg_for_tpl, topo_pod, tpl_merged, wellknown
+            problem, state.grp_counts, reg_for_tpl, topo_pod, tpl_merged,
+            wellknown, fuse=bounds_free,
         )
         within_limits = masks.fits(
             problem.it_cap[None, :, :], state.remaining[:, None, :]
@@ -316,10 +434,14 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
         max_cap = jnp.max(
             jnp.where(tpl_row_it_ok[:, None], problem.it_cap, 0.0), axis=0
         )
+        if bounds_free:
+            tpl_row = _row_sentinel_bounds(tpl_final, pick_c)
+        else:
+            tpl_row = tpl_final.row(pick_c)
         return (
             jnp.any(tpl_ok),
             tpl_pick.astype(jnp.int32),
-            tpl_final.row(pick_c),
+            tpl_row,
             tpl_requests2[pick_c],
             tpl_row_it_ok,
             max_cap,
@@ -336,7 +458,7 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
         """Commit one whole gate-identical chain (>= 1 pods) via the
         closed-form waterfill run commit (record sum included)."""
         p = queue[jnp.clip(i, 0, P - 1)]
-        pod = jax.tree_util.tree_map(lambda a: a[p], pods_xs)
+        pod = gather_pod(p)
         ahead = queue[jnp.clip(i + Srange, 0, P - 1)]  # [S]
         adj = (ahead == p + Srange) & ((i + Srange) < qlen)
         succ = jnp.clip(p + Srange, 0, P - 1)
@@ -366,7 +488,7 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
         placements while capacity and fewest-pods rank hold and no
         record->gate feedback is possible)."""
         p = queue[jnp.clip(i, 0, P - 1)]
-        pod = jax.tree_util.tree_map(lambda a: a[p], pods_xs)
+        pod = gather_pod(p)
         ahead = queue[jnp.clip(i + Srange, 0, P - 1)]
         adj = (ahead == p + Srange) & ((i + Srange) < qlen)
         succ = jnp.clip(p + Srange, 0, P - 1)
@@ -396,10 +518,18 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
         claim_fit_count = ev["claim_fit_count"]
         claim_npods0 = ev["claim_npods0"]
         active = ev["active"]
-        claim_row = claim_final.row(claim_pick)
+        if bounds_free:
+            claim_row = _row_sentinel_bounds(claim_final, claim_pick)
+        else:
+            claim_row = claim_final.row(claim_pick)
 
         free_slot = _first_true(~state.claim_open)
-        has_slot = jnp.any(~state.claim_open)
+        if bounds_free:
+            # _first_true returns C when no slot is free — a scalar compare
+            # replaces the [C] any-reduction
+            has_slot = free_slot < C
+        else:
+            has_slot = jnp.any(~state.claim_open)
         host_onehot = _mint_host_onehot(problem, free_slot)
         need_tpl = (~any_node) & (~any_claim) & has_slot & active
 
@@ -474,42 +604,83 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
             feedback = match & (
                 (selects & ~problem.grp_inverse) | (owned & problem.grp_inverse)
             )
-            stack_safe = ~jnp.any(feedback & ~aff_safe)
             pod_dom = pod[1].admitted[problem.grp_key]  # [G, V] strict pod domains
             positive_any = jnp.any(
                 state.grp_registered & (state.grp_counts > 0) & pod_dom, axis=-1
             )
-            fill_safe = stack_safe & jnp.all(~feedback | positive_any)
-            # spread mini-fill preconditions: exactly ONE matched group, a
-            # regular spread with no node-filter, nothing owned — then the
-            # chain's own gates read only that group's counters and the
-            # (counts, npods, caps, pins) mini-state simulates the sequential
-            # loop exactly (see spread_take)
-            spread_pod = (
-                (match.sum() == 1)
-                & jnp.any(match & (problem.grp_type == 0))
-                & ~jnp.any(match & problem.grp_has_filter)
-                & ~jnp.any(match & problem.grp_inverse)
-                # owning the matched spread group is the normal case; what
-                # the mini-sim cannot model is ownership of anything ELSE
+            if bounds_free:
+                # wide masked reduction (gate-diet): the nine scalar [G]
+                # any-reduces feeding the take-branch selector collapse into
+                # ONE stacked reduce — each was its own kernel launch
+                ga = jnp.any(
+                    jnp.stack(
+                        [
+                            feedback & ~aff_safe,
+                            feedback & ~positive_any,
+                            match & (problem.grp_type == 0),
+                            match & problem.grp_has_filter,
+                            match & problem.grp_inverse,
+                            owned & ~match,
+                            owned & problem.grp_inverse,
+                            match & selects,
+                            match & (problem.grp_key == HOSTNAME_KEY),
+                        ]
+                    ),
+                    axis=-1,
+                )
+                stack_safe = ~ga[0]
+                fill_safe = stack_safe & ~ga[1]
+                # spread mini-fill preconditions: exactly ONE matched group,
+                # a regular spread with no node-filter, nothing owned
                 # (inverse anti-affinity groups record via owned)
-                & ~jnp.any(owned & ~match)
-                & ~jnp.any(owned & problem.grp_inverse)
-            )
+                spread_pod = (
+                    (match.sum() == 1) & ga[2] & ~ga[3] & ~ga[4] & ~ga[5] & ~ga[6]
+                )
+                s_gi = ga[7].astype(jnp.int32)
+                is_host_g = ga[8]
+                gv = jnp.any(
+                    jnp.stack(
+                        [
+                            match[:, None] & state.grp_registered,
+                            match[:, None] & pod_dom,
+                        ]
+                    ),
+                    axis=1,
+                )  # [2, V]
+                reg_g, pod_dom_g = gv[0], gv[1]
+            else:
+                stack_safe = ~jnp.any(feedback & ~aff_safe)
+                fill_safe = stack_safe & jnp.all(~feedback | positive_any)
+                # spread mini-fill preconditions: exactly ONE matched group, a
+                # regular spread with no node-filter, nothing owned — then the
+                # chain's own gates read only that group's counters and the
+                # (counts, npods, caps, pins) mini-state simulates the
+                # sequential loop exactly (see spread_take)
+                spread_pod = (
+                    (match.sum() == 1)
+                    & jnp.any(match & (problem.grp_type == 0))
+                    & ~jnp.any(match & problem.grp_has_filter)
+                    & ~jnp.any(match & problem.grp_inverse)
+                    # owning the matched spread group is the normal case; what
+                    # the mini-sim cannot model is ownership of anything ELSE
+                    # (inverse anti-affinity groups record via owned)
+                    & ~jnp.any(owned & ~match)
+                    & ~jnp.any(owned & problem.grp_inverse)
+                )
+                s_gi = jnp.any(match & selects).astype(jnp.int32)
+                is_host_g = jnp.any(match & (problem.grp_key == HOSTNAME_KEY))
+                reg_g = (match[:, None] & state.grp_registered).any(axis=0)  # [V]
+                pod_dom_g = (match[:, None] & pod_dom).any(axis=0)  # [V]
             key_onehot_g = (
                 (problem.grp_key[:, None] == jnp.arange(K)[None, :]) & match[:, None]
             ).any(axis=0)  # [K]
-            reg_g = (match[:, None] & state.grp_registered).any(axis=0)  # [V]
             counts_g0 = (match[:, None] * state.grp_counts).sum(axis=0)  # [V]
-            pod_dom_g = (match[:, None] & pod_dom).any(axis=0)  # [V]
             lex_g = jnp.einsum(
                 "k,kv->v", key_onehot_g.astype(jnp.int32),
                 jnp.asarray(problem.lane_lex_rank), preferred_element_type=jnp.int32
             )
             skew_g = (match * problem.grp_max_skew).sum()
             md_g = jnp.max(jnp.where(match, problem.grp_min_domains, -1))
-            s_gi = jnp.any(match & selects).astype(jnp.int32)
-            is_host_g = jnp.any(match & (problem.grp_key == HOSTNAME_KEY))
             # shared spread-chain statics (mini-sim AND closed-form round)
             sup_mask = reg_g & pod_dom_g
             gmin_zero = is_host_g | ((md_g >= 0) & (sup_mask.sum() < md_g))
@@ -664,9 +835,19 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
                 defined=jnp.broadcast_to(key_onehot_g, (V, K)),
             )
             syn_packed = masks.pack_lanes(syn.admitted)
-            syn_neg = vmap(lambda r: masks.negative_polarity(r, lv, ln))(syn)
+            # syn rows carry sentinel bounds by construction, so the
+            # bounds-free kernels are exact for them regardless of the flag
+            syn_neg = vmap(
+                lambda r: masks.negative_polarity(r, lv, ln, bounds_free)
+            )(syn)
             kg_ok = masks.packed_pairwise_compat(
-                syn, syn_packed, syn_neg, problem.it_reqs, it_packed, it_neg
+                syn,
+                syn_packed,
+                syn_neg,
+                problem.it_reqs,
+                it_packed,
+                it_neg,
+                bounds_free=bounds_free,
             ) & _offer_rows(problem, syn.admitted)  # [V, T]
             relevant_t = jnp.any(claim_it_ok2, axis=0)
             pinnable = pod_dom_g & reg_g
@@ -835,11 +1016,20 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
         else:
             committed = claim_final
 
+        if bounds_free:
+            # every gt/lt in the program is the no-bound sentinel
+            # (problem_bounds_free), so these writes are identities —
+            # carrying the state rows through keeps them loop-invariant
+            new_gt = state.claim_req.gt
+            new_lt = state.claim_req.lt
+        else:
+            new_gt = jnp.where(tookc[:, None], committed.gt, state.claim_req.gt)
+            new_lt = jnp.where(tookc[:, None], committed.lt, state.claim_req.lt)
         new_claim_req = ReqTensor(
             admitted=jnp.where(tookc[:, None, None], committed.admitted, state.claim_req.admitted),
             comp=jnp.where(tookc[:, None], committed.comp, state.claim_req.comp),
-            gt=jnp.where(tookc[:, None], committed.gt, state.claim_req.gt),
-            lt=jnp.where(tookc[:, None], committed.lt, state.claim_req.lt),
+            gt=new_gt,
+            lt=new_lt,
             defined=jnp.where(tookc[:, None], committed.defined, state.claim_req.defined),
         )
         new_claim_requests = (
@@ -858,11 +1048,17 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
         if N > 0:
             is_node = kind == KIND_NODE
             nodex = jnp.where(is_node, index, N + 1)
+            if bounds_free:
+                new_gt_n = state.node_req.gt
+                new_lt_n = state.node_req.lt
+            else:
+                new_gt_n = state.node_req.gt.at[nodex].set(node_row.gt, mode="drop")
+                new_lt_n = state.node_req.lt.at[nodex].set(node_row.lt, mode="drop")
             new_node_req = ReqTensor(
                 admitted=state.node_req.admitted.at[nodex].set(node_row.admitted, mode="drop"),
                 comp=state.node_req.comp.at[nodex].set(node_row.comp, mode="drop"),
-                gt=state.node_req.gt.at[nodex].set(node_row.gt, mode="drop"),
-                lt=state.node_req.lt.at[nodex].set(node_row.lt, mode="drop"),
+                gt=new_gt_n,
+                lt=new_lt_n,
                 defined=state.node_req.defined.at[nodex].set(node_row.defined, mode="drop"),
             )
             new_node_requests = state.node_requests.at[nodex].add(
@@ -880,11 +1076,17 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
 
         # the (alone-committing) claim-open
         sidx = jnp.where(is_open, free_slot, C + 1)
+        if bounds_free:
+            new_gt_s = new_claim_req.gt
+            new_lt_s = new_claim_req.lt
+        else:
+            new_gt_s = new_claim_req.gt.at[sidx].set(slot_req.gt, mode="drop")
+            new_lt_s = new_claim_req.lt.at[sidx].set(slot_req.lt, mode="drop")
         new_claim_req = ReqTensor(
             admitted=new_claim_req.admitted.at[sidx].set(slot_req.admitted, mode="drop"),
             comp=new_claim_req.comp.at[sidx].set(slot_req.comp, mode="drop"),
-            gt=new_claim_req.gt.at[sidx].set(slot_req.gt, mode="drop"),
-            lt=new_claim_req.lt.at[sidx].set(slot_req.lt, mode="drop"),
+            gt=new_gt_s,
+            lt=new_lt_s,
             defined=new_claim_req.defined.at[sidx].set(slot_req.defined, mode="drop"),
         )
         new_claim_requests = new_claim_requests.at[sidx].set(tpl_req_row, mode="drop")
@@ -1020,7 +1222,9 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
     return narrow_iter, analytic_iter, chain_ahead
 
 
-def _sweeps_impl(problem: SchedulingProblem, init: FFDState, C: int) -> FFDResult:
+def _sweeps_impl(
+    problem: SchedulingProblem, init: FFDState, C: int, bounds_free: bool = False
+) -> FFDResult:
     """All retry passes of a solve in ONE device program.
 
     The reference's Solve loop requeues failed pods and retries while any
@@ -1046,9 +1250,9 @@ def _sweeps_impl(problem: SchedulingProblem, init: FFDState, C: int) -> FFDResul
     sees it at the same pass boundary it used to.
     """
     P = problem.num_pods
-    pods_xs = _pod_xs(problem)
+    pods_xs = _pod_xs(problem, bounds_free)
     narrow_iter, analytic_iter, chain_ahead = _make_stride(
-        problem, _statics(problem), C, _STRIDE, pods_xs
+        problem, _statics(problem, bounds_free), C, _STRIDE, pods_xs
     )
     active = jnp.asarray(problem.pod_active)
     # compact initial queue: active rows first, original (FFD) order kept —
@@ -1178,18 +1382,23 @@ def _sweeps_impl(problem: SchedulingProblem, init: FFDState, C: int) -> FFDResul
              jnp.int32(0), jnp.int32(0), jnp.int32(0), n_sweeps0),
         )
     )
-    # [narrow iterations, sweeps, chain-commit iterations (k>1), pods those
-    # chain commits consumed] — the backend surfaces this as last_iters
+    # the backend surfaces this as last_iters (named fields; see IterCounts)
     return FFDResult(
         kind=kinds, index=idxs, state=state,
-        iters=jnp.stack([n_iters, n_sweeps, n_cc, n_cp]),
+        iters=IterCounts(
+            narrow=n_iters, sweeps=n_sweeps, chain_commits=n_cc, chain_pods=n_cp
+        ),
     )
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
-def _solve_ffd_sweeps_fresh_jit(problem: SchedulingProblem, max_claims: int) -> FFDResult:
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _solve_ffd_sweeps_fresh_jit(
+    problem: SchedulingProblem, max_claims: int, bounds_free: bool = False
+) -> FFDResult:
     problem = _pad_lanes_mult32(problem)
-    return _sweeps_impl(problem, initial_state(problem, max_claims), max_claims)
+    return _sweeps_impl(
+        problem, initial_state(problem, max_claims), max_claims, bounds_free
+    )
 
 
 def solve_ffd_sweeps(
@@ -1200,4 +1409,6 @@ def solve_ffd_sweeps(
     a fresh state: the backend's sweeps mode never carries state across
     launches (nothing is relaxable, so there is no second launch)."""
     assert init is None, "sweeps mode always runs a whole solve in one launch"
-    return _solve_ffd_sweeps_fresh_jit(problem, max_claims)
+    return _solve_ffd_sweeps_fresh_jit(
+        problem, max_claims, problem_bounds_free(problem)
+    )

@@ -14,31 +14,48 @@ axis.
 from __future__ import annotations
 
 import jax.numpy as jnp
-from jax import vmap
+from jax import lax, vmap
 
 from karpenter_tpu.models.problem import ReqTensor
 
+# ``bounds_free`` (threaded from ops/ffd_core.problem_bounds_free as a STATIC
+# trace-time bool): no requirement anywhere in the problem carries a finite
+# integer Gt/Lt bound, so every gt is the -inf sentinel and every lt the +inf
+# sentinel for the whole solve (intersection max/min and the topology/hostname
+# passthroughs preserve sentinels). Bounds are already folded into the
+# admitted lanes at encode (models/problem.py), so under bounds_free the
+# gt/lt arrays carry zero information and every kernel here statically elides
+# their math — the (comp & gt < lt) term is comp, _in_bounds is lane_valid,
+# and intersection passes gt/lt through untouched (loop-invariant, so commit
+# sites skip their writes and XLA hoists the arrays out of the solve loop).
 
-def intersect(a: ReqTensor, b: ReqTensor) -> ReqTensor:
+
+def intersect(a: ReqTensor, b: ReqTensor, bounds_free: bool = False) -> ReqTensor:
     """Keywise requirement intersection (requirement.go:128-161).
 
     Admitted lanes already satisfy each side's bounds (folded at encode), so
     lane-AND applies the combined bounds for free; undefined keys are encoded
     as full-admit complements and act as identities."""
+    if bounds_free:
+        gt, lt = a.gt, a.lt  # both sides sentinel — max/min are identities
+    else:
+        gt, lt = jnp.maximum(a.gt, b.gt), jnp.minimum(a.lt, b.lt)
     return ReqTensor(
         admitted=a.admitted & b.admitted,
         comp=a.comp & b.comp,
-        gt=jnp.maximum(a.gt, b.gt),
-        lt=jnp.minimum(a.lt, b.lt),
+        gt=gt,
+        lt=lt,
         defined=a.defined | b.defined,
     )
 
 
-def nonempty(r: ReqTensor) -> jnp.ndarray:
+def nonempty(r: ReqTensor, bounds_free: bool = False) -> jnp.ndarray:
     """Per-key Len() != 0 (requirement.go:210-215): a concrete set is nonempty
     if any lane is admitted; a complement set is nonempty unless its integer
     bounds collapsed (gt >= lt, requirement.go:135-137 — the reference's Len()
     ignores bounds otherwise, and we match that exactly)."""
+    if bounds_free:
+        return jnp.any(r.admitted, axis=-1) | r.comp
     return jnp.any(r.admitted, axis=-1) | (r.comp & (r.gt < r.lt))
 
 
@@ -55,40 +72,69 @@ def _in_bounds(lane_numeric: jnp.ndarray, lane_valid: jnp.ndarray, gt, lt) -> jn
     return lane_valid & (unbounded | numeric_ok)
 
 
-def negative_polarity(r: ReqTensor, lane_valid, lane_numeric) -> jnp.ndarray:
+def negative_polarity(r: ReqTensor, lane_valid, lane_numeric, bounds_free: bool = False) -> jnp.ndarray:
     """Per-key Operator() in {NotIn, DoesNotExist} (requirement.go:197-208).
 
     Complement sets read as NotIn when they exclude at least one in-bounds
     vocab value (exclusions are always vocab members in the closed world);
     concrete sets read as DoesNotExist when no lane is admitted."""
-    excl = jnp.any(lane_valid & _in_bounds(lane_numeric, lane_valid, r.gt, r.lt) & ~r.admitted, axis=-1)
+    if bounds_free:
+        excl = jnp.any(lane_valid & ~r.admitted, axis=-1)
+    else:
+        excl = jnp.any(
+            lane_valid & _in_bounds(lane_numeric, lane_valid, r.gt, r.lt) & ~r.admitted,
+            axis=-1,
+        )
     return jnp.where(r.comp, excl, ~jnp.any(r.admitted, axis=-1))
 
 
-def intersects_ok(a: ReqTensor, b: ReqTensor, lane_valid, lane_numeric) -> jnp.ndarray:
+def intersects_ok(a: ReqTensor, b: ReqTensor, lane_valid, lane_numeric, bounds_free: bool = False) -> jnp.ndarray:
     """Requirements.Intersects as a scalar bool (requirements.go:241-258):
     keys defined on both sides must have a nonempty intersection, except when
     both sides read as NotIn/DoesNotExist."""
-    inter = intersect(a, b)
-    ne = nonempty(inter)
+    inter = intersect(a, b, bounds_free)
+    ne = nonempty(inter, bounds_free)
     both_defined = a.defined & b.defined
-    both_neg = negative_polarity(a, lane_valid, lane_numeric) & negative_polarity(
-        b, lane_valid, lane_numeric
+    both_neg = negative_polarity(a, lane_valid, lane_numeric, bounds_free) & negative_polarity(
+        b, lane_valid, lane_numeric, bounds_free
     )
     return jnp.all(~both_defined | ne | both_neg)
 
 
 def compatible_ok(
-    r: ReqTensor, incoming: ReqTensor, lane_valid, lane_numeric, key_wellknown
+    r: ReqTensor, incoming: ReqTensor, lane_valid, lane_numeric, key_wellknown,
+    bounds_free: bool = False,
 ) -> jnp.ndarray:
     """Requirements.Compatible (requirements.go:163-174): incoming keys that
     are neither defined on ``r`` nor allowed-undefined must have negative
     polarity; then the requirement sets must intersect. ``key_wellknown`` is
     the allow-undefined mask (zeros for the strict variant used by existing
     nodes, existingnode.go:94)."""
-    neg_inc = negative_polarity(incoming, lane_valid, lane_numeric)
+    neg_inc = negative_polarity(incoming, lane_valid, lane_numeric, bounds_free)
     undef_bad = incoming.defined & ~r.defined & ~key_wellknown & ~neg_inc
-    return ~jnp.any(undef_bad) & intersects_ok(r, incoming, lane_valid, lane_numeric)
+    return ~jnp.any(undef_bad) & intersects_ok(r, incoming, lane_valid, lane_numeric, bounds_free)
+
+
+def compatible_from_merged(
+    merged_ne: jnp.ndarray,  # bool[..., K] nonempty(intersect(r, incoming))
+    r_defined: jnp.ndarray,  # bool[..., K]
+    r_neg: jnp.ndarray,  # bool[..., K] negative_polarity(r)
+    inc_defined: jnp.ndarray,  # bool[K] (broadcasts over leading axes)
+    inc_neg: jnp.ndarray,  # bool[K] negative_polarity(incoming)
+    key_wellknown: jnp.ndarray,  # bool[K]
+) -> jnp.ndarray:
+    """Requirements.Compatible for callers that already hold the merged rows
+    (the narrow step intersects state x pod for the topology gate anyway —
+    recomputing the intersection inside compatible_ok doubled the gate's op
+    count). Exactly compatible_ok(r, incoming, ...) given
+    merged_ne = nonempty(intersect(r, incoming)) and each side's own
+    defined/polarity masks; the per-iteration pod-side masks are computed
+    once and shared across the node/claim/template phases."""
+    both_defined = r_defined & inc_defined
+    both_neg = r_neg & inc_neg
+    intersects = jnp.all(~both_defined | merged_ne | both_neg, axis=-1)
+    undef_bad = jnp.any(inc_defined & ~r_defined & ~key_wellknown & ~inc_neg, axis=-1)
+    return ~undef_bad & intersects
 
 
 def fits(requests: jnp.ndarray, available: jnp.ndarray) -> jnp.ndarray:
@@ -121,6 +167,7 @@ def packed_pairwise_compat(
     b: ReqTensor,
     b_packed: jnp.ndarray,  # uint32[B, K, W]
     b_neg: jnp.ndarray,  # bool[B, K]
+    bounds_free: bool = False,
 ) -> jnp.ndarray:
     """[A, B] all-pairs Requirements.Intersects on bitpacked lanes — the
     solver's hot product (every open bin x every instance type per pod step,
@@ -131,12 +178,101 @@ def packed_pairwise_compat(
         (a_packed[:, None, :, :] & b_packed[None, :, :, :]) != 0, axis=-1
     )  # [A, B, K]
     comp_ab = a.comp[:, None, :] & b.comp[None, :, :]
-    gt_ab = jnp.maximum(a.gt[:, None, :], b.gt[None, :, :])
-    lt_ab = jnp.minimum(a.lt[:, None, :], b.lt[None, :, :])
-    ne = inter_any | (comp_ab & (gt_ab < lt_ab))
+    if bounds_free:
+        ne = inter_any | comp_ab
+    else:
+        gt_ab = jnp.maximum(a.gt[:, None, :], b.gt[None, :, :])
+        lt_ab = jnp.minimum(a.lt[:, None, :], b.lt[None, :, :])
+        ne = inter_any | (comp_ab & (gt_ab < lt_ab))
     both_defined = a.defined[:, None, :] & b.defined[None, :, :]
     both_neg = a_neg[:, None, :] & b_neg[None, :, :]
     return jnp.all(~both_defined | ne | both_neg, axis=-1)  # [A, B]
+
+
+# --- single-tensor bitword requirement rows -------------------------------
+#
+# pack_req folds a ReqTensor row into ONE uint32 tensor [..., K, W + 3]
+# (W = V / 32 lane words):
+#
+#   [..., :W]    admitted lane bits (pack_lanes layout)
+#   [..., W]     flags word: bit0 comp, bit1 defined, bit2 negative polarity
+#   [..., W+1]   gt bitcast to uint32
+#   [..., W+2]   lt bitcast to uint32
+#
+# The flags are chosen so one bitwise AND of two packed rows answers every
+# pairwise gate question: lane-AND gives the intersection's admitted bits,
+# flag-AND bit0 is the intersection's complement bit, bit1 is both_defined,
+# and bit2 is both_negative — exactly the terms Intersects/Compatible
+# consume. Polarity is baked at pack time (it depends only on the row's own
+# state, bounds included via _in_bounds), so packed gates never touch
+# lane_numeric. gt/lt ride along as raw words for the non-bounds_free case.
+
+_FLAG_COMP = jnp.uint32(1)
+_FLAG_DEFINED = jnp.uint32(2)
+_FLAG_NEG = jnp.uint32(4)
+
+
+def pack_req(r: ReqTensor, lane_valid, lane_numeric, bounds_free: bool = False) -> jnp.ndarray:
+    """ReqTensor[..., K, V] -> uint32[..., K, W+3] bitword rows (layout
+    above). ``lane_valid``/``lane_numeric`` feed the polarity bit."""
+    words = pack_lanes(r.admitted)  # [..., K, W]
+    neg = negative_polarity(r, lane_valid, lane_numeric, bounds_free)
+    flags = (
+        r.comp.astype(jnp.uint32) * _FLAG_COMP
+        | r.defined.astype(jnp.uint32) * _FLAG_DEFINED
+        | neg.astype(jnp.uint32) * _FLAG_NEG
+    )
+    gt_w = lax.bitcast_convert_type(r.gt, jnp.uint32)
+    lt_w = lax.bitcast_convert_type(r.lt, jnp.uint32)
+    return jnp.concatenate(
+        [words, flags[..., None], gt_w[..., None], lt_w[..., None]], axis=-1
+    )
+
+
+def _packed_intersect_terms(pa: jnp.ndarray, pb: jnp.ndarray, bounds_free: bool):
+    """(nonempty[..., K], both_defined[..., K], both_neg[..., K]) of two
+    packed rows (broadcasting over leading axes)."""
+    and_w = pa & pb  # [..., K, W+3]
+    inter_any = jnp.any(and_w[..., :-3] != 0, axis=-1)
+    fl = and_w[..., -3]
+    comp_ab = (fl & _FLAG_COMP) != 0
+    if bounds_free:
+        ne = inter_any | comp_ab
+    else:
+        gt_ab = jnp.maximum(
+            lax.bitcast_convert_type(pa[..., -2], jnp.int32),
+            lax.bitcast_convert_type(pb[..., -2], jnp.int32),
+        )
+        lt_ab = jnp.minimum(
+            lax.bitcast_convert_type(pa[..., -1], jnp.int32),
+            lax.bitcast_convert_type(pb[..., -1], jnp.int32),
+        )
+        ne = inter_any | (comp_ab & (gt_ab < lt_ab))
+    return ne, (fl & _FLAG_DEFINED) != 0, (fl & _FLAG_NEG) != 0
+
+
+def packed_intersects_ok(pa: jnp.ndarray, pb: jnp.ndarray, bounds_free: bool = False) -> jnp.ndarray:
+    """Requirements.Intersects on pack_req rows — equals
+    intersects_ok(a, b, ...) on the unpacked rows (the fuzz in
+    tests/test_mask_kernels.py pins that)."""
+    ne, both_defined, both_neg = _packed_intersect_terms(pa, pb, bounds_free)
+    return jnp.all(~both_defined | ne | both_neg, axis=-1)
+
+
+def packed_compatible_ok(
+    pr: jnp.ndarray, pinc: jnp.ndarray, key_wellknown, bounds_free: bool = False
+) -> jnp.ndarray:
+    """Requirements.Compatible on pack_req rows — equals
+    compatible_ok(r, incoming, ...) on the unpacked rows."""
+    ne, both_defined, both_neg = _packed_intersect_terms(pr, pinc, bounds_free)
+    inc_fl, r_fl = pinc[..., -3], pr[..., -3]
+    undef_bad = (
+        ((inc_fl & _FLAG_DEFINED) != 0)
+        & ((r_fl & _FLAG_DEFINED) == 0)
+        & ~key_wellknown
+        & ((inc_fl & _FLAG_NEG) == 0)
+    )
+    return ~jnp.any(undef_bad, axis=-1) & jnp.all(~both_defined | ne | both_neg, axis=-1)
 
 
 def has_offering_zc(

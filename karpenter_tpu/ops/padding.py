@@ -15,9 +15,19 @@ sizes). Padded entities are made inert:
 
 from __future__ import annotations
 
+import os as _os
+
 import numpy as np
 
 from karpenter_tpu.models.problem import GT_NONE, LT_NONE, ReqTensor, SchedulingProblem
+
+# claim-axis windowing (KARPENTER_TPU_CLAIM_WINDOW, default on): above 128
+# the claim axis and the lane axis move in quarter-pow2 steps instead of
+# doubling, so a 134-claim batch compiles the C=160 program instead of
+# falling off the 256-slot cliff. 0 restores the pure-pow2 buckets.
+_CLAIM_WINDOW = _os.environ.get(
+    "KARPENTER_TPU_CLAIM_WINDOW", "1"
+).lower() in ("1", "true", "yes")
 
 
 def pow2_bucket(n: int, lo: int = 8) -> int:
@@ -25,6 +35,30 @@ def pow2_bucket(n: int, lo: int = 8) -> int:
     while b < n:
         b *= 2
     return b
+
+
+def claim_axis_bucket(n: int) -> int:
+    """Claim-slot bucket: pow2 up to 128, quarter-pow2 steps above
+    (160/192/224/256/320/...). The claim axis C multiplies every claim-gate
+    tensor AND (through the minted hostname lanes) the lane axis V, so the
+    pow2 jump 128->256 nearly quadrupled the narrow step's data — the
+    "256-slot cliff". Quarter steps cap the overshoot at 25% per axis for at
+    most 2x the compiled variants; the backend escalates one step at a time
+    on overflow (jax_backend.JaxSolver)."""
+    if not _CLAIM_WINDOW or n <= 128:
+        return pow2_bucket(n)
+    return quarter_bucket(n, lo=128)
+
+
+def lane_axis_bucket(n: int) -> int:
+    """Lane-axis bucket: pow2 up to 128, quarter-pow2 steps above. Every
+    quarter step over 128 is a multiple of 32, preserving the uint32
+    bitpack invariant on V. Tracks claim_axis_bucket because claim-heavy
+    batches mint one hostname lane per slot: at 134 claims V lands on 192
+    instead of doubling to 256+."""
+    if not _CLAIM_WINDOW or n <= 128:
+        return pow2_bucket(n, lo=32)
+    return quarter_bucket(n, lo=128)
 
 
 def pod_axis_bucket(n: int) -> int:
@@ -100,7 +134,8 @@ def pad_problem(p: SchedulingProblem, min_pods: int = 0) -> SchedulingProblem:
     K = pow2_bucket(p.num_keys, lo=4)
     # V must stay a multiple of 32: the solver bitpacks value lanes into
     # uint32 words for the hot instance-type compatibility product
-    V = pow2_bucket(p.num_lanes, lo=32)
+    # (lane_axis_bucket's quarter steps above 128 keep that invariant)
+    V = lane_axis_bucket(p.num_lanes)
     R = pow2_bucket(p.num_resources, lo=8)
     O = pow2_bucket(p.offer_ok.shape[1], lo=8)
     PT = pow2_bucket(p.pod_ports.shape[1], lo=8)

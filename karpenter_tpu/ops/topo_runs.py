@@ -71,7 +71,9 @@ def _bcast_req(row: ReqTensor, E: int, K: int, V: int) -> ReqTensor:
 
 
 def make_topo_run_commit(problem: SchedulingProblem, statics, C: int, max_run: int):
-    lv, ln, wellknown, no_allow, it_packed, it_neg = statics
+    # the topo run commits stay on the legacy (non-dieted) gate kernels;
+    # they consume only the first six statics fields
+    lv, ln, wellknown, no_allow, it_packed, it_neg = statics[:6]
     it_gate = _make_it_gate(problem, statics)
     N = problem.num_nodes
     T = problem.num_instance_types
@@ -106,6 +108,7 @@ def make_topo_run_commit(problem: SchedulingProblem, statics, C: int, max_run: i
             grp_owned,
             _pod_vols,
             _pa,
+            _pod_neg,
         ) = pod
         topo_pod_head = PodTopoStatics(
             strict_admitted=pod_strict.admitted,

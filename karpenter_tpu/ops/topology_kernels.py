@@ -53,9 +53,13 @@ def allowed_domains(
     registered: jnp.ndarray,  # bool[G, V] current registered domains
     pod: PodTopoStatics,
     bin_admitted: jnp.ndarray,  # bool[B, K, V] candidate-bin admitted lanes (after pod merge)
+    fuse: bool = False,
 ) -> jnp.ndarray:
     """bool[B, G, V]: the domains each matching group would allow this pod on
     each bin — TopologyGroup.Get, batched. Non-matching groups read all-True.
+
+    ``fuse=True`` (the round-7 gate diet) batches same-shaped reductions into
+    stacked single reduces — identical values, fewer kernel launches.
     """
     G = counts.shape[0]
     V = counts.shape[1]
@@ -69,7 +73,17 @@ def allowed_domains(
     # global min over registered lanes the pod supports; hostname keys pin 0
     sup = reg & pod_dom  # bool[G, V]
     sup_counts = jnp.where(sup, counts, _MAXI)
-    global_min = jnp.min(sup_counts, axis=-1)  # i32[G]
+    lex = problem.lane_lex_rank[key]  # i32[G, V]
+    boot_ranked = jnp.where(sup, lex, _MAXI)  # _lowest_by_rank(sup, lex) rank
+    if fuse:
+        # one stacked [2, G, V] -> [2, G] min: the spread global-min and the
+        # affinity-bootstrap best-rank share shape and monoid
+        mins2 = jnp.min(jnp.stack([sup_counts, boot_ranked]), axis=-1)
+        global_min = mins2[0]
+        boot_best = mins2[1][None, :, None]
+    else:
+        global_min = jnp.min(sup_counts, axis=-1)  # i32[G]
+        boot_best = jnp.min(boot_ranked, axis=-1)[None, :, None]
     n_supported = sup.sum(axis=-1).astype(jnp.int32)
     has_min_domains = problem.grp_min_domains >= 0
     global_min = jnp.where(
@@ -82,9 +96,18 @@ def allowed_domains(
     within_skew = (self_count - global_min[:, None]) <= problem.grp_max_skew[:, None]
     eligible = reg[None, :, :] & node_dom & within_skew[None, :, :]  # [B, G, V]
     # lowest count first, lexicographically-first value on ties (oracle parity)
-    lex = problem.lane_lex_rank[key]  # i32[G, V]
     rank = jnp.where(eligible, self_count[None, :, :] * V + jnp.minimum(lex, V - 1)[None, :, :], _MAXI)
-    best = jnp.min(rank, axis=-1, keepdims=True)
+    inter_mask = reg[None, :, :] & pod_dom[None, :, :] & node_dom  # [B, G, V]
+    inter_ranked = jnp.where(inter_mask, lex[None, :, :], _MAXI)
+    if fuse:
+        # stacked [2, B, G, V] -> [2, B, G, 1] min: spread best-rank and the
+        # bootstrap intersection best-rank
+        bmins = jnp.min(jnp.stack([rank, inter_ranked]), axis=-1, keepdims=True)
+        best = bmins[0]
+        inter_best = bmins[1]
+    else:
+        best = jnp.min(rank, axis=-1, keepdims=True)
+        inter_best = jnp.min(inter_ranked, axis=-1, keepdims=True)
     spread_allowed = eligible & (rank == best) & (best < _MAXI)
 
     # --- affinity (topologygroup.go:215-246) --------------------------------
@@ -92,10 +115,10 @@ def allowed_domains(
     aff_allowed = jnp.broadcast_to(positive[None, :, :], spread_allowed.shape)
     # bootstrap for self-selecting pods when nothing is placed yet
     nothing_placed = ~jnp.any(positive, axis=-1)  # [G]
-    boot_inter = _lowest_by_rank(
-        reg[None, :, :] & pod_dom[None, :, :] & node_dom, lex[None, :, :]
-    )  # [B, G, V]
-    boot_any = _lowest_by_rank(reg & pod_dom, lex)[None, :, :]  # [1, G, V]
+    boot_inter = inter_mask & (inter_ranked == inter_best) & (inter_best < _MAXI)
+    boot_any = (
+        sup & (boot_ranked == boot_best[0]) & (boot_best[0] < _MAXI)
+    )[None, :, :]  # [1, G, V]
     bootstrap = (boot_inter | boot_any) & (
         nothing_placed & pod.grp_selects
     )[None, :, None]
@@ -124,21 +147,24 @@ def topo_gate(
     pod: PodTopoStatics,
     bin_rows: ReqTensor,  # [B, K, V...] bin state after pod merge
     wellknown_allow: jnp.ndarray,  # bool[K] — zeros for existing nodes
+    fuse: bool = False,
 ):
     """Returns (ok[B], final_rows) — the reference's AddRequirements +
     Compatible + Add sequence (nodeclaim.go:92-100): every matching group must
     allow >= 1 domain, the allowed domains must intersect the bin state, the
     undefined-key rule applies (domains are concrete positive sets), and the
-    bin state narrows to the allowed lanes."""
+    bin state narrows to the allowed lanes.
+
+    ``fuse=True`` (the round-7 gate diet) batches same-shaped reductions —
+    identical verdicts, fewer kernel launches."""
     G = counts.shape[0]
     if G == 0:
         return jnp.ones(bin_rows.admitted.shape[0], dtype=bool), bin_rows
 
-    allowed = allowed_domains(problem, counts, registered, pod, bin_rows.admitted)
+    allowed = allowed_domains(
+        problem, counts, registered, pod, bin_rows.admitted, fuse
+    )
     match = pod.grp_match  # bool[G]
-    # unsatisfiable when a matching group allows no domain at all (allowed is
-    # forced all-True for non-matching groups inside allowed_domains)
-    grp_sat = jnp.any(allowed, axis=-1) | ~match[None, :]  # [B, G]
 
     # combine per key: AND of all matching groups' allowed lanes into a
     # [B, K, V] limit mask. Formulated as an MXU matmul over the group axis
@@ -164,17 +190,37 @@ def topo_gate(
     )
 
     new_admitted = bin_rows.admitted & jnp.where(touched[None, :, None], limit, True)
-    # Compatible: at touched keys the narrowed set must stay nonempty, and the
-    # key must be defined on the bin or allowed-undefined (domains are
-    # positive concrete sets, so no polarity escape applies)
-    key_ok = (
-        ~touched[None, :]
-        | (
-            jnp.any(new_admitted, axis=-1)
-            & (bin_rows.defined | wellknown_allow[None, :])
+    if fuse:
+        # unsatisfiable when a matching group allows no domain (grp_sat) OR a
+        # touched key narrows to empty / lands on a disallowed-undefined key
+        # (key_ok) — the [B, G] and [B, K] lane-any reduces share the V axis,
+        # so one concatenated [B, G+K, V] reduce answers both, and one
+        # concatenated [B, G+K] reduce folds them to ok[B]
+        lane_any = jnp.any(
+            jnp.concatenate([allowed, new_admitted], axis=1), axis=-1
+        )  # [B, G + K]
+        grp_sat = lane_any[:, :G] | ~match[None, :]
+        key_ok = (
+            ~touched[None, :]
+            | (lane_any[:, G:] & (bin_rows.defined | wellknown_allow[None, :]))
         )
-    )  # [B, K]
-    ok = jnp.all(grp_sat, axis=-1) & jnp.all(key_ok, axis=-1)
+        ok = jnp.all(jnp.concatenate([grp_sat, key_ok], axis=-1), axis=-1)
+    else:
+        # unsatisfiable when a matching group allows no domain at all
+        # (allowed is forced all-True for non-matching groups inside
+        # allowed_domains)
+        grp_sat = jnp.any(allowed, axis=-1) | ~match[None, :]  # [B, G]
+        # Compatible: at touched keys the narrowed set must stay nonempty,
+        # and the key must be defined on the bin or allowed-undefined
+        # (domains are positive concrete sets, so no polarity escape applies)
+        key_ok = (
+            ~touched[None, :]
+            | (
+                jnp.any(new_admitted, axis=-1)
+                & (bin_rows.defined | wellknown_allow[None, :])
+            )
+        )  # [B, K]
+        ok = jnp.all(grp_sat, axis=-1) & jnp.all(key_ok, axis=-1)
 
     final = ReqTensor(
         admitted=new_admitted,

@@ -34,6 +34,73 @@ def bucket_ladder(max_pods: int) -> list:
     return out
 
 
+def claim_ladder(max_claims: int) -> list:
+    """Every claim-slot bucket (ops/padding.py claim_axis_bucket) up to
+    ``max_claims`` — the shapes a slot-overflow escalation walks through.
+    With claim-axis windowing (KARPENTER_TPU_CLAIM_WINDOW, default on) the
+    ladder above 128 is 160/192/224/256/...; with it off, pow2 doubles."""
+    from karpenter_tpu.ops.padding import claim_axis_bucket
+
+    out, n = [], 1
+    while n <= max_claims:
+        b = claim_axis_bucket(n)
+        out.append(b)
+        n = b + 1
+    return out
+
+
+def prewarm_claim_buckets(
+    solver=None, max_claims: int = 256, instance_types_n: int = 100, catalog=None
+) -> int:
+    """Compile the claim-slot escalation ladder up to ``max_claims``: one
+    sweeps executable per claim bucket. A claim-heavy batch that overflows
+    its slots escalates through exactly these shapes (jax_backend's
+    _SlotOverflow retry), and every step is a fresh XLA compile unless it
+    was warmed here — the 256-slot program alone used to be the "cliff"
+    compile. Each bucket C is warmed by solving C pods with claim_slots
+    pinned to C through the REAL backend entrypoint: the executable cache
+    keys on shapes, so the solve doesn't need to open C claims. Returns the
+    number of buckets warmed; failures stop the ladder (warming is an
+    optimization, never a liveness dependency)."""
+    import random
+
+    from karpenter_tpu.apis.nodepool import NodePool
+    from karpenter_tpu.apis.objects import Container, ObjectMeta, Pod, PodSpec
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.solver.encode import template_from_nodepool
+    from karpenter_tpu.solver.jax_backend import JaxSolver
+
+    if solver is None:
+        solver = JaxSolver()
+    its = catalog if catalog else instance_types(instance_types_n)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="prewarm-claims")), its, range(len(its))
+    )
+    rng = random.Random(1)
+    warmed = 0
+    for c in claim_ladder(max_claims):
+        pods = [
+            Pod(
+                metadata=ObjectMeta(name=f"warm-claims-{c}-{i}"),
+                spec=PodSpec(
+                    containers=[
+                        Container(requests={"cpu": rng.choice([0.1, 0.5, 1.0])})
+                    ]
+                ),
+            )
+            for i in range(c)
+        ]
+        try:
+            # the ladder ascends, so pinning claim_slots selects bucket c
+            # exactly (the backend caps at claim_axis_bucket(len(pods)) == c)
+            solver.claim_slots = c
+            solver.solve(pods, its, [tpl])
+            warmed += 1
+        except Exception:
+            return warmed
+    return warmed
+
+
 def prewarm_solver(
     solver=None,
     pod_buckets: Sequence[int] = (9, 33),
@@ -218,6 +285,15 @@ def maybe_prewarm_in_background(options, cloud_provider=None) -> Optional["objec
             )
         except Exception:
             log.warning("prewarm: solver warm failed", exc_info=True)
+        n_claims = getattr(options, "prewarm_claim_slots", 0)
+        if n_claims:
+            try:
+                # claim-heavy workloads escalate through the claim-bucket
+                # ladder; warming it makes each _SlotOverflow retry a cache
+                # hit instead of a fresh compile
+                prewarm_claim_buckets(max_claims=n_claims, catalog=catalog)
+            except Exception:
+                log.warning("prewarm: claim-ladder warm failed", exc_info=True)
         n_screen = getattr(options, "prewarm_screen_candidates", 0)
         if n_screen:
             try:

@@ -1,0 +1,73 @@
+"""Kernel-count budget: one narrow-step iteration must stay on its diet.
+
+The 10k solve is launch-bound — wall time tracks the per-iteration op count,
+not FLOPs (docs/PERF_NOTES.md rounds 4/6/7). The round-7 gate diet brought
+one narrow iteration from 3051 to ~2394 flattened jaxpr equations; this test
+pins a budget just above the measured count so an innocent-looking gate edit
+that reinflates the program fails CI instead of silently costing ~20% of the
+10k wall. Counting is trace-only (jax.make_jaxpr, no XLA compile), so the
+test stays tier-1 fast.
+
+The budget is a CEILING, not a target: if a change legitimately needs more
+equations (new semantics), raise it in the same commit with a PERF_NOTES
+entry saying what the ops buy. If you got UNDER the budget, tighten it.
+"""
+
+import os
+
+import pytest
+
+from tools.kernel_census import build_census_problem, narrow_jaxpr_eqns
+
+# measured 2394 at the round-7 commit (P=64 T=64 K=4 V=32 C=16 after
+# padding); headroom covers jax-version jitter in primitive lowering
+NARROW_EQN_BUDGET = 2500
+
+# the pre-diet program (KARPENTER_TPU_PACKED_GATES=0) measured 3051; its
+# pin keeps the legacy A/B arm honest too — a drift there would silently
+# skew every before/after comparison the flag exists to make
+LEGACY_EQN_FLOOR = 2900
+
+
+@pytest.fixture(scope="module")
+def census_problem():
+    return build_census_problem()
+
+
+class TestNarrowStepBudget:
+    def test_dieted_program_is_measured(self):
+        """The budget only means something if the census counts the dieted
+        program — guard against the flag being off in the test env."""
+        from karpenter_tpu.ops.ffd_core import problem_bounds_free
+
+        assert os.environ.get("KARPENTER_TPU_PACKED_GATES", "1") != "0", (
+            "tier-1 runs with the gate diet on; unset KARPENTER_TPU_PACKED_GATES"
+        )
+        assert problem_bounds_free(build_census_problem(num_pods=8, its_n=6))
+
+    def test_narrow_iteration_under_budget(self, census_problem):
+        eqns = narrow_jaxpr_eqns(census_problem)
+        assert eqns <= NARROW_EQN_BUDGET, (
+            f"narrow iteration grew to {eqns} jaxpr eqns "
+            f"(budget {NARROW_EQN_BUDGET}); the 10k solve is launch-bound, "
+            f"so this is a real regression — see tools/kernel_census.py to "
+            f"attribute the growth"
+        )
+
+    def test_budget_is_tight(self, census_problem):
+        """A budget 2x the program is no budget at all: keep the pin within
+        ~10% of the measured count so growth is caught early."""
+        eqns = narrow_jaxpr_eqns(census_problem)
+        assert eqns >= NARROW_EQN_BUDGET * 0.8, (
+            f"narrow iteration shrank to {eqns} jaxpr eqns — nice! tighten "
+            f"NARROW_EQN_BUDGET to keep the guard meaningful"
+        )
+
+    def test_diet_actually_diets(self, census_problem):
+        """The flag must buy a real reduction: the dieted program counts
+        meaningfully fewer equations than the legacy floor."""
+        eqns = narrow_jaxpr_eqns(census_problem)
+        assert eqns < LEGACY_EQN_FLOOR * 0.9, (
+            f"dieted program at {eqns} eqns is within 10% of the legacy "
+            f"floor ({LEGACY_EQN_FLOOR}) — the gate diet stopped paying"
+        )

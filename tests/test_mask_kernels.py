@@ -116,6 +116,174 @@ class TestKernelParity:
         assert out.tolist() == [True, False]
 
 
+def _pad_lanes32(t: ReqTensor, lv, ln):
+    """Pad the 6-lane test vocab to the 32-lane word the bitword rows
+    require (padding.py guarantees V % 32 == 0 in production): padded lanes
+    are invalid and not admitted, an identity for every kernel here."""
+    pad = 32 - t.admitted.shape[-1]
+    return (
+        ReqTensor(
+            admitted=np.pad(t.admitted, [(0, 0), (0, pad)], constant_values=False),
+            comp=t.comp, gt=t.gt, lt=t.lt, defined=t.defined,
+        ),
+        np.pad(lv, [(0, 0), (0, pad)], constant_values=False),
+        np.pad(ln, [(0, 0), (0, pad)], constant_values=np.nan),
+    )
+
+
+def random_boundsless_requirements(rng, max_keys=3):
+    """Random requirements with no Gt/Lt — the corpus the bounds-free gate
+    diet (KARPENTER_TPU_PACKED_GATES) applies to."""
+    reqs = Requirements()
+    for key in rng.sample(KEYS, rng.randint(0, max_keys)):
+        op = rng.choice([IN, NOT_IN, EXISTS, DOES_NOT_EXIST])
+        vals = rng.sample(
+            VALUES, rng.randint(0 if op in (EXISTS, DOES_NOT_EXIST) else 1, 3)
+        )
+        reqs.add(Requirement(key, op, vals))
+    return reqs
+
+
+class TestPackedGateParity:
+    """The single-tensor bitword rows (masks.pack_req) and the merged-row
+    fused gate (masks.compatible_from_merged) against the five-array
+    kernels, on random corpora — the parity net under the round-7 gate
+    diet. Both gate programs (bounds_free True/False) are pinned."""
+
+    def test_packed_word_gates_match_five_array_gates(self):
+        rng = random.Random(21)
+        wellknown = np.array([k == "k0" for k in KEYS]).astype(bool)
+        for trial in range(300):
+            # general corpus: bounds included, so the non-bounds_free word
+            # layout (gt/lt riding as raw words) is exercised too
+            a, b = random_requirements(rng), random_requirements(rng)
+            ta, lv, ln = encode_single(a)
+            tb, _, _ = encode_single(b)
+            ta, lv32, ln32 = _pad_lanes32(ta, lv, ln)
+            tb, _, _ = _pad_lanes32(tb, lv, ln)
+            pa = masks.pack_req(ta, lv32, ln32)
+            pb = masks.pack_req(tb, lv32, ln32)
+            assert bool(masks.packed_intersects_ok(pa, pb)) == bool(
+                masks.intersects_ok(ta, tb, lv32, ln32)
+            ), f"trial {trial}: {a!r} vs {b!r}"
+            assert bool(masks.packed_compatible_ok(pa, pb, wellknown)) == bool(
+                masks.compatible_ok(ta, tb, lv32, ln32, wellknown)
+            ), f"trial {trial}: {a!r} vs {b!r}"
+
+    def test_bounds_free_gates_match_legacy_on_boundsless_corpus(self):
+        """On a Gt/Lt-free corpus the dieted kernels (bounds_free=True) must
+        equal the legacy kernels verdict-for-verdict — the invariant that
+        makes KARPENTER_TPU_PACKED_GATES a pure program swap."""
+        rng = random.Random(34)
+        wellknown = np.array([k == "k0" for k in KEYS]).astype(bool)
+        for trial in range(300):
+            a = random_boundsless_requirements(rng)
+            b = random_boundsless_requirements(rng)
+            ta, lv, ln = encode_single(a)
+            tb, _, _ = encode_single(b)
+            legacy_i = bool(masks.intersects_ok(ta, tb, lv, ln))
+            diet_i = bool(masks.intersects_ok(ta, tb, lv, ln, bounds_free=True))
+            assert legacy_i == diet_i, f"trial {trial}: {a!r} vs {b!r}"
+            legacy_c = bool(masks.compatible_ok(ta, tb, lv, ln, wellknown))
+            diet_c = bool(
+                masks.compatible_ok(ta, tb, lv, ln, wellknown, bounds_free=True)
+            )
+            assert legacy_c == diet_c, f"trial {trial}: {a!r} vs {b!r}"
+            ta32, lv32, ln32 = _pad_lanes32(ta, lv, ln)
+            tb32, _, _ = _pad_lanes32(tb, lv, ln)
+            pa = masks.pack_req(ta32, lv32, ln32, bounds_free=True)
+            pb = masks.pack_req(tb32, lv32, ln32, bounds_free=True)
+            assert (
+                bool(masks.packed_compatible_ok(pa, pb, wellknown, bounds_free=True))
+                == legacy_c
+            ), f"trial {trial}: {a!r} vs {b!r}"
+
+    def test_compatible_from_merged_matches_compatible_ok(self):
+        """The narrow step's fused gate: feed compatible_from_merged the
+        merged rows it receives in production (state x pod intersection) and
+        require bitwise agreement with vmapped compatible_ok over a random
+        multi-row state — both allow-lists, both gate programs."""
+        import jax
+
+        rng = random.Random(55)
+        wellknown = np.array([k == "k0" for k in KEYS]).astype(bool)
+        no_allow = np.zeros(len(KEYS), dtype=bool)
+        for trial in range(120):
+            rows = [random_boundsless_requirements(rng) for _ in range(4)]
+            inc = random_boundsless_requirements(rng)
+            encs = [encode_single(r)[0] for r in rows]
+            state = jax.tree_util.tree_map(
+                lambda *xs: np.stack(xs), *encs
+            )  # ReqTensor [4, K, V]
+            tinc, lv, ln = encode_single(inc)
+            for bf in (False, True):
+                merged = jax.vmap(
+                    lambda r: masks.intersect(r, tinc, bf)
+                )(state)
+                r_neg = jax.vmap(
+                    lambda r: masks.negative_polarity(r, lv, ln, bf)
+                )(state)
+                inc_neg = masks.negative_polarity(tinc, lv, ln, bf)
+                for allow in (wellknown, no_allow):
+                    fused = masks.compatible_from_merged(
+                        masks.nonempty(merged, bf),
+                        state.defined,
+                        r_neg,
+                        tinc.defined,
+                        inc_neg,
+                        allow,
+                    )
+                    legacy = jax.vmap(
+                        lambda r: masks.compatible_ok(r, tinc, lv, ln, allow, bf)
+                    )(state)
+                    np.testing.assert_array_equal(
+                        np.asarray(fused), np.asarray(legacy),
+                        err_msg=f"trial {trial} bf={bf}: {rows!r} vs {inc!r}",
+                    )
+
+
+class TestClaimAxisBuckets:
+    def test_claim_bucket_pow2_up_to_128(self):
+        from karpenter_tpu.ops.padding import claim_axis_bucket, pow2_bucket
+
+        for n in list(range(1, 130)):
+            if n <= 128:
+                assert claim_axis_bucket(n) == pow2_bucket(n)
+
+    def test_claim_bucket_quarter_steps_above_128(self):
+        from karpenter_tpu.ops.padding import claim_axis_bucket
+
+        assert claim_axis_bucket(129) == 160
+        assert claim_axis_bucket(134) == 160
+        assert claim_axis_bucket(160) == 160
+        assert claim_axis_bucket(161) == 192
+        assert claim_axis_bucket(224) == 224
+        assert claim_axis_bucket(225) == 256
+        assert claim_axis_bucket(257) == 320
+
+    def test_lane_bucket_multiple_of_32(self):
+        from karpenter_tpu.ops.padding import lane_axis_bucket
+
+        prev = 0
+        for n in range(1, 700, 7):
+            b = lane_axis_bucket(n)
+            assert b >= n and b % 32 == 0 and b >= prev, (n, b)
+            prev = b
+        assert lane_axis_bucket(129) == 160
+        assert lane_axis_bucket(192) == 192
+
+    def test_escalation_ladder_vs_cliff(self):
+        """The backend's overflow ladder at 134 needed claims stops at the
+        160 program; the pre-window ladder jumped to 256 (the cliff)."""
+        from karpenter_tpu.ops.padding import claim_axis_bucket
+
+        steps, c = [], 32
+        while c < 134:
+            c = claim_axis_bucket(c + 1)
+            steps.append(c)
+        assert steps == [64, 128, 160], steps
+
+
 class TestPodAxisBucket:
     def test_matches_pow2_up_to_1024(self):
         from karpenter_tpu.ops.padding import pod_axis_bucket, pow2_bucket

@@ -505,6 +505,39 @@ class TestRunCompressionDifferential:
             pytest.skip("no compressible runs formed for this seed")
 
 
+class TestClaimWindowParity:
+    """Oracle differential with the claim-axis window engaged
+    (KARPENTER_TPU_CLAIM_WINDOW, default on): above 128 the claim axis pads
+    to quarter-pow2 steps (160/192/224/...), so the solver runs programs
+    whose claim axis is NOT a power of two — a shape family no other parity
+    test compiles. Chain-heavy mixed populations (test_chain_parity's
+    generator: spreads, affinity retries, label-diverse generics) run
+    through a 160-slot program and must match the host oracle claim for
+    claim, pod for pod."""
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_windowed_claim_bucket_oracle_parity(self, seed):
+        from karpenter_tpu.cloudprovider.fake import FAKE_WELL_KNOWN_LABELS
+        from karpenter_tpu.solver.oracle import OracleSolver
+        from tests.test_chain_parity import _chain_pod
+
+        rng = random.Random(4000 + seed)
+        its = instance_types(6)
+        templates = [simple_template(its, name="a")]
+        # >160 pods so the backend's min(claim_slots, bucket(len(pods)))
+        # cap keeps the windowed 160 bucket rather than shrinking it
+        pods = [_chain_pod(rng, i) for i in range(rng.randint(165, 200))]
+        o = OracleSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(
+            pods, its, templates, ()
+        )
+        solver = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS, initial_claim_slots=130)
+        assert solver.claim_slots == 160, (
+            "claim window off? expected the quarter-step bucket"
+        )
+        j = solver.solve(pods, its, templates, ())
+        assert_same(o, j)
+
+
 class TestBenchSmallBatchFraction:
     def test_10_pod_diverse_mix_schedules_8(self):
         """Pins BENCH's pods=10 row at scheduled=8: with rng seed 42 the two

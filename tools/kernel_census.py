@@ -1,0 +1,221 @@
+"""Dev tool: count the ops in ONE narrow-step iteration.
+
+The 10k solve is launch-bound: ~2k narrow iterations, each ~100 small
+kernels (docs/PERF_NOTES.md rounds 4/6). This tool lowers exactly one
+`narrow_iter` application (ffd_sweeps._make_stride) over a representative
+encoded problem and reports
+
+  jaxpr_eqns      equations in the traced jaxpr, sub-jaxprs (cond/switch
+                  branches, while bodies) flattened in — deterministic
+                  across hosts, the number the tier-1 budget test pins
+  hlo_entry_ops   instructions in the optimized HLO ENTRY computation
+                  (post-fusion, ~ kernel launches per iteration)
+  hlo_total_ops   instructions across all computations (fusion bodies in)
+
+Run as a script for the human-readable report (add ``--quick`` to skip the
+XLA compile and print only the jaxpr count):
+
+    JAX_PLATFORMS=cpu python tools/kernel_census.py [--quick]
+
+Shapes are held small (census problem: 48 pods / 50 types / 16 claim
+slots) — op COUNT is shape-independent for a fixed program structure, and
+small shapes keep the trace under a second so CI can afford it.
+"""
+
+from __future__ import annotations
+
+import sys
+
+if __name__ == "__main__":
+    sys.path.insert(0, ".")
+    import __graft_entry__
+
+    __graft_entry__._respect_platform_env()
+
+
+def build_census_problem(num_pods: int = 48, its_n: int = 50, claim_slots: int = 16):
+    """A small encoded+padded problem exercising every narrow-step gate
+    family: plain pods, a DoNotSchedule zonal spread (topology gates), and
+    mixed resource shapes (distinct fit paths). Mirrors the 10k bench
+    family structurally — no existing nodes, one template."""
+    import random
+
+    from karpenter_tpu.apis import labels as wk
+    from karpenter_tpu.apis.nodepool import NodePool
+    from karpenter_tpu.apis.objects import (
+        DO_NOT_SCHEDULE,
+        Container,
+        LabelSelector,
+        ObjectMeta,
+        Pod,
+        PodSpec,
+        TopologySpreadConstraint,
+    )
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.ops.padding import pad_problem
+    from karpenter_tpu.provisioning.topology import Topology
+    from karpenter_tpu.solver.encode import (
+        Encoder,
+        domains_from_instance_types,
+        template_from_nodepool,
+    )
+
+    rng = random.Random(7)
+    its = instance_types(its_n)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="census")), its, range(len(its))
+    )
+    pods = []
+    for i in range(num_pods):
+        p = Pod(
+            metadata=ObjectMeta(name=f"census-{i}", labels={"census": "c"}),
+            spec=PodSpec(
+                containers=[Container(requests={"cpu": rng.choice([0.1, 0.5, 1.0])})]
+            ),
+        )
+        if i % 3 == 0:
+            p.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                    when_unsatisfiable=DO_NOT_SCHEDULE,
+                    label_selector=LabelSelector(match_labels={"census": "c"}),
+                )
+            ]
+        pods.append(p)
+    domains = domains_from_instance_types(its, [tpl])
+    topo = Topology(domains, batch_pods=pods, cluster_pods=[])
+    enc = Encoder(wk.WELL_KNOWN_LABELS)
+    encoded = enc.encode(
+        pods, its, [tpl], [], topology=topo, num_claim_slots=claim_slots
+    )
+    return pad_problem(encoded.problem)
+
+
+def _narrow_fn_and_args(problem, C: int):
+    """The single-iteration function the sweeps loop runs, plus concrete
+    arguments shaped like the loop carry. Every scalar the loop would carry
+    traced (i, qlen, ...) is passed as an argument so nothing constant-folds
+    away that the real program keeps."""
+    import jax
+    import jax.numpy as jnp
+
+    from karpenter_tpu.ops.ffd_sweeps import _STRIDE, _make_stride
+    from karpenter_tpu.ops.ffd_core import (
+        KIND_FAIL,
+        _pad_lanes_mult32,
+        _pod_xs,
+        _statics,
+        initial_state,
+        problem_bounds_free,
+    )
+
+    # the real program sees device arrays (it runs inside jit); the encoder
+    # hands back numpy, which tracer indexing rejects. bounds_free is decided
+    # the same way the solver entrypoints decide it (problem_bounds_free reads
+    # KARPENTER_TPU_PACKED_GATES), so the census counts the program the
+    # backend would actually run
+    bounds_free = problem_bounds_free(problem)
+    problem = jax.device_put(problem)
+    problem = _pad_lanes_mult32(problem)
+    narrow_iter, _analytic, _ahead = _make_stride(
+        problem, _statics(problem, bounds_free), C, _STRIDE,
+        _pod_xs(problem, bounds_free)
+    )
+    P = problem.num_pods
+    state = initial_state(problem, C)
+    args = (
+        state,
+        jnp.arange(P, dtype=jnp.int32),  # queue
+        jnp.int32(0),  # i
+        jnp.int32(P),  # qlen
+        jnp.full((P,), KIND_FAIL, jnp.int32),  # kinds
+        jnp.full((P,), -1, jnp.int32),  # idxs
+        jnp.zeros((P,), jnp.int32),  # nq
+        jnp.int32(0),  # nqlen
+    )
+    return narrow_iter, args
+
+
+def _count_jaxpr_eqns(jaxpr) -> int:
+    """Equations in a jaxpr, recursing into every sub-jaxpr held in eqn
+    params (cond/switch branches, while cond+body, scan, pjit calls)."""
+    closed = getattr(jaxpr, "jaxpr", None)
+    if closed is not None and hasattr(jaxpr, "consts"):
+        jaxpr = closed
+    n = 0
+    for eqn in jaxpr.eqns:
+        n += 1
+        for v in eqn.params.values():
+            for sub in _iter_subjaxprs(v):
+                n += _count_jaxpr_eqns(sub)
+    return n
+
+
+def _iter_subjaxprs(v):
+    if hasattr(v, "eqns") or (hasattr(v, "jaxpr") and hasattr(v, "consts")):
+        yield v
+    elif isinstance(v, (list, tuple)):
+        for x in v:
+            yield from _iter_subjaxprs(x)
+
+
+def narrow_jaxpr_eqns(problem=None, C: int = 16) -> int:
+    """Flattened jaxpr equation count of one narrow iteration — the number
+    the tier-1 budget test (tests/test_kernel_census.py) pins."""
+    import jax
+
+    if problem is None:
+        problem = build_census_problem(claim_slots=C)
+    fn, args = _narrow_fn_and_args(problem, C)
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    return _count_jaxpr_eqns(jaxpr)
+
+
+def _count_hlo_ops(text: str):
+    """(entry_ops, total_ops) over an HLO text dump. Post-optimization each
+    ENTRY instruction is roughly one kernel launch (fusions count once)."""
+    entry = total = 0
+    in_entry = False
+    for line in text.splitlines():
+        s = line.strip()
+        if s.startswith("ENTRY "):
+            in_entry = True
+            continue
+        if in_entry and s.startswith("}"):
+            in_entry = False
+            continue
+        if " = " in s and not s.startswith("//"):
+            total += 1
+            if in_entry:
+                entry += 1
+    return entry, total
+
+
+def narrow_hlo_ops(problem=None, C: int = 16):
+    """(entry_ops, total_ops) of the compiled single-iteration program."""
+    import jax
+
+    if problem is None:
+        problem = build_census_problem(claim_slots=C)
+    fn, args = _narrow_fn_and_args(problem, C)
+    compiled = jax.jit(fn).lower(*args).compile()
+    return _count_hlo_ops(compiled.as_text())
+
+
+def main(argv):
+    quick = "--quick" in argv
+    C = 16
+    problem = build_census_problem(claim_slots=C)
+    eqns = narrow_jaxpr_eqns(problem, C)
+    print(f"narrow-step census (P={problem.num_pods} T={problem.num_instance_types} "
+          f"K={problem.num_keys} V={problem.num_lanes} C={C})")
+    print(f"  jaxpr_eqns     = {eqns}")
+    if not quick:
+        entry, total = narrow_hlo_ops(problem, C)
+        print(f"  hlo_entry_ops  = {entry}")
+        print(f"  hlo_total_ops  = {total}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -3,9 +3,11 @@
 Runs itself as a subprocess per (KARPENTER_TPU_TOPO_CHAIN,
 KARPENTER_TPU_SPREAD_CHAIN, KARPENTER_TPU_STRIDE) config — the flags are read
 at module import. Times the sweeps solver twice (compile + steady) over the
-10k diverse bench problem and prints the 4-element iteration stack
-[narrow iterations, sweeps, chain-commit iterations, chain-committed pods],
-so the narrow-iteration floor and the hit rate are visible per config.
+10k diverse bench problem and prints the IterCounts fields (narrow, sweeps,
+chain_commits, chain_pods), so the narrow-iteration floor and the hit rate
+are visible per config. Steady timing ground-truths on np.asarray(r.kind) —
+a host materialization, not block_until_ready — so dispatch+transfer cost
+is inside the timed region, matching what the backend pays.
 """
 
 import os
@@ -77,8 +79,10 @@ t0 = time.perf_counter()
 r = solve_ffd_sweeps(problem, 128)
 np.asarray(r.kind)
 steady = time.perf_counter() - t0
-iters = [int(x) for x in np.asarray(r.iters)]
-narrow, sweeps, cc, cp = iters
+it = jax.device_get(r.iters)  # IterCounts — consume by NAME, not position
+narrow, sweeps, cc, cp = (
+    int(it.narrow), int(it.sweeps), int(it.chain_commits), int(it.chain_pods)
+)
 P = problem.num_pods
 print(
     f"topo_chain={os.environ['KARPENTER_TPU_TOPO_CHAIN']} "
