@@ -240,6 +240,14 @@ def run_child():
             )
             ev["chain_commits"] = it.chain_commits
             ev["chain_committed_pods"] = it.chain_pods
+            # round-8 wavefront telemetry: extra-lane commits, pods they
+            # placed, and FAIL chains batched past (the retry-tail burn-down)
+            ev["wavefront_commits"] = it.wave_commits
+            ev["wavefront_pods"] = it.wave_pods
+            ev["retry_iterations"] = it.retry_lanes
+        if solver.last_wave_hist is not None:
+            # index w = iterations that consumed w lanes (lane 0 included)
+            ev["wavefront_width_histogram"] = solver.last_wave_hist
         # lifetime slot-overflow recompiles so far (claim-axis windowing
         # keeps each one a quarter step instead of a doubling)
         ev["claim_escalations"] = solver.claim_escalations
@@ -515,6 +523,19 @@ def main():
             str(e["pods"]): e["chain_commit_hit_rate"]
             for e in shapes
             if "chain_commit_hit_rate" in e
+        }
+    # round-8 wavefront telemetry (per shape): width histogram of lanes
+    # consumed per narrow iteration, and retry chains batched past
+    if any("wavefront_width_histogram" in e for e in shapes):
+        out["per_shape_wavefront_width_histogram"] = {
+            str(e["pods"]): e["wavefront_width_histogram"]
+            for e in shapes
+            if "wavefront_width_histogram" in e
+        }
+        out["per_shape_retry_iterations"] = {
+            str(e["pods"]): e["retry_iterations"]
+            for e in shapes
+            if "retry_iterations" in e
         }
     first = next((e for e in events if e.get("event") == "first_solve"), None)
     if first is not None:

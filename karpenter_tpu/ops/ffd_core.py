@@ -87,6 +87,11 @@ class IterCounts(NamedTuple):
     sweeps: Any  # i32 requeue sweeps over the queue
     chain_commits: Any  # i32 closed-form chain commits (k > 1)
     chain_pods: Any  # i32 pods consumed by those chain commits
+    # wavefront telemetry (KARPENTER_TPU_WAVEFRONT; zeros when off so the
+    # backend's positional fetch stays shape-stable across the flag)
+    wave_commits: Any = 0  # i32 extra lanes that committed placements
+    wave_pods: Any = 0  # i32 pods placed by those extra lanes
+    retry_lanes: Any = 0  # i32 FAIL chains batched past in extra lanes
 
 
 @jax.tree_util.register_dataclass
@@ -97,6 +102,9 @@ class FFDResult:
     state: FFDState  # final bin state
     # IterCounts of i32 scalars (sweeps path only); None on the scan paths
     iters: Any = None
+    # i32[W+1] histogram of wavefront widths (lanes consumed per narrow
+    # iteration); None unless the sweeps path ran with the wavefront on
+    wave_hist: Any = None
 
 
 def _first_true(mask: jnp.ndarray) -> jnp.ndarray:

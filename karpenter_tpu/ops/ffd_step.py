@@ -6,10 +6,12 @@ see ops/ffd.py (facade) for the module map.
 This step is the PARITY ANCHOR for every batched commit: the sweeps path's
 chain commits (ffd_sweeps: waterfill, closed-form round, spread mini-sim —
 batched over pod_eqprev_chain runs whose members may differ on the select
-side) and the run solver's analytic commits must all be bit-identical to
-stepping pods one at a time through THIS body. The randomized fuzz suites
-(test_solver_parity, test_chain_parity) enforce that; gate changes must land
-here first and in the batched paths second.
+side), the round-8 wavefront lanes (ffd_sweeps._wave_extend: extra queue
+heads committed per iteration under explicit independence proofs), and the
+run solver's analytic commits must all be bit-identical to stepping pods one
+at a time through THIS body. The randomized fuzz suites (test_solver_parity,
+test_chain_parity, test_wavefront_parity) enforce that; gate changes must
+land here first and in the batched paths second.
 """
 
 

@@ -70,7 +70,29 @@ _SPREAD_CHAIN = _os.environ.get("KARPENTER_TPU_SPREAD_CHAIN", "1") == "1"
 _TOPO_CHAIN = _os.environ.get("KARPENTER_TPU_TOPO_CHAIN", "1") == "1"
 
 
-def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
+def _wavefront_lanes() -> int:
+    """EXTRA lanes per narrow iteration (round 8 wavefront commit). Read at
+    call time, not import time, so the parity fuzz can solve flag-on and
+    flag-off in one process; the value is a jit STATIC argument, so each
+    setting compiles (and caches) its own program. 0 reproduces the round-7
+    narrow step exactly (python-level branch, census-verified).
+
+    DEFAULT OFF: the 10k A/B (docs/PERF_NOTES.md round 8) measured the
+    wavefront a net loss on the CPU fallback — the FFD queue order
+    deliberately packs IDENTICAL pods adjacent (that is what chain commits
+    batch), so adjacent chain heads usually share a topology group or claim
+    and the realized width saturates near 2 while the vmapped eval multiplies
+    per-iteration cost by ~2-4x. Enable explicitly on corpora with
+    heterogeneous-adjacent queues or heavy FAIL-retry tails, where the lanes
+    batch work the chain commits cannot see."""
+    if _os.environ.get("KARPENTER_TPU_WAVEFRONT", "0") == "0":
+        return 0
+    return max(int(_os.environ.get("KARPENTER_TPU_WAVEFRONT_WIDTH", "4")) - 1, 0)
+
+
+def _make_stride(
+    problem: SchedulingProblem, statics, C: int, S: int, pods_xs, wavefront: int = 0
+):
     """One sweep iteration: evaluate ONE pod exactly (the narrow per-pod
     gates), then commit it together with up to S-1 byte-identical consecutive
     queue successors in closed form — bit-identical to stepping them one at a
@@ -445,6 +467,370 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
             tpl_requests2[pick_c],
             tpl_row_it_ok,
             max_cap,
+        )
+
+    def _wave_extend(
+        state1, queue, i, qlen, kinds, idxs, nq, nqlen, k0, k_chain0, is_open0,
+        noslot0,
+    ):
+        """Round-8 wavefront: after lane 0 (the unchanged narrow commit,
+        already landed in ``state1``), act on up to ``wavefront`` further
+        chain-head lanes in the SAME device iteration. All extra lanes are
+        evaluated with ONE vmapped eval_base against the post-lane-0 state,
+        so lane 1's verdict is the sequential ground truth outright; lane
+        j >= 2 only acts when its verdict is PROVABLY what the sequential
+        scan would compute, via anti-monotonicity of bin eligibility under
+        commits plus explicit independence checks:
+
+          - bin eligibility only SHRINKS under loads/row-narrowing/port/vol
+            commits, so a verdict of False at the post-lane-0 state stays
+            False — EXCEPT through topology counters, where an affinity gate
+            can OPEN as counts grow. Hence every acting lane requires its
+            matched groups to be disjoint from the select/own sets already
+            recorded into by earlier extra lanes (topo_indep);
+          - a committed lane's first-true node pick / fewest-pods claim pick
+            survives iff no earlier extra lane touched a bin it could use:
+            distinct node picks, and earlier-committed claims must be
+            INELIGIBLE to this lane (cap_c == 0) so their rising rank was
+            never in this lane's order anyway;
+          - extra lanes commit via single-bin stacking only (the per-pod
+            prefix that lands on one bin: min(fit, rank-hold, chain)); a
+            lane consuming less than its whole chain cuts the wavefront
+            after itself so later heads stay aligned;
+          - claim opens never happen mid-wavefront (a would-open lane cuts;
+            lane 0 opening admits no extras), so free_slot, remaining, and
+            the minted hostname are wavefront-invariant — which also makes
+            the FAIL verdict exact: ~any_node & ~any_claim & ~any_tpl at the
+            post-lane-0 state replicates at the lane's true state, letting
+            one iteration batch PAST whole failed affinity chains (the
+            retry-tail burn-down) instead of burning one iteration each.
+
+        Records are additive deltas on disjoint groups (topology_kernels
+        .record_delta), summed once at the end — bit-identical to stepping.
+        """
+        We = wavefront
+        # lane heads from chain extents alone: a lane that consumes less
+        # than its chain cuts the wavefront, so heads are valid for every
+        # lane that acts
+        heads, pvec, runs, kchains = [], [], [], []
+        h = i + k0
+        for _ in range(We):
+            p_j = queue[jnp.clip(h, 0, P - 1)]
+            ahead = queue[jnp.clip(h + Srange, 0, P - 1)]
+            adj = (ahead == p_j + Srange) & ((h + Srange) < qlen)
+            succ = jnp.clip(p_j + Srange, 0, P - 1)
+            run = lax.cummin(
+                (adj & ((Srange == 0) | chain_arr[succ])).astype(jnp.int32)
+            ).astype(bool)
+            heads.append(h)
+            pvec.append(p_j)
+            runs.append(run)
+            kchains.append(run.sum().astype(jnp.int32))
+            h = h + kchains[-1]
+        p_w = jnp.stack(pvec)  # [We]
+        pods_w = vmap(gather_pod)(p_w)
+        # ONE batched evaluation of every extra lane against the post-lane-0
+        # state: the wavefront's whole point — W-1 narrow evaluations for
+        # one vmapped kernel set instead of W-1 sequential iterations
+        ev_w = vmap(lambda pod: eval_base(state1, pod))(pods_w)
+
+        free_slot1 = _first_true(~state1.claim_open)
+        if bounds_free:
+            has_slot1 = free_slot1 < C
+        else:
+            has_slot1 = jnp.any(~state1.claim_open)
+        host1 = _mint_host_onehot(problem, free_slot1)
+        need_vec = (
+            (~ev_w["any_node"]) & (~ev_w["any_claim"]) & has_slot1 & ev_w["active"]
+        )
+
+        def tpl_any():
+            # scalar-per-lane outputs only: small-output conds are the cheap
+            # kind (see _make_step's NOTE); the would-open lane re-runs the
+            # full template phase as next iteration's lane 0
+            return vmap(
+                lambda pod: eval_tpl_one(state1, free_slot1, host1, pod)[0]
+            )(pods_w)
+
+        any_tpl_w = lax.cond(
+            jnp.any(need_vec), tpl_any, lambda: jnp.zeros((We,), bool)
+        )
+
+        cont = (k0 == k_chain0) & (~is_open0) & (~noslot0)
+        touched_c = jnp.zeros((C,), bool)
+        touched_n = jnp.zeros((N,), bool) if N > 0 else None
+        eff_acc = jnp.zeros((G,), bool) if G > 0 else None
+        n_lanes = jnp.int32(0)
+        n_commit = jnp.int32(0)
+        n_pods = jnp.int32(0)
+        n_retry = jnp.int32(0)
+        k_all = k0
+
+        cl_req = state1.claim_req
+        cl_requests = state1.claim_requests
+        cl_itok = state1.claim_it_ok
+        cl_npods = state1.claim_npods
+        cl_ports = state1.claim_used_ports
+        nd_req = state1.node_req
+        nd_requests = state1.node_requests
+        nd_npods = state1.node_npods
+        nd_ports = state1.node_used_ports
+        nd_vol = state1.node_vol_used
+
+        rec_rows, rec_allows, rec_matches, rec_w = [], [], [], []
+        rec_need = []
+
+        for j in range(We):
+            evj = jax.tree_util.tree_map(lambda a: a[j], ev_w)
+            pod_j = jax.tree_util.tree_map(lambda a: a[j], pods_w)
+            run_j, kch_j, h_j, p_j = runs[j], kchains[j], heads[j], pvec[j]
+            any_node_j = evj["any_node"]
+            is_claim_j = (~any_node_j) & evj["any_claim"]
+            active_j = evj["active"] & (h_j < qlen)
+            match_j, sel_j, own_j = pod_j[7], pod_j[8], pod_j[9]
+            if G > 0:
+                sel_mem_j = lax.dynamic_slice(
+                    sel_concat, (p_j, jnp.int32(0)), (S, G)
+                )
+                own_mem_j = lax.dynamic_slice(
+                    own_concat, (p_j, jnp.int32(0)), (S, G)
+                )
+                # groups this lane's chain RECORDS into (select side for
+                # regular groups, owned for inverse) — over-approximated by
+                # the union, which is what later lanes' gates must avoid
+                eff_j = jnp.any(run_j[:, None] & (sel_mem_j | own_mem_j), axis=0)
+                topo_indep = ~jnp.any(match_j & eff_acc)
+                aff_safe_j = (problem.grp_type == 1) & ~problem.grp_inverse
+                feedback_j = match_j & (
+                    (sel_j & ~problem.grp_inverse)
+                    | (own_j & problem.grp_inverse)
+                )
+                stack_safe_j = ~jnp.any(feedback_j & ~aff_safe_j)
+            else:
+                topo_indep = jnp.bool_(True)
+                stack_safe_j = jnp.bool_(True)
+
+            cpick_j = evj["claim_pick"]
+            # earlier-committed claims must be ineligible to this lane
+            # (claim_ok <=> cap_c > 0: the it-gate admits a claim only with
+            # room for one more pod, so eligibility implies capacity)
+            claim_indep = ~jnp.any(touched_c & (evj["cap_c"] > 0))
+            if N > 0:
+                node_indep = ~touched_n[jnp.clip(evj["node_pick"], 0, N - 1)]
+            else:
+                node_indep = jnp.bool_(True)
+            fail_j = need_vec[j] & ~any_tpl_w[j]
+            commit_j = (
+                cont
+                & active_j
+                & topo_indep
+                & ((any_node_j & node_indep) | (is_claim_j & claim_indep))
+            )
+            fail_act_j = cont & active_j & topo_indep & fail_j
+
+            # single-bin stacking: the per-pod prefix landing on ONE bin —
+            # same closed form as lane 0's single path
+            j_rank_j = jnp.where(
+                is_claim_j,
+                (evj["rank2"] - 1 - cpick_j) // C - evj["claim_npods0"] + 1,
+                jnp.int32(_BIG_CAP),
+            ).astype(jnp.int32)
+            fitc_j = jnp.where(
+                any_node_j, evj["node_fit_count"], evj["claim_fit_count"]
+            )
+            k_placed_j = jnp.where(
+                stack_safe_j, jnp.minimum(fitc_j, j_rank_j), 1
+            )
+            k_j = jnp.maximum(jnp.minimum(k_placed_j, kch_j), 1).astype(jnp.int32)
+
+            # claim commit (mirrors lane 0's tookc writes, one-hot row)
+            cidx = jnp.where(commit_j & is_claim_j, cpick_j, C + 1)
+            pc = jnp.clip(cpick_j, 0, C - 1)
+            if bounds_free:
+                claim_row_j = _row_sentinel_bounds(evj["claim_final"], cpick_j)
+            else:
+                claim_row_j = evj["claim_final"].row(pc)
+            if bounds_free:
+                new_gt_j, new_lt_j = cl_req.gt, cl_req.lt
+            else:
+                new_gt_j = cl_req.gt.at[cidx].set(claim_row_j.gt, mode="drop")
+                new_lt_j = cl_req.lt.at[cidx].set(claim_row_j.lt, mode="drop")
+            cl_req = ReqTensor(
+                admitted=cl_req.admitted.at[cidx].set(
+                    claim_row_j.admitted, mode="drop"
+                ),
+                comp=cl_req.comp.at[cidx].set(claim_row_j.comp, mode="drop"),
+                gt=new_gt_j,
+                lt=new_lt_j,
+                defined=cl_req.defined.at[cidx].set(
+                    claim_row_j.defined, mode="drop"
+                ),
+            )
+            cl_requests = cl_requests.at[cidx].add(
+                k_j.astype(cl_requests.dtype) * pod_j[2], mode="drop"
+            )
+            cl_itok = cl_itok.at[cidx].set(
+                evj["claim_it_ok2"][pc] & (evj["cap_ct_all"][pc] >= k_j),
+                mode="drop",
+            )
+            cl_npods = cl_npods.at[cidx].add(k_j, mode="drop")
+            cl_ports = cl_ports.at[cidx].max(pod_j[5], mode="drop")
+            touched_c = touched_c | ((jnp.arange(C) == cpick_j) & commit_j & is_claim_j)
+
+            if N > 0:
+                nidx = jnp.where(commit_j & any_node_j, evj["node_pick"], N + 1)
+                nrow = evj["node_row"]
+                if bounds_free:
+                    ngt_j, nlt_j = nd_req.gt, nd_req.lt
+                else:
+                    ngt_j = nd_req.gt.at[nidx].set(nrow.gt, mode="drop")
+                    nlt_j = nd_req.lt.at[nidx].set(nrow.lt, mode="drop")
+                nd_req = ReqTensor(
+                    admitted=nd_req.admitted.at[nidx].set(
+                        nrow.admitted, mode="drop"
+                    ),
+                    comp=nd_req.comp.at[nidx].set(nrow.comp, mode="drop"),
+                    gt=ngt_j,
+                    lt=nlt_j,
+                    defined=nd_req.defined.at[nidx].set(nrow.defined, mode="drop"),
+                )
+                nd_requests = nd_requests.at[nidx].add(
+                    k_j.astype(nd_requests.dtype) * pod_j[2], mode="drop"
+                )
+                nd_npods = nd_npods.at[nidx].add(k_j, mode="drop")
+                nd_ports = nd_ports.at[nidx].max(pod_j[5], mode="drop")
+                nd_vol = nd_vol.at[nidx].add(k_j * pod_j[10], mode="drop")
+                touched_n = touched_n | (
+                    (jnp.arange(N) == evj["node_pick"]) & commit_j & any_node_j
+                )
+
+            if G > 0:
+                covered_j = Srange < jnp.where(commit_j, k_j, 0)
+                w_sel1 = jnp.sum(covered_j[:, None] & sel_mem_j, axis=0)
+                w_own1 = jnp.sum(covered_j[:, None] & own_mem_j, axis=0)
+                w1 = jnp.where(problem.grp_inverse, w_own1, w_sel1).astype(
+                    jnp.int32
+                )
+                rec_row_j = claim_row_j
+                if N > 0:
+                    rec_row_j = jax.tree_util.tree_map(
+                        lambda n, c: jnp.where(any_node_j, n, c), nrow, rec_row_j
+                    )
+                rec_rows.append(rec_row_j)
+                rec_allows.append(jnp.where(any_node_j, no_allow, wellknown))
+                rec_matches.append(match_j)
+                rec_w.append(w1)
+                rec_need.append(commit_j & jnp.any(w1 > 0))
+                eff_acc = eff_acc | (eff_j & commit_j)
+
+            act_j = commit_j | fail_act_j
+            kind_j = jnp.where(
+                commit_j,
+                jnp.where(any_node_j, KIND_NODE, KIND_CLAIM),
+                KIND_FAIL,
+            ).astype(jnp.int32)
+            idx_j = jnp.where(
+                commit_j, jnp.where(any_node_j, evj["node_pick"], cpick_j), -1
+            ).astype(jnp.int32)
+            cons_j = jnp.where(
+                commit_j, k_j, jnp.where(fail_act_j, kch_j, 0)
+            ).astype(jnp.int32)
+            cov_out = Srange < cons_j
+            rows_j = p_j + Srange
+            out_idx = jnp.where(cov_out, rows_j, P + 1)
+            kinds = kinds.at[out_idx].set(
+                jnp.where(cov_out, kind_j, KIND_FAIL), mode="drop"
+            )
+            idxs = idxs.at[out_idx].set(jnp.where(cov_out, idx_j, -1), mode="drop")
+            requeue_j = cov_out & fail_act_j
+            frank_j = jnp.cumsum(requeue_j.astype(jnp.int32)) - 1
+            nq_idx = jnp.where(requeue_j, nqlen + frank_j, P + 1)
+            nq = nq.at[nq_idx].set(rows_j, mode="drop")
+            nqlen = nqlen + requeue_j.sum().astype(jnp.int32)
+
+            n_lanes = n_lanes + act_j.astype(jnp.int32)
+            n_commit = n_commit + commit_j.astype(jnp.int32)
+            n_pods = n_pods + jnp.where(commit_j, k_j, 0)
+            n_retry = n_retry + fail_act_j.astype(jnp.int32)
+            k_all = k_all + cons_j
+            # a full-chain commit or a batched FAIL keeps the wavefront
+            # going; anything else (cut, partial stack) ends it here
+            cont = (commit_j & (k_j == kch_j)) | fail_act_j
+
+        counts1 = state1.grp_counts
+        registered1 = state1.grp_registered
+        if G > 0:
+            rec_need_v = jnp.stack(rec_need)
+
+            def wave_record():
+                rows = jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack(xs), *rec_rows
+                )
+                allows = jnp.stack(rec_allows)
+                matches = jnp.stack(rec_matches)
+                strict_w = pods_w[1].admitted  # [We, K, V]
+                units = vmap(
+                    lambda row, allow, m, sa: record_delta(
+                        problem,
+                        PodTopoStatics(
+                            strict_admitted=sa,
+                            grp_match=m,
+                            grp_selects=jnp.ones((G,), bool),
+                            grp_owned=jnp.ones((G,), bool),
+                        ),
+                        row,
+                        allow,
+                        jnp.bool_(True),
+                        lv,
+                        ln,
+                    )
+                )(rows, allows, matches, strict_w)  # [We, G, V]
+                wstack = jnp.stack(rec_w)  # [We, G] (zero where no commit)
+                counts_add = jnp.einsum(
+                    "wg,wgv->gv", wstack, units.astype(jnp.int32)
+                )
+                reg_add = jnp.any((wstack > 0)[:, :, None] & units, axis=0)
+                return counts_add, reg_add
+
+            counts_add, reg_add = lax.cond(
+                jnp.any(rec_need_v),
+                wave_record,
+                lambda: (
+                    jnp.zeros((G, V), jnp.int32),
+                    jnp.zeros((G, V), bool),
+                ),
+            )
+            counts1 = counts1 + counts_add
+            registered1 = registered1 | reg_add
+
+        state_out = FFDState(
+            claim_req=cl_req,
+            claim_requests=cl_requests,
+            claim_it_ok=cl_itok,
+            claim_open=state1.claim_open,
+            claim_npods=cl_npods,
+            claim_tpl=state1.claim_tpl,
+            claim_used_ports=cl_ports,
+            node_req=nd_req,
+            node_requests=nd_requests,
+            node_npods=nd_npods,
+            node_used_ports=nd_ports,
+            node_vol_used=nd_vol,
+            remaining=state1.remaining,
+            grp_counts=counts1,
+            grp_registered=registered1,
+        )
+        return (
+            state_out,
+            kinds,
+            idxs,
+            nq,
+            nqlen,
+            k_all,
+            n_lanes,
+            n_commit,
+            n_pods,
+            n_retry,
         )
 
     def chain_ahead(queue, i, qlen, p):
@@ -1217,13 +1603,34 @@ def _make_stride(problem: SchedulingProblem, statics, C: int, S: int, pods_xs):
         nq = nq.at[nq_idx].set(rows, mode="drop")
         nqlen = nqlen + requeue.sum().astype(jnp.int32)
         noslot = jnp.any(covered & (kind_row == KIND_NO_SLOT))
+        if wavefront:
+            (
+                state_w,
+                kinds,
+                idxs,
+                nq,
+                nqlen,
+                k_all,
+                n_lanes,
+                n_commit,
+                n_pods,
+                n_retry,
+            ) = _wave_extend(
+                new_state, queue, i, qlen, kinds, idxs, nq, nqlen,
+                k, k_chain, is_open, noslot,
+            )
+            return (
+                state_w, kinds, idxs, nq, nqlen, k_all, noslot,
+                k, n_lanes, n_commit, n_pods, n_retry,
+            )
         return new_state, kinds, idxs, nq, nqlen, k, noslot
 
     return narrow_iter, analytic_iter, chain_ahead
 
 
 def _sweeps_impl(
-    problem: SchedulingProblem, init: FFDState, C: int, bounds_free: bool = False
+    problem: SchedulingProblem, init: FFDState, C: int, bounds_free: bool = False,
+    wavefront: int = 0,
 ) -> FFDResult:
     """All retry passes of a solve in ONE device program.
 
@@ -1250,9 +1657,16 @@ def _sweeps_impl(
     sees it at the same pass boundary it used to.
     """
     P = problem.num_pods
+    if _CHAIN_DISPATCH:
+        # the two-level dispatch predates the wavefront and carries its own
+        # chain consumption; its narrow body stays the 7-output one
+        wavefront = 0
+    # histogram bins: widths 1..wavefront+1 land in their own bin (index 0
+    # stays unused; out-of-range clips into the last bin)
+    WH = wavefront + 2
     pods_xs = _pod_xs(problem, bounds_free)
     narrow_iter, analytic_iter, chain_ahead = _make_stride(
-        problem, _statics(problem, bounds_free), C, _STRIDE, pods_xs
+        problem, _statics(problem, bounds_free), C, _STRIDE, pods_xs, wavefront
     )
     active = jnp.asarray(problem.pod_active)
     # compact initial queue: active rows first, original (FFD) order kept —
@@ -1268,7 +1682,13 @@ def _sweeps_impl(
         return progress & (qlen > 0) & ~noslot
 
     def sweep_body(c):
-        state, queue, qlen, kinds, idxs, _progress, noslot0, it_ct, cc_ct, cp_ct = c
+        if wavefront:
+            (
+                state, queue, qlen, kinds, idxs, _progress, noslot0,
+                it_ct, cc_ct, cp_ct, wc_ct, wp_ct, rl_ct, whist,
+            ) = c
+        else:
+            state, queue, qlen, kinds, idxs, _progress, noslot0, it_ct, cc_ct, cp_ct = c
         i0 = (
             jnp.int32(0),
             state,
@@ -1335,34 +1755,81 @@ def _sweeps_impl(
                 i = ic[0]
                 return i < qlen
 
-            def inner_body(ic):
-                i, state, nq, nqlen, kinds, idxs, noslot, n_it, n_cc, n_cp = ic
-                state, kinds, idxs, nq, nqlen, k, nosl = narrow_iter(
-                    state, queue, i, qlen, kinds, idxs, nq, nqlen
-                )
-                # chain-commit telemetry: iterations that consumed >1 pod,
-                # and how many pods those iterations consumed in total
-                multi = (k > 1).astype(jnp.int32)
-                return (
-                    i + k,
-                    state,
-                    nq,
-                    nqlen,
-                    kinds,
-                    idxs,
-                    noslot | nosl,
-                    n_it + 1,
-                    n_cc + multi,
-                    n_cp + k * multi,
-                )
+            if wavefront:
 
-            _i, state, nq, nqlen, kinds, idxs, noslot, it_ct, cc_ct, cp_ct = (
-                lax.while_loop(inner_cond, inner_body, i0 + (it_ct, cc_ct, cp_ct))
-            )
+                def inner_body(ic):
+                    (
+                        i, state, nq, nqlen, kinds, idxs, noslot,
+                        n_it, n_cc, n_cp, n_wc, n_wp, n_rl, wh,
+                    ) = ic
+                    (
+                        state, kinds, idxs, nq, nqlen, k, nosl, k0,
+                        n_lanes, n_commit, n_pods, n_retry,
+                    ) = narrow_iter(state, queue, i, qlen, kinds, idxs, nq, nqlen)
+                    # chain telemetry stays keyed on lane 0's consumption so
+                    # the numbers mean the same thing flag-on and flag-off
+                    multi = (k0 > 1).astype(jnp.int32)
+                    wh = wh.at[jnp.clip(1 + n_lanes, 0, WH - 1)].add(1)
+                    return (
+                        i + k,
+                        state,
+                        nq,
+                        nqlen,
+                        kinds,
+                        idxs,
+                        noslot | nosl,
+                        n_it + 1,
+                        n_cc + multi,
+                        n_cp + k0 * multi,
+                        n_wc + n_commit,
+                        n_wp + n_pods,
+                        n_rl + n_retry,
+                        wh,
+                    )
+
+                (
+                    _i, state, nq, nqlen, kinds, idxs, noslot,
+                    it_ct, cc_ct, cp_ct, wc_ct, wp_ct, rl_ct, whist,
+                ) = lax.while_loop(
+                    inner_cond,
+                    inner_body,
+                    i0 + (it_ct, cc_ct, cp_ct, wc_ct, wp_ct, rl_ct, whist),
+                )
+            else:
+
+                def inner_body(ic):
+                    i, state, nq, nqlen, kinds, idxs, noslot, n_it, n_cc, n_cp = ic
+                    state, kinds, idxs, nq, nqlen, k, nosl = narrow_iter(
+                        state, queue, i, qlen, kinds, idxs, nq, nqlen
+                    )
+                    # chain-commit telemetry: iterations that consumed >1 pod,
+                    # and how many pods those iterations consumed in total
+                    multi = (k > 1).astype(jnp.int32)
+                    return (
+                        i + k,
+                        state,
+                        nq,
+                        nqlen,
+                        kinds,
+                        idxs,
+                        noslot | nosl,
+                        n_it + 1,
+                        n_cc + multi,
+                        n_cp + k * multi,
+                    )
+
+                _i, state, nq, nqlen, kinds, idxs, noslot, it_ct, cc_ct, cp_ct = (
+                    lax.while_loop(inner_cond, inner_body, i0 + (it_ct, cc_ct, cp_ct))
+                )
         progress = nqlen < qlen
         # iters[1] counts sweeps in the low bits: encode as it_ct plus a
         # sweep counter carried in the same scalar is not worth the reshape —
         # carry the pair explicitly instead
+        if wavefront:
+            return (
+                state, nq, nqlen, kinds, idxs, progress, noslot,
+                it_ct, cc_ct, cp_ct, wc_ct, wp_ct, rl_ct, whist,
+            )
         return state, nq, nqlen, kinds, idxs, progress, noslot, it_ct, cc_ct, cp_ct
 
     n_sweeps0 = jnp.int32(0)
@@ -1374,6 +1841,27 @@ def _sweeps_impl(
         out = sweep_body(c[:-1])
         return out + (c[-1] + 1,)
 
+    if wavefront:
+        (
+            state, _queue, _qlen, kinds, idxs, _prog, _noslot,
+            n_iters, n_cc, n_cp, n_wc, n_wp, n_rl, whist, n_sweeps,
+        ) = lax.while_loop(
+            sweep_cond2,
+            sweep_body2,
+            (init, queue0, qlen0, kinds0, idxs0, jnp.bool_(True), jnp.bool_(False),
+             jnp.int32(0), jnp.int32(0), jnp.int32(0),
+             jnp.int32(0), jnp.int32(0), jnp.int32(0),
+             jnp.zeros((WH,), jnp.int32), n_sweeps0),
+        )
+        return FFDResult(
+            kind=kinds, index=idxs, state=state,
+            iters=IterCounts(
+                narrow=n_iters, sweeps=n_sweeps, chain_commits=n_cc,
+                chain_pods=n_cp, wave_commits=n_wc, wave_pods=n_wp,
+                retry_lanes=n_rl,
+            ),
+            wave_hist=whist,
+        )
     state, _queue, _qlen, kinds, idxs, _prog, _noslot, n_iters, n_cc, n_cp, n_sweeps = (
         lax.while_loop(
             sweep_cond2,
@@ -1391,24 +1879,34 @@ def _sweeps_impl(
     )
 
 
-@functools.partial(jax.jit, static_argnums=(1, 2))
+@functools.partial(jax.jit, static_argnums=(1, 2, 3))
 def _solve_ffd_sweeps_fresh_jit(
-    problem: SchedulingProblem, max_claims: int, bounds_free: bool = False
+    problem: SchedulingProblem, max_claims: int, bounds_free: bool = False,
+    wavefront: int = 0,
 ) -> FFDResult:
     problem = _pad_lanes_mult32(problem)
     return _sweeps_impl(
-        problem, initial_state(problem, max_claims), max_claims, bounds_free
+        problem, initial_state(problem, max_claims), max_claims, bounds_free,
+        wavefront,
     )
 
 
 def solve_ffd_sweeps(
-    problem: SchedulingProblem, max_claims: int, init: Optional[FFDState] = None
+    problem: SchedulingProblem, max_claims: int, init: Optional[FFDState] = None,
+    wavefront: Optional[int] = None,
 ) -> FFDResult:
     """Run ALL retry passes to convergence in one device launch (see
     _sweeps_impl). The production provisioning entrypoint. Always starts from
     a fresh state: the backend's sweeps mode never carries state across
-    launches (nothing is relaxable, so there is no second launch)."""
+    launches (nothing is relaxable, so there is no second launch).
+
+    ``wavefront`` is the number of EXTRA lanes per narrow iteration (round-8
+    wavefront commit); None reads KARPENTER_TPU_WAVEFRONT[_WIDTH]. It is a
+    static jit argument: each setting compiles once and 0 reproduces the
+    round-7 program exactly (census-pinned)."""
     assert init is None, "sweeps mode always runs a whole solve in one launch"
+    if wavefront is None:
+        wavefront = _wavefront_lanes()
     return _solve_ffd_sweeps_fresh_jit(
-        problem, max_claims, problem_bounds_free(problem)
+        problem, max_claims, problem_bounds_free(problem), wavefront
     )

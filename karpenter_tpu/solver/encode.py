@@ -401,29 +401,31 @@ class Encoder:
 
         def encode_reqs(entities: List[Requirements]) -> ReqTensor:
             E = len(entities)
-            admitted = np.zeros((E, K, V), dtype=bool)
-            comp = np.zeros((E, K), dtype=bool)
-            gt = np.full((E, K), GT_NONE, dtype=np.int32)
-            lt = np.full((E, K), LT_NONE, dtype=np.int32)
-            defined = np.zeros((E, K), dtype=bool)
-            # per-call fold memo: at 10k diverse pods only a few hundred
-            # requirement classes exist, and the per-value has() probing is
-            # the dominant host cost of this section (PERF_NOTES item 4)
+            # fold to requirement CLASSES first: at 10k diverse pods only a
+            # few hundred exist, and the per-value has() probing is the
+            # dominant host cost of this section (PERF_NOTES item 4). The
+            # tensors are then built once per class and every entity row is
+            # ONE fancy-index gather — no per-pod numpy row copies
             folded: Dict[tuple, int] = {}
+            reps: List[Requirements] = []
+            cls_of = np.empty(E, dtype=np.int32)
             for e, reqs in enumerate(entities):
                 digest = _reqs_digest(reqs)
-                src = folded.get(digest)
-                if src is not None:
-                    admitted[e] = admitted[src]
-                    comp[e] = comp[src]
-                    gt[e] = gt[src]
-                    lt[e] = lt[src]
-                    defined[e] = defined[src]
-                    continue
-                folded[digest] = e
+                ci = folded.get(digest)
+                if ci is None:
+                    ci = folded[digest] = len(reps)
+                    reps.append(reqs)
+                cls_of[e] = ci
+            U = len(reps)
+            admitted = np.zeros((U, K, V), dtype=bool)
+            comp = np.zeros((U, K), dtype=bool)
+            gt = np.full((U, K), GT_NONE, dtype=np.int32)
+            lt = np.full((U, K), LT_NONE, dtype=np.int32)
+            defined = np.zeros((U, K), dtype=bool)
+            for u, reqs in enumerate(reps):
                 # undefined keys are identity elements: full-admit complements
-                admitted[e] = lane_valid
-                comp[e] = True
+                admitted[u] = lane_valid
+                comp[u] = True
                 for key in reqs:
                     r = reqs.get(key)
                     # inactive key (instance-type rows only): no left-side
@@ -432,17 +434,23 @@ class Encoder:
                     ki = vocab.key_index.get(key)
                     if ki is None:
                         continue
-                    defined[e, ki] = True
-                    comp[e, ki] = r.complement
+                    defined[u, ki] = True
+                    comp[u, ki] = r.complement
                     if r.greater_than is not None:
-                        gt[e, ki] = r.greater_than
+                        gt[u, ki] = r.greater_than
                     if r.less_than is not None:
-                        lt[e, ki] = r.less_than
+                        lt[u, ki] = r.less_than
                     row = np.zeros(V, dtype=bool)
                     for value, vi in vocab.values[ki].items():
                         row[vi] = r.has(value)
-                    admitted[e, ki] = row
-            return ReqTensor(admitted=admitted, comp=comp, gt=gt, lt=lt, defined=defined)
+                    admitted[u, ki] = row
+            return ReqTensor(
+                admitted=admitted[cls_of],
+                comp=comp[cls_of],
+                gt=gt[cls_of],
+                lt=lt[cls_of],
+                defined=defined[cls_of],
+            )
 
         pod_reqs = encode_reqs(pod_reqs_list)
         pod_strict_reqs = encode_reqs(pod_strict_list)
@@ -516,14 +524,30 @@ class Encoder:
                     if name in t.remaining_resources:
                         tpl_remaining[ti, ri] = t.remaining_resources[name]
 
-        pod_tol_tpl = np.zeros((len(pods), TPL), dtype=bool)
+        # toleration folding: tolerates() reads only pod.spec.tolerations
+        # (a tuple of frozen dataclasses), so a 10k batch collapses to a
+        # handful of toleration CLASSES — compute one row per class and
+        # expand by fancy index instead of P x TPL / P x N python loops
+        tol_cls: Dict[tuple, int] = {}
+        tol_reps: List[Pod] = []
+        pod_tol_cls = np.empty(len(pods), dtype=np.int32)
         for pi, p in enumerate(pods):
+            tk = tuple(p.spec.tolerations)
+            ci = tol_cls.get(tk)
+            if ci is None:
+                ci = tol_cls[tk] = len(tol_reps)
+                tol_reps.append(p)
+            pod_tol_cls[pi] = ci
+        cls_tol_tpl = np.zeros((len(tol_reps), TPL), dtype=bool)
+        for ci, rep in enumerate(tol_reps):
             for ti, t in enumerate(templates):
-                pod_tol_tpl[pi, ti] = not t.taints.tolerates(p)
-        pod_tol_node = np.zeros((len(pods), len(nodes)), dtype=bool)
-        for pi, p in enumerate(pods):
+                cls_tol_tpl[ci, ti] = not t.taints.tolerates(rep)
+        cls_tol_node = np.zeros((len(tol_reps), len(nodes)), dtype=bool)
+        for ci, rep in enumerate(tol_reps):
             for ni, n in enumerate(nodes):
-                pod_tol_node[pi, ni] = not n.taints.tolerates(p)
+                cls_tol_node[ci, ni] = not n.taints.tolerates(rep)
+        pod_tol_tpl = cls_tol_tpl[pod_tol_cls]
+        pod_tol_node = cls_tol_node[pod_tol_cls]
 
         # -- 8. host-port lanes: vocab over every distinct port tuple in the
         # batch, with a precomputed lane-vs-lane conflict matrix (wildcard IPs
@@ -544,13 +568,25 @@ class Encoder:
         for a, hp_a in enumerate(lanes):
             for b, hp_b in enumerate(lanes):
                 conflict[a, b] = hp_a.matches(hp_b)
+        # port-row folding, same class trick as tolerations: the rows are a
+        # pure function of the pod's port tuple (almost always empty), so
+        # build one (ports, conflict) row pair per distinct tuple
         pod_ports = np.zeros((len(pods), PT), dtype=bool)
         pod_port_conflict = np.zeros((len(pods), PT), dtype=bool)
+        port_rows: Dict[tuple, Tuple[np.ndarray, np.ndarray]] = {}
         for pi, plist in enumerate(pod_port_lists):
-            for hp in plist:
-                li = port_vocab[hp]
-                pod_ports[pi, li] = True
-                pod_port_conflict[pi] |= conflict[li]
+            pk = tuple(plist)
+            rows = port_rows.get(pk)
+            if rows is None:
+                prow = np.zeros(PT, dtype=bool)
+                crow = np.zeros(PT, dtype=bool)
+                for hp in plist:
+                    li = port_vocab[hp]
+                    prow[li] = True
+                    crow |= conflict[li]
+                rows = port_rows[pk] = (prow, crow)
+            pod_ports[pi] = rows[0]
+            pod_port_conflict[pi] = rows[1]
         # -- CSI attach limits: one lane per driver that is limited on some
         # node (drivers no node limits never gate; see volumeusage.py)
         drivers = sorted({d for n in nodes for d in n.volume_limits})

@@ -161,10 +161,13 @@ class JaxSolver(SolverBackend):
         from karpenter_tpu.utils.jaxtools import enable_compilation_cache
 
         enable_compilation_cache()
-        # IterCounts (narrow, sweeps, chain_commits, chain_pods) of the LAST
-        # sweeps-mode solve; None before any, and reset by non-sweeps solves
-        # so stale counts are never misattributed
+        # IterCounts (narrow, sweeps, chain_commits, chain_pods, wave_commits,
+        # wave_pods, retry_lanes) of the LAST sweeps-mode solve; None before
+        # any, and reset by non-sweeps solves so stale counts are never
+        # misattributed. last_wave_hist is the matching width histogram
+        # (list of ints) when the wavefront ran, else None.
         self.last_iters = None
+        self.last_wave_hist = None
         self.well_known = (
             well_known if well_known is not None else wk.WELL_KNOWN_LABELS
         )
@@ -347,11 +350,12 @@ class JaxSolver(SolverBackend):
             # exits after this pass, so the final-decode state rides the same
             # roundtrip.
             if use_sweeps:
-                kinds, indices, _iters, *np_final = jax.device_get(
+                kinds, indices, _iters, _whist, *np_final = jax.device_get(
                     (
                         result.kind,
                         result.index,
                         result.iters,
+                        result.wave_hist,
                         state.claim_open,
                         state.claim_tpl,
                         state.claim_it_ok,
@@ -366,10 +370,16 @@ class JaxSolver(SolverBackend):
                 # the device-cost diagnostic (rides the same roundtrip):
                 # IterCounts named fields, still tuple-compatible
                 self.last_iters = IterCounts(*(int(x) for x in _iters))
+                # i32[W+1] wavefront-width histogram; None when the
+                # wavefront is off (flag-off keeps the program unchanged)
+                self.last_wave_hist = (
+                    [int(x) for x in _whist] if _whist is not None else None
+                )
             else:
                 kinds, indices = jax.device_get((result.kind, result.index))
                 np_final = None
                 self.last_iters = None
+                self.last_wave_hist = None
             t0 = _t("device-solve", t0)
             if (kinds[: len(queue)] == KIND_NO_SLOT).any():
                 raise _SlotOverflow()

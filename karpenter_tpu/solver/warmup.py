@@ -13,6 +13,13 @@ reloads them in well under a second.
 The reference has no equivalent knob (Go compiles nothing at runtime); this
 is the TPU-native cost the framework pays for its batched solver, amortized
 at operator startup instead of first traffic (VERDICT r2 weak #4).
+
+Program-keying flags must MATCH between the warming process and the serving
+process: ``KARPENTER_TPU_WAVEFRONT`` (and ``_WIDTH``) is a static jit
+argument, so the wavefront and non-wavefront narrow steps are DISTINCT
+executables — warming with the flag in one position buys nothing for a
+server running the other. The same holds for ``KARPENTER_TPU_PACKED_GATES``
+and the stride/window knobs (all read at program-build time).
 """
 
 from __future__ import annotations

@@ -2,7 +2,30 @@
 
 from __future__ import annotations
 
+import hashlib
 import os
+
+
+def _cpu_feature_tag() -> str:
+    """Short digest of the host's CPU feature set (x86 ``flags`` / arm64
+    ``Features`` line of /proc/cpuinfo). XLA:CPU serializes executables
+    AOT-compiled for the compiling host's ISA; ``cpu_aot_loader`` refuses an
+    entry whose feature set doesn't match the loading host and logs a
+    "machine feature mismatch" warning for every miss. A cache directory
+    shared across heterogeneous hosts (laptop vs CI runner vs tunnel target)
+    therefore spams that warning on every shape bucket and recompiles anyway
+    — keying the directory by this tag gives each ISA its own cache."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                    return hashlib.sha256(feats.encode()).hexdigest()[:12]
+    except OSError:
+        pass
+    import platform
+
+    return hashlib.sha256(platform.machine().encode()).hexdigest()[:12]
 
 
 def enable_compilation_cache(path: str = "/root/repo/.jax_cache") -> None:
@@ -14,13 +37,15 @@ def enable_compilation_cache(path: str = "/root/repo/.jax_cache") -> None:
     AOT serialization was actually vm.max_map_count exhaustion from the sheer
     number of live executables (bounded by ``bound_executable_maps`` below) —
     with that bounded, the CPU cache round-trips the run-solver programs
-    correctly (a warm process drops from ~18s to ~5s). XLA:CPU's loader logs
-    machine-feature mismatch warnings for its own `prefer-no-scatter/gather`
-    tuning pseudo-flags; the real ISA feature sets match on the same host and
-    the oracle-parity suite guards against any miscompile."""
+    correctly (a warm process drops from ~18s to ~5s). The cache lands in a
+    per-ISA subdirectory (see ``_cpu_feature_tag``) so entries written by a
+    host with a different CPU feature set never reach this host's
+    ``cpu_aot_loader`` — mixing them is harmless (the loader falls back to a
+    recompile) but noisy and wastes the warm-start the cache exists for."""
     try:
         import jax
 
+        path = os.path.join(path, _cpu_feature_tag())
         os.makedirs(path, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", path)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -30,6 +30,14 @@ import pytest  # noqa: E402
 from karpenter_tpu.utils.jaxtools import bound_executable_maps  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: deep fuzz seeds (one XLA compile each) excluded from the "
+        "tier-1 run's -m 'not slow'",
+    )
+
+
 @pytest.fixture(autouse=True)
 def _bounded_xla_executable_maps():
     # a full-suite run compiles hundreds of solver shape buckets and would

@@ -28,6 +28,13 @@ NARROW_EQN_BUDGET = 2500
 # skew every before/after comparison the flag exists to make
 LEGACY_EQN_FLOOR = 2900
 
+# round-8 wavefront body (KARPENTER_TPU_WAVEFRONT on, 3 extra lanes):
+# measured 5044 at the round-8 commit. The extra ~2650 eqns buy one vmapped
+# eval over 3 more chain heads per iteration — the per-iteration cost the
+# width knob trades against sequential depth, so growth here is as real a
+# regression as growth in the base body
+WAVEFRONT_EQN_BUDGET = 5300
+
 
 @pytest.fixture(scope="module")
 def census_problem():
@@ -70,4 +77,32 @@ class TestNarrowStepBudget:
         assert eqns < LEGACY_EQN_FLOOR * 0.9, (
             f"dieted program at {eqns} eqns is within 10% of the legacy "
             f"floor ({LEGACY_EQN_FLOOR}) — the gate diet stopped paying"
+        )
+
+
+class TestWavefrontBudget:
+    """Round-8 wavefront: the flag-off body must stay BIT-identical to the
+    pre-wavefront program (the python-level branch adds zero equations), and
+    the flag-on body gets its own pinned budget."""
+
+    def test_flag_off_body_unchanged(self, census_problem):
+        """KARPENTER_TPU_WAVEFRONT=0 must reproduce the round-7 program
+        exactly — same equation count, not merely under budget. The
+        wavefront is a python-level branch in _make_stride; if this pin
+        moves, the flag-off program changed and the A/B arm is broken."""
+        assert narrow_jaxpr_eqns(census_problem, wavefront=0) == 2394
+
+    def test_wavefront_body_under_budget(self, census_problem):
+        eqns = narrow_jaxpr_eqns(census_problem, wavefront=3)
+        assert eqns <= WAVEFRONT_EQN_BUDGET, (
+            f"wavefront narrow iteration grew to {eqns} jaxpr eqns "
+            f"(budget {WAVEFRONT_EQN_BUDGET}); the width knob's economics "
+            f"assume this body stays ~2x the base — see tools/kernel_census.py"
+        )
+
+    def test_wavefront_budget_is_tight(self, census_problem):
+        eqns = narrow_jaxpr_eqns(census_problem, wavefront=3)
+        assert eqns >= WAVEFRONT_EQN_BUDGET * 0.8, (
+            f"wavefront body shrank to {eqns} jaxpr eqns — nice! tighten "
+            f"WAVEFRONT_EQN_BUDGET to keep the guard meaningful"
         )

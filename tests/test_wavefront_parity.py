@@ -1,0 +1,230 @@
+"""Wavefront parity fuzz — the round-8 guard.
+
+The sweeps solver's round-8 wavefront (KARPENTER_TPU_WAVEFRONT) acts on up
+to W-1 extra chain-head lanes per narrow iteration, each lane gated by
+explicit independence proofs (disjoint topology groups, untouched node
+picks, capacity-ineligible touched claims, no mid-wavefront claim opens).
+Every acting lane must be BIT-identical to stepping its pods sequentially
+through the per-pod gates. The guard is a runtime differential: the SAME
+padded problem solved by solve_ffd_sweeps with the wavefront on vs off —
+wavefront is a static jit argument, so both arms run in one process and the
+off arm is the pre-round-8 program (itself census-pinned and fuzz-anchored).
+
+Corpora are deliberately topology-heavy: spread with maxSkew>1 and
+minDomains, hostname spread (fresh-claim-per-pod), affinity peer groups
+whose selectors only resolve on later sweeps (the retry tail the FAIL lanes
+burn down), and mixed sizes on shared claims so lane qualification hits the
+capacity-headroom edge (fitc / j_rank partial stacks that cut the front).
+"""
+
+import dataclasses
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.objects import (
+    Affinity,
+    Container,
+    DO_NOT_SCHEDULE,
+    LabelSelector,
+    ObjectMeta,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PodSpec,
+    SCHEDULE_ANYWAY,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.cloudprovider.fake import FAKE_WELL_KNOWN_LABELS, instance_types
+from karpenter_tpu.ops.ffd import solve_ffd_sweeps
+from karpenter_tpu.ops.ffd_core import KIND_FAIL
+from karpenter_tpu.ops.padding import pad_problem
+from karpenter_tpu.provisioning.topology import Topology
+from karpenter_tpu.solver.encode import Encoder
+from karpenter_tpu.solver.jax_backend import domains_from_instance_types
+from tests.test_solver_parity import simple_template
+
+
+def _wave_pod(rng: random.Random, i: int) -> Pod:
+    """One pod of a wavefront-stressing population: many small G-groups so
+    adjacent queue chains land in DIFFERENT groups (independent lanes fire)
+    but collide often enough to exercise the topo_indep cut, plus affinity
+    families that FAIL whole sweeps (retry-lane batching), plus mixed sizes
+    sharing claims (headroom-edge partial stacks)."""
+    letter = rng.choice("abcdefghij")
+    labels = {"my-label": letter}
+    spec_kw = {}
+    roll = rng.random()
+    if roll < 0.30:
+        # zonal spread, distinct selector letters => many disjoint groups;
+        # maxSkew>1 and minDomains in the mix
+        spec_kw["topology_spread_constraints"] = [
+            TopologySpreadConstraint(
+                max_skew=rng.choice([1, 1, 2, 3]),
+                topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                when_unsatisfiable=(
+                    DO_NOT_SCHEDULE if rng.random() < 0.7 else SCHEDULE_ANYWAY
+                ),
+                label_selector=LabelSelector(match_labels={"my-label": letter}),
+                min_domains=rng.choice([None, None, 2, 3, 5]),
+            )
+        ]
+    elif roll < 0.45:
+        # hostname spread: every placement opens/feeds a fresh claim, so
+        # extra lanes must detect the would-open cut
+        spec_kw["topology_spread_constraints"] = [
+            TopologySpreadConstraint(
+                max_skew=1,
+                topology_key=wk.LABEL_HOSTNAME,
+                when_unsatisfiable=DO_NOT_SCHEDULE,
+                label_selector=LabelSelector(
+                    match_labels={"my-label": rng.choice("abcdefghij")}
+                ),
+            )
+        ]
+    elif roll < 0.65:
+        # affinity peer groups: the selector may only be satisfied by LATER
+        # queue rows, so whole chains FAIL and requeue — the retry tail the
+        # wavefront's FAIL lanes batch past
+        labels = {"my-affinity": letter}
+        spec_kw["affinity"] = Affinity(
+            pod_affinity=PodAffinity(
+                required=[
+                    PodAffinityTerm(
+                        label_selector=LabelSelector(
+                            match_labels={"my-affinity": letter}
+                        ),
+                        topology_key=(
+                            wk.LABEL_TOPOLOGY_ZONE
+                            if rng.random() < 0.5
+                            else wk.LABEL_HOSTNAME
+                        ),
+                    )
+                ]
+            )
+        )
+    # sizes deliberately lumpy so shared claims run out of headroom mid-chain
+    cpu = rng.choice([0.1, 0.1, 0.5, 1.0, 1.5, 3.0])
+    return Pod(
+        metadata=ObjectMeta(name=f"p{i}", labels=labels),
+        spec=PodSpec(containers=[Container(requests={"cpu": cpu})], **spec_kw),
+    )
+
+
+def _encode(seed: int):
+    rng = random.Random(seed)
+    its = instance_types(rng.choice([6, 10]))
+    templates = [simple_template(its, name="a")]
+    n = rng.randint(40, 140) if seed % 3 else rng.randint(150, 260)
+    pods = [_wave_pod(rng, i) for i in range(n)]
+    domains = domains_from_instance_types(its, templates)
+    topo = Topology(domains, batch_pods=pods, cluster_pods=[])
+    encoded = Encoder(FAKE_WELL_KNOWN_LABELS).encode(
+        pods, its, templates, (), topology=topo, num_claim_slots=128,
+    )
+    return pad_problem(encoded.problem)
+
+
+# tier-1 keeps a fast fuzz core; the deep seeds (distinct padded shapes,
+# each a fresh XLA compile of BOTH programs) run under -m slow only — the
+# full 10-seed sweep costs ~7 min on a cold cache, most of it compiles
+_SEEDS = [
+    pytest.param(s, marks=[] if s < 3 else [pytest.mark.slow]) for s in range(10)
+]
+
+
+class TestWavefrontParity:
+    """wavefront on vs off on the SAME padded problem: exact placement
+    equality, pod for pod, plus iteration accounting."""
+
+    @pytest.mark.parametrize("seed", _SEEDS)
+    def test_wavefront_vs_sequential(self, seed):
+        problem = _encode(4000 + seed)
+        r_off = solve_ffd_sweeps(problem, 128, wavefront=0)
+        r_on = solve_ffd_sweeps(problem, 128, wavefront=3)
+        np.testing.assert_array_equal(
+            np.asarray(r_off.kind), np.asarray(r_on.kind)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(r_off.index), np.asarray(r_on.index)
+        )
+        # scheduled_frac equality rides the kind equality, but assert it
+        # explicitly so a future kind-code change can't silently weaken this
+        sched_off = int((np.asarray(r_off.kind) < KIND_FAIL).sum())
+        sched_on = int((np.asarray(r_on.kind) < KIND_FAIL).sum())
+        assert sched_off == sched_on
+
+    @pytest.mark.parametrize("seed", _SEEDS)
+    def test_iteration_accounting(self, seed):
+        """The wavefront must never need MORE narrow iterations, its width
+        histogram must sum to exactly the narrow-iteration count, and the
+        telemetry fields must be internally consistent."""
+        problem = _encode(4000 + seed)
+        it_off = solve_ffd_sweeps(problem, 128, wavefront=0).iters
+        r_on = solve_ffd_sweeps(problem, 128, wavefront=3)
+        it_on = r_on.iters
+        assert int(it_on.narrow) <= int(it_off.narrow), (it_on, it_off)
+        assert int(it_on.sweeps) == int(it_off.sweeps), (it_on, it_off)
+        hist = np.asarray(r_on.wave_hist)
+        assert hist.shape == (5,)  # widths 0..4 for 3 extra lanes
+        assert int(hist.sum()) == int(it_on.narrow)
+        assert int(hist[0]) == 0  # lane 0 always consumes, width >= 1
+        # extra-lane actions = commits + batched-FAIL lanes = total width
+        # beyond lane 0 across all iterations
+        extra = int((hist * np.arange(5)).sum()) - int(it_on.narrow)
+        assert extra == int(it_on.wave_commits) + int(it_on.retry_lanes)
+        assert int(it_on.wave_pods) >= int(it_on.wave_commits)
+
+    def test_wavefront_fires_and_saves_iterations(self):
+        """Coverage guard: across a few seeds the extra lanes must actually
+        commit placements AND batch past failed chains — otherwise the
+        wavefront is dead code that trivially passes parity."""
+        commits = retries = saved = 0
+        for seed in range(4):
+            problem = _encode(4000 + seed)
+            it_off = solve_ffd_sweeps(problem, 128, wavefront=0).iters
+            it_on = solve_ffd_sweeps(problem, 128, wavefront=3).iters
+            commits += int(it_on.wave_commits)
+            retries += int(it_on.retry_lanes)
+            saved += int(it_off.narrow) - int(it_on.narrow)
+        assert commits > 0, "no wavefront lane ever committed"
+        assert retries > 0, "no FAIL chain was ever batched past"
+        assert saved > 0, "the wavefront saved no narrow iterations"
+
+    def test_width_one_matches_off(self):
+        """Degenerate width (1 extra lane) must also hold parity — the lane
+        qualification logic has no width-dependent shortcuts."""
+        problem = _encode(4100)
+        r_off = solve_ffd_sweeps(problem, 128, wavefront=0)
+        r_on = solve_ffd_sweeps(problem, 128, wavefront=1)
+        np.testing.assert_array_equal(
+            np.asarray(r_off.kind), np.asarray(r_on.kind)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(r_off.index), np.asarray(r_on.index)
+        )
+
+
+class TestWavefrontChainInteraction:
+    """The wavefront rides ON TOP of chain commits: disabling topo-chains
+    (pod_eqprev_chain -> pod_eqprev byte identity) under the wavefront must
+    still match the sequential scan of the same problem."""
+
+    @pytest.mark.parametrize(
+        "seed", [0, pytest.param(3, marks=pytest.mark.slow)]
+    )
+    def test_byte_chains_under_wavefront(self, seed):
+        problem = _encode(4200 + seed)
+        plain = dataclasses.replace(
+            problem, pod_eqprev_chain=problem.pod_eqprev
+        )
+        r_off = solve_ffd_sweeps(plain, 128, wavefront=0)
+        r_on = solve_ffd_sweeps(plain, 128, wavefront=3)
+        np.testing.assert_array_equal(
+            np.asarray(r_off.kind), np.asarray(r_on.kind)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(r_off.index), np.asarray(r_on.index)
+        )
