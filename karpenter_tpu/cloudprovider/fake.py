@@ -195,9 +195,25 @@ class FakeCloudProvider(CloudProvider):
         self.next_create_error: Optional[Exception] = None
         self.created_nodeclaims: Dict[str, NodeClaim] = {}
         self.drifted: str = "drifted"
+        # per-instance fault injector (testing/faults.py); None falls through
+        # to the ambient/env-installed one so KARPENTER_TPU_FAULTS reaches
+        # the provider without test plumbing
+        self.fault_injector = None
 
     def reset(self):
         self.__init__()
+
+    def _draw_fault(self, site: str):
+        from karpenter_tpu.testing import faults
+
+        injector = (
+            self.fault_injector if self.fault_injector is not None else faults.active()
+        )
+        if injector is None:
+            return
+        rule = injector.draw(site)
+        if rule is not None:
+            raise faults.cloud_exception(rule)
 
     # -- SPI ------------------------------------------------------------------
 
@@ -205,6 +221,7 @@ class FakeCloudProvider(CloudProvider):
         if self.next_create_error is not None:
             err, self.next_create_error = self.next_create_error, None
             raise err
+        self._draw_fault("create")
         self.create_calls.append(node_claim)
         if len(self.create_calls) > self.allowed_create_calls:
             raise RuntimeError("number of allowed create calls exceeded")
@@ -275,6 +292,7 @@ class FakeCloudProvider(CloudProvider):
         return default_instance_types()
 
     def delete(self, node_claim: NodeClaim) -> None:
+        self._draw_fault("delete")
         self.delete_calls.append(node_claim)
         if node_claim.status.provider_id in self.created_nodeclaims:
             del self.created_nodeclaims[node_claim.status.provider_id]

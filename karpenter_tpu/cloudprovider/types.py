@@ -135,6 +135,17 @@ class NodeClassNotReadyError(CloudProviderError):
     """The referenced NodeClass is not fully resolved yet."""
 
 
+class RateLimitError(CloudProviderError):
+    """The provider API throttled the call. Transient: the lifecycle
+    controller retries the same claim with jittered exponential backoff
+    rather than deleting it."""
+
+
+class CreateTimeoutError(CloudProviderError):
+    """The Create call timed out at the provider. Transient, same backoff
+    treatment as RateLimitError."""
+
+
 class CloudProvider(abc.ABC):
     """The SPI every cloud implements (types.go:38-58)."""
 

@@ -24,8 +24,10 @@ from karpenter_tpu.apis.nodeclaim import INITIALIZED, LAUNCHED, NodeClaim, REGIS
 from karpenter_tpu.apis.objects import Node
 from karpenter_tpu.cloudprovider.types import (
     CloudProvider,
+    CreateTimeoutError,
     InsufficientCapacityError,
     NodeClassNotReadyError,
+    RateLimitError,
 )
 from karpenter_tpu.events import Recorder, object_event
 from karpenter_tpu.kube.client import KubeClient, NotFound
@@ -53,6 +55,17 @@ CLAIMS_TERMINATED_LIVENESS = REGISTRY.counter(
     "NodeClaims deleted for failing to register",
     subsystem="nodeclaims",
 )
+CLAIMS_LAUNCH_RETRIES = REGISTRY.counter(
+    "launch_retries_total",
+    "Create calls deferred for retry after a transient provider error",
+    subsystem="nodeclaims",
+)
+
+# transient-Create backoff: base doubles per attempt, capped, plus
+# deterministic jitter so a burst of throttled claims doesn't re-stampede
+# the provider API on the same tick
+LAUNCH_BACKOFF_BASE_SECONDS = 1.0
+LAUNCH_BACKOFF_CAP_SECONDS = 60.0
 
 
 class LifecycleController:
@@ -64,6 +77,8 @@ class LifecycleController:
         self.cloud_provider = cloud_provider
         self.clock = clock
         self.recorder = recorder
+        # claim name -> (attempts, earliest next Create try)
+        self._launch_backoff: dict = {}
 
     def reconcile_all(self) -> None:
         for claim in self.kube.list(NodeClaim):
@@ -91,17 +106,48 @@ class LifecycleController:
 
     def _launch(self, claim: NodeClaim) -> None:
         if claim.is_launched():
+            self._launch_backoff.pop(claim.metadata.name, None)
+            return
+        attempts, next_try = self._launch_backoff.get(claim.metadata.name, (0, 0.0))
+        if self.clock.now() < next_try:
             return
         try:
             launched = self.cloud_provider.create(claim)
         except (InsufficientCapacityError, NodeClassNotReadyError) as e:
             # ICE: delete the claim; the pods go back to pending and the next
             # scheduling pass avoids this shape (launch.go:81-88)
+            self._launch_backoff.pop(claim.metadata.name, None)
             self.recorder.publish(
                 object_event(claim, "Warning", "LaunchFailed", str(e))
             )
             self.kube.delete_opt(NodeClaim, claim.metadata.name, "")
             return
+        except (RateLimitError, CreateTimeoutError) as e:
+            # transient: keep the claim, retry the same Create with jittered
+            # exponential backoff instead of immediately requeueing
+            attempts += 1
+            delay = min(
+                LAUNCH_BACKOFF_BASE_SECONDS * 2.0 ** (attempts - 1),
+                LAUNCH_BACKOFF_CAP_SECONDS,
+            )
+            import zlib
+
+            frac = (
+                zlib.crc32(f"{claim.metadata.name}:{attempts}".encode()) / 2**32
+            )
+            delay *= 0.5 + frac  # deterministic jitter in [0.5, 1.5)
+            self._launch_backoff[claim.metadata.name] = (
+                attempts, self.clock.now() + delay,
+            )
+            CLAIMS_LAUNCH_RETRIES.inc()
+            self.recorder.publish(
+                object_event(
+                    claim, "Warning", "LaunchRetry",
+                    f"{e}; retrying in {delay:.1f}s (attempt {attempts})",
+                )
+            )
+            return
+        self._launch_backoff.pop(claim.metadata.name, None)
         def apply(c):
             c.status.provider_id = launched.status.provider_id
             c.status.capacity = dict(launched.status.capacity)

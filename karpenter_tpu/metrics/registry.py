@@ -173,6 +173,30 @@ class Registry:
 
 REGISTRY = Registry()
 
+# -- solver robustness series (solver/supervisor.py, solver/validator.py) -----
+# Registered here rather than next to their writers so the Prometheus endpoint
+# exports the full robustness surface even before the first solve runs.
+SOLVER_RETRIES = REGISTRY.counter(
+    "solver_retries_total",
+    "Solve attempts retried after a transient failure, by failure class",
+)
+SOLVER_FALLBACK = REGISTRY.counter(
+    "solver_fallback_total",
+    "Solves answered by the fallback backend, by (from, to) backend pair",
+)
+SOLVER_CIRCUIT_STATE = REGISTRY.gauge(
+    "solver_circuit_state",
+    "Primary-backend circuit breaker state (0=closed, 1=half-open, 2=open)",
+)
+VALIDATOR_REJECTIONS = REGISTRY.counter(
+    "validator_rejections_total",
+    "SolveResults quarantined by the invariant gate, by violated invariant",
+)
+SOLVE_DEADLINE_EXCEEDED = REGISTRY.counter(
+    "solve_deadline_exceeded_total",
+    "Solves abandoned by the wall-clock watchdog",
+)
+
 
 @contextmanager
 def measure(histogram: Histogram, labels: Optional[Dict[str, str]] = None):

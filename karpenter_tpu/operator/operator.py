@@ -79,12 +79,19 @@ class Operator:
         # method-duration decorator around the provider (cloudprovider/metrics)
         self.cloud_provider = MetricsCloudProvider(cloud_provider)
         self.cluster = Cluster(self.kube, self.clock)
-        solver = (
-            JaxSolver() if self.options.solver_backend == "jax" else OracleSolver()
-        )
+        # every controller solve (provisioning AND the disruption
+        # simulations, which call provisioner.solver directly) goes through
+        # the supervisor: deadline, retries, invariant gate, circuit-broken
+        # oracle fallback (solver/supervisor.py)
+        from karpenter_tpu.solver.supervisor import SupervisedSolver
+
+        if self.options.solver_backend == "jax":
+            self.supervisor = SupervisedSolver(JaxSolver(), fallback=OracleSolver())
+        else:
+            self.supervisor = SupervisedSolver(OracleSolver(), fallback=None)
         self.provisioner = Provisioner(
             self.kube, self.cloud_provider, self.cluster, self.clock,
-            self.recorder, solver=solver,
+            self.recorder, solver=self.supervisor,
         )
         self.batcher = Batcher(
             self.clock,
@@ -205,15 +212,26 @@ class Operator:
         self.wire()
         self._stop.clear()
         logger = oplog.configure(self.options.log_level)
-        self._servers = [serving.serve(self.options.metrics_port)]
-        if self.options.health_probe_port != self.options.metrics_port:
-            self._servers.append(serving.serve(self.options.health_probe_port))
-        if self.options.enable_profiling:
-            serving.start_profiler()
+        warm_thread = None
         if self.options.solver_backend == "jax":
             from karpenter_tpu.solver.warmup import maybe_prewarm_in_background
 
-            maybe_prewarm_in_background(self.options, self.cloud_provider)
+            warm_thread = maybe_prewarm_in_background(
+                self.options, self.cloud_provider
+            )
+        from karpenter_tpu.solver.warmup import warmup_ready
+
+        status = serving.OperatorStatus(
+            supervisor=self.supervisor,
+            warmup_ready=lambda: warmup_ready(warm_thread),
+        )
+        self._servers = [serving.serve(self.options.metrics_port, status=status)]
+        if self.options.health_probe_port != self.options.metrics_port:
+            self._servers.append(
+                serving.serve(self.options.health_probe_port, status=status)
+            )
+        if self.options.enable_profiling:
+            serving.start_profiler()
 
         def loop(name, reconcile, period):
             while not self._stop.is_set():

@@ -2,16 +2,21 @@
 
 Equivalent of the reference's metrics port and health probes
 (operator.go:139-182): /metrics serves the registry in Prometheus text
-format, /healthz and /readyz answer 200. --enable-profiling maps to the JAX
-profiler (the reference mounts net/http/pprof; the TPU-native analogue is a
-jax.profiler trace, SURVEY.md §5).
+format. /healthz is liveness-only (the process answers — always 200);
+/readyz reflects REAL readiness when the operator wires an OperatorStatus in
+(solver warmup finished and the solver circuit not hard-open), and /statusz
+exposes the supervisor's circuit/failure state as JSON for humans and
+dashboards. --enable-profiling maps to the JAX profiler (the reference
+mounts net/http/pprof; the TPU-native analogue is a jax.profiler trace,
+SURVEY.md §5).
 """
 
 from __future__ import annotations
 
+import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Callable, Optional
 
 from karpenter_tpu.metrics import REGISTRY
 
@@ -34,16 +39,67 @@ def render_prometheus() -> str:
     return "\n".join(lines) + "\n"
 
 
+class OperatorStatus:
+    """Readiness/introspection the endpoints consult. ``supervisor`` is the
+    SupervisedSolver (or None for an unwrapped backend); ``warmup_ready``
+    answers whether startup compilation finished."""
+
+    def __init__(
+        self,
+        supervisor=None,
+        warmup_ready: Optional[Callable[[], bool]] = None,
+    ):
+        self.supervisor = supervisor
+        self.warmup_ready = warmup_ready
+
+    def ready(self) -> bool:
+        """Ready to serve traffic: warmup done and the primary solve path not
+        hard-open. Half-open counts as ready — the next solve probes the
+        primary and the fallback still answers either way."""
+        if self.warmup_ready is not None and not self.warmup_ready():
+            return False
+        if self.supervisor is not None:
+            from karpenter_tpu.solver.supervisor import CIRCUIT_OPEN
+
+            if self.supervisor.circuit_state() == CIRCUIT_OPEN:
+                return False
+        return True
+
+    def statusz(self) -> dict:
+        out = {"ready": self.ready()}
+        if self.warmup_ready is not None:
+            out["warmup_complete"] = bool(self.warmup_ready())
+        if self.supervisor is not None:
+            out["solver"] = self.supervisor.status()
+        return out
+
+
 class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 (http.server API)
+        status: Optional[OperatorStatus] = getattr(self.server, "status", None)
         if self.path.startswith("/metrics"):
             body = render_prometheus().encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
-        elif self.path.startswith(("/healthz", "/readyz")):
+        elif self.path.startswith("/healthz"):
+            # liveness only: if this handler runs, the process is alive
             body = b"ok\n"
             self.send_response(200)
             self.send_header("Content-Type", "text/plain")
+        elif self.path.startswith("/readyz"):
+            # no wired status (tests, bare serve()) preserves always-ready
+            if status is None or status.ready():
+                body = b"ok\n"
+                self.send_response(200)
+            else:
+                body = b"not ready\n"
+                self.send_response(503)
+            self.send_header("Content-Type", "text/plain")
+        elif self.path.startswith("/statusz"):
+            payload = status.statusz() if status is not None else {"ready": True}
+            body = (json.dumps(payload, indent=1, default=str) + "\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
         else:
             body = b"not found\n"
             self.send_response(404)
@@ -55,11 +111,14 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
 
-def serve(port: int, host: str = "") -> ThreadingHTTPServer:
+def serve(
+    port: int, host: str = "", status: Optional[OperatorStatus] = None
+) -> ThreadingHTTPServer:
     """Start the endpoint server on a daemon thread; returns the server (call
     .shutdown() to stop). Binds all interfaces by default so in-cluster
     probes/scrapes against the pod IP work."""
     server = ThreadingHTTPServer((host, port), _Handler)
+    server.status = status
     threading.Thread(target=server.serve_forever, daemon=True,
                      name=f"karpenter-tpu/serve-{port}").start()
     return server

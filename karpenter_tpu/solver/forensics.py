@@ -184,6 +184,59 @@ def failure_reason(
     return "; ".join(parts)
 
 
+_quarantine_seq = 0
+
+
+def dump_quarantine(
+    result,
+    violations: Sequence,
+    backend: str = "",
+    directory: Optional[str] = None,
+) -> Optional[str]:
+    """Write a rejected SolveResult to a forensics JSON file so a bad
+    placement can be diagnosed offline after the supervisor failed over.
+    Directory: ``KARPENTER_TPU_QUARANTINE_DIR`` (default
+    /tmp/karpenter-tpu-quarantine). Best-effort — quarantine must never be
+    the thing that breaks the failover path — returns the path or None."""
+    import json
+    import os
+    import time
+
+    global _quarantine_seq
+    directory = directory or os.environ.get(
+        "KARPENTER_TPU_QUARANTINE_DIR", "/tmp/karpenter-tpu-quarantine"
+    )
+    try:
+        os.makedirs(directory, exist_ok=True)
+        _quarantine_seq += 1
+        path = os.path.join(
+            directory,
+            f"quarantine-{int(time.time())}-{os.getpid()}-{_quarantine_seq}.json",
+        )
+        payload = {
+            "backend": backend,
+            "violations": [str(v) for v in violations],
+            "new_claims": [
+                {
+                    "template_index": c.template_index,
+                    "nodepool_name": c.nodepool_name,
+                    "pod_indices": list(c.pod_indices),
+                    "instance_type_indices": list(c.instance_type_indices),
+                    "requests": dict(c.requests),
+                    "requirements": str(c.requirements),
+                }
+                for c in result.new_claims
+            ],
+            "node_pods": {k: list(v) for k, v in result.node_pods.items()},
+            "failures": {str(k): v for k, v in result.failures.items()},
+        }
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1, default=str)
+        return path
+    except Exception:
+        return None
+
+
 def _fmt_resources(requests: Dict[str, float]) -> str:
     if not requests:
         return "{}"

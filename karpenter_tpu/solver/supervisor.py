@@ -1,0 +1,469 @@
+"""Solver supervisor — deadlines, retries, circuit-broken fallback, salvage.
+
+The SolverBackend the controllers actually call (operator/operator.py wires
+every Provisioner through it). It wraps a primary backend (normally the JAX
+solver) and an optional fallback (the pure-Python oracle — the slow exact
+baseline CvxCluster-style systems pair against their fast solver) with:
+
+  deadline   every primary solve runs under a wall-clock watchdog
+             (``KARPENTER_TPU_SOLVE_DEADLINE_S``; 0 disables and the call is
+             inlined with zero overhead). The watchdog is a join(timeout) on
+             a daemon worker thread: a hung device call cannot be cancelled
+             from Python, so the thread is abandoned and the cycle proceeds.
+  classify   failures map to classes — compile / device / nan / deadline /
+             encode / unknown — by exception type and message. Transient
+             classes (device, deadline) are retried with capped exponential
+             backoff and deterministic jitter (crc32 of the attempt, never
+             the salted ``hash()``); deterministic classes go straight to
+             fallback: recompiling the same program or re-running the same
+             NaN-producing reduction cannot change the answer.
+  validate   successful results pass the invariant gate (solver/validator.py)
+             before leaving; a violation quarantines the result to disk
+             (forensics.dump_quarantine), counts as a primary failure, and
+             fails over — a bad placement must never reach a cloud Create.
+  circuit    N consecutive primary failures trip the breaker: solves route
+             straight to the fallback until a cooldown elapses, then one
+             half-open probe decides between closing and re-opening. State is
+             exported via the ``solver_circuit_state`` gauge and /statusz.
+  salvage    when no backend can answer, the cycle is never dropped: the
+             supervisor returns a SolveResult that requeues every pod via
+             ``failures`` (the provisioning layer retries next cycle), and a
+             validation failure with no fallback strips only the violating
+             bins, keeping the placements that verified.
+
+On the fault-free path the supervisor wraps, never alters, the primary's
+result: the same object comes back bit-identical, and the added work is one
+validator pass (level ``fast`` is linear in pods; ``KARPENTER_TPU_VALIDATE=0``
+removes even that).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+import zlib
+from typing import Dict, List, Optional, Sequence
+
+from karpenter_tpu.metrics.registry import (
+    SOLVE_DEADLINE_EXCEEDED,
+    SOLVER_CIRCUIT_STATE,
+    SOLVER_FALLBACK,
+    SOLVER_RETRIES,
+    VALIDATOR_REJECTIONS,
+)
+from karpenter_tpu.solver import validator as val
+from karpenter_tpu.solver.backend import SolveResult, SolverBackend
+from karpenter_tpu.testing import faults
+
+log = logging.getLogger(__name__)
+
+CLASS_COMPILE = "compile"
+CLASS_DEVICE = "device"
+CLASS_NAN = "nan"
+CLASS_DEADLINE = "deadline"
+CLASS_ENCODE = "encode"
+CLASS_VALIDATION = "validation"
+CLASS_UNKNOWN = "unknown"
+
+# retrying helps only when the same call can succeed next time
+RETRYABLE = frozenset({CLASS_DEVICE, CLASS_DEADLINE, CLASS_UNKNOWN})
+
+CIRCUIT_CLOSED = "closed"
+CIRCUIT_HALF_OPEN = "half-open"
+CIRCUIT_OPEN = "open"
+_CIRCUIT_GAUGE = {CIRCUIT_CLOSED: 0, CIRCUIT_HALF_OPEN: 1, CIRCUIT_OPEN: 2}
+
+
+class DeadlineExceeded(Exception):
+    """The watchdog gave up on a solve."""
+
+
+class NaNResultError(Exception):
+    """The solve returned NaN/inf request tensors (diverged reduction)."""
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def classify_failure(exc: BaseException) -> str:
+    """Map an exception from the solve path to a failure class. Type name
+    first (the injected fault types and jaxlib's exceptions carry their class
+    in the name), then message patterns for the exceptions XLA wraps in
+    RuntimeError."""
+    if isinstance(exc, DeadlineExceeded):
+        return CLASS_DEADLINE
+    if isinstance(exc, NaNResultError):
+        return CLASS_NAN
+    name = type(exc).__name__.lower()
+    msg = str(exc).lower()
+    if "encode" in name:
+        return CLASS_ENCODE
+    if "compil" in name or "compil" in msg or "lowering" in msg or "mosaic" in msg:
+        return CLASS_COMPILE
+    if (
+        "device" in name
+        or "xlaruntime" in name
+        or any(tok in msg for tok in ("resource_exhausted", "device", "pjrt", "dma"))
+    ):
+        return CLASS_DEVICE
+    return CLASS_UNKNOWN
+
+
+class SupervisedSolver(SolverBackend):
+    def __init__(
+        self,
+        primary: SolverBackend,
+        fallback: Optional[SolverBackend] = None,
+        deadline_s: Optional[float] = None,
+        retries: Optional[int] = None,
+        circuit_threshold: Optional[int] = None,
+        circuit_cooldown_s: Optional[float] = None,
+        validate: Optional[str] = None,
+        backoff_base_s: Optional[float] = None,
+        time_fn=time.monotonic,
+        sleep_fn=time.sleep,
+    ):
+        self.primary = primary
+        self.fallback = fallback
+        self.deadline_s = (
+            deadline_s
+            if deadline_s is not None
+            else _env_float("KARPENTER_TPU_SOLVE_DEADLINE_S", 0.0)
+        )
+        self.retries = (
+            retries
+            if retries is not None
+            else int(_env_float("KARPENTER_TPU_SOLVE_RETRIES", 1))
+        )
+        self.circuit_threshold = (
+            circuit_threshold
+            if circuit_threshold is not None
+            else int(_env_float("KARPENTER_TPU_CIRCUIT_THRESHOLD", 3))
+        )
+        self.circuit_cooldown_s = (
+            circuit_cooldown_s
+            if circuit_cooldown_s is not None
+            else _env_float("KARPENTER_TPU_CIRCUIT_COOLDOWN_S", 30.0)
+        )
+        if validate is None:
+            validate = os.environ.get("KARPENTER_TPU_VALIDATE", "1")
+        self.validate_level = {"0": "off", "1": "fast", "2": "full"}.get(
+            validate, validate
+        )
+        self.backoff_base_s = (
+            backoff_base_s
+            if backoff_base_s is not None
+            else _env_float("KARPENTER_TPU_RETRY_BACKOFF_S", 0.05)
+        )
+        self.backoff_cap_s = 2.0
+        self._time = time_fn
+        self._sleep = sleep_fn
+        self._lock = threading.Lock()
+        self._circuit = CIRCUIT_CLOSED
+        self._consecutive_failures = 0
+        self._opened_at = 0.0
+        self._solve_seq = 0
+        self.last_failure: Optional[Dict[str, str]] = None
+        self.counters: Dict[str, int] = {
+            "solve_retries": 0,
+            "solve_fallbacks": 0,
+            "validator_rejections": 0,
+            "deadline_exceeded": 0,
+            "salvaged": 0,
+        }
+        SOLVER_CIRCUIT_STATE.set(0)
+
+    # -- public introspection (serving.py /statusz) ---------------------------
+
+    def circuit_state(self) -> str:
+        with self._lock:
+            # an elapsed cooldown shows as half-open: the next solve probes
+            if (
+                self._circuit == CIRCUIT_OPEN
+                and self._time() - self._opened_at >= self.circuit_cooldown_s
+            ):
+                return CIRCUIT_HALF_OPEN
+            return self._circuit
+
+    def status(self) -> Dict:
+        return {
+            "primary": type(self.primary).__name__,
+            "fallback": type(self.fallback).__name__ if self.fallback else None,
+            "circuit": self.circuit_state(),
+            "consecutive_failures": self._consecutive_failures,
+            "deadline_s": self.deadline_s,
+            "validate": self.validate_level,
+            "counters": dict(self.counters),
+            "last_failure": self.last_failure,
+        }
+
+    # -- circuit transitions --------------------------------------------------
+
+    def _set_circuit(self, state: str) -> None:
+        self._circuit = state
+        SOLVER_CIRCUIT_STATE.set(_CIRCUIT_GAUGE[state])
+
+    def _route(self) -> str:
+        """Where this solve starts: 'primary' (closed, or half-open probe) or
+        'fallback' (open and cooling down). With no fallback there is nothing
+        to route to, so the primary is always tried."""
+        if self.fallback is None:
+            return "primary"
+        with self._lock:
+            if self._circuit == CIRCUIT_CLOSED:
+                return "primary"
+            if self._time() - self._opened_at >= self.circuit_cooldown_s:
+                self._set_circuit(CIRCUIT_HALF_OPEN)
+                return "primary"
+            return "fallback"
+
+    def _record_primary_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            if self._circuit != CIRCUIT_CLOSED:
+                log.info("solver circuit closed: primary backend recovered")
+            self._set_circuit(CIRCUIT_CLOSED)
+
+    def _record_primary_failure(self) -> None:
+        with self._lock:
+            self._consecutive_failures += 1
+            if self._circuit == CIRCUIT_HALF_OPEN:
+                # failed probe: restart the cooldown
+                self._opened_at = self._time()
+                self._set_circuit(CIRCUIT_OPEN)
+            elif (
+                self._circuit == CIRCUIT_CLOSED
+                and self._consecutive_failures >= self.circuit_threshold
+            ):
+                self._opened_at = self._time()
+                self._set_circuit(CIRCUIT_OPEN)
+                log.warning(
+                    "solver circuit opened after %d consecutive failures",
+                    self._consecutive_failures,
+                )
+
+    # -- the solve ------------------------------------------------------------
+
+    def solve(
+        self,
+        pods,
+        instance_types,
+        templates,
+        nodes=(),
+        pod_requirements_override=None,
+        topology=None,
+        cluster_pods=(),
+        domains=None,
+        pod_volumes=None,
+    ) -> SolveResult:
+        kwargs = dict(
+            nodes=nodes,
+            pod_requirements_override=pod_requirements_override,
+            topology=topology,
+            cluster_pods=cluster_pods,
+            domains=domains,
+            pod_volumes=pod_volumes,
+        )
+        self._solve_seq += 1
+        route = self._route()
+        failure_class = None
+        if route == "primary":
+            result, failure_class = self._solve_primary(
+                pods, instance_types, templates, kwargs
+            )
+            if result is not None:
+                return result
+        # primary skipped (open circuit) or exhausted — fall back
+        if self.fallback is not None:
+            from_name = type(self.primary).__name__
+            to_name = type(self.fallback).__name__
+            SOLVER_FALLBACK.inc({"from": from_name, "to": to_name})
+            self.counters["solve_fallbacks"] += 1
+            try:
+                result = self.fallback.solve(pods, instance_types, templates, **kwargs)
+            except Exception:
+                log.exception("fallback backend failed; salvaging the cycle")
+                return self._salvage(pods, failure_class or "fallback-error")
+            violations = self._validate(
+                result, pods, instance_types, templates, kwargs
+            )
+            if violations:
+                # both backends disagree with the invariants: keep what
+                # verified, requeue the rest
+                self._quarantine(result, violations, backend=to_name)
+                return val.strip_violations(
+                    result, violations, self._requeue_reason(CLASS_VALIDATION)
+                )
+            return result
+        return self._salvage(pods, failure_class or "primary-error")
+
+    def _solve_primary(self, pods, instance_types, templates, kwargs):
+        """Returns (result, None) on success or (None, failure_class) once
+        retries are exhausted."""
+        attempts = 1 + max(0, self.retries)
+        failure_class = None
+        for attempt in range(attempts):
+            try:
+                result = self._attempt(pods, instance_types, templates, kwargs)
+            except Exception as exc:
+                failure_class = classify_failure(exc)
+                self.last_failure = {
+                    "class": failure_class,
+                    "error": f"{type(exc).__name__}: {exc}",
+                }
+                if failure_class == CLASS_DEADLINE:
+                    SOLVE_DEADLINE_EXCEEDED.inc()
+                    self.counters["deadline_exceeded"] += 1
+                if failure_class in RETRYABLE and attempt + 1 < attempts:
+                    SOLVER_RETRIES.inc({"class": failure_class})
+                    self.counters["solve_retries"] += 1
+                    self._sleep(self._backoff(attempt))
+                    continue
+                log.warning(
+                    "primary solve failed (class=%s, attempt %d/%d): %s",
+                    failure_class, attempt + 1, attempts, exc,
+                )
+                self._record_primary_failure()
+                return None, failure_class
+            violations = self._validate(
+                result, pods, instance_types, templates, kwargs
+            )
+            if violations:
+                failure_class = CLASS_VALIDATION
+                self.last_failure = {
+                    "class": CLASS_VALIDATION,
+                    "error": "; ".join(str(v) for v in violations[:4]),
+                }
+                self._quarantine(
+                    result, violations, backend=type(self.primary).__name__
+                )
+                self._record_primary_failure()
+                if self.fallback is not None:
+                    return None, failure_class
+                # no fallback: keep the verified placements, requeue the rest
+                self._record_salvage()
+                return (
+                    val.strip_violations(
+                        result, violations, self._requeue_reason(CLASS_VALIDATION)
+                    ),
+                    None,
+                )
+            self._record_primary_success()
+            return result, None
+        return None, failure_class
+
+    def _attempt(self, pods, instance_types, templates, kwargs) -> SolveResult:
+        """One primary solve under the watchdog, with solve-site fault
+        injection applied (only the primary is ever injected — the fallback
+        must stay trustworthy for the chaos suite to mean anything)."""
+        injector = faults.active()
+        rule = injector.draw("solve") if injector is not None else None
+
+        def call():
+            if rule is not None:
+                if rule.kind == "hang":
+                    time.sleep(rule.param or 30.0)
+                else:
+                    faults.raise_solve_fault(rule)
+            result = self.primary.solve(pods, instance_types, templates, **kwargs)
+            if rule is not None and rule.kind == "nan":
+                faults.corrupt_result(result)
+            return result
+
+        result = self._with_deadline(call)
+        if val.has_nan(result):
+            raise NaNResultError("NaN/inf in result request tensors")
+        return result
+
+    def _with_deadline(self, fn):
+        if self.deadline_s <= 0:
+            return fn()
+        box: Dict[str, object] = {}
+        done = threading.Event()
+
+        def run():
+            try:
+                box["result"] = fn()
+            except BaseException as exc:  # propagate to the waiting thread
+                box["error"] = exc
+            finally:
+                done.set()
+
+        worker = threading.Thread(
+            target=run, daemon=True, name="karpenter-tpu/solve-worker"
+        )
+        worker.start()
+        if not done.wait(self.deadline_s):
+            # the worker cannot be cancelled; abandon it (daemon) and move on
+            raise DeadlineExceeded(f"solve exceeded {self.deadline_s:g}s deadline")
+        if "error" in box:
+            raise box["error"]  # type: ignore[misc]
+        return box["result"]
+
+    def _backoff(self, attempt: int) -> float:
+        base = min(self.backoff_base_s * (2.0 ** attempt), self.backoff_cap_s)
+        # deterministic jitter in [0.5, 1.5): crc32, not the salted hash()
+        frac = zlib.crc32(f"{self._solve_seq}:{attempt}".encode()) / 2**32
+        return base * (0.5 + frac)
+
+    # -- validation / quarantine / salvage ------------------------------------
+
+    def _validate(
+        self, result, pods, instance_types, templates, kwargs
+    ) -> List[val.Violation]:
+        if self.validate_level == "off":
+            return []
+        try:
+            violations = val.validate_result(
+                result,
+                pods,
+                instance_types,
+                templates,
+                nodes=kwargs["nodes"],
+                pod_requirements_override=kwargs["pod_requirements_override"],
+                cluster_pods=kwargs["cluster_pods"],
+                domains=kwargs["domains"],
+                level=self.validate_level,
+            )
+        except Exception:
+            # the gate must never take down a healthy solve
+            log.exception("validator crashed; passing result through")
+            return []
+        for v in violations:
+            VALIDATOR_REJECTIONS.inc({"invariant": v.invariant})
+        if violations:
+            self.counters["validator_rejections"] += 1
+        return violations
+
+    def _quarantine(self, result, violations, backend: str) -> None:
+        from karpenter_tpu.solver.forensics import dump_quarantine
+
+        path = dump_quarantine(result, violations, backend=backend)
+        log.error(
+            "validator rejected %s result (%d violation(s), first: %s)%s",
+            backend, len(violations), violations[0],
+            f"; forensics at {path}" if path else "",
+        )
+
+    def _requeue_reason(self, failure_class: str) -> str:
+        return (
+            f"solver unavailable ({failure_class}); pod requeued for the "
+            f"next provisioning cycle"
+        )
+
+    def _record_salvage(self) -> None:
+        self.counters["salvaged"] += 1
+
+    def _salvage(self, pods: Sequence, failure_class: str) -> SolveResult:
+        """No backend could answer: complete the cycle anyway by requeueing
+        every pod — FailedScheduling events fire and the next cycle retries,
+        instead of the controllers seeing an exception and dropping the batch."""
+        self._record_salvage()
+        reason = self._requeue_reason(failure_class)
+        return SolveResult(failures={i: reason for i in range(len(pods))})

@@ -225,6 +225,13 @@ def prewarm_screen(n_candidates: int) -> bool:
         return False
 
 
+def warmup_ready(thread: Optional["object"]) -> bool:
+    """Readiness predicate for /readyz: True once the background warm
+    finished (or never ran — a skipped warm must not hold readiness
+    hostage, it is an optimization, not a liveness dependency)."""
+    return thread is None or not thread.is_alive()
+
+
 def persistent_cache_enabled() -> bool:
     """Whether the cross-process compile cache is active
     (utils/jaxtools.py enable_compilation_cache)."""

@@ -1,0 +1,7 @@
+"""Deterministic test instrumentation shipped with the package.
+
+`faults` is the schedule-driven fault injector the solver supervisor and the
+fake cloud provider consult (ISSUE 4): production code paths carry the hook
+points so tier-1 chaos tests exercise the exact binaries that ship, but the
+hooks are inert (a dict lookup against None) unless a spec is installed.
+"""

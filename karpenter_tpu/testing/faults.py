@@ -1,0 +1,232 @@
+"""Seeded, schedule-driven fault injection (``KARPENTER_TPU_FAULTS``).
+
+Every degradation path the supervisor handles (solver/supervisor.py) must be
+reachable deterministically from tier-1, so the injector is driven by an
+explicit schedule rather than ambient randomness:
+
+    KARPENTER_TPU_FAULTS="seed=7;solve.compile@1;solve.nan@2..3;create.ice@p0.25"
+
+Grammar — ``;``-separated entries, optional leading ``seed=N``:
+
+    entry  := site '.' kind ['=' param] '@' sched
+    site   := 'solve' | 'create' | 'delete'
+    kind   := solve: compile | device | encode | nan | hang
+              create/delete: ice | ratelimit | timeout
+    param  := float   (hang duration in seconds; default 30)
+    sched  := N       fire on the N-th call to the site (1-based)
+            | N..M    fire on calls N through M inclusive
+            | pP      fire with probability P per call (seeded, per-call
+                      deterministic: the draw for call n depends only on
+                      (seed, site, n), never on interleaving)
+            | *       fire on every call
+
+Probabilistic draws hash ``(seed, site, call#)`` with crc32 — Python's
+``hash()`` is per-process salted and must not leak into schedules. The
+injector records every firing in ``fired`` so tests can assert replay
+determinism. Hook sites call :func:`active`, which is ``None`` unless an
+injector was installed programmatically or the env var is set — the
+production cost of the disabled path is one module-attribute read.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+SITES = ("solve", "create", "delete")
+SOLVE_KINDS = ("compile", "device", "encode", "nan", "hang")
+CLOUD_KINDS = ("ice", "ratelimit", "timeout")
+
+
+class InjectedFault(RuntimeError):
+    """Base for injected solver faults (cloud faults raise the provider's own
+    typed errors so the consuming code paths see exactly what a real cloud
+    would throw)."""
+
+
+class FaultCompileError(InjectedFault):
+    """Injected XLA compile failure (classified 'compile')."""
+
+
+class FaultDeviceError(InjectedFault):
+    """Injected device/runtime failure (classified 'device', retryable)."""
+
+
+class FaultEncodeError(InjectedFault):
+    """Injected host-side encode failure (classified 'encode')."""
+
+
+@dataclass
+class FaultRule:
+    site: str
+    kind: str
+    param: float = 0.0
+    start: int = 0  # 1-based inclusive; 0 = not schedule-based
+    end: int = 0
+    prob: float = -1.0  # >= 0 = probabilistic; -1 = schedule-based
+
+    def matches(self, n: int, seed: int) -> bool:
+        if self.prob >= 0.0:
+            draw = random.Random(
+                zlib.crc32(f"{seed}:{self.site}:{n}".encode())
+            ).random()
+            return draw < self.prob
+        return self.start <= n <= self.end
+
+
+def parse_spec(spec: str) -> Tuple[List[FaultRule], int]:
+    """Parse a KARPENTER_TPU_FAULTS spec into (rules, seed). Raises
+    ValueError on malformed entries — a typo'd chaos schedule silently
+    injecting nothing would be worse than failing fast."""
+    rules: List[FaultRule] = []
+    seed = 0
+    for raw in spec.split(";"):
+        entry = raw.strip()
+        if not entry:
+            continue
+        if entry.startswith("seed="):
+            seed = int(entry[len("seed="):])
+            continue
+        if "@" not in entry:
+            raise ValueError(f"fault entry {entry!r}: missing '@sched'")
+        head, sched = entry.rsplit("@", 1)
+        param = 0.0
+        if "=" in head:
+            head, param_s = head.split("=", 1)
+            param = float(param_s)
+        if "." not in head:
+            raise ValueError(f"fault entry {entry!r}: expected site.kind")
+        site, kind = head.split(".", 1)
+        if site not in SITES:
+            raise ValueError(f"fault entry {entry!r}: unknown site {site!r}")
+        allowed = SOLVE_KINDS if site == "solve" else CLOUD_KINDS
+        if kind not in allowed:
+            raise ValueError(
+                f"fault entry {entry!r}: kind {kind!r} not valid for {site!r}"
+            )
+        rule = FaultRule(site=site, kind=kind, param=param)
+        if sched == "*":
+            rule.start, rule.end = 1, 2**31
+        elif sched.startswith("p"):
+            rule.prob = float(sched[1:])
+            if not 0.0 <= rule.prob <= 1.0:
+                raise ValueError(f"fault entry {entry!r}: probability out of range")
+        elif ".." in sched:
+            a, b = sched.split("..", 1)
+            rule.start, rule.end = int(a), int(b)
+        else:
+            rule.start = rule.end = int(sched)
+        rules.append(rule)
+    return rules, seed
+
+
+class FaultInjector:
+    """Per-site call counter + first-matching-rule dispatch. ``fired`` logs
+    (site, kind, call#) tuples so a chaos test can assert that the same spec
+    and seed replay the same fault sequence."""
+
+    def __init__(self, rules: Sequence[FaultRule] = (), seed: int = 0):
+        self.rules = list(rules)
+        self.seed = seed
+        self._counts: Dict[str, int] = {}
+        self.fired: List[Tuple[str, str, int]] = []
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "FaultInjector":
+        rules, seed = parse_spec(spec)
+        return cls(rules, seed)
+
+    def reset(self) -> None:
+        self._counts.clear()
+        self.fired.clear()
+
+    def calls(self, site: str) -> int:
+        return self._counts.get(site, 0)
+
+    def draw(self, site: str) -> Optional[FaultRule]:
+        """Advance the site's call counter and return the first matching rule
+        (or None). Call exactly once per hooked operation."""
+        n = self._counts.get(site, 0) + 1
+        self._counts[site] = n
+        for rule in self.rules:
+            if rule.site == site and rule.matches(n, self.seed):
+                self.fired.append((site, rule.kind, n))
+                return rule
+        return None
+
+
+# -- fault realization helpers ------------------------------------------------
+
+
+def raise_solve_fault(rule: FaultRule) -> None:
+    """Raise the typed exception for a solve-site rule (hang/nan are handled
+    in-line by the supervisor, not raised)."""
+    if rule.kind == "compile":
+        raise FaultCompileError(f"injected compile failure (call schedule {rule})")
+    if rule.kind == "device":
+        raise FaultDeviceError(f"injected device failure (call schedule {rule})")
+    if rule.kind == "encode":
+        raise FaultEncodeError(f"injected encode failure (call schedule {rule})")
+
+
+def corrupt_result(result) -> None:
+    """NaN-poison a SolveResult in place (the 'nan' kind): every new claim's
+    request tensor gets a NaN, the signature of a diverged device reduction."""
+    for claim in result.new_claims:
+        for key in list(claim.requests):
+            claim.requests[key] = float("nan")
+
+
+def cloud_exception(rule: FaultRule) -> Exception:
+    """The typed cloud-provider error for a create/delete-site rule."""
+    from karpenter_tpu.cloudprovider.types import (
+        CreateTimeoutError,
+        InsufficientCapacityError,
+        RateLimitError,
+    )
+
+    if rule.kind == "ice":
+        return InsufficientCapacityError("injected: insufficient capacity")
+    if rule.kind == "ratelimit":
+        return RateLimitError("injected: API rate limit exceeded")
+    return CreateTimeoutError("injected: create timed out")
+
+
+# -- ambient installation -----------------------------------------------------
+
+_injector: Optional[FaultInjector] = None
+_env_injector: Optional[FaultInjector] = None
+_env_spec: Optional[str] = None
+
+
+def install(injector: Optional[FaultInjector]) -> None:
+    """Install a process-wide injector (tests). Overrides the env spec."""
+    global _injector
+    _injector = injector
+
+
+def clear() -> None:
+    global _injector, _env_injector, _env_spec
+    _injector = None
+    _env_injector = None
+    _env_spec = None
+
+
+def active() -> Optional[FaultInjector]:
+    """The injector hook sites consult: the installed one, else one built
+    from KARPENTER_TPU_FAULTS (rebuilt if the env value changed), else None."""
+    global _env_injector, _env_spec
+    if _injector is not None:
+        return _injector
+    spec = os.environ.get("KARPENTER_TPU_FAULTS")
+    if not spec:
+        _env_injector = None
+        _env_spec = None
+        return None
+    if spec != _env_spec:
+        _env_injector = FaultInjector.from_spec(spec)
+        _env_spec = spec
+    return _env_injector

@@ -122,6 +122,51 @@ def test_threaded_start_serves_metrics_and_survives_errors():
         op.stop()
 
 
+def test_readyz_and_statusz_reflect_circuit_state():
+    import json
+    import socket
+    import urllib.error
+    import urllib.request
+
+    from karpenter_tpu.operator import serving
+    from karpenter_tpu.solver.oracle import OracleSolver
+    from karpenter_tpu.solver.supervisor import SupervisedSolver
+
+    clock = {"t": 0.0}
+    sup = SupervisedSolver(
+        OracleSolver(), fallback=OracleSolver(), circuit_threshold=1,
+        circuit_cooldown_s=30.0, time_fn=lambda: clock["t"],
+    )
+    status = serving.OperatorStatus(supervisor=sup, warmup_ready=lambda: True)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    server = serving.serve(port, status=status)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        assert urllib.request.urlopen(f"{base}/readyz", timeout=5).read() == b"ok\n"
+        payload = json.loads(
+            urllib.request.urlopen(f"{base}/statusz", timeout=5).read()
+        )
+        assert payload["ready"] and payload["solver"]["circuit"] == "closed"
+        # trip the breaker: /readyz flips to 503, /statusz names the state
+        sup._record_primary_failure()
+        with __import__("pytest").raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(f"{base}/readyz", timeout=5)
+        assert exc.value.code == 503
+        payload = json.loads(
+            urllib.request.urlopen(f"{base}/statusz", timeout=5).read()
+        )
+        assert not payload["ready"] and payload["solver"]["circuit"] == "open"
+        # /healthz stays 200 throughout: liveness must not track readiness
+        assert urllib.request.urlopen(f"{base}/healthz", timeout=5).read() == b"ok\n"
+        # cooldown elapses -> half-open counts as ready again
+        clock["t"] += 31.0
+        assert urllib.request.urlopen(f"{base}/readyz", timeout=5).read() == b"ok\n"
+    finally:
+        server.shutdown()
+
+
 def test_step_respects_periods():
     op, clock = make_operator()
     op.kube.create(make_nodepool())

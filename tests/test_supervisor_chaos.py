@@ -1,0 +1,406 @@
+"""Chaos suite for the solver supervisor (solver/supervisor.py).
+
+Every injected fault class must end in a COMPLETED provisioning cycle — a
+SolveResult with either placements (fallback answered, parity with the
+fault-free oracle) or requeued pods (salvage) — never an exception reaching
+the controllers, and never a dropped cycle. Fault schedules are seeded and
+deterministic (testing/faults.py), so every path here replays bit-identically.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from karpenter_tpu.apis.nodepool import NodePool
+from karpenter_tpu.apis.objects import ObjectMeta
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.solver.encode import template_from_nodepool
+from karpenter_tpu.solver.oracle import OracleSolver
+from karpenter_tpu.solver.supervisor import (
+    CIRCUIT_CLOSED,
+    CIRCUIT_HALF_OPEN,
+    CIRCUIT_OPEN,
+    SupervisedSolver,
+    classify_failure,
+)
+from karpenter_tpu.testing import faults
+
+from bench import make_diverse_pods
+import random
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def build_problem(pod_count=60, its_count=20):
+    its = instance_types(its_count)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="chaos")), its, range(len(its))
+    )
+    pods = make_diverse_pods(pod_count, random.Random(42))
+    return pods, its, [tpl]
+
+
+def placements_key(result):
+    return (
+        tuple(
+            (c.template_index, tuple(c.pod_indices), tuple(c.instance_type_indices))
+            for c in result.new_claims
+        ),
+        tuple(sorted((k, tuple(v)) for k, v in result.node_pods.items())),
+        tuple(sorted(result.failures)),
+    )
+
+
+class CountingSolver:
+    """Wraps a backend, counting calls; optionally fails the first N."""
+
+    def __init__(self, inner, fail_first=0, error=None):
+        self.inner = inner
+        self.calls = 0
+        self.fail_first = fail_first
+        self.error = error or RuntimeError("device: injected")
+
+    def solve(self, *args, **kwargs):
+        self.calls += 1
+        if self.calls <= self.fail_first:
+            raise self.error
+        return self.inner.solve(*args, **kwargs)
+
+
+# -- fault-free path -----------------------------------------------------------
+
+
+def test_fault_free_path_is_bit_identical():
+    pods, its, tpls = build_problem()
+    baseline = OracleSolver().solve(pods, its, tpls)
+    sup = SupervisedSolver(OracleSolver(), fallback=OracleSolver())
+    result = sup.solve(pods, its, tpls)
+    assert placements_key(result) == placements_key(baseline)
+    assert sup.counters == {
+        "solve_retries": 0,
+        "solve_fallbacks": 0,
+        "validator_rejections": 0,
+        "deadline_exceeded": 0,
+        "salvaged": 0,
+    }
+    assert sup.circuit_state() == CIRCUIT_CLOSED
+
+
+# -- one test per fault class: the cycle completes with oracle parity ----------
+
+
+@pytest.mark.parametrize(
+    "spec,expect_fallback",
+    [
+        ("solve.compile@1", True),   # deterministic: straight to fallback
+        ("solve.encode@1", True),    # deterministic: straight to fallback
+        ("solve.nan@1", True),       # NaN gate: straight to fallback
+        ("solve.device@1", False),   # transient: the retry succeeds
+    ],
+)
+def test_fault_class_completes_cycle_with_parity(spec, expect_fallback):
+    pods, its, tpls = build_problem()
+    baseline = OracleSolver().solve(pods, its, tpls)
+    faults.install(faults.FaultInjector.from_spec(spec))
+    sup = SupervisedSolver(
+        OracleSolver(), fallback=OracleSolver(), retries=1, backoff_base_s=0.001
+    )
+    result = sup.solve(pods, its, tpls)  # must not raise: zero dropped cycles
+    assert placements_key(result) == placements_key(baseline)
+    if expect_fallback:
+        assert sup.counters["solve_fallbacks"] == 1
+        assert sup.counters["solve_retries"] == 0
+    else:
+        assert sup.counters["solve_fallbacks"] == 0
+        assert sup.counters["solve_retries"] == 1
+    # the injector logged exactly the scheduled firing
+    assert faults.active().fired == [("solve", spec.split(".")[1].split("@")[0], 1)]
+
+
+def test_hang_is_caught_by_deadline_then_falls_back():
+    pods, its, tpls = build_problem(pod_count=20)
+    baseline = OracleSolver().solve(pods, its, tpls)
+    faults.install(faults.FaultInjector.from_spec("solve.hang=5@1..2"))
+    sup = SupervisedSolver(
+        OracleSolver(),
+        fallback=OracleSolver(),
+        deadline_s=0.1,
+        retries=1,
+        backoff_base_s=0.001,
+    )
+    result = sup.solve(pods, its, tpls)
+    assert placements_key(result) == placements_key(baseline)
+    # hang is retryable (deadline class), both attempts hung, then fallback
+    assert sup.counters["deadline_exceeded"] == 2
+    assert sup.counters["solve_retries"] == 1
+    assert sup.counters["solve_fallbacks"] == 1
+    assert sup.last_failure["class"] == "deadline"
+
+
+def test_persistent_failure_without_fallback_salvages_not_raises():
+    pods, its, tpls = build_problem(pod_count=12)
+    faults.install(faults.FaultInjector.from_spec("solve.compile@*"))
+    sup = SupervisedSolver(OracleSolver(), fallback=None)
+    result = sup.solve(pods, its, tpls)  # completes the cycle anyway
+    assert result.new_claims == [] and result.node_pods == {}
+    assert set(result.failures) == set(range(len(pods)))
+    for reason in result.failures.values():
+        assert "requeued" in reason
+    assert sup.counters["salvaged"] == 1
+
+
+def test_failure_classification():
+    from karpenter_tpu.solver.supervisor import DeadlineExceeded, NaNResultError
+
+    assert classify_failure(faults.FaultCompileError("x")) == "compile"
+    assert classify_failure(faults.FaultDeviceError("x")) == "device"
+    assert classify_failure(faults.FaultEncodeError("x")) == "encode"
+    assert classify_failure(DeadlineExceeded("x")) == "deadline"
+    assert classify_failure(NaNResultError("x")) == "nan"
+    assert classify_failure(RuntimeError("RESOURCE_EXHAUSTED: hbm")) == "device"
+    assert classify_failure(RuntimeError("error during lowering")) == "compile"
+    assert classify_failure(ValueError("whatever")) == "unknown"
+
+
+# -- circuit breaker -----------------------------------------------------------
+
+
+def test_circuit_opens_routes_to_fallback_then_half_open_probe_closes():
+    pods, its, tpls = build_problem(pod_count=10)
+    baseline = OracleSolver().solve(pods, its, tpls)
+    clock = {"t": 0.0}
+    primary = CountingSolver(OracleSolver(), fail_first=2)
+    sup = SupervisedSolver(
+        primary,
+        fallback=OracleSolver(),
+        retries=0,
+        circuit_threshold=2,
+        circuit_cooldown_s=30.0,
+        time_fn=lambda: clock["t"],
+        sleep_fn=lambda s: None,
+    )
+    # two consecutive failures trip the breaker (both still complete)
+    for _ in range(2):
+        result = sup.solve(pods, its, tpls)
+        assert placements_key(result) == placements_key(baseline)
+    assert sup.circuit_state() == CIRCUIT_OPEN
+    assert primary.calls == 2
+
+    # open: the primary is not even tried, fallback answers directly
+    result = sup.solve(pods, its, tpls)
+    assert placements_key(result) == placements_key(baseline)
+    assert primary.calls == 2
+    assert sup.counters["solve_fallbacks"] == 3
+
+    # cooldown elapses -> half-open -> the probe succeeds -> closed
+    clock["t"] += 31.0
+    assert sup.circuit_state() == CIRCUIT_HALF_OPEN
+    result = sup.solve(pods, its, tpls)
+    assert placements_key(result) == placements_key(baseline)
+    assert primary.calls == 3
+    assert sup.circuit_state() == CIRCUIT_CLOSED
+
+
+def test_failed_half_open_probe_reopens():
+    pods, its, tpls = build_problem(pod_count=10)
+    clock = {"t": 0.0}
+    primary = CountingSolver(OracleSolver(), fail_first=10)
+    sup = SupervisedSolver(
+        primary,
+        fallback=OracleSolver(),
+        retries=0,
+        circuit_threshold=1,
+        circuit_cooldown_s=30.0,
+        time_fn=lambda: clock["t"],
+        sleep_fn=lambda s: None,
+    )
+    sup.solve(pods, its, tpls)
+    assert sup.circuit_state() == CIRCUIT_OPEN
+    clock["t"] += 31.0
+    sup.solve(pods, its, tpls)  # probe fails
+    assert sup.circuit_state() == CIRCUIT_OPEN
+    # the cooldown restarted at the failed probe
+    clock["t"] += 15.0
+    assert sup.circuit_state() == CIRCUIT_OPEN
+
+
+# -- validator gate e2e --------------------------------------------------------
+
+
+class LyingSolver:
+    """Returns the oracle's answer with the first claim's pods doubled into
+    bin 0 — the overpacked-commit signature the validator must catch."""
+
+    def __init__(self):
+        self.inner = OracleSolver()
+
+    def solve(self, *args, **kwargs):
+        result = self.inner.solve(*args, **kwargs)
+        if len(result.new_claims) >= 2:
+            a, b = result.new_claims[0], result.new_claims[1]
+            a.pod_indices = a.pod_indices + b.pod_indices
+            result.new_claims.pop(1)
+        return result
+
+
+def test_bad_result_fails_over_and_quarantines(tmp_path, monkeypatch):
+    monkeypatch.setenv("KARPENTER_TPU_QUARANTINE_DIR", str(tmp_path))
+    its = instance_types(1)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="q")), its, range(len(its))
+    )
+    from tests.factories import make_pod
+
+    pods = [make_pod(cpu=0.8) for _ in range(4)]
+    baseline = OracleSolver().solve(pods, its, [tpl])
+    sup = SupervisedSolver(LyingSolver(), fallback=OracleSolver())
+    result = sup.solve(pods, its, [tpl])
+    # the corrupted placement never escaped; the fallback's answer did
+    assert placements_key(result) == placements_key(baseline)
+    assert sup.counters["validator_rejections"] == 1
+    assert sup.counters["solve_fallbacks"] == 1
+    dumps = list(tmp_path.glob("quarantine-*.json"))
+    assert len(dumps) == 1
+    payload = json.loads(dumps[0].read_text())
+    assert payload["violations"]
+    assert sup.last_failure["class"] == "validation"
+
+
+def test_bad_result_without_fallback_strips_only_bad_bins(tmp_path, monkeypatch):
+    monkeypatch.setenv("KARPENTER_TPU_QUARANTINE_DIR", str(tmp_path))
+    its = instance_types(1)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="q2")), its, range(len(its))
+    )
+    from tests.factories import make_pod
+
+    pods = [make_pod(cpu=0.8) for _ in range(4)]
+    sup = SupervisedSolver(LyingSolver(), fallback=None)
+    result = sup.solve(pods, its, [tpl])
+    # the overpacked bin's pods are requeued; every pod stays accounted for
+    accounted = set(result.failures)
+    for c in result.new_claims:
+        accounted |= set(c.pod_indices)
+    assert accounted == set(range(len(pods)))
+    assert result.failures  # something was actually stripped
+
+
+# -- determinism ---------------------------------------------------------------
+
+
+def test_fault_replay_is_deterministic():
+    spec = "seed=7;solve.device@p0.4"
+    logs = []
+    for _ in range(2):
+        inj = faults.FaultInjector.from_spec(spec)
+        for n in range(50):
+            inj.draw("solve")
+        logs.append(list(inj.fired))
+    assert logs[0] == logs[1]
+    assert logs[0]  # p=0.4 over 50 draws fires at least once
+
+    # a different seed gives a different schedule
+    other = faults.FaultInjector.from_spec("seed=8;solve.device@p0.4")
+    for n in range(50):
+        other.draw("solve")
+    assert other.fired != logs[0]
+
+
+def test_malformed_fault_specs_fail_fast():
+    for bad in ("solve.compile", "oven.bake@1", "solve.ice@1", "solve.device@p1.5"):
+        with pytest.raises(ValueError):
+            faults.parse_spec(bad)
+
+
+# -- cloud-provider faults end in a completed provisioning cycle ---------------
+
+
+def test_ice_fault_requeues_pods_and_next_cycle_provisions():
+    from karpenter_tpu.apis.nodeclaim import NodeClaim
+    from karpenter_tpu.controllers.nodeclaim_lifecycle import LifecycleController
+    from tests.factories import make_nodepool, make_pod
+    from tests.harness import Env
+
+    env = Env(solver=SupervisedSolver(OracleSolver()))
+    env.cloud_provider.fault_injector = faults.FaultInjector.from_spec(
+        "create.ice@1"
+    )
+    env.create(make_nodepool(), make_pod(name="p1", cpu=1.0))
+    env.provisioner.reconcile()
+    assert len(env.kube.list(NodeClaim)) == 1
+    ctrl = LifecycleController(env.kube, env.cloud_provider, env.clock, env.recorder)
+    ctrl.reconcile_all()  # ICE: the claim is torn down, the pod stays pending
+    live = [
+        c for c in env.kube.list(NodeClaim)
+        if c.metadata.deletion_timestamp is None
+    ]
+    assert live == []
+    assert env.recorder.count("LaunchFailed") == 1
+    # the termination controller finishes the teardown (finalizer removal)
+    from karpenter_tpu.controllers.nodeclaim_termination import TerminationController
+
+    TerminationController(env.kube, env.cloud_provider).reconcile_all()
+    assert env.kube.list(NodeClaim) == []
+    # next cycle: the injector's schedule is exhausted, the cycle completes
+    pass_ = env.provisioner.reconcile()
+    assert len(pass_.created) == 1
+    ctrl.reconcile_all()
+    launched = [c for c in env.kube.list(NodeClaim) if c.is_launched()]
+    assert len(launched) == 1
+
+
+def test_ratelimit_fault_backs_off_then_launches():
+    from karpenter_tpu.apis.nodeclaim import NodeClaim
+    from karpenter_tpu.controllers.nodeclaim_lifecycle import LifecycleController
+    from tests.factories import make_nodeclaim, make_nodepool
+    from tests.harness import Env
+
+    env = Env(solver=SupervisedSolver(OracleSolver()))
+    env.cloud_provider.fault_injector = faults.FaultInjector.from_spec(
+        "create.ratelimit@1"
+    )
+    env.create(make_nodepool(), make_nodeclaim(name="c1", requirements=[]))
+    ctrl = LifecycleController(env.kube, env.cloud_provider, env.clock, env.recorder)
+    ctrl.reconcile_all()  # throttled: the claim survives, a retry is booked
+    got = env.kube.get(NodeClaim, "c1", "")
+    assert not got.is_launched()
+    assert env.recorder.count("LaunchRetry") == 1
+    # before the backoff elapses nothing happens (no API stampede)
+    ctrl.reconcile_all()
+    assert len(env.cloud_provider.create_calls) == 0
+    # past the (jittered, <= 1.5x base) backoff the same Create succeeds
+    env.clock.step(2.0)
+    ctrl.reconcile_all()
+    got = env.kube.get(NodeClaim, "c1", "")
+    assert got.is_launched()
+    assert env.recorder.count("LaunchFailed") == 0
+
+
+# -- deep chaos (slow) ---------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_flaky_device_storm_over_300_pod_corpus():
+    """25% per-call device-fault probability over repeated cycles on the
+    300-pod diverse corpus: every cycle completes with oracle parity."""
+    pods, its, tpls = build_problem(pod_count=300, its_count=50)
+    baseline = OracleSolver().solve(pods, its, tpls)
+    base_key = placements_key(baseline)
+    faults.install(faults.FaultInjector.from_spec("seed=11;solve.device@p0.25"))
+    sup = SupervisedSolver(
+        OracleSolver(), fallback=OracleSolver(), retries=1, backoff_base_s=0.001
+    )
+    for cycle in range(8):
+        result = sup.solve(pods, its, tpls)
+        assert placements_key(result) == base_key, f"cycle {cycle} lost parity"
+    # the storm actually exercised the machinery
+    assert faults.active().fired
+    assert sup.counters["solve_retries"] + sup.counters["solve_fallbacks"] > 0

@@ -1,0 +1,266 @@
+"""Adversarial tests for the SolveResult invariant gate (solver/validator.py).
+
+Each test hand-corrupts a known-good oracle result the way a buggy device
+kernel would (overpacked bin, violated taint, port clash, wrong zone,
+phantom pods) and asserts the gate names the violated invariant — and that
+the uncorrupted result passes both levels, so the gate cannot false-positive
+a healthy backend into failover.
+"""
+
+from __future__ import annotations
+
+import copy
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodepool import NodePool
+from karpenter_tpu.apis.objects import (
+    DO_NOT_SCHEDULE,
+    IN,
+    LabelSelector,
+    NO_SCHEDULE,
+    ObjectMeta,
+    Taint,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.scheduling import Requirement, Requirements
+from karpenter_tpu.scheduling.taints import Taints
+from karpenter_tpu.solver import validator as val
+from karpenter_tpu.solver.encode import NodeInfo, TemplateInfo, template_from_nodepool
+from karpenter_tpu.solver.oracle import OracleSolver
+
+from tests.factories import make_pod
+
+
+def build(pods, templates=None, its=None, nodes=()):
+    its = its if its is not None else instance_types(10)
+    if templates is None:
+        templates = [
+            template_from_nodepool(
+                NodePool(metadata=ObjectMeta(name="np")), its, range(len(its))
+            )
+        ]
+    result = OracleSolver().solve(pods, its, templates, nodes=nodes)
+    return result, its, templates
+
+
+def invariants(violations):
+    return {v.invariant for v in violations}
+
+
+def test_valid_result_passes_both_levels():
+    pods = [make_pod(cpu=0.5) for _ in range(8)]
+    pods += [make_pod(cpu=0.2, host_ports=[8080 + i]) for i in range(2)]
+    result, its, tpls = build(pods)
+    assert result.num_scheduled() == len(pods)
+    assert val.validate_result(result, pods, its, tpls) == []
+    assert val.validate_result(result, pods, its, tpls, level="full") == []
+
+
+def test_overpacked_bin_is_caught():
+    # two claims forced by a tiny catalog; merging B's pods into A without
+    # updating the request tensor is exactly what an off-by-one device
+    # commit would produce
+    its = instance_types(1)  # 1 cpu / 2Gi / 10 pods
+    pods = [make_pod(cpu=0.8) for _ in range(4)]
+    result, its, tpls = build(pods, its=its)
+    assert len(result.new_claims) >= 2
+    a, b = result.new_claims[0], result.new_claims[1]
+    corrupted = copy.deepcopy(result)
+    corrupted.new_claims[0].pod_indices = a.pod_indices + b.pod_indices
+    corrupted.new_claims.pop(1)
+    found = invariants(val.validate_result(corrupted, pods, its, tpls))
+    assert found & {"claim-requests", "claim-capacity"}
+
+    # same shape with the requests tensor kept consistent: capacity must
+    # still fail because no listed instance type fits the doubled load
+    corrupted2 = copy.deepcopy(corrupted)
+    expected = dict(tpls[0].daemon_overhead)
+    from karpenter_tpu.utils import resources as res
+
+    for pi in corrupted2.new_claims[0].pod_indices:
+        expected = res.merge(expected, {**res.pod_requests(pods[pi]), res.PODS: 1.0})
+    corrupted2.new_claims[0].requests = expected
+    found2 = invariants(val.validate_result(corrupted2, pods, its, tpls))
+    assert "claim-capacity" in found2
+
+
+def test_violated_taint_is_caught():
+    its = instance_types(10)
+    base = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="np")), its, range(len(its))
+    )
+    tainted = TemplateInfo(
+        nodepool_name="tainted",
+        requirements=base.requirements.copy(),
+        taints=Taints([Taint(key="team", value="gpu", effect=NO_SCHEDULE)]),
+        daemon_overhead=dict(base.daemon_overhead),
+        instance_type_indices=list(base.instance_type_indices),
+    )
+    pods = [make_pod(cpu=0.5) for _ in range(3)]
+    result, its, tpls = build(pods, templates=[base, tainted], its=its)
+    assert all(c.template_index == 0 for c in result.new_claims)
+    corrupted = copy.deepcopy(result)
+    for c in corrupted.new_claims:
+        c.template_index = 1  # point the placement at the tainted template
+    found = invariants(val.validate_result(corrupted, pods, its, tpls))
+    assert "taint-admissibility" in found
+
+
+def test_host_port_clash_is_caught():
+    pods = [make_pod(cpu=0.1, host_ports=[9000]) for _ in range(2)]
+    result, its, tpls = build(pods)
+    # the solver must keep clashing ports on separate claims
+    assert len(result.new_claims) == 2
+    corrupted = copy.deepcopy(result)
+    merged = corrupted.new_claims[0]
+    merged.pod_indices = (
+        merged.pod_indices + corrupted.new_claims[1].pod_indices
+    )
+    corrupted.new_claims.pop(1)
+    found = invariants(val.validate_result(corrupted, pods, its, tpls))
+    assert "host-port" in found
+
+
+def test_requirement_intersection_is_caught():
+    pods = [make_pod(cpu=0.5, node_selector={wk.LABEL_TOPOLOGY_ZONE: "test-zone-1"})]
+    result, its, tpls = build(pods)
+    assert result.num_scheduled() == 1
+    corrupted = copy.deepcopy(result)
+    corrupted.new_claims[0].requirements = Requirements(
+        Requirement(wk.LABEL_TOPOLOGY_ZONE, IN, ["test-zone-2"])
+    )
+    found = invariants(val.validate_result(corrupted, pods, its, tpls))
+    assert "requirement-intersection" in found
+
+
+def test_node_overpack_and_unknown_node_are_caught():
+    node = NodeInfo(
+        name="node-1",
+        requirements=Requirements(
+            Requirement(wk.LABEL_HOSTNAME, IN, ["node-1"]),
+            Requirement(wk.LABEL_TOPOLOGY_ZONE, IN, ["test-zone-1"]),
+        ),
+        taints=Taints(),
+        available={"cpu": 1.0, "memory": 2 * 1024.0**3, "pods": 10.0},
+        daemon_overhead={},
+    )
+    pods = [make_pod(cpu=0.5) for _ in range(4)]
+    result, its, tpls = build(pods, nodes=[node])
+    corrupted = copy.deepcopy(result)
+    # cram every pod onto the 1-cpu node
+    corrupted.new_claims = []
+    corrupted.node_pods = {"node-1": list(range(4))}
+    found = invariants(
+        val.validate_result(corrupted, pods, its, tpls, nodes=[node])
+    )
+    assert "node-capacity" in found
+
+    # move pod 0 out of wherever it landed onto a node the inputs never had
+    phantom = copy.deepcopy(result)
+    for c in phantom.new_claims:
+        c.pod_indices = [pi for pi in c.pod_indices if pi != 0]
+    phantom.new_claims = [c for c in phantom.new_claims if c.pod_indices]
+    phantom.node_pods = {
+        name: [pi for pi in indices if pi != 0]
+        for name, indices in phantom.node_pods.items()
+    }
+    phantom.node_pods = {k: v for k, v in phantom.node_pods.items() if v}
+    phantom.node_pods["node-ghost"] = [0]
+    found = invariants(
+        val.validate_result(phantom, pods, its, tpls, nodes=[node])
+    )
+    assert "node-unknown" in found
+
+
+def test_pod_accounting_catches_drops_and_duplicates():
+    pods = [make_pod(cpu=0.5) for _ in range(4)]
+    result, its, tpls = build(pods)
+    dropped = copy.deepcopy(result)
+    dropped.new_claims[0].pod_indices = dropped.new_claims[0].pod_indices[:-1]
+    assert "pod-accounting" in invariants(
+        val.validate_result(dropped, pods, its, tpls)
+    )
+    duped = copy.deepcopy(result)
+    duped.new_claims[0].pod_indices = (
+        duped.new_claims[0].pod_indices + duped.new_claims[0].pod_indices[:1]
+    )
+    assert "pod-accounting" in invariants(
+        val.validate_result(duped, pods, its, tpls)
+    )
+
+
+def test_topology_skew_bound_is_caught_at_full_level():
+    selector = LabelSelector(match_labels={"app": "s"})
+    tsc = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=wk.LABEL_TOPOLOGY_ZONE,
+        when_unsatisfiable=DO_NOT_SCHEDULE,
+        label_selector=selector,
+    )
+    pods = [
+        make_pod(cpu=0.5, labels={"app": "s"}, topology_spread=[copy.deepcopy(tsc)])
+        for _ in range(6)
+    ]
+    result, its, tpls = build(pods)
+    assert result.num_scheduled() == 6
+    assert val.validate_result(result, pods, its, tpls, level="full") == []
+    corrupted = copy.deepcopy(result)
+    for c in corrupted.new_claims:
+        c.requirements = Requirements(
+            Requirement(wk.LABEL_TOPOLOGY_ZONE, IN, ["test-zone-1"])
+        )
+    found = invariants(
+        val.validate_result(corrupted, pods, its, tpls, level="full")
+    )
+    assert "topology-skew" in found
+
+
+def test_nan_detection():
+    pods = [make_pod(cpu=0.5)]
+    result, its, tpls = build(pods)
+    assert not val.has_nan(result)
+    poisoned = copy.deepcopy(result)
+    for key in list(poisoned.new_claims[0].requests):
+        poisoned.new_claims[0].requests[key] = float("nan")
+    assert val.has_nan(poisoned)
+
+
+def test_strip_violations_requeues_only_the_bad_bins():
+    its = instance_types(1)
+    pods = [make_pod(cpu=0.8) for _ in range(4)]
+    result, its, tpls = build(pods, its=its)
+    assert len(result.new_claims) >= 2
+    corrupted = copy.deepcopy(result)
+    corrupted.new_claims[0].requests = {
+        k: v * 100 for k, v in corrupted.new_claims[0].requests.items()
+    }
+    violations = val.validate_result(corrupted, pods, its, tpls)
+    assert violations
+    salvaged = val.strip_violations(corrupted, violations, "requeued")
+    # the untouched claims survive, the corrupted claim's pods are requeued
+    assert len(salvaged.new_claims) == len(corrupted.new_claims) - 1
+    requeued = set(corrupted.new_claims[0].pod_indices)
+    assert requeued <= set(salvaged.failures)
+    # every pod still accounted for: salvage must never drop a pod
+    accounted = set(salvaged.failures)
+    for c in salvaged.new_claims:
+        accounted |= set(c.pod_indices)
+    assert accounted == set(range(len(pods)))
+
+
+def test_validator_rejects_empty_and_unknown_references():
+    pods = [make_pod(cpu=0.5)]
+    result, its, tpls = build(pods)
+    bad = copy.deepcopy(result)
+    bad.new_claims[0].instance_type_indices = []
+    assert "claim-instance-types" in invariants(
+        val.validate_result(bad, pods, its, tpls)
+    )
+    bad2 = copy.deepcopy(result)
+    bad2.new_claims[0].template_index = 99
+    assert "claim-template" in invariants(
+        val.validate_result(bad2, pods, its, tpls)
+    )

@@ -1,0 +1,156 @@
+"""Chaos sweep: run the fault matrix (fault class x backend x corpus) through
+the supervised solver and print a survival table.
+
+Survival means the provisioning cycle COMPLETES: the solve returns a
+SolveResult (placements, or requeued pods in salvage mode) instead of
+raising, and — when the fallback answered — the placements match the
+fault-free oracle baseline. Zero dropped cycles is the acceptance bar.
+
+    JAX_PLATFORMS=cpu python tools/chaos_sweep.py --quick
+    python tools/chaos_sweep.py --pods 60,300 --backends oracle,jax
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import sys
+import time
+
+sys.path.insert(0, ".")
+
+# fault class -> KARPENTER_TPU_FAULTS spec driven at the primary backend;
+# "hang" needs the watchdog, so a deadline is set for every cell
+FAULT_SPECS = {
+    "none": "",
+    "compile": "solve.compile@1",
+    "device": "solve.device@1",
+    "device-storm": "solve.device@1..3",
+    "nan": "solve.nan@1",
+    "hang": "solve.hang=0.6@1",
+    "encode": "solve.encode@1",
+    "flaky-p25": "seed=7;solve.device@p0.25",
+}
+
+
+def build_problem(pod_count: int, its_count: int):
+    from karpenter_tpu.apis.nodepool import NodePool
+    from karpenter_tpu.apis.objects import ObjectMeta
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.solver.encode import template_from_nodepool
+    from bench import make_diverse_pods
+
+    its = instance_types(its_count)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="chaos")), its, range(len(its))
+    )
+    pods = make_diverse_pods(pod_count, random.Random(42))
+    return pods, its, [tpl]
+
+
+def make_backend(name: str):
+    if name == "jax":
+        from karpenter_tpu.solver.jax_backend import JaxSolver
+
+        return JaxSolver()
+    from karpenter_tpu.solver.oracle import OracleSolver
+
+    return OracleSolver()
+
+
+def placements_key(result):
+    return (
+        tuple(
+            (c.template_index, tuple(c.pod_indices), tuple(c.instance_type_indices))
+            for c in result.new_claims
+        ),
+        tuple(sorted((k, tuple(v)) for k, v in result.node_pods.items())),
+        tuple(sorted(result.failures)),
+    )
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--pods", default="60,300",
+                    help="comma-separated corpus sizes (default 60,300)")
+    ap.add_argument("--backends", default="oracle,jax",
+                    help="comma-separated primary backends (oracle,jax)")
+    ap.add_argument("--instance-types", type=int, default=50)
+    ap.add_argument("--deadline", type=float, default=0.25,
+                    help="watchdog deadline in seconds (catches 'hang')")
+    ap.add_argument("--quick", action="store_true",
+                    help="oracle primary only, 60-pod corpus")
+    args = ap.parse_args()
+
+    from karpenter_tpu.solver.oracle import OracleSolver
+    from karpenter_tpu.solver.supervisor import SupervisedSolver
+    from karpenter_tpu.testing import faults
+
+    pod_counts = [60] if args.quick else [int(p) for p in args.pods.split(",")]
+    backends = ["oracle"] if args.quick else args.backends.split(",")
+
+    rows = []
+    for pod_count in pod_counts:
+        pods, its, tpls = build_problem(pod_count, args.instance_types)
+        baseline = OracleSolver().solve(pods, its, tpls)
+        base_key = placements_key(baseline)
+        for backend_name in backends:
+            if backend_name == "jax":
+                # compile outside the deadline/fault window so 'hang' rows
+                # time the injected sleep, not XLA
+                make_backend("jax").solve(pods, its, tpls)
+            for fault, spec in FAULT_SPECS.items():
+                faults.install(faults.FaultInjector.from_spec(spec) if spec else None)
+                sup = SupervisedSolver(
+                    make_backend(backend_name),
+                    fallback=OracleSolver(),
+                    deadline_s=args.deadline if fault == "hang" else 0.0,
+                    retries=1,
+                    backoff_base_s=0.01,
+                )
+                t0 = time.perf_counter()
+                try:
+                    result = sup.solve(pods, its, tpls)
+                    survived = True
+                except Exception as exc:  # a raised solve IS a dropped cycle
+                    print(f"DROPPED CYCLE: {backend_name}/{fault}: {exc}")
+                    result, survived = None, False
+                finally:
+                    faults.install(None)
+                elapsed = time.perf_counter() - t0
+                scheduled = result.num_scheduled() if result else 0
+                parity = result is not None and (
+                    placements_key(result) == base_key
+                    or scheduled == baseline.num_scheduled()
+                )
+                rows.append({
+                    "pods": pod_count,
+                    "backend": backend_name,
+                    "fault": fault,
+                    "survived": survived,
+                    "scheduled": f"{scheduled}/{len(pods)}",
+                    "parity": parity,
+                    "retries": sup.counters["solve_retries"],
+                    "fallbacks": sup.counters["solve_fallbacks"],
+                    "s": round(elapsed, 3),
+                })
+    faults.clear()
+
+    header = ("pods", "backend", "fault", "survived", "scheduled", "parity",
+              "retries", "fallbacks", "s")
+    widths = {h: max(len(h), *(len(str(r[h])) for r in rows)) for h in header}
+    line = "  ".join(h.ljust(widths[h]) for h in header)
+    print(line)
+    print("-" * len(line))
+    for r in rows:
+        print("  ".join(str(r[h]).ljust(widths[h]) for h in header))
+    failed = [r for r in rows if not r["survived"] or not r["parity"]]
+    print(
+        f"\n{len(rows) - len(failed)}/{len(rows)} cells survived with parity"
+        + ("" if not failed else f"; FAILED: {failed}")
+    )
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
