@@ -171,6 +171,14 @@ def _measure(fn, reps: int):
 
 
 def run_child():
+    # one-line notice instead of the XLA machine-feature/SIGILL flag dump
+    # (must run before jax loads its C++ backend), and phase tracing on so
+    # every shape reports where its wall clock went
+    from karpenter_tpu.operator.logging import quiet_xla_warnings
+
+    quiet_xla_warnings(notify_stderr=True)
+    os.environ.setdefault("KARPENTER_TPU_TRACE", "1")
+
     import __graft_entry__
 
     __graft_entry__._respect_platform_env()
@@ -223,6 +231,7 @@ def run_child():
             first_solve = {"pods": pod_count, "s": round(warm_s, 4)}
 
         counters_before = dict(sup.counters)
+        cache_before = (solver.compile_cache_hits, solver.compile_cache_misses)
         samples, median, result = _measure(
             lambda: sup.solve(pods, its, [tpl]), reps
         )
@@ -267,6 +276,25 @@ def run_child():
         ev["validator_rejections"] = (
             sup.counters["validator_rejections"] - counters_before["validator_rejections"]
         )
+        # per-phase breakdown of the LAST measured rep (obs/trace.py spans:
+        # self time per phase, sums to the rep's wall clock) and the
+        # compile-cache hit rate across this shape's measured reps — where
+        # the 10k-pod seconds actually go, and whether they include compiles
+        from karpenter_tpu.obs import trace as obs_trace
+
+        last_trace = obs_trace.ring().last()
+        if last_trace is not None:
+            ev["trace_id"] = last_trace["trace_id"]
+            ev["phase_breakdown_s"] = {
+                k: round(v, 4) for k, v in last_trace["phases"].items()
+            }
+        cc_hits = solver.compile_cache_hits - cache_before[0]
+        cc_misses = solver.compile_cache_misses - cache_before[1]
+        ev["compile_cache"] = {
+            "hits": cc_hits,
+            "misses": cc_misses,
+            "hit_rate": round(cc_hits / max(cc_hits + cc_misses, 1), 4),
+        }
         emit(ev)
     if first_solve is not None:
         emit({"event": "first_solve", **first_solve})
@@ -568,6 +596,19 @@ def main():
             str(e["pods"]): e["retry_iterations"]
             for e in shapes
             if "retry_iterations" in e
+        }
+    # per-phase waterfall + compile-cache hit rate per shape (obs/trace.py):
+    # the decomposition that says whether a regression is encode, compile,
+    # device narrow time, or host decode
+    if any("phase_breakdown_s" in e for e in shapes):
+        out["per_shape_phase_breakdown_s"] = {
+            str(e["pods"]): e["phase_breakdown_s"]
+            for e in shapes
+            if "phase_breakdown_s" in e
+        }
+    if any("compile_cache" in e for e in shapes):
+        out["per_shape_compile_cache"] = {
+            str(e["pods"]): e["compile_cache"] for e in shapes if "compile_cache" in e
         }
     first = next((e for e in events if e.get("event") == "first_solve"), None)
     if first is not None:

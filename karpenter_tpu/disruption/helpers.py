@@ -153,7 +153,10 @@ def simulate_scheduling(
     if inputs is None:
         return None
     inputs.nodes = [n for n in inputs.nodes if n.name not in candidate_names]
-    with measure(SCHEDULING_SIMULATION_DURATION):
+    from karpenter_tpu.obs import trace
+
+    with measure(SCHEDULING_SIMULATION_DURATION), \
+            trace.cycle("disruption", candidates=len(candidates)):
         result = provisioner.solver.solve(
             inputs.pods,
             inputs.instance_types,

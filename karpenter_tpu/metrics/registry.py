@@ -30,6 +30,8 @@ def _labels_key(labels: Optional[Dict[str, str]]) -> LabelValues:
 
 
 class _Metric:
+    kind = "untyped"
+
     def __init__(self, name: str, help_: str = "", subsystem: str = ""):
         parts = [NAMESPACE]
         if subsystem:
@@ -41,6 +43,8 @@ class _Metric:
 
 
 class Counter(_Metric):
+    kind = "counter"
+
     def __init__(self, name: str, help_: str = "", subsystem: str = ""):
         super().__init__(name, help_, subsystem)
         self._values: Dict[LabelValues, float] = {}
@@ -61,6 +65,8 @@ class Counter(_Metric):
 
 
 class Gauge(_Metric):
+    kind = "gauge"
+
     def __init__(self, name: str, help_: str = "", subsystem: str = ""):
         super().__init__(name, help_, subsystem)
         self._values: Dict[LabelValues, float] = {}
@@ -86,6 +92,8 @@ class Gauge(_Metric):
 
 
 class Histogram(_Metric):
+    kind = "histogram"
+
     def __init__(
         self,
         name: str,
@@ -170,6 +178,12 @@ class Registry:
             out.extend(m.collect())
         return out
 
+    def describe(self) -> List[Tuple[str, str, str]]:
+        """(kind, name, help) for every registered metric, sample or not —
+        the exposition headers and tools/metrics_lint.py read this."""
+        with self._lock:
+            return [(m.kind, m.name, m.help) for m in self._metrics.values()]
+
 
 REGISTRY = Registry()
 
@@ -195,6 +209,20 @@ VALIDATOR_REJECTIONS = REGISTRY.counter(
 SOLVE_DEADLINE_EXCEEDED = REGISTRY.counter(
     "solve_deadline_exceeded_total",
     "Solves abandoned by the wall-clock watchdog",
+)
+
+# -- solve-cycle tracing series (obs/trace.py, solver/jax_backend.py) ---------
+SOLVER_PHASE_DURATION = REGISTRY.histogram(
+    "solver_phase_duration_seconds",
+    "Per-phase solve-cycle self time, by phase span name and backend",
+)
+COMPILE_CACHE = REGISTRY.counter(
+    "solver_compile_cache_total",
+    "Solver program-cache lookups, by result (hit, miss)",
+)
+TRANSFER_BYTES = REGISTRY.counter(
+    "solver_transfer_bytes_total",
+    "Host-device transfer bytes on the solve path, by direction (h2d, d2h)",
 )
 
 

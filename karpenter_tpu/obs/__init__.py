@@ -1,0 +1,3 @@
+"""Observability: solve-cycle tracing (phase spans, ring buffer, exporters)."""
+
+from karpenter_tpu.obs import trace  # noqa: F401

@@ -5,6 +5,8 @@ debug-event suppression maps to the events logger's level)."""
 from __future__ import annotations
 
 import logging
+import os
+import sys
 
 _LEVELS = {
     "debug": logging.DEBUG,
@@ -13,6 +15,41 @@ _LEVELS = {
     "warning": logging.WARNING,
     "error": logging.ERROR,
 }
+
+_xla_quieted = False
+
+
+def quiet_xla_warnings(logger=None, notify_stderr: bool = False) -> bool:
+    """Suppress XLA/TSL C++ warning spam (the per-process "machine feature
+    mismatch ... SIGILL" flag dump) by raising ``TF_CPP_MIN_LOG_LEVEL`` to
+    errors-only, replacing the multi-line dump with a one-line notice.
+
+    Must run BEFORE jax initializes its backend — the C++ logger reads the
+    env var once at load. Respects an operator override: a caller-set
+    ``TF_CPP_MIN_LOG_LEVEL`` or ``KARPENTER_TPU_XLA_VERBOSE=1`` keeps the
+    native verbosity. The value ``"1"`` is NOT treated as a caller preset:
+    ``import jax`` setdefaults it to 1, which is indistinguishable from an
+    explicit 1 — and 1 still passes the WARNING-level feature-mismatch dump.
+    Operators who want level 1 specifically have the VERBOSE flag. Returns
+    whether suppression is active."""
+    global _xla_quieted
+    if os.environ.get("KARPENTER_TPU_XLA_VERBOSE", "") == "1":
+        return False
+    preset = os.environ.get("TF_CPP_MIN_LOG_LEVEL")
+    if preset is not None and preset != "1":
+        return preset >= "2"
+    os.environ["TF_CPP_MIN_LOG_LEVEL"] = "2"  # 2 = warnings off, errors kept
+    if not _xla_quieted:
+        _xla_quieted = True
+        notice = (
+            "XLA C++ warnings suppressed (host ISA/feature notices included); "
+            "set KARPENTER_TPU_XLA_VERBOSE=1 to restore them"
+        )
+        if logger is not None:
+            logger.debug(notice)
+        elif notify_stderr:
+            sys.stderr.write(f"[karpenter-tpu] {notice}\n")
+    return True
 
 
 def configure(log_level: str = "info") -> logging.Logger:
@@ -27,4 +64,5 @@ def configure(log_level: str = "info") -> logging.Logger:
     logging.getLogger("karpenter_tpu.events").setLevel(
         logging.DEBUG if level == logging.DEBUG else logging.WARNING
     )
+    quiet_xla_warnings(logger=logger)
     return logger

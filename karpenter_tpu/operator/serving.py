@@ -29,13 +29,29 @@ def _series(name: str, labels, value) -> str:
 
 
 def render_prometheus() -> str:
-    lines = []
+    # HELP/TYPE headers come from describe() so every REGISTERED metric
+    # appears in the exposition even before its first sample — scrape configs
+    # and tools/metrics_lint.py see the full surface from process start.
+    samples: dict = {}
     for kind, name, labels, value in REGISTRY.collect():
-        if kind == "histogram":
-            lines.append(_series(name + "_count", labels, value["count"]))
-            lines.append(_series(name + "_sum", labels, value["sum"]))
-        else:
-            lines.append(_series(name, labels, value))
+        samples.setdefault(name, []).append((kind, labels, value))
+    lines = []
+    for kind, name, help_ in REGISTRY.describe():
+        lines.append(f"# HELP {name} {help_}")
+        lines.append(f"# TYPE {name} {kind}")
+        for _, labels, value in samples.pop(name, ()):
+            if kind == "histogram":
+                lines.append(_series(name + "_count", labels, value["count"]))
+                lines.append(_series(name + "_sum", labels, value["sum"]))
+            else:
+                lines.append(_series(name, labels, value))
+    for name, entries in samples.items():  # unregistered strays, if any
+        for kind, labels, value in entries:
+            if kind == "histogram":
+                lines.append(_series(name + "_count", labels, value["count"]))
+                lines.append(_series(name + "_sum", labels, value["sum"]))
+            else:
+                lines.append(_series(name, labels, value))
     return "\n".join(lines) + "\n"
 
 
@@ -66,11 +82,22 @@ class OperatorStatus:
         return True
 
     def statusz(self) -> dict:
+        from karpenter_tpu.obs import trace
+
         out = {"ready": self.ready()}
         if self.warmup_ready is not None:
             out["warmup_complete"] = bool(self.warmup_ready())
         if self.supervisor is not None:
             out["solver"] = self.supervisor.status()
+        captured = trace.ring().snapshot()
+        summary = {"enabled": trace.enabled(), "captured": len(captured)}
+        if captured:
+            last = captured[0]
+            summary["last"] = {
+                k: last.get(k)
+                for k in ("trace_id", "name", "backend", "duration_s", "phases")
+            }
+        out["traces"] = summary
         return out
 
 
@@ -98,6 +125,22 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path.startswith("/statusz"):
             payload = status.statusz() if status is not None else {"ready": True}
             body = (json.dumps(payload, indent=1, default=str) + "\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+        elif self.path.startswith("/debug/traces"):
+            from karpenter_tpu.obs import trace
+
+            captured = trace.ring().snapshot()  # most recent first
+            if "chrome" in self.path or "format=chrome" in self.path:
+                # Perfetto/chrome://tracing-loadable trace-event JSON
+                body = (trace.chrome_trace_json(captured, indent=1) + "\n").encode()
+            else:
+                payload = {
+                    "enabled": trace.enabled(),
+                    "captured": len(captured),
+                    "traces": captured,
+                }
+                body = (json.dumps(payload, indent=1, default=str) + "\n").encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
         else:

@@ -439,7 +439,10 @@ class Provisioner:
         inputs = self.build_inputs(pods)
         if inputs is None:
             return SolveResult(failures={i: "no nodepools" for i in range(len(pods))}), None
-        with measure(SCHEDULING_DURATION), measure(SCHEDULING_SIMULATION_DURATION):
+        from karpenter_tpu.obs import trace
+
+        with measure(SCHEDULING_DURATION), measure(SCHEDULING_SIMULATION_DURATION), \
+                trace.cycle("provision", pods=len(pods)):
             result = self.solver.solve(
                 inputs.pods,
                 inputs.instance_types,

@@ -213,8 +213,13 @@ def dump_quarantine(
             directory,
             f"quarantine-{int(time.time())}-{os.getpid()}-{_quarantine_seq}.json",
         )
+        from karpenter_tpu.obs import trace
+
         payload = {
             "backend": backend,
+            # the solve cycle that produced this rejected result — grep the
+            # id across /debug/traces and logs to reconstruct the timeline
+            "trace_id": trace.current_trace_id(),
             "violations": [str(v) for v in violations],
             "new_claims": [
                 {

@@ -25,6 +25,8 @@ import numpy as np
 
 from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.apis.objects import Pod
+from karpenter_tpu.metrics.registry import COMPILE_CACHE, TRANSFER_BYTES
+from karpenter_tpu.obs import trace
 from karpenter_tpu.cloudprovider.types import InstanceType
 from karpenter_tpu.provisioning.preferences import Preferences
 from karpenter_tpu.provisioning.topology import Topology
@@ -103,6 +105,30 @@ class _SlotOverflow(Exception):
     pass
 
 
+# Program keys this process has dispatched at least once. jax.jit's executable
+# cache is process-global and keyed by abstract shapes, so (solve fn,
+# claim-slot bucket, padded leaf shapes/dtypes) is a faithful proxy: a key
+# seen before hits the jit cache (or the on-disk executable cache), a new key
+# pays a compile. Feeds karpenter_solver_compile_cache_total and the
+# compile|narrow span naming.
+_COMPILED_PROGRAMS: set = set()
+
+
+def _program_key(solve_fn, max_claims: int, problem) -> tuple:
+    return (
+        solve_fn.__name__,
+        int(max_claims),
+        tuple(
+            (tuple(leaf.shape), str(getattr(leaf, "dtype", type(leaf).__name__)))
+            for leaf in jax.tree_util.tree_leaves(problem)
+        ),
+    )
+
+
+def _nbytes(arrays) -> int:
+    return int(sum(getattr(a, "nbytes", 0) for a in jax.tree_util.tree_leaves(arrays)))
+
+
 def decode_claim_requirements(meta, adm_row, comp_row, gt_row, lt_row, defined_row):
     """Invert encode_reqs for one claim row: the narrowed requirement state
     the solve committed becomes the claim's Requirements — what the reference
@@ -178,6 +204,10 @@ class JaxSolver(SolverBackend):
         # recompile at the next claim bucket) — benches record it alongside
         # wall time to attribute escalation cost
         self.claim_escalations = 0
+        # lifetime program-cache lookups (see _program_key) — bench.py takes
+        # deltas per shape to report the compile-cache hit rate
+        self.compile_cache_hits = 0
+        self.compile_cache_misses = 0
 
     def solve(
         self,
@@ -205,7 +235,12 @@ class JaxSolver(SolverBackend):
         bound_executable_maps()
         t0 = _t("maps-guard", t0)
         max_claims = min(self.claim_slots, claim_axis_bucket(len(pods)))
-        with self._dispatch_device(len(pods), len(nodes)):
+        # passthrough: when the supervisor (or provisioner) already opened
+        # this cycle, phases land directly under its span; a direct backend
+        # call becomes its own cycle root
+        with trace.cycle(
+            "solve", backend=type(self).__name__, passthrough=True, pods=len(pods)
+        ), self._dispatch_device(len(pods), len(nodes)):
             while True:
                 try:
                     return self._solve_with_slots(
@@ -225,6 +260,8 @@ class JaxSolver(SolverBackend):
                     )
                     self.claim_slots = max(self.claim_slots, max_claims)
                     self.claim_escalations += 1
+                    with trace.span("escalate", max_claims=max_claims):
+                        pass
 
     @staticmethod
     def _dispatch_device(n_pods: int, n_nodes: int):
@@ -292,49 +329,55 @@ class JaxSolver(SolverBackend):
         queue = list(range(len(work)))
         while queue:
             t0 = _now()
-            encoded = encoder.encode(
-                [work[i] for i in queue],
-                instance_types,
-                templates,
-                nodes,
-                # the override pins label requirements for the whole solve —
-                # relaxation still runs its full ladder, but node-affinity
-                # steps can't change the pinned reqs (only topology-side
-                # effects like spread node-filters survive); the override's
-                # full universe seeds the frozen vocabulary
-                pod_reqs_override=(
-                    [pod_requirements_override[i] for i in queue]
-                    if pod_requirements_override is not None
-                    else None
-                ),
-                topology=topo,
-                num_claim_slots=max_claims,
-                vocab_pods=vocab_pods,
-                vocab_reqs=pod_requirements_override,
-                pod_volumes=(
-                    [pod_volumes[i] for i in queue]
-                    if pod_volumes is not None
-                    else None
-                ),
-            )
+            with trace.span("encode", queue=len(queue)):
+                encoded = encoder.encode(
+                    [work[i] for i in queue],
+                    instance_types,
+                    templates,
+                    nodes,
+                    # the override pins label requirements for the whole solve —
+                    # relaxation still runs its full ladder, but node-affinity
+                    # steps can't change the pinned reqs (only topology-side
+                    # effects like spread node-filters survive); the override's
+                    # full universe seeds the frozen vocabulary
+                    pod_reqs_override=(
+                        [pod_requirements_override[i] for i in queue]
+                        if pod_requirements_override is not None
+                        else None
+                    ),
+                    topology=topo,
+                    num_claim_slots=max_claims,
+                    vocab_pods=vocab_pods,
+                    vocab_reqs=pod_requirements_override,
+                    pod_volumes=(
+                        [pod_volumes[i] for i in queue]
+                        if pod_volumes is not None
+                        else None
+                    ),
+                )
             t0 = _t(f"encode q={len(queue)}", t0)
-            # each pass pads to its own queue's pow2 bucket: a retry pass over
-            # the failed minority scans far fewer steps than the full batch,
-            # at the cost of at most log2(P) cached compiles per shape family
-            problem, meta = pad_problem(encoded.problem), encoded.meta
-            t0 = _t("pad", t0)
-            group_keys = [
-                tg.hash_key()
-                for tg in list(topo.topologies.values())
-                + list(topo.inverse_topologies.values())
-            ]
-            if state is not None and group_keys != prev_group_keys:
-                # relaxation changed the group set (e.g. a dropped OR term
-                # produced a new spread node-filter): remap carried rows to
-                # the new group order; brand-new groups start from the fresh
-                # census, exactly like the reference's countDomains on Update
-                state = _remap_group_state(state, prev_group_keys, group_keys, problem)
-            prev_group_keys = group_keys
+            with trace.span("bucket", max_claims=max_claims):
+                # each pass pads to its own queue's pow2 bucket: a retry pass
+                # over the failed minority scans far fewer steps than the full
+                # batch, at the cost of at most log2(P) cached compiles per
+                # shape family
+                problem, meta = pad_problem(encoded.problem), encoded.meta
+                t0 = _t("pad", t0)
+                group_keys = [
+                    tg.hash_key()
+                    for tg in list(topo.topologies.values())
+                    + list(topo.inverse_topologies.values())
+                ]
+                if state is not None and group_keys != prev_group_keys:
+                    # relaxation changed the group set (e.g. a dropped OR term
+                    # produced a new spread node-filter): remap carried rows to
+                    # the new group order; brand-new groups start from the
+                    # fresh census, exactly like the reference's countDomains
+                    # on Update
+                    state = _remap_group_state(
+                        state, prev_group_keys, group_keys, problem
+                    )
+                prev_group_keys = group_keys
             t0 = _t("group-remap", t0)
             if _USE_RUNS:
                 solve = solve_ffd_runs
@@ -342,67 +385,101 @@ class JaxSolver(SolverBackend):
                 solve = solve_ffd_sweeps
             else:
                 solve = solve_ffd
-            result = solve(problem, max_claims, init=state)
-            state = result.state
-            # one batched fetch: device_get issues async copies for all
-            # buffers before waiting, so the pass pays a single runtime
-            # roundtrip instead of one per array. The sweeps fast path always
-            # exits after this pass, so the final-decode state rides the same
-            # roundtrip.
-            if use_sweeps:
-                kinds, indices, _iters, _whist, *np_final = jax.device_get(
-                    (
-                        result.kind,
-                        result.index,
-                        result.iters,
-                        result.wave_hist,
-                        state.claim_open,
-                        state.claim_tpl,
-                        state.claim_it_ok,
-                        state.claim_requests,
-                        state.claim_req.admitted,
-                        state.claim_req.comp,
-                        state.claim_req.gt,
-                        state.claim_req.lt,
-                        state.claim_req.defined,
-                    )
-                )
-                # the device-cost diagnostic (rides the same roundtrip):
-                # IterCounts named fields, still tuple-compatible
-                self.last_iters = IterCounts(*(int(x) for x in _iters))
-                # i32[W+1] wavefront-width histogram; None when the
-                # wavefront is off (flag-off keeps the program unchanged)
-                self.last_wave_hist = (
-                    [int(x) for x in _whist] if _whist is not None else None
-                )
+            # compile-cache accounting: a program key this process has not
+            # dispatched yet pays a compile (or an on-disk cache load), so the
+            # device span is named "compile" for it; repeat keys are pure
+            # execution and span as the solver mode ("sweeps"/"narrow").
+            key = _program_key(solve, max_claims, problem)
+            cache_hit = key in _COMPILED_PROGRAMS
+            _COMPILED_PROGRAMS.add(key)
+            COMPILE_CACHE.inc({"result": "hit" if cache_hit else "miss"})
+            if cache_hit:
+                self.compile_cache_hits += 1
+                span_name = "sweeps" if use_sweeps else "narrow"
             else:
-                kinds, indices = jax.device_get((result.kind, result.index))
-                np_final = None
-                self.last_iters = None
-                self.last_wave_hist = None
+                self.compile_cache_misses += 1
+                span_name = "compile"
+            h2d = _nbytes(problem) + (_nbytes(state) if state is not None else 0)
+            TRANSFER_BYTES.inc({"direction": "h2d"}, h2d)
+            with trace.span(
+                span_name,
+                cache="hit" if cache_hit else "miss",
+                program=solve.__name__,
+            ) as sp:
+                result = solve(problem, max_claims, init=state)
+                state = result.state
+                # one batched fetch: device_get issues async copies for all
+                # buffers before waiting, so the pass pays a single runtime
+                # roundtrip instead of one per array. The sweeps fast path
+                # always exits after this pass, so the final-decode state
+                # rides the same roundtrip.
+                if use_sweeps:
+                    fetched = jax.device_get(
+                        (
+                            result.kind,
+                            result.index,
+                            result.iters,
+                            result.wave_hist,
+                            state.claim_open,
+                            state.claim_tpl,
+                            state.claim_it_ok,
+                            state.claim_requests,
+                            state.claim_req.admitted,
+                            state.claim_req.comp,
+                            state.claim_req.gt,
+                            state.claim_req.lt,
+                            state.claim_req.defined,
+                        )
+                    )
+                    kinds, indices, _iters, _whist, *np_final = fetched
+                    # the device-cost diagnostic (rides the same roundtrip):
+                    # IterCounts named fields, still tuple-compatible
+                    self.last_iters = IterCounts(*(int(x) for x in _iters))
+                    # i32[W+1] wavefront-width histogram; None when the
+                    # wavefront is off (flag-off keeps the program unchanged)
+                    self.last_wave_hist = (
+                        [int(x) for x in _whist] if _whist is not None else None
+                    )
+                else:
+                    fetched = jax.device_get((result.kind, result.index))
+                    kinds, indices = fetched
+                    np_final = None
+                    self.last_iters = None
+                    self.last_wave_hist = None
+                d2h = _nbytes(fetched)
+                TRANSFER_BYTES.inc({"direction": "d2h"}, d2h)
+                if sp is not None:
+                    sp.count("h2d_bytes", h2d)
+                    sp.count("d2h_bytes", d2h)
+                    if self.last_iters is not None:
+                        for field, value in zip(
+                            IterCounts._fields, self.last_iters
+                        ):
+                            sp.count(field, value)
             t0 = _t("device-solve", t0)
             if (kinds[: len(queue)] == KIND_NO_SLOT).any():
                 raise _SlotOverflow()
 
-            failed = []
-            progress = False
-            for row in range(len(meta.pod_order)):
-                orig = queue[meta.pod_order[row]]
-                kind, index = int(kinds[row]), int(indices[row])
-                if kind in (KIND_NODE, KIND_CLAIM, KIND_NEW_CLAIM):
-                    pod_kinds[orig] = (kind, index)
-                    progress = True
-                else:
-                    failed.append(orig)
-            relaxed_any = False
-            if not use_sweeps:  # sweeps imply nothing is relaxable
-                for orig in failed:
-                    if orig not in copied:
-                        work[orig] = copy.deepcopy(work[orig])
-                        copied.add(orig)
-                    if prefs.relax(work[orig]) is not None:
-                        relaxed_any = True
-                        topo.update(work[orig])
+            with trace.span("decode"):
+                failed = []
+                progress = False
+                for row in range(len(meta.pod_order)):
+                    orig = queue[meta.pod_order[row]]
+                    kind, index = int(kinds[row]), int(indices[row])
+                    if kind in (KIND_NODE, KIND_CLAIM, KIND_NEW_CLAIM):
+                        pod_kinds[orig] = (kind, index)
+                        progress = True
+                    else:
+                        failed.append(orig)
+                relaxed_any = False
+                if not use_sweeps:  # sweeps imply nothing is relaxable
+                    for orig in failed:
+                        if orig not in copied:
+                            work[orig] = copy.deepcopy(work[orig])
+                            copied.add(orig)
+                        if prefs.relax(work[orig]) is not None:
+                            relaxed_any = True
+                            topo.update(work[orig])
             t0 = _t("decode+relax", t0)
             if use_sweeps or (not progress and not relaxed_any):
                 # terminal failures: reconstruct the reference's per-template
@@ -427,48 +504,51 @@ class JaxSolver(SolverBackend):
 
         # -- decode final bin state (single batched fetch, see device_get note)
         t_dec = _now()
-        if state is not None and np_final is not None:
-            (claim_open, claim_tpl, claim_it_ok, claim_requests,
-             claim_adm, claim_comp, claim_gt, claim_lt, claim_def) = np_final
-        elif state is not None:
-            (claim_open, claim_tpl, claim_it_ok, claim_requests,
-             claim_adm, claim_comp, claim_gt, claim_lt, claim_def) = jax.device_get(
-                (state.claim_open, state.claim_tpl, state.claim_it_ok,
-                 state.claim_requests, state.claim_req.admitted,
-                 state.claim_req.comp, state.claim_req.gt,
-                 state.claim_req.lt, state.claim_req.defined)
-            )
-        else:
-            claim_open, claim_tpl, claim_it_ok, claim_requests = np.zeros(0), None, None, None
-            claim_adm = claim_comp = claim_gt = claim_lt = claim_def = None
-        slot_to_claim = {}
-        for slot in range(max_claims):
-            if slot < len(claim_open) and claim_open[slot]:
-                tpl_idx = int(claim_tpl[slot])
-                placement = Placement(
-                    template_index=tpl_idx,
-                    nodepool_name=meta.template_names[tpl_idx],
-                    instance_type_indices=[
-                        int(t)
-                        for t in np.flatnonzero(claim_it_ok[slot])
-                        if t < len(meta.instance_type_names)
-                    ],
-                    requirements=decode_claim_requirements(
-                        meta, claim_adm[slot], claim_comp[slot],
-                        claim_gt[slot], claim_lt[slot], claim_def[slot],
-                    ),
-                    requests={
-                        name: float(claim_requests[slot, ri])
-                        for ri, name in enumerate(meta.resource_names)
-                        if claim_requests[slot, ri] > 0
-                    },
+        with trace.span("decode", final=True):
+            if state is not None and np_final is not None:
+                (claim_open, claim_tpl, claim_it_ok, claim_requests,
+                 claim_adm, claim_comp, claim_gt, claim_lt, claim_def) = np_final
+            elif state is not None:
+                fetched = jax.device_get(
+                    (state.claim_open, state.claim_tpl, state.claim_it_ok,
+                     state.claim_requests, state.claim_req.admitted,
+                     state.claim_req.comp, state.claim_req.gt,
+                     state.claim_req.lt, state.claim_req.defined)
                 )
-                slot_to_claim[slot] = placement
-                out.new_claims.append(placement)
-        for orig, (kind, index) in pod_kinds.items():
-            if kind == KIND_NODE:
-                out.node_pods.setdefault(meta.node_names[index], []).append(orig)
+                TRANSFER_BYTES.inc({"direction": "d2h"}, _nbytes(fetched))
+                (claim_open, claim_tpl, claim_it_ok, claim_requests,
+                 claim_adm, claim_comp, claim_gt, claim_lt, claim_def) = fetched
             else:
-                slot_to_claim[index].pod_indices.append(orig)
+                claim_open, claim_tpl, claim_it_ok, claim_requests = np.zeros(0), None, None, None
+                claim_adm = claim_comp = claim_gt = claim_lt = claim_def = None
+            slot_to_claim = {}
+            for slot in range(max_claims):
+                if slot < len(claim_open) and claim_open[slot]:
+                    tpl_idx = int(claim_tpl[slot])
+                    placement = Placement(
+                        template_index=tpl_idx,
+                        nodepool_name=meta.template_names[tpl_idx],
+                        instance_type_indices=[
+                            int(t)
+                            for t in np.flatnonzero(claim_it_ok[slot])
+                            if t < len(meta.instance_type_names)
+                        ],
+                        requirements=decode_claim_requirements(
+                            meta, claim_adm[slot], claim_comp[slot],
+                            claim_gt[slot], claim_lt[slot], claim_def[slot],
+                        ),
+                        requests={
+                            name: float(claim_requests[slot, ri])
+                            for ri, name in enumerate(meta.resource_names)
+                            if claim_requests[slot, ri] > 0
+                        },
+                    )
+                    slot_to_claim[slot] = placement
+                    out.new_claims.append(placement)
+            for orig, (kind, index) in pod_kinds.items():
+                if kind == KIND_NODE:
+                    out.node_pods.setdefault(meta.node_names[index], []).append(orig)
+                else:
+                    slot_to_claim[index].pod_indices.append(orig)
         _t("final-decode", t_dec)
         return out

@@ -39,6 +39,7 @@ removes even that).
 
 from __future__ import annotations
 
+import contextvars
 import logging
 import os
 import threading
@@ -53,6 +54,7 @@ from karpenter_tpu.metrics.registry import (
     SOLVER_RETRIES,
     VALIDATOR_REJECTIONS,
 )
+from karpenter_tpu.obs import trace
 from karpenter_tpu.solver import validator as val
 from karpenter_tpu.solver.backend import SolveResult, SolverBackend
 from karpenter_tpu.testing import faults
@@ -271,6 +273,12 @@ class SupervisedSolver(SolverBackend):
             pod_volumes=pod_volumes,
         )
         self._solve_seq += 1
+        with trace.cycle(
+            "solve", backend=type(self.primary).__name__, pods=len(pods)
+        ):
+            return self._solve_supervised(pods, instance_types, templates, kwargs)
+
+    def _solve_supervised(self, pods, instance_types, templates, kwargs) -> SolveResult:
         route = self._route()
         failure_class = None
         if route == "primary":
@@ -285,14 +293,26 @@ class SupervisedSolver(SolverBackend):
             to_name = type(self.fallback).__name__
             SOLVER_FALLBACK.inc({"from": from_name, "to": to_name})
             self.counters["solve_fallbacks"] += 1
-            try:
-                result = self.fallback.solve(pods, instance_types, templates, **kwargs)
-            except Exception:
-                log.exception("fallback backend failed; salvaging the cycle")
-                return self._salvage(pods, failure_class or "fallback-error")
-            violations = self._validate(
-                result, pods, instance_types, templates, kwargs
+            log.warning(
+                "solve falling back %s -> %s (class=%s, trace=%s)",
+                from_name, to_name, failure_class or "circuit-open",
+                trace.current_trace_id(),
             )
+            with trace.span(
+                "fallback",
+                **{"from": from_name, "to": to_name,
+                   "class": failure_class or "circuit-open"},
+            ):
+                try:
+                    result = self.fallback.solve(
+                        pods, instance_types, templates, **kwargs
+                    )
+                except Exception:
+                    log.exception("fallback backend failed; salvaging the cycle")
+                    return self._salvage(pods, failure_class or "fallback-error")
+                violations = self._validate(
+                    result, pods, instance_types, templates, kwargs
+                )
             if violations:
                 # both backends disagree with the invariants: keep what
                 # verified, requeue the rest
@@ -317,13 +337,19 @@ class SupervisedSolver(SolverBackend):
                     "class": failure_class,
                     "error": f"{type(exc).__name__}: {exc}",
                 }
+                trace_id = trace.current_trace_id()
+                if trace_id:
+                    self.last_failure["trace_id"] = trace_id
                 if failure_class == CLASS_DEADLINE:
                     SOLVE_DEADLINE_EXCEEDED.inc()
                     self.counters["deadline_exceeded"] += 1
                 if failure_class in RETRYABLE and attempt + 1 < attempts:
                     SOLVER_RETRIES.inc({"class": failure_class})
                     self.counters["solve_retries"] += 1
-                    self._sleep(self._backoff(attempt))
+                    with trace.span(
+                        "retry", **{"class": failure_class, "attempt": attempt + 1}
+                    ):
+                        self._sleep(self._backoff(attempt))
                     continue
                 log.warning(
                     "primary solve failed (class=%s, attempt %d/%d): %s",
@@ -340,6 +366,9 @@ class SupervisedSolver(SolverBackend):
                     "class": CLASS_VALIDATION,
                     "error": "; ".join(str(v) for v in violations[:4]),
                 }
+                trace_id = trace.current_trace_id()
+                if trace_id:
+                    self.last_failure["trace_id"] = trace_id
                 self._quarantine(
                     result, violations, backend=type(self.primary).__name__
                 )
@@ -386,10 +415,14 @@ class SupervisedSolver(SolverBackend):
             return fn()
         box: Dict[str, object] = {}
         done = threading.Event()
+        # The worker inherits the caller's contextvars (copy_context) so the
+        # active trace/span propagate into it and the backend's phase spans
+        # land in the right tree.
+        ctx = contextvars.copy_context()
 
         def run():
             try:
-                box["result"] = fn()
+                box["result"] = ctx.run(fn)
             except BaseException as exc:  # propagate to the waiting thread
                 box["error"] = exc
             finally:
@@ -465,5 +498,6 @@ class SupervisedSolver(SolverBackend):
         every pod — FailedScheduling events fire and the next cycle retries,
         instead of the controllers seeing an exception and dropping the batch."""
         self._record_salvage()
-        reason = self._requeue_reason(failure_class)
-        return SolveResult(failures={i: reason for i in range(len(pods))})
+        with trace.span("salvage", **{"class": failure_class}):
+            reason = self._requeue_reason(failure_class)
+            return SolveResult(failures={i: reason for i in range(len(pods))})

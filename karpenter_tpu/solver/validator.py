@@ -144,6 +144,29 @@ def validate_result(
     domains: Optional[Dict[str, set]] = None,
     level: str = "fast",
 ) -> List[Violation]:
+    from karpenter_tpu.obs import trace
+
+    with trace.span("validate", level=level) as sp:
+        violations = _validate_result(
+            result, pods, instance_types, templates, nodes,
+            pod_requirements_override, cluster_pods, domains, level,
+        )
+        if sp is not None and violations:
+            sp.count("violations", len(violations))
+        return violations
+
+
+def _validate_result(
+    result: SolveResult,
+    pods: Sequence[Pod],
+    instance_types: Sequence[InstanceType],
+    templates: Sequence[TemplateInfo],
+    nodes: Sequence[NodeInfo] = (),
+    pod_requirements_override: Optional[Sequence[Requirements]] = None,
+    cluster_pods: Sequence = (),
+    domains: Optional[Dict[str, set]] = None,
+    level: str = "fast",
+) -> List[Violation]:
     violations: List[Violation] = []
     node_by_name = {n.name: n for n in nodes}
 

@@ -85,26 +85,29 @@ def prewarm_claim_buckets(
     )
     rng = random.Random(1)
     warmed = 0
-    for c in claim_ladder(max_claims):
-        pods = [
-            Pod(
-                metadata=ObjectMeta(name=f"warm-claims-{c}-{i}"),
-                spec=PodSpec(
-                    containers=[
-                        Container(requests={"cpu": rng.choice([0.1, 0.5, 1.0])})
-                    ]
-                ),
-            )
-            for i in range(c)
-        ]
-        try:
-            # the ladder ascends, so pinning claim_slots selects bucket c
-            # exactly (the backend caps at claim_axis_bucket(len(pods)) == c)
-            solver.claim_slots = c
-            solver.solve(pods, its, [tpl])
-            warmed += 1
-        except Exception:
-            return warmed
+    from karpenter_tpu.obs import trace
+
+    with trace.cycle("warmup", kind="claim-ladder", max_claims=max_claims):
+        for c in claim_ladder(max_claims):
+            pods = [
+                Pod(
+                    metadata=ObjectMeta(name=f"warm-claims-{c}-{i}"),
+                    spec=PodSpec(
+                        containers=[
+                            Container(requests={"cpu": rng.choice([0.1, 0.5, 1.0])})
+                        ]
+                    ),
+                )
+                for i in range(c)
+            ]
+            try:
+                # the ladder ascends, so pinning claim_slots selects bucket c
+                # exactly (the backend caps at claim_axis_bucket(len(pods)) == c)
+                solver.claim_slots = c
+                solver.solve(pods, its, [tpl])
+                warmed += 1
+            except Exception:
+                return warmed
     return warmed
 
 
@@ -186,19 +189,22 @@ def prewarm_solver(
     buckets = list(pod_buckets)
     warmed_shapes = {pod_axis_bucket(b) for b in buckets}
     ladder = [b for b in bucket_ladder(max_pods) if b not in warmed_shapes]
-    for n in buckets:
-        for topo in (False, True):
+    from karpenter_tpu.obs import trace
+
+    with trace.cycle("warmup", kind="solver", max_pods=max_pods):
+        for n in buckets:
+            for topo in (False, True):
+                try:
+                    solver.solve(make(n, topo), its, [tpl])
+                    solved += 1
+                except Exception:
+                    return solved
+        for n in ladder:
             try:
-                solver.solve(make(n, topo), its, [tpl])
+                solver.solve(make(n, True), its, [tpl])
                 solved += 1
             except Exception:
                 return solved
-    for n in ladder:
-        try:
-            solver.solve(make(n, True), its, [tpl])
-            solved += 1
-        except Exception:
-            return solved
     return solved
 
 
@@ -209,17 +215,19 @@ def prewarm_screen(n_candidates: int) -> bool:
     a reconcile pass will request). Synthetic-shape caveat as in
     prewarm_solver."""
     from karpenter_tpu.disruption.batch import bench_candidate_scoring
+    from karpenter_tpu.obs import trace
     from karpenter_tpu.ops.padding import quarter_bucket
 
     try:
-        n = 8
-        while n <= n_candidates:
-            b = quarter_bucket(n)
-            # mesh="auto" matches production score_subsets: on multi-device
-            # hosts the sharded program (and its device-rounded B) is the
-            # executable a reconcile pass will actually request
-            bench_candidate_scoring(b, mesh="auto")
-            n = b + 1
+        with trace.cycle("warmup", kind="screen", candidates=n_candidates):
+            n = 8
+            while n <= n_candidates:
+                b = quarter_bucket(n)
+                # mesh="auto" matches production score_subsets: on multi-device
+                # hosts the sharded program (and its device-rounded B) is the
+                # executable a reconcile pass will actually request
+                bench_candidate_scoring(b, mesh="auto")
+                n = b + 1
         return True
     except Exception:
         return False

@@ -92,6 +92,20 @@ class TestWavefrontBudget:
         moves, the flag-off program changed and the A/B arm is broken."""
         assert narrow_jaxpr_eqns(census_problem, wavefront=0) == 2394
 
+    def test_tracing_on_adds_zero_equations(self, census_problem):
+        """Solve-cycle tracing (obs/trace.py) is host-side Python only: with
+        KARPENTER_TPU_TRACE forced on, the flag-off narrow body must count
+        EXACTLY the same 2394 equations — zero tracing ops may leak into the
+        traced jaxpr (the 'zero overhead when off, bit-identical when on'
+        contract in docs/OBSERVABILITY.md)."""
+        from karpenter_tpu.obs import trace
+
+        trace.set_enabled(True)
+        try:
+            assert narrow_jaxpr_eqns(census_problem, wavefront=0) == 2394
+        finally:
+            trace.set_enabled(None)
+
     def test_wavefront_body_under_budget(self, census_problem):
         eqns = narrow_jaxpr_eqns(census_problem, wavefront=3)
         assert eqns <= WAVEFRONT_EQN_BUDGET, (

@@ -1,0 +1,10 @@
+"""Tier-1 wiring for tools/metrics_lint.py: every registered metric must be
+documented (docs/*.md or README.md) and present in the /metrics exposition."""
+
+from __future__ import annotations
+
+
+def test_every_registered_metric_is_documented_and_exposed():
+    from tools.metrics_lint import run
+
+    assert run() == []
