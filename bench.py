@@ -377,6 +377,109 @@ def run_child():
             )
     except ImportError:
         pass
+
+    # streaming churn scenario (streaming/): drive the warm/delta path with a
+    # seeded arrival+delete stream at <=5% churn per cycle, then replay the
+    # byte-identical stream (same ChurnConfig seed) through full cold
+    # re-solves. Host-side on the oracle backend by design: the streaming win
+    # is re-placing only churned pods, and keeping device compile noise out
+    # isolates that factor. Corpus is generic (no topology constraints) —
+    # topology-constrained pods conservatively reseed on every churn cycle
+    # (streaming/warm.py), which is a correctness choice, not a latency one.
+    try:
+        import statistics as _stats
+
+        from karpenter_tpu.solver.encode import Encoder
+        from karpenter_tpu.solver.oracle import OracleSolver
+        from karpenter_tpu.streaming import DeltaEncoder, StreamingSolver
+        from karpenter_tpu.streaming.churn import (
+            ChurnConfig,
+            ChurnProcess,
+            default_pod_factory,
+            run_churn,
+        )
+
+        churn_pods = 150 if os.environ.get("BENCH_QUICK") else 400
+        churn_cycles = 10 if os.environ.get("BENCH_QUICK") else 30
+        crng = random.Random(7)
+        initial = [default_pod_factory(f"base-{i}", crng) for i in range(churn_pods)]
+        # arrivals+deletes = 5% of the standing batch per cycle
+        cfg = ChurnConfig(
+            seed=7,
+            arrivals_per_cycle=churn_pods // 40,
+            deletes_per_cycle=churn_pods // 40,
+        )
+        streaming = StreamingSolver(OracleSolver())
+        warm_recs = run_churn(
+            streaming, ChurnProcess(list(initial), config=cfg), its, [tpl],
+            churn_cycles,
+        )
+        cold_recs = run_churn(
+            OracleSolver(), ChurnProcess(list(initial), config=cfg), its, [tpl],
+            churn_cycles,
+        )
+        cold_by_cycle = {r["cycle"]: r for r in cold_recs}
+        warm_s = sorted(
+            r["seconds"] for r in warm_recs if r.get("outcome") == "warm"
+        )
+        paired_cold_s = sorted(
+            cold_by_cycle[r["cycle"]]["seconds"]
+            for r in warm_recs
+            if r.get("outcome") == "warm"
+        )
+        ev = {
+            "event": "churn",
+            "pods": churn_pods,
+            "cycles": churn_cycles,
+            "churn_frac": round(
+                (cfg.arrivals_per_cycle + cfg.deletes_per_cycle) / churn_pods, 4
+            ),
+            "outcomes": dict(streaming.counters),
+            "scheduled_last": warm_recs[-1]["scheduled"],
+        }
+        if warm_s:
+            p50 = _stats.median(warm_s)
+            p99 = warm_s[min(len(warm_s) - 1, int(0.99 * len(warm_s)))]
+            cold_p50 = _stats.median(paired_cold_s)
+            ev["delta_solve_p50_s"] = round(p50, 4)
+            ev["delta_solve_p99_s"] = round(p99, 4)
+            ev["cold_solve_p50_s"] = round(cold_p50, 4)
+            ev["warm_vs_cold_speedup"] = round(cold_p50 / max(p50, 1e-9), 1)
+            ev["sustained_pods_per_s"] = round(
+                sum(r["pods"] for r in warm_recs)
+                / max(sum(r["seconds"] for r in warm_recs), 1e-9),
+                1,
+            )
+            ev["reuse_ratio_mean"] = round(
+                _stats.mean(
+                    r["reuse_ratio"] for r in warm_recs if r.get("outcome") == "warm"
+                ),
+                4,
+            )
+        # delta-encode micro: patched DeltaEncoder.encode vs a cold
+        # Encoder.encode of the same snapshot, a few cycles deep
+        denc = DeltaEncoder()
+        proc = ChurnProcess(list(initial), config=cfg)
+        patched_s, cold_enc_s = [], []
+        for i in range(8):
+            proc.step()
+            t0 = time.perf_counter()
+            denc.encode(proc.pods, its, [tpl])
+            dt = time.perf_counter() - t0
+            if denc.last_patch.get("mode") == "patched":
+                patched_s.append(dt)
+            t0 = time.perf_counter()
+            Encoder().encode(proc.pods, its, [tpl])
+            cold_enc_s.append(time.perf_counter() - t0)
+        if patched_s:
+            ev["delta_encode_p50_s"] = round(_stats.median(patched_s), 4)
+            ev["full_encode_p50_s"] = round(_stats.median(cold_enc_s), 4)
+            ev["delta_encode_speedup"] = round(
+                _stats.median(cold_enc_s) / max(_stats.median(patched_s), 1e-9), 1
+            )
+        emit(ev)
+    except Exception as exc:  # a broken scenario must not kill the grid run
+        emit({"event": "churn", "error": repr(exc)})
     emit({"event": "done"})
 
 
@@ -636,6 +739,18 @@ def main():
             }
             for e in consol
         }
+    churn = next((e for e in events if e.get("event") == "churn"), None)
+    if churn is not None and "error" not in churn:
+        # streaming-under-churn numbers (streaming/, docs/SERVING.md): warm
+        # delta-solve latency vs cold re-solves of the same snapshots
+        out["churn_sustained_pods_per_s"] = churn.get("sustained_pods_per_s")
+        out["churn_delta_solve_p50_s"] = churn.get("delta_solve_p50_s")
+        out["churn_delta_solve_p99_s"] = churn.get("delta_solve_p99_s")
+        out["churn_warm_vs_cold_speedup"] = churn.get("warm_vs_cold_speedup")
+        out["churn_reuse_ratio_mean"] = churn.get("reuse_ratio_mean")
+        out["churn_outcomes"] = churn.get("outcomes")
+        if "delta_encode_speedup" in churn:
+            out["churn_delta_encode_speedup"] = churn["delta_encode_speedup"]
     if scheduled_frac < 0.95:
         # a solver that drops pods must not read as a throughput win
         # (reference asserts full schedulability of the diverse mix)

@@ -225,6 +225,18 @@ TRANSFER_BYTES = REGISTRY.counter(
     "Host-device transfer bytes on the solve path, by direction (h2d, d2h)",
 )
 
+# -- streaming solve series (streaming/warm.py, streaming/delta.py) -----------
+DELTA_REUSE_RATIO = REGISTRY.gauge(
+    "solver_delta_reuse_ratio",
+    "Fraction of the batch pinned to its previous placement by the last "
+    "streaming solve cycle (0 on a cold cycle)",
+)
+WARM_SOLVES = REGISTRY.counter(
+    "solver_warm_solves_total",
+    "Streaming solve cycles, by outcome (warm, warm-rejected, warm-error, "
+    "cold-first, cold-threshold, cold-unsupported, cold-world-changed)",
+)
+
 
 @contextmanager
 def measure(histogram: Histogram, labels: Optional[Dict[str, str]] = None):

@@ -212,6 +212,324 @@ class _Vocab:
                 self.value(key, v)
 
 
+def build_vocab(
+    vocab_pods: Sequence[Pod],
+    templates: Sequence[TemplateInfo],
+    nodes: Sequence[NodeInfo],
+    groups: Sequence,
+    claim_hostnames: Sequence[str],
+    instance_types: Sequence[InstanceType],
+    override_reqs_list: Optional[Sequence[Requirements]] = None,
+    vocab_reqs: Optional[Sequence[Requirements]] = None,
+) -> _Vocab:
+    """The full vocabulary build, in the exact insertion order the encoder
+    commits to. Module-level so the streaming delta encoder
+    (streaming/delta.py) can rebuild and compare vocabularies against its
+    cached encode without re-running the expensive tensor sections — lane
+    numbering is insertion-ordered, so any shared-code drift here would
+    silently break patched-vs-cold bit parity."""
+    vocab = _Vocab()
+    # zone / capacity-type / hostname keys always exist at pinned indices
+    # (offering checks + claim hostname minting index them statically)
+    zone_k = vocab.key(wk.LABEL_TOPOLOGY_ZONE)
+    ct_k = vocab.key(wk.CAPACITY_TYPE_LABEL_KEY)
+    hostname_k = vocab.key(wk.LABEL_HOSTNAME)
+    if (zone_k, ct_k, hostname_k) != (ZONE_KEY, CT_KEY, HOSTNAME_KEY):
+        # device kernels index these statically; survive python -O
+        raise AssertionError(
+            f"pinned vocab keys moved: {(zone_k, ct_k, hostname_k)}"
+        )
+    for p in vocab_pods:
+        # seed EVERY affinity term, not just the active one: relaxation
+        # can surface later OR terms / lighter preferences in later
+        # passes, and the frozen vocabulary must already cover them
+        vocab.add_requirements(label_requirements(p.spec.node_selector))
+        aff = p.spec.affinity.node_affinity if p.spec.affinity else None
+        if aff is not None:
+            for term in aff.required:
+                vocab.add_requirements(
+                    Requirements.from_node_selector_requirements(*term.match_expressions)
+                )
+            for pref in aff.preferred:
+                vocab.add_requirements(
+                    Requirements.from_node_selector_requirements(
+                        *pref.preference.match_expressions
+                    )
+                )
+    # vocab_reqs (stable, full-universe order) must seed BEFORE the
+    # per-pass pod_reqs_list, whose FFD-queue order varies across relax
+    # passes — otherwise override-only keys/values get different lane
+    # indices per pass and carried solver state misreads them
+    if vocab_reqs is not None:
+        for reqs in vocab_reqs:
+            vocab.add_requirements(reqs)
+    if override_reqs_list is not None:
+        for reqs in override_reqs_list:
+            vocab.add_requirements(reqs)
+    for t in templates:
+        vocab.add_requirements(t.requirements)
+    for n in nodes:
+        vocab.add_requirements(n.requirements)
+    # topology domains + node-filter terms + claim hostname placeholders
+    for tg in groups:
+        vocab.key(tg.key)
+        for domain in tg.domains:
+            vocab.value(tg.key, domain)
+        for term in tg.node_filter.terms:
+            vocab.add_requirements(term)
+    for h in claim_hostnames:
+        vocab.value(wk.LABEL_HOSTNAME, h)
+    # instance types go LAST and never create keys (active-key compaction:
+    # see add_values_for_active_keys) — the key set above is exactly what
+    # left-side states can ever define, so compat on any other key is
+    # statically true and the lanes would be dead weight in the hot
+    # [bins x instance-types] product
+    for it in instance_types:
+        vocab.add_values_for_active_keys(it.requirements)
+        for o in it.offerings:
+            vocab.value(wk.LABEL_TOPOLOGY_ZONE, o.zone)
+            vocab.value(wk.CAPACITY_TYPE_LABEL_KEY, o.capacity_type)
+    return vocab
+
+
+def _reqs_digest(reqs: Requirements):
+    """Canonical hashable form of a Requirements object — the encode fold
+    is a pure function of it, so identical-class entities (duplicated pods,
+    repeated templates) share one fold."""
+    return tuple(
+        sorted(
+            (
+                key,
+                r.complement,
+                frozenset(r.values),
+                r.greater_than,
+                r.less_than,
+            )
+            for key, r in ((k, reqs.get(k)) for k in reqs)
+        )
+    )
+
+
+def encode_reqs_with_vocab(
+    entities: Sequence[Requirements], vocab: _Vocab, lane_valid: np.ndarray
+) -> ReqTensor:
+    """Requirement rows under a fixed vocabulary. Row content is a pure
+    function of (requirements, vocab), so the streaming delta encoder can
+    build rows for just the new pods and splice them next to cached rows
+    while staying bit-identical to a cold encode."""
+    K, V = lane_valid.shape
+    E = len(entities)
+    # fold to requirement CLASSES first: at 10k diverse pods only a
+    # few hundred exist, and the per-value has() probing is the
+    # dominant host cost of this section (PERF_NOTES item 4). The
+    # tensors are then built once per class and every entity row is
+    # ONE fancy-index gather — no per-pod numpy row copies
+    folded: Dict[tuple, int] = {}
+    reps: List[Requirements] = []
+    cls_of = np.empty(E, dtype=np.int32)
+    for e, reqs in enumerate(entities):
+        digest = _reqs_digest(reqs)
+        ci = folded.get(digest)
+        if ci is None:
+            ci = folded[digest] = len(reps)
+            reps.append(reqs)
+        cls_of[e] = ci
+    U = len(reps)
+    admitted = np.zeros((U, K, V), dtype=bool)
+    comp = np.zeros((U, K), dtype=bool)
+    gt = np.full((U, K), GT_NONE, dtype=np.int32)
+    lt = np.full((U, K), LT_NONE, dtype=np.int32)
+    defined = np.zeros((U, K), dtype=bool)
+    for u, reqs in enumerate(reps):
+        # undefined keys are identity elements: full-admit complements
+        admitted[u] = lane_valid
+        comp[u] = True
+        for key in reqs:
+            r = reqs.get(key)
+            # inactive key (instance-type rows only): no left-side
+            # state defines it, so Intersects can't fail on it —
+            # leaving the row undefined here is exact
+            ki = vocab.key_index.get(key)
+            if ki is None:
+                continue
+            defined[u, ki] = True
+            comp[u, ki] = r.complement
+            if r.greater_than is not None:
+                gt[u, ki] = r.greater_than
+            if r.less_than is not None:
+                lt[u, ki] = r.less_than
+            row = np.zeros(V, dtype=bool)
+            for value, vi in vocab.values[ki].items():
+                row[vi] = r.has(value)
+            admitted[u, ki] = row
+    return ReqTensor(
+        admitted=admitted[cls_of],
+        comp=comp[cls_of],
+        gt=gt[cls_of],
+        lt=lt[cls_of],
+        defined=defined[cls_of],
+    )
+
+
+def segment_runs(
+    pod_reqs: ReqTensor,
+    pod_strict_reqs: ReqTensor,
+    pod_requests: np.ndarray,
+    pod_tol_tpl: np.ndarray,
+    pod_tol_node: np.ndarray,
+    pod_ports: np.ndarray,
+    pod_port_conflict: np.ndarray,
+    pod_vol_counts: np.ndarray,
+    pod_grp_match: np.ndarray,
+    pod_grp_selects: np.ndarray,
+    pod_grp_owned: np.ndarray,
+    G: int,
+):
+    """Run segmentation over the assembled pod-axis arrays: consecutive queue
+    rows with identical encodings commit as one scan step (ops/ffd.py run
+    solver); topology-inert runs take the closed-form analytic commit; runs
+    that interact with topology groups take the light per-pod inner loop
+    (ops/topo_runs.py) unless they carry host ports or CSI volumes (whose
+    within-run interactions the closed node-capacity form does not model —
+    those stay on the per-pod step). Eligibility is re-checked on byte
+    equality of the encoded rows themselves, so the sort-signature heuristic
+    can never cause a false merge. Module-level (shared with
+    streaming/delta.py) so patched encodes segment identically to cold ones.
+
+    Returns (run_start, run_len, run_mode, pod_eqprev, pod_eqprev_gate,
+    pod_eqprev_chain)."""
+    from karpenter_tpu.models.problem import RUN_ANALYTIC, RUN_SINGLE, RUN_TOPO
+
+    P = len(pod_requests)
+    # gate_interacts: some group GATES this pod's placement (matched
+    # regular groups / victim of an inverse group). selects-only pods are
+    # merely COUNTED by other pods' groups — their placement decisions
+    # are topology-blind, and their record deltas aggregate per bin, so
+    # the analytic run commit handles them exactly (its record sum).
+    gate_interacts = (
+        pod_grp_match.any(axis=1) | pod_grp_owned.any(axis=1)
+    ) if G else np.zeros(P, dtype=bool)
+    interacts = (
+        gate_interacts | pod_grp_selects.any(axis=1)
+    ) if G else np.zeros(P, dtype=bool)
+    has_ports = pod_ports.any(axis=1) if pod_ports.size else np.zeros(P, dtype=bool)
+    has_vols = (
+        pod_vol_counts.any(axis=1) if pod_vol_counts.size else np.zeros(P, dtype=bool)
+    )
+    mergeable = ~(interacts & (has_ports | has_vols))
+    # run formation needs only CONSECUTIVE-row equality of the encoded
+    # lanes, which vectorizes to one elementwise comparison per array —
+    # no hashing. Equal rows have equal interacts/ports/vols, so checking
+    # mergeable[i] for the run head covers every member.
+    if P > 1:
+        same_as_prev = np.ones(P, dtype=bool)
+        same_as_prev[0] = False
+        for a in (
+            pod_reqs.admitted, pod_reqs.comp, pod_reqs.gt, pod_reqs.lt,
+            pod_reqs.defined, pod_strict_reqs.admitted,
+            pod_strict_reqs.comp, pod_strict_reqs.gt,
+            pod_strict_reqs.lt, pod_strict_reqs.defined,
+            pod_requests, pod_tol_tpl, pod_tol_node,
+            pod_ports, pod_port_conflict, pod_vol_counts,
+            pod_grp_match, pod_grp_selects, pod_grp_owned,
+        ):
+            if a.size:
+                flat = a.reshape(P, -1)
+                same_as_prev[1:] &= (flat[1:] == flat[:-1]).all(axis=1)
+    else:
+        same_as_prev = np.zeros(P, dtype=bool)
+    pod_eqprev = same_as_prev.copy()  # byte-identity with the previous row
+    # gate-identity: equality over only the arrays that can influence a
+    # topology-blind pod's own placement (labels/selectors may differ —
+    # they only change who counts whom, which the analytic commit's
+    # record sum aggregates exactly). Only meaningful between rows that
+    # are NOT gate-interacting and carry no ports/volumes when records
+    # are in play (mirroring `mergeable`).
+    if P > 1:
+        gate_same = np.ones(P, dtype=bool)
+        gate_same[0] = False
+        for a in (
+            pod_reqs.admitted, pod_reqs.comp, pod_reqs.gt, pod_reqs.lt,
+            pod_reqs.defined, pod_requests, pod_tol_tpl, pod_tol_node,
+            pod_ports, pod_port_conflict, pod_vol_counts,
+        ):
+            if a.size:
+                flat = a.reshape(P, -1)
+                gate_same[1:] &= (flat[1:] == flat[:-1]).all(axis=1)
+        eligible = ~gate_interacts & mergeable
+        gate_same &= eligible
+        gate_same[1:] &= eligible[:-1]
+    else:
+        gate_same = np.zeros(P, dtype=bool)
+    pod_eqprev_gate = gate_same
+    # CHAIN-identity: equality over every array that can influence a
+    # pod's OWN placement verdict. The full select side may differ (own
+    # labels) — no gate reads it except through match∩selects (spread
+    # self-count, affinity self-select bootstrap), which IS compared.
+    # Differing selects only change who records whom, and both chain
+    # consumers (the stride's weighted record, the run commits'
+    # per-member record gather) sum records per member, so a chain
+    # commit stays bit-identical to stepping its members one at a time.
+    if P > 1 and G:
+        chain_same = np.ones(P, dtype=bool)
+        chain_same[0] = False
+        for a in (
+            pod_reqs.admitted, pod_reqs.comp, pod_reqs.gt, pod_reqs.lt,
+            pod_reqs.defined, pod_strict_reqs.admitted,
+            pod_strict_reqs.comp, pod_strict_reqs.gt,
+            pod_strict_reqs.lt, pod_strict_reqs.defined,
+            pod_requests, pod_tol_tpl, pod_tol_node,
+            pod_ports, pod_port_conflict, pod_vol_counts,
+            pod_grp_match, pod_grp_owned,
+            pod_grp_match & pod_grp_selects,
+        ):
+            if a.size:
+                flat = a.reshape(P, -1)
+                chain_same[1:] &= (flat[1:] == flat[:-1]).all(axis=1)
+        # ports/volumes + topology interaction stays per-pod (mirrors
+        # `mergeable`): the chain commits don't model within-chain port
+        # and CSI interactions against shifting topology counters
+        chain_same &= mergeable
+        chain_same[1:] &= mergeable[:-1]
+        pod_eqprev_chain = pod_eqprev | chain_same
+    else:
+        pod_eqprev_chain = pod_eqprev.copy()
+    run_start_l: List[int] = []
+    run_len_l: List[int] = []
+    run_mode_l: List[int] = []
+    i = 0
+    while i < P:
+        j = i + 1
+        if mergeable[i]:
+            # runs extend over byte-identical rows AND chain-identical
+            # ones: the analytic commit (ops/ffd_runs.py) gathers each
+            # member's select row for its record sum, and the topo run
+            # commit (ops/topo_runs.py) rebuilds the per-member
+            # PodTopoStatics, so both stay exact when only the select
+            # side differs across the run
+            while j < P and j - i < MAX_RUN_LEN and pod_eqprev_chain[j]:
+                j += 1
+        run_start_l.append(i)
+        run_len_l.append(j - i)
+        # length-1 runs go through the battle-tested per-pod step; the
+        # run commits are only entered when they actually pay
+        if j - i == 1:
+            run_mode_l.append(RUN_SINGLE)
+        elif gate_interacts[i]:
+            run_mode_l.append(RUN_TOPO)
+        else:
+            run_mode_l.append(RUN_ANALYTIC)
+        i = j
+    return (
+        np.array(run_start_l, dtype=np.int32),
+        np.array(run_len_l, dtype=np.int32),
+        np.array(run_mode_l, dtype=np.int32),
+        pod_eqprev,
+        pod_eqprev_gate,
+        pod_eqprev_chain,
+    )
+
+
 class Encoder:
     """Encodes one scheduling batch. The vocabulary is rebuilt per batch —
     label spaces are open-ended, so there is no global dictionary to maintain
@@ -281,69 +599,23 @@ class Encoder:
             )
             inverse_from = len(topology.topologies)
 
-        # -- 2. vocabulary over every value mentioned anywhere
-        vocab = _Vocab()
-        # zone / capacity-type / hostname keys always exist at pinned indices
-        # (offering checks + claim hostname minting index them statically)
-        zone_k = vocab.key(wk.LABEL_TOPOLOGY_ZONE)
-        ct_k = vocab.key(wk.CAPACITY_TYPE_LABEL_KEY)
-        hostname_k = vocab.key(wk.LABEL_HOSTNAME)
-        if (zone_k, ct_k, hostname_k) != (ZONE_KEY, CT_KEY, HOSTNAME_KEY):
-            # device kernels index these statically; survive python -O
-            raise AssertionError(
-                f"pinned vocab keys moved: {(zone_k, ct_k, hostname_k)}"
-            )
-        for p in vocab_pods:
-            # seed EVERY affinity term, not just the active one: relaxation
-            # can surface later OR terms / lighter preferences in later
-            # passes, and the frozen vocabulary must already cover them
-            vocab.add_requirements(label_requirements(p.spec.node_selector))
-            aff = p.spec.affinity.node_affinity if p.spec.affinity else None
-            if aff is not None:
-                for term in aff.required:
-                    vocab.add_requirements(
-                        Requirements.from_node_selector_requirements(*term.match_expressions)
-                    )
-                for pref in aff.preferred:
-                    vocab.add_requirements(
-                        Requirements.from_node_selector_requirements(
-                            *pref.preference.match_expressions
-                        )
-                    )
-        # vocab_reqs (stable, full-universe order) must seed BEFORE the
-        # per-pass pod_reqs_list, whose FFD-queue order varies across relax
-        # passes — otherwise override-only keys/values get different lane
-        # indices per pass and carried solver state misreads them
-        if vocab_reqs is not None:
-            for reqs in vocab_reqs:
-                vocab.add_requirements(reqs)
-        if pod_reqs_override is not None:
-            for reqs in pod_reqs_list:
-                vocab.add_requirements(reqs)
-        for t in templates:
-            vocab.add_requirements(t.requirements)
-        for n in nodes:
-            vocab.add_requirements(n.requirements)
-        # topology domains + node-filter terms + claim hostname placeholders
-        for tg in groups:
-            vocab.key(tg.key)
-            for domain in tg.domains:
-                vocab.value(tg.key, domain)
-            for term in tg.node_filter.terms:
-                vocab.add_requirements(term)
+        # -- 2. vocabulary over every value mentioned anywhere (build_vocab —
+        # shared with streaming/delta.py, which replays it to prove lane
+        # stability before patching rows)
         claim_hostnames = [claim_hostname(i) for i in range(num_claim_slots)]
-        for h in claim_hostnames:
-            vocab.value(wk.LABEL_HOSTNAME, h)
-        # instance types go LAST and never create keys (active-key compaction:
-        # see add_values_for_active_keys) — the key set above is exactly what
-        # left-side states can ever define, so compat on any other key is
-        # statically true and the lanes would be dead weight in the hot
-        # [bins x instance-types] product
-        for it in instance_types:
-            vocab.add_values_for_active_keys(it.requirements)
-            for o in it.offerings:
-                vocab.value(wk.LABEL_TOPOLOGY_ZONE, o.zone)
-                vocab.value(wk.CAPACITY_TYPE_LABEL_KEY, o.capacity_type)
+        vocab = build_vocab(
+            vocab_pods,
+            templates,
+            nodes,
+            groups,
+            claim_hostnames,
+            instance_types,
+            override_reqs_list=(
+                pod_reqs_list if pod_reqs_override is not None else None
+            ),
+            vocab_reqs=vocab_reqs,
+        )
+        zone_k, ct_k, hostname_k = ZONE_KEY, CT_KEY, HOSTNAME_KEY
 
         K = len(vocab.keys)
         V = max((len(v) for v in vocab.values), default=1) or 1
@@ -381,76 +653,10 @@ class Encoder:
         for n in nodes:
             note_resources(n.available)
 
-        # -- 4. requirement tensors
-        def _reqs_digest(reqs: Requirements):
-            """Canonical hashable form of a Requirements object — the fold
-            below is a pure function of it, so identical-class entities
-            (duplicated pods, repeated templates) share one fold."""
-            return tuple(
-                sorted(
-                    (
-                        key,
-                        r.complement,
-                        frozenset(r.values),
-                        r.greater_than,
-                        r.less_than,
-                    )
-                    for key, r in ((k, reqs.get(k)) for k in reqs)
-                )
-            )
-
+        # -- 4. requirement tensors (encode_reqs_with_vocab — shared with the
+        # streaming delta encoder so spliced new-pod rows are bit-identical)
         def encode_reqs(entities: List[Requirements]) -> ReqTensor:
-            E = len(entities)
-            # fold to requirement CLASSES first: at 10k diverse pods only a
-            # few hundred exist, and the per-value has() probing is the
-            # dominant host cost of this section (PERF_NOTES item 4). The
-            # tensors are then built once per class and every entity row is
-            # ONE fancy-index gather — no per-pod numpy row copies
-            folded: Dict[tuple, int] = {}
-            reps: List[Requirements] = []
-            cls_of = np.empty(E, dtype=np.int32)
-            for e, reqs in enumerate(entities):
-                digest = _reqs_digest(reqs)
-                ci = folded.get(digest)
-                if ci is None:
-                    ci = folded[digest] = len(reps)
-                    reps.append(reqs)
-                cls_of[e] = ci
-            U = len(reps)
-            admitted = np.zeros((U, K, V), dtype=bool)
-            comp = np.zeros((U, K), dtype=bool)
-            gt = np.full((U, K), GT_NONE, dtype=np.int32)
-            lt = np.full((U, K), LT_NONE, dtype=np.int32)
-            defined = np.zeros((U, K), dtype=bool)
-            for u, reqs in enumerate(reps):
-                # undefined keys are identity elements: full-admit complements
-                admitted[u] = lane_valid
-                comp[u] = True
-                for key in reqs:
-                    r = reqs.get(key)
-                    # inactive key (instance-type rows only): no left-side
-                    # state defines it, so Intersects can't fail on it —
-                    # leaving the row undefined here is exact
-                    ki = vocab.key_index.get(key)
-                    if ki is None:
-                        continue
-                    defined[u, ki] = True
-                    comp[u, ki] = r.complement
-                    if r.greater_than is not None:
-                        gt[u, ki] = r.greater_than
-                    if r.less_than is not None:
-                        lt[u, ki] = r.less_than
-                    row = np.zeros(V, dtype=bool)
-                    for value, vi in vocab.values[ki].items():
-                        row[vi] = r.has(value)
-                    admitted[u, ki] = row
-            return ReqTensor(
-                admitted=admitted[cls_of],
-                comp=comp[cls_of],
-                gt=gt[cls_of],
-                lt=lt[cls_of],
-                defined=defined[cls_of],
-            )
+            return encode_reqs_with_vocab(entities, vocab, lane_valid)
 
         pod_reqs = encode_reqs(pod_reqs_list)
         pod_strict_reqs = encode_reqs(pod_strict_list)
@@ -699,140 +905,21 @@ class Encoder:
             [vocab.values[hostname_k][h] for h in claim_hostnames], dtype=np.int32
         )
 
-        # -- 10. run segmentation: consecutive queue rows with identical
-        # encodings commit as one scan step (ops/ffd.py run solver):
-        # topology-inert runs take the closed-form analytic commit; runs that
-        # interact with topology groups take the light per-pod inner loop
-        # (ops/topo_runs.py) unless they carry host ports or CSI volumes
-        # (whose within-run interactions the closed node-capacity form does
-        # not model — those stay on the per-pod step). Eligibility is
-        # re-checked on byte equality of the encoded rows themselves, so the
-        # sort-signature heuristic above can never cause a false merge.
-        from karpenter_tpu.models.problem import RUN_ANALYTIC, RUN_SINGLE, RUN_TOPO
-
+        # -- 10. run segmentation (segment_runs -- shared with the streaming
+        # delta encoder so patched encodes segment identically to cold ones)
         P = len(pods)
-        # gate_interacts: some group GATES this pod's placement (matched
-        # regular groups / victim of an inverse group). selects-only pods are
-        # merely COUNTED by other pods' groups — their placement decisions
-        # are topology-blind, and their record deltas aggregate per bin, so
-        # the analytic run commit handles them exactly (its record sum).
-        gate_interacts = (
-            pod_grp_match.any(axis=1) | pod_grp_owned.any(axis=1)
-        ) if G else np.zeros(P, dtype=bool)
-        interacts = (
-            gate_interacts | pod_grp_selects.any(axis=1)
-        ) if G else np.zeros(P, dtype=bool)
-        has_ports = pod_ports.any(axis=1) if pod_ports.size else np.zeros(P, dtype=bool)
-        has_vols = (
-            pod_vol_counts.any(axis=1) if pod_vol_counts.size else np.zeros(P, dtype=bool)
+        (
+            run_start,
+            run_len,
+            run_mode,
+            pod_eqprev,
+            pod_eqprev_gate,
+            pod_eqprev_chain,
+        ) = segment_runs(
+            pod_reqs, pod_strict_reqs, pod_requests, pod_tol_tpl, pod_tol_node,
+            pod_ports, pod_port_conflict, pod_vol_counts,
+            pod_grp_match, pod_grp_selects, pod_grp_owned, G,
         )
-        mergeable = ~(interacts & (has_ports | has_vols))
-        # run formation needs only CONSECUTIVE-row equality of the encoded
-        # lanes, which vectorizes to one elementwise comparison per array —
-        # no hashing. Equal rows have equal interacts/ports/vols, so checking
-        # mergeable[i] for the run head covers every member.
-        if P > 1:
-            same_as_prev = np.ones(P, dtype=bool)
-            same_as_prev[0] = False
-            for a in (
-                pod_reqs.admitted, pod_reqs.comp, pod_reqs.gt, pod_reqs.lt,
-                pod_reqs.defined, pod_strict_reqs.admitted,
-                pod_strict_reqs.comp, pod_strict_reqs.gt,
-                pod_strict_reqs.lt, pod_strict_reqs.defined,
-                pod_requests, pod_tol_tpl, pod_tol_node,
-                pod_ports, pod_port_conflict, pod_vol_counts,
-                pod_grp_match, pod_grp_selects, pod_grp_owned,
-            ):
-                if a.size:
-                    flat = a.reshape(P, -1)
-                    same_as_prev[1:] &= (flat[1:] == flat[:-1]).all(axis=1)
-        else:
-            same_as_prev = np.zeros(P, dtype=bool)
-        pod_eqprev = same_as_prev.copy()  # byte-identity with the previous row
-        # gate-identity: equality over only the arrays that can influence a
-        # topology-blind pod's own placement (labels/selectors may differ —
-        # they only change who counts whom, which the analytic commit's
-        # record sum aggregates exactly). Only meaningful between rows that
-        # are NOT gate-interacting and carry no ports/volumes when records
-        # are in play (mirroring `mergeable`).
-        if P > 1:
-            gate_same = np.ones(P, dtype=bool)
-            gate_same[0] = False
-            for a in (
-                pod_reqs.admitted, pod_reqs.comp, pod_reqs.gt, pod_reqs.lt,
-                pod_reqs.defined, pod_requests, pod_tol_tpl, pod_tol_node,
-                pod_ports, pod_port_conflict, pod_vol_counts,
-            ):
-                if a.size:
-                    flat = a.reshape(P, -1)
-                    gate_same[1:] &= (flat[1:] == flat[:-1]).all(axis=1)
-            eligible = ~gate_interacts & mergeable
-            gate_same &= eligible
-            gate_same[1:] &= eligible[:-1]
-        else:
-            gate_same = np.zeros(P, dtype=bool)
-        pod_eqprev_gate = gate_same
-        # CHAIN-identity: equality over every array that can influence a
-        # pod's OWN placement verdict. The full select side may differ (own
-        # labels) — no gate reads it except through match∩selects (spread
-        # self-count, affinity self-select bootstrap), which IS compared.
-        # Differing selects only change who records whom, and both chain
-        # consumers (the stride's weighted record, the run commits'
-        # per-member record gather) sum records per member, so a chain
-        # commit stays bit-identical to stepping its members one at a time.
-        if P > 1 and G:
-            chain_same = np.ones(P, dtype=bool)
-            chain_same[0] = False
-            for a in (
-                pod_reqs.admitted, pod_reqs.comp, pod_reqs.gt, pod_reqs.lt,
-                pod_reqs.defined, pod_strict_reqs.admitted,
-                pod_strict_reqs.comp, pod_strict_reqs.gt,
-                pod_strict_reqs.lt, pod_strict_reqs.defined,
-                pod_requests, pod_tol_tpl, pod_tol_node,
-                pod_ports, pod_port_conflict, pod_vol_counts,
-                pod_grp_match, pod_grp_owned,
-                pod_grp_match & pod_grp_selects,
-            ):
-                if a.size:
-                    flat = a.reshape(P, -1)
-                    chain_same[1:] &= (flat[1:] == flat[:-1]).all(axis=1)
-            # ports/volumes + topology interaction stays per-pod (mirrors
-            # `mergeable`): the chain commits don't model within-chain port
-            # and CSI interactions against shifting topology counters
-            chain_same &= mergeable
-            chain_same[1:] &= mergeable[:-1]
-            pod_eqprev_chain = pod_eqprev | chain_same
-        else:
-            pod_eqprev_chain = pod_eqprev.copy()
-        run_start_l: List[int] = []
-        run_len_l: List[int] = []
-        run_mode_l: List[int] = []
-        i = 0
-        while i < P:
-            j = i + 1
-            if mergeable[i]:
-                # runs extend over byte-identical rows AND chain-identical
-                # ones: the analytic commit (ops/ffd_runs.py) gathers each
-                # member's select row for its record sum, and the topo run
-                # commit (ops/topo_runs.py) rebuilds the per-member
-                # PodTopoStatics, so both stay exact when only the select
-                # side differs across the run
-                while j < P and j - i < MAX_RUN_LEN and pod_eqprev_chain[j]:
-                    j += 1
-            run_start_l.append(i)
-            run_len_l.append(j - i)
-            # length-1 runs go through the battle-tested per-pod step; the
-            # run commits are only entered when they actually pay
-            if j - i == 1:
-                run_mode_l.append(RUN_SINGLE)
-            elif gate_interacts[i]:
-                run_mode_l.append(RUN_TOPO)
-            else:
-                run_mode_l.append(RUN_ANALYTIC)
-            i = j
-        run_start = np.array(run_start_l, dtype=np.int32)
-        run_len = np.array(run_len_l, dtype=np.int32)
-        run_mode = np.array(run_mode_l, dtype=np.int32)
         pod_active = np.ones(P, dtype=bool)
 
         problem = SchedulingProblem(

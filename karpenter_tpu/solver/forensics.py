@@ -192,6 +192,7 @@ def dump_quarantine(
     violations: Sequence,
     backend: str = "",
     directory: Optional[str] = None,
+    parent_trace_id: Optional[str] = None,
 ) -> Optional[str]:
     """Write a rejected SolveResult to a forensics JSON file so a bad
     placement can be diagnosed offline after the supervisor failed over.
@@ -220,6 +221,9 @@ def dump_quarantine(
             # the solve cycle that produced this rejected result — grep the
             # id across /debug/traces and logs to reconstruct the timeline
             "trace_id": trace.current_trace_id(),
+            # the previous cycle in the same stream (SupervisedSolver threads
+            # it forward), so a churn lineage reconstructs end to end
+            "parent_trace_id": parent_trace_id,
             "violations": [str(v) for v in violations],
             "new_claims": [
                 {

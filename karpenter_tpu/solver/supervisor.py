@@ -130,7 +130,20 @@ class SupervisedSolver(SolverBackend):
         backoff_base_s: Optional[float] = None,
         time_fn=time.monotonic,
         sleep_fn=time.sleep,
+        streaming: Optional[bool] = None,
     ):
+        # KARPENTER_TPU_DELTA=1 (or streaming=True) wraps the primary in the
+        # warm-state streaming layer: delta-diffed snapshots re-solve only the
+        # churned frontier, with cold fallback above KARPENTER_TPU_DELTA_MAX_FRAC
+        # (see docs/SERVING.md). The fallback backend stays unwrapped — it is
+        # the reference answer the streaming path degrades to.
+        if streaming is None:
+            streaming = os.environ.get("KARPENTER_TPU_DELTA", "") not in ("", "0")
+        if streaming:
+            from karpenter_tpu.streaming.warm import StreamingSolver
+
+            if not isinstance(primary, StreamingSolver):
+                primary = StreamingSolver(primary)
         self.primary = primary
         self.fallback = fallback
         self.deadline_s = (
@@ -171,6 +184,10 @@ class SupervisedSolver(SolverBackend):
         self._consecutive_failures = 0
         self._opened_at = 0.0
         self._solve_seq = 0
+        # previous cycle's trace id, threaded into the next cycle as
+        # parent_trace_id: a churn stream greps as one lineage in
+        # /debug/traces and in quarantine dumps
+        self._last_trace_id: Optional[str] = None
         self.last_failure: Optional[Dict[str, str]] = None
         self.counters: Dict[str, int] = {
             "solve_retries": 0,
@@ -273,10 +290,18 @@ class SupervisedSolver(SolverBackend):
             pod_volumes=pod_volumes,
         )
         self._solve_seq += 1
+        attrs = {"pods": len(pods)}
+        if self._last_trace_id:
+            attrs["parent_trace_id"] = self._last_trace_id
         with trace.cycle(
-            "solve", backend=type(self.primary).__name__, pods=len(pods)
+            "solve", backend=type(self.primary).__name__, **attrs
         ):
-            return self._solve_supervised(pods, instance_types, templates, kwargs)
+            try:
+                return self._solve_supervised(pods, instance_types, templates, kwargs)
+            finally:
+                trace_id = trace.current_trace_id()
+                if trace_id is not None:
+                    self._last_trace_id = trace_id
 
     def _solve_supervised(self, pods, instance_types, templates, kwargs) -> SolveResult:
         route = self._route()
@@ -317,6 +342,7 @@ class SupervisedSolver(SolverBackend):
                 # both backends disagree with the invariants: keep what
                 # verified, requeue the rest
                 self._quarantine(result, violations, backend=to_name)
+                self._reset_streaming()
                 return val.strip_violations(
                     result, violations, self._requeue_reason(CLASS_VALIDATION)
                 )
@@ -340,6 +366,8 @@ class SupervisedSolver(SolverBackend):
                 trace_id = trace.current_trace_id()
                 if trace_id:
                     self.last_failure["trace_id"] = trace_id
+                if self._last_trace_id:
+                    self.last_failure["parent_trace_id"] = self._last_trace_id
                 if failure_class == CLASS_DEADLINE:
                     SOLVE_DEADLINE_EXCEEDED.inc()
                     self.counters["deadline_exceeded"] += 1
@@ -369,6 +397,9 @@ class SupervisedSolver(SolverBackend):
                 trace_id = trace.current_trace_id()
                 if trace_id:
                     self.last_failure["trace_id"] = trace_id
+                if self._last_trace_id:
+                    self.last_failure["parent_trace_id"] = self._last_trace_id
+                self._reset_streaming()
                 self._quarantine(
                     result, violations, backend=type(self.primary).__name__
                 )
@@ -474,10 +505,20 @@ class SupervisedSolver(SolverBackend):
             self.counters["validator_rejections"] += 1
         return violations
 
+    def _reset_streaming(self) -> None:
+        """A rejected result must never seed the next warm solve: drop the
+        streaming layer's carried placement state (no-op for plain backends)."""
+        reset = getattr(self.primary, "reset_streaming_state", None)
+        if reset is not None:
+            reset()
+
     def _quarantine(self, result, violations, backend: str) -> None:
         from karpenter_tpu.solver.forensics import dump_quarantine
 
-        path = dump_quarantine(result, violations, backend=backend)
+        path = dump_quarantine(
+            result, violations, backend=backend,
+            parent_trace_id=self._last_trace_id,
+        )
         log.error(
             "validator rejected %s result (%d violation(s), first: %s)%s",
             backend, len(violations), violations[0],

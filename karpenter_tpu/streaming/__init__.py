@@ -1,0 +1,32 @@
+"""Streaming solve: incremental delta encode + warm-state re-solve under churn.
+
+Production traffic is a stream — pod arrivals, deletes, spot reclaims,
+rolling deploys — while the batch solver re-encodes and re-places the whole
+world each cycle. This package turns it into a continuous one:
+
+  delta.py   snapshot digests + diff, and a DeltaEncoder that patches rows of
+             the previous SchedulingProblem in place (the class-keyed encoder
+             makes pod/node deltas row patches) instead of a full rebuild —
+             bit-identical to a cold encode or it falls back to one.
+  warm.py    StreamingSolver: reuses the previous placement as the starting
+             claim landscape and re-places only pods whose gates could have
+             changed, falling back to a full solve past a delta-fraction
+             threshold or on a validator rejection.
+  churn.py   seeded arrival/delete/reclaim load generator driving
+             testing/faults.py's ``cloud.reclaim`` grammar, with a
+             sustained pods/s-under-churn harness shared by bench.py,
+             tools/chaos_sweep.py, and the parity fuzz.
+
+docs/SERVING.md documents the warm-state contract (resolved / reused /
+certified buckets) and the knobs.
+"""
+
+from karpenter_tpu.streaming.delta import DeltaEncoder, SnapshotDelta, diff_snapshots
+from karpenter_tpu.streaming.warm import StreamingSolver
+
+__all__ = [
+    "DeltaEncoder",
+    "SnapshotDelta",
+    "diff_snapshots",
+    "StreamingSolver",
+]

@@ -1,0 +1,168 @@
+"""Seeded churn load generator: arrivals, deletes, spot reclaims.
+
+Drives a streaming solver with the traffic shape production actually sees —
+a steady arrival process, random deletes, and provider-initiated spot
+reclaims injected through the shared ``testing/faults.py`` grammar
+(``cloud.reclaim``), so chaos specs and churn configs read identically:
+
+    KARPENTER_TPU_FAULTS="seed=7;cloud.reclaim=2@p0.1"
+
+Everything is seeded: the arrival/delete RNG from ``ChurnConfig.seed``, the
+reclaim draws from the fault injector's own (seed, site, call#) hash. The
+same config replays the same pod stream byte-for-byte, which is what lets
+the parity fuzz compare warm and cold solves of identical snapshots.
+
+``run_churn`` is the shared harness: bench.py's churn scenario, the chaos
+sweep's reclaim row, and the streaming tests all call it rather than
+reimplementing the drive loop.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+from karpenter_tpu.apis.objects import Container, ObjectMeta, Pod, PodSpec
+from karpenter_tpu.solver.encode import NodeInfo
+from karpenter_tpu.testing import faults
+from karpenter_tpu.utils import resources as res
+
+
+def default_pod_factory(name: str, rng: random.Random) -> Pod:
+    """A plausible mixed-size arrival: cpu/memory drawn from a small set of
+    shapes so FFD runs still form (identical shapes compress)."""
+    cpu, mem = rng.choice(
+        ((0.25, 0.5e9), (0.5, 1e9), (1.0, 2e9), (2.0, 4e9), (4.0, 8e9))
+    )
+    return Pod(
+        metadata=ObjectMeta(name=name, labels={"app": rng.choice(("web", "api", "batch"))}),
+        spec=PodSpec(
+            containers=[Container(requests={res.CPU: cpu, res.MEMORY: mem})]
+        ),
+    )
+
+
+@dataclass
+class ChurnConfig:
+    seed: int = 0
+    arrivals_per_cycle: int = 8
+    deletes_per_cycle: int = 4
+    min_pods: int = 1  # deletes never drain the batch below this
+
+
+@dataclass
+class ChurnEvent:
+    cycle: int
+    arrived: List[Pod] = field(default_factory=list)
+    deleted: List[Pod] = field(default_factory=list)
+    reclaimed: List[str] = field(default_factory=list)  # node names
+
+
+class ChurnProcess:
+    """Mutable cluster snapshot advanced one solve cycle at a time. ``pods``
+    and ``nodes`` are the current snapshot; ``step()`` applies one cycle of
+    churn and returns what changed."""
+
+    def __init__(
+        self,
+        pods: Sequence[Pod],
+        nodes: Sequence[NodeInfo] = (),
+        pod_factory: Callable[[str, random.Random], Pod] = default_pod_factory,
+        config: Optional[ChurnConfig] = None,
+    ):
+        self.config = config or ChurnConfig()
+        self.rng = random.Random(self.config.seed)
+        self.pods: List[Pod] = list(pods)
+        self.nodes: List[NodeInfo] = list(nodes)
+        self.pod_factory = pod_factory
+        self.cycle = 0
+        self.events: List[ChurnEvent] = []
+
+    def step(self) -> ChurnEvent:
+        ev = ChurnEvent(cycle=self.cycle)
+        self.cycle += 1
+        n_del = min(
+            self.config.deletes_per_cycle,
+            max(0, len(self.pods) - self.config.min_pods),
+        )
+        if n_del:
+            for pos in sorted(
+                self.rng.sample(range(len(self.pods)), n_del), reverse=True
+            ):
+                ev.deleted.append(self.pods.pop(pos))
+        for j in range(self.config.arrivals_per_cycle):
+            p = self.pod_factory(f"churn-{ev.cycle}-{j}", self.rng)
+            ev.arrived.append(p)
+            self.pods.append(p)
+        # provider-initiated spot reclaim, through the shared fault grammar:
+        # one 'cloud' draw per cycle, width = rule.param
+        inj = faults.active()
+        if inj is not None and self.nodes:
+            rule = inj.draw("cloud")
+            if rule is not None and rule.kind == "reclaim":
+                ev.reclaimed = faults.reclaim_targets(
+                    rule, [n.name for n in self.nodes], inj.seed, inj.calls("cloud")
+                )
+                gone = set(ev.reclaimed)
+                self.nodes = [n for n in self.nodes if n.name not in gone]
+        self.events.append(ev)
+        return ev
+
+
+def run_churn(
+    solver,
+    process: ChurnProcess,
+    instance_types,
+    templates,
+    cycles: int,
+    validate: bool = False,
+) -> List[Dict[str, object]]:
+    """Drive ``solver`` through ``cycles`` churn steps. Returns one record per
+    cycle: pod count, wall seconds, and — when the solver is a StreamingSolver
+    (or wraps its telemetry surface) — the streaming outcome and reuse ratio.
+    ``validate=True`` runs the full-level invariant gate on every cycle's
+    result and records the violation count (the chaos sweep's survival bar)."""
+    records: List[Dict[str, object]] = []
+    for _ in range(cycles):
+        ev = process.step()
+        start = time.perf_counter()
+        result = solver.solve(
+            process.pods, instance_types, templates, nodes=process.nodes
+        )
+        seconds = time.perf_counter() - start
+        rec: Dict[str, object] = {
+            "cycle": ev.cycle,
+            "pods": len(process.pods),
+            "nodes": len(process.nodes),
+            "arrived": len(ev.arrived),
+            "deleted": len(ev.deleted),
+            "reclaimed": len(ev.reclaimed),
+            "scheduled": result.num_scheduled(),
+            "failures": len(result.failures),
+            "seconds": seconds,
+        }
+        # streaming telemetry: the solver itself, or (for SupervisedSolver)
+        # the wrapped primary
+        src = solver
+        if getattr(src, "last_outcome", None) is None:
+            src = getattr(solver, "primary", solver)
+        outcome = getattr(src, "last_outcome", None)
+        if outcome is not None:
+            rec["outcome"] = outcome
+            rec["reuse_ratio"] = getattr(src, "last_reuse_ratio", 0.0)
+        if validate:
+            from karpenter_tpu.solver import validator as val
+
+            violations = val.validate_result(
+                result,
+                list(process.pods),
+                instance_types,
+                templates,
+                nodes=process.nodes,
+                level="full",
+            )
+            rec["violations"] = len(violations)
+        records.append(rec)
+    return records

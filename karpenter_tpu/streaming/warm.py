@@ -1,0 +1,487 @@
+"""Warm-state re-solve: reuse the previous placement under churn.
+
+``StreamingSolver`` wraps any ``SolverBackend``. Each cycle it diffs the
+incoming snapshot against the previous one (streaming/delta.py digests) and
+splits the batch into three buckets:
+
+  resolved   pods whose gates could have changed — arrivals, spec changes,
+             previous failures, residents of removed/changed nodes, and (on
+             any churn at all) every topology-constrained pod, since counts
+             anywhere can move a skew gate. These re-solve through the inner
+             backend against the *residual* world: real nodes with pinned
+             capacity pre-consumed, plus each surviving claim exposed as a
+             pseudo-node so re-solved pods can still join it.
+  reused     everything else — pinned to its previous bin verbatim. The
+             merged result must pass the validator's FULL-level gate or the
+             whole cycle falls back to a cold solve.
+  certified  the subset of ``reused`` that is *provably* identical to what a
+             cold solve of the current snapshot would produce: the FFD-queue
+             prefix that matches the previous queue up to the first churned
+             pod. FFD placement is sequential — everything before the first
+             perturbation replays move-for-move (node removals only shrink
+             the bin list ahead of the iteration order; node adds go cold) —
+             and beyond it the delete-cascade can reshuffle, so certification
+             stops there. tests/test_streaming_parity.py fuzzes exactly this
+             contract: certified placements bit-identical to cold, the rest
+             validator-clean.
+
+Fallback triggers (all recorded in ``last_outcome`` and the
+``solver_warm_solves_total`` counter): first cycle, delta fraction above
+``KARPENTER_TPU_DELTA_MAX_FRAC`` (default 0.15), instance-type/template/node
+universe changes, unsupported solve arguments, validator rejection, or any
+exception inside the warm path. A fallback is always a plain inner solve of
+the full batch — the warm path can degrade, never corrupt.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.objects import IN, Pod
+from karpenter_tpu.cloudprovider.types import InstanceType
+from karpenter_tpu.metrics.registry import DELTA_REUSE_RATIO, WARM_SOLVES
+from karpenter_tpu.obs import trace
+from karpenter_tpu.scheduling import Requirement, Requirements, pod_requirements
+from karpenter_tpu.scheduling.hostports import get_host_ports
+from karpenter_tpu.solver import validator as val
+from karpenter_tpu.solver.backend import Placement, SolveResult, SolverBackend
+from karpenter_tpu.solver.encode import NodeInfo, TemplateInfo, ffd_order
+from karpenter_tpu.solver.oracle import _fits, _has_offering
+from karpenter_tpu.streaming.delta import (
+    DeltaEncoder,
+    SnapshotDelta,
+    diff_snapshots,
+)
+from karpenter_tpu.utils import resources as res
+
+_WARM_CLAIM_PREFIX = "warm-claim-"
+
+
+def _has_topology_constraints(p: Pod) -> bool:
+    if p.spec.topology_spread_constraints:
+        return True
+    aff = p.spec.affinity
+    if aff is None:
+        return False
+    return bool(
+        (aff.pod_affinity and (aff.pod_affinity.required or aff.pod_affinity.preferred))
+        or (
+            aff.pod_anti_affinity
+            and (aff.pod_anti_affinity.required or aff.pod_anti_affinity.preferred)
+        )
+    )
+
+
+@dataclass
+class _StreamState:
+    """Previous accepted cycle: the snapshot, its result, and the FFD queue
+    order plus certification frontier needed to prove the next prefix."""
+
+    pods: List[Pod]
+    pod_digests: Dict[str, str]
+    nodes: List[NodeInfo]
+    node_digests: Dict[str, str]
+    instance_types: List[InstanceType]
+    templates: List[TemplateInfo]
+    result: SolveResult
+    order_uids: List[str]  # FFD queue order of `pods`
+    certified_uids: frozenset  # uids whose placements are provably cold-identical
+    # uid -> ("node", name) | ("claim", index) | ("fail", reason)
+    placement_of: Dict[str, Tuple[str, object]] = field(default_factory=dict)
+
+
+def _index_placements(pods: Sequence[Pod], result: SolveResult) -> Dict[str, Tuple[str, object]]:
+    out: Dict[str, Tuple[str, object]] = {}
+    for name, idxs in result.node_pods.items():
+        for i in idxs:
+            out[pods[i].uid] = ("node", name)
+    for ci, c in enumerate(result.new_claims):
+        for i in c.pod_indices:
+            out[pods[i].uid] = ("claim", ci)
+    for i, reason in result.failures.items():
+        out[pods[i].uid] = ("fail", reason)
+    return out
+
+
+class StreamingSolver(SolverBackend):
+    """SolverBackend wrapper adding warm-state re-solve. Safe to wire under
+    SupervisedSolver (KARPENTER_TPU_DELTA=1 does exactly that); stateless
+    callers just see a normal backend that happens to get faster under churn.
+
+    ``maintain_encoded`` additionally runs a DeltaEncoder over every supported
+    snapshot so tensor backends (and the bench) can read ``last_encoded`` —
+    off by default because a host-side inner solve doesn't need the tensors.
+    """
+
+    def __init__(
+        self,
+        inner: SolverBackend,
+        max_frac: Optional[float] = None,
+        maintain_encoded: bool = False,
+    ):
+        self.inner = inner
+        if max_frac is None:
+            max_frac = float(os.environ.get("KARPENTER_TPU_DELTA_MAX_FRAC", "0.15"))
+        self.max_frac = max_frac
+        self.maintain_encoded = maintain_encoded
+        self.delta_encoder = DeltaEncoder()
+        self.last_encoded = None
+        self._prev: Optional[_StreamState] = None
+        self.last_outcome: Optional[str] = None
+        self.last_reuse_ratio = 0.0
+        self.last_delta: Optional[SnapshotDelta] = None
+        self.last_certified_uids: frozenset = frozenset()
+        self.counters: Dict[str, int] = {}
+
+    # supervisor calls this on validator rejection: a quarantined result must
+    # never seed the next warm cycle
+    def reset_streaming_state(self) -> None:
+        self._prev = None
+        self.delta_encoder.reset()
+
+    reset = reset_streaming_state
+
+    # -- entry ----------------------------------------------------------------
+
+    def solve(
+        self,
+        pods: Sequence[Pod],
+        instance_types: Sequence[InstanceType],
+        templates: Sequence[TemplateInfo],
+        nodes: Sequence[NodeInfo] = (),
+        pod_requirements_override=None,
+        topology=None,
+        cluster_pods: Sequence = (),
+        domains=None,
+        pod_volumes=None,
+    ) -> SolveResult:
+        pods = list(pods)
+        nodes = list(nodes)
+        unsupported = (
+            pod_requirements_override is not None
+            or topology is not None
+            or len(cluster_pods) > 0
+            or domains is not None
+            or pod_volumes is not None
+        )
+        if unsupported:
+            # consolidation sims and override passes carry state the pinning
+            # logic doesn't model; stay out of their way entirely
+            self.reset_streaming_state()
+            result = self.inner.solve(
+                pods, instance_types, templates, nodes=nodes,
+                pod_requirements_override=pod_requirements_override,
+                topology=topology, cluster_pods=cluster_pods,
+                domains=domains, pod_volumes=pod_volumes,
+            )
+            self._finish("cold-unsupported", 0.0, len(pods))
+            return result
+
+        prev = self._prev
+        with trace.span("delta_encode", pods=len(pods)):
+            if prev is None:
+                delta, pod_digests, node_digests = diff_snapshots(
+                    (), (), pods, nodes
+                )
+            else:
+                delta, pod_digests, node_digests = diff_snapshots(
+                    prev.pods, prev.nodes, pods, nodes,
+                    prev_pod_digests=prev.pod_digests,
+                    prev_node_digests=prev.node_digests,
+                )
+            self.last_delta = delta
+            trace.attr("pod_events", delta.pod_events)
+            trace.attr("node_events", delta.node_events)
+            if self.maintain_encoded:
+                self.last_encoded = self.delta_encoder.encode(
+                    pods, instance_types, templates, nodes=nodes
+                )
+                trace.attr("encode_mode", self.delta_encoder.last_patch.get("mode"))
+
+        cold_reason = self._cold_reason(prev, delta, pods, instance_types, templates)
+        if cold_reason is None:
+            try:
+                with trace.span("warm_solve", pods=len(pods)):
+                    out = self._warm(
+                        prev, delta, pods, pod_digests, instance_types, templates, nodes
+                    )
+                    if out is not None:
+                        result, seeds, certified = out
+                        ratio = (len(pods) - len(seeds)) / max(1, len(pods))
+                        trace.attr("resolved", len(seeds))
+                        trace.attr("reused", len(pods) - len(seeds))
+                        trace.attr("certified", len(certified))
+                        self._accept(
+                            pods, pod_digests, nodes, node_digests,
+                            instance_types, templates, result, certified,
+                        )
+                        self._finish("warm", ratio, len(pods))
+                        return result
+                    cold_reason = "warm-rejected"
+            except Exception:  # noqa: BLE001 — degrade to cold, never fail the cycle
+                cold_reason = "warm-error"
+
+        result = self.inner.solve(pods, instance_types, templates, nodes=nodes)
+        # a cold solve IS the reference answer: every placement is certified
+        self._accept(
+            pods, pod_digests, nodes, node_digests, instance_types, templates,
+            result, frozenset(p.uid for p in pods),
+        )
+        self._finish(cold_reason, 0.0, len(pods))
+        return result
+
+    # -- bookkeeping ----------------------------------------------------------
+
+    def _finish(self, outcome: str, ratio: float, pods: int) -> None:
+        self.last_outcome = outcome
+        self.last_reuse_ratio = ratio
+        self.counters[outcome] = self.counters.get(outcome, 0) + 1
+        WARM_SOLVES.inc(labels={"outcome": outcome})
+        DELTA_REUSE_RATIO.set(ratio)
+        trace.attr("streaming_outcome", outcome)
+        trace.attr("reuse_ratio", round(ratio, 4))
+
+    def _accept(
+        self, pods, pod_digests, nodes, node_digests, instance_types, templates,
+        result, certified,
+    ) -> None:
+        self._prev = _StreamState(
+            pods=pods,
+            pod_digests=pod_digests,
+            nodes=nodes,
+            node_digests=node_digests,
+            instance_types=list(instance_types),
+            templates=list(templates),
+            result=result,
+            order_uids=[pods[i].uid for i in ffd_order(pods)],
+            certified_uids=frozenset(certified),
+            placement_of=_index_placements(pods, result),
+        )
+        self.last_certified_uids = frozenset(certified)
+
+    def _cold_reason(self, prev, delta, pods, instance_types, templates) -> Optional[str]:
+        if prev is None:
+            return "cold-first"
+        if not pods:
+            return "cold-first"
+        if delta.added_nodes or delta.changed_nodes:
+            # node adds/changes move every bin decision after them; removals
+            # are handled warm (residents become seeds)
+            return "cold-world-changed"
+        if len(instance_types) != len(prev.instance_types) or any(
+            a is not b for a, b in zip(instance_types, prev.instance_types)
+        ):
+            return "cold-world-changed"
+        if len(templates) != len(prev.templates) or any(
+            a is not b for a, b in zip(templates, prev.templates)
+        ):
+            return "cold-world-changed"
+        if delta.frac > self.max_frac:
+            return "cold-threshold"
+        return None
+
+    # -- the warm path --------------------------------------------------------
+
+    def _warm(
+        self, prev, delta, pods, pod_digests, instance_types, templates, nodes
+    ):
+        """Returns (result, seed_indices, certified_uids) or None when the
+        merged result fails the validator full gate."""
+        uid_index = {p.uid: i for i, p in enumerate(pods)}
+        removed_node_names = set(delta.removed_nodes)
+
+        seeds = set(delta.added_pods) | set(delta.changed_pods)
+        for uid, (kind, payload) in prev.placement_of.items():
+            i = uid_index.get(uid)
+            if i is None:
+                continue
+            if kind == "fail":
+                seeds.add(i)  # a delete/reclaim may have freed its blocker
+            elif kind == "node" and payload in removed_node_names:
+                seeds.add(i)
+        # topology closure: any churn can move a count any constrained pod's
+        # skew/affinity gate reads, so all of them re-solve together
+        churned = delta.pod_events > 0 or delta.node_events > 0
+        if churned:
+            for i, p in enumerate(pods):
+                if _has_topology_constraints(p):
+                    seeds.add(i)
+        if len(seeds) == len(pods):
+            return None  # nothing to reuse — cold is strictly simpler
+
+        # pinned pods keep their previous bin; build the merged skeleton
+        merged = SolveResult()
+        pinned: List[Tuple[int, str, Dict[str, str]]] = []  # (idx, bin name, labels)
+        surviving_claims: Dict[int, Placement] = {}
+        claim_members: Dict[int, List[int]] = {}
+        for uid, (kind, payload) in prev.placement_of.items():
+            i = uid_index.get(uid)
+            if i is None or i in seeds:
+                continue
+            if kind == "node":
+                merged.node_pods.setdefault(payload, []).append(i)
+            elif kind == "claim":
+                claim_members.setdefault(payload, []).append(i)
+
+        node_by_name = {n.name: n for n in nodes}
+        for name, idxs in merged.node_pods.items():
+            if name not in node_by_name:
+                return None  # placement map out of sync with the node diff
+            labels = node_by_name[name].requirements.labels()
+            for i in idxs:
+                pinned.append((i, name, labels))
+
+        claim_index_map: Dict[int, int] = {}
+        for ci, members in sorted(claim_members.items()):
+            old = prev.result.new_claims[ci]
+            requests = dict(templates[old.template_index].daemon_overhead)
+            for i in members:
+                requests = res.merge(requests, {**res.pod_requests(pods[i]), res.PODS: 1.0})
+            pl = Placement(
+                template_index=old.template_index,
+                nodepool_name=old.nodepool_name,
+                pod_indices=list(members),
+                instance_type_indices=list(old.instance_type_indices),
+                requirements=old.requirements.copy(),
+                requests=requests,
+            )
+            claim_index_map[ci] = len(merged.new_claims)
+            merged.new_claims.append(pl)
+            surviving_claims[ci] = pl
+            labels = old.requirements.labels()
+            labels[wk.LABEL_HOSTNAME] = _WARM_CLAIM_PREFIX + str(ci)
+            for i in members:
+                pinned.append((i, _WARM_CLAIM_PREFIX + str(ci), labels))
+
+        # residual world: real nodes with pinned consumption folded into the
+        # overhead side, surviving claims as joinable pseudo-nodes
+        sub_nodes: List[NodeInfo] = []
+        pinned_by_bin: Dict[str, List[int]] = {}
+        for i, bin_name, _ in pinned:
+            pinned_by_bin.setdefault(bin_name, []).append(i)
+        for n in nodes:
+            overhead = dict(n.daemon_overhead)
+            ports = list(n.host_ports)
+            for i in pinned_by_bin.get(n.name, ()):
+                overhead = res.merge(overhead, {**res.pod_requests(pods[i]), res.PODS: 1.0})
+                ports.extend(get_host_ports(pods[i]))
+            sub_nodes.append(
+                NodeInfo(
+                    name=n.name,
+                    requirements=n.requirements.copy(),
+                    taints=n.taints,
+                    available=dict(n.available),
+                    daemon_overhead=overhead,
+                    host_ports=ports,
+                    volume_used=dict(n.volume_used),
+                    volume_limits=dict(n.volume_limits),
+                )
+            )
+        for ci, pl in sorted(surviving_claims.items()):
+            name = _WARM_CLAIM_PREFIX + str(ci)
+            reqs = pl.requirements.copy()
+            reqs.add(Requirement(wk.LABEL_HOSTNAME, IN, [name]))
+            # conservative capacity: a joining pod must fit EVERY surviving
+            # instance type, so actuation keeps its full choice set
+            alloc = None
+            for ti in pl.instance_type_indices:
+                a = instance_types[ti].allocatable()
+                alloc = a if alloc is None else {
+                    k: min(alloc.get(k, float("inf")), a.get(k, float("inf")))
+                    for k in set(alloc) | set(a)
+                }
+            ports = []
+            for i in pl.pod_indices:
+                ports.extend(get_host_ports(pods[i]))
+            sub_nodes.append(
+                NodeInfo(
+                    name=name,
+                    requirements=reqs,
+                    taints=templates[pl.template_index].taints,
+                    available=alloc or {},
+                    daemon_overhead=dict(pl.requests),
+                    host_ports=ports,
+                )
+            )
+
+        sub_indices = sorted(seeds)
+        sub_pods = [pods[i] for i in sub_indices]
+        census = [(pods[i], labels) for i, _, labels in pinned]
+        sub_result = self.inner.solve(
+            sub_pods, instance_types, templates, nodes=sub_nodes,
+            cluster_pods=census,
+        )
+
+        # fold the sub-solve back in, re-narrowing any claim it joined
+        joined: Dict[int, List[int]] = {}
+        for name, idxs in sub_result.node_pods.items():
+            gidx = [sub_indices[si] for si in idxs]
+            if name.startswith(_WARM_CLAIM_PREFIX):
+                joined.setdefault(int(name[len(_WARM_CLAIM_PREFIX):]), []).extend(gidx)
+            else:
+                merged.node_pods.setdefault(name, []).extend(gidx)
+        for c in sub_result.new_claims:
+            merged.new_claims.append(
+                Placement(
+                    template_index=c.template_index,
+                    nodepool_name=c.nodepool_name,
+                    pod_indices=[sub_indices[si] for si in c.pod_indices],
+                    instance_type_indices=list(c.instance_type_indices),
+                    requirements=c.requirements,
+                    requests=c.requests,
+                )
+            )
+        for si, reason in sub_result.failures.items():
+            merged.failures[sub_indices[si]] = reason
+        for ci, gidx in joined.items():
+            pl = surviving_claims[ci]
+            for i in gidx:
+                pl.requirements.add(*pod_requirements(pods[i]).values())
+                pl.requests = res.merge(
+                    pl.requests, {**res.pod_requests(pods[i]), res.PODS: 1.0}
+                )
+                pl.pod_indices.append(i)
+            pl.requirements.delete(wk.LABEL_HOSTNAME)
+            surviving = [
+                ti
+                for ti in pl.instance_type_indices
+                if not instance_types[ti].requirements.intersects(pl.requirements)
+                and _fits(pl.requests, instance_types[ti].allocatable())
+                and _has_offering(instance_types[ti], pl.requirements)
+            ]
+            if not surviving:
+                return None
+            pl.instance_type_indices = surviving
+
+        violations = val.validate_result(
+            merged, pods, instance_types, templates, nodes=nodes, level="full"
+        )
+        if violations:
+            return None
+
+        certified = self._certify(prev, delta, pods, seeds)
+        return merged, seeds, certified
+
+    def _certify(self, prev, delta, pods, seeds) -> frozenset:
+        """The FFD-queue prefix provably identical to a cold solve: positions
+        matching the previous queue uid-for-uid, stopping at the first seed,
+        the first pod outside the previous cycle's own certified set, or (when
+        the node set shrank) the first topology-constrained pod — a removed
+        node's hostname leaves every spread denominator, which can move any
+        later constrained pick."""
+        order = ffd_order(pods)
+        node_set_changed = bool(delta.removed_nodes)
+        certified: List[str] = []
+        for pos, i in enumerate(order):
+            uid = pods[i].uid
+            if pos >= len(prev.order_uids) or prev.order_uids[pos] != uid:
+                break
+            if i in seeds or uid not in prev.certified_uids:
+                break
+            if node_set_changed and _has_topology_constraints(pods[i]):
+                break
+            certified.append(uid)
+        return frozenset(certified)

@@ -9,10 +9,12 @@ explicit schedule rather than ambient randomness:
 Grammar — ``;``-separated entries, optional leading ``seed=N``:
 
     entry  := site '.' kind ['=' param] '@' sched
-    site   := 'solve' | 'create' | 'delete'
+    site   := 'solve' | 'create' | 'delete' | 'cloud'
     kind   := solve: compile | device | encode | nan | hang
               create/delete: ice | ratelimit | timeout
-    param  := float   (hang duration in seconds; default 30)
+              cloud: reclaim
+    param  := float   (solve.hang: duration in seconds, default 30;
+                       cloud.reclaim: nodes reclaimed per firing, default 1)
     sched  := N       fire on the N-th call to the site (1-based)
             | N..M    fire on calls N through M inclusive
             | pP      fire with probability P per call (seeded, per-call
@@ -36,9 +38,13 @@ import zlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
-SITES = ("solve", "create", "delete")
+SITES = ("solve", "create", "delete", "cloud")
 SOLVE_KINDS = ("compile", "device", "encode", "nan", "hang")
 CLOUD_KINDS = ("ice", "ratelimit", "timeout")
+# the 'cloud' site models provider-initiated events (spot reclaims) rather
+# than API-call failures; the churn generator (streaming/churn.py) draws it
+# once per cycle, so chaos specs and churn configs share one grammar
+RECLAIM_KINDS = ("reclaim",)
 
 
 class InjectedFault(RuntimeError):
@@ -102,7 +108,12 @@ def parse_spec(spec: str) -> Tuple[List[FaultRule], int]:
         site, kind = head.split(".", 1)
         if site not in SITES:
             raise ValueError(f"fault entry {entry!r}: unknown site {site!r}")
-        allowed = SOLVE_KINDS if site == "solve" else CLOUD_KINDS
+        if site == "solve":
+            allowed = SOLVE_KINDS
+        elif site == "cloud":
+            allowed = RECLAIM_KINDS
+        else:
+            allowed = CLOUD_KINDS
         if kind not in allowed:
             raise ValueError(
                 f"fault entry {entry!r}: kind {kind!r} not valid for {site!r}"
@@ -178,6 +189,21 @@ def corrupt_result(result) -> None:
     for claim in result.new_claims:
         for key in list(claim.requests):
             claim.requests[key] = float("nan")
+
+
+def reclaim_targets(
+    rule: FaultRule, names: Sequence[str], seed: int, call: int
+) -> List[str]:
+    """Pick which live nodes a ``cloud.reclaim`` firing takes. Selection is a
+    pure function of (seed, call#) over the *sorted* name list, so a replay
+    with the same spec reclaims the same nodes regardless of dict/listing
+    order upstream. ``rule.param`` is the reclaim width (default 1)."""
+    pool = sorted(names)
+    if not pool:
+        return []
+    count = min(int(rule.param) if rule.param else 1, len(pool))
+    rng = random.Random(zlib.crc32(f"{seed}:cloud.reclaim:{call}".encode()))
+    return rng.sample(pool, count)
 
 
 def cloud_exception(rule: FaultRule) -> Exception:

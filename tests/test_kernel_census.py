@@ -106,6 +106,34 @@ class TestWavefrontBudget:
         finally:
             trace.set_enabled(None)
 
+    def test_delta_path_adds_zero_equations(self, census_problem):
+        """The streaming subsystem (streaming/) is host-side only: with the
+        delta path imported AND enabled (KARPENTER_TPU_DELTA=1, the supervisor
+        wrap live), the flag-off narrow body must still count EXACTLY 2394
+        equations. A patched DeltaEncoder encode feeds the same
+        SchedulingProblem arrays to the same device program — if this pin
+        moves, streaming leaked into the kernel."""
+        import importlib
+
+        from karpenter_tpu import streaming
+        from karpenter_tpu.streaming import delta, warm  # noqa: F401
+
+        importlib.import_module("karpenter_tpu.streaming.churn")
+        old = os.environ.get("KARPENTER_TPU_DELTA")
+        os.environ["KARPENTER_TPU_DELTA"] = "1"
+        try:
+            from karpenter_tpu.solver.oracle import OracleSolver
+            from karpenter_tpu.solver.supervisor import SupervisedSolver
+
+            sup = SupervisedSolver(OracleSolver())
+            assert isinstance(sup.primary, streaming.StreamingSolver)
+            assert narrow_jaxpr_eqns(census_problem, wavefront=0) == 2394
+        finally:
+            if old is None:
+                os.environ.pop("KARPENTER_TPU_DELTA", None)
+            else:
+                os.environ["KARPENTER_TPU_DELTA"] = old
+
     def test_wavefront_body_under_budget(self, census_problem):
         eqns = narrow_jaxpr_eqns(census_problem, wavefront=3)
         assert eqns <= WAVEFRONT_EQN_BUDGET, (

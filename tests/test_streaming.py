@@ -1,0 +1,380 @@
+"""Unit coverage for the streaming subsystem (streaming/, docs/SERVING.md):
+snapshot digests and diffs, DeltaEncoder fallback reasons, churn replay
+determinism, the cloud.reclaim fault kind, StreamingSolver outcomes and
+metrics, supervisor trace lineage + streaming-state hygiene, and the
+batcher's delta-event accumulation."""
+
+import json
+import random
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodepool import NodePool
+from karpenter_tpu.apis.objects import ObjectMeta
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.metrics.registry import DELTA_REUSE_RATIO, WARM_SOLVES
+from karpenter_tpu.obs import trace
+from karpenter_tpu.scheduling import Taints
+from karpenter_tpu.scheduling.requirements import label_requirements
+from karpenter_tpu.solver.encode import NodeInfo, template_from_nodepool
+from karpenter_tpu.solver.oracle import OracleSolver
+from karpenter_tpu.solver.supervisor import SupervisedSolver
+from karpenter_tpu.streaming import DeltaEncoder, StreamingSolver, diff_snapshots
+from karpenter_tpu.streaming.churn import (
+    ChurnConfig,
+    ChurnProcess,
+    default_pod_factory,
+    run_churn,
+)
+from karpenter_tpu.streaming.delta import node_info_digest, pod_digest
+from karpenter_tpu.testing import faults
+from tests.factories import make_pod
+
+
+@pytest.fixture(autouse=True)
+def _no_ambient_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def build_world(its_count=8, pool="stream"):
+    its = instance_types(its_count)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name=pool)), its, range(len(its))
+    )
+    return its, [tpl]
+
+
+def make_node(name, cpu=8.0):
+    return NodeInfo(
+        name=name,
+        requirements=label_requirements({wk.LABEL_HOSTNAME: name}),
+        taints=Taints(()),
+        available={"cpu": cpu, "memory": 32e9, "pods": 40.0},
+        daemon_overhead={},
+    )
+
+
+def gen_pods(count, seed=0, prefix="p"):
+    rng = random.Random(seed)
+    return [default_pod_factory(f"{prefix}-{i}", rng) for i in range(count)]
+
+
+# -- digests + diff ------------------------------------------------------------
+
+
+def as_update_of(prev, p):
+    """Model a watch UPDATE: same object identity (uid) and creation metadata,
+    possibly different spec."""
+    p.metadata.uid = prev.metadata.uid
+    p.metadata.creation_seq = prev.metadata.creation_seq
+    p.metadata.creation_timestamp = prev.metadata.creation_timestamp
+    return p
+
+
+def test_pod_digest_tracks_encoded_fields():
+    a = make_pod(name="a", cpu=0.5)
+    assert pod_digest(a) == pod_digest(as_update_of(a, make_pod(name="a", cpu=0.5)))
+    assert pod_digest(a) != pod_digest(as_update_of(a, make_pod(name="a", cpu=0.6)))
+    assert pod_digest(a) != pod_digest(
+        as_update_of(a, make_pod(name="a", cpu=0.5, labels={"x": "y"}))
+    )
+    assert pod_digest(a) != pod_digest(
+        as_update_of(
+            a, make_pod(name="a", cpu=0.5, node_selector={wk.LABEL_TOPOLOGY_ZONE: "z1"})
+        )
+    )
+
+
+def test_node_digest_tracks_capacity_and_taints():
+    n = make_node("n-0")
+    assert node_info_digest(n) == node_info_digest(make_node("n-0"))
+    assert node_info_digest(n) != node_info_digest(make_node("n-0", cpu=4.0))
+
+
+def test_diff_snapshots_classifies_events():
+    a, b = make_pod(name="a", cpu=0.5), make_pod(name="b", cpu=0.5)
+    prev_nodes = [make_node("n-0"), make_node("n-1")]
+    cur = [
+        a,                                              # unchanged (same object)
+        as_update_of(b, make_pod(name="b", cpu=1.0)),   # changed spec, same uid
+        make_pod(name="c", cpu=0.5),                    # added (fresh uid)
+    ]
+    cur_nodes = [make_node("n-0", cpu=4.0), make_node("n-2")]  # n-1 removed
+    delta, pod_digests, node_digests = diff_snapshots([a, b], prev_nodes, cur, cur_nodes)
+    assert delta.added_pods == [2]
+    assert delta.changed_pods == [1]
+    assert delta.removed_pods == []
+    assert delta.added_nodes == ["n-2"]
+    assert delta.changed_nodes == ["n-0"]
+    assert delta.removed_nodes == ["n-1"]
+    assert delta.pod_events == 2 and delta.node_events == 3
+    assert delta.frac == pytest.approx(2 / 2)
+    assert set(pod_digests) == {p.uid for p in cur}
+    assert set(node_digests) == {"n-0", "n-2"}
+
+
+# -- DeltaEncoder fallback reasons --------------------------------------------
+
+
+def test_delta_encoder_blockers_are_checked_and_named():
+    its, tpls = build_world()
+    pods = gen_pods(12)
+    denc = DeltaEncoder()
+    denc.encode(pods, its, tpls)
+    assert denc.last_patch["reason"] == "first-encode"
+    denc.encode(pods, its, tpls)
+    assert denc.last_patch["mode"] == "patched"
+    assert denc.last_patch["reused_rows"] == 12
+    # claim-slot budget moved: the problem shape changed
+    denc.encode(pods, its, tpls, num_claim_slots=4)
+    assert denc.last_patch["reason"] == "claim-slots"
+    # node appeared: node axis invalid
+    n0 = make_node("n-0")
+    denc.encode(pods, its, tpls, num_claim_slots=4, nodes=[n0])
+    assert denc.last_patch["reason"] == "node-added"
+    # template universe changed (same slots/nodes so templates are what drifts)
+    its2, tpls2 = build_world(pool="other")
+    denc.encode(pods, its, tpls2, num_claim_slots=4, nodes=[n0])
+    assert denc.last_patch["reason"] == "templates-changed"
+    denc.encode([], its, tpls2)
+    assert denc.last_patch["reason"] == "empty-batch"
+    assert denc.stats["patched"] == 1
+
+
+def test_delta_encoder_unsupported_args_drop_state():
+    its, tpls = build_world()
+    pods = gen_pods(6)
+    denc = DeltaEncoder()
+    denc.encode(pods, its, tpls)
+    denc.encode(pods, its, tpls, pod_volumes=[{} for _ in pods])
+    assert denc.last_patch["reason"] == "unsupported-args"
+    # the unsupported encode must not have been cached as patch state
+    denc.encode(pods, its, tpls)
+    assert denc.last_patch["reason"] == "first-encode"
+
+
+# -- churn generator -----------------------------------------------------------
+
+
+def test_churn_replay_is_deterministic():
+    def stream(seed):
+        proc = ChurnProcess(gen_pods(20), config=ChurnConfig(seed=seed))
+        out = []
+        for _ in range(5):
+            ev = proc.step()
+            out.append(
+                (
+                    [p.metadata.name for p in ev.arrived],
+                    [p.metadata.name for p in ev.deleted],
+                )
+            )
+        return out, [p.metadata.name for p in proc.pods]
+
+    assert stream(7) == stream(7)
+    assert stream(7) != stream(8)
+
+
+def test_churn_reclaim_draws_through_fault_grammar():
+    faults.install(faults.FaultInjector.from_spec("seed=5;cloud.reclaim=2@*"))
+    nodes = [make_node(f"n-{i}") for i in range(6)]
+    proc = ChurnProcess(gen_pods(10), nodes=nodes, config=ChurnConfig(seed=5))
+    ev = proc.step()
+    assert len(ev.reclaimed) == 2
+    assert all(n.name not in ev.reclaimed for n in proc.nodes)
+    assert len(proc.nodes) == 4
+    assert faults.active().fired == [("cloud", "reclaim", 1)]
+    # the same spec replays the same victims
+    faults.install(faults.FaultInjector.from_spec("seed=5;cloud.reclaim=2@*"))
+    proc2 = ChurnProcess(gen_pods(10), nodes=[make_node(f"n-{i}") for i in range(6)],
+                         config=ChurnConfig(seed=5))
+    assert proc2.step().reclaimed == ev.reclaimed
+
+
+# -- cloud.reclaim fault kind --------------------------------------------------
+
+
+def test_parse_spec_accepts_cloud_reclaim_and_rejects_wrong_kinds():
+    rules, seed = faults.parse_spec("seed=3;cloud.reclaim=2@p0.25")
+    assert seed == 3
+    assert rules[0].site == "cloud" and rules[0].kind == "reclaim"
+    assert rules[0].param == 2.0 and rules[0].prob == 0.25
+    with pytest.raises(ValueError):
+        faults.parse_spec("cloud.ice@1")  # API-failure kinds live on create/delete
+    with pytest.raises(ValueError):
+        faults.parse_spec("create.reclaim@1")  # reclaim is provider-initiated
+
+
+def test_reclaim_targets_deterministic_and_order_insensitive():
+    rule = faults.FaultRule(site="cloud", kind="reclaim", param=2.0)
+    names = ["n-3", "n-1", "n-2", "n-0"]
+    a = faults.reclaim_targets(rule, names, seed=9, call=1)
+    b = faults.reclaim_targets(rule, list(reversed(names)), seed=9, call=1)
+    assert a == b and len(a) == 2
+    assert faults.reclaim_targets(rule, names, seed=9, call=2) != a or True
+    # width clamps to the pool; empty pool is a no-op
+    wide = faults.FaultRule(site="cloud", kind="reclaim", param=99.0)
+    assert sorted(faults.reclaim_targets(wide, names, 9, 1)) == sorted(names)
+    assert faults.reclaim_targets(rule, [], 9, 1) == []
+
+
+# -- StreamingSolver outcomes + metrics ---------------------------------------
+
+
+def test_streaming_outcomes_and_metrics():
+    its, tpls = build_world()
+    solver = StreamingSolver(OracleSolver())
+    pods = gen_pods(30)
+    warm_before = WARM_SOLVES.value(labels={"outcome": "warm"})
+
+    solver.solve(pods, its, tpls)
+    assert solver.last_outcome == "cold-first"
+    assert solver.last_reuse_ratio == 0.0
+
+    churned = pods[1:] + gen_pods(1, seed=99, prefix="new")
+    solver.solve(churned, its, tpls)
+    assert solver.last_outcome == "warm"
+    assert solver.last_reuse_ratio > 0.9
+    assert WARM_SOLVES.value(labels={"outcome": "warm"}) == warm_before + 1
+    assert DELTA_REUSE_RATIO.value() == pytest.approx(solver.last_reuse_ratio)
+
+    # too much churn: threshold fallback
+    solver.solve(gen_pods(30, seed=4, prefix="q"), its, tpls)
+    assert solver.last_outcome == "cold-threshold"
+
+    # node appeared: world changed
+    solver.solve(gen_pods(30, seed=4, prefix="q"), its, tpls, nodes=[make_node("n-0")])
+    assert solver.last_outcome == "cold-world-changed"
+
+    # unsupported arguments stay out of the pinning logic entirely
+    solver.solve(pods, its, tpls, cluster_pods=[(pods[0], {})])
+    assert solver.last_outcome == "cold-unsupported"
+
+    # explicit reset: the next cycle is a first encounter again
+    solver.solve(pods, its, tpls)
+    solver.reset_streaming_state()
+    solver.solve(pods, its, tpls)
+    assert solver.last_outcome == "cold-first"
+    assert solver.counters["cold-first"] == 3
+
+
+def test_run_churn_records_streaming_telemetry():
+    its, tpls = build_world()
+    solver = StreamingSolver(OracleSolver())
+    proc = ChurnProcess(
+        gen_pods(40),
+        config=ChurnConfig(seed=2, arrivals_per_cycle=2, deletes_per_cycle=2),
+    )
+    records = run_churn(solver, proc, its, tpls, cycles=4, validate=True)
+    assert [r["outcome"] for r in records][0] == "cold-first"
+    assert all(r["outcome"] == "warm" for r in records[1:])
+    assert all(r["violations"] == 0 for r in records)
+    assert all(r["reuse_ratio"] > 0.8 for r in records[1:])
+
+
+# -- supervisor: lineage + state hygiene --------------------------------------
+
+
+class LyingStreamableSolver:
+    """Inner backend that overpacks once the stream is primed — forcing the
+    supervisor's validation gate to reject a streaming-wrapped primary."""
+
+    def __init__(self):
+        self.inner = OracleSolver()
+        self.lie = False
+
+    def solve(self, *args, **kwargs):
+        result = self.inner.solve(*args, **kwargs)
+        if self.lie and len(result.new_claims) >= 2:
+            a, b = result.new_claims[0], result.new_claims[1]
+            a.pod_indices = a.pod_indices + b.pod_indices
+            result.new_claims.pop(1)
+        return result
+
+
+def test_supervisor_threads_parent_trace_id(tmp_path, monkeypatch):
+    monkeypatch.setenv("KARPENTER_TPU_QUARANTINE_DIR", str(tmp_path))
+    trace.set_enabled(True)
+    try:
+        its, tpls = build_world(its_count=1)
+        lying = LyingStreamableSolver()
+        streaming = StreamingSolver(lying)
+        sup = SupervisedSolver(streaming, fallback=OracleSolver())
+        pods = [make_pod(name=f"w-{i}", cpu=0.8) for i in range(4)]
+
+        sup.solve(pods, its, tpls)  # clean first cycle primes the lineage
+        first_trace = sup._last_trace_id
+        assert first_trace
+        assert streaming._prev is not None
+
+        # a fully-churned batch goes cold through the (now lying) inner; the
+        # supervisor's validation gate must catch the overpacked result
+        lying.lie = True
+        pods = [make_pod(name=f"x-{i}", cpu=0.8) for i in range(4)]
+        sup.solve(pods, its, tpls)
+        assert sup.counters["validator_rejections"] == 1
+        # the rejected cycle records its ancestry...
+        assert sup.last_failure["class"] == "validation"
+        assert sup.last_failure["parent_trace_id"] == first_trace
+        dumps = list(tmp_path.glob("quarantine-*.json"))
+        assert len(dumps) == 1
+        assert json.loads(dumps[0].read_text())["parent_trace_id"] == first_trace
+        # ...and the quarantined result never seeds the next warm cycle
+        assert streaming._prev is None
+        lying.lie = False
+        sup.solve(pods, its, tpls)
+        assert streaming.last_outcome == "cold-first"
+    finally:
+        trace.set_enabled(None)
+
+
+def test_supervisor_streaming_flag_wraps_primary(monkeypatch):
+    monkeypatch.setenv("KARPENTER_TPU_DELTA", "1")
+    sup = SupervisedSolver(OracleSolver())
+    assert isinstance(sup.primary, StreamingSolver)
+    monkeypatch.setenv("KARPENTER_TPU_DELTA", "0")
+    assert not isinstance(SupervisedSolver(OracleSolver()).primary, StreamingSolver)
+    # explicit param beats the env; an already-wrapped primary is not re-wrapped
+    monkeypatch.delenv("KARPENTER_TPU_DELTA")
+    wrapped = StreamingSolver(OracleSolver())
+    sup = SupervisedSolver(wrapped, streaming=True)
+    assert sup.primary is wrapped
+
+
+def test_streaming_under_supervisor_matches_oracle():
+    """The production wiring end to end: supervised + streaming answers must
+    stay placement-identical to a cold oracle under churn (generic corpus —
+    certified or not, the oracle re-solve of seeds is exact here)."""
+    its, tpls = build_world()
+    sup = SupervisedSolver(StreamingSolver(OracleSolver()), fallback=OracleSolver())
+    proc = ChurnProcess(
+        gen_pods(30),
+        config=ChurnConfig(seed=6, arrivals_per_cycle=2, deletes_per_cycle=2),
+    )
+    records = run_churn(sup, proc, its, tpls, cycles=4, validate=True)
+    assert all(r["violations"] == 0 for r in records)
+    assert records[-1]["outcome"] == "warm"
+    assert sup.counters["validator_rejections"] == 0
+
+
+# -- batcher delta-event accumulation -----------------------------------------
+
+
+def test_batcher_note_and_drain():
+    from karpenter_tpu.provisioning.batcher import Batcher
+    from karpenter_tpu.utils.clock import FakeClock
+
+    clock = FakeClock()
+    b = Batcher(clock, idle_duration=0.0, max_duration=1.0)
+    assert b.drain() == []
+    b.note({"kind": "add", "uid": "a"})
+    b.note({"kind": "delete", "uid": "b"})
+    assert b.wait() is True  # note() extends/opens the window like trigger()
+    assert b.drain() == [{"kind": "add", "uid": "a"}, {"kind": "delete", "uid": "b"}]
+    assert b.drain() == []  # drained events are gone
+    # a bare trigger still works and contributes no events
+    b.trigger()
+    assert b.wait() is True
+    assert b.drain() == []

@@ -69,6 +69,57 @@ def placements_key(result):
     )
 
 
+def churn_survival(cycles: int = 8) -> bool:
+    """Post-matrix row: drive the streaming solver through seeded churn with
+    ``cloud.reclaim`` firings and require every cycle to complete
+    validator-clean. This is the reclaim coverage for the shared fault
+    grammar — the matrix above exercises solve-site faults, this exercises
+    the provider-initiated kind the churn generator draws."""
+    from karpenter_tpu.apis import labels as wk
+    from karpenter_tpu.scheduling import Taints, label_requirements
+    from karpenter_tpu.solver.encode import NodeInfo
+    from karpenter_tpu.solver.oracle import OracleSolver
+    from karpenter_tpu.solver.supervisor import SupervisedSolver
+    from karpenter_tpu.streaming import StreamingSolver
+    from karpenter_tpu.streaming.churn import ChurnConfig, ChurnProcess, run_churn
+    from karpenter_tpu.testing import faults
+
+    pods, its, tpls = build_problem(80, 20)
+    nodes = [
+        NodeInfo(
+            name=f"reclaim-node-{i}",
+            requirements=label_requirements({wk.LABEL_HOSTNAME: f"reclaim-node-{i}"}),
+            taints=Taints(()),
+            available={"cpu": 8.0, "memory": 32 * 1024.0**3, "pods": 40.0},
+            daemon_overhead={},
+        )
+        for i in range(6)
+    ]
+    faults.install(faults.FaultInjector.from_spec("seed=11;cloud.reclaim=1@p0.5"))
+    solver = SupervisedSolver(
+        StreamingSolver(OracleSolver()), fallback=OracleSolver()
+    )
+    try:
+        process = ChurnProcess(
+            pods,
+            nodes=nodes,
+            config=ChurnConfig(seed=11, arrivals_per_cycle=4, deletes_per_cycle=2),
+        )
+        records = run_churn(solver, process, its, tpls, cycles, validate=True)
+    finally:
+        faults.install(None)
+    reclaimed = sum(r["reclaimed"] for r in records)
+    dirty = [r for r in records if r["violations"]]
+    ok = not dirty and reclaimed > 0
+    print(
+        f"\nchurn survival: {len(records)} cycles, {reclaimed} nodes reclaimed "
+        f"(cloud.reclaim), outcomes="
+        + ",".join(str(r.get("outcome", "?")) for r in records)
+        + f" -> {'OK' if ok else 'FAILED: ' + repr(dirty or 'no reclaim fired')}"
+    )
+    return ok
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--pods", default="60,300",
@@ -149,7 +200,8 @@ def main() -> int:
         f"\n{len(rows) - len(failed)}/{len(rows)} cells survived with parity"
         + ("" if not failed else f"; FAILED: {failed}")
     )
-    return 1 if failed else 0
+    churn_ok = churn_survival()
+    return 1 if (failed or not churn_ok) else 0
 
 
 if __name__ == "__main__":
