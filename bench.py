@@ -33,6 +33,11 @@ Env knobs:
   BENCH_DEADLINE=secs   global budget for the child (default 2400)
   BENCH_STALL=secs      per-line stall timeout (default 600; first TPU
                         compile of the biggest bucket can take minutes)
+  BENCH_LABEL=name      label stamped on the emitted history row
+  BENCH_HISTORY=path    append the history row (tools/perf_gate.py schema,
+                        docs/PERF_NOTES.md) to this jsonl file — unset means
+                        emit-only, so CI runs never mutate the committed
+                        bench_history.jsonl
 """
 
 from __future__ import annotations
@@ -178,6 +183,9 @@ def run_child():
 
     quiet_xla_warnings(notify_stderr=True)
     os.environ.setdefault("KARPENTER_TPU_TRACE", "1")
+    # program registry on for the whole run: per-program compile attribution
+    # and per-cycle device-memory watermarks ride every shape event below
+    os.environ.setdefault("KARPENTER_TPU_PROGRAMS", "1")
 
     import __graft_entry__
 
@@ -295,9 +303,40 @@ def run_child():
             "misses": cc_misses,
             "hit_rate": round(cc_hits / max(cc_hits + cc_misses, 1), 4),
         }
+        # device-memory watermark of this shape's last solve cycle
+        # (obs/programs.py sample: live/peak device bytes + carried FFDState)
+        from karpenter_tpu.obs import programs as obs_programs
+
+        mem = obs_programs.registry().snapshot()["memory"]["last"]
+        if mem is not None:
+            ev["device_memory"] = {
+                k: mem[k]
+                for k in ("live_bytes", "peak_bytes", "carried_state_bytes",
+                          "source")
+            }
         emit(ev)
     if first_solve is not None:
         emit({"event": "first_solve", **first_solve})
+
+    # the run's compile bill, itemized (obs/programs.py): every program the
+    # grid compiled, its wall cost and cache source — the forensics for a
+    # compile_s regression
+    from karpenter_tpu.obs import programs as obs_programs
+
+    snap = obs_programs.registry().snapshot()
+    emit({
+        "event": "programs",
+        "totals": snap["totals"],
+        "top": [
+            {
+                "program": p["program"],
+                "compile_s": p["compile_s_total"],
+                "launches": p["launches"],
+                "sources": p["sources"],
+            }
+            for p in snap["programs"][:10]
+        ],
+    })
 
     # cold-process latency: how long a FRESH process (persistent compile
     # cache populated by the grid above) takes from exec to a completed
@@ -306,6 +345,11 @@ def run_child():
     if not os.environ.get("BENCH_QUICK"):
         code = (
             "import time; t0=time.perf_counter();"
+            # quiet before jax's C++ backend loads (inherited env covers the
+            # common case; explicit so the coldstart child stays clean even
+            # when spawned from an unquieted environment)
+            "from karpenter_tpu.operator.logging import quiet_xla_warnings;"
+            "quiet_xla_warnings();"
             "import __graft_entry__; __graft_entry__._respect_platform_env();"
             "import random; from bench import make_diverse_pods;"
             "from karpenter_tpu.apis.nodepool import NodePool;"
@@ -491,6 +535,8 @@ def _probe(env) -> bool:
     """Can the requested backend run a tiny op at all? Cheap fail-fast guard
     so a wedged TPU tunnel doesn't eat the whole budget."""
     code = (
+        "from karpenter_tpu.operator.logging import quiet_xla_warnings;"
+        "quiet_xla_warnings();"
         "import __graft_entry__, jax;"
         "__graft_entry__._respect_platform_env();"
         "x = jax.numpy.ones((4, 4));"
@@ -593,6 +639,13 @@ def _run_measurement(env):
 
 
 def main():
+    # quiet the PARENT before the env snapshot below: the probe and child
+    # subprocesses inherit TF_CPP_MIN_LOG_LEVEL from it, so the XLA machine-
+    # feature/SIGILL dump can't leak into their stderr tails (the residual
+    # spam visible in BENCH_r05 came from the unquieted probe, not the child)
+    from karpenter_tpu.operator.logging import quiet_xla_warnings
+
+    quiet_xla_warnings(notify_stderr=True)
     base_env = dict(os.environ)
     platform = "tpu"
     if not _probe(base_env):
@@ -725,6 +778,27 @@ def main():
     cold = next((e for e in events if e.get("event") == "coldstart"), None)
     if cold is not None and "cold_s" in cold:
         out["coldstart_2500_s"] = cold["cold_s"]
+    # per-shape device-memory watermarks (obs/programs.py samples); the
+    # 2500-pod peak is the headline number carried-buffer work tracks
+    if any("device_memory" in e for e in shapes):
+        out["per_shape_device_memory"] = {
+            str(e["pods"]): e["device_memory"]
+            for e in shapes
+            if "device_memory" in e
+        }
+        mem_2500 = next(
+            (e["device_memory"] for e in shapes
+             if e["pods"] == 2500 and "device_memory" in e), None
+        )
+        if mem_2500 is not None:
+            out["device_peak_bytes_2500"] = mem_2500["peak_bytes"]
+    progs = next((e for e in events if e.get("event") == "programs"), None)
+    if progs is not None:
+        # the itemized compile bill: totals + the 10 most expensive programs
+        out["program_summary"] = {
+            "totals": progs.get("totals"),
+            "top": progs.get("top"),
+        }
     if consol:
         rate = lambda e: e["candidates"] / max(e["solve_s"], 1e-9)
         best = max(consol, key=rate)
@@ -755,10 +829,31 @@ def main():
         # a solver that drops pods must not read as a throughput win
         # (reference asserts full schedulability of the diverse mix)
         out["error"] = f"only {scheduled}/{total_pods} pods scheduled"
-        print(json.dumps(out))
-        return 1
+    _emit_history_row(out)
     print(json.dumps(out))
-    return 0
+    return 1 if "error" in out else 0
+
+
+def _emit_history_row(out: dict) -> None:
+    """Stamp the stable machine-readable history row (tools/perf_gate.py
+    schema, docs/PERF_NOTES.md) onto the output, and append it to
+    $BENCH_HISTORY when set — appending is opt-in so automated runs never
+    mutate the committed bench_history.jsonl."""
+    try:
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from tools.perf_gate import row_from_bench
+    except Exception as exc:
+        out["history_row_error"] = repr(exc)
+        return
+    row = row_from_bench(out, label=os.environ.get("BENCH_LABEL", "run"))
+    out["history_row"] = row
+    path = os.environ.get("BENCH_HISTORY")
+    if path:
+        try:
+            with open(path, "a") as f:
+                f.write(json.dumps(row) + "\n")
+        except OSError as exc:
+            out["history_row_error"] = repr(exc)
 
 
 if __name__ == "__main__":

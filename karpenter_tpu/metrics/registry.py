@@ -225,6 +225,27 @@ TRANSFER_BYTES = REGISTRY.counter(
     "Host-device transfer bytes on the solve path, by direction (h2d, d2h)",
 )
 
+# -- program registry series (obs/programs.py) --------------------------------
+PROGRAM_COMPILE_SECONDS = REGISTRY.histogram(
+    "solver_compile_seconds",
+    "Per-program compile wall time by program (fn/claim-bucket) and cache "
+    "source (persistent = on-disk AOT reload, cold = full trace+compile)",
+)
+PROGRAM_LAUNCHES = REGISTRY.counter(
+    "solver_program_launches_total",
+    "Dispatches of each compiled solver program (fn/claim-bucket)",
+)
+DEVICE_BYTES = REGISTRY.gauge(
+    "solver_device_bytes",
+    "Device memory at the last solve-cycle sample, by kind (live, peak, "
+    "carried_state)",
+)
+PERSISTENT_CACHE = REGISTRY.counter(
+    "solver_persistent_cache_total",
+    "Process-cold program dispatches by persistent-cache result (hit = AOT "
+    "executable reloaded from disk, miss = cold trace+compile)",
+)
+
 # -- streaming solve series (streaming/warm.py, streaming/delta.py) -----------
 DELTA_REUSE_RATIO = REGISTRY.gauge(
     "solver_delta_reuse_ratio",

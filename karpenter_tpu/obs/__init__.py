@@ -1,3 +1,4 @@
-"""Observability: solve-cycle tracing (phase spans, ring buffer, exporters)."""
+"""Observability: solve-cycle tracing (phase spans, ring buffer, exporters)
+and the XLA program registry (compile/device-memory telemetry)."""
 
-from karpenter_tpu.obs import trace  # noqa: F401
+from karpenter_tpu.obs import programs, trace  # noqa: F401

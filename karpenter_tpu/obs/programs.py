@@ -1,0 +1,579 @@
+"""Program registry: per-XLA-program compile & device-memory telemetry.
+
+Round 10's tracing (obs/trace.py) decomposes a solve cycle's wall clock into
+phase spans; this module decomposes the layer BELOW the spans — the compiled
+XLA programs themselves. Every jitted entry point (narrow body, sweeps, each
+escalation-ladder rung, the consolidation screen, the wavefront body, warmup
+prewarms) registers its dispatches here under a stable program key, so the
+two standing ROADMAP killers become measurable instead of anecdotal:
+
+  cold compile 30-76s (open item 5)   per-program compile wall time with
+        cache-source attribution: ``memory`` (in-process jit cache),
+        ``persistent`` (on-disk AOT executable reloaded), ``cold`` (full
+        trace+compile). The split says whether a slow start is a cache miss
+        or a cache that never helps.
+  carried-buffer bloat (open item 1)   per-launch problem/carried/result/
+        donated byte accounting plus per-solve-cycle device-memory sampling
+        (live bytes, peak watermark, carried FFDState bytes) — the exact
+        numbers fusion-boundary surgery and donation work need.
+
+The program key reuses the round-8 cache-key ingredients: solve-fn name x
+claim-slot bucket x padded leaf shapes/dtypes, extended with the
+program-keying flag config (solver/warmup.py's MATCH warning — the wavefront
+and gate-diet flags select distinct executables) and the host ISA tag
+(utils/jaxtools._cpu_feature_tag, the persistent cache's directory key).
+
+Cache-source classification is *observed*, not guessed: JAX's monitoring
+hooks record a ``/jax/compilation_cache/cache_hits`` event whenever a
+compile is answered from the persistent cache, so a process-cold dispatch
+during which that event fired loaded an AOT executable ("persistent") and
+one without it paid a real compile ("cold"). tests/test_obs_programs.py
+proves the attribution by pre-seeding and clearing the cache directory.
+
+Same contract as tracing: zero overhead when off (``KARPENTER_TPU_PROGRAMS``
+unset — every public call returns immediately), all accounting is host-side
+Python so placements are bit-identical and the narrow-body census pin (2394
+eqns, tests/test_kernel_census.py) holds with the registry enabled. Jaxpr
+equation counting re-traces the program once per cold key, so it hides
+behind its own sub-flag (``KARPENTER_TPU_PROGRAMS_EQNS``).
+
+Three sinks, mirroring trace.py: Prometheus
+(``karpenter_solver_compile_seconds{program,source}``,
+``karpenter_solver_program_launches_total``, ``karpenter_solver_device_bytes``,
+``karpenter_solver_persistent_cache_total``), a ``/debug/programs`` JSON
+inventory + ``/statusz`` summary (operator/serving.py), and the program key
+stamped onto the existing ``compile`` trace spans.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+_perf = time.perf_counter
+_wall = time.time
+
+_enabled_override: Optional[bool] = None
+
+
+def set_enabled(value: Optional[bool]) -> None:
+    """Force the registry on/off (tests, bench); ``None`` restores the env
+    flag."""
+    global _enabled_override
+    _enabled_override = value
+
+
+def enabled() -> bool:
+    if _enabled_override is not None:
+        return _enabled_override
+    return os.environ.get("KARPENTER_TPU_PROGRAMS", "") not in ("", "0")
+
+
+def eqns_enabled() -> bool:
+    """Jaxpr equation counting re-traces each cold program once (host-side
+    jax.make_jaxpr, no compile) — cheap for small shapes, seconds at the 10k
+    bucket, so it needs its own opt-in on top of the registry flag."""
+    return enabled() and os.environ.get(
+        "KARPENTER_TPU_PROGRAMS_EQNS", ""
+    ) not in ("", "0")
+
+
+# cache sources, in the order a dispatch tries them
+SOURCE_MEMORY = "memory"          # in-process jit executable cache
+SOURCE_PERSISTENT = "persistent"  # on-disk AOT executable reloaded
+SOURCE_COLD = "cold"              # full trace + XLA compile
+
+
+# -- program keys -------------------------------------------------------------
+# The flags that are static jit arguments or program-build-time reads: two
+# processes (or two phases of one process) differing in any of these compile
+# DIFFERENT executables from the same shapes (solver/warmup.py docstring).
+PROGRAM_FLAGS = (
+    "KARPENTER_TPU_WAVEFRONT",
+    "KARPENTER_TPU_WAVEFRONT_WIDTH",
+    "KARPENTER_TPU_PACKED_GATES",
+    "KARPENTER_TPU_CLAIM_WINDOW",
+    "KARPENTER_TPU_STRIDE",
+    "KARPENTER_TPU_RUNS",
+    "KARPENTER_TPU_SCAN_UNROLL",
+    "KARPENTER_TPU_TOPO_CHAIN",
+    "KARPENTER_TPU_SPREAD_CHAIN",
+    "KARPENTER_TPU_ABLATE",
+)
+
+
+def flag_config() -> Dict[str, str]:
+    """The program-keying flags currently set (unset flags omitted — their
+    defaults are part of the code, not the config)."""
+    return {f: os.environ[f] for f in PROGRAM_FLAGS if os.environ.get(f)}
+
+
+def _digest(text: str, n: int = 8) -> str:
+    return hashlib.sha256(text.encode()).hexdigest()[:n]
+
+
+def flag_digest() -> str:
+    return _digest(repr(sorted(flag_config().items())))
+
+
+def isa_tag() -> str:
+    from karpenter_tpu.utils.jaxtools import _cpu_feature_tag
+
+    return _cpu_feature_tag()
+
+
+def shape_digest(tree) -> str:
+    """Digest of the padded leaf shapes/dtypes — the round-8 cache-key
+    shape component, hashed so keys stay printable."""
+    import jax
+
+    leaves = [
+        (tuple(getattr(leaf, "shape", ())),
+         str(getattr(leaf, "dtype", type(leaf).__name__)))
+        for leaf in jax.tree_util.tree_leaves(tree)
+    ]
+    return _digest(repr(leaves))
+
+
+def program_key(name: str, claims: int, shapes, statics=None) -> str:
+    """Stable program identity: fn name x claim bucket x padded shapes x
+    static args x flag config x ISA. Distinct keys ARE distinct executables;
+    the converse holds up to hash collisions on the shape digest."""
+    parts = [name, f"C{int(claims)}", f"s{shape_digest(shapes)}"]
+    if statics:
+        parts.append("a" + _digest(repr(sorted(statics.items()))))
+    parts.append("f" + flag_digest())
+    parts.append(isa_tag())
+    return "/".join(parts)
+
+
+def program_label(name: str, claims: int) -> str:
+    """The Prometheus ``program`` label: fn name + claim bucket only. The
+    full key (shape digest included) is unbounded-cardinality — it lives in
+    /debug/programs; the label stays a small fixed family."""
+    return f"{name}/C{int(claims)}"
+
+
+# -- persistent-cache hit observation -----------------------------------------
+# jax._src.compiler records /jax/compilation_cache/cache_hits exactly when a
+# compile was answered from the on-disk cache. Snapshotting the counter
+# around a process-cold dispatch classifies it persistent vs cold. Private
+# API, so degrade gracefully: without the hook every non-memory dispatch
+# reads as "cold" (still correct compile accounting, just no AOT split).
+
+_pc_lock = threading.Lock()
+_pc_hits = 0
+_pc_listener_installed = False
+_pc_listener_ok = False
+
+
+def _pc_on_event(event, *args, **kwargs) -> None:
+    global _pc_hits
+    if event == "/jax/compilation_cache/cache_hits":
+        with _pc_lock:
+            _pc_hits += 1
+
+
+def ensure_cache_listener() -> bool:
+    """Install the monitoring listener once; returns whether it is active."""
+    global _pc_listener_installed, _pc_listener_ok
+    if _pc_listener_installed:
+        return _pc_listener_ok
+    _pc_listener_installed = True
+    try:
+        from jax._src import monitoring
+
+        monitoring.register_event_listener(_pc_on_event)
+        _pc_listener_ok = True
+    except Exception:
+        _pc_listener_ok = False
+    return _pc_listener_ok
+
+
+def persistent_cache_hits() -> int:
+    with _pc_lock:
+        return _pc_hits
+
+
+# -- the registry -------------------------------------------------------------
+
+
+class ProgramRecord:
+    """Lifetime accounting for one program key."""
+
+    __slots__ = (
+        "key", "label", "name", "claims", "first_seen_unix", "launches",
+        "compiles", "compile_s_total", "compile_s_last", "sources", "eqns",
+        "statics", "bytes_last", "bytes_total",
+    )
+
+    def __init__(self, key: str, label: str, name: str, claims: int,
+                 statics=None):
+        self.key = key
+        self.label = label
+        self.name = name
+        self.claims = int(claims)
+        self.first_seen_unix = _wall()
+        self.launches = 0
+        self.compiles = 0
+        self.compile_s_total = 0.0
+        self.compile_s_last: Optional[float] = None
+        self.sources: Dict[str, int] = {}
+        self.eqns: Optional[int] = None
+        self.statics = dict(statics) if statics else {}
+        # donated is the donation headroom: buffers the program COULD reuse
+        # in place but currently copies (no donate_argnums on the solve path
+        # yet) — carried is the FFDState that rides between passes
+        self.bytes_last: Dict[str, int] = {}
+        self.bytes_total: Dict[str, int] = {}
+
+    def to_dict(self) -> Dict:
+        return {
+            "key": self.key,
+            "program": self.label,
+            "name": self.name,
+            "claims": self.claims,
+            "first_seen_unix": self.first_seen_unix,
+            "launches": self.launches,
+            "compiles": self.compiles,
+            "compile_s_total": round(self.compile_s_total, 6),
+            "compile_s_last": (
+                round(self.compile_s_last, 6)
+                if self.compile_s_last is not None else None
+            ),
+            "sources": dict(self.sources),
+            "eqns": self.eqns,
+            "statics": dict(self.statics),
+            "bytes_last": dict(self.bytes_last),
+            "bytes_total": dict(self.bytes_total),
+        }
+
+
+class ProgramRegistry:
+    """Process-global program inventory + device-memory sample ring."""
+
+    def __init__(self, memory_samples: int = 64):
+        self._lock = threading.Lock()
+        self._programs: Dict[str, ProgramRecord] = {}
+        # keys this registry has seen dispatched — the process-cache proxy
+        # (kept separate from jax_backend._COMPILED_PROGRAMS so tests can
+        # reset classification without touching the backend's span naming)
+        self._seen: set = set()
+        self._memory: deque = deque(maxlen=max(1, memory_samples))
+        self._live_peak = 0  # running peak for the live-array fallback
+
+    # -- dispatch accounting ---------------------------------------------------
+
+    def seen(self, key: str) -> bool:
+        with self._lock:
+            return key in self._seen
+
+    def mark_seen(self, key: str) -> bool:
+        """Returns True when the key was NEW (this dispatch pays a compile
+        or an AOT load)."""
+        with self._lock:
+            if key in self._seen:
+                return False
+            self._seen.add(key)
+            return True
+
+    def observe(
+        self,
+        key: str,
+        label: str,
+        name: str,
+        claims: int,
+        *,
+        source: str,
+        wall_s: Optional[float] = None,
+        eqns: Optional[int] = None,
+        statics=None,
+        problem_bytes: int = 0,
+        carried_bytes: int = 0,
+        result_bytes: int = 0,
+        donated_bytes: int = 0,
+    ) -> ProgramRecord:
+        """Record one dispatch of ``key``. ``wall_s`` is the dispatch wall
+        clock; for non-memory sources it IS the compile cost (trace+compile
+        or AOT load dominates the first dispatch)."""
+        from karpenter_tpu.metrics.registry import (
+            PERSISTENT_CACHE,
+            PROGRAM_COMPILE_SECONDS,
+            PROGRAM_LAUNCHES,
+        )
+
+        with self._lock:
+            rec = self._programs.get(key)
+            if rec is None:
+                rec = ProgramRecord(key, label, name, claims, statics)
+                self._programs[key] = rec
+            rec.launches += 1
+            rec.sources[source] = rec.sources.get(source, 0) + 1
+            if eqns is not None:
+                rec.eqns = eqns
+            if source != SOURCE_MEMORY:
+                rec.compiles += 1
+                if wall_s is not None:
+                    rec.compile_s_total += wall_s
+                    rec.compile_s_last = wall_s
+            for kind, nbytes in (
+                ("problem", problem_bytes), ("carried", carried_bytes),
+                ("result", result_bytes), ("donated", donated_bytes),
+            ):
+                rec.bytes_last[kind] = int(nbytes)
+                rec.bytes_total[kind] = rec.bytes_total.get(kind, 0) + int(nbytes)
+        PROGRAM_LAUNCHES.inc({"program": label})
+        if source != SOURCE_MEMORY:
+            if wall_s is not None:
+                PROGRAM_COMPILE_SECONDS.observe(
+                    wall_s, {"program": label, "source": source}
+                )
+            PERSISTENT_CACHE.inc(
+                {"result": "hit" if source == SOURCE_PERSISTENT else "miss"}
+            )
+        return rec
+
+    # -- device-memory sampling ------------------------------------------------
+
+    def _device_memory(self):
+        """(live_bytes, peak_bytes, how) — allocator stats when the backend
+        exposes them (TPU), else the sum of live jax arrays with a
+        registry-tracked running peak (CPU's PJRT reports no stats)."""
+        import jax
+
+        try:
+            stats = jax.local_devices()[0].memory_stats()
+        except Exception:
+            stats = None
+        if stats and stats.get("bytes_in_use") is not None:
+            live = int(stats["bytes_in_use"])
+            peak = int(stats.get("peak_bytes_in_use", live))
+            return live, peak, "allocator"
+        live = int(
+            sum(getattr(a, "nbytes", 0) for a in jax.live_arrays())
+        )
+        with self._lock:
+            self._live_peak = max(self._live_peak, live)
+            peak = self._live_peak
+        return live, peak, "live_arrays"
+
+    def sample_memory(
+        self, carried_bytes: int = 0, pods: Optional[int] = None,
+        cycle: Optional[str] = None,
+    ) -> Optional[Dict]:
+        """One per-solve-cycle sample: live/peak device bytes + the carried
+        FFDState footprint. Feeds the solver_device_bytes gauge and the
+        bounded sample ring in /debug/programs."""
+        from karpenter_tpu.metrics.registry import DEVICE_BYTES
+
+        live, peak, how = self._device_memory()
+        sample = {
+            "unix": _wall(),
+            "live_bytes": live,
+            "peak_bytes": peak,
+            "carried_state_bytes": int(carried_bytes),
+            "source": how,
+        }
+        if pods is not None:
+            sample["pods"] = int(pods)
+        if cycle is not None:
+            sample["cycle"] = cycle
+        with self._lock:
+            self._memory.append(sample)
+        DEVICE_BYTES.set(live, {"kind": "live"})
+        DEVICE_BYTES.set(peak, {"kind": "peak"})
+        DEVICE_BYTES.set(int(carried_bytes), {"kind": "carried_state"})
+        return sample
+
+    # -- views -----------------------------------------------------------------
+
+    def snapshot(self) -> Dict:
+        """The /debug/programs payload."""
+        with self._lock:
+            programs = [r.to_dict() for r in self._programs.values()]
+            memory = list(self._memory)
+        programs.sort(key=lambda r: (-r["compile_s_total"], r["key"]))
+        return {
+            "enabled": enabled(),
+            "isa": isa_tag(),
+            "flags": flag_config(),
+            "persistent_cache_hits": persistent_cache_hits(),
+            "totals": {
+                "programs": len(programs),
+                "launches": sum(r["launches"] for r in programs),
+                "compiles": sum(r["compiles"] for r in programs),
+                "compile_s": round(
+                    sum(r["compile_s_total"] for r in programs), 6
+                ),
+            },
+            "programs": programs,
+            "memory": {
+                "samples": memory,
+                "last": memory[-1] if memory else None,
+            },
+        }
+
+    def summary(self) -> Dict:
+        """The /statusz one-liner."""
+        with self._lock:
+            records = list(self._programs.values())
+            last_mem = self._memory[-1] if self._memory else None
+        by_source: Dict[str, int] = {}
+        for r in records:
+            for src, n in r.sources.items():
+                by_source[src] = by_source.get(src, 0) + n
+        out = {
+            "enabled": enabled(),
+            "programs": len(records),
+            "launches": sum(r.launches for r in records),
+            "compile_s": round(sum(r.compile_s_total for r in records), 3),
+            "by_source": by_source,
+        }
+        if last_mem is not None:
+            out["device_memory"] = last_mem
+        return out
+
+    def reset(self) -> None:
+        """Drop all records and the seen-set (tests). Does NOT clear jax's
+        own executable caches — pair with jax.clear_caches() when a test
+        needs dispatches to read process-cold again."""
+        with self._lock:
+            self._programs.clear()
+            self._seen.clear()
+            self._memory.clear()
+            self._live_peak = 0
+
+
+_registry: Optional[ProgramRegistry] = None
+_registry_lock = threading.Lock()
+
+
+def registry() -> ProgramRegistry:
+    global _registry
+    if _registry is None:
+        with _registry_lock:
+            if _registry is None:
+                _registry = ProgramRegistry()
+    return _registry
+
+
+def reset() -> None:
+    registry().reset()
+
+
+# -- dispatch observation helper ----------------------------------------------
+
+
+class _Dispatch:
+    """Handle returned by begin_dispatch: call ``finish()`` after the jitted
+    call (and its fetches) to record the launch. Classification happens at
+    finish time: process-cache hit -> memory; else the persistent-hit
+    counter moved during the dispatch -> persistent; else cold."""
+
+    __slots__ = ("key", "label", "name", "claims", "statics", "first",
+                 "hits0", "t0")
+
+    def __init__(self, key, label, name, claims, statics, first, hits0):
+        self.key = key
+        self.label = label
+        self.name = name
+        self.claims = claims
+        self.statics = statics
+        self.first = first
+        self.hits0 = hits0
+        self.t0 = _perf()
+
+    def finish(
+        self,
+        problem_bytes: int = 0,
+        carried_bytes: int = 0,
+        result_bytes: int = 0,
+        donated_bytes: int = 0,
+        eqns: Optional[int] = None,
+    ) -> str:
+        wall = _perf() - self.t0
+        if not self.first:
+            source = SOURCE_MEMORY
+        elif persistent_cache_hits() > self.hits0:
+            source = SOURCE_PERSISTENT
+        else:
+            source = SOURCE_COLD
+        registry().observe(
+            self.key, self.label, self.name, self.claims,
+            source=source, wall_s=wall, eqns=eqns, statics=self.statics,
+            problem_bytes=problem_bytes, carried_bytes=carried_bytes,
+            result_bytes=result_bytes, donated_bytes=donated_bytes,
+        )
+        return source
+
+
+def begin_dispatch(
+    name: str, claims: int, shapes, statics=None
+) -> Optional[_Dispatch]:
+    """Start observing one jitted dispatch; returns None when the registry
+    is off (the zero-overhead contract — callers guard with ``if obs:``)."""
+    if not enabled():
+        return None
+    ensure_cache_listener()
+    key = program_key(name, claims, shapes, statics)
+    label = program_label(name, claims)
+    first = registry().mark_seen(key)
+    return _Dispatch(
+        key, label, name, claims, statics, first, persistent_cache_hits()
+    )
+
+
+def sample_memory(
+    carried_bytes: int = 0, pods: Optional[int] = None,
+    cycle: Optional[str] = None,
+) -> Optional[Dict]:
+    """Module-level convenience with the off-path short-circuit."""
+    if not enabled():
+        return None
+    return registry().sample_memory(carried_bytes, pods=pods, cycle=cycle)
+
+
+# -- jaxpr equation counting (KARPENTER_TPU_PROGRAMS_EQNS) --------------------
+
+
+def _iter_subjaxprs(value):
+    # duck-typed like tools/kernel_census.py: Jaxpr has .eqns, ClosedJaxpr
+    # wraps one in .jaxpr/.consts
+    if hasattr(value, "eqns") or (
+        hasattr(value, "jaxpr") and hasattr(value, "consts")
+    ):
+        yield value
+    elif isinstance(value, (tuple, list)):
+        for v in value:
+            yield from _iter_subjaxprs(v)
+
+
+def count_eqns(jaxpr) -> int:
+    """Flattened equation count, recursing into sub-jaxprs (cond/scan/while
+    branches, pjit calls) — same convention as tools/kernel_census.py."""
+    closed = getattr(jaxpr, "jaxpr", None)
+    if closed is not None and hasattr(jaxpr, "consts"):
+        jaxpr = closed
+    n = 0
+    for eqn in jaxpr.eqns:
+        n += 1
+        for v in eqn.params.values():
+            for sub in _iter_subjaxprs(v):
+                n += count_eqns(sub)
+    return n
+
+
+def maybe_count_eqns(thunk) -> Optional[int]:
+    """Count the equations of the program ``thunk`` traces (a callable
+    returning a jaxpr), only when the eqns sub-flag is on; tracing failures
+    degrade to None — counting is telemetry, never a solve dependency."""
+    if not eqns_enabled():
+        return None
+    try:
+        return count_eqns(thunk())
+    except Exception:
+        return None

@@ -82,7 +82,7 @@ class OperatorStatus:
         return True
 
     def statusz(self) -> dict:
-        from karpenter_tpu.obs import trace
+        from karpenter_tpu.obs import programs, trace
 
         out = {"ready": self.ready()}
         if self.warmup_ready is not None:
@@ -98,6 +98,9 @@ class OperatorStatus:
                 for k in ("trace_id", "name", "backend", "duration_s", "phases")
             }
         out["traces"] = summary
+        # program registry one-liner (obs/programs.py): compiled-program
+        # count, launch totals, cache-source split, last memory sample
+        out["programs"] = programs.registry().summary()
         return out
 
 
@@ -125,6 +128,18 @@ class _Handler(BaseHTTPRequestHandler):
         elif self.path.startswith("/statusz"):
             payload = status.statusz() if status is not None else {"ready": True}
             body = (json.dumps(payload, indent=1, default=str) + "\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+        elif self.path.startswith("/debug/programs"):
+            from karpenter_tpu.obs import programs
+
+            # full program inventory: keys, compile times by cache source,
+            # launch counters, byte accounting, device-memory sample ring
+            body = (
+                json.dumps(programs.registry().snapshot(), indent=1,
+                           default=str)
+                + "\n"
+            ).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
         elif self.path.startswith("/debug/traces"):

@@ -27,6 +27,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from karpenter_tpu.models.problem import SchedulingProblem
+from karpenter_tpu.obs import programs
 from karpenter_tpu.ops.ffd import (
     FFDResult,
     _solve_ffd_jit,
@@ -37,6 +38,12 @@ from karpenter_tpu.ops.ffd import (
 )
 
 CANDIDATE_AXIS = "candidates"
+
+
+def _tree_bytes(tree) -> int:
+    return int(
+        sum(getattr(a, "nbytes", 0) for a in jax.tree_util.tree_leaves(tree))
+    )
 
 
 def make_mesh(n_devices: Optional[int] = None, axis: str = CANDIDATE_AXIS) -> Mesh:
@@ -79,7 +86,14 @@ def batched_solve(
     with_topo = _has_topo_runs(batch)
     if mesh is not None:
         batch = shard_batch(batch, mesh)
-    return _batched_solve_jit(batch, max_claims, max_run, with_topo)
+    obs = programs.begin_dispatch(
+        "batched_solve", max_claims, batch,
+        statics={"max_run": max_run, "with_topo": with_topo},
+    )
+    result = _batched_solve_jit(batch, max_claims, max_run, with_topo)
+    if obs is not None:
+        obs.finish(problem_bytes=_tree_bytes(batch))
+    return result
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
@@ -125,7 +139,14 @@ def batched_screen(
     with_topo = _has_topo_runs(batch)
     if mesh is not None:
         batch = shard_batch(batch, mesh)
-    return _batched_screen_jit(batch, max_claims, passes, max_run, with_topo)
+    obs = programs.begin_dispatch(
+        "batched_screen", max_claims, batch,
+        statics={"passes": passes, "max_run": max_run, "with_topo": with_topo},
+    )
+    result = _batched_screen_jit(batch, max_claims, passes, max_run, with_topo)
+    if obs is not None:
+        obs.finish(problem_bytes=_tree_bytes(batch))
+    return result
 
 
 class ScreenVariants:
@@ -203,7 +224,14 @@ def lean_screen(
         base = jax.tree_util.tree_map(
             lambda x: jax.device_put(x, replicate), base
         )
-    return _lean_screen_jit(base, tree, max_claims, passes, max_run, with_topo)
+    obs = programs.begin_dispatch(
+        "lean_screen", max_claims, (base, tree),
+        statics={"passes": passes, "max_run": max_run, "with_topo": with_topo},
+    )
+    result = _lean_screen_jit(base, tree, max_claims, passes, max_run, with_topo)
+    if obs is not None:
+        obs.finish(problem_bytes=_tree_bytes((base, tree)))
+    return result
 
 
 def default_mesh(min_devices: int = 2) -> Optional[Mesh]:

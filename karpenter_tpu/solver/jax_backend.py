@@ -26,7 +26,7 @@ import numpy as np
 from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.apis.objects import Pod
 from karpenter_tpu.metrics.registry import COMPILE_CACHE, TRANSFER_BYTES
-from karpenter_tpu.obs import trace
+from karpenter_tpu.obs import programs, trace
 from karpenter_tpu.cloudprovider.types import InstanceType
 from karpenter_tpu.provisioning.preferences import Preferences
 from karpenter_tpu.provisioning.topology import Topology
@@ -399,8 +399,27 @@ class JaxSolver(SolverBackend):
             else:
                 self.compile_cache_misses += 1
                 span_name = "compile"
-            h2d = _nbytes(problem) + (_nbytes(state) if state is not None else 0)
+            prob_bytes = _nbytes(problem)
+            carried_in = _nbytes(state) if state is not None else 0
+            h2d = prob_bytes + carried_in
             TRANSFER_BYTES.inc({"direction": "h2d"}, h2d)
+            # program-registry jaxpr census (KARPENTER_TPU_PROGRAMS_EQNS):
+            # re-trace the exact call pattern once per cold key, OUTSIDE the
+            # dispatch timing so the count never pollutes compile wall time
+            reg_eqns = None
+            if not cache_hit and programs.eqns_enabled():
+                # nullary closure: solve() inspects the CONCRETE problem on
+                # the host (problem_bounds_free etc.) before entering jit, so
+                # the problem must not itself be a tracer; the inner jitted
+                # call still lands as a counted sub-jaxpr
+                prev_state = state
+                reg_eqns = programs.maybe_count_eqns(
+                    lambda: jax.make_jaxpr(
+                        lambda: solve(problem, max_claims, init=prev_state)
+                    )()
+                )
+            # program registry (KARPENTER_TPU_PROGRAMS): None when off
+            obs = programs.begin_dispatch(solve.__name__, max_claims, problem)
             with trace.span(
                 span_name,
                 cache="hit" if cache_hit else "miss",
@@ -448,6 +467,19 @@ class JaxSolver(SolverBackend):
                     self.last_wave_hist = None
                 d2h = _nbytes(fetched)
                 TRANSFER_BYTES.inc({"direction": "d2h"}, d2h)
+                if obs is not None:
+                    # dispatch + fetch observed: wall is the compile cost on
+                    # a first dispatch (memory hits record launch/bytes only)
+                    source = obs.finish(
+                        problem_bytes=prob_bytes,
+                        carried_bytes=carried_in,
+                        result_bytes=d2h,
+                        eqns=reg_eqns,
+                    )
+                    if sp is not None:
+                        # Perfetto waterfalls name the program that compiled
+                        sp.attrs["program_key"] = obs.key
+                        sp.attrs["cache_source"] = source
                 if sp is not None:
                     sp.count("h2d_bytes", h2d)
                     sp.count("d2h_bytes", d2h)
@@ -551,4 +583,12 @@ class JaxSolver(SolverBackend):
                 else:
                     slot_to_claim[index].pod_indices.append(orig)
         _t("final-decode", t_dec)
+        # per-solve-cycle device-memory watermark (KARPENTER_TPU_PROGRAMS):
+        # live/peak device bytes + the carried FFDState footprint — the
+        # numbers the carried-buffer diet (ROADMAP open item 1) tracks
+        programs.sample_memory(
+            carried_bytes=_nbytes(state) if state is not None else 0,
+            pods=len(pods),
+            cycle=trace.current_trace_id(),
+        )
         return out

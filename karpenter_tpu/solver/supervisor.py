@@ -211,7 +211,9 @@ class SupervisedSolver(SolverBackend):
             return self._circuit
 
     def status(self) -> Dict:
-        return {
+        from karpenter_tpu.obs import programs
+
+        out = {
             "primary": type(self.primary).__name__,
             "fallback": type(self.fallback).__name__ if self.fallback else None,
             "circuit": self.circuit_state(),
@@ -221,6 +223,11 @@ class SupervisedSolver(SolverBackend):
             "counters": dict(self.counters),
             "last_failure": self.last_failure,
         }
+        if programs.enabled():
+            # which compiled programs the supervised path has been paying
+            # for (compile seconds, cache-source split, last memory sample)
+            out["programs"] = programs.registry().summary()
+        return out
 
     # -- circuit transitions --------------------------------------------------
 

@@ -322,6 +322,19 @@ def maybe_prewarm_in_background(options, cloud_provider=None) -> Optional["objec
                 prewarm_screen(n_screen)
             except Exception:
                 log.warning("prewarm: screen warm failed", exc_info=True)
+        # the startup compile bill, itemized (obs/programs.py): how many
+        # programs the warm compiled, what they cost, and how many came
+        # back from the persistent cache instead of a cold trace
+        from karpenter_tpu.obs import programs
+
+        if programs.enabled():
+            s = programs.registry().summary()
+            log.info(
+                "prewarm: %d programs, %d launches, %.1fs compile "
+                "(by source: %s)",
+                s["programs"], s["launches"], s["compile_s"],
+                s["by_source"],
+            )
 
     t = threading.Thread(
         target=probe_then_warm, daemon=True, name="karpenter-tpu/solver-prewarm"

@@ -106,6 +106,26 @@ class TestWavefrontBudget:
         finally:
             trace.set_enabled(None)
 
+    def test_program_registry_on_adds_zero_equations(self, census_problem):
+        """The program registry (obs/programs.py) observes dispatches from
+        the host side only: with KARPENTER_TPU_PROGRAMS forced on (eqn
+        sub-flag included — it re-traces via make_jaxpr, never edits the
+        program), the flag-off narrow body must count EXACTLY the same 2394
+        equations."""
+        from karpenter_tpu.obs import programs
+
+        programs.set_enabled(True)
+        old = os.environ.get("KARPENTER_TPU_PROGRAMS_EQNS")
+        os.environ["KARPENTER_TPU_PROGRAMS_EQNS"] = "1"
+        try:
+            assert narrow_jaxpr_eqns(census_problem, wavefront=0) == 2394
+        finally:
+            programs.set_enabled(None)
+            if old is None:
+                os.environ.pop("KARPENTER_TPU_PROGRAMS_EQNS", None)
+            else:
+                os.environ["KARPENTER_TPU_PROGRAMS_EQNS"] = old
+
     def test_delta_path_adds_zero_equations(self, census_problem):
         """The streaming subsystem (streaming/) is host-side only: with the
         delta path imported AND enabled (KARPENTER_TPU_DELTA=1, the supervisor

@@ -1,0 +1,341 @@
+"""Program registry (obs/programs.py): cache-source classification proven
+against a real on-disk persistent cache, launch-counter accuracy across a
+claim escalation, the flag-off zero-overhead/bit-identity contract, device
+memory sampling, and the /debug/programs + /statusz serving surface."""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import urllib.request
+
+import jax
+import pytest
+
+from karpenter_tpu.apis.nodepool import NodePool
+from karpenter_tpu.apis.objects import ObjectMeta
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.obs import programs
+from karpenter_tpu.solver.encode import template_from_nodepool
+from karpenter_tpu.solver.jax_backend import JaxSolver
+
+from bench import make_diverse_pods
+
+
+@pytest.fixture(autouse=True)
+def _registry_on():
+    programs.set_enabled(True)
+    programs.reset()
+    yield
+    programs.set_enabled(None)
+    programs.reset()
+
+
+def build_problem(pod_count=40, its_count=10, seed=42, name="programs"):
+    its = instance_types(its_count)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name=name)), its, range(len(its))
+    )
+    pods = make_diverse_pods(pod_count, random.Random(seed))
+    return pods, its, [tpl]
+
+
+def placements_key(result):
+    return (
+        tuple(
+            (c.template_index, tuple(c.pod_indices), tuple(c.instance_type_indices))
+            for c in result.new_claims
+        ),
+        tuple(sorted((k, tuple(v)) for k, v in result.node_pods.items())),
+        tuple(sorted(result.failures)),
+    )
+
+
+def solve_records(snap):
+    return [r for r in snap["programs"] if r["name"].startswith("solve_ffd")]
+
+
+# -- program keys --------------------------------------------------------------
+
+
+class TestProgramKey:
+    def test_key_varies_by_shape_and_claims(self):
+        import numpy as np
+
+        a = {"x": np.zeros((4, 2), np.float32)}
+        b = {"x": np.zeros((8, 2), np.float32)}
+        k1 = programs.program_key("f", 16, a)
+        k2 = programs.program_key("f", 16, b)
+        k3 = programs.program_key("f", 32, a)
+        assert len({k1, k2, k3}) == 3
+        assert k1.startswith("f/C16/")
+        assert k1.endswith(programs.isa_tag())
+
+    def test_key_varies_by_flag_config(self, monkeypatch):
+        import numpy as np
+
+        a = {"x": np.zeros((4, 2), np.float32)}
+        k1 = programs.program_key("f", 16, a)
+        monkeypatch.setenv("KARPENTER_TPU_WAVEFRONT", "1")
+        k2 = programs.program_key("f", 16, a)
+        assert k1 != k2
+
+    def test_label_is_bounded(self):
+        # the prometheus label is fn/claim-bucket ONLY; shape digests stay in
+        # /debug/programs where cardinality is free
+        assert programs.program_label("solve_ffd_sweeps", 32) == (
+            "solve_ffd_sweeps/C32"
+        )
+
+
+# -- cache-source classification ----------------------------------------------
+
+
+class TestCacheSourceClassification:
+    """Proven against a real on-disk cache: cold compile into an empty dir,
+    persistent reload after clearing the in-process executable caches, cold
+    again once the disk cache is swapped for an empty one."""
+
+    @staticmethod
+    def _point_cache_at(path):
+        # the disk-cache object is created lazily and pinned at first use, so
+        # a config update alone does not retarget an already-initialized
+        # cache — reset it explicitly
+        from jax._src import compilation_cache
+
+        jax.config.update("jax_compilation_cache_dir", str(path))
+        compilation_cache.reset_cache()
+
+    @pytest.mark.slow  # clears process-wide jit caches: quarantined from tier-1
+    def test_cold_then_memory_then_persistent_then_cold(self, tmp_path):
+        if not programs.ensure_cache_listener():
+            pytest.skip("jax monitoring listener unavailable")
+        try:
+            from jax._src.compilation_cache import reset_cache  # noqa: F401
+        except ImportError:
+            pytest.skip("jax compilation_cache.reset_cache unavailable")
+        pods, its, tpls = build_problem(14, 5, seed=3, name="cache-src")
+        solver = JaxSolver()  # ctor resets cache config; override after
+        old_dir = jax.config.jax_compilation_cache_dir
+        cache1 = tmp_path / "cache1"
+        cache2 = tmp_path / "cache2"
+        cache1.mkdir()
+        cache2.mkdir()
+        self._point_cache_at(cache1)
+        # earlier tests in the session may already hold this executable in
+        # memory — the cold leg needs a genuinely empty process cache
+        jax.clear_caches()
+        programs.reset()
+        # the write path skips fast compiles by default — force every
+        # executable to disk so the reload leg has something to hit
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        try:
+            base = placements_key(solver.solve(pods, its, tpls))
+            rec = solve_records(programs.registry().snapshot())
+            assert len(rec) == 1
+            assert rec[0]["sources"] == {programs.SOURCE_COLD: 1}
+            assert rec[0]["compile_s_last"] > 0
+            assert list(cache1.iterdir()), "cold compile wrote nothing to disk"
+
+            # same process, same executable: memory
+            assert placements_key(solver.solve(pods, its, tpls)) == base
+            rec = solve_records(programs.registry().snapshot())
+            assert rec[0]["sources"] == {
+                programs.SOURCE_COLD: 1, programs.SOURCE_MEMORY: 1,
+            }
+
+            # drop the in-process caches; the disk cache answers: persistent
+            jax.clear_caches()
+            programs.reset()
+            assert placements_key(solver.solve(pods, its, tpls)) == base
+            rec = solve_records(programs.registry().snapshot())
+            assert rec[0]["sources"] == {programs.SOURCE_PERSISTENT: 1}
+
+            # empty disk cache + cleared process caches: cold again
+            self._point_cache_at(cache2)
+            jax.clear_caches()
+            programs.reset()
+            assert placements_key(solver.solve(pods, its, tpls)) == base
+            rec = solve_records(programs.registry().snapshot())
+            assert rec[0]["sources"] == {programs.SOURCE_COLD: 1}
+        finally:
+            self._point_cache_at(old_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.5
+            )
+            jax.clear_caches()
+
+    def test_persistent_hits_counter_monotonic(self):
+        before = programs.persistent_cache_hits()
+        programs._pc_on_event("/jax/compilation_cache/cache_hits")
+        programs._pc_on_event("/some/other/event")
+        assert programs.persistent_cache_hits() == before + 1
+
+
+# -- launch counters across an escalation --------------------------------------
+
+
+class TestLaunchCounters:
+    def test_escalation_registers_each_claim_bucket(self):
+        pods, its, tpls = build_problem(60, 4, seed=7, name="esc")
+        solver = JaxSolver(initial_claim_slots=2)
+        solver.solve(pods, its, tpls)
+        assert solver.claim_escalations >= 1, "shape no longer escalates"
+        recs = solve_records(programs.registry().snapshot())
+        buckets = {r["claims"] for r in recs}
+        assert len(buckets) >= 2, f"one record per rung expected, got {recs}"
+        # one dispatch per attempt: the overflow rung + each escalation retry
+        assert sum(r["launches"] for r in recs) == solver.claim_escalations + 1
+
+    def test_byte_accounting_present(self):
+        pods, its, tpls = build_problem(20, 6, seed=5, name="bytes")
+        JaxSolver().solve(pods, its, tpls)
+        recs = solve_records(programs.registry().snapshot())
+        b = recs[0]["bytes_last"]
+        assert b["problem"] > 0
+        assert b["result"] > 0
+        assert b["donated"] == 0  # donation headroom: nothing donated yet
+
+
+# -- flag-off contract ---------------------------------------------------------
+
+
+class TestFlagOff:
+    def test_off_records_nothing_and_placements_bit_identical(self):
+        pods, its, tpls = build_problem(40, 10, name="ab")
+        programs.set_enabled(False)
+        off = JaxSolver().solve(pods, its, tpls)
+        snap = programs.registry().snapshot()
+        assert snap["totals"]["launches"] == 0
+        assert snap["memory"]["last"] is None
+
+        programs.set_enabled(True)
+        on = JaxSolver().solve(pods, its, tpls)
+        assert placements_key(on) == placements_key(off)
+        assert programs.registry().snapshot()["totals"]["launches"] >= 1
+
+    def test_begin_dispatch_returns_none_when_off(self):
+        programs.set_enabled(False)
+        assert programs.begin_dispatch("f", 8, {"x": 1}) is None
+
+
+# -- device-memory sampling ----------------------------------------------------
+
+
+class TestMemorySampling:
+    def test_solve_cycle_records_sample(self):
+        pods, its, tpls = build_problem(25, 6, seed=9, name="mem")
+        JaxSolver().solve(pods, its, tpls)
+        snap = programs.registry().snapshot()
+        last = snap["memory"]["last"]
+        assert last is not None
+        assert last["live_bytes"] > 0
+        assert last["peak_bytes"] >= last["live_bytes"]
+        assert last["carried_state_bytes"] >= 0
+        assert last["source"] in ("allocator", "live_arrays")
+        assert last["pods"] == 25
+
+    def test_gauge_exported(self):
+        from karpenter_tpu.operator.serving import render_prometheus
+
+        programs.registry().sample_memory(carried_bytes=123, pods=1)
+        text = render_prometheus()
+        assert 'karpenter_solver_device_bytes{kind="live"}' in text
+        assert 'karpenter_solver_device_bytes{kind="carried_state"} 123' in text
+
+
+# -- jaxpr equation counting (sub-flag) ----------------------------------------
+
+
+class TestEqnCounting:
+    def test_eqns_recorded_when_subflag_on(self, monkeypatch):
+        from karpenter_tpu.solver import jax_backend
+
+        monkeypatch.setenv("KARPENTER_TPU_PROGRAMS_EQNS", "1")
+        pods, its, tpls = build_problem(23, 7, seed=11, name="eqns")
+        # the census runs once per process-cold program key; earlier tests
+        # may have dispatched this shape bucket already, so forget it
+        saved = set(jax_backend._COMPILED_PROGRAMS)
+        jax_backend._COMPILED_PROGRAMS.clear()
+        try:
+            JaxSolver().solve(pods, its, tpls)
+        finally:
+            jax_backend._COMPILED_PROGRAMS |= saved
+        recs = solve_records(programs.registry().snapshot())
+        assert any(r["eqns"] and r["eqns"] > 100 for r in recs), recs
+
+    def test_eqns_off_by_default(self, monkeypatch):
+        monkeypatch.delenv("KARPENTER_TPU_PROGRAMS_EQNS", raising=False)
+        assert not programs.eqns_enabled()
+
+
+# -- serving surface -----------------------------------------------------------
+
+
+class TestServing:
+    def test_debug_programs_and_statusz(self):
+        from karpenter_tpu.operator import serving
+
+        pods, its, tpls = build_problem(15, 5, seed=13, name="serve")
+        JaxSolver().solve(pods, its, tpls)
+        server = serving.serve(
+            0, host="127.0.0.1", status=serving.OperatorStatus()
+        )
+        try:
+            port = server.server_address[1]
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/programs"
+            ) as resp:
+                body = json.loads(resp.read())
+            assert body["enabled"] is True
+            assert body["totals"]["launches"] >= 1
+            assert body["programs"], "no program records served"
+            first = body["programs"][0]
+            assert {"key", "program", "sources", "launches"} <= set(first)
+            assert first["key"].endswith(programs.isa_tag())
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/statusz"
+            ) as resp:
+                status = json.loads(resp.read())
+            assert status["programs"]["launches"] >= 1
+            assert status["programs"]["by_source"]
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics"
+            ) as resp:
+                metrics = resp.read().decode()
+            assert "# TYPE karpenter_solver_compile_seconds histogram" in metrics
+            assert "karpenter_solver_program_launches_total{" in metrics
+        finally:
+            server.shutdown()
+
+    def test_trace_span_stamped_with_program_key(self):
+        from karpenter_tpu.obs import trace
+
+        trace.set_enabled(True)
+        trace.reset_ring()
+        try:
+            pods, its, tpls = build_problem(18, 5, seed=17, name="stamp")
+            JaxSolver().solve(pods, its, tpls)
+            d = trace.ring().last()
+            assert d is not None
+
+            def walk(node):
+                yield node
+                for child in node.get("children", ()):
+                    yield from walk(child)
+
+            stamped = [
+                n for n in walk(d["root"])
+                if n.get("attrs", {}).get("program_key")
+            ]
+            assert stamped, "no span carries a program_key attr"
+            assert stamped[0]["attrs"]["cache_source"] in (
+                programs.SOURCE_COLD, programs.SOURCE_MEMORY,
+                programs.SOURCE_PERSISTENT,
+            )
+        finally:
+            trace.set_enabled(None)
+            trace.reset_ring()

@@ -1,0 +1,148 @@
+"""Perf regression sentinel (tools/perf_gate.py): the committed baseline
+passes its own gate, a synthetically regressed row fails it, the bench-output
+distiller keeps its schema, and the tiny-shape smoke runs in tier-1."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from tools.perf_gate import (
+    DEFAULT_BANDS,
+    DEFAULT_BASELINE,
+    HISTORY_SCHEMA_VERSION,
+    gate,
+    load_history,
+    platform_family,
+    row_from_bench,
+    smoke,
+)
+
+
+@pytest.fixture(scope="module")
+def baseline_rows():
+    rows = load_history(DEFAULT_BASELINE)
+    assert rows, "bench_history.jsonl missing or empty"
+    return rows
+
+
+class TestBaseline:
+    def test_committed_rows_parse(self, baseline_rows):
+        assert all(r.get("schema") == HISTORY_SCHEMA_VERSION for r in baseline_rows)
+        # the seed trajectory intentionally includes the r01 failure row —
+        # the gate must tolerate history with errors in it
+        assert any(r.get("error") for r in baseline_rows)
+        assert sum(1 for r in baseline_rows if not r.get("error")) >= 3
+
+    def test_every_usable_row_passes_its_window(self, baseline_rows):
+        for row in baseline_rows:
+            if row.get("error"):
+                continue
+            problems = gate(row, baseline_rows)
+            assert problems == [], (
+                f"committed row {row['label']} fails its own gate: {problems}"
+            )
+
+    def test_error_row_is_rejected_as_candidate(self, baseline_rows):
+        bad = next(r for r in baseline_rows if r.get("error"))
+        problems = gate(bad, baseline_rows)
+        assert len(problems) == 1 and "error" in problems[0]
+
+
+class TestGate:
+    def test_synthetic_regression_fails(self, baseline_rows):
+        donor = [r for r in baseline_rows if not r.get("error")][-1]
+        regressed = dict(donor, label="regressed")
+        for metric, (direction, _) in DEFAULT_BANDS.items():
+            if not isinstance(regressed.get(metric), (int, float)):
+                continue
+            if direction == "lower":
+                regressed[metric] = regressed[metric] * 10
+            else:
+                regressed[metric] = regressed[metric] / 10
+        problems = gate(regressed, baseline_rows)
+        assert len(problems) >= 2, problems
+
+    def test_single_metric_cliff_is_caught(self, baseline_rows):
+        donor = [r for r in baseline_rows if not r.get("error")][-1]
+        regressed = dict(donor, label="slow-10k", solve_10k_s=1e4)
+        problems = gate(regressed, baseline_rows)
+        assert any("solve_10k_s" in p for p in problems)
+
+    def test_unknown_family_passes_loudly(self, baseline_rows, capsys):
+        candidate = {
+            "schema": 1, "label": "new-family", "platform": "tpu-v9",
+            "pods_per_sec": 0.001,
+        }
+        only_cpu = [
+            r for r in baseline_rows
+            if platform_family(r.get("platform")) == "cpu"
+        ]
+        assert gate(candidate, only_cpu) == []
+        assert "seeds the window" in capsys.readouterr().err
+
+    def test_band_override_tightens(self, baseline_rows):
+        donor = [r for r in baseline_rows if not r.get("error")][-1]
+        # a mild 1.3x slip passes the default generous bands but fails once
+        # the override tightens them to 1.01x
+        mild = dict(donor, label="mild", solve_10k_s=donor["solve_10k_s"] * 1.3)
+        assert gate(mild, baseline_rows) == []
+        assert any(
+            "solve_10k_s" in p
+            for p in gate(mild, baseline_rows, band_override=1.01)
+        )
+
+
+class TestRowFromBench:
+    def test_schema_stability(self):
+        out = {
+            "metric": "scheduling_throughput_400it_diverse_grid",
+            "value": 1234.5,
+            "platform": "cpu-fallback",
+            "scheduled_frac": 0.99,
+            "compile_s": 12.3,
+            "backend_init_s": 0.5,
+            "solve_10k_pods_s": 2.5,
+            "coldstart_2500_s": 14.0,
+            "first_solve_after_start_s": 1.7,
+            "consolidation_candidates_per_sec": 200.0,
+            "device_peak_bytes_2500": 123456,
+        }
+        row = row_from_bench(out, label="r99")
+        assert row == {
+            "schema": HISTORY_SCHEMA_VERSION,
+            "label": "r99",
+            "platform": "cpu-fallback",
+            "pods_per_sec": 1234.5,
+            "scheduled_frac": 0.99,
+            "compile_s": 12.3,
+            "backend_init_s": 0.5,
+            "solve_10k_s": 2.5,
+            "coldstart_2500_s": 14.0,
+            "first_solve_s": 1.7,
+            "consolidation_per_s": 200.0,
+            "device_peak_bytes_2500": 123456,
+        }
+        assert json.loads(json.dumps(row)) == row
+
+    def test_error_and_missing_sections(self):
+        row = row_from_bench({"value": 0.0, "error": "rc=1"}, label="bad")
+        assert row["error"] == "rc=1"
+        assert "solve_10k_s" not in row
+        assert platform_family(row.get("platform")) == "tpu"  # unknown->tpu
+
+    def test_bad_history_lines_skipped(self, tmp_path, capsys):
+        p = tmp_path / "hist.jsonl"
+        p.write_text('# comment\n{"schema": 1, "label": "ok"}\nnot json\n')
+        rows = load_history(p)
+        assert [r["label"] for r in rows] == ["ok"]
+        assert "skipping bad row" in capsys.readouterr().err
+
+
+class TestSmoke:
+    def test_smoke_passes(self):
+        """The tier-1 wiring for the sentinel: committed baseline gates
+        clean, and a real 10-pod solve with the registry forced on lands
+        inside the absolute ceilings and populates the registry."""
+        assert smoke() == []

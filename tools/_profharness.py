@@ -1,0 +1,213 @@
+"""Shared setup for the tools/profile_*.py dev scripts.
+
+Every profile script used to open with the same ritual — path hack, graft
+entry import, platform respect, stderr banner — and each had drifted its own
+copy (some quieted XLA spam, most didn't; two had private timeit()s; four
+rebuilt the bench problem from scratch; two re-implemented the perfetto
+trace-gz parser). This module is that ritual, once:
+
+    from tools import _profharness as H
+    jax = H.setup()
+
+``setup()`` quiets the XLA machine-feature/SIGILL dump BEFORE the backend
+initializes (same contract as bench.py's parent process — the C++ logger
+reads TF_CPP_MIN_LOG_LEVEL once at load), so no profile run leaks the
+multi-line flag spam into a terminal or a captured log tail.
+
+The helpers that touch the program registry (``enable_registry``,
+``observed``, ``registry_report``) let scripts that call kernels DIRECTLY
+(solve_ffd and friends, bypassing the instrumented JaxSolver dispatch site)
+still land their launches and buffer bytes in karpenter_tpu.obs.programs —
+profile_kernels and profile_buffers report from the registry instead of
+hand-rolled counters.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+from collections import defaultdict
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_jax = None
+
+
+def setup(banner: bool = True):
+    """Path + log-noise + platform setup every profile script needs.
+    Returns the jax module (already platform-respecting)."""
+    global _jax
+    if REPO_ROOT not in sys.path:
+        sys.path.insert(0, REPO_ROOT)
+    # must precede backend init — see module docstring
+    from karpenter_tpu.operator.logging import quiet_xla_warnings
+
+    quiet_xla_warnings(notify_stderr=True)
+    import __graft_entry__
+
+    __graft_entry__._respect_platform_env()
+    import jax
+
+    _jax = jax
+    if banner:
+        print(
+            f"platform: {jax.devices()[0].platform}  jax {jax.__version__}",
+            file=sys.stderr,
+        )
+    return jax
+
+
+def timeit(label, fn, n: int = 8, warmup: int = 1):
+    """Steady-state per-call wall time; the warmup calls eat compile."""
+    for _ in range(warmup):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    per = (time.perf_counter() - t0) / n
+    print(f"{label}: {per * 1e3:.1f} ms")
+    return per
+
+
+def fanout(script_file, configs, child_var: str) -> bool:
+    """Self-spawn one subprocess per env config (flags read at module import
+    can only vary across processes). ``configs`` is a list of dicts of env
+    overrides. Returns True in the child (caller proceeds to measure); the
+    parent loops the configs and exits."""
+    if os.environ.get(child_var) == "1":
+        return True
+    for cfg in configs:
+        env = dict(os.environ)
+        env[child_var] = "1"
+        env.update(cfg)
+        subprocess.run([sys.executable, script_file], env=env)
+    sys.exit(0)
+
+
+def bench_problem(pods_n: int = 10000, num_its: int = 400,
+                  num_claim_slots: int = 128, seed: int = 42):
+    """The padded bench-shaped problem the kernel profilers share (400 fake
+    instance types, makeDiversePods mix). Returns (problem, pods, its, tpl)."""
+    import random
+
+    from bench import make_diverse_pods
+    from karpenter_tpu.apis import labels as wk
+    from karpenter_tpu.apis.nodepool import NodePool
+    from karpenter_tpu.apis.objects import ObjectMeta
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.ops.padding import pad_problem
+    from karpenter_tpu.provisioning.topology import Topology
+    from karpenter_tpu.solver.encode import (
+        Encoder,
+        domains_from_instance_types,
+        template_from_nodepool,
+    )
+
+    rng = random.Random(seed)
+    its = instance_types(num_its)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="default")), its, range(len(its))
+    )
+    pods = make_diverse_pods(pods_n, rng)
+    domains = domains_from_instance_types(its, [tpl])
+    topo = Topology(domains, batch_pods=pods, cluster_pods=[])
+    enc = Encoder(wk.WELL_KNOWN_LABELS)
+    encoded = enc.encode(
+        pods, its, [tpl], [], topology=topo, num_claim_slots=num_claim_slots
+    )
+    return pad_problem(encoded.problem), pods, its, tpl
+
+
+def kernel_trace(fn, trace_dir: str):
+    """Run ``fn`` under a jax.profiler trace and parse the perfetto gz into
+    per-op-name (seconds, count, sample-args) maps."""
+    jax = _jax
+    assert jax is not None, "call setup() first"
+    shutil.rmtree(trace_dir, ignore_errors=True)
+    with jax.profiler.trace(trace_dir):
+        fn()
+    paths = glob.glob(f"{trace_dir}/**/*.trace.json.gz", recursive=True)
+    print("trace files:", paths, file=sys.stderr)
+    buckets = defaultdict(float)
+    counts = defaultdict(int)
+    samples = {}
+    for path in paths:
+        with gzip.open(path, "rt") as f:
+            data = json.load(f)
+        for ev in data.get("traceEvents", []):
+            if ev.get("ph") != "X":
+                continue
+            name = ev.get("name", "")
+            # keep device-side compute events only (heuristic: pid/tid naming
+            # is messy; filter by typical XLA op-name shapes)
+            if not name or name.startswith(("$", "process_")):
+                continue
+            buckets[name] += ev.get("dur", 0) / 1e6  # us -> s
+            counts[name] += 1
+            samples[name] = ev.get("args", {})
+    return buckets, counts, samples
+
+
+# -- program registry bridge ---------------------------------------------------
+
+
+def tree_bytes(tree) -> int:
+    jax = _jax
+    assert jax is not None, "call setup() first"
+    return sum(
+        getattr(leaf, "nbytes", 0) for leaf in jax.tree_util.tree_leaves(tree)
+    )
+
+
+def enable_registry():
+    """Force the program registry on for this profiling process (the env
+    flag stays authoritative for production)."""
+    from karpenter_tpu.obs import programs
+
+    programs.set_enabled(True)
+    return programs
+
+
+def observed(name: str, claims: int, problem, fn, statics=None):
+    """Run one jitted call under program-registry observation. Scripts that
+    invoke kernels directly (not through JaxSolver) use this so their
+    launches/compiles/bytes land in the same registry the operator exports."""
+    from karpenter_tpu.obs import programs
+
+    obs = programs.begin_dispatch(name, claims, problem, statics=statics)
+    out = fn()
+    if obs is not None:
+        obs.finish(
+            problem_bytes=tree_bytes(problem), result_bytes=tree_bytes(out)
+        )
+    return out
+
+
+def registry_report(top: int = 20) -> None:
+    """Print the registry's per-program launch counters, compile attribution
+    and buffer-byte accounting (what /debug/programs serves in production)."""
+    from karpenter_tpu.obs import programs
+
+    snap = programs.registry().snapshot()
+    tot = snap["totals"]
+    print(
+        f"-- program registry: {tot['programs']} programs, "
+        f"{tot['launches']} launches, {tot['compile_s']:.2f}s compile "
+        f"(persistent-cache hits: {snap['persistent_cache_hits']})"
+    )
+    for rec in snap["programs"][:top]:
+        by_src = ",".join(f"{k}={v}" for k, v in sorted(rec["sources"].items()))
+        b = rec["bytes_last"]
+        print(
+            f"   {rec['program']:28s} launches={rec['launches']:5d} "
+            f"compile={rec['compile_s_total']:.2f}s [{by_src}] "
+            f"bytes(problem={b.get('problem', 0)} "
+            f"carried={b.get('carried', 0)} result={b.get('result', 0)} "
+            f"donated={b.get('donated', 0)})"
+        )

@@ -81,6 +81,18 @@ def run() -> list:
             problems.append(f"{name} ({kind}) is absent from /metrics exposition")
         if not help_:
             problems.append(f"{name} ({kind}) has no help text")
+        # prometheus naming conventions: the suffix promises the type, and
+        # dashboards/recording rules key off that promise
+        if name.endswith("_seconds") and kind != "histogram":
+            problems.append(
+                f"{name} is *_seconds but registered as a {kind} "
+                f"(convention: duration metrics are histograms)"
+            )
+        if name.endswith("_total") and kind != "counter":
+            problems.append(
+                f"{name} is *_total but registered as a {kind} "
+                f"(convention: *_total names a counter)"
+            )
     return problems
 
 

@@ -1,0 +1,288 @@
+"""Perf regression sentinel: gate a bench run against the committed history.
+
+``bench.py`` emits one machine-readable history row per run (schema below,
+documented in docs/PERF_NOTES.md); the committed ``bench_history.jsonl`` at
+the repo root holds the parsed BENCH_r01..r05 trajectory as the seed baseline
+window. This tool compares a candidate row against the window and exits
+nonzero on regression, so the trajectory is an enforced curve instead of a
+pile of unparsed JSON snapshots.
+
+Comparison model — explicit noise bands, not statistics theater:
+
+  * rows are grouped by platform FAMILY (``cpu`` vs ``tpu``: a tunneled-TPU
+    number and a CPU-fallback number are not comparable), and a candidate is
+    gated only against same-family rows without an ``error`` field;
+  * per metric, the candidate is compared to the window MEDIAN with a
+    per-metric multiplicative band (DEFAULT_BANDS). Lower-better metrics fail
+    when ``candidate > median * band``; higher-better when
+    ``candidate < median / band``;
+  * the seed window is heterogeneous (platform flips, whole subsystems landed
+    between rounds — r02's 10k solve was 2.7s on CPU before the supervisor
+    wrap, r05's is 22s), so the default bands are GENEROUS (3-4x). They exist
+    to catch order-of-magnitude cliffs — a wedged tunnel, an accidental
+    O(n^2), a compile-cache that stopped working — not 10% noise. Tighten
+    with ``--band`` as the history grows homogeneous.
+
+Usage:
+    python tools/perf_gate.py                       # last committed row vs window
+    python tools/perf_gate.py --candidate run.json  # a fresh bench row/output
+    python tools/perf_gate.py --smoke               # tier-1 tiny-shape smoke
+
+``--smoke`` (wired into tier-1 via tests/test_perf_gate.py) proves the whole
+sentinel cheaply: parses the committed baseline, gates its newest row, then
+runs a 10-pod solve through the real backend with the program registry on and
+checks it lands inside an absolute band.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+from pathlib import Path
+
+if __name__ == "__main__":
+    sys.path.insert(0, ".")
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+DEFAULT_BASELINE = REPO_ROOT / "bench_history.jsonl"
+
+HISTORY_SCHEMA_VERSION = 1
+
+# metric -> (direction, band). Band is multiplicative headroom vs the
+# same-family window median; see module docstring for why they start wide.
+LOWER_BETTER = "lower"
+HIGHER_BETTER = "higher"
+DEFAULT_BANDS = {
+    "pods_per_sec": (HIGHER_BETTER, 4.0),
+    "solve_10k_s": (LOWER_BETTER, 4.0),
+    "coldstart_2500_s": (LOWER_BETTER, 3.0),
+    "first_solve_s": (LOWER_BETTER, 3.0),
+    "consolidation_per_s": (HIGHER_BETTER, 4.0),
+}
+
+# absolute ceiling for the --smoke tiny-shape solve (steady-state, post
+# compile): a 10-pod CPU solve runs in ~10ms; 30s only trips on a wedged
+# backend or a dispatch path that stopped caching
+SMOKE_STEADY_CEILING_S = 30.0
+SMOKE_WARM_CEILING_S = 300.0  # first solve, compile included
+
+
+def row_from_bench(out: dict, label: str = "run") -> dict:
+    """The stable history row distilled from bench.py's output JSON. Missing
+    sections (quick grid, failed coldstart) simply omit their keys — the
+    gate skips metrics the window or candidate lacks."""
+    row = {
+        "schema": HISTORY_SCHEMA_VERSION,
+        "label": label,
+        "platform": out.get("platform"),
+        "pods_per_sec": out.get("value"),
+        "scheduled_frac": out.get("scheduled_frac"),
+        "compile_s": out.get("compile_s"),
+        "backend_init_s": out.get("backend_init_s"),
+    }
+    optional = {
+        "solve_10k_s": out.get("solve_10k_pods_s"),
+        "coldstart_2500_s": out.get("coldstart_2500_s"),
+        "first_solve_s": out.get("first_solve_after_start_s"),
+        "consolidation_per_s": out.get("consolidation_candidates_per_sec"),
+        "device_peak_bytes_2500": out.get("device_peak_bytes_2500"),
+        "error": out.get("error"),
+    }
+    row.update({k: v for k, v in optional.items() if v is not None})
+    return row
+
+
+def platform_family(platform) -> str:
+    return "cpu" if str(platform or "").startswith("cpu") else "tpu"
+
+
+def load_history(path) -> list:
+    """Rows from a jsonl file; unparseable lines are skipped with a notice
+    (the seed trajectory includes a failed round — r01 rc=1 — recorded as an
+    error row on purpose: the gate must tolerate it, not choke)."""
+    rows = []
+    for i, line in enumerate(Path(path).read_text().splitlines(), 1):
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            rows.append(json.loads(line))
+        except json.JSONDecodeError as exc:
+            print(f"perf-gate: {path}:{i}: skipping bad row: {exc}",
+                  file=sys.stderr)
+    return rows
+
+
+def gate(candidate: dict, baseline_rows: list, bands=None, window: int = 5,
+         band_override=None) -> list:
+    """Compare one candidate row against the baseline window. Returns a list
+    of problem strings; empty means the gate passes."""
+    bands = dict(bands or DEFAULT_BANDS)
+    if band_override is not None:
+        bands = {m: (d, float(band_override)) for m, (d, _) in bands.items()}
+    if candidate.get("error"):
+        return [f"candidate row carries an error: {candidate['error']}"]
+    family = platform_family(candidate.get("platform"))
+    rows = [
+        r for r in baseline_rows
+        if not r.get("error") and platform_family(r.get("platform")) == family
+    ][-max(1, window):]
+    if not rows:
+        # nothing to regress against — pass, loudly (a brand-new platform
+        # family seeds its own window with this run)
+        print(f"perf-gate: no '{family}' baseline rows; candidate seeds the "
+              f"window", file=sys.stderr)
+        return []
+    problems = []
+    for metric, (direction, band) in bands.items():
+        cand = candidate.get(metric)
+        if not isinstance(cand, (int, float)):
+            continue
+        window_vals = [
+            r[metric] for r in rows
+            if isinstance(r.get(metric), (int, float))
+        ]
+        if not window_vals:
+            continue
+        med = statistics.median(window_vals)
+        if direction == LOWER_BETTER:
+            limit = med * band
+            if cand > limit:
+                problems.append(
+                    f"{metric}: {cand:g} exceeds {band:g}x window median "
+                    f"{med:g} (limit {limit:g}, window n={len(window_vals)}, "
+                    f"family={family})"
+                )
+        else:
+            limit = med / band
+            if cand < limit:
+                problems.append(
+                    f"{metric}: {cand:g} below 1/{band:g} of window median "
+                    f"{med:g} (limit {limit:g}, window n={len(window_vals)}, "
+                    f"family={family})"
+                )
+    return problems
+
+
+def smoke(baseline_path=DEFAULT_BASELINE) -> list:
+    """Tier-1 smoke: (1) the committed baseline parses and its newest row
+    passes its own window; (2) a tiny-shape solve through the real backend,
+    program registry on, lands inside generous absolute bands and actually
+    populated the registry. Returns problem strings."""
+    import time
+
+    problems = []
+    rows = load_history(baseline_path)
+    usable = [r for r in rows if not r.get("error")]
+    if not usable:
+        return [f"no usable baseline rows in {baseline_path}"]
+    problems += [
+        f"committed baseline fails its own gate: {p}"
+        for p in gate(usable[-1], rows)
+    ]
+
+    from karpenter_tpu.obs import programs
+
+    programs.set_enabled(True)
+    try:
+        import random
+
+        from bench import make_diverse_pods
+        from karpenter_tpu.apis.nodepool import NodePool
+        from karpenter_tpu.apis.objects import ObjectMeta
+        from karpenter_tpu.cloudprovider.fake import instance_types
+        from karpenter_tpu.solver.encode import template_from_nodepool
+        from karpenter_tpu.solver.jax_backend import JaxSolver
+
+        its = instance_types(10)
+        tpl = template_from_nodepool(
+            NodePool(metadata=ObjectMeta(name="perf-gate-smoke")),
+            its, range(len(its)),
+        )
+        pods = make_diverse_pods(10, random.Random(42))
+        solver = JaxSolver()
+        t0 = time.perf_counter()
+        solver.solve(pods, its, [tpl])  # warm: compile included
+        warm_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        result = solver.solve(pods, its, [tpl])
+        steady_s = time.perf_counter() - t0
+        if warm_s > SMOKE_WARM_CEILING_S:
+            problems.append(
+                f"smoke warm solve took {warm_s:.1f}s "
+                f"(ceiling {SMOKE_WARM_CEILING_S:g}s)"
+            )
+        if steady_s > SMOKE_STEADY_CEILING_S:
+            problems.append(
+                f"smoke steady solve took {steady_s:.1f}s "
+                f"(ceiling {SMOKE_STEADY_CEILING_S:g}s)"
+            )
+        if result.num_scheduled() == 0:
+            problems.append("smoke solve scheduled 0 pods")
+        snap = programs.registry().snapshot()
+        if snap["totals"]["launches"] < 2:
+            problems.append(
+                f"program registry recorded {snap['totals']['launches']} "
+                f"launches for 2 solves"
+            )
+        if snap["memory"]["last"] is None:
+            problems.append("program registry captured no memory sample")
+    finally:
+        programs.set_enabled(None)
+    return problems
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--baseline", default=str(DEFAULT_BASELINE),
+                    help="committed history jsonl (default: repo root)")
+    ap.add_argument("--candidate", default=None,
+                    help="candidate row: a json file holding a history row "
+                         "or a full bench output, or '-' for stdin; default "
+                         "gates the baseline's newest usable row")
+    ap.add_argument("--band", type=float, default=None,
+                    help="override every metric's band multiplier")
+    ap.add_argument("--window", type=int, default=5,
+                    help="same-family rows to compare against (default 5)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1 tiny-shape smoke (see docstring)")
+    args = ap.parse_args()
+
+    if args.smoke:
+        problems = smoke(args.baseline)
+        for p in problems:
+            print(f"perf-gate: SMOKE FAIL: {p}", file=sys.stderr)
+        if not problems:
+            print("perf-gate: smoke ok")
+        return 1 if problems else 0
+
+    rows = load_history(args.baseline)
+    if args.candidate == "-":
+        candidate = json.load(sys.stdin)
+    elif args.candidate:
+        candidate = json.loads(Path(args.candidate).read_text())
+    else:
+        usable = [r for r in rows if not r.get("error")]
+        if not usable:
+            print("perf-gate: no usable baseline rows", file=sys.stderr)
+            return 1
+        candidate = usable[-1]
+    if "schema" not in candidate and "metric" in candidate:
+        # a raw bench.py output JSON was passed — distill it
+        candidate = row_from_bench(candidate, label="candidate")
+    problems = gate(candidate, rows, window=args.window,
+                    band_override=args.band)
+    for p in problems:
+        print(f"perf-gate: REGRESSION: {p}", file=sys.stderr)
+    if not problems:
+        print(
+            f"perf-gate: ok ({candidate.get('label', '?')} vs "
+            f"{len(rows)} baseline rows)"
+        )
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,28 +1,23 @@
 """Dev tool: does per-launch overhead scale with the number of in/out buffers
-through the axon tunnel?"""
+through the axon tunnel?
 
+Each variant dispatches under program-registry observation, so the closing
+report shows per-variant launch counts and input/output buffer bytes from
+karpenter_tpu.obs.programs rather than ad-hoc bookkeeping.
+"""
+
+import os
 import sys
-import time
 
-sys.path.insert(0, ".")
-import __graft_entry__
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+from tools import _profharness as H
 
-__graft_entry__._respect_platform_env()
+jax = H.setup()
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
-print(f"platform: {jax.devices()[0].platform}", file=sys.stderr)
-
-
-def timeit(label, fn, n=8):
-    fn()
-    t0 = time.perf_counter()
-    for _ in range(n):
-        fn()
-    per = (time.perf_counter() - t0) / n
-    print(f"{label}: {per*1e3:.1f} ms")
+programs = H.enable_registry()
 
 
 for n_in, n_out in [(2, 1), (40, 1), (2, 20), (40, 20), (60, 40)]:
@@ -38,15 +33,16 @@ for n_in, n_out in [(2, 1), (40, 1), (2, 20), (40, 20), (60, 40)]:
 
     f = make(n_out)
 
-    def run(f=f, ins=ins):
-        out = f(*ins)
+    def run(f=f, ins=ins, n_in=n_in, n_out=n_out):
+        out = H.observed(
+            "buffers", n_in, ins, lambda: f(*ins), statics={"n_out": n_out}
+        )
         return np.asarray(out[0])
 
-    timeit(f"jit {n_in} inputs -> {n_out} outputs", run)
+    H.timeit(f"jit {n_in} inputs -> {n_out} outputs", run)
 
 # device-resident inputs variant
 ins_dev = [jax.device_put(np.full((8, 8), i, np.float32)) for i in range(40)]
-f40 = None
 
 
 @jax.jit
@@ -56,11 +52,13 @@ def g(*xs):
 
 
 def run_dev():
-    out = g(*ins_dev)
+    out = H.observed(
+        "buffers_dev", 40, ins_dev, lambda: g(*ins_dev), statics={"n_out": 20}
+    )
     return np.asarray(out[0])
 
 
-timeit("jit 40 dev inputs -> 20 outputs", run_dev)
+H.timeit("jit 40 dev inputs -> 20 outputs", run_dev)
 
 # chained: do launches with many buffers pipeline?
 def chained():
@@ -69,4 +67,6 @@ def chained():
     return np.asarray(out2[0])
 
 
-timeit("2 chained 40-buffer launches + 1 fetch", chained)
+H.timeit("2 chained 40-buffer launches + 1 fetch", chained)
+
+H.registry_report()

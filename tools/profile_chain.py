@@ -11,9 +11,11 @@ is inside the timed region, matching what the backend pays.
 """
 
 import os
-import subprocess
 import sys
 import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+from tools import _profharness as H
 
 # (topo_chain, spread_chain, stride)
 CONFIGS = [
@@ -25,51 +27,26 @@ CONFIGS = [
     ("1", "1", "128"),
 ]
 
-if os.environ.get("_PROFILE_CHAIN_CHILD") != "1":
-    for topo, spread, stride in CONFIGS:
-        env = dict(os.environ)
-        env["_PROFILE_CHAIN_CHILD"] = "1"
-        env["KARPENTER_TPU_TOPO_CHAIN"] = topo
-        env["KARPENTER_TPU_SPREAD_CHAIN"] = spread
-        env["KARPENTER_TPU_STRIDE"] = stride
-        subprocess.run([sys.executable, __file__], env=env)
-    sys.exit(0)
+H.fanout(
+    __file__,
+    [
+        {
+            "KARPENTER_TPU_TOPO_CHAIN": topo,
+            "KARPENTER_TPU_SPREAD_CHAIN": spread,
+            "KARPENTER_TPU_STRIDE": stride,
+        }
+        for topo, spread, stride in CONFIGS
+    ],
+    "_PROFILE_CHAIN_CHILD",
+)
 
-sys.path.insert(0, ".")
-import __graft_entry__
+jax = H.setup(banner=False)
 
-__graft_entry__._respect_platform_env()
-
-import random
-
-import jax
 import numpy as np
 
-from bench import make_diverse_pods
-from karpenter_tpu.apis import labels as wk
-from karpenter_tpu.apis.nodepool import NodePool
-from karpenter_tpu.apis.objects import ObjectMeta
-from karpenter_tpu.cloudprovider.fake import instance_types
 from karpenter_tpu.ops.ffd import solve_ffd_sweeps
-from karpenter_tpu.ops.padding import pad_problem
-from karpenter_tpu.provisioning.topology import Topology
-from karpenter_tpu.solver.encode import (
-    Encoder,
-    domains_from_instance_types,
-    template_from_nodepool,
-)
 
-rng = random.Random(42)
-its = instance_types(400)
-tpl = template_from_nodepool(
-    NodePool(metadata=ObjectMeta(name="default")), its, range(len(its))
-)
-pods = make_diverse_pods(10000, rng)
-domains = domains_from_instance_types(its, [tpl])
-topo = Topology(domains, batch_pods=pods, cluster_pods=[])
-enc = Encoder(wk.WELL_KNOWN_LABELS)
-encoded = enc.encode(pods, its, [tpl], [], topology=topo, num_claim_slots=128)
-problem = pad_problem(encoded.problem)
+problem, _, _, _ = H.bench_problem()
 
 t0 = time.perf_counter()
 r = solve_ffd_sweeps(problem, 128)

@@ -1,83 +1,41 @@
 """Dev tool: per-kernel time attribution for one FFD scan pass via
-jax.profiler trace -> perfetto json parsing (no tensorboard needed)."""
+jax.profiler trace -> perfetto json parsing (no tensorboard needed).
 
-import glob
-import gzip
-import json
+Launch counts, compile attribution and buffer bytes come from the program
+registry (karpenter_tpu.obs.programs) — the same inventory /debug/programs
+serves — instead of hand-rolled counters.
+"""
+
 import os
-import random
 import sys
-import time
-from collections import defaultdict
 
-sys.path.insert(0, ".")
-import __graft_entry__
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+from tools import _profharness as H
 
-__graft_entry__._respect_platform_env()
+jax = H.setup()
 
-import jax
 import numpy as np
 
-from bench import make_diverse_pods
-from karpenter_tpu.apis import labels as wk
-from karpenter_tpu.apis.nodepool import NodePool
-from karpenter_tpu.apis.objects import ObjectMeta
-from karpenter_tpu.cloudprovider.fake import instance_types
 from karpenter_tpu.ops.ffd import solve_ffd
-from karpenter_tpu.ops.padding import pad_problem
-from karpenter_tpu.provisioning.topology import Topology
-from karpenter_tpu.solver.encode import (
-    Encoder,
-    domains_from_instance_types,
-    template_from_nodepool,
-)
 
 PODS = int(sys.argv[1]) if len(sys.argv) > 1 else 10000
 
-rng = random.Random(42)
-its = instance_types(400)
-tpl = template_from_nodepool(
-    NodePool(metadata=ObjectMeta(name="default")), its, range(len(its))
-)
-pods = make_diverse_pods(PODS, rng)
-domains = domains_from_instance_types(its, [tpl])
-topo = Topology(domains, batch_pods=pods, cluster_pods=[])
-enc = Encoder(wk.WELL_KNOWN_LABELS)
-encoded = enc.encode(pods, its, [tpl], [], topology=topo, num_claim_slots=128)
-problem = pad_problem(encoded.problem)
+programs = H.enable_registry()
+problem, _, _, _ = H.bench_problem(pods_n=PODS)
 
-r = solve_ffd(problem, 128)
-np.asarray(r.kind)  # warm
 
-trace_dir = "/tmp/jaxtrace"
-os.system(f"rm -rf {trace_dir}")
-with jax.profiler.trace(trace_dir):
-    r = solve_ffd(problem, 128)
+def run():
+    r = H.observed("solve_ffd", 128, problem, lambda: solve_ffd(problem, 128))
     np.asarray(r.kind)
 
-# find the trace json
-paths = glob.glob(f"{trace_dir}/**/*.trace.json.gz", recursive=True)
-print("trace files:", paths, file=sys.stderr)
-buckets = defaultdict(float)
-counts = defaultdict(int)
-total = 0.0
-for path in paths:
-    with gzip.open(path, "rt") as f:
-        data = json.load(f)
-    for ev in data.get("traceEvents", []):
-        if ev.get("ph") != "X":
-            continue
-        name = ev.get("name", "")
-        dur = ev.get("dur", 0) / 1e6  # us -> s
-        # keep device-side compute events only (heuristic: pid/tid naming is
-        # messy; filter by typical XLA op-name shapes)
-        if not name or name.startswith(("$", "process_")):
-            continue
-        buckets[name] += dur
-        counts[name] += 1
-        total += dur
+
+run()  # warm (the cold compile lands in the registry)
+buckets, counts, _ = H.kernel_trace(run, "/tmp/jaxtrace")
 
 top = sorted(buckets.items(), key=lambda kv: -kv[1])[:45]
+total = sum(buckets.values())
 print(f"total traced exclusive time (all threads) {total:.3f}s")
 for name, t in top:
     print(f"{t:8.4f}s  n={counts[name]:6d}  {name[:140]}")
+
+H.registry_report()

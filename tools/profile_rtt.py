@@ -4,30 +4,18 @@ block_until_ready on axon may not truly wait; np.asarray / device_get is the
 ground truth for host-visible completion.
 """
 
+import os
 import sys
-import time
 
-sys.path.insert(0, ".")
-import __graft_entry__
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+from tools import _profharness as H
 
-__graft_entry__._respect_platform_env()
+jax = H.setup()
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
-print(f"platform: {jax.devices()[0].platform}  jax {jax.__version__}", file=sys.stderr)
-
-
-def timeit(label, fn, n=10):
-    fn()
-    t0 = time.perf_counter()
-    for _ in range(n):
-        fn()
-    per = (time.perf_counter() - t0) / n
-    print(f"{label}: {per*1e3:.1f} ms")
-    return per
-
+timeit = lambda label, fn: H.timeit(label, fn, n=10)
 
 # 1. pure fetch RTT: tiny device-resident array
 tiny = jax.device_put(np.ones((4,), np.float32))
@@ -62,13 +50,6 @@ def reduce_it(x):
 timeit("H2D 256KB + jit + fetch scalar", lambda: np.asarray(reduce_it(host_in)))
 
 # 6. execute-only cost estimation: launch K chained jits then one fetch
-@jax.jit
-def chain(x):
-    for _ in range(8):
-        x = x + 1
-    return x
-
-
 def chained():
     y = tiny
     for _ in range(8):

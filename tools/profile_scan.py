@@ -4,48 +4,21 @@ Encodes one small problem, then times repeated solve_ffd calls (same shapes,
 cached executable) and a few synthetic scans of varying body size.
 """
 
-import random
+import os
 import sys
 import time
 
-sys.path.insert(0, ".")
-import __graft_entry__
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+from tools import _profharness as H
 
-__graft_entry__._respect_platform_env()
+jax = H.setup()
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
-print(f"platform: {jax.devices()[0].platform}", file=sys.stderr)
+from karpenter_tpu.ops.ffd import solve_ffd
 
-from bench import make_diverse_pods
-from karpenter_tpu.apis.nodepool import NodePool
-from karpenter_tpu.apis.objects import ObjectMeta
-from karpenter_tpu.cloudprovider.fake import instance_types
-from karpenter_tpu.ops.ffd import initial_state, solve_ffd
-from karpenter_tpu.ops.padding import pad_problem
-from karpenter_tpu.solver.encode import (
-    Encoder,
-    domains_from_instance_types,
-    template_from_nodepool,
-)
-from karpenter_tpu.provisioning.topology import Topology
-
-rng = random.Random(42)
-its = instance_types(400)
-tpl = template_from_nodepool(
-    NodePool(metadata=ObjectMeta(name="default")), its, range(len(its))
-)
-pods = make_diverse_pods(10, rng)
-domains = domains_from_instance_types(its, [tpl])
-topo = Topology(domains, batch_pods=pods, cluster_pods=[])
-enc = Encoder(None)
-from karpenter_tpu.apis import labels as wk
-
-enc = Encoder(wk.WELL_KNOWN_LABELS)
-encoded = enc.encode(pods, its, [tpl], [], topology=topo, num_claim_slots=16)
-problem = pad_problem(encoded.problem)
+problem, _, _, _ = H.bench_problem(pods_n=10, num_claim_slots=16)
 print(
     f"P={problem.num_pods} T={problem.num_instance_types} K={problem.num_keys} "
     f"V={problem.num_lanes} G={problem.grp_key.shape[0]} N={problem.num_nodes}",

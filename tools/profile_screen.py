@@ -1,21 +1,13 @@
 """Dev tool: time + kernel-trace the consolidation screen (B=100)."""
 
-import glob
-import gzip
-import json
 import os
 import sys
 import time
-from collections import defaultdict
 
-sys.path.insert(0, ".")
-import __graft_entry__
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+from tools import _profharness as H
 
-__graft_entry__._respect_platform_env()
-
-import jax
-
-print(f"platform: {jax.devices()[0].platform}", file=sys.stderr)
+jax = H.setup()
 
 from karpenter_tpu.disruption.batch import bench_candidate_scoring
 
@@ -26,28 +18,9 @@ t0 = time.perf_counter()
 bench_candidate_scoring(100)
 print(f"steady: {time.perf_counter() - t0:.2f}s")
 
-trace_dir = "/tmp/jaxtrace_screen"
-os.system(f"rm -rf {trace_dir}")
-with jax.profiler.trace(trace_dir):
-    bench_candidate_scoring(100)
-
-paths = glob.glob(f"{trace_dir}/**/*.trace.json.gz", recursive=True)
-buckets = defaultdict(float)
-counts = defaultdict(int)
-samples = {}
-for path in paths:
-    with gzip.open(path, "rt") as f:
-        data = json.load(f)
-    for ev in data.get("traceEvents", []):
-        if ev.get("ph") != "X":
-            continue
-        name = ev.get("name", "")
-        if not name or name.startswith(("$", "process_")):
-            continue
-        buckets[name] += ev.get("dur", 0) / 1e6
-        counts[name] += 1
-        samples[name] = ev.get("args", {})
+buckets, counts, samples = H.kernel_trace(
+    lambda: bench_candidate_scoring(100), "/tmp/jaxtrace_screen"
+)
 for name, t in sorted(buckets.items(), key=lambda kv: -kv[1])[:20]:
-    a = samples[name]
-    src = a.get("source", "")
+    src = samples[name].get("source", "")
     print(f"{t:8.4f}s n={counts[name]:6d} {name[:60]} {src}")

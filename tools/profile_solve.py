@@ -5,19 +5,15 @@ Runs each shape twice (warm compile, then steady) against the bench workload
 (400 fake instance types, makeDiversePods mix) and prints the pass structure.
 """
 
+import os
 import random
 import sys
 import time
 
-sys.path.insert(0, ".")
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+from tools import _profharness as H
 
-import __graft_entry__  # noqa: F401  (respects JAX_PLATFORMS)
-
-__graft_entry__._respect_platform_env()
-
-import jax
-
-print(f"platform: {jax.devices()[0].platform}", file=sys.stderr)
+jax = H.setup()
 
 from bench import make_diverse_pods
 from karpenter_tpu.apis.nodepool import NodePool

@@ -7,9 +7,11 @@ timing matters.
 """
 
 import os
-import subprocess
 import sys
 import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+from tools import _profharness as H
 
 CONFIGS = [
     "",
@@ -22,49 +24,19 @@ CONFIGS = [
     "citgate,ctopo,ttopo,titgate,record",
 ]
 
-if os.environ.get("_PROFILE_STEP_CHILD") != "1":
-    for cfg in CONFIGS:
-        env = dict(os.environ)
-        env["_PROFILE_STEP_CHILD"] = "1"
-        env["KARPENTER_TPU_ABLATE"] = cfg
-        subprocess.run([sys.executable, __file__], env=env)
-    sys.exit(0)
+H.fanout(
+    __file__,
+    [{"KARPENTER_TPU_ABLATE": cfg} for cfg in CONFIGS],
+    "_PROFILE_STEP_CHILD",
+)
 
-sys.path.insert(0, ".")
-import __graft_entry__
+jax = H.setup(banner=False)
 
-__graft_entry__._respect_platform_env()
-
-import random
-
-import jax
 import numpy as np
 
-from bench import make_diverse_pods
-from karpenter_tpu.apis import labels as wk
-from karpenter_tpu.apis.nodepool import NodePool
-from karpenter_tpu.apis.objects import ObjectMeta
-from karpenter_tpu.cloudprovider.fake import instance_types
 from karpenter_tpu.ops.ffd import solve_ffd
-from karpenter_tpu.ops.padding import pad_problem
-from karpenter_tpu.provisioning.topology import Topology
-from karpenter_tpu.solver.encode import (
-    Encoder,
-    domains_from_instance_types,
-    template_from_nodepool,
-)
 
-rng = random.Random(42)
-its = instance_types(400)
-tpl = template_from_nodepool(
-    NodePool(metadata=ObjectMeta(name="default")), its, range(len(its))
-)
-pods = make_diverse_pods(10000, rng)
-domains = domains_from_instance_types(its, [tpl])
-topo = Topology(domains, batch_pods=pods, cluster_pods=[])
-enc = Encoder(wk.WELL_KNOWN_LABELS)
-encoded = enc.encode(pods, its, [tpl], [], topology=topo, num_claim_slots=128)
-problem = pad_problem(encoded.problem)
+problem, _, _, _ = H.bench_problem()
 
 t0 = time.perf_counter()
 r = solve_ffd(problem, 128)

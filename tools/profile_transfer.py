@@ -2,19 +2,16 @@
 tunnel — a jitted reduction over a problem-sized pytree, called with (a) fresh
 numpy arrays each time, (b) device-resident arrays."""
 
+import os
 import sys
-import time
 
-sys.path.insert(0, ".")
-import __graft_entry__
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+from tools import _profharness as H
 
-__graft_entry__._respect_platform_env()
+jax = H.setup()
 
-import jax
 import jax.numpy as jnp
 import numpy as np
-
-print(f"platform: {jax.devices()[0].platform}", file=sys.stderr)
 
 # ~problem-shaped inputs: T=512 it-side lanes + pod-side smalls
 T, K, V, O, R, P, C = 512, 4, 128, 8, 8, 16, 16
@@ -36,21 +33,16 @@ def f(d):
     return sum(jnp.sum(v) for v in d.values())
 
 
-# warm
-jax.block_until_ready(f(arrays))
-
-N = 10
-t0 = time.perf_counter()
-for _ in range(N):
-    jax.block_until_ready(f(arrays))
-host_t = (time.perf_counter() - t0) / N
+host_t = H.timeit(
+    "per-call with numpy inputs   ",
+    lambda: jax.block_until_ready(f(arrays)), n=10,
+)
 
 dev = jax.device_put(arrays)
-jax.block_until_ready(f(dev))
-t0 = time.perf_counter()
-for _ in range(N):
-    jax.block_until_ready(f(dev))
-dev_t = (time.perf_counter() - t0) / N
+dev_t = H.timeit(
+    "per-call with device inputs  ",
+    lambda: jax.block_until_ready(f(dev)), n=10,
+)
 
 # single big array of same total bytes
 total = sum(v.nbytes for v in arrays.values())
@@ -62,13 +54,9 @@ def g(x):
     return jnp.sum(x)
 
 
-jax.block_until_ready(g(big))
-t0 = time.perf_counter()
-for _ in range(N):
-    jax.block_until_ready(g(big))
-big_t = (time.perf_counter() - t0) / N
+big_t = H.timeit(
+    "per-call one big numpy array ",
+    lambda: jax.block_until_ready(g(big)), n=10,
+)
 
 print(f"total input bytes: {total/1e6:.2f} MB over {len(arrays)} arrays")
-print(f"per-call with numpy inputs   : {host_t*1e3:.1f} ms")
-print(f"per-call with device inputs  : {dev_t*1e3:.1f} ms")
-print(f"per-call one big numpy array : {big_t*1e3:.1f} ms")
