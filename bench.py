@@ -186,6 +186,10 @@ def run_child():
     # program registry on for the whole run: per-program compile attribution
     # and per-cycle device-memory watermarks ride every shape event below
     os.environ.setdefault("KARPENTER_TPU_PROGRAMS", "1")
+    # placement explainability on: per-shape unschedulable-reason histograms
+    # and the attribution pass's overhead fraction (acceptance: <= 5% of
+    # solve wall; ~0 on a healthy run where nothing fails)
+    os.environ.setdefault("KARPENTER_TPU_EXPLAIN", "1")
 
     import __graft_entry__
 
@@ -313,6 +317,19 @@ def run_child():
                 k: mem[k]
                 for k in ("live_bytes", "peak_bytes", "carried_state_bytes",
                           "source")
+            }
+        # explain telemetry of the last measured rep (obs/explain.py): reason
+        # histogram over unscheduled pods and the attribution pass's cost
+        # relative to the solve it explained
+        last_explain = getattr(solver, "last_explain", None)
+        if last_explain is not None:
+            ev["explain"] = {
+                "unschedulable": len(last_explain.pods),
+                "reasons": last_explain.counts(),
+                "overhead_s": round(last_explain.overhead_s, 4),
+                "overhead_frac": round(
+                    last_explain.overhead_s / max(median, 1e-9), 4
+                ),
             }
         emit(ev)
     if first_solve is not None:
@@ -799,6 +816,24 @@ def main():
             "totals": progs.get("totals"),
             "top": progs.get("top"),
         }
+    # explainability telemetry (obs/explain.py, schema v2 history columns):
+    # merged unschedulable-reason histogram plus the attribution pass's cost
+    # as a fraction of solve wall — the north-star shape's if present, else
+    # the worst shape (acceptance: <= 0.05)
+    if any("explain" in e for e in shapes):
+        reasons = {}
+        for e in shapes:
+            for k, v in e.get("explain", {}).get("reasons", {}).items():
+                reasons[k] = reasons.get(k, 0) + v
+        out["unschedulable_reasons"] = reasons
+        out["per_shape_explain"] = {
+            str(e["pods"]): e["explain"] for e in shapes if "explain" in e
+        }
+        fracs = {
+            e["pods"]: e["explain"]["overhead_frac"]
+            for e in shapes if "explain" in e
+        }
+        out["explain_overhead_frac"] = fracs.get(10000, max(fracs.values()))
     if consol:
         rate = lambda e: e["candidates"] / max(e["solve_s"], 1e-9)
         best = max(consol, key=rate)

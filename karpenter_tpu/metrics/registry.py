@@ -258,6 +258,23 @@ WARM_SOLVES = REGISTRY.counter(
     "cold-first, cold-threshold, cold-unsupported, cold-world-changed)",
 )
 
+# -- placement explainability series (obs/explain.py) -------------------------
+UNSCHEDULABLE_PODS = REGISTRY.counter(
+    "unschedulable_pods_total",
+    "Pods a solve left unscheduled, by UnschedulableReason (label values are "
+    "bounded to the obs/explain.py taxonomy; KARPENTER_TPU_EXPLAIN only)",
+)
+EXPLAIN_OVERHEAD = REGISTRY.histogram(
+    "solver_explain_overhead_seconds",
+    "Wall time of the post-pass gate-attribution + decode (the explain "
+    "feature's whole marginal cost; zero series when the flag is off)",
+)
+EVENTS_DEDUPED = REGISTRY.counter(
+    "events_deduped_total",
+    "Event publishes suppressed by the recorder, by cause (duplicate = seen "
+    "within the dedupe TTL, rate-limited = per-key flow control)",
+)
+
 
 @contextmanager
 def measure(histogram: Histogram, labels: Optional[Dict[str, str]] = None):

@@ -101,6 +101,10 @@ class OperatorStatus:
         # program registry one-liner (obs/programs.py): compiled-program
         # count, launch totals, cache-source split, last memory sample
         out["programs"] = programs.registry().summary()
+        from karpenter_tpu.obs import explain
+
+        # unschedulable summary over the report ring (/debug/explain drills in)
+        out["unschedulable"] = explain.summary()
         return out
 
 
@@ -140,6 +144,19 @@ class _Handler(BaseHTTPRequestHandler):
                            default=str)
                 + "\n"
             ).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+        elif self.path.startswith("/debug/explain"):
+            from karpenter_tpu.obs import explain
+
+            # decision provenance of recent solves: per-pod reasons, hints,
+            # raw gate bits, nomination margins (most recent report first)
+            payload = {
+                "enabled": explain.enabled(),
+                "captured": len(explain.ring()),
+                "reports": explain.ring().snapshot(),
+            }
+            body = (json.dumps(payload, indent=1, default=str) + "\n").encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
         elif self.path.startswith("/debug/traces"):

@@ -105,6 +105,9 @@ class FFDResult:
     # i32[W+1] histogram of wavefront widths (lanes consumed per narrow
     # iteration); None unless the sweeps path ran with the wavefront on
     wave_hist: Any = None
+    # obs/explain.py attribution words int32[B, 3] for the failed rows (set
+    # host-side post-solve, KARPENTER_TPU_EXPLAIN only); None otherwise
+    explain: Any = None
 
 
 def _first_true(mask: jnp.ndarray) -> jnp.ndarray:

@@ -52,6 +52,7 @@ from karpenter_tpu.ops.ffd_core import (  # noqa: F401
     _lane_align,
     _make_it_gate,
     _mix_req_rows,
+    _offer_rows,
     _pad_lanes_mult32,
     _pod_xs,
     _row_sentinel_bounds,
@@ -501,3 +502,246 @@ def _solve_ffd_fresh_jit(
     return _solve_ffd_jit.__wrapped__(
         problem, initial_state(problem, max_claims), bounds_free
     )
+
+
+# -- placement explainability (obs/explain.py): post-pass gate attribution ----
+#
+# A SEPARATE program from the solve, run only when KARPENTER_TPU_EXPLAIN is on
+# and only over the pods the pack failed: it re-evaluates the narrow step's
+# gate families against the FINAL FFDState. That is exact, not approximate —
+# a terminal pass by definition made no commits (no progress, no relaxation),
+# and state only mutates on commits, so the final state equals the state every
+# failed pod was last evaluated against. _make_step is untouched; the solve
+# program (and the census pin, tests/test_kernel_census.py) cannot move.
+
+# pods per attribution launch: bounds the [B, C, T] / [B, TPL, T] gate
+# intermediates while keeping shapes static (one compile per problem bucket)
+_EXPLAIN_CHUNK = 32
+
+
+def _make_attribution(problem: SchedulingProblem, statics, C: int, state: FFDState):
+    """Per-pod gate-family attribution closure, vmapped by _attribute_jit.
+    Mirrors _make_step's node/claim/template gate phases, but instead of
+    picking a bin it reduces per-family fail predicates into the
+    obs/explain.py wire words via masks.family_bitmask. Family bit order is
+    obs/explain.FAM_*: resources, requirements, taints, host-ports, topology,
+    claim-capacity, volume."""
+    lv, ln = statics.lv, statics.ln
+    wellknown, no_allow = statics.wellknown, statics.no_allow
+    bounds_free = statics.bounds_free
+    N = problem.num_nodes
+    TPL = problem.num_templates
+
+    def it_terms(state_rows, requests):
+        """(compat&offer, fit) halves of _make_it_gate's product [B, T] —
+        split so requirements-vs-resources attribution can see which half
+        killed the last surviving instance type."""
+        state_packed = masks.pack_lanes(state_rows.admitted)
+        state_neg = vmap(
+            lambda r: masks.negative_polarity(r, lv, ln, bounds_free)
+        )(state_rows)
+        compat = masks.packed_pairwise_compat(
+            state_rows, state_packed, state_neg,
+            problem.it_reqs, statics.it_packed, statics.it_neg, bounds_free,
+        )
+        offer = _offer_rows(problem, state_rows.admitted)
+        fit = masks.fits(requests[:, None, :], problem.it_alloc[None, :, :])
+        return compat & offer, fit
+
+    # template-side capacity terms are pod-independent: hoisted out of vmap
+    tpl_base0 = jnp.asarray(problem.tpl_it_ok)  # [TPL, T] static tpl x IT compat
+    within_limits = masks.fits(
+        problem.it_cap[None, :, :], state.remaining[:, None, :]
+    )  # [TPL, T]
+    tpl_cap_ok = tpl_base0 & within_limits
+    tpl_has_base = jnp.any(tpl_base0, axis=-1)
+    tpl_has_cap = jnp.any(tpl_cap_ok, axis=-1)
+    tpl_fail_cap = tpl_has_base & ~tpl_has_cap  # nodepool limits ate the headroom
+
+    def attr(pod):
+        (
+            pod_req,
+            pod_strict,
+            pod_requests,
+            tol_tpl,
+            tol_node,
+            pod_ports,
+            pod_conflict,
+            grp_match,
+            grp_selects,
+            grp_owned,
+            pod_vols,
+            pod_is_active,
+            pod_neg,
+        ) = pod
+        topo_pod = PodTopoStatics(
+            strict_admitted=pod_strict.admitted,
+            grp_match=grp_match,
+            grp_selects=grp_selects,
+            grp_owned=grp_owned,
+        )
+
+        def gated(merged, allow, registered):
+            return topo_gate(
+                problem, state.grp_counts, registered, topo_pod, merged, allow,
+                fuse=bounds_free,
+            )
+
+        # -- node class (mirror of step phase 1)
+        if N == 0:
+            node_ubn = jnp.array([0, 1 << 7, 0], jnp.int32)
+        else:
+            node_requests2 = state.node_requests + pod_requests[None, :]
+            node_fit = masks.fits(node_requests2, problem.node_avail)
+            node_merged = _intersect_rows(state.node_req, pod_req, bounds_free)
+            if bounds_free:
+                node_neg = vmap(
+                    lambda r: masks.negative_polarity(r, lv, ln, True)
+                )(state.node_req)
+                node_compat = masks.compatible_from_merged(
+                    masks.nonempty(node_merged, True),
+                    state.node_req.defined,
+                    node_neg,
+                    pod_req.defined,
+                    pod_neg,
+                    no_allow,
+                )
+            else:
+                node_compat = vmap(
+                    lambda nr: masks.compatible_ok(nr, pod_req, lv, ln, no_allow)
+                )(state.node_req)
+            node_port_ok = ~jnp.any(
+                state.node_used_ports & pod_conflict[None, :], axis=-1
+            )
+            node_vol_ok = jnp.all(
+                state.node_vol_used + pod_vols[None, :] <= problem.node_vol_limits,
+                axis=-1,
+            )
+            node_topo_ok, _ = gated(node_merged, no_allow, state.grp_registered)
+            zeros_n = jnp.zeros((N,), bool)
+            node_ubn = masks.family_bitmask(
+                jnp.stack([
+                    ~node_fit,       # resources
+                    ~node_compat,    # requirements
+                    ~tol_node,       # taints
+                    ~node_port_ok,   # host-ports
+                    ~node_topo_ok,   # topology
+                    zeros_n,         # claim-capacity (n/a on existing nodes)
+                    ~node_vol_ok,    # volume
+                ]),
+                # padded node rows carry node_avail = -1 (padding.py); keep
+                # them out of the candidate set so they don't pollute unions
+                jnp.any(problem.node_avail >= 0, axis=-1),
+            )
+
+        # -- open-claim class (mirror of step phase 2)
+        claim_merged = _intersect_rows(state.claim_req, pod_req, bounds_free)
+        if bounds_free:
+            claim_neg = vmap(
+                lambda r: masks.negative_polarity(r, lv, ln, True)
+            )(state.claim_req)
+            claim_compat = masks.compatible_from_merged(
+                masks.nonempty(claim_merged, True),
+                state.claim_req.defined,
+                claim_neg,
+                pod_req.defined,
+                pod_neg,
+                wellknown,
+            )
+        else:
+            claim_compat = vmap(
+                lambda cr: masks.compatible_ok(cr, pod_req, lv, ln, wellknown)
+            )(state.claim_req)
+        claim_topo_ok, claim_final = gated(
+            claim_merged, wellknown, state.grp_registered
+        )
+        claim_requests2 = state.claim_requests + pod_requests[None, :]
+        it_co, it_fit = it_terms(claim_final, claim_requests2)
+        claim_co = state.claim_it_ok & it_co
+        claim_fit = claim_co & it_fit
+        has_base = jnp.any(state.claim_it_ok, axis=-1)
+        has_co = jnp.any(claim_co, axis=-1)
+        has_fit = jnp.any(claim_fit, axis=-1)
+        claim_port_ok = ~jnp.any(
+            state.claim_used_ports & pod_conflict[None, :], axis=-1
+        )
+        zeros_c = jnp.zeros((C,), bool)
+        claim_ubn = masks.family_bitmask(
+            jnp.stack([
+                (has_co & ~has_fit) | ~has_base,       # resources
+                ~claim_compat | (has_base & ~has_co),  # requirements (incl offering)
+                ~tol_tpl[state.claim_tpl],             # taints
+                ~claim_port_ok,                        # host-ports
+                ~claim_topo_ok,                        # topology
+                zeros_c,                               # claim-capacity
+                zeros_c,                               # volume
+            ]),
+            state.claim_open,
+        )
+
+        # -- fresh-template class (mirror of step phase 3, same minted slot)
+        free_slot = _first_true(~state.claim_open)
+        tpl_requests2 = problem.tpl_overhead + pod_requests[None, :]
+        tpl_merged, tpl_compat, host_onehot = _fresh_template_rows(
+            problem, lv, ln, wellknown, pod_req, free_slot,
+            bounds_free=bounds_free, tpl_neg=statics.tpl_neg, pod_neg=pod_neg,
+        )
+        reg_for_tpl = state.grp_registered | (
+            (problem.grp_key == HOSTNAME_KEY)[:, None] & host_onehot[None, :]
+        )
+        tpl_topo_ok, tpl_final = gated(tpl_merged, wellknown, reg_for_tpl)
+        it_co_t, it_fit_t = it_terms(tpl_final, tpl_requests2)
+        tpl_co = tpl_cap_ok & it_co_t
+        tpl_fit = tpl_co & it_fit_t
+        has_co_t = jnp.any(tpl_co, axis=-1)
+        has_fit_t = jnp.any(tpl_fit, axis=-1)
+        zeros_t = jnp.zeros((TPL,), bool)
+        tpl_ubn = masks.family_bitmask(
+            jnp.stack([
+                has_co_t & ~has_fit_t,                               # resources
+                ~tpl_compat | ~tpl_has_base | (tpl_has_cap & ~has_co_t),  # requirements
+                ~tol_tpl,                                            # taints
+                zeros_t,                                             # host-ports
+                ~tpl_topo_ok,                                        # topology
+                tpl_fail_cap,                                        # claim-capacity
+                zeros_t,                                             # volume
+            ]),
+            # padded template rows have tpl_it_ok all-False (padding.py)
+            jnp.any(problem.tpl_it_ok, axis=-1),
+        )
+
+        # one int32 triple per pod: class bytes packed node | claim<<8 | tpl<<16
+        return node_ubn + claim_ubn * 256 + tpl_ubn * 65536
+
+    return attr
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _attribute_jit(problem, state, rows, bounds_free):
+    statics = _statics(problem, bounds_free)
+    C = state.claim_open.shape[0]
+    xs = _pod_xs(problem, bounds_free)
+    sel = jax.tree_util.tree_map(lambda a: a[rows], xs)
+    return vmap(_make_attribution(problem, statics, C, state))(sel)
+
+
+def attribute_pods(problem: SchedulingProblem, state: FFDState, rows):
+    """int32[B, 3] explain words (union, blockers, near — obs/explain.py wire
+    format) for the pod rows ``rows``, evaluated against the final ``state``.
+    Host entry: chunks the rows so the [chunk, C, T] gate intermediates stay
+    bounded, pads the tail chunk (shape-static programs), returns numpy."""
+    import numpy as np
+
+    rows = np.asarray(rows, dtype=np.int32)
+    if rows.size == 0:
+        return np.zeros((0, 3), np.int32)
+    bounds_free = problem_bounds_free(problem)
+    problem, state = _lane_align(problem, state)
+    out = []
+    for i in range(0, len(rows), _EXPLAIN_CHUNK):
+        chunk = rows[i : i + _EXPLAIN_CHUNK]
+        pad = _EXPLAIN_CHUNK - len(chunk)
+        padded = np.pad(chunk, (0, pad), constant_values=chunk[-1])
+        words = _attribute_jit(problem, state, jnp.asarray(padded), bounds_free)
+        out.append(np.asarray(words)[: len(chunk)])
+    return np.concatenate(out, axis=0)

@@ -275,6 +275,40 @@ def packed_compatible_ok(
     return ~jnp.any(undef_bad, axis=-1) & jnp.all(~both_defined | ne | both_neg, axis=-1)
 
 
+def family_bitmask(fails: jnp.ndarray, cand: jnp.ndarray) -> jnp.ndarray:
+    """int32[3] (union, blockers, near) gate-attribution byte for ONE
+    candidate class — the device twin of obs/explain.encode_family_bits
+    (tests/test_explain.py pins the byte-for-byte equivalence).
+
+    ``fails``: bool[F, E] — family f failed on candidate e (F <= 7 families,
+    obs/explain.FAM_*). ``cand``: bool[E] — candidate liveness (open claims;
+    all-True for nodes/templates). One wide OR/AND reduction over predicates
+    the gate kernels already computed — no gathers:
+
+      union    bit f: family f failed on >= 1 live candidate
+      blockers bit f: family f failed on EVERY live candidate; bit 7 when the
+               class has no live candidate at all (EMPTY)
+      near     bit f: some live candidate failed ONLY family f — the
+               counterfactual "relax this one gate and the pod schedules"
+    """
+    F = fails.shape[0]
+    present = jnp.any(cand)
+    hit = fails & cand[None, :]  # [F, E]
+    union = jnp.any(hit, axis=-1)
+    blockers = present & jnp.all(fails | ~cand[None, :], axis=-1)
+    nfail = jnp.sum(hit, axis=0)  # [E] families failing each live candidate
+    near = jnp.any(hit & (nfail[None, :] == 1), axis=-1)
+    bits = jnp.int32(1) << jnp.arange(F, dtype=jnp.int32)
+    return jnp.stack(
+        [
+            jnp.sum(jnp.where(union, bits, 0)),
+            jnp.sum(jnp.where(blockers, bits, 0))
+            + jnp.where(present, 0, jnp.int32(1 << 7)),
+            jnp.sum(jnp.where(near, bits, 0)),
+        ]
+    ).astype(jnp.int32)
+
+
 def has_offering_zc(
     state_admitted: jnp.ndarray,  # bool[B, K, V] — bin states' admitted lanes
     zone_key: int,

@@ -477,13 +477,22 @@ class Provisioner:
                         f"should schedule on node {node_name}",
                     )
                 )
+        explain = getattr(result, "explain", None)
         for pi, reason in result.failures.items():
             # reference event text (scheduling/events.go:52-56) with the
             # per-criterion forensics rendered by solver/forensics.py
+            message = f"Failed to schedule pod, {reason}"
+            expl = explain.pods.get(pi) if explain is not None else None
+            if expl is not None:
+                # gate attribution prefix (obs/explain.py): the stable reason
+                # plus its counterfactual hint lead the forensics string
+                message = (
+                    f"Failed to schedule pod [{expl.reason}: {expl.hint}], "
+                    f"{reason}"
+                )
             self.recorder.publish(
                 object_event(
-                    inputs.pods[pi], "Warning", "FailedScheduling",
-                    f"Failed to schedule pod, {reason}",
+                    inputs.pods[pi], "Warning", "FailedScheduling", message,
                 )
             )
         return ProvisioningPass(

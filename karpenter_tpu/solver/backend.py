@@ -43,6 +43,9 @@ class SolveResult:
     node_pods: Dict[str, List[int]] = field(default_factory=dict)
     # failed pod index -> reason
     failures: Dict[int, str] = field(default_factory=dict)
+    # obs/explain.ExplainReport decision provenance (KARPENTER_TPU_EXPLAIN
+    # only; None when the flag is off or the backend doesn't attribute)
+    explain: Optional[object] = None
 
     def num_scheduled(self) -> int:
         return sum(len(c.pod_indices) for c in self.new_claims) + sum(

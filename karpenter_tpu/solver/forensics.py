@@ -187,6 +187,39 @@ def failure_reason(
 _quarantine_seq = 0
 
 
+def _quarantine_max() -> int:
+    """Ring size for on-disk quarantine dumps (KARPENTER_TPU_QUARANTINE_MAX,
+    default 32): a crash-looping validator must not fill the disk."""
+    import os
+
+    try:
+        return max(1, int(os.environ.get("KARPENTER_TPU_QUARANTINE_MAX", "32")))
+    except ValueError:
+        return 32
+
+
+def _evict_quarantine(directory: str, keep: int) -> None:
+    """Oldest-first eviction down to ``keep`` files. The timestamp-pid-seq
+    filename sorts lexicographically wrong across epochs of different digit
+    counts, so order on mtime (ties broken by name for determinism)."""
+    import os
+
+    try:
+        entries = [
+            (os.path.getmtime(os.path.join(directory, name)), name)
+            for name in os.listdir(directory)
+            if name.startswith("quarantine-") and name.endswith(".json")
+        ]
+    except OSError:
+        return
+    entries.sort()
+    for _, name in entries[: max(0, len(entries) - keep)]:
+        try:
+            os.remove(os.path.join(directory, name))
+        except OSError:
+            pass
+
+
 def dump_quarantine(
     result,
     violations: Sequence,
@@ -197,8 +230,10 @@ def dump_quarantine(
     """Write a rejected SolveResult to a forensics JSON file so a bad
     placement can be diagnosed offline after the supervisor failed over.
     Directory: ``KARPENTER_TPU_QUARANTINE_DIR`` (default
-    /tmp/karpenter-tpu-quarantine). Best-effort — quarantine must never be
-    the thing that breaks the failover path — returns the path or None."""
+    /tmp/karpenter-tpu-quarantine), bounded to the newest
+    ``KARPENTER_TPU_QUARANTINE_MAX`` dumps (oldest evicted first).
+    Best-effort — quarantine must never be the thing that breaks the
+    failover path — returns the path or None."""
     import json
     import os
     import time
@@ -239,8 +274,16 @@ def dump_quarantine(
             "node_pods": {k: list(v) for k, v in result.node_pods.items()},
             "failures": {str(k): v for k, v in result.failures.items()},
         }
+        explain = getattr(result, "explain", None)
+        if explain is not None:
+            # decision provenance travels with the quarantined result: the
+            # offline diagnosis starts from the per-pod gate attribution
+            payload["explain"] = (
+                explain.to_dict() if hasattr(explain, "to_dict") else explain
+            )
         with open(path, "w") as f:
             json.dump(payload, f, indent=1, default=str)
+        _evict_quarantine(directory, _quarantine_max())
         return path
     except Exception:
         return None

@@ -208,6 +208,9 @@ class JaxSolver(SolverBackend):
         # deltas per shape to report the compile-cache hit rate
         self.compile_cache_hits = 0
         self.compile_cache_misses = 0
+        # obs/explain.ExplainReport of the LAST solve (KARPENTER_TPU_EXPLAIN
+        # only); None before any explained solve and reset per solve
+        self.last_explain = None
 
     def solve(
         self,
@@ -234,6 +237,7 @@ class JaxSolver(SolverBackend):
         t0 = _now()
         bound_executable_maps()
         t0 = _t("maps-guard", t0)
+        self.last_explain = None  # never misattribute a prior solve's report
         max_claims = min(self.claim_slots, claim_axis_bucket(len(pods)))
         # passthrough: when the supervisor (or provisioner) already opened
         # this cycle, phases land directly under its span; a direct backend
@@ -262,6 +266,104 @@ class JaxSolver(SolverBackend):
                     self.claim_escalations += 1
                     with trace.span("escalate", max_claims=max_claims):
                         pass
+
+    def _explain(
+        self, out, problem, state, meta, kinds, failed, failed_rows,
+        pod_kinds, instance_types, total_pods,
+    ):
+        """Run the post-pass gate attribution (ops/ffd_step.attribute_pods)
+        over the failed rows, decode reasons, attach bounded winning-candidate
+        rationale, and publish the ExplainReport (ring + metrics). Returns the
+        raw attribution words (stamped into FFDResult.explain)."""
+        import time
+
+        from karpenter_tpu.obs import explain as obs_explain
+        from karpenter_tpu.ops.ffd_step import attribute_pods
+
+        t0 = time.perf_counter()
+        with trace.span("explain", failed=len(failed)):
+            words = attribute_pods(problem, state, failed_rows)
+            report = obs_explain.ExplainReport(
+                backend=type(self).__name__,
+                trace_id=trace.current_trace_id(),
+                total_pods=total_pods,
+                scheduled=total_pods - len(failed),
+            )
+            pod_requests = np.asarray(problem.pod_requests)
+            for i, orig in enumerate(failed):
+                row = failed_rows[i]
+                expl = obs_explain.decode_pod(orig, int(kinds[row]), words[i])
+                if expl.reason == obs_explain.REASON_RESOURCES:
+                    requests = {
+                        name: float(pod_requests[row, ri])
+                        for ri, name in enumerate(meta.resource_names)
+                        if ri < pod_requests.shape[1] and pod_requests[row, ri] > 0
+                    }
+                    hint = obs_explain.resource_hint(requests, instance_types)
+                    if hint:
+                        expl.hint = hint
+                report.pods[orig] = expl
+            if pod_kinds:
+                report.nominations = self._nominations(
+                    problem, state, meta, pod_kinds
+                )
+            report.overhead_s = time.perf_counter() - t0
+            trace.attr("reasons", report.counts())
+            trace.attr("overhead_s", round(report.overhead_s, 6))
+            obs_explain.publish(report)
+        self.last_explain = report
+        out.explain = report
+        return words
+
+    def _nominations(self, problem, state, meta, pod_kinds):
+        """Winning-candidate rationale for up to KARPENTER_TPU_EXPLAIN_MAX
+        scheduled pods in commit order (pod_kinds preserves insertion order
+        across passes): the chosen bin and its per-resource slack against the
+        end-of-pass bin state — the margins the pod's commit left behind."""
+        import itertools
+
+        from karpenter_tpu.obs import explain as obs_explain
+
+        cap = obs_explain.max_pods()
+        node_requests, claim_requests, claim_it_ok = jax.device_get(
+            (state.node_requests, state.claim_requests, state.claim_it_ok)
+        )
+        node_avail = np.asarray(problem.node_avail)
+        it_alloc = np.asarray(problem.it_alloc)
+        R = len(meta.resource_names)
+        noms = {}
+        for orig, (kind, index) in itertools.islice(pod_kinds.items(), cap):
+            if kind == KIND_NODE and index < len(node_avail):
+                slack = node_avail[index][:R] - node_requests[index][:R]
+                bin_name = meta.node_names[index]
+            elif index < len(claim_requests):
+                surviving = np.flatnonzero(claim_it_ok[index])
+                best = (
+                    it_alloc[surviving].max(axis=0)
+                    if len(surviving)
+                    else np.zeros(it_alloc.shape[1])
+                )
+                slack = best[:R] - claim_requests[index][:R]
+                bin_name = int(index)
+            else:
+                continue
+            margins = {
+                meta.resource_names[ri]: round(float(slack[ri]), 6)
+                for ri in range(min(R, len(slack)))
+            }
+            worst = (
+                min(margins.items(), key=lambda kv: kv[1])
+                if margins
+                else (None, 0.0)
+            )
+            noms[orig] = {
+                "kind": obs_explain.KIND_NAMES[kind],
+                "bin": bin_name,
+                "margin_basis": "end-of-pass",
+                "margins": margins,
+                "min_margin": {"resource": worst[0], "value": worst[1]},
+            }
+        return noms
 
     @staticmethod
     def _dispatch_device(n_pods: int, n_nodes: int):
@@ -494,6 +596,7 @@ class JaxSolver(SolverBackend):
 
             with trace.span("decode"):
                 failed = []
+                failed_rows = []  # device row per failed orig (explain lookup)
                 progress = False
                 for row in range(len(meta.pod_order)):
                     orig = queue[meta.pod_order[row]]
@@ -503,6 +606,7 @@ class JaxSolver(SolverBackend):
                         progress = True
                     else:
                         failed.append(orig)
+                        failed_rows.append(row)
                 relaxed_any = False
                 if not use_sweeps:  # sweeps imply nothing is relaxable
                     for orig in failed:
@@ -531,6 +635,16 @@ class JaxSolver(SolverBackend):
                         ),
                         well_known=self.well_known,
                     ) or FAIL_INCOMPATIBLE
+                # placement explainability (single flag check per solve; the
+                # attribution pass is a separate program over the final state,
+                # so placements are bit-identical with the flag on or off)
+                from karpenter_tpu.obs import explain as obs_explain
+
+                if obs_explain.enabled() and state is not None:
+                    result.explain = self._explain(
+                        out, problem, state, meta, kinds, failed, failed_rows,
+                        pod_kinds, instance_types, len(pods),
+                    )
                 break
             queue = failed
 

@@ -227,6 +227,11 @@ class SupervisedSolver(SolverBackend):
             # which compiled programs the supervised path has been paying
             # for (compile seconds, cache-source split, last memory sample)
             out["programs"] = programs.registry().summary()
+        from karpenter_tpu.obs import explain as obs_explain
+
+        if obs_explain.enabled() or len(obs_explain.ring()):
+            # decision provenance of recent solves (/debug/explain drills in)
+            out["explain"] = obs_explain.summary()
         return out
 
     # -- circuit transitions --------------------------------------------------

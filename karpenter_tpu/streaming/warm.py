@@ -436,6 +436,30 @@ class StreamingSolver(SolverBackend):
             )
         for si, reason in sub_result.failures.items():
             merged.failures[sub_indices[si]] = reason
+        sub_explain = getattr(sub_result, "explain", None)
+        if sub_explain is not None:
+            # failed pods are always seeds, so the sub-solve attributed every
+            # failure; re-key its report to batch-global indices (the inner
+            # backend already published ring/metrics — this is result-carried
+            # provenance for events, quarantine dumps, and the provisioner)
+            import dataclasses
+
+            from karpenter_tpu.obs import explain as obs_explain
+
+            remapped = obs_explain.ExplainReport(
+                backend=sub_explain.backend,
+                trace_id=sub_explain.trace_id,
+                total_pods=len(pods),
+                scheduled=len(pods) - len(merged.failures),
+                overhead_s=sub_explain.overhead_s,
+            )
+            for si, expl in sub_explain.pods.items():
+                remapped.pods[sub_indices[si]] = dataclasses.replace(
+                    expl, pod=sub_indices[si]
+                )
+            for si, nom in sub_explain.nominations.items():
+                remapped.nominations[sub_indices[si]] = nom
+            merged.explain = remapped
         for ci, gidx in joined.items():
             pl = surviving_claims[ci]
             for i in gidx:

@@ -1,0 +1,492 @@
+"""Placement explainability (obs/explain.py + ops/masks.family_bitmask +
+backend attribution passes, docs/OBSERVABILITY.md "Explainability").
+
+Covers the encoder/decoder contract (device kernel byte-for-byte vs the
+host encoder, the decode ladder's priorities), the flag contract (off:
+result.explain is None and placements untouched; on: bit-identical
+placements, every unscheduled pod gets a non-unknown reason), oracle↔jax
+reason parity, warm re-solve survival, recorder flow control, the
+/debug/explain surface, and the tools/explain.py --demo smoke."""
+
+import dataclasses
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodepool import NodePool
+from karpenter_tpu.apis.objects import (
+    Container,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    Taint,
+)
+from karpenter_tpu.cloudprovider.fake import FAKE_WELL_KNOWN_LABELS, instance_types
+from karpenter_tpu.obs import explain as ox
+from karpenter_tpu.scheduling import Taints
+from karpenter_tpu.solver.encode import template_from_nodepool
+from karpenter_tpu.solver.jax_backend import JaxSolver
+from karpenter_tpu.solver.oracle import OracleSolver
+
+
+@pytest.fixture(autouse=True)
+def _explain_hygiene():
+    """Every test starts flag-unforced with an empty report ring."""
+    ox.set_enabled(None)
+    ox.reset_ring()
+    yield
+    ox.set_enabled(None)
+    ox.reset_ring()
+
+
+def make_pod(name, cpu=0.5, mem=1e8, node_selector=None, tolerations=()):
+    return Pod(
+        metadata=ObjectMeta(name=name),
+        spec=PodSpec(
+            containers=[Container(requests={"cpu": cpu, "memory": mem})],
+            node_selector=node_selector or {},
+            tolerations=list(tolerations),
+        ),
+    )
+
+
+@pytest.fixture(scope="module")
+def universe():
+    its = instance_types(8)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="default")), its, range(len(its))
+    )
+    return its, tpl
+
+
+# -- encoder: device kernel vs host mirror ------------------------------------
+
+
+class TestEncoder:
+    def test_device_host_bitmask_equivalence(self):
+        """masks.family_bitmask and explain.encode_family_bits are twins:
+        byte-for-byte equal on randomized fail/candidate matrices — the pin
+        that lets the oracle classifier cross-check the jitted kernel."""
+        import jax.numpy as jnp
+
+        from karpenter_tpu.ops import masks
+
+        rng = np.random.default_rng(7)
+        for _ in range(100):
+            E = int(rng.integers(1, 9))
+            fails = rng.random((ox.NUM_FAMILIES, E)) < 0.4
+            cand = rng.random(E) < 0.7
+            host = ox.encode_family_bits(
+                [list(row) for row in fails], list(cand)
+            )
+            dev = masks.family_bitmask(jnp.asarray(fails), jnp.asarray(cand))
+            assert tuple(int(x) for x in dev) == host
+
+    def test_empty_class_sets_bit7(self):
+        union, blockers, near = ox.encode_family_bits(
+            [[True]] * ox.NUM_FAMILIES, [False]
+        )
+        assert union == 0 and near == 0
+        assert blockers == 1 << ox.EMPTY_BIT
+
+    def test_pack_words_byte_layout(self):
+        u, b, n = ox.pack_words([(0x11, 0x01, 0x00), (0x22, 0x02, 0x00),
+                                 (0x44, 0x80, 0x04)])
+        assert u == 0x11 | (0x22 << 8) | (0x44 << 16)
+        assert b == 0x01 | (0x02 << 8) | (0x80 << 16)
+        assert n == 0x04 << 16
+
+
+# -- decoder: the ladder ------------------------------------------------------
+
+
+def _words(node=(0, 0, 0), claim=(0, 0, 0), template=(0, 0, 0)):
+    return ox.pack_words([node, claim, template])
+
+
+_EMPTY = (0, 1 << ox.EMPTY_BIT, 0)
+
+
+class TestDecoder:
+    def test_no_slot_is_claim_capacity(self):
+        expl = ox.decode_pod(0, ox._KIND_NO_SLOT, _words())
+        assert expl.reason == ox.REASON_CLAIM_CAPACITY
+        assert expl.derivation == "no-slot"
+
+    def test_all_empty_is_no_candidates(self):
+        expl = ox.decode_pod(0, ox._KIND_FAIL, _words(_EMPTY, _EMPTY, _EMPTY))
+        assert expl.reason == ox.REASON_NO_CANDIDATES
+
+    def test_blocking_priority_taints_over_resources(self):
+        """Both families block every class: the identity gate (taints) wins
+        over the capacity catch-all (resources)."""
+        byte = (1 << ox.FAM_TAINTS) | (1 << ox.FAM_RESOURCES)
+        cls = (byte, byte, 0)
+        expl = ox.decode_pod(0, ox._KIND_FAIL, _words(cls, _EMPTY, cls))
+        assert expl.reason == ox.REASON_TAINTS
+        assert expl.derivation == "blocking"
+
+    def test_blocker_must_cover_every_non_empty_class(self):
+        """A family blocking only ONE of two non-empty classes is not a
+        blocker verdict; the near-miss rung answers instead."""
+        taint_blocks = (1 << ox.FAM_TAINTS, 1 << ox.FAM_TAINTS, 0)
+        res_near = (1 << ox.FAM_RESOURCES, 0, 1 << ox.FAM_RESOURCES)
+        expl = ox.decode_pod(0, ox._KIND_FAIL, _words(taint_blocks, _EMPTY, res_near))
+        assert expl.reason == ox.REASON_RESOURCES
+        assert expl.derivation == "near-miss"
+
+    def test_near_miss_prefers_template_class(self):
+        """'One gate away from a fresh node' beats a near miss on an
+        existing node: the template class is scanned first."""
+        node_near = (1 << ox.FAM_PORTS, 0, 1 << ox.FAM_PORTS)
+        tpl_near = (1 << ox.FAM_TOPOLOGY, 0, 1 << ox.FAM_TOPOLOGY)
+        expl = ox.decode_pod(0, ox._KIND_FAIL, _words(node_near, _EMPTY, tpl_near))
+        assert expl.reason == ox.REASON_TOPOLOGY
+
+    def test_dominant_union_by_coverage(self):
+        two_cls = (1 << ox.FAM_VOLUME, 0, 0)
+        one_cls = (1 << ox.FAM_TAINTS, 0, 0)
+        expl = ox.decode_pod(
+            0, ox._KIND_FAIL, _words(two_cls, two_cls, one_cls)
+        )
+        assert expl.reason == ox.REASON_VOLUME
+        assert expl.derivation == "dominant"
+
+    def test_all_zero_words_is_unknown(self):
+        expl = ox.decode_pod(0, ox._KIND_FAIL, _words())
+        assert expl.reason == ox.REASON_UNKNOWN
+
+    def test_reasons_taxonomy_is_closed(self):
+        """Every reason the decoder can emit is in REASONS (the bounded
+        metric-label contract tools/metrics_lint.py enforces)."""
+        assert set(ox._FAMILY_REASON.values()) | {
+            ox.REASON_NO_CANDIDATES, ox.REASON_UNKNOWN
+        } <= set(ox.REASONS)
+
+
+# -- the flag contract through the jax backend --------------------------------
+
+
+def _engineered_pods():
+    return [
+        make_pod("ok-0"),
+        make_pod("huge", cpu=10_000.0),  # -> resources
+        make_pod("moon", node_selector={wk.LABEL_TOPOLOGY_ZONE: "no-such-zone"}),
+        make_pod("ok-1"),
+    ]
+
+
+class TestJaxExplain:
+    def test_flag_off_no_report(self, universe):
+        its, tpl = universe
+        result = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(
+            _engineered_pods(), its, [tpl]
+        )
+        assert getattr(result, "explain", None) is None
+        assert len(ox.ring()) == 0
+
+    def test_flag_on_bit_identical_and_reasons(self, universe):
+        its, tpl = universe
+        pods = _engineered_pods()
+        off = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(pods, its, [tpl])
+        ox.set_enabled(True)
+        on = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(pods, its, [tpl])
+
+        # placements bit-identical either flag state
+        assert on.failures.keys() == off.failures.keys()
+        assert {k: sorted(v) for k, v in on.node_pods.items()} == {
+            k: sorted(v) for k, v in off.node_pods.items()
+        }
+        assert [sorted(c.pod_indices) for c in on.new_claims] == [
+            sorted(c.pod_indices) for c in off.new_claims
+        ]
+
+        # every unscheduled pod explained, non-unknown, in the taxonomy
+        rep = on.explain
+        assert rep is not None and rep.pods.keys() == on.failures.keys()
+        reasons = {pi: e.reason for pi, e in rep.pods.items()}
+        assert reasons == {1: ox.REASON_RESOURCES, 2: ox.REASON_REQUIREMENTS}
+        assert all(e.hint for e in rep.pods.values())
+        # the resources hint names the binding resource
+        assert "cpu" in rep.pods[1].hint
+
+        # published: report ring + bounded-label counter
+        assert len(ox.ring()) >= 1
+        assert ox.ring().last().get("reasons") == {
+            ox.REASON_RESOURCES: 1, ox.REASON_REQUIREMENTS: 1,
+        }
+        assert rep.overhead_s >= 0.0
+
+    def test_taints_reason(self, universe):
+        its, tpl = universe
+        tainted = dataclasses.replace(
+            tpl, taints=Taints([Taint(key="team", value="x", effect="NoSchedule")])
+        )
+        ox.set_enabled(True)
+        result = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(
+            [make_pod("plain")], its, [tainted]
+        )
+        assert 0 in result.failures
+        assert result.explain.pods[0].reason == ox.REASON_TAINTS
+
+    def test_unschedulable_counter_is_bounded(self, universe):
+        from karpenter_tpu.metrics.registry import UNSCHEDULABLE_PODS
+
+        its, tpl = universe
+        before = UNSCHEDULABLE_PODS.value(labels={"reason": ox.REASON_RESOURCES})
+        ox.set_enabled(True)
+        JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(
+            [make_pod("huge", cpu=10_000.0)], its, [tpl]
+        )
+        assert UNSCHEDULABLE_PODS.value(
+            labels={"reason": ox.REASON_RESOURCES}
+        ) == before + 1
+
+    def test_nominations_for_scheduled_pods(self, universe):
+        its, tpl = universe
+        ox.set_enabled(True)
+        result = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(
+            [make_pod("ok-0"), make_pod("ok-1")], its, [tpl]
+        )
+        assert not result.failures
+        noms = result.explain.nominations
+        assert set(noms) == {0, 1}
+        for nom in noms.values():
+            assert nom["kind"] in ox.KIND_NAMES
+            assert "min_margin" in nom
+
+
+# -- oracle parity (the acceptance cross-check) -------------------------------
+
+
+class TestOracleParity:
+    def test_reasons_and_hints_match(self, universe):
+        its, tpl = universe
+        pods = _engineered_pods()
+        ox.set_enabled(True)
+        jr = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(pods, its, [tpl])
+        orr = OracleSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(pods, its, [tpl])
+        assert jr.failures.keys() == orr.failures.keys()
+        assert {k: v.reason for k, v in jr.explain.pods.items()} == {
+            k: v.reason for k, v in orr.explain.pods.items()
+        }
+        assert {k: v.hint for k, v in jr.explain.pods.items()} == {
+            k: v.hint for k, v in orr.explain.pods.items()
+        }
+        assert ox.REASON_UNKNOWN not in {
+            v.reason for v in jr.explain.pods.values()
+        }
+
+    def test_taints_parity(self, universe):
+        its, tpl = universe
+        tainted = dataclasses.replace(
+            tpl, taints=Taints([Taint(key="team", value="x", effect="NoSchedule")])
+        )
+        ox.set_enabled(True)
+        jr = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(
+            [make_pod("plain")], its, [tainted]
+        )
+        orr = OracleSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(
+            [make_pod("plain")], its, [tainted]
+        )
+        assert (
+            jr.explain.pods[0].reason
+            == orr.explain.pods[0].reason
+            == ox.REASON_TAINTS
+        )
+
+
+# -- warm re-solve survival ---------------------------------------------------
+
+
+class TestWarmSurvival:
+    def test_reasons_survive_warm_resolve_with_global_indices(self, universe):
+        from karpenter_tpu.streaming import StreamingSolver
+
+        its, tpl = universe
+        ox.set_enabled(True)
+        solver = StreamingSolver(OracleSolver(well_known=FAKE_WELL_KNOWN_LABELS))
+
+        rng = random.Random(3)
+        base = [make_pod(f"w-{i}", cpu=0.1 + 0.05 * rng.random()) for i in range(20)]
+        huge = make_pod("w-huge", cpu=10_000.0)
+        pods = base + [huge]
+        solver.solve(pods, its, [tpl])
+        assert solver.last_outcome == "cold-first"
+
+        # churn one pod; the failed pod seeds the warm sub-batch and its
+        # reason must come back keyed by the GLOBAL index in the new batch
+        churned = base[1:] + [make_pod("w-new", cpu=0.1), huge]
+        result = solver.solve(churned, its, [tpl])
+        assert solver.last_outcome == "warm"
+        huge_idx = churned.index(huge)
+        assert huge_idx in result.failures
+        assert result.explain is not None
+        expl = result.explain.pods[huge_idx]
+        assert expl.pod == huge_idx
+        assert expl.reason == ox.REASON_RESOURCES
+
+
+# -- recorder flow control (satellite: events dedup + rate limit) -------------
+
+
+class TestRecorderFlowControl:
+    def test_dedupe_and_rate_limit(self):
+        from karpenter_tpu.events.recorder import Event, Recorder
+        from karpenter_tpu.metrics.registry import EVENTS_DEDUPED
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        rec = Recorder(clock=clock)
+        dup_before = EVENTS_DEDUPED.value(labels={"cause": "duplicate"})
+        rl_before = EVENTS_DEDUPED.value(labels={"cause": "rate-limited"})
+
+        ev = Event(involved_kind="Pod", involved_name="p", reason="R", message="m")
+        rec.publish(ev)
+        rec.publish(ev)  # exact duplicate within TTL
+        assert len(rec.events) == 1 and rec.deduped == 1
+        assert EVENTS_DEDUPED.value(labels={"cause": "duplicate"}) == dup_before + 1
+
+        # distinct messages share the (kind|name|reason) bucket: burst 25,
+        # one token already spent above -> 24 more pass, the rest throttle
+        for i in range(30):
+            rec.publish(Event(involved_kind="Pod", involved_name="p",
+                              reason="R", message=f"storm {i}"))
+        assert len(rec.events) == 25
+        assert rec.rate_limited == 6
+        assert (
+            EVENTS_DEDUPED.value(labels={"cause": "rate-limited"})
+            == rl_before + 6
+        )
+
+        # tokens refill at 10/s: one second buys ten more publishes
+        clock.step(1.0)
+        for i in range(12):
+            rec.publish(Event(involved_kind="Pod", involved_name="p",
+                              reason="R", message=f"later {i}"))
+        assert len(rec.events) == 35
+
+        # a different object's bucket is untouched
+        rec.publish(Event(involved_kind="Pod", involved_name="q",
+                          reason="R", message="other"))
+        assert len(rec.events) == 36
+
+    def test_dedupe_expires_after_ttl(self):
+        from karpenter_tpu.events import recorder as rmod
+        from karpenter_tpu.utils.clock import FakeClock
+
+        clock = FakeClock()
+        rec = rmod.Recorder(clock=clock)
+        ev = rmod.Event(involved_kind="Pod", involved_name="p",
+                        reason="R", message="m")
+        rec.publish(ev)
+        clock.step(rmod._DEDUPE_TTL + 1.0)
+        rec.publish(ev)
+        assert len(rec.events) == 2 and rec.deduped == 0
+
+
+# -- event + endpoint surfaces ------------------------------------------------
+
+
+class TestSurfaces:
+    def test_failed_scheduling_event_carries_reason_and_hint(self):
+        from tests.factories import make_pod as factory_pod
+        from tests.harness import Env
+
+        from tests.factories import make_nodepool
+
+        ox.set_enabled(True)
+        env = Env()
+        env.create(make_nodepool())
+        env.expect_provisioned(factory_pod(name="huge", cpu=50_000.0))
+        messages = [
+            e.message
+            for e in env.recorder.events
+            if e.reason == "FailedScheduling" and e.involved_name == "huge"
+        ]
+        assert messages
+        assert any(f"[{ox.REASON_RESOURCES}:" in m for m in messages), messages
+
+    def test_summary_and_statusz_shape(self, universe):
+        its, tpl = universe
+        ox.set_enabled(True)
+        JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(
+            [make_pod("huge", cpu=10_000.0)], its, [tpl]
+        )
+        summary = ox.summary()
+        assert summary["enabled"] and summary["reports"] >= 1
+        assert summary["reasons"].get(ox.REASON_RESOURCES, 0) >= 1
+
+        from karpenter_tpu.operator.serving import OperatorStatus
+
+        payload = OperatorStatus().statusz()
+        assert payload["unschedulable"]["reasons"].get(ox.REASON_RESOURCES, 0) >= 1
+
+    def test_quarantine_dump_embeds_explain(self, universe, tmp_path):
+        from karpenter_tpu.solver.forensics import dump_quarantine
+
+        its, tpl = universe
+        ox.set_enabled(True)
+        result = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(
+            [make_pod("huge", cpu=10_000.0)], its, [tpl]
+        )
+        path = dump_quarantine(result, ["synthetic violation"],
+                               backend="JaxSolver", directory=str(tmp_path))
+        assert path is not None
+        import json
+
+        payload = json.loads(open(path).read())
+        assert payload["explain"]["pods"]["0"]["reason"] == ox.REASON_RESOURCES
+
+
+# -- CLI (satellite: tools/explain.py --demo wired into tier-1) ---------------
+
+
+class TestCli:
+    def test_demo_renders_waterfall(self, capsys):
+        from tools.explain import main
+
+        assert main(["--demo"]) == 0
+        out = capsys.readouterr().out
+        assert "report JaxSolver" in out
+        assert ox.REASON_RESOURCES in out and ox.REASON_REQUIREMENTS in out
+        assert "nominations" in out
+
+    def test_demo_pod_drilldown(self, capsys):
+        from tools.explain import main
+
+        assert main(["--demo", "--pod", "1"]) == 0
+        out = capsys.readouterr().out
+        assert "pod 1" in out and "pod 2" not in out
+
+
+# -- metrics lint extension (satellite: taxonomy bounded + documented) --------
+
+
+class TestTaxonomyLint:
+    def test_undocumented_reason_is_flagged(self):
+        from tools.metrics_lint import _check_explain_taxonomy
+
+        full = " ".join(f"`{r}`" for r in ox.REASONS)
+        assert _check_explain_taxonomy(full) == []
+        partial = " ".join(f"`{r}`" for r in ox.REASONS if r != ox.REASON_TAINTS)
+        problems = _check_explain_taxonomy(partial)
+        assert any(ox.REASON_TAINTS in p for p in problems)
+
+    def test_out_of_taxonomy_label_is_flagged(self):
+        from karpenter_tpu.metrics.registry import UNSCHEDULABLE_PODS
+        from tools.metrics_lint import _check_explain_taxonomy
+
+        full = " ".join(f"`{r}`" for r in ox.REASONS)
+        UNSCHEDULABLE_PODS.inc({"reason": "not-a-reason"})
+        key = (("reason", "not-a-reason"),)
+        try:
+            problems = _check_explain_taxonomy(full)
+            assert any("not-a-reason" in p for p in problems)
+        finally:
+            UNSCHEDULABLE_PODS._values.pop(key, None)
+        assert not any(
+            "not-a-reason" in p for p in _check_explain_taxonomy(full)
+        )

@@ -172,6 +172,49 @@ class TestBackendsRenderForensics:
         assert set(jr.failures) == {0, 1}
 
 
+class TestQuarantineRing:
+    """dump_quarantine is bounded to KARPENTER_TPU_QUARANTINE_MAX files per
+    directory, evicting oldest-first — a crash-looping validator must not
+    fill the disk."""
+
+    class _Result:
+        new_claims = ()
+        node_pods: dict = {}
+        failures: dict = {}
+
+    def test_oldest_first_eviction(self, tmp_path, monkeypatch):
+        import os
+
+        from karpenter_tpu.solver.forensics import dump_quarantine
+
+        monkeypatch.setenv("KARPENTER_TPU_QUARANTINE_MAX", "3")
+        paths = []
+        for i in range(6):
+            path = dump_quarantine(
+                self._Result(), [f"violation {i}"], directory=str(tmp_path)
+            )
+            assert path is not None
+            paths.append(path)
+            # force a strictly increasing mtime order: same-second dumps
+            # would otherwise tie and fall back to the name tiebreak
+            os.utime(path, (1000.0 + 10 * i, 1000.0 + 10 * i))
+        survivors = sorted(
+            p.name for p in tmp_path.glob("quarantine-*.json")
+        )
+        expected = sorted(os.path.basename(p) for p in paths[-3:])
+        assert survivors == expected, (
+            f"eviction kept {survivors}, wanted the 3 NEWEST {expected}"
+        )
+
+    def test_malformed_max_falls_back(self, tmp_path, monkeypatch):
+        from karpenter_tpu.solver.forensics import _quarantine_max
+
+        monkeypatch.setenv("KARPENTER_TPU_QUARANTINE_MAX", "nope")
+        assert _quarantine_max() == 32
+        monkeypatch.setenv("KARPENTER_TPU_QUARANTINE_MAX", "0")
+        assert _quarantine_max() == 1  # ring of at least the newest dump
+
+
 class TestProvisionerEvent:
     def test_failed_scheduling_event_carries_forensics(self):
         """FailedScheduling events carry the per-criterion reason

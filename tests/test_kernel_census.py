@@ -126,6 +126,21 @@ class TestWavefrontBudget:
             else:
                 os.environ["KARPENTER_TPU_PROGRAMS_EQNS"] = old
 
+    def test_explain_on_adds_zero_equations(self, census_problem):
+        """Placement explainability (obs/explain.py) attributes failures in a
+        SEPARATE post-pass kernel over failed rows only: with
+        KARPENTER_TPU_EXPLAIN forced on, the narrow body itself must count
+        EXACTLY the same 2394 equations — the solve program is untouched,
+        which is what makes flag-on placements bit-identical by
+        construction."""
+        from karpenter_tpu.obs import explain
+
+        explain.set_enabled(True)
+        try:
+            assert narrow_jaxpr_eqns(census_problem, wavefront=0) == 2394
+        finally:
+            explain.set_enabled(None)
+
     def test_delta_path_adds_zero_equations(self, census_problem):
         """The streaming subsystem (streaming/) is host-side only: with the
         delta path imported AND enabled (KARPENTER_TPU_DELTA=1, the supervisor

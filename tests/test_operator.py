@@ -167,6 +167,85 @@ def test_readyz_and_statusz_reflect_circuit_state():
         server.shutdown()
 
 
+def test_debug_endpoints_untorn_json_under_live_solves():
+    """Thread hammer: /debug/explain, /debug/traces, /debug/programs and
+    /statusz must serve parseable (untorn) JSON while solves are publishing
+    into the rings they read — the rings lock, ThreadingHTTPServer threads
+    read, and any torn snapshot surfaces as a JSONDecodeError here."""
+    import json
+    import socket
+    import threading
+    import urllib.request
+
+    from karpenter_tpu.apis.nodepool import NodePool
+    from karpenter_tpu.apis.objects import ObjectMeta
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.obs import explain, trace
+    from karpenter_tpu.operator import serving
+    from karpenter_tpu.solver.encode import template_from_nodepool
+    from karpenter_tpu.solver.oracle import OracleSolver
+    from karpenter_tpu.solver.supervisor import SupervisedSolver
+
+    its = instance_types(8)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="hammer")), its, range(len(its))
+    )
+    sup = SupervisedSolver(OracleSolver())
+    explain.set_enabled(True)
+    trace.set_enabled(True)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    server = serving.serve(port, status=serving.OperatorStatus(supervisor=sup))
+    base = f"http://127.0.0.1:{port}"
+    stop = threading.Event()
+    errors = []
+
+    def solve_loop():
+        try:
+            for i in range(40):
+                pods = [
+                    make_pod(name=f"hm-{i}-ok", cpu=0.25),
+                    make_pod(name=f"hm-{i}-huge", cpu=50_000.0),
+                ]
+                sup.solve(pods, its, [tpl])
+        except Exception as exc:  # pragma: no cover - diagnostic
+            errors.append(("solve", exc))
+        finally:
+            stop.set()
+
+    def hammer(path):
+        try:
+            while not stop.is_set():
+                body = urllib.request.urlopen(f"{base}{path}", timeout=5).read()
+                json.loads(body)
+        except Exception as exc:  # pragma: no cover - diagnostic
+            errors.append((path, exc))
+
+    threads = [threading.Thread(target=solve_loop)] + [
+        threading.Thread(target=hammer, args=(p,))
+        for p in ("/debug/explain", "/debug/traces", "/debug/programs", "/statusz")
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        assert not any(t.is_alive() for t in threads)
+        # the hammer actually raced live publishes: reports were captured
+        payload = json.loads(
+            urllib.request.urlopen(f"{base}/debug/explain", timeout=5).read()
+        )
+        assert payload["captured"] >= 1
+    finally:
+        stop.set()
+        explain.set_enabled(None)
+        trace.set_enabled(None)
+        explain.reset_ring()
+        server.shutdown()
+
+
 def test_step_respects_periods():
     op, clock = make_operator()
     op.kube.create(make_nodepool())

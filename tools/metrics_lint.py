@@ -93,6 +93,44 @@ def run() -> list:
                 f"{name} is *_total but registered as a {kind} "
                 f"(convention: *_total names a counter)"
             )
+    problems.extend(_check_explain_taxonomy(docs))
+    return problems
+
+
+def _check_explain_taxonomy(docs: str) -> list:
+    """The UnschedulableReason taxonomy is a metrics/label contract:
+
+    1. every member of obs/explain.REASONS must be documented (the reason
+       strings are dashboard label values and Event prefixes an operator
+       greps for);
+    2. every ``{reason}`` label value the unschedulable counter has actually
+       emitted must be a member of the taxonomy — an unbounded label is a
+       cardinality leak and a silent taxonomy fork.
+    """
+    problems = []
+    from karpenter_tpu.metrics.registry import UNSCHEDULABLE_PODS
+    from karpenter_tpu.obs import explain
+
+    for reason in explain.REASONS:
+        if f"`{reason}`" not in docs and f'"{reason}"' not in docs:
+            problems.append(
+                f"UnschedulableReason '{reason}' is not documented in "
+                f"docs/*.md or README.md (taxonomy table required)"
+            )
+    for label_key in UNSCHEDULABLE_PODS._values:
+        labels = dict(label_key)
+        reason = labels.get("reason")
+        if set(labels) != {"reason"}:
+            problems.append(
+                f"{UNSCHEDULABLE_PODS.name} emitted labels {sorted(labels)} "
+                f"(contract: exactly one label, 'reason')"
+            )
+        elif reason not in explain.REASONS:
+            problems.append(
+                f"{UNSCHEDULABLE_PODS.name} emitted reason={reason!r}, which "
+                f"is not in the obs/explain.py taxonomy (bounded label "
+                f"contract)"
+            )
     return problems
 
 

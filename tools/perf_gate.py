@@ -48,7 +48,12 @@ if __name__ == "__main__":
 REPO_ROOT = Path(__file__).resolve().parent.parent
 DEFAULT_BASELINE = REPO_ROOT / "bench_history.jsonl"
 
-HISTORY_SCHEMA_VERSION = 1
+# v1: perf metrics only. v2 adds the explainability columns
+# (unschedulable_reasons histogram, explain_overhead_frac). The gate compares
+# only DEFAULT_BANDS metrics present in BOTH rows, so v1 and v2 rows gate
+# against each other transparently — no migration of the committed history.
+HISTORY_SCHEMA_VERSION = 2
+SUPPORTED_SCHEMAS = (1, 2)
 
 # metric -> (direction, band). Band is multiplicative headroom vs the
 # same-family window median; see module docstring for why they start wide.
@@ -88,6 +93,10 @@ def row_from_bench(out: dict, label: str = "run") -> dict:
         "first_solve_s": out.get("first_solve_after_start_s"),
         "consolidation_per_s": out.get("consolidation_candidates_per_sec"),
         "device_peak_bytes_2500": out.get("device_peak_bytes_2500"),
+        # schema v2: per-run UnschedulableReason histogram and the explain
+        # pass's cost as a fraction of solve wall (acceptance: <= 0.05)
+        "unschedulable_reasons": out.get("unschedulable_reasons"),
+        "explain_overhead_frac": out.get("explain_overhead_frac"),
         "error": out.get("error"),
     }
     row.update({k: v for k, v in optional.items() if v is not None})
