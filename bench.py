@@ -412,6 +412,88 @@ def run_child():
         except subprocess.TimeoutExpired:
             emit({"event": "coldstart", "pods": 2500, "error": "timeout"})
 
+        # restart recovery: the same fresh-process measurement with AOT
+        # executable restore + the streaming journal enabled
+        # (KARPENTER_TPU_AOT_RESTORE / KARPENTER_TPU_STATE_DIR). A seeding
+        # child populates the snapshot dir write-through, then a second fresh
+        # child restores, probe-solves, and completes the 2500-pod solve —
+        # exec-to-answer with restore on, against the coldstart control
+        # above (acceptance: >= 5x faster, target < 2 s)
+        import tempfile
+
+        restart_dir = tempfile.mkdtemp(prefix="ktpu-bench-restart-")
+        restart_env = dict(os.environ)
+        restart_env["KARPENTER_TPU_AOT_RESTORE"] = "1"
+        restart_env["KARPENTER_TPU_STATE_DIR"] = restart_dir
+        common = (
+            "from karpenter_tpu.operator.logging import quiet_xla_warnings;"
+            "quiet_xla_warnings();"
+            "import __graft_entry__; __graft_entry__._respect_platform_env();"
+            "import random; from bench import make_diverse_pods;"
+            "from karpenter_tpu.apis.nodepool import NodePool;"
+            "from karpenter_tpu.apis.objects import ObjectMeta;"
+            "from karpenter_tpu.cloudprovider.fake import instance_types;"
+            "from karpenter_tpu.solver.encode import template_from_nodepool;"
+            "from karpenter_tpu.solver.jax_backend import JaxSolver;"
+            "from karpenter_tpu.solver import warmup;"
+            "its = instance_types(400);"
+            "tpl = template_from_nodepool(NodePool(metadata=ObjectMeta(name='d')), its, range(len(its)));"
+        )
+        seed_code = common + (
+            # snapshot the probe shape too, so the restore child's probe
+            # solve is itself a restore instead of a fresh compile
+            "warmup._probe_solve();"
+            "r = JaxSolver().solve(make_diverse_pods(2500, random.Random(42)), its, [tpl]);"
+            "print('SEEDED', r.num_scheduled())"
+        )
+        restore_code = (
+            "import time; t0=time.perf_counter();" + common +
+            "rec = warmup.restore_and_probe();"
+            "r = JaxSolver().solve(make_diverse_pods(2500, random.Random(42)), its, [tpl]);"
+            "print('RESTART', time.perf_counter() - t0, r.num_scheduled())"
+        )
+        try:
+            seeded = subprocess.run(
+                [sys.executable, "-c", seed_code],
+                capture_output=True, text=True, timeout=300,
+                cwd=os.path.dirname(os.path.abspath(__file__)), env=restart_env,
+            )
+            out2 = subprocess.run(
+                [sys.executable, "-c", restore_code],
+                capture_output=True, text=True, timeout=300,
+                cwd=os.path.dirname(os.path.abspath(__file__)), env=restart_env,
+            )
+            line = next(
+                (l for l in out2.stdout.splitlines() if l.startswith("RESTART")),
+                None,
+            )
+            if line and any(
+                l.startswith("SEEDED") for l in seeded.stdout.splitlines()
+            ):
+                emit(
+                    {
+                        "event": "restart",
+                        "pods": 2500,
+                        "restart_s": round(float(line.split()[1]), 2),
+                        "scheduled": int(line.split()[2]),
+                    }
+                )
+            else:
+                emit(
+                    {
+                        "event": "restart",
+                        "pods": 2500,
+                        "error": f"seed rc={seeded.returncode} restore "
+                                 f"rc={out2.returncode}: {out2.stderr[-300:]}",
+                    }
+                )
+        except subprocess.TimeoutExpired:
+            emit({"event": "restart", "pods": 2500, "error": "timeout"})
+        finally:
+            import shutil
+
+            shutil.rmtree(restart_dir, ignore_errors=True)
+
     # consolidation: score candidate subsets through the batched device path
     try:
         from karpenter_tpu.disruption.batch import bench_candidate_scoring
@@ -795,6 +877,11 @@ def main():
     cold = next((e for e in events if e.get("event") == "coldstart"), None)
     if cold is not None and "cold_s" in cold:
         out["coldstart_2500_s"] = cold["cold_s"]
+    restart = next((e for e in events if e.get("event") == "restart"), None)
+    if restart is not None and "restart_s" in restart:
+        # exec-to-answer with AOT restore + journal on, same 2500-pod shape
+        # as the coldstart control row above
+        out["restart_recovery_s"] = restart["restart_s"]
     # per-shape device-memory watermarks (obs/programs.py samples); the
     # 2500-pod peak is the headline number carried-buffer work tracks
     if any("device_memory" in e for e in shapes):

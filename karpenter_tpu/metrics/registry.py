@@ -258,6 +258,33 @@ WARM_SOLVES = REGISTRY.counter(
     "cold-first, cold-threshold, cold-unsupported, cold-world-changed)",
 )
 
+# -- restart-resilience series (solver/aot.py, streaming/snapshot.py,
+# solver/warmup.py recovery) ---------------------------------------------------
+RESTART_RECOVERY_SECONDS = REGISTRY.histogram(
+    "solver_restart_recovery_seconds",
+    "Wall time of the restart-recovery sequence (AOT executable restore + "
+    "probe solve + streaming-journal restore) after a process exec",
+)
+AOT_RESTORE = REGISTRY.counter(
+    "solver_aot_restore_total",
+    "AOT executable snapshot entries processed at restore, by result "
+    "(restored, or the classified failure: missing, truncated, corrupt, "
+    "checksum, version-skew, isa-mismatch, flag-mismatch, "
+    "deserialize-error, probe-failed)",
+)
+STATE_RESTORE = REGISTRY.counter(
+    "solver_state_restore_total",
+    "Streaming-state journal restore attempts, by outcome (restored, "
+    "missing, truncated, corrupt, checksum, version-skew, isa-mismatch, "
+    "stale, validator, error)",
+)
+RESTORE_FALLBACK = REGISTRY.counter(
+    "restore_fallback_total",
+    "Restore paths that degraded to a cold start, by classified reason "
+    "(aot-* for executable-snapshot failures, journal-* for streaming-state "
+    "failures; every recovery is classified — 'unknown' never appears)",
+)
+
 # -- placement explainability series (obs/explain.py) -------------------------
 UNSCHEDULABLE_PODS = REGISTRY.counter(
     "unschedulable_pods_total",

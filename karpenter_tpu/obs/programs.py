@@ -84,7 +84,8 @@ def eqns_enabled() -> bool:
 
 # cache sources, in the order a dispatch tries them
 SOURCE_MEMORY = "memory"          # in-process jit executable cache
-SOURCE_PERSISTENT = "persistent"  # on-disk AOT executable reloaded
+SOURCE_RESTORED = "restored"      # AOT executable snapshot deserialized (solver/aot.py)
+SOURCE_PERSISTENT = "persistent"  # on-disk XLA compile-cache hit (trace still paid)
 SOURCE_COLD = "cold"              # full trace + XLA compile
 
 
@@ -332,9 +333,13 @@ class ProgramRegistry:
                 PROGRAM_COMPILE_SECONDS.observe(
                     wall_s, {"program": label, "source": source}
                 )
-            PERSISTENT_CACHE.inc(
-                {"result": "hit" if source == SOURCE_PERSISTENT else "miss"}
-            )
+            if source == SOURCE_PERSISTENT:
+                result = "hit"
+            elif source == SOURCE_RESTORED:
+                result = "restored"
+            else:
+                result = "miss"
+            PERSISTENT_CACHE.inc({"result": result})
         return rec
 
     # -- device-memory sampling ------------------------------------------------
@@ -494,10 +499,15 @@ class _Dispatch:
         result_bytes: int = 0,
         donated_bytes: int = 0,
         eqns: Optional[int] = None,
+        source_override: Optional[str] = None,
     ) -> str:
         wall = _perf() - self.t0
         if not self.first:
             source = SOURCE_MEMORY
+        elif source_override is not None:
+            # the dispatcher KNOWS where the executable came from (solver/aot.py
+            # deserialized it) — observation can't see that, so it tells us
+            source = source_override
         elif persistent_cache_hits() > self.hits0:
             source = SOURCE_PERSISTENT
         else:

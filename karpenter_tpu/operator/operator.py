@@ -214,8 +214,16 @@ class Operator:
         logger = oplog.configure(self.options.log_level)
         warm_thread = None
         if self.options.solver_backend == "jax":
-            from karpenter_tpu.solver.warmup import maybe_prewarm_in_background
+            from karpenter_tpu.solver.warmup import (
+                maybe_prewarm_in_background,
+                maybe_recover_in_background,
+            )
 
+            # restart recovery first (solver/aot.py): marks /readyz blocked
+            # synchronously, then deserializes AOT executable snapshots and
+            # probe-solves on a daemon thread — a restarted process reaches
+            # warm service in seconds instead of retracing the ladder
+            maybe_recover_in_background()
             warm_thread = maybe_prewarm_in_background(
                 self.options, self.cloud_provider
             )

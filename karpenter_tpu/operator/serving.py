@@ -69,10 +69,18 @@ class OperatorStatus:
         self.warmup_ready = warmup_ready
 
     def ready(self) -> bool:
-        """Ready to serve traffic: warmup done and the primary solve path not
-        hard-open. Half-open counts as ready — the next solve probes the
-        primary and the fallback still answers either way."""
+        """Ready to serve traffic: warmup done, no restart recovery in
+        flight, and the primary solve path not hard-open. Half-open counts as
+        ready — the next solve probes the primary and the fallback still
+        answers either way. Recovery blocks only while restoring/probing: a
+        FAILED recovery un-blocks (it degrades to cold compiles)."""
         if self.warmup_ready is not None and not self.warmup_ready():
+            return False
+        from karpenter_tpu.solver import aot
+
+        if aot.recovery_blocking():
+            # restored AOT executables must pass the probe solve before any
+            # traffic can land on them (solver/warmup.py restore_and_probe)
             return False
         if self.supervisor is not None:
             from karpenter_tpu.solver.supervisor import CIRCUIT_OPEN
@@ -98,6 +106,16 @@ class OperatorStatus:
                 for k in ("trace_id", "name", "backend", "duration_s", "phases")
             }
         out["traces"] = summary
+        # restart recovery (solver/aot.py): current phase plus the last
+        # completed recovery record — restore summary, probe verdict, wall
+        # seconds, and the recovery trace id for /debug/traces drill-down
+        from karpenter_tpu.solver import aot
+
+        recovery = {"phase": aot.recovery_phase()}
+        last = aot.last_recovery()
+        if last is not None:
+            recovery["last_restart_recovery"] = last
+        out["recovery"] = recovery
         # program registry one-liner (obs/programs.py): compiled-program
         # count, launch totals, cache-source split, last memory sample
         out["programs"] = programs.registry().summary()

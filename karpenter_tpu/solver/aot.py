@@ -1,0 +1,489 @@
+"""AOT executable snapshot/restore: compiled solver programs that survive exec.
+
+The persistent XLA compilation cache (utils/jaxtools.py) already skips the
+XLA *compile* on restart, but a fresh process still pays the full jax TRACE
+of every solver program — seconds per executable, tens of seconds across the
+warmup ladder (ROADMAP open item 5). This module closes that gap with
+``jax.experimental.serialize_executable``: when ``KARPENTER_TPU_AOT_RESTORE``
+is on (and ``KARPENTER_TPU_STATE_DIR`` set), every solver program the process
+compiles is serialized — executable bytes plus in/out pytree defs — into an
+ISA-keyed snapshot directory, and a restarted process deserializes the lot in
+tens of milliseconds instead of retracing.
+
+How it plugs in: solver/jax_backend.py routes its jitted dispatch through
+:func:`maybe_begin`. The AOT table is keyed by the TRUE static configuration
+of each entry function — not just the registry's (fn, claims, shapes) key,
+because ``bounds_free`` / ``max_run`` / ``with_topo`` / ``wavefront`` are
+derived from concrete problem VALUES and baked into the executable; a
+restored program invoked under mismatched statics would silently compute
+wrong placements. :func:`_call_spec` recomputes each fn's statics exactly the
+way its public entry point does, so a table hit is a program that the jit
+path would have dispatched identically.
+
+Restore classification (``karpenter_solver_aot_restore_total{result}`` and
+``karpenter_restore_fallback_total{reason}``): every snapshot entry either
+restores or lands in one classified failure — truncated / corrupt / checksum
+/ version-skew (frame or jax version) / isa-mismatch / flag-mismatch /
+deserialize-error — and a failure always degrades to a cold trace+compile,
+never an exception on the solve path. The program registry (obs/programs.py)
+records dispatches served from a restored executable under the first-class
+``restored`` cache source.
+
+Recovery sequencing for /readyz (operator/serving.py): the recovery runner
+(solver/warmup.py restore_and_probe) drives the phase machine here —
+``idle -> restoring -> probing -> ready|failed`` — and readiness is held
+false while a recovery is in flight, so traffic never lands on executables
+that have not passed a probe solve.
+
+Flag off: :func:`maybe_begin` is one env read returning None — the dispatch
+path, placements, and the narrow-body census (2394 eqns) are untouched.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+AOT_VERSION = 1
+_FILE_SUFFIX = ".aot"
+
+# restore failure reasons (doubles as the bounded label-value set)
+REASONS = (
+    "missing", "truncated", "corrupt", "checksum", "version-skew",
+    "isa-mismatch", "flag-mismatch", "deserialize-error", "probe-failed",
+)
+
+
+def enabled() -> bool:
+    """AOT snapshot/restore is opt-in twice over: the flag AND a state dir.
+    Either unset means zero overhead and a byte-identical dispatch path."""
+    return (
+        os.environ.get("KARPENTER_TPU_AOT_RESTORE", "") not in ("", "0")
+        and bool(os.environ.get("KARPENTER_TPU_STATE_DIR"))
+    )
+
+
+def state_dir() -> Optional[str]:
+    return os.environ.get("KARPENTER_TPU_STATE_DIR") or None
+
+
+def aot_dir() -> Optional[str]:
+    """Snapshot directory, keyed by host ISA exactly like the persistent
+    compile cache: an executable serialized on one microarchitecture must
+    never deserialize on another."""
+    root = state_dir()
+    if not root:
+        return None
+    from karpenter_tpu.obs.programs import isa_tag
+
+    return os.path.join(root, "aot", isa_tag())
+
+
+def _device_tag() -> str:
+    """The platform the lowering targets right now (the small-batch dispatch
+    can pin CPU on a TPU host, so fn+shape alone underdetermines the
+    executable)."""
+    try:
+        import jax
+
+        dev = getattr(jax.config, "jax_default_device", None)
+        if dev is not None:
+            return str(getattr(dev, "platform", dev))
+        return str(jax.default_backend())
+    except Exception:
+        return "unknown"
+
+
+# -- call specs: the true statics of each solver entry fn ----------------------
+
+
+class _Spec:
+    __slots__ = ("fn", "lower_args", "dyn", "statics")
+
+    def __init__(self, fn, lower_args: tuple, dyn: tuple, statics: Tuple[str, ...]):
+        self.fn = fn
+        self.lower_args = lower_args
+        self.dyn = dyn
+        self.statics = statics
+
+
+def _call_spec(solve_name: str, problem, max_claims: int, init) -> Optional[_Spec]:
+    """Mirror each public entry point's static derivation (ops/ffd_step.py,
+    ops/ffd_sweeps.py, ops/ffd_runs.py): the returned spec's ``lower_args``
+    reproduce the exact jitted call, ``dyn`` are the runtime arguments a
+    Compiled takes (statics are baked), and ``statics`` feed the table key."""
+    from karpenter_tpu.ops.ffd_core import problem_bounds_free
+
+    if solve_name == "solve_ffd_sweeps":
+        from karpenter_tpu.ops.ffd_sweeps import (
+            _solve_ffd_sweeps_fresh_jit,
+            _wavefront_lanes,
+        )
+
+        bf = problem_bounds_free(problem)
+        wf = _wavefront_lanes()
+        return _Spec(
+            _solve_ffd_sweeps_fresh_jit,
+            (problem, int(max_claims), bf, wf),
+            (problem,),
+            (f"C{int(max_claims)}", f"bf{int(bf)}", f"wf{int(wf)}"),
+        )
+    if solve_name == "solve_ffd":
+        from karpenter_tpu.ops.ffd_step import _solve_ffd_fresh_jit, _solve_ffd_jit
+
+        bf = problem_bounds_free(problem)
+        if init is None:
+            return _Spec(
+                _solve_ffd_fresh_jit,
+                (problem, int(max_claims), bf),
+                (problem,),
+                (f"C{int(max_claims)}", f"bf{int(bf)}", "fresh"),
+            )
+        return _Spec(
+            _solve_ffd_jit,
+            (problem, init, bf),
+            (problem, init),
+            (f"bf{int(bf)}", "carried"),
+        )
+    if solve_name == "solve_ffd_runs":
+        from karpenter_tpu.ops.ffd_runs import (
+            _solve_ffd_runs_fresh_jit,
+            _solve_ffd_runs_jit,
+            has_topo_runs,
+            max_run_bucket,
+        )
+
+        mr = max_run_bucket(problem)
+        wt = has_topo_runs(problem)
+        if init is None:
+            return _Spec(
+                _solve_ffd_runs_fresh_jit,
+                (problem, int(max_claims), mr, wt),
+                (problem,),
+                (f"C{int(max_claims)}", f"mr{int(mr)}", f"wt{int(wt)}", "fresh"),
+            )
+        return _Spec(
+            _solve_ffd_runs_jit,
+            (problem, init, mr, wt),
+            (problem, init),
+            (f"mr{int(mr)}", f"wt{int(wt)}", "carried"),
+        )
+    return None
+
+
+def _entry_key(fn_name: str, dyn: tuple, statics: Tuple[str, ...]) -> str:
+    import jax
+
+    from karpenter_tpu.obs.programs import _digest, flag_digest, isa_tag, shape_digest
+
+    tree = _digest(repr(jax.tree_util.tree_structure(dyn)))
+    return "/".join(
+        [fn_name, f"s{shape_digest(dyn)}", f"t{tree}", "-".join(statics),
+         f"f{flag_digest()}", f"d{_device_tag()}", isa_tag()]
+    )
+
+
+# -- the executable table ------------------------------------------------------
+
+
+class _Entry:
+    __slots__ = ("key", "compiled", "source", "path", "dispatched")
+
+    def __init__(self, key: str, compiled, source: str, path: Optional[str]):
+        self.key = key
+        self.compiled = compiled
+        self.source = source  # "compiled" | "restored"
+        self.path = path
+        self.dispatched = 0
+
+
+_lock = threading.Lock()
+_table: Dict[str, _Entry] = {}
+_warned: set = set()
+
+
+def _warn_once(tag: str, msg: str, *args) -> None:
+    if tag in _warned:
+        return
+    _warned.add(tag)
+    log.warning(msg, *args)
+
+
+def table_size() -> int:
+    with _lock:
+        return len(_table)
+
+
+def restored_count() -> int:
+    with _lock:
+        return sum(1 for e in _table.values() if e.source == "restored")
+
+
+def reset_table() -> None:
+    """Drop the in-memory table (tests / simulated restart). Snapshot files
+    stay on disk — that is the point."""
+    with _lock:
+        _table.clear()
+
+
+def clear_restored(reason: str = "probe-failed") -> int:
+    """Evict restored executables (probe failure): subsequent dispatches pay
+    a fresh trace+compile instead of trusting an executable that could not
+    produce a valid placement. Returns how many were dropped."""
+    from karpenter_tpu.metrics.registry import AOT_RESTORE, RESTORE_FALLBACK
+
+    with _lock:
+        bad = [k for k, e in _table.items() if e.source == "restored"]
+        for k in bad:
+            del _table[k]
+    if bad:
+        AOT_RESTORE.inc({"result": reason}, len(bad))
+        RESTORE_FALLBACK.inc({"reason": f"aot-{reason}"})
+    return len(bad)
+
+
+class _Handle:
+    """One AOT-served dispatch: ``call()`` launches the Compiled (dynamic
+    args only; statics are baked), ``source_override`` tells the program
+    registry when the executable came off disk instead of a compile."""
+
+    __slots__ = ("entry", "spec")
+
+    def __init__(self, entry: _Entry, spec: _Spec):
+        self.entry = entry
+        self.spec = spec
+
+    def call(self):
+        self.entry.dispatched += 1
+        return self.entry.compiled(*self.spec.dyn)
+
+    @property
+    def source_override(self) -> Optional[str]:
+        if self.entry.source == "restored":
+            from karpenter_tpu.obs.programs import SOURCE_RESTORED
+
+            return SOURCE_RESTORED
+        return None
+
+
+def maybe_begin(solve_fn, problem, max_claims: int, init) -> Optional[_Handle]:
+    """The jax_backend dispatch hook. Returns a handle when AOT mode serves
+    this call (table hit, or miss compiled + persisted write-through), None
+    to fall through to the plain jit path. NEVER raises: any AOT-layer error
+    is a classified fallback — the solve must not inherit new failure
+    modes."""
+    if not enabled():
+        return None
+    from karpenter_tpu.metrics.registry import RESTORE_FALLBACK
+
+    try:
+        spec = _call_spec(solve_fn.__name__, problem, max_claims, init)
+        if spec is None:
+            return None
+        key = _entry_key(spec.fn.__name__, spec.dyn, spec.statics)
+        with _lock:
+            entry = _table.get(key)
+        if entry is None:
+            compiled = spec.fn.lower(*spec.lower_args).compile()
+            entry = _Entry(key, compiled, "compiled", None)
+            entry.path = _persist_entry(key, compiled)
+            with _lock:
+                _table.setdefault(key, entry)
+                entry = _table[key]
+        return _Handle(entry, spec)
+    except Exception as exc:  # noqa: BLE001 — degrade to the jit path
+        RESTORE_FALLBACK.inc({"reason": "aot-dispatch-error"})
+        _warn_once(
+            "dispatch", "aot: dispatch hook degraded to jit path: %s: %s",
+            type(exc).__name__, exc,
+        )
+        return None
+
+
+# -- snapshot persistence ------------------------------------------------------
+
+
+def _entry_path(key: str) -> Optional[str]:
+    directory = aot_dir()
+    if directory is None:
+        return None
+    from karpenter_tpu.obs.programs import _digest
+
+    return os.path.join(directory, _digest(key, 20) + _FILE_SUFFIX)
+
+
+def _persist_entry(key: str, compiled) -> Optional[str]:
+    """Write-through snapshot of one executable. Best-effort: a snapshot
+    failure costs the NEXT process a compile, never this one a solve."""
+    from karpenter_tpu.metrics.registry import RESTORE_FALLBACK
+
+    path = _entry_path(key)
+    if path is None:
+        return None
+    try:
+        import jax
+        from jax.experimental import serialize_executable as se
+
+        from karpenter_tpu.obs.programs import flag_digest, isa_tag
+        from karpenter_tpu.utils import persist
+
+        payload_bytes, in_tree, out_tree = se.serialize(compiled)
+        blob = pickle.dumps(
+            {"key": key, "serialized": (payload_bytes, in_tree, out_tree)},
+            protocol=pickle.HIGHEST_PROTOCOL,
+        )
+        persist.write_framed(
+            path, blob, kind="aot-entry", version=AOT_VERSION,
+            meta={
+                "key": key,
+                "isa": isa_tag(),
+                "flags": flag_digest(),
+                "device": _device_tag(),
+                "jax": jax.__version__,
+            },
+        )
+        return path
+    except Exception as exc:  # noqa: BLE001
+        RESTORE_FALLBACK.inc({"reason": "aot-persist-error"})
+        _warn_once(
+            "persist", "aot: snapshot write failed (restore disabled for "
+            "this program): %s: %s", type(exc).__name__, exc,
+        )
+        return None
+
+
+def restore() -> Dict:
+    """Load every snapshot entry matching this host's ISA / flag config /
+    jax version into the table as ``restored`` executables. Every entry
+    resolves to exactly one classified result — restored, or a failure
+    reason — so no recovery is ever 'unknown'. Returns a summary dict."""
+    from karpenter_tpu.metrics.registry import AOT_RESTORE, RESTORE_FALLBACK
+    from karpenter_tpu.utils.persist import PersistError, load_framed
+
+    t0 = time.perf_counter()
+    summary: Dict = {"entries": 0, "restored": 0, "failures": {}}
+
+    def fail(reason: str) -> None:
+        summary["failures"][reason] = summary["failures"].get(reason, 0) + 1
+        AOT_RESTORE.inc({"result": reason})
+        RESTORE_FALLBACK.inc({"reason": f"aot-{reason}"})
+
+    directory = aot_dir()
+    if not enabled() or directory is None or not os.path.isdir(directory):
+        summary["seconds"] = time.perf_counter() - t0
+        return summary
+    import jax
+    from jax.experimental import serialize_executable as se
+
+    from karpenter_tpu.obs.programs import flag_digest, isa_tag
+
+    for name in sorted(os.listdir(directory)):
+        if not name.endswith(_FILE_SUFFIX):
+            continue
+        summary["entries"] += 1
+        path = os.path.join(directory, name)
+        try:
+            header, payload = load_framed(
+                path, kind="aot-entry", min_version=AOT_VERSION
+            )
+        except PersistError as exc:
+            fail(exc.reason)
+            continue
+        meta = header.get("meta", {})
+        if meta.get("isa") != isa_tag() or meta.get("device") != _device_tag():
+            fail("isa-mismatch")
+            continue
+        if meta.get("flags") != flag_digest():
+            fail("flag-mismatch")
+            continue
+        if meta.get("jax") != jax.__version__:
+            fail("version-skew")
+            continue
+        try:
+            blob = pickle.loads(payload)
+            key = blob["key"]
+            payload_bytes, in_tree, out_tree = blob["serialized"]
+            compiled = se.deserialize_and_load(payload_bytes, in_tree, out_tree)
+        except Exception as exc:  # noqa: BLE001 — checksummed, but be exhaustive
+            fail("deserialize-error")
+            _warn_once(
+                "deserialize", "aot: entry %s failed to deserialize: %s: %s",
+                name, type(exc).__name__, exc,
+            )
+            continue
+        with _lock:
+            _table[key] = _Entry(key, compiled, "restored", path)
+        summary["restored"] += 1
+        AOT_RESTORE.inc({"result": "restored"})
+    summary["seconds"] = time.perf_counter() - t0
+    return summary
+
+
+def snapshot_files() -> List[str]:
+    directory = aot_dir()
+    if directory is None or not os.path.isdir(directory):
+        return []
+    return sorted(
+        os.path.join(directory, n)
+        for n in os.listdir(directory)
+        if n.endswith(_FILE_SUFFIX)
+    )
+
+
+# -- recovery state machine (consulted by /readyz) -----------------------------
+
+PHASE_IDLE = "idle"
+PHASE_RESTORING = "restoring"
+PHASE_PROBING = "probing"
+PHASE_READY = "ready"
+PHASE_FAILED = "failed"
+
+_recovery_lock = threading.Lock()
+_recovery_phase = PHASE_IDLE
+_last_recovery: Optional[Dict] = None
+
+
+def set_recovery_phase(phase: str) -> None:
+    global _recovery_phase
+    with _recovery_lock:
+        _recovery_phase = phase
+
+
+def recovery_phase() -> str:
+    with _recovery_lock:
+        return _recovery_phase
+
+
+def recovery_blocking() -> bool:
+    """True while a recovery is in flight: /readyz must stay false until the
+    restored executables pass a probe solve. ``failed`` does NOT block —
+    recovery degrades to cold compiles, it never holds the process hostage."""
+    with _recovery_lock:
+        return _recovery_phase in (PHASE_RESTORING, PHASE_PROBING)
+
+
+def finish_recovery(record: Optional[Dict], phase: str) -> None:
+    global _recovery_phase, _last_recovery
+    with _recovery_lock:
+        _recovery_phase = phase
+        if record is not None:
+            _last_recovery = dict(record)
+
+
+def last_recovery() -> Optional[Dict]:
+    """The /statusz ``last_restart_recovery`` payload (None before any)."""
+    with _recovery_lock:
+        return dict(_last_recovery) if _last_recovery is not None else None
+
+
+def reset_recovery_for_tests() -> None:
+    global _recovery_phase, _last_recovery
+    with _recovery_lock:
+        _recovery_phase = PHASE_IDLE
+        _last_recovery = None

@@ -18,7 +18,7 @@ function, so the rendered reasons are backend-independent.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from karpenter_tpu.apis.objects import Pod
 from karpenter_tpu.cloudprovider.types import InstanceType
@@ -281,12 +281,69 @@ def dump_quarantine(
             payload["explain"] = (
                 explain.to_dict() if hasattr(explain, "to_dict") else explain
             )
-        with open(path, "w") as f:
+        # atomic tmp+rename: a crash (or SIGKILL) mid-dump must leave either
+        # no file or a complete one — a torn half-JSON used to poison every
+        # later loader pass over the ring
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
             json.dump(payload, f, indent=1, default=str)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
         _evict_quarantine(directory, _quarantine_max())
         return path
     except Exception:
         return None
+
+
+def load_quarantine(
+    directory: Optional[str] = None, limit: int = 0
+) -> List[Dict]:
+    """Load the quarantine ring, newest first, each payload annotated with
+    its ``_path``. Tolerant by design: unparseable or unreadable files —
+    pre-fix torn dumps, bit rot, concurrent eviction — are SKIPPED, never
+    raised; offline forensics must degrade to the dumps that survived. Use
+    :func:`scan_quarantine` to also see which paths were skipped."""
+    return scan_quarantine(directory, limit)[0]
+
+
+def scan_quarantine(
+    directory: Optional[str] = None, limit: int = 0
+) -> Tuple[List[Dict], List[str]]:
+    """Like :func:`load_quarantine` but also returns the paths that failed
+    to parse (so tooling can report how much of the ring was torn)."""
+    import json
+    import os
+
+    directory = directory or os.environ.get(
+        "KARPENTER_TPU_QUARANTINE_DIR", "/tmp/karpenter-tpu-quarantine"
+    )
+    try:
+        entries = [
+            (os.path.getmtime(os.path.join(directory, name)), name)
+            for name in os.listdir(directory)
+            if name.startswith("quarantine-") and name.endswith(".json")
+        ]
+    except OSError:
+        return [], []
+    entries.sort(reverse=True)  # newest first
+    loaded: List[Dict] = []
+    skipped: List[str] = []
+    for _, name in entries:
+        if limit and len(loaded) >= limit:
+            break
+        path = os.path.join(directory, name)
+        try:
+            with open(path) as f:
+                payload = json.load(f)
+            if not isinstance(payload, dict):
+                raise ValueError("quarantine payload is not an object")
+        except (OSError, ValueError):
+            skipped.append(path)
+            continue
+        payload["_path"] = path
+        loaded.append(payload)
+    return loaded, skipped
 
 
 def _fmt_resources(requests: Dict[str, float]) -> str:

@@ -27,6 +27,7 @@ from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.apis.objects import Pod
 from karpenter_tpu.metrics.registry import COMPILE_CACHE, TRANSFER_BYTES
 from karpenter_tpu.obs import programs, trace
+from karpenter_tpu.solver import aot
 from karpenter_tpu.cloudprovider.types import InstanceType
 from karpenter_tpu.provisioning.preferences import Preferences
 from karpenter_tpu.provisioning.topology import Topology
@@ -520,6 +521,11 @@ class JaxSolver(SolverBackend):
                         lambda: solve(problem, max_claims, init=prev_state)
                     )()
                 )
+            # AOT executable table (KARPENTER_TPU_AOT_RESTORE): when on, the
+            # dispatch is served by a snapshot-backed Compiled (restored off
+            # disk, or compiled-and-persisted write-through); None falls
+            # through to the plain jit path — including on ANY aot-layer error
+            aot_handle = aot.maybe_begin(solve, problem, max_claims, state)
             # program registry (KARPENTER_TPU_PROGRAMS): None when off
             obs = programs.begin_dispatch(solve.__name__, max_claims, problem)
             with trace.span(
@@ -527,7 +533,10 @@ class JaxSolver(SolverBackend):
                 cache="hit" if cache_hit else "miss",
                 program=solve.__name__,
             ) as sp:
-                result = solve(problem, max_claims, init=state)
+                if aot_handle is not None:
+                    result = aot_handle.call()
+                else:
+                    result = solve(problem, max_claims, init=state)
                 state = result.state
                 # one batched fetch: device_get issues async copies for all
                 # buffers before waiting, so the pass pays a single runtime
@@ -577,6 +586,10 @@ class JaxSolver(SolverBackend):
                         carried_bytes=carried_in,
                         result_bytes=d2h,
                         eqns=reg_eqns,
+                        source_override=(
+                            aot_handle.source_override
+                            if aot_handle is not None else None
+                        ),
                     )
                     if sp is not None:
                         # Perfetto waterfalls name the program that compiled

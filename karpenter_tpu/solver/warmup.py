@@ -233,6 +233,125 @@ def prewarm_screen(n_candidates: int) -> bool:
         return False
 
 
+def _probe_solve(n_pods: int = 12, instance_types_n: int = 20) -> bool:
+    """One small solve through the REAL backend entrypoint, checked hard:
+    every pod accounted exactly once and the fast validator gate clean. This
+    is what restored AOT executables must pass before /readyz goes true — a
+    deserialized program that launches but computes garbage fails here, and
+    the recovery degrades to cold compiles instead of serving it."""
+    import random
+
+    from karpenter_tpu.apis.nodepool import NodePool
+    from karpenter_tpu.apis.objects import Container, ObjectMeta, Pod, PodSpec
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.solver import validator as val
+    from karpenter_tpu.solver.encode import template_from_nodepool
+    from karpenter_tpu.solver.jax_backend import JaxSolver
+
+    its = instance_types(instance_types_n)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="recovery-probe")), its, range(len(its))
+    )
+    rng = random.Random(3)
+    pods = [
+        Pod(
+            metadata=ObjectMeta(name=f"probe-{i}"),
+            spec=PodSpec(
+                containers=[Container(requests={"cpu": rng.choice([0.1, 0.5, 1.0])})]
+            ),
+        )
+        for i in range(n_pods)
+    ]
+    result = JaxSolver().solve(pods, its, [tpl])
+    seen: list = []
+    for idxs in result.node_pods.values():
+        seen.extend(idxs)
+    for c in result.new_claims:
+        seen.extend(c.pod_indices)
+    seen.extend(result.failures)
+    if sorted(seen) != list(range(n_pods)):
+        return False
+    return not val.validate_result(result, pods, its, [tpl], level="fast")
+
+
+def restore_and_probe() -> Optional[dict]:
+    """The restart-recovery sequence, driving solver/aot.py's phase machine
+    (idle -> restoring -> probing -> ready|failed):
+
+      1. deserialize every matching AOT executable snapshot into the table
+         (``restored`` cache source, classified failure counters);
+      2. when anything restored, run a probe solve — the standard small
+         bucket, which the warmup ladder snapshots first, so the probe
+         actually exercises a restored executable — and on failure evict
+         every restored entry (classified ``probe-failed``): traffic then
+         pays cold compiles, never trusts an unproven deserialization;
+      3. record the recovery (wall seconds into
+         ``solver_restart_recovery_seconds``, trace id + summary into
+         ``aot.last_recovery()`` for /statusz ``last_restart_recovery``).
+
+    /readyz is held false by ``aot.recovery_blocking()`` for the whole
+    sequence. Returns the recovery record, or None when AOT restore is off.
+    Never raises: recovery degrades, it does not take the process down."""
+    import logging
+    import time
+
+    from karpenter_tpu.solver import aot
+
+    if not aot.enabled():
+        return None
+    from karpenter_tpu.metrics.registry import RESTART_RECOVERY_SECONDS
+    from karpenter_tpu.obs import trace
+
+    log = logging.getLogger(__name__)
+    t0 = time.perf_counter()
+    record: dict = {}
+    aot.set_recovery_phase(aot.PHASE_RESTORING)
+    try:
+        with trace.cycle("recovery", kind="restart"):
+            record["trace_id"] = trace.current_trace_id()
+            record["aot"] = aot.restore()
+            aot.set_recovery_phase(aot.PHASE_PROBING)
+            if record["aot"]["restored"]:
+                ok = _probe_solve()
+                record["probe"] = "passed" if ok else "failed"
+                if not ok:
+                    record["evicted"] = aot.clear_restored()
+            else:
+                record["probe"] = "skipped"
+        phase = (
+            aot.PHASE_FAILED if record.get("probe") == "failed" else aot.PHASE_READY
+        )
+    except Exception:  # noqa: BLE001 — recovery is never a liveness dependency
+        log.warning("restart recovery failed", exc_info=True)
+        record["probe"] = record.get("probe", "error")
+        phase = aot.PHASE_FAILED
+    record["phase"] = phase
+    record["seconds"] = round(time.perf_counter() - t0, 4)
+    RESTART_RECOVERY_SECONDS.observe(record["seconds"])
+    aot.finish_recovery(record, phase)
+    log.info("restart recovery: %s", record)
+    return record
+
+
+def maybe_recover_in_background() -> Optional["object"]:
+    """Operator.start() hook: when AOT restore is enabled, mark recovery as
+    blocking SYNCHRONOUSLY (so a /readyz probe racing the thread start still
+    sees not-ready) and run :func:`restore_and_probe` on a daemon thread."""
+    import threading
+
+    from karpenter_tpu.solver import aot
+
+    if not aot.enabled():
+        return None
+    aot.set_recovery_phase(aot.PHASE_RESTORING)
+    t = threading.Thread(
+        target=restore_and_probe, daemon=True,
+        name="karpenter-tpu/restart-recovery",
+    )
+    t.start()
+    return t
+
+
 def warmup_ready(thread: Optional["object"]) -> bool:
     """Readiness predicate for /readyz: True once the background warm
     finished (or never ran — a skipped warm must not hold readiness

@@ -12,6 +12,10 @@ world each cycle. This package turns it into a continuous one:
              claim landscape and re-places only pods whose gates could have
              changed, falling back to a full solve past a delta-fraction
              threshold or on a validator rejection.
+  snapshot.py  crash-consistent journal of the accepted cycle state
+             (atomic framed writes via utils/persist.py, classified restore
+             outcomes, full validator gate) so a restarted process re-enters
+             the warm path on its first solve.
   churn.py   seeded arrival/delete/reclaim load generator driving
              testing/faults.py's ``cloud.reclaim`` grammar, with a
              sustained pods/s-under-churn harness shared by bench.py,

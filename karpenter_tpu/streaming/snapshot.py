@@ -1,0 +1,203 @@
+"""Crash-consistent streaming-state journal: warm solves that survive exec.
+
+Since round 11 the 16.1x warm-vs-cold advantage lives entirely in
+``StreamingSolver._prev`` — process memory. A restart therefore used to be a
+double cold-start: retrace every executable AND re-solve the whole world
+cold. This module journals the accepted cycle state (the previous snapshot's
+pods/nodes with their identity digests, the accepted ``SolveResult``, the FFD
+queue order, and the certification prefix — exactly ``_StreamState``) through
+the shared framed-file protocol (utils/persist.py: atomic tmp+rename+fsync,
+sha256, version header), so a freshly exec'd process re-enters the warm path
+on its FIRST cycle.
+
+Safety before speed, in three layers:
+
+  1. every way the file can be wrong is a CLASSIFIED cold-start fallback
+     (``karpenter_solver_state_restore_total{outcome}``): missing, truncated,
+     corrupt, checksum, version-skew, isa-mismatch, stale, error — loading
+     never raises into the solve path;
+  2. a decoded journal is admitted only behind the FULL-level validator gate
+     (outcome ``validator`` when rejected) — the same gate every warm merge
+     passes, so a restored state cannot assert placements a live one
+     couldn't;
+  3. even an admitted journal only SEEDS the delta diff: the next cycle
+     still diffs the live world against it, and any divergence falls out as
+     the ordinary cold-world-changed / cold-threshold outcomes.
+
+A wrong placement is therefore unreachable from a bad journal; the worst
+case is always one cold solve. ``reset_streaming_state`` (the supervisor's
+quarantine hook) also invalidates the on-disk journal — a quarantined result
+must not resurrect after a crash.
+
+Enabled by ``KARPENTER_TPU_STATE_DIR`` alone (the journal is useful without
+AOT executable restore); cadence via ``KARPENTER_TPU_STATE_SNAPSHOT_EVERY``
+(journal every Nth accepted cycle, default 1), staleness bound via
+``KARPENTER_TPU_STATE_MAX_AGE_S`` (default 900 s).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import time
+from typing import Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+JOURNAL_VERSION = 1
+
+# classified restore outcomes (the bounded metric label-value set)
+OUTCOMES = (
+    "restored", "missing", "truncated", "corrupt", "checksum",
+    "version-skew", "isa-mismatch", "stale", "validator", "error",
+)
+
+
+def enabled() -> bool:
+    return bool(os.environ.get("KARPENTER_TPU_STATE_DIR"))
+
+
+def journal_path() -> Optional[str]:
+    root = os.environ.get("KARPENTER_TPU_STATE_DIR")
+    if not root:
+        return None
+    return os.path.join(root, "stream", "journal.snap")
+
+
+def cadence() -> int:
+    try:
+        return max(1, int(os.environ.get("KARPENTER_TPU_STATE_SNAPSHOT_EVERY", "1")))
+    except ValueError:
+        return 1
+
+
+def max_age_s() -> float:
+    try:
+        return float(os.environ.get("KARPENTER_TPU_STATE_MAX_AGE_S", "900"))
+    except ValueError:
+        return 900.0
+
+
+_warned: set = set()
+
+
+def _warn_once(tag: str, msg: str, *args) -> None:
+    if tag in _warned:
+        return
+    _warned.add(tag)
+    log.warning(msg, *args)
+
+
+def save(state) -> bool:
+    """Journal one accepted ``_StreamState``. Best-effort: a journal failure
+    costs the NEXT process a cold solve, never this one anything — so every
+    failure is a warn + counter, never an exception. Returns success."""
+    from karpenter_tpu.metrics.registry import RESTORE_FALLBACK
+    from karpenter_tpu.obs.programs import isa_tag
+    from karpenter_tpu.testing import faults
+    from karpenter_tpu.utils import persist
+
+    path = journal_path()
+    if path is None:
+        return False
+    try:
+        payload = pickle.dumps(state, protocol=pickle.HIGHEST_PROTOCOL)
+    except Exception as exc:  # noqa: BLE001 — an unpicklable field, not a bug here
+        RESTORE_FALLBACK.inc({"reason": "journal-persist-error"})
+        _warn_once(
+            "pickle", "stream journal: state not picklable, journaling "
+            "disabled for this cycle: %s: %s", type(exc).__name__, exc,
+        )
+        return False
+    faults.crash_point("journal.pre-write")
+    try:
+        persist.write_framed(
+            path, payload, kind="stream-journal", version=JOURNAL_VERSION,
+            meta={
+                "isa": isa_tag(),
+                "pods": len(state.pods),
+                "nodes": len(state.nodes),
+                "certified": len(state.certified_uids),
+            },
+        )
+    except OSError as exc:
+        RESTORE_FALLBACK.inc({"reason": "journal-persist-error"})
+        _warn_once(
+            "write", "stream journal: write failed: %s: %s",
+            type(exc).__name__, exc,
+        )
+        return False
+    faults.crash_point("journal.post-write")
+    return True
+
+
+def invalidate() -> None:
+    """Remove the on-disk journal (quarantine / reset): a state the live
+    process rejected must not be what the next process restores."""
+    path = journal_path()
+    if path is None:
+        return
+    try:
+        os.remove(path)
+    except FileNotFoundError:
+        pass
+    except OSError as exc:
+        _warn_once(
+            "invalidate", "stream journal: invalidate failed: %s: %s",
+            type(exc).__name__, exc,
+        )
+
+
+def load() -> Tuple[str, Optional[object]]:
+    """Restore the journal: ``(outcome, state)`` where outcome is one of
+    :data:`OUTCOMES` and state is a ``_StreamState`` only for ``restored``.
+    Counts every attempt in ``solver_state_restore_total{outcome}`` and every
+    degradation in ``restore_fallback_total{reason=journal-*}`` — a restore
+    is never unclassified and never raises."""
+    from karpenter_tpu.metrics.registry import RESTORE_FALLBACK, STATE_RESTORE
+    from karpenter_tpu.obs.programs import isa_tag
+    from karpenter_tpu.utils.persist import PersistError, load_framed
+
+    def classify(outcome: str) -> Tuple[str, None]:
+        STATE_RESTORE.inc({"outcome": outcome})
+        # "missing" is the normal first boot, not a degradation
+        if outcome not in ("restored", "missing"):
+            RESTORE_FALLBACK.inc({"reason": f"journal-{outcome}"})
+        return outcome, None
+
+    path = journal_path()
+    if path is None:
+        return classify("missing")
+    try:
+        header, payload = load_framed(
+            path, kind="stream-journal", min_version=JOURNAL_VERSION
+        )
+    except PersistError as exc:
+        return classify(exc.reason)
+    if header.get("meta", {}).get("isa") != isa_tag():
+        return classify("isa-mismatch")
+    age = time.time() - float(header.get("created_unix", 0.0))
+    if age > max_age_s():
+        return classify("stale")
+    try:
+        state = pickle.loads(payload)
+    except Exception:  # noqa: BLE001 — checksummed, but be exhaustive
+        return classify("error")
+    try:
+        from karpenter_tpu.solver import validator as val
+
+        violations = val.validate_result(
+            state.result, state.pods, state.instance_types, state.templates,
+            nodes=state.nodes, level="full",
+        )
+    except Exception:  # noqa: BLE001 — a malformed state that crashes the gate
+        return classify("error")
+    if violations:
+        _warn_once(
+            "validator", "stream journal: restored state rejected by the "
+            "full validator gate (%d violations) — cold start", len(violations),
+        )
+        return classify("validator")
+    STATE_RESTORE.inc({"outcome": "restored"})
+    return "restored", state

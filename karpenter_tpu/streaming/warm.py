@@ -55,6 +55,8 @@ from karpenter_tpu.streaming.delta import (
     SnapshotDelta,
     diff_snapshots,
 )
+from karpenter_tpu.streaming import snapshot as journal
+from karpenter_tpu.testing import faults
 from karpenter_tpu.utils import resources as res
 
 _WARM_CLAIM_PREFIX = "warm-claim-"
@@ -91,6 +93,10 @@ class _StreamState:
     certified_uids: frozenset  # uids whose placements are provably cold-identical
     # uid -> ("node", name) | ("claim", index) | ("fail", reason)
     placement_of: Dict[str, Tuple[str, object]] = field(default_factory=dict)
+    # True when this state was restored from the on-disk journal: universe
+    # comparisons must go by content digest — unpickled objects can never
+    # pass the identity fast path
+    restored: bool = False
 
 
 def _index_placements(pods: Sequence[Pod], result: SolveResult) -> Dict[str, Tuple[str, object]]:
@@ -135,12 +141,29 @@ class StreamingSolver(SolverBackend):
         self.last_delta: Optional[SnapshotDelta] = None
         self.last_certified_uids: frozenset = frozenset()
         self.counters: Dict[str, int] = {}
+        self._accepts = 0
+        # crash-consistent journal (KARPENTER_TPU_STATE_DIR): a fresh process
+        # restores the last accepted cycle and re-enters the warm path on its
+        # first solve — classified fallback to cold on ANY journal defect
+        self.restored_from_journal = False
+        self.last_restore_outcome: Optional[str] = None
+        if journal.enabled():
+            outcome, state = journal.load()
+            self.last_restore_outcome = outcome
+            if state is not None:
+                state.restored = True
+                self._prev = state
+                self.restored_from_journal = True
 
     # supervisor calls this on validator rejection: a quarantined result must
     # never seed the next warm cycle
     def reset_streaming_state(self) -> None:
         self._prev = None
         self.delta_encoder.reset()
+        # the on-disk journal mirrors _prev: a quarantined result must not
+        # resurrect in the next process either
+        if journal.enabled():
+            journal.invalidate()
 
     reset = reset_streaming_state
 
@@ -158,6 +181,7 @@ class StreamingSolver(SolverBackend):
         domains=None,
         pod_volumes=None,
     ) -> SolveResult:
+        faults.crash_point("cycle.enter")
         pods = list(pods)
         nodes = list(nodes)
         unsupported = (
@@ -261,6 +285,9 @@ class StreamingSolver(SolverBackend):
             placement_of=_index_placements(pods, result),
         )
         self.last_certified_uids = frozenset(certified)
+        self._accepts += 1
+        if journal.enabled() and self._accepts % journal.cadence() == 0:
+            journal.save(self._prev)
 
     def _cold_reason(self, prev, delta, pods, instance_types, templates) -> Optional[str]:
         if prev is None:
@@ -271,17 +298,38 @@ class StreamingSolver(SolverBackend):
             # node adds/changes move every bin decision after them; removals
             # are handled warm (residents become seeds)
             return "cold-world-changed"
-        if len(instance_types) != len(prev.instance_types) or any(
-            a is not b for a, b in zip(instance_types, prev.instance_types)
-        ):
-            return "cold-world-changed"
-        if len(templates) != len(prev.templates) or any(
-            a is not b for a, b in zip(templates, prev.templates)
-        ):
+        if self._universe_changed(
+            instance_types, prev.instance_types, prev.restored,
+        ) or self._universe_changed(templates, prev.templates, prev.restored):
             return "cold-world-changed"
         if delta.frac > self.max_frac:
             return "cold-threshold"
         return None
+
+    @staticmethod
+    def _universe_changed(cur, prev, prev_restored: bool) -> bool:
+        """Instance-type/template universe comparison: object identity in the
+        steady state (the provisioner passes the same lists), content digests
+        when the previous state came off the journal (identity cannot survive
+        a pickle round trip)."""
+        if len(cur) != len(prev):
+            return True
+        if all(a is b for a, b in zip(cur, prev)):
+            return False
+        if not prev_restored:
+            return True
+        from karpenter_tpu.streaming.delta import (
+            instance_type_digest,
+            template_digest,
+        )
+        from karpenter_tpu.solver.encode import TemplateInfo
+
+        fn = (
+            template_digest
+            if prev and isinstance(prev[0], TemplateInfo)
+            else instance_type_digest
+        )
+        return any(fn(a) != fn(b) for a, b in zip(cur, prev))
 
     # -- the warm path --------------------------------------------------------
 

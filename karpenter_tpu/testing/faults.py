@@ -9,10 +9,11 @@ explicit schedule rather than ambient randomness:
 Grammar — ``;``-separated entries, optional leading ``seed=N``:
 
     entry  := site '.' kind ['=' param] '@' sched
-    site   := 'solve' | 'create' | 'delete' | 'cloud'
+    site   := 'solve' | 'create' | 'delete' | 'cloud' | 'proc'
     kind   := solve: compile | device | encode | nan | hang
               create/delete: ice | ratelimit | timeout
               cloud: reclaim
+              proc: crash
     param  := float   (solve.hang: duration in seconds, default 30;
                        cloud.reclaim: nodes reclaimed per firing, default 1)
     sched  := N       fire on the N-th call to the site (1-based)
@@ -38,13 +39,19 @@ import zlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
-SITES = ("solve", "create", "delete", "cloud")
+SITES = ("solve", "create", "delete", "cloud", "proc")
 SOLVE_KINDS = ("compile", "device", "encode", "nan", "hang")
 CLOUD_KINDS = ("ice", "ratelimit", "timeout")
 # the 'cloud' site models provider-initiated events (spot reclaims) rather
 # than API-call failures; the churn generator (streaming/churn.py) draws it
 # once per cycle, so chaos specs and churn configs share one grammar
 RECLAIM_KINDS = ("reclaim",)
+# the 'proc' site models process death: 'crash' SIGKILLs the process at the
+# N-th crash-point visit (phase-boundary hooks sprinkled through the solve/
+# journal path call crash_point()). Only the subprocess restart harness
+# (testing/restart.py) schedules it — an in-process test scheduling proc.crash
+# kills the test runner.
+PROC_KINDS = ("crash",)
 
 
 class InjectedFault(RuntimeError):
@@ -112,6 +119,8 @@ def parse_spec(spec: str) -> Tuple[List[FaultRule], int]:
             allowed = SOLVE_KINDS
         elif site == "cloud":
             allowed = RECLAIM_KINDS
+        elif site == "proc":
+            allowed = PROC_KINDS
         else:
             allowed = CLOUD_KINDS
         if kind not in allowed:
@@ -204,6 +213,27 @@ def reclaim_targets(
     count = min(int(rule.param) if rule.param else 1, len(pool))
     rng = random.Random(zlib.crc32(f"{seed}:cloud.reclaim:{call}".encode()))
     return rng.sample(pool, count)
+
+
+def crash_point(point: str) -> None:
+    """Phase-boundary hook for ``proc.crash``: callers mark kill-eligible
+    sites (cycle entry, journal pre/post-write, persist pre-rename) with a
+    named visit. Each visit advances the shared 'proc' counter; the scheduled
+    firing SIGKILLs the process — no atexit, no cleanup, exactly the death a
+    kernel OOM-kill or node preemption delivers. Disabled-path cost is one
+    module-attribute read (``active()``)."""
+    injector = active()
+    if injector is None:
+        return
+    rule = injector.draw("proc")
+    if rule is not None and rule.kind == "crash":
+        import logging
+        import signal
+
+        logging.getLogger(__name__).warning(
+            "proc.crash firing at %s (call %d)", point, injector.calls("proc")
+        )
+        os.kill(os.getpid(), signal.SIGKILL)
 
 
 def cloud_exception(rule: FaultRule) -> Exception:

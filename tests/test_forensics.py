@@ -215,6 +215,62 @@ class TestQuarantineRing:
         assert _quarantine_max() == 1  # ring of at least the newest dump
 
 
+class TestQuarantineLoader:
+    """dump_quarantine writes atomically (tmp + os.replace) and
+    scan_quarantine/load_quarantine tolerate torn or non-JSON files — a
+    crash mid-dump must not poison later forensics reads."""
+
+    class _Result:
+        new_claims = ()
+        node_pods: dict = {}
+        failures: dict = {}
+
+    def test_dump_is_atomic_no_tmp_residue(self, tmp_path):
+        from karpenter_tpu.solver.forensics import dump_quarantine
+
+        path = dump_quarantine(self._Result(), ["v"], directory=str(tmp_path))
+        assert path is not None
+        assert not list(tmp_path.glob("*.tmp.*"))
+        assert list(tmp_path.glob("quarantine-*.json"))
+
+    def test_loader_skips_torn_json(self, tmp_path):
+        import os
+
+        from karpenter_tpu.solver.forensics import (
+            dump_quarantine,
+            load_quarantine,
+            scan_quarantine,
+        )
+
+        for i in range(2):
+            p = dump_quarantine(
+                self._Result(), [f"violation {i}"], directory=str(tmp_path)
+            )
+            os.utime(p, (1000.0 + 10 * i,) * 2)
+        # a torn half-JSON dump (the pre-atomic-write failure mode) and a
+        # non-dict payload: both skipped, neither raises
+        torn = tmp_path / "quarantine-torn.json"
+        torn.write_text('{"result": {"claims": [')
+        os.utime(torn, (1020.0, 1020.0))
+        notdict = tmp_path / "quarantine-list.json"
+        notdict.write_text("[1, 2]")
+        os.utime(notdict, (1030.0, 1030.0))
+
+        payloads, skipped = scan_quarantine(str(tmp_path))
+        assert len(payloads) == 2
+        assert len(skipped) == 2
+        assert all("_path" in p and p["violations"] for p in payloads)
+        # newest-first ordering and the limit knob
+        assert payloads[0]["violations"] == ["violation 1"]
+        assert len(load_quarantine(str(tmp_path), limit=1)) == 1
+
+    def test_loader_empty_or_missing_dir(self, tmp_path):
+        from karpenter_tpu.solver.forensics import scan_quarantine
+
+        assert scan_quarantine(str(tmp_path)) == ([], [])
+        assert scan_quarantine(str(tmp_path / "nope")) == ([], [])
+
+
 class TestProvisionerEvent:
     def test_failed_scheduling_event_carries_forensics(self):
         """FailedScheduling events carry the per-criterion reason

@@ -1,0 +1,350 @@
+"""Restart resilience (docs/ROBUSTNESS.md): AOT executable snapshot/restore
+(solver/aot.py), the crash-consistent streaming-state journal
+(streaming/snapshot.py), proc.crash injection + the restart-storm harness
+(testing/restart.py), and the /readyz recovery sequencing
+(operator/serving.py). The invariant under test everywhere: a snapshot can
+be wrong in any way and the outcome is a CLASSIFIED cold start — never an
+exception on the solve path, never a different placement."""
+
+import os
+import random
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from karpenter_tpu.apis.nodepool import NodePool
+from karpenter_tpu.apis.objects import ObjectMeta
+from karpenter_tpu.cloudprovider.fake import instance_types
+from karpenter_tpu.metrics.registry import AOT_RESTORE, STATE_RESTORE
+from karpenter_tpu.solver import aot
+from karpenter_tpu.solver.encode import template_from_nodepool
+from karpenter_tpu.solver.jax_backend import JaxSolver
+from karpenter_tpu.solver.oracle import OracleSolver
+from karpenter_tpu.streaming import StreamingSolver
+from karpenter_tpu.streaming import snapshot as journal
+from karpenter_tpu.streaming.churn import default_pod_factory
+from karpenter_tpu.testing import faults
+from karpenter_tpu.testing.restart import result_digest, run_restart_storm
+from karpenter_tpu.utils import persist
+
+REPO_ROOT = str(Path(__file__).resolve().parent.parent)
+
+
+@pytest.fixture(autouse=True)
+def _clean_restart_state():
+    faults.clear()
+    aot.reset_table()
+    aot.reset_recovery_for_tests()
+    yield
+    faults.clear()
+    aot.reset_table()
+    aot.reset_recovery_for_tests()
+
+
+def build_world(its_count=8, pool="restart"):
+    its = instance_types(its_count)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name=pool)), its, range(len(its))
+    )
+    return its, [tpl]
+
+
+def gen_pods(count, seed=0, prefix="p"):
+    rng = random.Random(seed)
+    return [default_pod_factory(f"{prefix}-{i}", rng) for i in range(count)]
+
+
+# -- AOT executable snapshot/restore ------------------------------------------
+
+
+def test_disabled_is_noop(monkeypatch):
+    """Flag off (either env unset) must be one env read returning None —
+    the dispatch path and placements are untouched."""
+    monkeypatch.delenv("KARPENTER_TPU_AOT_RESTORE", raising=False)
+    monkeypatch.delenv("KARPENTER_TPU_STATE_DIR", raising=False)
+    assert not aot.enabled()
+    assert aot.maybe_begin(None, None, 0, None) is None
+    monkeypatch.setenv("KARPENTER_TPU_AOT_RESTORE", "1")
+    assert not aot.enabled()  # no state dir -> still off
+    summary = aot.restore()
+    assert summary["entries"] == 0 and summary["restored"] == 0
+
+
+def test_aot_round_trip_parity_and_corruption(tmp_path, monkeypatch):
+    its, tpls = build_world()
+    pods = gen_pods(10)
+
+    # control: flag off
+    monkeypatch.delenv("KARPENTER_TPU_AOT_RESTORE", raising=False)
+    monkeypatch.delenv("KARPENTER_TPU_STATE_DIR", raising=False)
+    control = JaxSolver().solve(pods, its, tpls)
+
+    # flag on: same placements, write-through snapshot
+    monkeypatch.setenv("KARPENTER_TPU_AOT_RESTORE", "1")
+    monkeypatch.setenv("KARPENTER_TPU_STATE_DIR", str(tmp_path))
+    r1 = JaxSolver().solve(pods, its, tpls)
+    assert result_digest(r1) == result_digest(control)
+    files = aot.snapshot_files()
+    assert files, "write-through snapshot produced no .aot entries"
+    assert aot.table_size() >= 1 and aot.restored_count() == 0
+    # no torn tmp files left behind by the atomic write protocol
+    assert not list(tmp_path.rglob("*.tmp.*"))
+
+    # simulated restart: drop the in-memory table, restore from disk
+    aot.reset_table()
+    before = AOT_RESTORE.value(labels={"result": "restored"})
+    summary = aot.restore()
+    assert summary["restored"] == summary["entries"] >= 1
+    assert not summary["failures"]
+    assert AOT_RESTORE.value(labels={"result": "restored"}) >= before + 1
+    assert aot.restored_count() >= 1
+    r2 = JaxSolver().solve(pods, its, tpls)
+    assert result_digest(r2) == result_digest(control)
+
+    # the program registry records restored-executable dispatches first-class
+    from karpenter_tpu.obs import programs
+
+    programs.set_enabled(True)
+    try:
+        JaxSolver().solve(pods, its, tpls)
+        snap = programs.registry().snapshot()
+        assert any(
+            "restored" in p.get("sources", {}) for p in snap["programs"]
+        ), snap["programs"]
+    finally:
+        programs.set_enabled(None)
+
+    # corruption fuzz over one snapshot file: every mutation classifies,
+    # restores nothing from the damaged entry, and never raises
+    path = files[0]
+    blob = Path(path).read_bytes()
+    header, payload = persist.load_framed(path, kind="aot-entry")
+
+    def failures_after(data: bytes):
+        Path(path).write_bytes(data)
+        aot.reset_table()
+        s = aot.restore()
+        assert set(s["failures"]) <= set(aot.REASONS), s
+        return s["failures"]
+
+    assert "truncated" in failures_after(blob[: len(blob) // 2])
+    flipped = blob[:-10] + bytes([blob[-10] ^ 0xFF]) + blob[-9:]
+    assert "checksum" in failures_after(flipped)
+    assert "corrupt" in failures_after(b"not a snapshot at all")
+    persist.write_framed(
+        path, payload, kind="aot-entry", version=aot.AOT_VERSION + 1,
+        meta=header["meta"],
+    )
+    aot.reset_table()
+    assert "version-skew" in aot.restore()["failures"]
+    persist.write_framed(
+        path, payload, kind="aot-entry", version=aot.AOT_VERSION,
+        meta=dict(header["meta"], isa="alien-isa"),
+    )
+    aot.reset_table()
+    assert "isa-mismatch" in aot.restore()["failures"]
+    # restore the pristine bytes: the entry works again
+    Path(path).write_bytes(blob)
+    aot.reset_table()
+    assert aot.restore()["failures"] == {}
+
+
+def test_restore_and_probe_end_to_end(tmp_path, monkeypatch):
+    """The full recovery sequence: restore snapshots, probe-solve them,
+    record the traced recovery, land phase=ready with /readyz unblocked."""
+    from karpenter_tpu.solver import warmup
+
+    monkeypatch.setenv("KARPENTER_TPU_AOT_RESTORE", "1")
+    monkeypatch.setenv("KARPENTER_TPU_STATE_DIR", str(tmp_path))
+    # tracing on: the recovery runs as one traced cycle and /statusz links
+    # its trace id (with tracing off the record simply carries None)
+    monkeypatch.setenv("KARPENTER_TPU_TRACE", "1")
+    # seed the snapshot dir with exactly the probe shape, as the warmup
+    # ladder's smallest bucket would
+    assert warmup._probe_solve()
+    assert aot.snapshot_files()
+    aot.reset_table()
+
+    record = warmup.restore_and_probe()
+    assert record is not None
+    assert record["aot"]["restored"] >= 1, record
+    assert record["probe"] == "passed"
+    assert record["phase"] == aot.PHASE_READY
+    assert record["trace_id"]
+    assert record["seconds"] >= 0
+    assert aot.recovery_phase() == aot.PHASE_READY
+    assert not aot.recovery_blocking()
+    assert aot.last_recovery()["trace_id"] == record["trace_id"]
+
+
+# -- streaming-state journal ---------------------------------------------------
+
+
+def test_journal_round_trip_after_restart(tmp_path, monkeypatch):
+    its, tpls = build_world()
+    pods = gen_pods(24)
+    cycle2 = pods[1:] + gen_pods(1, seed=9, prefix="n")
+    cycle3 = cycle2[1:] + gen_pods(1, seed=10, prefix="m")
+
+    # control: the same three cycles through one never-restarted solver
+    monkeypatch.delenv("KARPENTER_TPU_STATE_DIR", raising=False)
+    ctrl = StreamingSolver(OracleSolver())
+    ctrl.solve(pods, its, tpls)
+    ctrl.solve(cycle2, its, tpls)
+    ctrl_r = ctrl.solve(cycle3, its, tpls)
+    assert ctrl.last_outcome == "warm"
+
+    # live: two cycles journaled, then a "restart" (a fresh solver instance)
+    monkeypatch.setenv("KARPENTER_TPU_STATE_DIR", str(tmp_path))
+    live = StreamingSolver(OracleSolver())
+    live.solve(pods, its, tpls)
+    live.solve(cycle2, its, tpls)
+    assert journal.journal_path() and os.path.exists(journal.journal_path())
+
+    before = STATE_RESTORE.value(labels={"outcome": "restored"})
+    reborn = StreamingSolver(OracleSolver())
+    assert reborn.restored_from_journal
+    assert reborn.last_restore_outcome == "restored"
+    assert STATE_RESTORE.value(labels={"outcome": "restored"}) == before + 1
+    r = reborn.solve(cycle3, its, tpls)
+    assert reborn.last_outcome == "warm", reborn.last_outcome
+    assert result_digest(r) == result_digest(ctrl_r)
+
+    # reset_streaming_state (the quarantine hook) invalidates the journal:
+    # a rejected state must not resurrect after a crash
+    reborn.reset_streaming_state()
+    assert not os.path.exists(journal.journal_path())
+    again = StreamingSolver(OracleSolver())
+    assert not again.restored_from_journal
+    assert again.last_restore_outcome == "missing"
+
+
+def test_journal_corruption_classified(tmp_path, monkeypatch):
+    """Every way the journal can be wrong is a classified cold start:
+    load() returns (outcome, None), counts the outcome, never raises."""
+    its, tpls = build_world()
+    monkeypatch.setenv("KARPENTER_TPU_STATE_DIR", str(tmp_path))
+    StreamingSolver(OracleSolver()).solve(gen_pods(12), its, tpls)
+    path = journal.journal_path()
+    blob = Path(path).read_bytes()
+    header, payload = persist.load_framed(path, kind="stream-journal")
+
+    def outcome_of(data: bytes) -> str:
+        Path(path).write_bytes(data)
+        outcome, state = journal.load()
+        assert state is None
+        assert outcome in journal.OUTCOMES
+        return outcome
+
+    assert outcome_of(blob[: len(blob) // 2]) == "truncated"
+    flipped = blob[:-10] + bytes([blob[-10] ^ 0xFF]) + blob[-9:]
+    assert outcome_of(flipped) == "checksum"
+    # long enough to carry a frame header, wrong magic -> corrupt (a
+    # few-byte stub is "truncated": shorter than any frame can be)
+    assert outcome_of(b"x" * 64) == "corrupt"
+    persist.write_framed(
+        path, payload, kind="stream-journal",
+        version=journal.JOURNAL_VERSION + 1, meta=header["meta"],
+    )
+    assert journal.load()[0] == "version-skew"
+    persist.write_framed(
+        path, payload, kind="stream-journal", version=journal.JOURNAL_VERSION,
+        meta=dict(header["meta"], isa="alien-isa"),
+    )
+    assert journal.load()[0] == "isa-mismatch"
+    # pristine bytes but aged out -> stale
+    Path(path).write_bytes(blob)
+    monkeypatch.setenv("KARPENTER_TPU_STATE_MAX_AGE_S", "0")
+    assert journal.load()[0] == "stale"
+    monkeypatch.delenv("KARPENTER_TPU_STATE_MAX_AGE_S")
+    # pristine and fresh -> restores
+    outcome, state = journal.load()
+    assert outcome == "restored" and state is not None
+
+
+# -- crash injection + restart storm ------------------------------------------
+
+
+def test_proc_crash_sigkills_child(tmp_path):
+    """proc.crash is the honest crash: the child dies by SIGKILL at the
+    scheduled crash point, no atexit, no cleanup."""
+    env = dict(
+        os.environ,
+        KARPENTER_TPU_STATE_DIR=str(tmp_path),
+        KARPENTER_TPU_FAULTS="proc.crash@1",
+        JAX_PLATFORMS="cpu",
+    )
+    out = subprocess.run(
+        [sys.executable, "-m", "karpenter_tpu.testing.restart",
+         "--pods", "8", "--its", "2", "--cycles", "2"],
+        capture_output=True, text=True, timeout=120, cwd=REPO_ROOT, env=env,
+    )
+    assert out.returncode == -9, (out.returncode, out.stdout, out.stderr)
+
+
+def test_restart_storm_small():
+    """A tier-1-sized storm: 2 SIGKILLs across 4 churn cycles. Placement
+    parity with the never-crashed control, every pod accounted exactly once,
+    every restore outcome classified."""
+    res = run_restart_storm(pod_count=16, its_count=3, cycles=4, kills=2)
+    assert res["ok"], res
+    assert res["kills"] == 2
+    assert res["cycles"] == 4
+    assert res["parity_ok"] and res["acct_ok"], res
+    assert res["restores_classified"], res["restores"]
+
+
+# -- /readyz sequencing + /statusz --------------------------------------------
+
+
+def test_readyz_blocks_through_recovery_phases():
+    import json
+    import socket
+    import urllib.error
+    import urllib.request
+
+    from karpenter_tpu.operator import serving
+
+    status = serving.OperatorStatus(warmup_ready=lambda: True)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    server = serving.serve(port, status=status)
+    base = f"http://127.0.0.1:{port}"
+
+    def readyz_code() -> int:
+        try:
+            return urllib.request.urlopen(f"{base}/readyz", timeout=5).status
+        except urllib.error.HTTPError as exc:
+            return exc.code
+
+    try:
+        assert readyz_code() == 200  # idle: no recovery, ready
+        aot.set_recovery_phase(aot.PHASE_RESTORING)
+        assert readyz_code() == 503
+        aot.set_recovery_phase(aot.PHASE_PROBING)
+        assert readyz_code() == 503
+        payload = json.loads(
+            urllib.request.urlopen(f"{base}/statusz", timeout=5).read()
+        )
+        assert payload["recovery"]["phase"] == "probing"
+        assert "last_restart_recovery" not in payload["recovery"]
+        # probe passed: ready, /statusz carries the recovery record + trace id
+        record = {"trace_id": "tr-recovery-1", "probe": "passed",
+                  "seconds": 0.12, "phase": "ready"}
+        aot.finish_recovery(record, aot.PHASE_READY)
+        assert readyz_code() == 200
+        payload = json.loads(
+            urllib.request.urlopen(f"{base}/statusz", timeout=5).read()
+        )
+        assert payload["recovery"]["phase"] == "ready"
+        last = payload["recovery"]["last_restart_recovery"]
+        assert last["trace_id"] == "tr-recovery-1"
+        assert last["probe"] == "passed"
+        # a FAILED recovery un-blocks: degraded to cold compiles, not hostage
+        aot.set_recovery_phase(aot.PHASE_FAILED)
+        assert readyz_code() == 200
+    finally:
+        server.shutdown()

@@ -120,6 +120,27 @@ def churn_survival(cycles: int = 8) -> bool:
     return ok
 
 
+def restart_storm(kills: int = 5, cycles: int = 8) -> bool:
+    """Post-matrix row: SIGKILL the solving process ``kills`` times mid-cycle
+    under churn (testing/restart.py subprocess harness) and require full
+    recovery — all cycles completed, zero dropped/duplicated pods, placements
+    parity with a never-crashed control run, and every journal restore
+    classified (no ``unknown`` outcomes)."""
+    from karpenter_tpu.testing.restart import run_restart_storm
+
+    summary = run_restart_storm(pod_count=40, cycles=cycles, kills=kills)
+    restores = summary.get("restores", [])
+    print(
+        f"restart storm: {summary.get('kills', 0)} SIGKILLs over "
+        f"{summary.get('children', 0)} launches, {summary.get('cycles', 0)} "
+        f"cycles, parity={summary.get('parity_ok')}, "
+        f"acct={summary.get('acct_ok')}, restores="
+        + ",".join(restores)
+        + f" -> {'OK' if summary['ok'] else 'FAILED: ' + repr(summary)}"
+    )
+    return bool(summary["ok"])
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--pods", default="60,300",
@@ -201,7 +222,8 @@ def main() -> int:
         + ("" if not failed else f"; FAILED: {failed}")
     )
     churn_ok = churn_survival()
-    return 1 if (failed or not churn_ok) else 0
+    storm_ok = restart_storm()
+    return 1 if (failed or not churn_ok or not storm_ok) else 0
 
 
 if __name__ == "__main__":

@@ -65,6 +65,9 @@ DEFAULT_BANDS = {
     "coldstart_2500_s": (LOWER_BETTER, 3.0),
     "first_solve_s": (LOWER_BETTER, 3.0),
     "consolidation_per_s": (HIGHER_BETTER, 4.0),
+    # exec-to-answer with AOT restore + journal on (bench.py restart
+    # scenario). Old rows simply lack the field and the gate skips it.
+    "restart_recovery_s": (LOWER_BETTER, 3.0),
 }
 
 # absolute ceiling for the --smoke tiny-shape solve (steady-state, post
@@ -91,6 +94,7 @@ def row_from_bench(out: dict, label: str = "run") -> dict:
         "solve_10k_s": out.get("solve_10k_pods_s"),
         "coldstart_2500_s": out.get("coldstart_2500_s"),
         "first_solve_s": out.get("first_solve_after_start_s"),
+        "restart_recovery_s": out.get("restart_recovery_s"),
         "consolidation_per_s": out.get("consolidation_candidates_per_sec"),
         "device_peak_bytes_2500": out.get("device_peak_bytes_2500"),
         # schema v2: per-run UnschedulableReason histogram and the explain
