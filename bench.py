@@ -38,6 +38,12 @@ Env knobs:
                         docs/PERF_NOTES.md) to this jsonl file — unset means
                         emit-only, so CI runs never mutate the committed
                         bench_history.jsonl
+
+Solver flags flow through to the child unchanged; notably
+KARPENTER_TPU_RELAX=1 makes the run measure the two-phase relaxation solve,
+and the per-shape events + history row gain the relax_* columns
+(relax_placed_frac, repair_iterations, relax phase wall, solve_10k_relax_s)
+so flag-on and flag-off runs stay separately gateable.
 """
 
 from __future__ import annotations
@@ -300,6 +306,26 @@ def run_child():
             ev["phase_breakdown_s"] = {
                 k: round(v, 4) for k, v in last_trace["phases"].items()
             }
+        # round-15 two-phase telemetry (KARPENTER_TPU_RELAX): how much of
+        # the batch phase 1 placed, the repair tail it left (narrow
+        # iterations of the carried sweeps pass), and phase-1's own wall
+        # share — the three numbers the relaxation's economics hang on
+        last_relax = getattr(solver, "last_relax", None)
+        if last_relax is not None:
+            ev["relax"] = {
+                "placed_frac": round(
+                    last_relax["placed"] / max(pod_count, 1), 4
+                ),
+                "eligible": last_relax["eligible"],
+                "demoted": last_relax["demoted"],
+                "fallbacks": solver.relax_fallbacks,
+            }
+            if solver.last_iters is not None:
+                ev["relax"]["repair_iterations"] = solver.last_iters.narrow
+            if last_trace is not None and "relax" in last_trace["phases"]:
+                ev["relax"]["phase_s"] = round(
+                    last_trace["phases"]["relax"], 4
+                )
         cc_hits = solver.compile_cache_hits - cache_before[0]
         cc_misses = solver.compile_cache_misses - cache_before[1]
         ev["compile_cache"] = {
@@ -874,6 +900,37 @@ def main():
         # the BASELINE north star: 10k pods x 400+ ITs Solve() latency
         out["solve_10k_pods_s"] = round(north["solve_s"], 3)
         out["solve_10k_vs_100ms_target"] = round(0.1 / max(north["solve_s"], 1e-9), 4)
+    # round-15 two-phase columns (schema v2): phase-1 coverage, the repair
+    # tail, and the relax dispatch's wall. Present only when the run had
+    # KARPENTER_TPU_RELAX on — flag-off rows simply lack them, and the gate
+    # compares only metrics both rows carry
+    if any("relax" in e for e in shapes):
+        out["per_shape_relax"] = {
+            str(e["pods"]): e["relax"] for e in shapes if "relax" in e
+        }
+        fracs = {e["pods"]: e["relax"]["placed_frac"]
+                 for e in shapes if "relax" in e}
+        # headline is the north-star shape's; else the worst shape, so a
+        # rounding regression on ANY shape moves the published number
+        out["relax_placed_frac"] = fracs.get(10000, min(fracs.values()))
+        iters = {
+            e["pods"]: e["relax"]["repair_iterations"]
+            for e in shapes
+            if "relax" in e and "repair_iterations" in e["relax"]
+        }
+        if iters:
+            out["repair_iterations"] = iters.get(10000, max(iters.values()))
+        walls = {
+            e["pods"]: e["relax"]["phase_s"]
+            for e in shapes if "relax" in e and "phase_s" in e["relax"]
+        }
+        if walls:
+            out["relax_phase_s"] = walls.get(10000, max(walls.values()))
+        if north is not None and "relax" in north:
+            # the relaxed 10k solve gets its OWN gated metric: a relax run
+            # and a pure-FFD run are different modes, so they must not
+            # share solve_10k_pods_s's baseline window
+            out["solve_10k_relax_s"] = round(north["solve_s"], 3)
     cold = next((e for e in events if e.get("event") == "coldstart"), None)
     if cold is not None and "cold_s" in cold:
         out["coldstart_2500_s"] = cold["cold_s"]

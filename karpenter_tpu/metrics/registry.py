@@ -210,6 +210,11 @@ SOLVE_DEADLINE_EXCEEDED = REGISTRY.counter(
     "solve_deadline_exceeded_total",
     "Solves abandoned by the wall-clock watchdog",
 )
+RELAX_FALLBACK = REGISTRY.counter(
+    "solver_relax_fallback_total",
+    "Two-phase (KARPENTER_TPU_RELAX) solves redone without relaxation after "
+    "the full-level validator rejected the relaxed result",
+)
 
 # -- solve-cycle tracing series (obs/trace.py, solver/jax_backend.py) ---------
 SOLVER_PHASE_DURATION = REGISTRY.histogram(
@@ -238,7 +243,8 @@ PROGRAM_LAUNCHES = REGISTRY.counter(
 DEVICE_BYTES = REGISTRY.gauge(
     "solver_device_bytes",
     "Device memory at the last solve-cycle sample, by kind (live, peak, "
-    "carried_state)",
+    "carried_state, donated = carried bytes reclaimed in place by "
+    "donate_argnums on the carried solve entries)",
 )
 PERSISTENT_CACHE = REGISTRY.counter(
     "solver_persistent_cache_total",

@@ -104,6 +104,8 @@ PROGRAM_FLAGS = (
     "KARPENTER_TPU_TOPO_CHAIN",
     "KARPENTER_TPU_SPREAD_CHAIN",
     "KARPENTER_TPU_ABLATE",
+    "KARPENTER_TPU_RELAX",
+    "KARPENTER_TPU_RELAX_PASSES",
 )
 
 
@@ -226,9 +228,9 @@ class ProgramRecord:
         self.sources: Dict[str, int] = {}
         self.eqns: Optional[int] = None
         self.statics = dict(statics) if statics else {}
-        # donated is the donation headroom: buffers the program COULD reuse
-        # in place but currently copies (no donate_argnums on the solve path
-        # yet) — carried is the FFDState that rides between passes
+        # donated is the carried-state bytes the program reclaimed in place
+        # (donate_argnums on the carried solve entries, round 15) — carried
+        # is the FFDState that rides between passes
         self.bytes_last: Dict[str, int] = {}
         self.bytes_total: Dict[str, int] = {}
 
@@ -368,11 +370,12 @@ class ProgramRegistry:
 
     def sample_memory(
         self, carried_bytes: int = 0, pods: Optional[int] = None,
-        cycle: Optional[str] = None,
+        cycle: Optional[str] = None, donated_bytes: int = 0,
     ) -> Optional[Dict]:
         """One per-solve-cycle sample: live/peak device bytes + the carried
-        FFDState footprint. Feeds the solver_device_bytes gauge and the
-        bounded sample ring in /debug/programs."""
+        FFDState footprint + the bytes donation reclaimed in place this
+        cycle. Feeds the solver_device_bytes gauge and the bounded sample
+        ring in /debug/programs."""
         from karpenter_tpu.metrics.registry import DEVICE_BYTES
 
         live, peak, how = self._device_memory()
@@ -381,6 +384,7 @@ class ProgramRegistry:
             "live_bytes": live,
             "peak_bytes": peak,
             "carried_state_bytes": int(carried_bytes),
+            "donated_bytes": int(donated_bytes),
             "source": how,
         }
         if pods is not None:
@@ -392,6 +396,7 @@ class ProgramRegistry:
         DEVICE_BYTES.set(live, {"kind": "live"})
         DEVICE_BYTES.set(peak, {"kind": "peak"})
         DEVICE_BYTES.set(int(carried_bytes), {"kind": "carried_state"})
+        DEVICE_BYTES.set(int(donated_bytes), {"kind": "donated"})
         return sample
 
     # -- views -----------------------------------------------------------------
@@ -539,12 +544,14 @@ def begin_dispatch(
 
 def sample_memory(
     carried_bytes: int = 0, pods: Optional[int] = None,
-    cycle: Optional[str] = None,
+    cycle: Optional[str] = None, donated_bytes: int = 0,
 ) -> Optional[Dict]:
     """Module-level convenience with the off-path short-circuit."""
     if not enabled():
         return None
-    return registry().sample_memory(carried_bytes, pods=pods, cycle=cycle)
+    return registry().sample_memory(
+        carried_bytes, pods=pods, cycle=cycle, donated_bytes=donated_bytes
+    )
 
 
 # -- jaxpr equation counting (KARPENTER_TPU_PROGRAMS_EQNS) --------------------

@@ -2,7 +2,9 @@
 
 Every solve cycle — a Provisioner.schedule, a disruption simulation, a direct
 backend call — gets a trace id and a tree of phase spans
-(``encode → bucket → compile|narrow → sweeps → validate → decode`` plus the
+(``encode → bucket → compile|relax → compile|narrow → sweeps → validate →
+decode`` — ``relax`` is the phase-1 dense placement dispatch when
+KARPENTER_TPU_RELAX routes the solve through the two-phase path — plus the
 supervisor's ``retry/fallback/salvage``). Kant (arXiv:2510.01256) credits its
 large-cluster scheduling wins to exactly this per-stage latency decomposition;
 this module is the equivalent layer for the JAX solver.

@@ -22,6 +22,9 @@ Module map (split round-5 from the former 2k-line monolith):
   ffd_runs.py   — run-compressed scan committing whole identical-pod runs
                   by waterfill (solve_ffd_runs, fuzz-anchored to the
                   per-pod step)
+  relax.py      — phase-1 dense relaxation placement (KARPENTER_TPU_RELAX):
+                  waterfill over pod-groups x template bins, residue repaired
+                  by the carried sweeps entry (solve_ffd_sweeps_carried)
 
 Every public (and test-visible private) name re-exports here so callers
 keep one import surface.
@@ -63,9 +66,11 @@ from karpenter_tpu.ops.ffd_step import (  # noqa: F401
 )
 from karpenter_tpu.ops.ffd_sweeps import (  # noqa: F401
     _make_stride,
+    _solve_ffd_sweeps_carried_jit,
     _solve_ffd_sweeps_fresh_jit,
     _sweeps_impl,
     solve_ffd_sweeps,
+    solve_ffd_sweeps_carried,
 )
 from karpenter_tpu.ops.ffd_runs import (  # noqa: F401
     _make_run_commit,

@@ -78,6 +78,11 @@ def solve_ffd(
     return _solve_ffd_jit(problem, init, bounds_free)
 
 
+# carried calls donate ``init`` (see _solve_ffd_jit): the backend reports the
+# carried bytes as reclaimed in the program registry's donated accounting
+solve_ffd._donates_carry = True
+
+
 
 def _make_step(problem: SchedulingProblem, statics, C: int):
     lv, ln = statics.lv, statics.ln
@@ -474,14 +479,19 @@ def _make_step(problem: SchedulingProblem, statics, C: int):
     return step
 
 
-@functools.partial(jax.jit, static_argnums=(2,))
+@functools.partial(jax.jit, static_argnums=(2,), donate_argnums=(1,))
 def _solve_ffd_jit(
     problem: SchedulingProblem, init: FFDState, bounds_free: bool = False
 ) -> FFDResult:
     """Reference per-pod scan: one pod per step — the provisioning
     production default (faster than the run-compressed scan on diverse
     workloads, see solver/jax_backend.py) and the semantic anchor the
-    run-compressed solver is fuzz-checked against."""
+    run-compressed solver is fuzz-checked against.
+
+    The carried state is donated: the relax-and-retry loop only ever reads
+    the RESULT's state (the previous pass's landscape is dead the moment the
+    next pass dispatches), so XLA reuses the claim/topology buffers in place
+    across passes — see obs/programs.py donated-bytes accounting."""
     problem, init = _lane_align(problem, init)
     step = _make_step(
         problem, _statics(problem, bounds_free), init.claim_open.shape[0]

@@ -46,6 +46,7 @@ from karpenter_tpu.ops.ffd_core import (  # noqa: F401
     _first_true,
     _fresh_template_rows,
     _intersect_rows,
+    _lane_align,
     _make_it_gate,
     _mint_host_onehot,
     _offer_rows,
@@ -1630,7 +1631,7 @@ def _make_stride(
 
 def _sweeps_impl(
     problem: SchedulingProblem, init: FFDState, C: int, bounds_free: bool = False,
-    wavefront: int = 0,
+    wavefront: int = 0, kinds0=None, idxs0=None,
 ) -> FFDResult:
     """All retry passes of a solve in ONE device program.
 
@@ -1674,8 +1675,13 @@ def _sweeps_impl(
     # cache entries but zero runtime
     queue0 = jnp.argsort(~active, stable=True).astype(jnp.int32)
     qlen0 = jnp.sum(active).astype(jnp.int32)
-    kinds0 = jnp.full((P,), KIND_FAIL, jnp.int32)
-    idxs0 = jnp.full((P,), -1, jnp.int32)
+    # repair-pass seeding (ops/relax.py): phase-1 verdict rows ride through
+    # untouched because their pods are inactive here and never stepped.
+    # None (every fresh solve) traces the exact pre-relaxation constants.
+    if kinds0 is None:
+        kinds0 = jnp.full((P,), KIND_FAIL, jnp.int32)
+    if idxs0 is None:
+        idxs0 = jnp.full((P,), -1, jnp.int32)
 
     def sweep_cond(c):
         _state, _queue, qlen, _kinds, _idxs, progress, noslot = c[:7]
@@ -1889,6 +1895,50 @@ def _solve_ffd_sweeps_fresh_jit(
         problem, initial_state(problem, max_claims), max_claims, bounds_free,
         wavefront,
     )
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4), donate_argnums=(1,))
+def _solve_ffd_sweeps_carried_jit(
+    problem: SchedulingProblem, carry, max_claims: int,
+    bounds_free: bool = False, wavefront: int = 0,
+) -> FFDResult:
+    """Repair pass of the two-phase solve (ops/relax.py): the phase-1 claim
+    landscape arrives as carried state, the phase-1 verdict rows seed
+    kinds/idxs, and ``problem.pod_active`` holds only the residue. Chain
+    commits stay safe on the sparse queue: batching requires ORIGINAL-row
+    adjacency (queue[i+1] == p+1), so a phase-1 placement between two residue
+    pods breaks their chain instead of batching across the gap.
+
+    The whole carry is donated: phase 1 hands these buffers over for good
+    (the backend only ever reads the REPAIR result's state), so XLA reuses
+    the claim/topology arrays in place instead of holding both landscapes
+    live — the reclaimed bytes surface as solver_device_bytes{kind="donated"}
+    via obs/programs.py."""
+    state, kinds0, idxs0 = carry
+    problem, state = _lane_align(problem, state)
+    return _sweeps_impl(problem, state, max_claims, bounds_free, wavefront,
+                        kinds0, idxs0)
+
+
+def solve_ffd_sweeps_carried(
+    problem: SchedulingProblem, max_claims: int, init=None,
+    wavefront: Optional[int] = None,
+) -> FFDResult:
+    """Sweeps repair entry: ``init`` is a RelaxCarry (state, kind, index)
+    from ops/relax.relax_place. Separate from solve_ffd_sweeps so program
+    keys, AOT table entries, and the registry distinguish the carried
+    executable from the fresh one."""
+    assert init is not None, "the repair pass always carries phase-1 state"
+    if wavefront is None:
+        wavefront = _wavefront_lanes()
+    return _solve_ffd_sweeps_carried_jit(
+        problem, tuple(init), max_claims, problem_bounds_free(problem), wavefront
+    )
+
+
+# flag for the dispatch accounting: this entry donates its carry, so the
+# backend reports the carried bytes as reclaimed (obs/programs.py donated)
+solve_ffd_sweeps_carried._donates_carry = True
 
 
 def solve_ffd_sweeps(

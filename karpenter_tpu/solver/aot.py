@@ -134,6 +134,32 @@ def _call_spec(solve_name: str, problem, max_claims: int, init) -> Optional[_Spe
             (problem,),
             (f"C{int(max_claims)}", f"bf{int(bf)}", f"wf{int(wf)}"),
         )
+    if solve_name == "solve_ffd_sweeps_carried":
+        from karpenter_tpu.ops.ffd_sweeps import (
+            _solve_ffd_sweeps_carried_jit,
+            _wavefront_lanes,
+        )
+
+        bf = problem_bounds_free(problem)
+        wf = _wavefront_lanes()
+        carry = tuple(init)
+        return _Spec(
+            _solve_ffd_sweeps_carried_jit,
+            (problem, carry, int(max_claims), bf, wf),
+            (problem, carry),
+            (f"C{int(max_claims)}", f"bf{int(bf)}", f"wf{int(wf)}", "carried"),
+        )
+    if solve_name == "relax_place":
+        from karpenter_tpu.ops.relax import _relax_place_jit, relax_passes
+
+        bf = problem_bounds_free(problem)
+        rp = relax_passes()
+        return _Spec(
+            _relax_place_jit,
+            (problem, int(max_claims), bf, rp),
+            (problem,),
+            (f"C{int(max_claims)}", f"bf{int(bf)}", f"rp{int(rp)}"),
+        )
     if solve_name == "solve_ffd":
         from karpenter_tpu.ops.ffd_step import _solve_ffd_fresh_jit, _solve_ffd_jit
 

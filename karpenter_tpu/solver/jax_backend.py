@@ -13,6 +13,16 @@ FFDState (bins + topology counters persist); failed pods are relaxed one
 notch (provisioning/preferences.py) and retried until a pass places nothing
 and relaxes nothing. The vocabulary is frozen from the original unrelaxed
 batch so carried state keeps valid lane indices across passes.
+
+Two-phase solve (KARPENTER_TPU_RELAX, round 15): in sweeps mode the backend
+can first run one dense relaxation program (ops/relax.py) that places the
+eligible bulk of the batch by waterfill over pods x template bins, then feed
+the residue into the SAME sweeps program as a repair pass carrying phase 1's
+claim landscape (solve_ffd_sweeps_carried). Every relaxed result is
+full-gated through the validator before the backend returns it; a violation
+triggers one fallback re-solve with relaxation off
+(solver_relax_fallback_total). Flag off, nothing changes: same programs,
+bit-identical placements.
 """
 
 from __future__ import annotations
@@ -25,7 +35,11 @@ import numpy as np
 
 from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.apis.objects import Pod
-from karpenter_tpu.metrics.registry import COMPILE_CACHE, TRANSFER_BYTES
+from karpenter_tpu.metrics.registry import (
+    COMPILE_CACHE,
+    RELAX_FALLBACK,
+    TRANSFER_BYTES,
+)
 from karpenter_tpu.obs import programs, trace
 from karpenter_tpu.solver import aot
 from karpenter_tpu.cloudprovider.types import InstanceType
@@ -45,6 +59,7 @@ from karpenter_tpu.solver.encode import (
     domains_from_instance_types,
 )
 from karpenter_tpu.ops.padding import claim_axis_bucket, pad_problem, pow2_bucket
+from karpenter_tpu.ops import relax
 from karpenter_tpu.ops.ffd import (
     KIND_CLAIM,
     KIND_NEW_CLAIM,
@@ -54,6 +69,7 @@ from karpenter_tpu.ops.ffd import (
     solve_ffd,
     solve_ffd_runs,
     solve_ffd_sweeps,
+    solve_ffd_sweeps_carried,
 )
 
 # The per-pod scan is the production default. Measured on the reference's
@@ -212,6 +228,15 @@ class JaxSolver(SolverBackend):
         # obs/explain.ExplainReport of the LAST solve (KARPENTER_TPU_EXPLAIN
         # only); None before any explained solve and reset per solve
         self.last_explain = None
+        # phase-1 relaxation telemetry of the LAST solve
+        # (KARPENTER_TPU_RELAX only): dict with eligible/placed/demoted/
+        # claims counts; None when the last solve was pure FFD — including
+        # after a validator fallback, since the returned placements are then
+        # not relaxed
+        self.last_relax = None
+        # lifetime count of full-gate rejections that forced a re-solve with
+        # relaxation off (mirrors solver_relax_fallback_total per backend)
+        self.relax_fallbacks = 0
 
     def solve(
         self,
@@ -243,15 +268,16 @@ class JaxSolver(SolverBackend):
         # passthrough: when the supervisor (or provisioner) already opened
         # this cycle, phases land directly under its span; a direct backend
         # call becomes its own cycle root
+        allow_relax = True
         with trace.cycle(
             "solve", backend=type(self).__name__, passthrough=True, pods=len(pods)
         ), self._dispatch_device(len(pods), len(nodes)):
             while True:
                 try:
-                    return self._solve_with_slots(
+                    result = self._solve_with_slots(
                         pods, instance_types, templates, nodes,
                         pod_requirements_override, topology, cluster_pods, domains,
-                        max_claims, pod_volumes,
+                        max_claims, pod_volumes, allow_relax,
                     )
                 except _SlotOverflow:
                     if max_claims >= len(pods):
@@ -267,6 +293,29 @@ class JaxSolver(SolverBackend):
                     self.claim_escalations += 1
                     with trace.span("escalate", max_claims=max_claims):
                         pass
+                    continue
+                if self.last_relax is not None:
+                    # the relaxed-solve contract: phase-1 placements are
+                    # validator-equivalent rather than bit-identical, so EVERY
+                    # result the two-phase path produced is full-gated before
+                    # it leaves the backend; a violation falls back to one
+                    # pure-FFD re-solve (the safe, parity-proven path)
+                    from karpenter_tpu.solver.validator import full_gate_relaxed
+
+                    violations = full_gate_relaxed(
+                        result, pods, instance_types, templates, nodes,
+                        pod_requirements_override, cluster_pods, domains,
+                    )
+                    if violations:
+                        RELAX_FALLBACK.inc()
+                        self.relax_fallbacks += 1
+                        allow_relax = False
+                        with trace.span(
+                            "relax_fallback", violations=len(violations)
+                        ):
+                            pass
+                        continue
+                return result
 
     def _explain(
         self, out, problem, state, meta, kinds, failed, failed_rows,
@@ -381,12 +430,85 @@ class JaxSolver(SolverBackend):
                 return jax.default_device(cpu)
         return contextlib.nullcontext()
 
+    def _relax_phase(self, problem, max_claims):
+        """Phase 1 of the two-phase solve (KARPENTER_TPU_RELAX): dispatch the
+        dense relaxation program over the padded problem and return its
+        RelaxOut (carried state + per-pod verdicts + residue mask), or None
+        when it placed nothing — the plain sweeps program is strictly better
+        then (nothing to seed, no second executable to compile). Instrumented
+        exactly like the generic dispatch below: program-key cache accounting,
+        AOT executable table, program registry, transfer bytes, trace span."""
+        relax_place = relax.relax_place
+        key = _program_key(relax_place, max_claims, problem)
+        cache_hit = key in _COMPILED_PROGRAMS
+        _COMPILED_PROGRAMS.add(key)
+        COMPILE_CACHE.inc({"result": "hit" if cache_hit else "miss"})
+        if cache_hit:
+            self.compile_cache_hits += 1
+            span_name = "relax"
+        else:
+            self.compile_cache_misses += 1
+            span_name = "compile"
+        prob_bytes = _nbytes(problem)
+        TRANSFER_BYTES.inc({"direction": "h2d"}, prob_bytes)
+        reg_eqns = None
+        if not cache_hit and programs.eqns_enabled():
+            reg_eqns = programs.maybe_count_eqns(
+                lambda: jax.make_jaxpr(
+                    lambda: relax_place(problem, max_claims)
+                )()
+            )
+        aot_handle = aot.maybe_begin(relax_place, problem, max_claims, None)
+        obs = programs.begin_dispatch(relax_place.__name__, max_claims, problem)
+        with trace.span(
+            span_name,
+            cache="hit" if cache_hit else "miss",
+            program=relax_place.__name__,
+        ) as sp:
+            if aot_handle is not None:
+                rout = aot_handle.call()
+            else:
+                rout = relax_place(problem, max_claims)
+            # the stats scalars are all phase 2 needs on the host; the state
+            # and verdict tensors stay on device and ride into the carried
+            # sweeps dispatch (which donates them back)
+            stats = jax.device_get(rout.stats)
+            d2h = _nbytes(stats)
+            TRANSFER_BYTES.inc({"direction": "d2h"}, d2h)
+            if obs is not None:
+                source = obs.finish(
+                    problem_bytes=prob_bytes,
+                    result_bytes=d2h,
+                    eqns=reg_eqns,
+                    source_override=(
+                        aot_handle.source_override
+                        if aot_handle is not None else None
+                    ),
+                )
+                if sp is not None:
+                    sp.attrs["program_key"] = obs.key
+                    sp.attrs["cache_source"] = source
+            self.last_relax = {
+                "eligible": int(stats.eligible),
+                "placed": int(stats.placed),
+                "demoted": int(stats.demoted),
+                "claims": int(stats.claims),
+            }
+            if sp is not None:
+                for field, value in self.last_relax.items():
+                    sp.count(field, value)
+        if self.last_relax["placed"] <= 0:
+            self.last_relax = None
+            return None
+        return rout
+
     def _solve_with_slots(
         self, pods, instance_types, templates, nodes,
         pod_requirements_override, topology, cluster_pods, domains, max_claims,
-        pod_volumes=None,
+        pod_volumes=None, allow_relax=True,
     ) -> SolveResult:
         t_init = _now()
+        self.last_relax = None  # never misattribute a prior attempt's phase 1
         # copy-on-write: pods are only copied when relaxation is about to
         # mutate them — the common all-scheduled case pays no deepcopy
         work = list(pods)
@@ -429,6 +551,7 @@ class JaxSolver(SolverBackend):
         meta = None
         np_final = None
         prev_group_keys = None
+        donated_total = 0  # carried-state bytes reclaimed in place this solve
         queue = list(range(len(work)))
         while queue:
             t0 = _now()
@@ -488,6 +611,27 @@ class JaxSolver(SolverBackend):
                 solve = solve_ffd_sweeps
             else:
                 solve = solve_ffd
+            if (
+                use_sweeps
+                and allow_relax
+                and state is None
+                and relax.enabled()
+                and relax.relax_applicable(problem)
+            ):
+                # phase 1 (KARPENTER_TPU_RELAX): one dense relaxation program
+                # places the eligible bulk, then the SAME sweeps loop repairs
+                # the residue carrying phase 1's claim landscape and per-pod
+                # verdicts. Sweeps mode runs exactly one pass, so phase 1
+                # only ever fires here with fresh state.
+                rout = self._relax_phase(problem, max_claims)
+                if rout is not None:
+                    import dataclasses
+
+                    solve = solve_ffd_sweeps_carried
+                    state = (rout.state, rout.kind, rout.index)
+                    problem = dataclasses.replace(
+                        problem, pod_active=rout.residue_active
+                    )
             # compile-cache accounting: a program key this process has not
             # dispatched yet pays a compile (or an on-disk cache load), so the
             # device span is named "compile" for it; repeat keys are pure
@@ -506,6 +650,13 @@ class JaxSolver(SolverBackend):
             carried_in = _nbytes(state) if state is not None else 0
             h2d = prob_bytes + carried_in
             TRANSFER_BYTES.inc({"direction": "h2d"}, h2d)
+            # carried entries marked _donates_carry consume their input state
+            # in place (donate_argnums), so the carried bytes are reclaimed
+            # rather than copied — solver_device_bytes{kind="donated"}
+            donated = (
+                carried_in if getattr(solve, "_donates_carry", False) else 0
+            )
+            donated_total += donated
             # program-registry jaxpr census (KARPENTER_TPU_PROGRAMS_EQNS):
             # re-trace the exact call pattern once per cold key, OUTSIDE the
             # dispatch timing so the count never pollutes compile wall time
@@ -585,6 +736,7 @@ class JaxSolver(SolverBackend):
                         problem_bytes=prob_bytes,
                         carried_bytes=carried_in,
                         result_bytes=d2h,
+                        donated_bytes=donated,
                         eqns=reg_eqns,
                         source_override=(
                             aot_handle.source_override
@@ -717,5 +869,6 @@ class JaxSolver(SolverBackend):
             carried_bytes=_nbytes(state) if state is not None else 0,
             pods=len(pods),
             cycle=trace.current_trace_id(),
+            donated_bytes=donated_total,
         )
         return out

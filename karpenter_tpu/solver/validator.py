@@ -156,6 +156,29 @@ def validate_result(
         return violations
 
 
+def full_gate_relaxed(
+    result: SolveResult,
+    pods: Sequence[Pod],
+    instance_types: Sequence[InstanceType],
+    templates: Sequence[TemplateInfo],
+    nodes: Sequence[NodeInfo] = (),
+    pod_requirements_override: Optional[Sequence[Requirements]] = None,
+    cluster_pods: Sequence = (),
+    domains: Optional[Dict[str, set]] = None,
+) -> List[Violation]:
+    """The relaxed-solve contract (KARPENTER_TPU_RELAX, round 15): phase-1
+    placements are validator-equivalent to FFD rather than bit-identical, so
+    EVERY result the two-phase path produces is full-gated here before the
+    backend returns it — a violation makes the backend redo the solve with
+    relaxation off (solver_relax_fallback_total) instead of acting on it.
+    Just validate_result at the full level under the relax span's roof; a
+    named wrapper so call sites and tests pin the contract, not a string."""
+    return validate_result(
+        result, pods, instance_types, templates, nodes,
+        pod_requirements_override, cluster_pods, domains, level="full",
+    )
+
+
 def _validate_result(
     result: SolveResult,
     pods: Sequence[Pod],

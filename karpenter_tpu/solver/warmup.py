@@ -18,8 +18,14 @@ Program-keying flags must MATCH between the warming process and the serving
 process: ``KARPENTER_TPU_WAVEFRONT`` (and ``_WIDTH``) is a static jit
 argument, so the wavefront and non-wavefront narrow steps are DISTINCT
 executables — warming with the flag in one position buys nothing for a
-server running the other. The same holds for ``KARPENTER_TPU_PACKED_GATES``
-and the stride/window knobs (all read at program-build time).
+server running the other. The same holds for ``KARPENTER_TPU_PACKED_GATES``,
+the stride/window knobs, and ``KARPENTER_TPU_RELAX`` (and ``_RELAX_PASSES``):
+with the relax flag on, every warm batch routes through the two-phase entry,
+so the relaxation program (ops/relax.py) and the carried repair sweeps
+compile — and AOT-serialize/restore (solver/aot.py) — at the SAME pod and
+claim buckets as the narrow step; with it off, the warms compile the plain
+sweeps program instead, so a mismatched server recompiles on first contact
+either way.
 """
 
 from __future__ import annotations

@@ -10,7 +10,11 @@ splits the batch into three buckets:
              anywhere can move a skew gate. These re-solve through the inner
              backend against the *residual* world: real nodes with pinned
              capacity pre-consumed, plus each surviving claim exposed as a
-             pseudo-node so re-solved pods can still join it.
+             pseudo-node so re-solved pods can still join it. The sub-solve
+             goes through the inner backend's ordinary entry, so with
+             ``KARPENTER_TPU_RELAX`` on it takes the same two-phase
+             relaxation+repair path (and full-level gate) as any other
+             solve — no streaming-side switch exists or is needed.
   reused     everything else — pinned to its previous bin verbatim. The
              merged result must pass the validator's FULL-level gate or the
              whole cycle falls back to a cold solve.

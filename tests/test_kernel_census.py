@@ -17,7 +17,11 @@ import os
 
 import pytest
 
-from tools.kernel_census import build_census_problem, narrow_jaxpr_eqns
+from tools.kernel_census import (
+    build_census_problem,
+    narrow_jaxpr_eqns,
+    relax_jaxpr_eqns,
+)
 
 # measured 2394 at the round-7 commit (P=64 T=64 K=4 V=32 C=16 after
 # padding); headroom covers jax-version jitter in primitive lowering
@@ -34,6 +38,13 @@ LEGACY_EQN_FLOOR = 2900
 # width knob trades against sequential depth, so growth here is as real a
 # regression as growth in the base body
 WAVEFRONT_EQN_BUDGET = 5300
+
+# round-15 phase-1 relaxation program (KARPENTER_TPU_RELAX, 2 rounding
+# passes): measured 1304 at the round-15 commit. This is the WHOLE one-shot
+# program, not a loop body — ~0.55x of ONE narrow iteration — which is the
+# entire economics of the two-phase solve: one dense dispatch stands in for
+# the hundreds of narrow iterations the bulk would otherwise cost
+RELAX_EQN_BUDGET = 1450
 
 
 @pytest.fixture(scope="module")
@@ -182,4 +193,55 @@ class TestWavefrontBudget:
         assert eqns >= WAVEFRONT_EQN_BUDGET * 0.8, (
             f"wavefront body shrank to {eqns} jaxpr eqns — nice! tighten "
             f"WAVEFRONT_EQN_BUDGET to keep the guard meaningful"
+        )
+
+
+class TestRelaxBudget:
+    """Round-15 two-phase solve: the phase-1 relaxation program gets its own
+    pinned budget, and the flag must not touch the narrow body — relaxation
+    is orchestrated entirely at the backend layer (solver/jax_backend.py), so
+    KARPENTER_TPU_RELAX=1 selects DIFFERENT programs rather than editing the
+    existing ones."""
+
+    def test_relax_program_under_budget(self, census_problem):
+        eqns = relax_jaxpr_eqns(census_problem)
+        assert eqns <= RELAX_EQN_BUDGET, (
+            f"phase-1 relaxation program grew to {eqns} jaxpr eqns "
+            f"(budget {RELAX_EQN_BUDGET}); the two-phase economics assume "
+            f"phase 1 stays ~half of ONE narrow iteration — see "
+            f"tools/kernel_census.py relax_jaxpr_eqns to attribute the growth"
+        )
+
+    def test_relax_budget_is_tight(self, census_problem):
+        eqns = relax_jaxpr_eqns(census_problem)
+        assert eqns >= RELAX_EQN_BUDGET * 0.8, (
+            f"relaxation program shrank to {eqns} jaxpr eqns — nice! tighten "
+            f"RELAX_EQN_BUDGET to keep the guard meaningful"
+        )
+
+    def test_relax_flag_on_narrow_body_unchanged(self, census_problem):
+        """With KARPENTER_TPU_RELAX forced on, the flag-off narrow body must
+        still count EXACTLY 2394 equations: the relax flag is read by the
+        backend's dispatch orchestration and by ops/relax.py's own entry,
+        never inside the sweeps/narrow kernels, so the repair pass runs the
+        SAME narrow program as a pure-FFD solve."""
+        old = os.environ.get("KARPENTER_TPU_RELAX")
+        os.environ["KARPENTER_TPU_RELAX"] = "1"
+        try:
+            assert narrow_jaxpr_eqns(census_problem, wavefront=0) == 2394
+        finally:
+            if old is None:
+                os.environ.pop("KARPENTER_TPU_RELAX", None)
+            else:
+                os.environ["KARPENTER_TPU_RELAX"] = old
+
+    def test_rounding_passes_scale_linearly_bounded(self, census_problem):
+        """Each extra rounding rung re-runs one feasibility gate sweep; the
+        knob must stay cheap (sub-linear in the narrow body) or the passes
+        ladder stops being a free lever."""
+        base = relax_jaxpr_eqns(census_problem, passes=2)
+        more = relax_jaxpr_eqns(census_problem, passes=3)
+        assert more - base < 300, (
+            f"one extra rounding pass costs {more - base} eqns — the ladder "
+            f"was designed around a per-rung gate sweep of <300"
         )

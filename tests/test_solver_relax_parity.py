@@ -1,0 +1,306 @@
+"""Two-phase relaxation solve (KARPENTER_TPU_RELAX) differential fuzz.
+
+The relaxed solve trades the pure-FFD parity contract (bit-identical to the
+oracle, tests/test_solver_parity.py) for a weaker but still hard one, pinned
+here over fuzz corpora mirroring the parity generators:
+
+  validator-clean   every flag-on result passes the FULL-level validator —
+                    capacity, instance-type sweep, host ports, topology skew
+                    bounds. The backend itself full-gates every relaxed
+                    result before returning it (solver/validator.py
+                    full_gate_relaxed), so a violation surfacing HERE means
+                    the fallback loop is broken, not just the kernel.
+  no-worse          scheduled_frac(flag on) >= scheduled_frac(flag off) on
+                    the same workload. Phase 1 only places pods the repair
+                    loop could also place, and the repair loop IS the
+                    flag-off solver over the residue, so relaxation may
+                    never lose a pod that pure FFD schedules.
+  exactly-once      every pod accounted exactly once across node_pods /
+                    new_claims / failures.
+
+Adversarial classes steer phase-1 rounding into territory it must hand to
+the repair loop: host-port conflicts (port pods are never phase-1 eligible)
+and DoNotSchedule topology skew (selected/owned pods are never eligible).
+"""
+
+import os
+import random
+from contextlib import contextmanager
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.objects import (
+    DO_NOT_SCHEDULE,
+    ContainerPort,
+    LabelSelector,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.cloudprovider.fake import FAKE_WELL_KNOWN_LABELS, instance_types
+from karpenter_tpu.solver.jax_backend import JaxSolver
+from karpenter_tpu.solver.validator import full_gate_relaxed
+
+# aliased so pytest does not re-collect the parity suites in this module
+from test_solver_parity import (
+    TestExistingNodesParity as _ExistingNodes,
+    TestRandomizedTopologyParity as _RandomizedTopology,
+    make_pod,
+    simple_template,
+)
+
+
+@contextmanager
+def relax_flag(value):
+    old = os.environ.get("KARPENTER_TPU_RELAX")
+    if value is None:
+        os.environ.pop("KARPENTER_TPU_RELAX", None)
+    else:
+        os.environ["KARPENTER_TPU_RELAX"] = value
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop("KARPENTER_TPU_RELAX", None)
+        else:
+            os.environ["KARPENTER_TPU_RELAX"] = old
+
+
+def run_ab(pods, its, templates, nodes=()):
+    """(off_solver, off_result, on_solver, on_result) for one workload."""
+    s_off = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS)
+    with relax_flag(None):
+        off = s_off.solve(pods, its, templates, nodes)
+    s_on = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS)
+    with relax_flag("1"):
+        on = s_on.solve(pods, its, templates, nodes)
+    return s_off, off, s_on, on
+
+
+def assert_exactly_once(result, n):
+    seen = []
+    for idxs in result.node_pods.values():
+        seen.extend(idxs)
+    for c in result.new_claims:
+        seen.extend(c.pod_indices)
+    seen.extend(result.failures)
+    assert sorted(seen) == list(range(n)), "pods not accounted exactly once"
+
+
+def assert_contract(pods, its, templates, nodes, off, on):
+    assert_exactly_once(on, len(pods))
+    violations = full_gate_relaxed(on, pods, its, templates, nodes)
+    assert not violations, f"relaxed result failed FULL validator: {violations}"
+    assert on.num_scheduled() >= off.num_scheduled(), (
+        f"relaxation lost pods: on={on.num_scheduled()} "
+        f"off={off.num_scheduled()} of {len(pods)}"
+    )
+
+
+class TestRelaxFuzzGeneric:
+    """The TestRandomizedParity workload family (selectors, tolerations,
+    ports, sizes, capped pool limits, existing nodes) under the A/B flag.
+    Pool limits make relax_applicable false and port pods shrink
+    eligibility — both must degrade gracefully, never violate."""
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_fuzz(self, seed):
+        rng = random.Random(5000 + seed)
+        its = instance_types(rng.randint(2, 12))
+        zones = ["test-zone-1", "test-zone-2", "test-zone-3"]
+        taint = Taint(key="team", value="x", effect="NoSchedule")
+        templates = [simple_template(its, name="a")]
+        if rng.random() < 0.3:
+            templates[0].remaining_resources = {"cpu": float(rng.randint(4, 40))}
+        if rng.random() < 0.5:
+            templates.append(simple_template(its, name="b", taints=[taint]))
+        pods = []
+        for i in range(rng.randint(5, 30)):
+            selector = {}
+            if rng.random() < 0.3:
+                selector[wk.LABEL_TOPOLOGY_ZONE] = rng.choice(zones)
+            if rng.random() < 0.2:
+                selector["integer"] = str(rng.randint(1, 12))
+            tols = (
+                [Toleration(key="team", operator="Exists")]
+                if rng.random() < 0.3
+                else []
+            )
+            pod = make_pod(
+                i,
+                cpu=rng.choice([0.1, 0.25, 0.5, 1.0, 1.5, 3.0]),
+                mem=rng.choice([1e8, 2.5e8, 1e9, 4e9]),
+                selector=selector,
+                tolerations=tols,
+            )
+            if rng.random() < 0.25:
+                pod.spec.containers[0].ports.append(
+                    ContainerPort(
+                        host_port=rng.choice([80, 443, 8080]),
+                        host_ip=rng.choice(["", "10.0.0.1"]),
+                        protocol=rng.choice(["TCP", "UDP"]),
+                    )
+                )
+            pods.append(pod)
+        nodes = [
+            _ExistingNodes().make_node(
+                f"node-{n}", cpu=rng.choice([2.0, 4.0, 8.0])
+            )
+            for n in range(rng.randint(0, 3))
+        ]
+        _, off, _, on = run_ab(pods, its, templates, nodes)
+        assert_contract(pods, its, templates, nodes, off, on)
+
+
+class TestRelaxFuzzTopology:
+    """The hard corpus: spread/affinity/anti-affinity mixes (the round-3
+    topology fuzz generator). Topology-constrained pods are never phase-1
+    eligible, so these seeds exercise heavy residue through the repair loop
+    carrying phase-1 state — including group counts phase 1 registered."""
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_fuzz_topology(self, seed):
+        gen = _RandomizedTopology()
+        rng = random.Random(7000 + seed)
+        its = instance_types(rng.choice([6, 10]))
+        templates = [simple_template(its, name="a")]
+        n = rng.randint(12, 60)
+        pods = [gen._make_topology_pod(rng, i) for i in range(n)]
+        nodes = [
+            _ExistingNodes().make_node(
+                f"node-{j}",
+                cpu=rng.choice([2.0, 4.0, 8.0]),
+                zone=rng.choice(gen.ZONES),
+            )
+            for j in range(rng.randint(0, 3))
+        ]
+        _, off, _, on = run_ab(pods, its, templates, nodes)
+        assert_contract(pods, its, templates, nodes, off, on)
+
+
+class TestRelaxTelemetry:
+    """The two-phase solve must actually run as two phases on its target
+    workload (homogeneous bulk) and report it: last_relax populated, the
+    bulk placed in phase 1, and the repair loop doing a small fraction of
+    the flag-off narrow iterations."""
+
+    def test_phase1_places_bulk_and_shrinks_repair(self):
+        its = instance_types(8)
+        pods = [make_pod(i, cpu=0.3 + 0.2 * (i % 5)) for i in range(48)]
+        templates = [simple_template(its)]
+        s_off, off, s_on, on = run_ab(pods, its, templates)
+        assert s_off.last_relax is None
+        assert s_on.last_relax is not None, "relaxation did not fire"
+        assert s_on.last_relax["placed"] > 0.5 * len(pods), s_on.last_relax
+        assert s_on.relax_fallbacks == 0
+        # the repair loop starts from phase 1's landscape: strictly fewer
+        # narrow iterations than the pure-FFD solve of the same batch
+        assert s_on.last_iters.narrow < s_off.last_iters.narrow, (
+            s_on.last_iters,
+            s_off.last_iters,
+        )
+        assert_contract(pods, its, templates, (), off, on)
+
+    def test_flag_off_solver_reports_nothing(self):
+        its = instance_types(4)
+        pods = [make_pod(i) for i in range(10)]
+        s = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS)
+        with relax_flag("0"):
+            s.solve(pods, its, [simple_template(its)])
+        assert s.last_relax is None
+        assert s.relax_fallbacks == 0
+
+    def test_template_limits_disable_relaxation(self):
+        """relax_applicable is false under pool resource limits (phase-1
+        waterfill has no remaining-capacity ledger): the solve must run
+        pure FFD, not relax-and-violate."""
+        its = instance_types(6)
+        tpl = simple_template(its)
+        tpl.remaining_resources = {"cpu": 6.0}
+        pods = [make_pod(i, cpu=1.0) for i in range(12)]
+        s = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS)
+        with relax_flag("1"):
+            r = s.solve(pods, its, [tpl])
+        assert s.last_relax is None
+        assert_exactly_once(r, len(pods))
+
+
+class TestRelaxAdversarialRounding:
+    """Workloads built so naive dense rounding WOULD violate: the violating
+    pods must be excluded from phase-1 eligibility and correctly land in the
+    repair loop, whose placements the full validator then certifies."""
+
+    def test_host_port_conflicts_route_to_repair(self):
+        """16 pods pinning the same host port can never share a bin: dense
+        waterfill would stack them, so they must not be phase-1 eligible.
+        The repair loop spreads them one per claim."""
+        its = instance_types(6)
+        templates = [simple_template(its)]
+        pods = []
+        for i in range(28):
+            p = make_pod(i, cpu=0.2)
+            if i % 2 == 0:
+                p.spec.containers[0].ports.append(
+                    ContainerPort(host_port=9443, protocol="TCP")
+                )
+            pods.append(p)
+        s_off, off, s_on, on = run_ab(pods, its, templates)
+        assert_contract(pods, its, templates, (), off, on)
+        if s_on.last_relax is not None:
+            # the port half of the batch was never eligible
+            assert s_on.last_relax["eligible"] <= len(pods) // 2
+        # every claim holds at most one port-9443 pod
+        for c in on.new_claims:
+            port_pods = [i for i in c.pod_indices if i % 2 == 0]
+            assert len(port_pods) <= 1, f"host-port conflict in claim: {c.pod_indices}"
+
+    def test_topology_skew_routes_to_repair(self):
+        """A DoNotSchedule zonal spread over half the batch: waterfill
+        rounding knows nothing about skew, so the spread pods must go to the
+        repair loop, which enforces the bound against phase-1-registered
+        zone counts. The full validator re-checks the skew bound."""
+        its = instance_types(8)
+        templates = [simple_template(its)]
+        pods = []
+        for i in range(32):
+            p = make_pod(i, cpu=0.25)
+            p.metadata.labels = {"grp": "skew"}
+            if i % 2 == 0:
+                p.spec.topology_spread_constraints = [
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                        when_unsatisfiable=DO_NOT_SCHEDULE,
+                        label_selector=LabelSelector(match_labels={"grp": "skew"}),
+                    )
+                ]
+            pods.append(p)
+        s_off, off, s_on, on = run_ab(pods, its, templates)
+        assert_contract(pods, its, templates, (), off, on)
+        if s_on.last_relax is not None:
+            assert s_on.last_relax["eligible"] <= len(pods) // 2
+
+    def test_hostname_spread_repair(self):
+        """Hostname spread with maxSkew=1 forces near-one-per-bin placement —
+        the exact opposite of dense packing. All spread pods repair-loop."""
+        its = instance_types(6)
+        templates = [simple_template(its)]
+        pods = []
+        for i in range(18):
+            p = make_pod(i, cpu=0.2)
+            p.metadata.labels = {"grp": "host-spread"}
+            if i < 6:
+                p.spec.topology_spread_constraints = [
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=wk.LABEL_HOSTNAME,
+                        when_unsatisfiable=DO_NOT_SCHEDULE,
+                        label_selector=LabelSelector(
+                            match_labels={"grp": "host-spread"}
+                        ),
+                    )
+                ]
+            pods.append(p)
+        s_off, off, s_on, on = run_ab(pods, its, templates)
+        assert_contract(pods, its, templates, (), off, on)

@@ -178,6 +178,29 @@ def narrow_jaxpr_eqns(problem=None, C: int = 16, wavefront: int = 0) -> int:
     return _count_jaxpr_eqns(jaxpr)
 
 
+def relax_jaxpr_eqns(problem=None, C: int = 16, passes: int = 2) -> int:
+    """Flattened jaxpr equation count of the WHOLE phase-1 relaxation
+    program (ops/relax.py, KARPENTER_TPU_RELAX). Unlike the narrow step this
+    is a one-shot program, not a loop body: its count is the total trace, so
+    the meaningful comparison is against iterations x narrow-step eqns, not
+    eqns-per-iteration. Pinned by tests/test_kernel_census.py like the other
+    program bodies."""
+    import jax
+
+    from karpenter_tpu.ops.ffd_core import _pad_lanes_mult32, problem_bounds_free
+    from karpenter_tpu.ops.relax import _relax_impl
+
+    if problem is None:
+        problem = build_census_problem(claim_slots=C)
+    bounds_free = problem_bounds_free(problem)
+    problem = jax.device_put(problem)
+    padded = _pad_lanes_mult32(problem)
+    jaxpr = jax.make_jaxpr(lambda p: _relax_impl(p, C, bounds_free, passes))(
+        padded
+    )
+    return _count_jaxpr_eqns(jaxpr)
+
+
 def _count_hlo_ops(text: str):
     """(entry_ops, total_ops) over an HLO text dump. Post-optimization each
     ENTRY instruction is roughly one kernel launch (fusions count once)."""
@@ -221,6 +244,9 @@ def main(argv):
           f"K={problem.num_keys} V={problem.num_lanes} C={C})")
     print(f"  jaxpr_eqns           = {eqns}")
     print(f"  jaxpr_eqns_wavefront = {wave_eqns}  (3 extra lanes)")
+    relax_eqns = relax_jaxpr_eqns(problem, C)
+    print(f"  jaxpr_eqns_relax     = {relax_eqns}  (whole phase-1 program, "
+          f"2 rounding passes)")
     if not quick:
         entry, total = narrow_hlo_ops(problem, C)
         print(f"  hlo_entry_ops  = {entry}")

@@ -68,6 +68,17 @@ DEFAULT_BANDS = {
     # exec-to-answer with AOT restore + journal on (bench.py restart
     # scenario). Old rows simply lack the field and the gate skips it.
     "restart_recovery_s": (LOWER_BETTER, 3.0),
+    # round-15 two-phase solve (KARPENTER_TPU_RELAX=1 runs): the relaxed 10k
+    # solve gates against its OWN window — a relax run and a pure-FFD run
+    # are different modes and must not share solve_10k_s's baseline. Band is
+    # 3x (not 4x): the two-phase number is steadier than the seed window's
+    # heterogeneous pure-FFD trajectory. The first flag-on run seeds the
+    # window (flag-off rows lack the column, so the gate skips it there).
+    "solve_10k_relax_s": (LOWER_BETTER, 3.0),
+    # phase-1 coverage must not silently collapse: losing rounding coverage
+    # pushes pods back into the launch-bound repair loop, which is the exact
+    # regression the two-phase solve exists to avoid
+    "relax_placed_frac": (HIGHER_BETTER, 2.0),
 }
 
 # absolute ceiling for the --smoke tiny-shape solve (steady-state, post
@@ -101,6 +112,12 @@ def row_from_bench(out: dict, label: str = "run") -> dict:
         # pass's cost as a fraction of solve wall (acceptance: <= 0.05)
         "unschedulable_reasons": out.get("unschedulable_reasons"),
         "explain_overhead_frac": out.get("explain_overhead_frac"),
+        # schema v2, round 15: two-phase solve columns — present only on
+        # KARPENTER_TPU_RELAX=1 runs (bench.py per_shape_relax aggregation)
+        "relax_placed_frac": out.get("relax_placed_frac"),
+        "repair_iterations": out.get("repair_iterations"),
+        "relax_phase_s": out.get("relax_phase_s"),
+        "solve_10k_relax_s": out.get("solve_10k_relax_s"),
         "error": out.get("error"),
     }
     row.update({k: v for k, v in optional.items() if v is not None})
