@@ -216,6 +216,21 @@ RELAX_FALLBACK = REGISTRY.counter(
     "the full-level validator rejected the relaxed result",
 )
 
+# -- verification gate series (verify/, KARPENTER_TPU_DEVICE_GATE) ------------
+GATE_DURATION = REGISTRY.histogram(
+    "solver_gate_duration_seconds",
+    "Placement verification gate wall time, by mode (device = jitted "
+    "invariant program incl. host structural screen, host = full float64 "
+    "validator, incremental = row-scoped streaming re-check, audit = "
+    "sampled float64 spot-check)",
+)
+GATE_AUDIT = REGISTRY.counter(
+    "solver_gate_audit_total",
+    "Float64 audits of device-gate verdicts, by outcome (match / mismatch "
+    "on sampled rows of accepted results; reject_confirmed / "
+    "reject_overturned for host confirmation of device rejections)",
+)
+
 # -- solve-cycle tracing series (obs/trace.py, solver/jax_backend.py) ---------
 SOLVER_PHASE_DURATION = REGISTRY.histogram(
     "solver_phase_duration_seconds",

@@ -160,6 +160,20 @@ def _call_spec(solve_name: str, problem, max_claims: int, init) -> Optional[_Spe
             (problem,),
             (f"C{int(max_claims)}", f"bf{int(bf)}", f"rp{int(rp)}"),
         )
+    if solve_name == "verify_gate":
+        # the device verification program (verify/device.py): ``problem`` is
+        # a GateProblem view and ``init`` carries (GateArgs, bounds_free) —
+        # the caller computed bounds_free from the gate's own tensors (plus
+        # the published claim rows), so respect it rather than rederiving
+        from karpenter_tpu.verify.device import _gate_jit
+
+        ga, bf = init
+        return _Spec(
+            _gate_jit,
+            (problem, ga, bool(bf)),
+            (problem, ga),
+            (f"C{int(max_claims)}", f"bf{int(bf)}", "gate"),
+        )
     if solve_name == "solve_ffd":
         from karpenter_tpu.ops.ffd_step import _solve_ffd_fresh_jit, _solve_ffd_jit
 

@@ -46,6 +46,13 @@ class SolveResult:
     # obs/explain.ExplainReport decision provenance (KARPENTER_TPU_EXPLAIN
     # only; None when the flag is off or the backend doesn't attribute)
     explain: Optional[object] = None
+    # verify.GateContext stashed by single-pass jax solves: the padded
+    # problem + meta this result decoded from, which the device-side
+    # verification gate (verify/) re-reads. None from the oracle backend,
+    # multi-pass relax-ladder solves, and any synthetic/stripped result —
+    # all of which the host validator handles as before. Excluded from
+    # equality/repr: it is provenance, not part of the placement.
+    verify_ctx: Optional[object] = field(default=None, compare=False, repr=False)
 
     def num_scheduled(self) -> int:
         return sum(len(c.pod_indices) for c in self.new_claims) + sum(

@@ -299,10 +299,14 @@ class JaxSolver(SolverBackend):
                     # validator-equivalent rather than bit-identical, so EVERY
                     # result the two-phase path produced is full-gated before
                     # it leaves the backend; a violation falls back to one
-                    # pure-FFD re-solve (the safe, parity-proven path)
-                    from karpenter_tpu.solver.validator import full_gate_relaxed
+                    # pure-FFD re-solve (the safe, parity-proven path). The
+                    # gate rides the device program when the result carries a
+                    # GateContext (verify/, KARPENTER_TPU_DEVICE_GATE) — the
+                    # change that makes relax-by-default affordable — and is
+                    # the host full_gate_relaxed otherwise.
+                    from karpenter_tpu.verify import gate_relaxed
 
-                    violations = full_gate_relaxed(
+                    violations = gate_relaxed(
                         result, pods, instance_types, templates, nodes,
                         pod_requirements_override, cluster_pods, domains,
                     )
@@ -871,4 +875,15 @@ class JaxSolver(SolverBackend):
             cycle=trace.current_trace_id(),
             donated_bytes=donated_total,
         )
+        if use_sweeps and meta is not None:
+            # single-pass solves hand the device gate (verify/) the exact
+            # padded tensors this result decoded from; multi-pass ladders
+            # re-encode per pass (the final problem covers only the last
+            # queue) so they stay on the host validator
+            from karpenter_tpu import verify
+
+            out.verify_ctx = verify.make_context(
+                problem, meta, max_claims, len(pods),
+                pod_requirements_override is not None,
+            )
         return out

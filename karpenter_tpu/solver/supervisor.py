@@ -495,6 +495,13 @@ class SupervisedSolver(SolverBackend):
     ) -> List[val.Violation]:
         if self.validate_level == "off":
             return []
+        violations = self._device_gate(result, pods, instance_types, templates, kwargs)
+        if violations is not None:
+            for v in violations:
+                VALIDATOR_REJECTIONS.inc({"invariant": v.invariant})
+            if violations:
+                self.counters["validator_rejections"] += 1
+            return violations
         try:
             violations = val.validate_result(
                 result,
@@ -516,6 +523,40 @@ class SupervisedSolver(SolverBackend):
         if violations:
             self.counters["validator_rejections"] += 1
         return violations
+
+    def _device_gate(
+        self, result, pods, instance_types, templates, kwargs
+    ) -> Optional[List[val.Violation]]:
+        """Try the device-side verification gate (verify/) before the host
+        validator. Returns the canonical violation list when the gate owned
+        the verdict, or None when it is off/not applicable (no verify_ctx,
+        shape mismatch, gate crash) so the host path keeps the cycle.
+
+        When the gate engages, verification runs at FULL rigor regardless of
+        validate_level: a device accept is sound against the full host gate
+        (the device predicates are tolerance-tighter), and a device reject is
+        host-confirmed at full level before anything is stripped — so the
+        level knob only governs the fallback host path's cost.
+        """
+        from karpenter_tpu import verify
+
+        if not verify.enabled():
+            return None
+        if getattr(result, "verify_ctx", None) is None:
+            return None
+        outcome = verify.full_gate(
+            result,
+            pods,
+            instance_types,
+            templates,
+            nodes=kwargs["nodes"],
+            pod_requirements_override=kwargs["pod_requirements_override"],
+            cluster_pods=kwargs["cluster_pods"],
+            domains=kwargs["domains"],
+        )
+        if outcome is None:
+            return None
+        return list(outcome.violations)
 
     def _reset_streaming(self) -> None:
         """A rejected result must never seed the next warm solve: drop the

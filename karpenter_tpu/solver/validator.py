@@ -118,6 +118,24 @@ def _hard_taints(taints: Taints) -> Taints:
     return Taints(t for t in taints if t.effect in (NO_SCHEDULE, NO_EXECUTE))
 
 
+def checked_requirements(pod: Pod) -> Optional[Requirements]:
+    """A placed pod's label requirements, when they are provably still in
+    force: relaxation may have legally dropped affinity terms, so relaxable
+    pods are skipped unless an override pins them. Pods with no node selector
+    and no node affinity have empty requirements — trivially intersecting —
+    and skip the recompute entirely (the common case on large batches; this
+    keeps the fast gate sub-0.5% of a 10k solve). Module-level so the device
+    gate (verify/gate.py) derives its pod_check mask from the same predicate
+    the host intersection checks use."""
+    if not pod.spec.node_selector:
+        aff = pod.spec.affinity
+        if aff is None or aff.node_affinity is None:
+            return None
+    if Preferences.is_relaxable(pod):
+        return None
+    return pod_requirements(pod)
+
+
 def _port_clashes(pods_ports: List[Tuple[int, list]], pre_used: list) -> List[str]:
     errs = []
     used = [(None, p) for p in pre_used]
@@ -143,13 +161,25 @@ def validate_result(
     cluster_pods: Sequence = (),
     domains: Optional[Dict[str, set]] = None,
     level: str = "fast",
+    *,
+    claim_scope: Optional[set] = None,
+    node_scope: Optional[set] = None,
+    check_topology: bool = True,
 ) -> List[Violation]:
+    """``claim_scope`` / ``node_scope`` / ``check_topology`` scope the check
+    to a row subset (verify/ incremental re-checks and the sampled float64
+    audit): None means every bin, a set restricts the per-claim / per-node
+    loops to those claim indices / node names. Pod accounting always runs —
+    it is the cross-bin invariant scoping cannot localize. Defaults keep the
+    historical full-surface behavior bit-for-bit."""
     from karpenter_tpu.obs import trace
 
     with trace.span("validate", level=level) as sp:
         violations = _validate_result(
             result, pods, instance_types, templates, nodes,
             pod_requirements_override, cluster_pods, domains, level,
+            claim_scope=claim_scope, node_scope=node_scope,
+            check_topology=check_topology,
         )
         if sp is not None and violations:
             sp.count("violations", len(violations))
@@ -189,6 +219,10 @@ def _validate_result(
     cluster_pods: Sequence = (),
     domains: Optional[Dict[str, set]] = None,
     level: str = "fast",
+    *,
+    claim_scope: Optional[set] = None,
+    node_scope: Optional[set] = None,
+    check_topology: bool = True,
 ) -> List[Violation]:
     violations: List[Violation] = []
     node_by_name = {n.name: n for n in nodes}
@@ -236,25 +270,14 @@ def _validate_result(
         return violations  # downstream checks would index out of bounds
 
     def reqs_of(pi: int) -> Optional[Requirements]:
-        """A placed pod's label requirements, when they are provably still in
-        force: relaxation may have legally dropped affinity terms, so
-        relaxable pods are skipped unless an override pins them. Pods with no
-        node selector and no node affinity have empty requirements — trivially
-        intersecting — and skip the recompute entirely (the common case on
-        large batches; this keeps the fast gate sub-0.5% of a 10k solve)."""
         if pod_requirements_override is not None:
             return pod_requirements_override[pi]
-        pod = pods[pi]
-        if not pod.spec.node_selector:
-            aff = pod.spec.affinity
-            if aff is None or aff.node_affinity is None:
-                return None
-        if Preferences.is_relaxable(pod):
-            return None
-        return pod_requirements(pod)
+        return checked_requirements(pods[pi])
 
     # -- per-claim invariants -------------------------------------------------
     for ci, claim in enumerate(result.new_claims):
+        if claim_scope is not None and ci not in claim_scope:
+            continue
         if not 0 <= claim.template_index < len(templates):
             violations.append(
                 Violation(
@@ -395,6 +418,8 @@ def _validate_result(
 
     # -- existing-node invariants ---------------------------------------------
     for name, indices in result.node_pods.items():
+        if node_scope is not None and name not in node_scope:
+            continue
         node = node_by_name.get(name)
         if node is None:
             violations.append(
@@ -451,7 +476,7 @@ def _validate_result(
                     )
                 )
 
-    if level == "full":
+    if level == "full" and check_topology:
         violations.extend(
             _check_topology_skew(
                 result, pods, instance_types, templates, nodes,
@@ -508,7 +533,13 @@ def _check_topology_skew(
         for pi in indices:
             placed_reqs[pi] = node.requirements
 
-    # group constraints by (key, skew, selector identity)
+    # group constraints by (key, skew, selector CONTENT): every cohort pod
+    # carries its own constraint instance, so an identity dedup would rescan
+    # the same O(P) cohort once per member — quadratic on spread-heavy mixes.
+    # The check depends only on the constraint's content, so content-equal
+    # signatures are one class and one scan.
+    from karpenter_tpu.provisioning.topology import _selector_key
+
     checked = set()
     for pi, pod in enumerate(pods):
         for tsc in pod.spec.topology_spread_constraints or ():
@@ -517,7 +548,7 @@ def _check_topology_skew(
             key = tsc.topology_key
             if key == wk.LABEL_HOSTNAME or key not in domains:
                 continue
-            sig = (key, tsc.max_skew, id(tsc.label_selector))
+            sig = (key, tsc.max_skew, _selector_key(tsc.label_selector))
             if sig in checked:
                 continue
             checked.add(sig)
@@ -581,12 +612,16 @@ def _check_topology_skew(
                 continue
             skew = max(counts.values()) - min(counts.values())
             if skew > tsc.max_skew:
+                # pin the whole cohort: the content dedup reports each class
+                # once, and strip_violations must still requeue every bin the
+                # cohort occupies (the identity dedup used to reach them via
+                # one violation per member)
                 violations.append(
                     Violation(
                         "topology-skew",
                         f"key {key}: domain counts {counts} skew {skew} > "
                         f"max_skew {tsc.max_skew}",
-                        pod_indices=(pi,),
+                        pod_indices=tuple(cohort),
                     )
                 )
     return violations

@@ -25,7 +25,9 @@ so the relaxation program (ops/relax.py) and the carried repair sweeps
 compile — and AOT-serialize/restore (solver/aot.py) — at the SAME pod and
 claim buckets as the narrow step; with it off, the warms compile the plain
 sweeps program instead, so a mismatched server recompiles on first contact
-either way.
+either way. With ``KARPENTER_TPU_DEVICE_GATE`` on (the default), each warm
+solve additionally drives the device verification gate (verify/), so the
+gate program compiles and AOT-serializes at the same buckets too.
 """
 
 from __future__ import annotations
@@ -110,7 +112,7 @@ def prewarm_claim_buckets(
                 # the ladder ascends, so pinning claim_slots selects bucket c
                 # exactly (the backend caps at claim_axis_bucket(len(pods)) == c)
                 solver.claim_slots = c
-                solver.solve(pods, its, [tpl])
+                _warm_gate(solver.solve(pods, its, [tpl]), pods, its, [tpl])
                 warmed += 1
             except Exception:
                 return warmed
@@ -201,17 +203,34 @@ def prewarm_solver(
         for n in buckets:
             for topo in (False, True):
                 try:
-                    solver.solve(make(n, topo), its, [tpl])
+                    pods = make(n, topo)
+                    _warm_gate(solver.solve(pods, its, [tpl]), pods, its, [tpl])
                     solved += 1
                 except Exception:
                     return solved
         for n in ladder:
             try:
-                solver.solve(make(n, True), its, [tpl])
+                pods = make(n, True)
+                _warm_gate(solver.solve(pods, its, [tpl]), pods, its, [tpl])
                 solved += 1
             except Exception:
                 return solved
     return solved
+
+
+def _warm_gate(result, pods, its, tpls) -> None:
+    """Drive the device verification gate over a warm solve result so its
+    program compiles (and AOT-serializes) at the same pod/claim buckets the
+    solve itself warmed — the gate is on the serving hot path whenever
+    KARPENTER_TPU_DEVICE_GATE is on. Failures are swallowed like every other
+    warm step."""
+    try:
+        from karpenter_tpu import verify
+
+        if verify.enabled() and getattr(result, "verify_ctx", None) is not None:
+            verify.full_gate(result, pods, its, tpls)
+    except Exception:
+        pass
 
 
 def prewarm_screen(n_candidates: int) -> bool:

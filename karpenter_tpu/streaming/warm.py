@@ -341,7 +341,8 @@ class StreamingSolver(SolverBackend):
         self, prev, delta, pods, pod_digests, instance_types, templates, nodes
     ):
         """Returns (result, seed_indices, certified_uids) or None when the
-        merged result fails the validator full gate."""
+        merged result fails the exit gate (incremental row-scoped check when
+        KARPENTER_TPU_DEVICE_GATE is on, full validator otherwise)."""
         uid_index = {p.uid: i for i, p in enumerate(pods)}
         removed_node_names = set(delta.removed_nodes)
 
@@ -532,9 +533,42 @@ class StreamingSolver(SolverBackend):
                 return None
             pl.instance_type_indices = surviving
 
-        violations = val.validate_result(
-            merged, pods, instance_types, templates, nodes=nodes, level="full"
-        )
+        from karpenter_tpu import verify
+
+        if verify.enabled():
+            # re-gate only what this merge touched: sub-solve claims, reused
+            # claims the fold-back joined (re-narrowed), and nodes that
+            # received pods. Untouched reused pins were proven when the
+            # previous result was accepted and their pods' digests are
+            # unchanged; the incremental gate still rides a seeded audit
+            # sample of them each cycle. Topology skew re-runs whenever any
+            # seed carries a spread constraint — the topology closure above
+            # guarantees skew cohorts are then entirely inside the seed set.
+            n_sub = len(sub_result.new_claims)
+            touched_claims = set(
+                range(len(merged.new_claims) - n_sub, len(merged.new_claims))
+            )
+            touched_claims |= {claim_index_map[ci] for ci in joined}
+            scope = verify.IncrementalScope(
+                claim_indices=touched_claims,
+                node_names={
+                    name
+                    for name in sub_result.node_pods
+                    if not name.startswith(_WARM_CLAIM_PREFIX)
+                },
+                check_topology=any(
+                    _has_topology_constraints(pods[i]) for i in seeds
+                ),
+                total_claims=len(merged.new_claims),
+                total_nodes=len(nodes),
+            )
+            violations = verify.incremental_gate(
+                merged, pods, instance_types, templates, nodes, scope
+            )
+        else:
+            violations = val.validate_result(
+                merged, pods, instance_types, templates, nodes=nodes, level="full"
+            )
         if violations:
             return None
 

@@ -1,0 +1,54 @@
+"""Device-side verification subsystem (KARPENTER_TPU_DEVICE_GATE).
+
+Round 15 measured the cost of trust: the host-side full-level validator gate
+is 7.2 s at 10k pods — more than the relaxation phase it certifies saves —
+so `KARPENTER_TPU_RELAX` shipped OFF and every streaming warm re-solve paid
+a full-level recheck of placements that never moved. This package re-expresses
+the full-level invariants as jitted tensor reductions over the decoded
+placement (verify/device.py), reusing the exact predicate kernels the solver
+already gates with (ops/masks.py, ops/ffd_core._make_it_gate), and layers two
+host-side escape hatches on top:
+
+  incremental checker   (verify/incremental.py) re-verifies only the bins
+        touched since the last accepted result — the streaming DeltaEncoder
+        already knows which rows churned, and the warm-solve fold-back knows
+        which bins the sub-solve produced.
+  sampled float64 audit (verify/gate.py) keeps solver/validator.py as ground
+        truth on a seeded random row subset every cycle
+        (KARPENTER_TPU_VERIFY_AUDIT_FRAC) and on EVERY device-gate rejection:
+        a device reject is confirmed by the full host gate before anyone
+        quarantines a backend, so a device-gate bug costs latency, never a
+        wrong accept or a wrong reject.
+
+Safety argument (why accept-side trust is sound): every device predicate is
+equal to or strictly TIGHTER than its host float64 twin — masks.fits uses
+eps = 1e-6 + 1e-6|avail| where the host's _fits_loose allows
+1e-6 + 1e-4|avail|, and the toleration rows encode ALL taints where the host
+checks only hard ones — so device-accept implies host-accept up to float32
+accumulation noise (which the sampled audit watches), and device-reject is
+always host-confirmed. tests/test_verify.py fuzzes the verdict parity on the
+hand-corrupted corpora from tests/test_validator.py.
+"""
+
+from karpenter_tpu.verify.gate import (
+    GateContext,
+    GateOutcome,
+    audit_frac,
+    enabled,
+    full_gate,
+    gate_relaxed,
+    make_context,
+)
+from karpenter_tpu.verify.incremental import IncrementalScope, incremental_gate
+
+__all__ = [
+    "GateContext",
+    "GateOutcome",
+    "IncrementalScope",
+    "audit_frac",
+    "enabled",
+    "full_gate",
+    "gate_relaxed",
+    "incremental_gate",
+    "make_context",
+]

@@ -1,0 +1,313 @@
+"""Jitted invariant gate: the full-level validator as tensor reductions.
+
+One program re-checks a decoded placement against the SAME padded problem
+tensors the solve consumed, re-using the solver's own predicate kernels
+(masks.fits / packed_pairwise_compat / has_offering via ffd_core._make_it_gate)
+so the gate is largely a reduction over masks the encode already built. The
+program sees the placement as one flat assignment vector: ``pod_bin[r]`` maps
+problem row r to its bin — a claim slot (0..C-1), an existing node (C..C+N-1),
+or -1 for failed/unplaced rows — plus per-claim tensors describing what the
+result PUBLISHED (reported requests, listed instance types, narrowed
+requirements re-encoded through the meta vocab). Verifying published data,
+not solver internals, is the point: a decode bug upstream still trips the
+gate.
+
+Invariants covered on-device (indices into the returned count vector follow
+``INVARIANTS``): claim-requests, claim-capacity, instance-type-survivor,
+taint-admissibility, host-port, requirement-intersection, node-capacity.
+Pod accounting, structural claim checks (template/empty/instance-type index
+ranges), node-unknown, NaN screening, and topology-skew stay host-side in
+verify/gate.py — they are O(P) python or need exact float64/cohort semantics.
+
+Tolerance direction (the safety contract): every device predicate here is
+equal to or TIGHTER than its host float64 twin. masks.fits allows
+eps = 1e-6 + 1e-6|avail| where the host _fits_loose allows 1e-6 + 1e-4|avail|;
+pod_tol_* rows encode ALL taints where the host checks hard taints only.
+Tighter means device-accept ⇒ host-accept (sound fast-accept), and any
+device-reject is host-confirmed by the caller before it can strip or
+quarantine anything.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from karpenter_tpu.models.problem import ReqTensor, SchedulingProblem
+from karpenter_tpu.ops import masks
+from karpenter_tpu.ops.ffd_core import _make_it_gate, _offer_rows, _statics
+
+# Count-vector lane order; gate.py maps nonzero lanes back to host Violation
+# invariant names when building the reject report.
+INVARIANTS = (
+    "claim-requests",
+    "claim-capacity",
+    "instance-type-survivor",
+    "taint-admissibility",
+    "host-port",
+    "requirement-intersection",
+    "node-capacity",
+)
+
+# Host validator tolerances for the claim-requests equality check (the one
+# device predicate that is an equality, not a one-sided fit — same REL/ABS as
+# validator._close so float32 drift is the only divergence, and the sampled
+# audit owns that).
+_REL_TOL = 1e-4
+_ABS_TOL = 1e-6
+
+
+class GateProblem(NamedTuple):
+    """The subset of SchedulingProblem the gate program reads, as a pytree.
+
+    A trimmed view rather than the full problem so the dispatch does not
+    ship solve-only tensors (pod_strict_reqs, topology groups, run tables)
+    to the device; field names match SchedulingProblem because
+    ffd_core._statics/_make_it_gate/_offer_rows duck-type their argument.
+    """
+
+    lane_valid: Any  # bool[K, V]
+    lane_numeric: Any  # f32[K, V]
+    key_wellknown: Any  # bool[K]
+    pod_reqs: ReqTensor  # [P]
+    pod_requests: Any  # f32[P, R] (includes PODS lane, see encode)
+    pod_tol_tpl: Any  # bool[P, TPL] True = NOT tolerated
+    pod_tol_node: Any  # bool[P, N] True = NOT tolerated
+    pod_ports: Any  # bool[P, PT]
+    pod_port_conflict: Any  # bool[P, PT]
+    it_reqs: ReqTensor  # [T]
+    it_alloc: Any  # f32[T, R]
+    offer_zone: Any  # i32[T, O]
+    offer_ct: Any  # i32[T, O]
+    offer_ok: Any  # bool[T, O]
+    offer_zc: Optional[Any]  # bool[T, Zb, Cb] or None
+    tpl_reqs: ReqTensor  # [TPL]
+    tpl_overhead: Any  # f32[TPL, R]
+    node_reqs: ReqTensor  # [N]
+    node_avail: Any  # f32[N, R]
+    node_overhead: Any  # f32[N, R]
+    node_used_ports: Any  # bool[N, PT]
+
+
+def gate_problem(problem: SchedulingProblem) -> GateProblem:
+    """Project a (lane-padded) SchedulingProblem onto the gate's field set."""
+    return GateProblem(
+        lane_valid=problem.lane_valid,
+        lane_numeric=problem.lane_numeric,
+        key_wellknown=problem.key_wellknown,
+        pod_reqs=problem.pod_reqs,
+        pod_requests=problem.pod_requests,
+        pod_tol_tpl=problem.pod_tol_tpl,
+        pod_tol_node=problem.pod_tol_node,
+        pod_ports=problem.pod_ports,
+        pod_port_conflict=problem.pod_port_conflict,
+        it_reqs=problem.it_reqs,
+        it_alloc=problem.it_alloc,
+        offer_zone=problem.offer_zone,
+        offer_ct=problem.offer_ct,
+        offer_ok=problem.offer_ok,
+        offer_zc=problem.offer_zc,
+        tpl_reqs=problem.tpl_reqs,
+        tpl_overhead=problem.tpl_overhead,
+        node_reqs=problem.node_reqs,
+        node_avail=problem.node_avail,
+        node_overhead=problem.node_overhead,
+        node_used_ports=problem.node_used_ports,
+    )
+
+
+class GateArgs(NamedTuple):
+    """Per-result tensors describing the decoded placement under test."""
+
+    claim_req: ReqTensor  # [C] published claim requirements (meta vocab)
+    claim_tpl: Any  # i32[C] template index per claim slot
+    claim_active: Any  # bool[C]
+    claim_reported: Any  # f32[C, R] densified claim.requests
+    claim_its: Any  # bool[C, T] listed instance types
+    claim_has_reqs: Any  # bool[C] claim.requirements was not None
+    pod_bin: Any  # i32[P] claim 0..C-1 / node C..C+N-1 / -1 unplaced
+    pod_check: Any  # bool[P] host reqs_of() would be non-None
+
+
+def _gate_impl(gp: GateProblem, ga: GateArgs, bounds_free: bool) -> jnp.ndarray:
+    """i32[len(INVARIANTS)] violation counts; all-zero means device-accept."""
+    P, R = gp.pod_requests.shape
+    C = ga.claim_tpl.shape[0]
+    N = gp.node_avail.shape[0]
+    TPL = gp.tpl_overhead.shape[0]
+    statics = _statics(gp, bounds_free)
+
+    on_claim = (ga.pod_bin >= 0) & (ga.pod_bin < C)
+    on_node = (ga.pod_bin >= C) & (ga.pod_bin < C + N)
+    placed = on_claim | on_node
+    # scatter targets: out-of-range sentinel rows are dropped, not wrapped
+    ci = jnp.where(on_claim, ga.pod_bin, C)  # [P] -> claims, C drops
+    ni = jnp.where(on_node, ga.pod_bin - C, N)  # [P] -> nodes, N drops
+    ci_safe = jnp.clip(ci, 0, jnp.maximum(C - 1, 0))
+
+    # -- claim-requests: published requests must equal template overhead plus
+    # the placed pods' request rows (validator recomputes the same merge)
+    summed = jnp.zeros((C, R), dtype=jnp.float32).at[ci].add(
+        gp.pod_requests, mode="drop"
+    )
+    tpl_safe = jnp.clip(ga.claim_tpl, 0, max(TPL - 1, 0))
+    expected = summed + jnp.where(
+        ga.claim_active[:, None], gp.tpl_overhead[tpl_safe], 0.0
+    )
+    err = jnp.abs(expected - ga.claim_reported)
+    tol = _ABS_TOL + _REL_TOL * jnp.maximum(
+        jnp.abs(expected), jnp.abs(ga.claim_reported)
+    )
+    bad_requests = ga.claim_active & jnp.any(err > tol, axis=-1)
+
+    # -- claim-capacity: some listed instance type must fit the recomputed
+    # totals (empty instance-type lists are a host-side structural check)
+    fit_ct = masks.fits(expected[:, None, :], gp.it_alloc[None, :, :])  # [C, T]
+    any_listed = jnp.any(ga.claim_its, axis=-1)
+    bad_capacity = (
+        ga.claim_active & any_listed & ~jnp.any(ga.claim_its & fit_ct, axis=-1)
+    )
+
+    # -- instance-type-survivor (full level): every LISTED instance type must
+    # survive the published requirements — compat x fits x offering, the same
+    # three-way product the solver's it_gate applies while packing
+    it_gate = _make_it_gate(gp, statics)
+    ok_it = it_gate(ga.claim_req, expected, jnp.ones((C, gp.it_alloc.shape[0]), dtype=bool))
+    bad_survivor = (
+        ga.claim_active
+        & ga.claim_has_reqs
+        & jnp.any(ga.claim_its & ~ok_it, axis=-1)
+    )
+
+    # -- taint-admissibility: pod_tol_* rows are True where the pod TOLERATES
+    # the template/node (encode builds them as `not taints.tolerates(rep)`
+    # inverted per class; covers all taints where the host checks hard taints
+    # only -> device tighter, accept-side safe)
+    tpl_of_pod = jnp.clip(ga.claim_tpl[ci_safe], 0, max(TPL - 1, 0))
+    bad_taint_claim = on_claim & ~gp.pod_tol_tpl[jnp.arange(P), tpl_of_pod]
+    if N:
+        ni_safe = jnp.clip(ni, 0, N - 1)
+        bad_taint_node = on_node & ~gp.pod_tol_node[jnp.arange(P), ni_safe]
+    else:
+        bad_taint_node = jnp.zeros((P,), dtype=bool)
+    taint_count = jnp.sum(bad_taint_claim) + jnp.sum(bad_taint_node)
+
+    # -- host-port: a pod's conflict lanes must not be used by any OTHER pod
+    # in its bin, nor pre-used by its node (validator._port_clashes likewise
+    # never flags a pod against its own port list)
+    PT = gp.pod_ports.shape[1]
+    B = C + N
+    bidx = jnp.where(placed, ga.pod_bin, B)
+    ports_i = gp.pod_ports.astype(jnp.int32)
+    cnt = jnp.zeros((B, PT), dtype=jnp.int32).at[bidx].add(ports_i, mode="drop")
+    if N:
+        pre = jnp.concatenate(
+            [jnp.zeros((C, PT), dtype=jnp.int32), gp.node_used_ports.astype(jnp.int32)]
+        )
+    else:
+        pre = jnp.zeros((B, PT), dtype=jnp.int32)
+    bidx_safe = jnp.clip(bidx, 0, B - 1)
+    others = cnt[bidx_safe] - ports_i + pre[bidx_safe]  # [P, PT]
+    bad_port = placed & jnp.any(gp.pod_port_conflict & (others > 0), axis=-1)
+
+    # -- requirement-intersection: each checked pod's requirement row must
+    # intersect its bin's published/narrowed row. Packed lanes keep the
+    # gathered per-pod rows at uint32[P, K, W] instead of bool[P, K, V].
+    lv, ln = statics.lv, statics.ln
+    pod_packed = masks.pack_req(gp.pod_reqs, lv, ln, bounds_free)
+    claim_packed = masks.pack_req(ga.claim_req, lv, ln, bounds_free)
+    if N:
+        node_packed = masks.pack_req(gp.node_reqs, lv, ln, bounds_free)
+        bin_packed = jnp.concatenate([claim_packed, node_packed])
+    else:
+        bin_packed = claim_packed
+    ok_int = masks.packed_intersects_ok(
+        bin_packed[bidx_safe], pod_packed, bounds_free
+    )  # [P]
+    claim_side = on_claim & ga.claim_has_reqs[ci_safe]
+    bad_intersect = ga.pod_check & (claim_side | on_node) & ~ok_int
+
+    # -- node-capacity: daemon overhead plus landed pods fits availability,
+    # checked only for nodes that received pods this round (host semantics)
+    if N:
+        nsum = jnp.zeros((N, R), dtype=jnp.float32).at[ni].add(
+            gp.pod_requests, mode="drop"
+        )
+        got = jnp.zeros((N,), dtype=jnp.int32).at[ni].add(1, mode="drop") > 0
+        bad_node = got & ~masks.fits(gp.node_overhead + nsum, gp.node_avail)
+        node_count = jnp.sum(bad_node)
+    else:
+        node_count = jnp.asarray(0, dtype=jnp.int32)
+
+    return jnp.stack(
+        [
+            jnp.sum(bad_requests),
+            jnp.sum(bad_capacity),
+            jnp.sum(bad_survivor),
+            taint_count,
+            jnp.sum(bad_port),
+            jnp.sum(bad_intersect),
+            node_count,
+        ]
+    ).astype(jnp.int32)
+
+
+# positional statics so aot._call_spec can .lower(gp, ga, bf) the same way
+# it calls: static_argnums, not static_argnames
+_gate_jit = jax.jit(_gate_impl, static_argnums=(2,))
+
+
+def verify_gate(gp: GateProblem, ga: GateArgs, bounds_free: bool) -> jnp.ndarray:
+    """Jitted entry point; name is the program-registry / AOT call-spec key."""
+    return _gate_jit(gp, ga, bounds_free)
+
+
+def gate_bounds_free(gp: GateProblem) -> bool:
+    """Host-side bounds-free screen over exactly the gate's requirement
+    tensors (mirrors ffd_core.problem_bounds_free, minus solve-only fields).
+    The claim rows under test start from the same vocab and cannot introduce
+    bounds the sources lack — but gate.py still demotes to bounds_free=False
+    when a published claim row carries one."""
+    import numpy as np
+
+    from karpenter_tpu.models.problem import GT_NONE, LT_NONE
+    from karpenter_tpu.ops.ffd_core import _GATE_DIET
+
+    if not _GATE_DIET:
+        return False
+    for r in (gp.pod_reqs, gp.it_reqs, gp.tpl_reqs, gp.node_reqs):
+        gt, lt = np.asarray(r.gt), np.asarray(r.lt)
+        if gt.size and (np.any(gt != GT_NONE) or np.any(lt != LT_NONE)):
+            return False
+    return True
+
+
+def dummy_gate_args(gp: GateProblem, max_claims: int) -> GateArgs:
+    """Shape-correct all-inactive GateArgs for AOT lowering and census: the
+    same bucketed axes a real dispatch uses, with every mask cleared so the
+    lowered program is byte-identical to production for the shape family."""
+    import numpy as np
+
+    lv = np.asarray(gp.lane_valid)
+    K, V = lv.shape
+    P, R = np.asarray(gp.pod_requests).shape
+    T = np.asarray(gp.it_alloc).shape[0]
+    C = int(max_claims)
+    return GateArgs(
+        claim_req=ReqTensor(
+            admitted=np.broadcast_to(lv, (C, K, V)).copy(),
+            comp=np.ones((C, K), dtype=bool),
+            gt=np.full((C, K), -(2**31) + 1, dtype=np.int32),
+            lt=np.full((C, K), 2**31 - 1, dtype=np.int32),
+            defined=np.zeros((C, K), dtype=bool),
+        ),
+        claim_tpl=np.zeros((C,), dtype=np.int32),
+        claim_active=np.zeros((C,), dtype=bool),
+        claim_reported=np.zeros((C, R), dtype=np.float32),
+        claim_its=np.zeros((C, T), dtype=bool),
+        claim_has_reqs=np.zeros((C,), dtype=bool),
+        pod_bin=np.full((P,), -1, dtype=np.int32),
+        pod_check=np.zeros((P,), dtype=bool),
+    )

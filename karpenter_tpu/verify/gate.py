@@ -1,0 +1,520 @@
+"""Composite placement gate: device program + host screen + sampled audit.
+
+The flow for one result (``full_gate``):
+
+  1. host structural screen — O(P) python over the decoded result: pod
+     accounting, index ranges, NaN, claim template/empty/instance-type
+     structure, node names, request keys outside the encoded resource axis.
+     These are exactly the checks a tensor program cannot express (they
+     guard whether the placement can even be mapped onto the problem axes).
+  2. device invariant program (verify/device.py) — one jitted reduction over
+     the SAME padded problem tensors the solve consumed (stashed on the
+     result as a GateContext by solver/jax_backend.py), re-checking the
+     published claims/placements: claim-requests, claim-capacity,
+     instance-type-survivor, taints, host-ports, requirement intersection,
+     node-capacity.
+  3. host topology-skew check — cheap after the validator's content-keyed
+     cohort dedup, and it needs exact python cohort semantics, so it stays
+     on the host.
+  4. sampled float64 audit — a seeded random subset of claims/nodes re-run
+     through solver/validator.py at full level every cycle
+     (KARPENTER_TPU_VERIFY_AUDIT_FRAC); solver/validator.py remains ground
+     truth, the device program is only ever an accelerator of it.
+
+Any reject signal — screen hit, nonzero device counts, skew violation, audit
+mismatch — routes through ONE confirmation: the full host validator runs and
+ITS violation list is returned (solver_gate_audit_total records
+reject_confirmed / reject_overturned). So a device-gate bug can cost a host
+re-validation, never a wrong accept or a wrong reject, and callers always
+strip/quarantine off canonical host Violations.
+
+``full_gate`` returns None whenever the device path cannot serve the call
+(flag off, no GateContext on the result, context/result mismatch, any
+internal error) — callers fall back to the host validator unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import random
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+log = logging.getLogger(__name__)
+
+_ABS_TOL = 1e-6
+_REL_TOL = 1e-4
+
+
+def enabled() -> bool:
+    """KARPENTER_TPU_DEVICE_GATE, default ON: the composite gate is
+    verdict-equivalent to the host validator by construction (tighter device
+    predicates + host confirmation of every reject), so there is no
+    correctness reason to leave the 7.2 s host gate on the hot path."""
+    return os.environ.get("KARPENTER_TPU_DEVICE_GATE", "1") not in ("", "0")
+
+
+def audit_frac() -> float:
+    """KARPENTER_TPU_VERIFY_AUDIT_FRAC: per-cycle probability each accepted
+    bin is re-checked by the float64 host validator. Clamped to [0, 1]."""
+    raw = os.environ.get("KARPENTER_TPU_VERIFY_AUDIT_FRAC", "0.05")
+    try:
+        return max(0.0, min(1.0, float(raw)))
+    except ValueError:
+        return 0.05
+
+
+def _audit_seed() -> int:
+    try:
+        return int(os.environ.get("KARPENTER_TPU_VERIFY_AUDIT_SEED", "0"))
+    except ValueError:
+        return 0
+
+
+# deterministic per-process audit cadence: (env seed, call ordinal) seeds the
+# sampler so a replayed cycle audits the same rows (restart journals replay
+# cycles in order) while successive cycles walk different subsets
+_audit_calls = 0
+
+
+def _audit_rng() -> random.Random:
+    global _audit_calls
+    _audit_calls += 1
+    return random.Random((_audit_seed() << 20) ^ _audit_calls)
+
+
+@dataclasses.dataclass
+class GateContext:
+    """Stashed by the jax backend on each single-pass (sweeps-mode) result:
+    the padded problem + meta the solve consumed, which the device program
+    re-reads so verification and solve see bit-identical tensors. Multi-pass
+    relax-ladder solves never attach one (their final encoded problem covers
+    only the last pass's queue), and non-jax backends know nothing of it —
+    both fall back to the host validator."""
+
+    problem: Any  # padded SchedulingProblem (host-side numpy)
+    meta: Any  # ProblemMeta
+    max_claims: int
+    num_pods: int
+    has_override: bool
+
+
+@dataclasses.dataclass
+class GateOutcome:
+    violations: List[Any]
+    mode: str  # "device" | "host-confirm"
+    counts: Dict[str, int] = dataclasses.field(default_factory=dict)
+    audited: bool = False
+    audit_outcome: Optional[str] = None
+
+
+def make_context(problem, meta, max_claims, num_pods, has_override) -> GateContext:
+    return GateContext(
+        problem=problem, meta=meta, max_claims=int(max_claims),
+        num_pods=int(num_pods), has_override=bool(has_override),
+    )
+
+
+def full_gate(
+    result,
+    pods: Sequence,
+    instance_types: Sequence,
+    templates: Sequence,
+    nodes: Sequence = (),
+    pod_requirements_override=None,
+    cluster_pods: Sequence = (),
+    domains=None,
+) -> Optional[GateOutcome]:
+    """Full-level verdict on ``result``, or None when the device path cannot
+    serve it (caller then runs the host validator as before)."""
+    if not enabled():
+        return None
+    ctx = getattr(result, "verify_ctx", None)
+    if ctx is None or ctx.num_pods != len(pods):
+        return None
+    if len(result.new_claims) > ctx.max_claims:
+        return None
+    if ctx.has_override != (pod_requirements_override is not None):
+        return None
+    from karpenter_tpu.metrics.registry import GATE_AUDIT, GATE_DURATION, measure
+    from karpenter_tpu.obs import trace
+
+    host_args = (
+        result, pods, instance_types, templates, nodes,
+        pod_requirements_override, cluster_pods, domains,
+    )
+    try:
+        with trace.span("gate") as sp, measure(GATE_DURATION, {"mode": "device"}):
+            reject = _screen(result, pods, templates, instance_types, nodes, ctx)
+            counts: Dict[str, int] = {}
+            if reject is None:
+                counts = _device_counts(
+                    ctx, result, pods, pod_requirements_override
+                )
+                if counts:
+                    reject = "device:" + ",".join(sorted(counts))
+            if reject is None:
+                skew = _skew_check(*host_args)
+                if skew:
+                    reject = "topology-skew"
+            if sp is not None and reject is not None:
+                sp.attrs["reject"] = reject
+    except Exception as exc:  # noqa: BLE001 — degrade to the host validator
+        log.warning(
+            "verify: device gate degraded to host validator: %s: %s",
+            type(exc).__name__, exc, exc_info=True,
+        )
+        return None
+
+    if reject is not None:
+        # every reject is host-confirmed before anyone acts on it: the
+        # canonical violation list (and hence strip/quarantine behavior)
+        # always comes from the float64 validator
+        violations = _host_full(*host_args)
+        GATE_AUDIT.inc(
+            {"outcome": "reject_confirmed" if violations else "reject_overturned"}
+        )
+        return GateOutcome(
+            violations=violations, mode="host-confirm", counts=counts,
+            audited=True,
+            audit_outcome="reject_confirmed" if violations else "reject_overturned",
+        )
+
+    outcome = GateOutcome(violations=[], mode="device", counts=counts)
+    audit = _maybe_audit(*host_args)
+    if audit is not None:
+        outcome.audited = True
+        if audit:
+            # float64 disagrees with the device accept on a sampled row:
+            # the full host gate governs this cycle
+            GATE_AUDIT.inc({"outcome": "mismatch"})
+            violations = _host_full(*host_args)
+            return GateOutcome(
+                violations=violations, mode="host-confirm", counts=counts,
+                audited=True, audit_outcome="mismatch",
+            )
+        GATE_AUDIT.inc({"outcome": "match"})
+        outcome.audit_outcome = "match"
+    return outcome
+
+
+def gate_relaxed(
+    result, pods, instance_types, templates, nodes=(),
+    pod_requirements_override=None, cluster_pods=(), domains=None,
+) -> List[Any]:
+    """The relax retry-loop gate (solver/jax_backend.py): composite verdict
+    when a GateContext is available, the host full_gate_relaxed otherwise."""
+    outcome = full_gate(
+        result, pods, instance_types, templates, nodes,
+        pod_requirements_override, cluster_pods, domains,
+    )
+    if outcome is not None:
+        return outcome.violations
+    from karpenter_tpu.solver.validator import full_gate_relaxed
+
+    return full_gate_relaxed(
+        result, pods, instance_types, templates, nodes,
+        pod_requirements_override, cluster_pods, domains,
+    )
+
+
+# -- host-side pieces ----------------------------------------------------------
+
+
+def _host_full(
+    result, pods, instance_types, templates, nodes,
+    pod_requirements_override, cluster_pods, domains,
+) -> List[Any]:
+    from karpenter_tpu.metrics.registry import GATE_DURATION, measure
+    from karpenter_tpu.solver.validator import validate_result
+
+    with measure(GATE_DURATION, {"mode": "host"}):
+        return validate_result(
+            result, pods, instance_types, templates, nodes,
+            pod_requirements_override, cluster_pods, domains, level="full",
+        )
+
+
+def _skew_check(
+    result, pods, instance_types, templates, nodes,
+    pod_requirements_override, cluster_pods, domains,
+) -> List[Any]:
+    from karpenter_tpu.solver.validator import _check_topology_skew
+
+    return _check_topology_skew(
+        result, pods, instance_types, templates, nodes,
+        pod_requirements_override, cluster_pods, domains,
+    )
+
+
+def _maybe_audit(
+    result, pods, instance_types, templates, nodes,
+    pod_requirements_override, cluster_pods, domains,
+) -> Optional[List[Any]]:
+    """Float64 spot-check of an accepted result: every claim/node is drawn
+    into the sample at audit_frac, and the sampled subset runs through the
+    host validator at full level (accounting always rides along — it is
+    O(P) and the one cross-bin invariant). Returns None when nothing was
+    sampled, else the sampled violations (empty = match)."""
+    frac = audit_frac()
+    if frac <= 0.0:
+        return None
+    rng = _audit_rng()
+    claim_scope = {
+        ci for ci in range(len(result.new_claims)) if rng.random() < frac
+    }
+    node_scope = {name for name in result.node_pods if rng.random() < frac}
+    if not claim_scope and not node_scope:
+        return None
+    from karpenter_tpu.metrics.registry import GATE_DURATION, measure
+    from karpenter_tpu.solver.validator import validate_result
+
+    with measure(GATE_DURATION, {"mode": "audit"}):
+        return validate_result(
+            result, pods, instance_types, templates, nodes,
+            pod_requirements_override, cluster_pods, domains, level="full",
+            claim_scope=claim_scope, node_scope=node_scope,
+            check_topology=False,
+        )
+
+
+def _screen(result, pods, templates, instance_types, nodes, ctx) -> Optional[str]:
+    """Structural host screen: returns a short reject reason, or None when
+    the placement is structurally sound and mappable onto the problem axes.
+    Detection only — the host validator produces the canonical violations on
+    the confirm path."""
+    meta = ctx.meta
+    num_pods = len(pods)
+    seen = set()
+
+    def account(pi) -> Optional[str]:
+        if not isinstance(pi, int) or not 0 <= pi < num_pods:
+            return "pod-range"
+        if pi in seen:
+            return "pod-duplicate"
+        seen.add(pi)
+        return None
+
+    res_index = {name: ri for ri, name in enumerate(meta.resource_names)}
+    for claim in result.new_claims:
+        if not 0 <= claim.template_index < len(templates):
+            return "claim-template"
+        if not claim.pod_indices:
+            return "claim-empty"
+        if not claim.instance_type_indices:
+            return "claim-instance-types"
+        for ti in claim.instance_type_indices:
+            if not 0 <= ti < len(instance_types):
+                return "claim-instance-types"
+        for key, value in claim.requests.items():
+            v = float(value)
+            if v != v or v in (float("inf"), float("-inf")):
+                return "nan"
+            if key not in res_index and abs(v) > _ABS_TOL + _REL_TOL * abs(v):
+                # a request on a resource the encode never saw cannot be
+                # checked on-device; nonzero means the host must arbitrate
+                return "resource-axis"
+        for pi in claim.pod_indices:
+            bad = account(pi)
+            if bad:
+                return bad
+    node_names = set(meta.node_names)
+    for name, indices in result.node_pods.items():
+        if name not in node_names or name not in {n.name for n in nodes}:
+            return "node-unknown"
+        for pi in indices:
+            bad = account(pi)
+            if bad:
+                return bad
+    for pi in result.failures:
+        bad = account(pi)
+        if bad:
+            return bad
+    if len(seen) != num_pods:
+        return "pod-dropped"
+    return None
+
+
+# -- device dispatch -----------------------------------------------------------
+
+_SEEN_GATE_PROGRAMS: set = set()
+
+
+def _nbytes(tree) -> int:
+    import jax
+
+    return int(
+        sum(
+            getattr(leaf, "nbytes", 0)
+            for leaf in jax.tree_util.tree_leaves(tree)
+        )
+    )
+
+
+def _device_counts(ctx, result, pods, pod_requirements_override) -> Dict[str, int]:
+    """Build the gate tensors for ``result`` against the stashed problem,
+    dispatch the jitted invariant program (instrumented exactly like the
+    solver's own dispatches: program-key cache accounting, AOT executable
+    table, program registry, transfer bytes, trace span), and return the
+    nonzero per-invariant counts (empty dict = device-accept)."""
+    import jax
+
+    from karpenter_tpu.metrics.registry import COMPILE_CACHE, TRANSFER_BYTES
+    from karpenter_tpu.obs import programs, trace
+    from karpenter_tpu.solver import aot
+    from karpenter_tpu.verify import device as dev
+
+    gp, ga, bf = _build_args(ctx, result, pods, pod_requirements_override)
+    key = (
+        "verify_gate", int(ctx.max_claims), bool(bf),
+        tuple(
+            (tuple(leaf.shape), str(leaf.dtype))
+            for leaf in jax.tree_util.tree_leaves(gp)
+        ),
+    )
+    cache_hit = key in _SEEN_GATE_PROGRAMS
+    _SEEN_GATE_PROGRAMS.add(key)
+    COMPILE_CACHE.inc({"result": "hit" if cache_hit else "miss"})
+    prob_bytes = _nbytes((gp, ga))
+    TRANSFER_BYTES.inc({"direction": "h2d"}, prob_bytes)
+    reg_eqns = None
+    if not cache_hit and programs.eqns_enabled():
+        reg_eqns = programs.maybe_count_eqns(
+            lambda: jax.make_jaxpr(lambda: dev.verify_gate(gp, ga, bf))()
+        )
+    aot_handle = aot.maybe_begin(dev.verify_gate, gp, ctx.max_claims, (ga, bf))
+    obs = programs.begin_dispatch(
+        "verify_gate", ctx.max_claims, gp, statics={"bf": int(bf)}
+    )
+    with trace.span(
+        "gate_program" if cache_hit else "compile",
+        cache="hit" if cache_hit else "miss",
+        program="verify_gate",
+    ) as sp:
+        if aot_handle is not None:
+            counts = aot_handle.call()
+        else:
+            counts = dev.verify_gate(gp, ga, bf)
+        counts = np.asarray(jax.device_get(counts))
+        TRANSFER_BYTES.inc({"direction": "d2h"}, int(counts.nbytes))
+        if obs is not None:
+            source = obs.finish(
+                problem_bytes=prob_bytes,
+                result_bytes=int(counts.nbytes),
+                eqns=reg_eqns,
+                source_override=(
+                    aot_handle.source_override if aot_handle is not None else None
+                ),
+            )
+            if sp is not None:
+                sp.attrs["program_key"] = obs.key
+                sp.attrs["cache_source"] = source
+        nonzero = {
+            dev.INVARIANTS[i]: int(counts[i])
+            for i in range(len(dev.INVARIANTS))
+            if counts[i]
+        }
+        if sp is not None:
+            for name, n in nonzero.items():
+                sp.count(name, n)
+    return nonzero
+
+
+def _build_args(ctx, result, pods, pod_requirements_override):
+    """Map the decoded result onto the problem axes: pod rows via the
+    inverse of meta.pod_order (identity in sweeps mode, but do not rely on
+    it), claims onto the slot axis in publication order, nodes onto the
+    node axis via meta.node_names. Claim requirement rows re-encode the
+    PUBLISHED claim.requirements through the same vocab the solve used
+    (streaming/delta.py reconstructs it exactly from meta), so the device
+    checks what the caller will act on, not solver internals."""
+    from karpenter_tpu.models.problem import GT_NONE, LT_NONE
+    from karpenter_tpu.ops.ffd_core import _pad_lanes_mult32
+    from karpenter_tpu.scheduling import Requirements
+    from karpenter_tpu.solver.encode import encode_reqs_with_vocab
+    from karpenter_tpu.solver.validator import checked_requirements
+    from karpenter_tpu.streaming.delta import _vocab_from_meta
+    from karpenter_tpu.verify import device as dev
+
+    meta = ctx.meta
+    problem = _pad_lanes_mult32(ctx.problem)  # no-op on the bucketed path
+    gp = dev.gate_problem(problem)
+    P = np.asarray(problem.pod_requests).shape[0]
+    R = np.asarray(problem.pod_requests).shape[1]
+    T = np.asarray(problem.it_alloc).shape[0]
+    C = int(ctx.max_claims)
+
+    row_of = np.full(len(pods), -1, dtype=np.int64)
+    for row, orig in enumerate(meta.pod_order):
+        if 0 <= orig < len(pods):
+            row_of[orig] = row
+    pod_bin = np.full(P, -1, dtype=np.int32)
+    pod_check = np.zeros(P, dtype=bool)
+
+    def place(pi: int, b: int) -> None:
+        row = row_of[pi]
+        if row < 0:
+            raise ValueError(f"pod {pi} has no encoded row")
+        pod_bin[row] = b
+        if pod_requirements_override is not None:
+            pod_check[row] = pod_requirements_override[pi] is not None
+        else:
+            pod_check[row] = checked_requirements(pods[pi]) is not None
+
+    claims = result.new_claims
+    claim_tpl = np.zeros(C, dtype=np.int32)
+    claim_active = np.zeros(C, dtype=bool)
+    claim_reported = np.zeros((C, R), dtype=np.float32)
+    claim_its = np.zeros((C, T), dtype=bool)
+    claim_has_reqs = np.zeros(C, dtype=bool)
+    res_index = {name: ri for ri, name in enumerate(meta.resource_names)}
+    for ci, claim in enumerate(claims):
+        claim_tpl[ci] = claim.template_index
+        claim_active[ci] = True
+        claim_has_reqs[ci] = claim.requirements is not None
+        for key, value in claim.requests.items():
+            ri = res_index.get(key)
+            if ri is not None and ri < R:
+                claim_reported[ci, ri] = value
+        for ti in claim.instance_type_indices:
+            if 0 <= ti < T:
+                claim_its[ci, ti] = True
+        for pi in claim.pod_indices:
+            place(pi, ci)
+    node_index = {name: ni for ni, name in enumerate(meta.node_names)}
+    for name, indices in result.node_pods.items():
+        ni = node_index[name]
+        for pi in indices:
+            place(pi, C + ni)
+
+    vocab = _vocab_from_meta(meta)
+    lane_valid = np.asarray(problem.lane_valid)
+    empty = Requirements()
+    entities = [
+        c.requirements if c.requirements is not None else empty for c in claims
+    ]
+    entities.extend([empty] * (C - len(claims)))
+    claim_req = encode_reqs_with_vocab(entities, vocab, lane_valid)
+
+    bf = dev.gate_bounds_free(gp)
+    if bf:
+        gt, lt = np.asarray(claim_req.gt), np.asarray(claim_req.lt)
+        if gt.size and (np.any(gt != GT_NONE) or np.any(lt != LT_NONE)):
+            # a published claim row carries an integer bound the sources
+            # lacked: demote to the bounds-carrying program rather than
+            # silently ignoring it
+            bf = False
+    ga = dev.GateArgs(
+        claim_req=claim_req,
+        claim_tpl=claim_tpl,
+        claim_active=claim_active,
+        claim_reported=claim_reported,
+        claim_its=claim_its,
+        claim_has_reqs=claim_has_reqs,
+        pod_bin=pod_bin,
+        pod_check=pod_check,
+    )
+    return gp, ga, bf

@@ -1,0 +1,102 @@
+"""Incremental row-scoped re-checks for the streaming warm path.
+
+The round-11 three-bucket contract (streaming/warm.py) re-validated EVERY
+warm result at full level — reused pins included — each cycle. That is the
+one place a full gate is provably redundant: an untouched reused bin was
+validated when the previous result was accepted, its pods' digests are
+unchanged (the DeltaEncoder's diff drove the seed set), and any change to
+the shared universe (templates, instance types, nodes, vocab, resource axis)
+forces the cold path before this code runs. So only the bins the warm merge
+actually touched need re-proving:
+
+  - every claim built or re-narrowed from the sub-solve fold-back,
+  - every existing node that RECEIVED pods this cycle,
+  - pod accounting over the whole batch (cross-bin, always cheap),
+  - topology skew whenever any touched pod carries a spread constraint —
+    sound because the warm path's topology closure promotes ALL
+    topology-constrained pods to seeds on any churn, so a skew cohort is
+    always entirely inside the touched set.
+
+Untouched bins are not trusted blindly either: each cycle a seeded sample of
+them (KARPENTER_TPU_VERIFY_AUDIT_FRAC) rides along through the same scoped
+host check, so a latent corruption in a long-lived pin is still found in
+O(1/frac) cycles — and any violation, touched or sampled, rejects the warm
+result exactly as the full gate did (warm.py falls back to a cold solve).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, List, Optional, Sequence, Set
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class IncrementalScope:
+    """What the warm merge touched this cycle, as validator scopes."""
+
+    claim_indices: Set[int]
+    node_names: Set[str]
+    check_topology: bool
+    total_claims: int
+    total_nodes: int
+
+
+def incremental_gate(
+    result,
+    pods: Sequence,
+    instance_types: Sequence,
+    templates: Sequence,
+    nodes: Sequence,
+    scope: IncrementalScope,
+    *,
+    pod_requirements_override=None,
+    cluster_pods: Sequence = (),
+    domains=None,
+) -> List[Any]:
+    """Scoped full-level host check of a warm result: touched bins plus a
+    seeded audit sample of the untouched ones. Returns the violation list
+    (empty = accept), exactly like validate_result."""
+    from karpenter_tpu.metrics.registry import GATE_AUDIT, GATE_DURATION, measure
+    from karpenter_tpu.solver.validator import validate_result
+    from karpenter_tpu.verify.gate import _audit_rng, audit_frac
+
+    claim_scope = set(scope.claim_indices)
+    node_scope = set(scope.node_names)
+    sampled_claims: Set[int] = set()
+    sampled_nodes: Set[str] = set()
+    frac = audit_frac()
+    if frac > 0.0:
+        rng = _audit_rng()
+        sampled_claims = {
+            ci for ci in range(scope.total_claims)
+            if ci not in claim_scope and rng.random() < frac
+        }
+        sampled_nodes = {
+            name for name in result.node_pods
+            if name not in node_scope and rng.random() < frac
+        }
+        claim_scope |= sampled_claims
+        node_scope |= sampled_nodes
+
+    with measure(GATE_DURATION, {"mode": "incremental"}):
+        violations = validate_result(
+            result, pods, instance_types, templates, nodes,
+            pod_requirements_override, cluster_pods, domains, level="full",
+            claim_scope=claim_scope, node_scope=node_scope,
+            check_topology=scope.check_topology,
+        )
+
+    if sampled_claims or sampled_nodes:
+        # attribute audit outcomes: a violation pinned to a sampled-only bin
+        # means the previous accept's trust was misplaced — the device/warm
+        # fast path let something rot
+        audit_hit = any(
+            (v.claim_index in sampled_claims and v.claim_index not in scope.claim_indices)
+            or (v.node_name in sampled_nodes and v.node_name not in scope.node_names)
+            for v in violations
+        )
+        GATE_AUDIT.inc({"outcome": "mismatch" if audit_hit else "match"})
+    return violations

@@ -19,6 +19,7 @@ import pytest
 
 from tools.kernel_census import (
     build_census_problem,
+    gate_jaxpr_eqns,
     narrow_jaxpr_eqns,
     relax_jaxpr_eqns,
 )
@@ -45,6 +46,12 @@ WAVEFRONT_EQN_BUDGET = 5300
 # entire economics of the two-phase solve: one dense dispatch stands in for
 # the hundreds of narrow iterations the bulk would otherwise cost
 RELAX_EQN_BUDGET = 1450
+
+# round-16 device verification gate (KARPENTER_TPU_DEVICE_GATE): measured
+# 336 at the round-16 commit. The whole one-shot reduction re-proving seven
+# invariants over a decoded result — ~0.14x of ONE narrow iteration, which
+# is why re-verifying every accept on device is affordable at all
+GATE_EQN_BUDGET = 400
 
 
 @pytest.fixture(scope="module")
@@ -245,3 +252,42 @@ class TestRelaxBudget:
             f"one extra rounding pass costs {more - base} eqns — the ladder "
             f"was designed around a per-rung gate sweep of <300"
         )
+
+
+class TestGateBudget:
+    """Round-16 device verification gate: the gate program gets its own
+    pinned budget, and the flag must not touch the narrow body — the gate is
+    dispatched entirely from verify/gate.py on an already-decoded result, so
+    KARPENTER_TPU_DEVICE_GATE=1 adds a program rather than editing any."""
+
+    def test_gate_program_under_budget(self, census_problem):
+        eqns = gate_jaxpr_eqns(census_problem)
+        assert eqns <= GATE_EQN_BUDGET, (
+            f"verification gate program grew to {eqns} jaxpr eqns "
+            f"(budget {GATE_EQN_BUDGET}); the gate rides EVERY supervised "
+            f"accept, so growth here taxes every solve — see "
+            f"tools/kernel_census.py gate_jaxpr_eqns to attribute it"
+        )
+
+    def test_gate_budget_is_tight(self, census_problem):
+        eqns = gate_jaxpr_eqns(census_problem)
+        assert eqns >= GATE_EQN_BUDGET * 0.8, (
+            f"verification gate program shrank to {eqns} jaxpr eqns — nice! "
+            f"tighten GATE_EQN_BUDGET to keep the guard meaningful"
+        )
+
+    def test_gate_flag_on_narrow_body_unchanged(self, census_problem):
+        """With the gate imported AND forced on, the flag-off narrow body
+        must still count EXACTLY 2394 equations: verification happens after
+        decode in a separate program, never inside the solve kernels."""
+        import karpenter_tpu.verify  # noqa: F401 — import must be inert too
+
+        old = os.environ.get("KARPENTER_TPU_DEVICE_GATE")
+        os.environ["KARPENTER_TPU_DEVICE_GATE"] = "1"
+        try:
+            assert narrow_jaxpr_eqns(census_problem, wavefront=0) == 2394
+        finally:
+            if old is None:
+                os.environ.pop("KARPENTER_TPU_DEVICE_GATE", None)
+            else:
+                os.environ["KARPENTER_TPU_DEVICE_GATE"] = old

@@ -70,7 +70,7 @@ def relax_flag(value):
 def run_ab(pods, its, templates, nodes=()):
     """(off_solver, off_result, on_solver, on_result) for one workload."""
     s_off = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS)
-    with relax_flag(None):
+    with relax_flag("0"):  # explicit: the env default is ON since round 16
         off = s_off.solve(pods, its, templates, nodes)
     s_on = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS)
     with relax_flag("1"):

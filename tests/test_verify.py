@@ -1,0 +1,308 @@
+"""Cross-backend parity for the device verification gate (verify/).
+
+The contract under test is the one verify/device.py's safety argument makes:
+the composite gate's verdict must EQUAL the host full validator's on every
+result — fast-accepting on device exactly when the host finds nothing, and
+reporting the host's own canonical violations whenever anything is wrong
+(a device reject is host-confirmed before it can strip or quarantine).
+
+Each corruption below hand-damages a known-good JaxSolver result (the jax
+backend attaches the GateContext the gate dispatches from) the way a buggy
+device kernel would — the same fault corpus as tests/test_validator.py, but
+driven through the composite gate. Any divergence between the composite
+verdict and validate_result(level="full") is a test failure.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+from contextlib import contextmanager
+
+from karpenter_tpu import verify
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.nodepool import NodePool
+from karpenter_tpu.apis.objects import IN, NO_SCHEDULE, ObjectMeta, Taint, Toleration
+from karpenter_tpu.cloudprovider.fake import FAKE_WELL_KNOWN_LABELS, instance_types
+from karpenter_tpu.scheduling import Requirement, Requirements
+from karpenter_tpu.scheduling.taints import Taints
+from karpenter_tpu.solver import validator as val
+from karpenter_tpu.solver.encode import NodeInfo, TemplateInfo, template_from_nodepool
+from karpenter_tpu.solver.jax_backend import JaxSolver
+
+from tests.factories import make_pod
+
+
+@contextmanager
+def env(key, value):
+    old = os.environ.get(key)
+    if value is None:
+        os.environ.pop(key, None)
+    else:
+        os.environ[key] = value
+    try:
+        yield
+    finally:
+        if old is None:
+            os.environ.pop(key, None)
+        else:
+            os.environ[key] = old
+
+
+def jax_build(pods, templates=None, its=None, nodes=()):
+    its = its if its is not None else instance_types(10)
+    if templates is None:
+        templates = [
+            template_from_nodepool(
+                NodePool(metadata=ObjectMeta(name="np")), its, range(len(its))
+            )
+        ]
+    result = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(
+        pods, its, templates, nodes=nodes
+    )
+    assert result.verify_ctx is not None, "jax sweeps solve must attach a GateContext"
+    return result, its, templates
+
+
+def corrupt(result):
+    """Deepcopy for mutation, re-attaching the ORIGINAL GateContext: the
+    context describes the encoded problem, not the (about to be damaged)
+    result — sharing it is exactly what a decode bug would hand the gate."""
+    c = copy.deepcopy(result)
+    c.verify_ctx = result.verify_ctx
+    return c
+
+
+def assert_parity(result, pods, its, tpls, nodes=()):
+    """THE satellite-3 contract: composite gate verdict == host full gate."""
+    outcome = verify.full_gate(result, pods, its, tpls, nodes)
+    assert outcome is not None, "gate did not engage"
+    host = val.validate_result(result, pods, its, tpls, nodes=nodes, level="full")
+    assert {v.invariant for v in outcome.violations} == {
+        v.invariant for v in host
+    }, f"gate diverged from host: {outcome} vs {host}"
+    if host:
+        assert outcome.mode == "host-confirm"
+    else:
+        assert outcome.violations == []
+    return outcome, host
+
+
+def invariants(violations):
+    return {v.invariant for v in violations}
+
+
+# -- clean-accept parity ------------------------------------------------------
+
+
+def test_clean_result_fast_accepts_on_device():
+    pods = [make_pod(cpu=0.5) for _ in range(8)]
+    pods += [make_pod(cpu=0.2, host_ports=[8080 + i]) for i in range(2)]
+    result, its, tpls = jax_build(pods)
+    assert result.num_scheduled() == len(pods)
+    outcome, host = assert_parity(result, pods, its, tpls)
+    assert host == []
+    assert outcome.mode == "device" and outcome.counts == {}
+
+
+def test_tolerated_taints_fast_accept():
+    # polarity regression: pod_tol_* rows are True where the pod TOLERATES —
+    # pods legally placed on a tainted template must not read as violations
+    its = instance_types(10)
+    base = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="np")), its, range(len(its))
+    )
+    tainted = TemplateInfo(
+        nodepool_name="tainted",
+        requirements=base.requirements.copy(),
+        taints=Taints([Taint(key="team", value="gpu", effect=NO_SCHEDULE)]),
+        daemon_overhead=dict(base.daemon_overhead),
+        instance_type_indices=list(base.instance_type_indices),
+    )
+    pods = [
+        make_pod(
+            cpu=0.5,
+            tolerations=[Toleration(key="team", operator="Equal", value="gpu")],
+        )
+        for _ in range(3)
+    ]
+    result, its, tpls = jax_build(pods, templates=[tainted], its=its)
+    assert result.num_scheduled() == len(pods)
+    outcome, host = assert_parity(result, pods, its, tpls)
+    assert host == [] and outcome.mode == "device"
+
+
+def test_flag_off_gate_stands_down():
+    pods = [make_pod(cpu=0.5) for _ in range(3)]
+    result, pods_its, tpls = jax_build(pods)
+    with env("KARPENTER_TPU_DEVICE_GATE", "0"):
+        assert verify.full_gate(result, pods, pods_its, tpls) is None
+
+
+# -- fault-injection parity (test_validator.py corpus through the gate) -------
+
+
+def test_overpacked_merge_parity():
+    its = instance_types(1)  # 1 cpu / 2Gi / 10 pods
+    pods = [make_pod(cpu=0.8) for _ in range(4)]
+    result, its, tpls = jax_build(pods, its=its)
+    assert len(result.new_claims) >= 2
+    c = corrupt(result)
+    c.new_claims[0].pod_indices = (
+        c.new_claims[0].pod_indices + c.new_claims[1].pod_indices
+    )
+    c.new_claims.pop(1)
+    outcome, host = assert_parity(c, pods, its, tpls)
+    assert invariants(host) & {"claim-requests", "claim-capacity"}
+
+
+def test_stale_requests_parity():
+    pods = [make_pod(cpu=0.5) for _ in range(4)]
+    result, its, tpls = jax_build(pods)
+    c = corrupt(result)
+    c.new_claims[0].requests = dict(c.new_claims[0].requests)
+    c.new_claims[0].requests["cpu"] = c.new_claims[0].requests.get("cpu", 0.0) + 7.0
+    outcome, host = assert_parity(c, pods, its, tpls)
+    assert "claim-requests" in invariants(host)
+
+
+def test_retargeted_tainted_template_parity():
+    its = instance_types(10)
+    base = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="np")), its, range(len(its))
+    )
+    tainted = TemplateInfo(
+        nodepool_name="tainted",
+        requirements=base.requirements.copy(),
+        taints=Taints([Taint(key="team", value="gpu", effect=NO_SCHEDULE)]),
+        daemon_overhead=dict(base.daemon_overhead),
+        instance_type_indices=list(base.instance_type_indices),
+    )
+    pods = [make_pod(cpu=0.5) for _ in range(3)]
+    result, its, tpls = jax_build(pods, templates=[base, tainted], its=its)
+    assert all(cl.template_index == 0 for cl in result.new_claims)
+    c = corrupt(result)
+    for cl in c.new_claims:
+        cl.template_index = 1  # point the placement at the tainted template
+    outcome, host = assert_parity(c, pods, its, tpls)
+    assert "taint-admissibility" in invariants(host)
+
+
+def test_port_clash_merge_parity():
+    pods = [make_pod(cpu=0.1, host_ports=[9000]) for _ in range(2)]
+    result, its, tpls = jax_build(pods)
+    assert len(result.new_claims) == 2
+    c = corrupt(result)
+    c.new_claims[0].pod_indices = (
+        c.new_claims[0].pod_indices + c.new_claims[1].pod_indices
+    )
+    c.new_claims.pop(1)
+    outcome, host = assert_parity(c, pods, its, tpls)
+    assert "host-port" in invariants(host)
+
+
+def test_requirement_intersection_parity():
+    pods = [
+        make_pod(cpu=0.5, node_selector={wk.LABEL_TOPOLOGY_ZONE: "test-zone-1"})
+    ]
+    result, its, tpls = jax_build(pods)
+    assert result.num_scheduled() == 1
+    c = corrupt(result)
+    c.new_claims[0].requirements = Requirements(
+        Requirement(wk.LABEL_TOPOLOGY_ZONE, IN, ["test-zone-2"])
+    )
+    outcome, host = assert_parity(c, pods, its, tpls)
+    assert "requirement-intersection" in invariants(host)
+
+
+def test_node_overpack_and_unknown_node_parity():
+    node = NodeInfo(
+        name="node-1",
+        requirements=Requirements(
+            Requirement(wk.LABEL_HOSTNAME, IN, ["node-1"]),
+            Requirement(wk.LABEL_TOPOLOGY_ZONE, IN, ["test-zone-1"]),
+        ),
+        taints=Taints(),
+        available={"cpu": 1.0, "memory": 2 * 1024.0**3, "pods": 10.0},
+        daemon_overhead={},
+    )
+    pods = [make_pod(cpu=0.5) for _ in range(4)]
+    result, its, tpls = jax_build(pods, nodes=[node])
+    c = corrupt(result)
+    c.new_claims = []
+    c.node_pods = {"node-1": list(range(4))}  # cram everything on 1 cpu
+    outcome, host = assert_parity(c, pods, its, tpls, nodes=[node])
+    assert "node-capacity" in invariants(host)
+
+    phantom = corrupt(result)
+    for cl in phantom.new_claims:
+        cl.pod_indices = [pi for pi in cl.pod_indices if pi != 0]
+    phantom.new_claims = [cl for cl in phantom.new_claims if cl.pod_indices]
+    phantom.node_pods = {
+        name: [pi for pi in idxs if pi != 0]
+        for name, idxs in phantom.node_pods.items()
+    }
+    phantom.node_pods = {k: v for k, v in phantom.node_pods.items() if v}
+    phantom.node_pods["node-ghost"] = [0]
+    outcome, host = assert_parity(phantom, pods, its, tpls, nodes=[node])
+    assert "node-unknown" in invariants(host)
+
+
+def test_accounting_and_nan_parity():
+    pods = [make_pod(cpu=0.5) for _ in range(4)]
+    result, its, tpls = jax_build(pods)
+    dup = corrupt(result)
+    dup.node_pods = dict(dup.node_pods)
+    dup.node_pods.setdefault("nowhere", [])  # keep shape; duplicate below
+    first = dup.new_claims[0].pod_indices[0]
+    dup.new_claims[0].pod_indices = dup.new_claims[0].pod_indices + [first]
+    outcome, host = assert_parity(dup, pods, its, tpls)
+    assert "pod-accounting" in invariants(host)
+
+    nan = corrupt(result)
+    nan.new_claims[0].requests = dict(nan.new_claims[0].requests)
+    nan.new_claims[0].requests["cpu"] = float("nan")
+    assert_parity(nan, pods, its, tpls)
+
+
+# -- incremental gate ---------------------------------------------------------
+
+
+def test_incremental_gate_scope_and_audit_widening():
+    its = instance_types(1)
+    pods = [make_pod(cpu=0.8) for _ in range(6)]
+    result, its, tpls = jax_build(pods, its=its)
+    assert len(result.new_claims) >= 2
+    c = corrupt(result)
+    c.new_claims[1].requests = {"cpu": 0.0}  # stale tensor on claim 1
+
+    def scope(touched):
+        return verify.IncrementalScope(
+            claim_indices=set(touched),
+            node_names=set(),
+            check_topology=False,
+            total_claims=len(c.new_claims),
+            total_nodes=0,
+        )
+
+    with env("KARPENTER_TPU_VERIFY_AUDIT_FRAC", "0"):
+        hit = verify.incremental_gate(c, pods, its, tpls, (), scope({1}))
+        assert "claim-requests" in invariants(hit)
+        # untouched + unsampled: the reuse contract skips the bin entirely
+        miss = verify.incremental_gate(c, pods, its, tpls, (), scope({0}))
+        assert "claim-requests" not in invariants(miss)
+    with env("KARPENTER_TPU_VERIFY_AUDIT_FRAC", "1.0"):
+        # full-rate audit widens the scope to every untouched bin
+        audited = verify.incremental_gate(c, pods, its, tpls, (), scope({0}))
+        assert "claim-requests" in invariants(audited)
+
+
+def test_audit_frac_parsing():
+    with env("KARPENTER_TPU_VERIFY_AUDIT_FRAC", None):
+        assert verify.audit_frac() == 0.05
+    with env("KARPENTER_TPU_VERIFY_AUDIT_FRAC", "0.5"):
+        assert verify.audit_frac() == 0.5
+    with env("KARPENTER_TPU_VERIFY_AUDIT_FRAC", "7"):
+        assert verify.audit_frac() == 1.0
+    with env("KARPENTER_TPU_VERIFY_AUDIT_FRAC", "nonsense"):
+        assert verify.audit_frac() == 0.05

@@ -201,6 +201,33 @@ def relax_jaxpr_eqns(problem=None, C: int = 16, passes: int = 2) -> int:
     return _count_jaxpr_eqns(jaxpr)
 
 
+def gate_jaxpr_eqns(problem=None, C: int = 16) -> int:
+    """Flattened jaxpr equation count of the device verification gate
+    program (verify/device.py, KARPENTER_TPU_DEVICE_GATE). Like the relax
+    program this is a one-shot reduction, not a loop body: the count is the
+    whole trace. Pinned by tests/test_kernel_census.py, which also proves
+    that importing/enabling the gate leaves the narrow body untouched —
+    flag-gated programs must SELECT different programs, never edit the
+    existing ones."""
+    import jax
+
+    from karpenter_tpu.ops.ffd_core import _pad_lanes_mult32
+    from karpenter_tpu.verify.device import (
+        _gate_impl,
+        dummy_gate_args,
+        gate_bounds_free,
+        gate_problem,
+    )
+
+    if problem is None:
+        problem = build_census_problem(claim_slots=C)
+    gp = gate_problem(_pad_lanes_mult32(problem))
+    ga = dummy_gate_args(gp, C)
+    bounds_free = gate_bounds_free(gp)
+    jaxpr = jax.make_jaxpr(lambda p, a: _gate_impl(p, a, bounds_free))(gp, ga)
+    return _count_jaxpr_eqns(jaxpr)
+
+
 def _count_hlo_ops(text: str):
     """(entry_ops, total_ops) over an HLO text dump. Post-optimization each
     ENTRY instruction is roughly one kernel launch (fusions count once)."""
@@ -247,6 +274,9 @@ def main(argv):
     relax_eqns = relax_jaxpr_eqns(problem, C)
     print(f"  jaxpr_eqns_relax     = {relax_eqns}  (whole phase-1 program, "
           f"2 rounding passes)")
+    gate_eqns = gate_jaxpr_eqns(problem, C)
+    print(f"  jaxpr_eqns_gate      = {gate_eqns}  (whole verification gate "
+          f"program)")
     if not quick:
         entry, total = narrow_hlo_ops(problem, C)
         print(f"  hlo_entry_ops  = {entry}")

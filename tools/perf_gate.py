@@ -79,6 +79,11 @@ DEFAULT_BANDS = {
     # pushes pods back into the launch-bound repair loop, which is the exact
     # regression the two-phase solve exists to avoid
     "relax_placed_frac": (HIGHER_BETTER, 2.0),
+    # round-16 device verification gate (verify/): the composite full-gate
+    # wall at the north-star shape. It sits on EVERY supervised solve when
+    # KARPENTER_TPU_DEVICE_GATE is on, so a 3x blow-up here silently taxes
+    # all of them. The first gate-carrying run seeds the window.
+    "gate_full_s": (LOWER_BETTER, 3.0),
 }
 
 # absolute ceiling for the --smoke tiny-shape solve (steady-state, post
@@ -118,6 +123,11 @@ def row_from_bench(out: dict, label: str = "run") -> dict:
         "repair_iterations": out.get("repair_iterations"),
         "relax_phase_s": out.get("relax_phase_s"),
         "solve_10k_relax_s": out.get("solve_10k_relax_s"),
+        # schema v2, round 16: device verification gate columns — present
+        # only when the bench gate scenario ran with the gate enabled
+        "gate_full_s": out.get("gate_full_s"),
+        "gate_incremental_s": out.get("gate_incremental_s"),
+        "audit_frac": out.get("audit_frac"),
         "error": out.get("error"),
     }
     row.update({k: v for k, v in optional.items() if v is not None})
