@@ -707,6 +707,152 @@ def run_child():
         emit(ev)
     except Exception as exc:  # a broken scenario must not kill the grid run
         emit({"event": "churn", "error": repr(exc)})
+
+    # multi-tenant serve scenario (serve/): N concurrent tenant streams
+    # multiplexed over ONE dispatcher vs the same problems solved
+    # sequentially. The dispatcher serializes device access, so the ratio
+    # measures pure serving overhead (queueing, DWRR bookkeeping, ticket
+    # plumbing) plus whatever co-batching wins back by stacking
+    # shape-compatible tenants into one batched_screen launch.
+    # Acceptance: aggregate throughput >= 0.7x sequential. The overload
+    # probe then floods a tiny queue and requires every shed request to
+    # carry a CLASSIFIED overloaded reason — silent drops are the failure
+    # mode the admission path exists to prevent.
+    try:
+        import statistics as _stats
+
+        from karpenter_tpu import serve as serve_pkg
+        from karpenter_tpu.solver.oracle import OracleSolver
+
+        n_tenants = 4 if os.environ.get("BENCH_QUICK") else 16
+        serve_cycles = 3 if os.environ.get("BENCH_QUICK") else 6
+        pods_per_cycle = 20 if os.environ.get("BENCH_QUICK") else 50
+        serve_its = instance_types(50)
+        serve_tpl = template_from_nodepool(
+            NodePool(metadata=ObjectMeta(name="serve")), serve_its,
+            range(len(serve_its)),
+        )
+        srng = random.Random(99)
+        from karpenter_tpu.streaming.churn import default_pod_factory as _pf
+
+        # pregenerate every cycle's per-tenant pod batch so the serve run
+        # and the sequential control solve the SAME problems
+        problems = [
+            [
+                [_pf(f"sv-{c}-{t}-{i}", srng) for i in range(pods_per_cycle)]
+                for t in range(n_tenants)
+            ]
+            for c in range(serve_cycles)
+        ]
+        shared_jax = JaxSolver()
+        service = serve_pkg.SolveService()
+        for t in range(n_tenants):
+            service.register_tenant(
+                f"tenant-{t}",
+                solver=serve_pkg.build_tenant_solver(
+                    f"tenant-{t}", primary=shared_jax,
+                    fallback=OracleSolver(),
+                ),
+            )
+        service.start()
+
+        def serve_pass():
+            pass_lat = []
+            t0 = time.perf_counter()
+            for cycle in problems:
+                tickets = [
+                    service.submit(f"tenant-{t}", cycle[t], serve_its,
+                                   [serve_tpl])
+                    for t in range(n_tenants)
+                ]
+                pass_lat.extend(
+                    o.latency_s
+                    for o in (tk.wait(timeout=300.0) for tk in tickets)
+                    if o.status == "ok"
+                )
+            return time.perf_counter() - t0, pass_lat
+
+        try:
+            # warmup pass over EVERY cycle's shapes (per-cycle pod mixes hit
+            # different padded vocab buckets, each a distinct compile), then
+            # the measured steady-state pass
+            serve_pass()
+            before = service.summary()
+            serve_wall, lat = serve_pass()
+            after = service.summary()
+            completed = after["completed"] - before["completed"]
+            batched = after["batched"] - before["batched"]
+        finally:
+            service.close()
+        # sequential control: same measured problems, same warm solver,
+        # same supervisor wrap — one stream, no dispatcher in the path
+        from karpenter_tpu.solver.supervisor import SupervisedSolver as _Sup
+
+        control = _Sup(shared_jax, fallback=OracleSolver())
+        for warm_pass in range(2):  # pass 0 absorbs the SOLO-shape compiles
+            t0 = time.perf_counter()
+            for cycle in problems:
+                for t in range(n_tenants):
+                    control.solve(cycle[t], serve_its, [serve_tpl])
+            seq_wall = time.perf_counter() - t0
+        measured_pods = n_tenants * serve_cycles * pods_per_cycle
+        lat.sort()
+        ev = {
+            "event": "serve",
+            "tenants": n_tenants,
+            "cycles": serve_cycles,
+            "pods_per_cycle": pods_per_cycle,
+            "serve_wall_s": round(serve_wall, 4),
+            "sequential_wall_s": round(seq_wall, 4),
+            "agg_pods_per_s": round(measured_pods / max(serve_wall, 1e-9), 1),
+            "vs_sequential": round(seq_wall / max(serve_wall, 1e-9), 3),
+            "completed": completed,
+            "batched": batched,
+            "batch_hit_rate": round(batched / max(completed, 1), 4),
+        }
+        if lat:
+            ev["p50_cycle_s"] = round(_stats.median(lat), 4)
+            ev["p99_cycle_s"] = round(
+                lat[min(len(lat) - 1, int(0.99 * len(lat)))], 4
+            )
+        # overload probe: a 2-deep queue, a deliberately slow solver, and a
+        # 50ms deadline budget — every outcome must be a classified status
+        class _Slow:
+            def solve(self, pods, its_, tpls_, **kw):
+                time.sleep(0.02)
+                return type("R", (), {"num_scheduled": lambda s: 0,
+                                      "new_claims": (), "node_pods": {},
+                                      "failures": {}})()
+
+        probe = serve_pkg.SolveService(queue_depth=2, batching=False)
+        probe.register_tenant("flood", solver=_Slow())
+        probe.start()
+        try:
+            flood = [
+                probe.submit("flood", cycle[0][:4], serve_its, [serve_tpl],
+                             deadline_s=0.05)
+                for _ in range(24)
+            ]
+            flood_outs = [tk.wait(timeout=60.0) for tk in flood]
+        finally:
+            probe.close()
+        statuses = {}
+        for o in flood_outs:
+            key = o.status if o.status == "ok" else f"{o.status}:{o.reason}"
+            statuses[key] = statuses.get(key, 0) + 1
+        unclassified = sum(
+            1 for o in flood_outs
+            if o.status not in ("ok", "overloaded", "rejected")
+            or (o.status != "ok" and not o.reason)
+        )
+        ev["overload"] = {
+            "submitted": len(flood_outs),
+            "statuses": statuses,
+            "unclassified": unclassified,
+        }
+        emit(ev)
+    except Exception as exc:
+        emit({"event": "serve", "error": repr(exc)})
     emit({"event": "done"})
 
 
@@ -1081,6 +1227,24 @@ def main():
         out["churn_outcomes"] = churn.get("outcomes")
         if "delta_encode_speedup" in churn:
             out["churn_delta_encode_speedup"] = churn["delta_encode_speedup"]
+    serve = next((e for e in events if e.get("event") == "serve"), None)
+    if serve is not None and "error" not in serve:
+        # multi-tenant serve columns (serve/, docs/SERVING.md): aggregate
+        # throughput through the dispatcher, end-to-end cycle p99, overhead
+        # vs a sequential control, and the co-batching hit rate
+        out["serve_agg_pods_s"] = serve.get("agg_pods_per_s")
+        out["serve_p99_cycle_s"] = serve.get("p99_cycle_s")
+        out["serve_vs_sequential"] = serve.get("vs_sequential")
+        out["serve_batch_hit_rate"] = serve.get("batch_hit_rate")
+        out["serve_tenants"] = serve.get("tenants")
+        if "overload" in serve:
+            out["serve_overload"] = serve["overload"]
+            if serve["overload"].get("unclassified", 0) > 0:
+                out["error"] = (
+                    f"serve overload probe: "
+                    f"{serve['overload']['unclassified']} outcomes without a "
+                    f"classified status (admission contract violated)"
+                )
     if scheduled_frac < 0.95:
         # a solver that drops pods must not read as a throughput win
         # (reference asserts full schedulability of the diverse mix)

@@ -184,6 +184,12 @@ class Registry:
         with self._lock:
             return [(m.kind, m.name, m.help) for m in self._metrics.values()]
 
+    def get(self, name: str):
+        """The registered metric object by name (None if absent) — lint and
+        tests inspect live label sets through this."""
+        with self._lock:
+            return self._metrics.get(name)
+
 
 REGISTRY = Registry()
 
@@ -200,11 +206,13 @@ SOLVER_FALLBACK = REGISTRY.counter(
 )
 SOLVER_CIRCUIT_STATE = REGISTRY.gauge(
     "solver_circuit_state",
-    "Primary-backend circuit breaker state (0=closed, 1=half-open, 2=open)",
+    "Primary-backend circuit breaker state (0=closed, 1=half-open, 2=open); "
+    "one series per tenant under the multi-tenant serve layer",
 )
 VALIDATOR_REJECTIONS = REGISTRY.counter(
     "validator_rejections_total",
-    "SolveResults quarantined by the invariant gate, by violated invariant",
+    "SolveResults quarantined by the invariant gate, by violated invariant "
+    "and, under the multi-tenant serve layer, tenant",
 )
 SOLVE_DEADLINE_EXCEEDED = REGISTRY.counter(
     "solve_deadline_exceeded_total",
@@ -276,7 +284,47 @@ DELTA_REUSE_RATIO = REGISTRY.gauge(
 WARM_SOLVES = REGISTRY.counter(
     "solver_warm_solves_total",
     "Streaming solve cycles, by outcome (warm, warm-rejected, warm-error, "
-    "cold-first, cold-threshold, cold-unsupported, cold-world-changed)",
+    "cold-first, cold-threshold, cold-unsupported, cold-world-changed) and, "
+    "under the multi-tenant serve layer, tenant",
+)
+
+# -- multi-tenant serve series (serve/, KARPENTER_TPU_SERVE) -------------------
+# The tenant label on these (and on solver_circuit_state,
+# validator_rejections_total, solver_warm_solves_total) is bounded by
+# KARPENTER_TPU_SERVE_MAX_TENANTS; tools/metrics_lint.py enforces the bound.
+SERVE_QUEUE_DEPTH = REGISTRY.gauge(
+    "serve_queue_depth",
+    "Queued solve requests per tenant stream (each queue bounded by "
+    "KARPENTER_TPU_SERVE_QUEUE_DEPTH)",
+)
+SERVE_ADMISSION = REGISTRY.counter(
+    "serve_admission_total",
+    "Serve-layer admission decisions, by tenant and classified outcome "
+    "(accepted, overloaded-queue-full, overloaded-predicted-wait, "
+    "overloaded-expired, rejected-max-tenants, rejected-shutdown) — an "
+    "unadmitted request is always one of these, never a silent drop",
+)
+SERVE_FAIRNESS_DEFICIT = REGISTRY.gauge(
+    "serve_fairness_deficit",
+    "Deficit-weighted-round-robin balance per tenant: the pod-units of "
+    "service the stream may still spend before yielding its turn",
+)
+SERVE_CYCLES = REGISTRY.counter(
+    "serve_cycles_total",
+    "Solve requests completed by the serve dispatcher, by tenant and path "
+    "(solo = per-tenant supervised solve, batched = answered by a "
+    "cross-stream stacked dispatch)",
+)
+SERVE_BATCH = REGISTRY.counter(
+    "serve_batch_total",
+    "Cross-stream batching decisions, by result (hit = request answered by "
+    "a stacked batched_screen dispatch, fallback = stacked path stood down "
+    "to the per-tenant solve)",
+)
+SERVE_CYCLE_SECONDS = REGISTRY.histogram(
+    "serve_cycle_seconds",
+    "End-to-end serve request latency from admission to completed result "
+    "(queue wait included; per-tenant quantiles live in /debug/tenants)",
 )
 
 # -- restart-resilience series (solver/aot.py, streaming/snapshot.py,

@@ -64,9 +64,14 @@ class OperatorStatus:
         self,
         supervisor=None,
         warmup_ready: Optional[Callable[[], bool]] = None,
+        serve_service=None,
     ):
         self.supervisor = supervisor
         self.warmup_ready = warmup_ready
+        # the multi-tenant SolveService (serve/), when the operator runs one
+        # (KARPENTER_TPU_SERVE=1): readiness then also requires a live
+        # dispatcher, and /statusz + /debug/tenants expose its streams
+        self.serve_service = serve_service
 
     def ready(self) -> bool:
         """Ready to serve traffic: warmup done, no restart recovery in
@@ -87,6 +92,10 @@ class OperatorStatus:
 
             if self.supervisor.circuit_state() == CIRCUIT_OPEN:
                 return False
+        if self.serve_service is not None and not self.serve_service.healthy():
+            # a closed service or dead dispatcher thread would queue
+            # requests forever — stop routing traffic here
+            return False
         return True
 
     def statusz(self) -> dict:
@@ -123,6 +132,9 @@ class OperatorStatus:
 
         # unschedulable summary over the report ring (/debug/explain drills in)
         out["unschedulable"] = explain.summary()
+        if self.serve_service is not None:
+            # multi-tenant fleet totals (/debug/tenants has per-stream rows)
+            out["serve"] = self.serve_service.summary()
         return out
 
 
@@ -174,6 +186,25 @@ class _Handler(BaseHTTPRequestHandler):
                 "captured": len(explain.ring()),
                 "reports": explain.ring().snapshot(),
             }
+            body = (json.dumps(payload, indent=1, default=str) + "\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+        elif self.path.startswith("/debug/tenants"):
+            from karpenter_tpu import serve as serve_pkg
+
+            # per-tenant stream rows of the multi-tenant solve service:
+            # queue pressure, DWRR balance, outcome counters, latency
+            # quantiles, circuit state. Resolves the wired service first,
+            # then the process-wide one (a bare serve() still answers).
+            service = (
+                getattr(status, "serve_service", None)
+                or serve_pkg.current_service()
+            )
+            payload = (
+                service.snapshot()
+                if service is not None
+                else {"enabled": serve_pkg.enabled(), "tenants": []}
+            )
             body = (json.dumps(payload, indent=1, default=str) + "\n").encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")

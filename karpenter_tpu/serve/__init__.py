@@ -1,0 +1,156 @@
+"""Multi-tenant solve service: N tenant streams over one solver process.
+
+The provisioning stack below this package is single-stream: one
+SupervisedSolver owns one circuit breaker, one StreamingSolver carries one
+warm state, one quarantine ring collects one stream's rejected results. A
+real control plane multiplexes many independent clusters (tenants) onto one
+warmed-up solver process — compiled executables and the device are shared,
+everything stateful must not be. This package adds that layer:
+
+  isolation   every tenant gets its OWN SupervisedSolver stack (circuit
+              breaker, warm streaming state, quarantine namespace, journal
+              namespace, deadline budget) built through the tenant plumbing
+              each of those layers grew: ``SupervisedSolver(tenant=...)``,
+              ``forensics.dump_quarantine(tenant=...)``,
+              ``snapshot.journal_path(namespace=...)``,
+              ``faults.tenant_scope``. A fault in one tenant's stream trips
+              that tenant's circuit and quarantines into that tenant's ring;
+              the chaos suite (tools/chaos_sweep.py tenant-isolation row)
+              proves the blast radius stops there.
+  fairness    a single dispatcher thread drains the per-tenant bounded
+              queues by deficit-weighted round robin: each sweep a nonempty
+              queue earns ``weight x quantum`` pod-units of deficit and the
+              first stream whose head fits its balance runs. A heavy tenant
+              cannot starve a light one; an idle tenant cannot hoard credit.
+  admission   every request the service cannot serve is CLASSIFIED, never
+              silently dropped: queue-full, predicted-wait, and expired
+              requests resolve as ``overloaded`` outcomes; capacity and
+              shutdown rejections as ``rejected`` (serve_admission_total).
+  batching    shape-compatible cold generic requests from different tenants
+              are opportunistically stacked into one ``batched_screen``
+              device dispatch (serve/batch.py) — the candidate-axis
+              machinery the consolidation screen already compiles, now
+              amortizing across tenants. Every batched lane is full-gated by
+              the validator; any doubt falls back to the tenant's own
+              supervised solve.
+
+Flag contract: the layer activates only through explicit construction or
+``KARPENTER_TPU_SERVE=1``; with the flag unset nothing here is imported by
+the single-tenant path and placements are bit-identical to the pre-serve
+tree (the flag-off kernel census stays exactly 2,394 eqns).
+
+Knobs (all read at construction; see docs/SERVING.md):
+
+  KARPENTER_TPU_SERVE                  enable the serve layer (operator wiring)
+  KARPENTER_TPU_SERVE_MAX_TENANTS      tenant capacity + metric-label bound (16)
+  KARPENTER_TPU_SERVE_QUEUE_DEPTH      per-tenant queue bound (8)
+  KARPENTER_TPU_SERVE_QUANTUM          DWRR pod-units earned per sweep (64)
+  KARPENTER_TPU_SERVE_WEIGHTS          per-tenant weights, "a=4,b=1"
+  KARPENTER_TPU_SERVE_ADMIT_DEADLINE_S predicted-wait shed bound (0 = off)
+  KARPENTER_TPU_SERVE_BATCH            cross-tenant stacking (1)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+
+def enabled() -> bool:
+    """The operator wires a SolveService only when this is set; the flag-off
+    process never constructs the layer (zero overhead, identical programs)."""
+    return os.environ.get("KARPENTER_TPU_SERVE", "") not in ("", "0")
+
+
+def max_tenants() -> int:
+    try:
+        return max(1, int(os.environ.get("KARPENTER_TPU_SERVE_MAX_TENANTS", "16")))
+    except ValueError:
+        return 16
+
+
+def queue_depth() -> int:
+    try:
+        return max(1, int(os.environ.get("KARPENTER_TPU_SERVE_QUEUE_DEPTH", "8")))
+    except ValueError:
+        return 8
+
+
+def quantum() -> float:
+    try:
+        return max(1.0, float(os.environ.get("KARPENTER_TPU_SERVE_QUANTUM", "64")))
+    except ValueError:
+        return 64.0
+
+
+def admit_deadline_s() -> float:
+    try:
+        return float(os.environ.get("KARPENTER_TPU_SERVE_ADMIT_DEADLINE_S", "0"))
+    except ValueError:
+        return 0.0
+
+
+def batching_enabled() -> bool:
+    return os.environ.get("KARPENTER_TPU_SERVE_BATCH", "1") not in ("", "0")
+
+
+def parse_weights(spec: Optional[str] = None) -> Dict[str, float]:
+    """``KARPENTER_TPU_SERVE_WEIGHTS="a=4,b=1"`` -> {"a": 4.0, "b": 1.0}.
+    Malformed entries are skipped (an operator typo must not take down the
+    service); unlisted tenants default to weight 1."""
+    if spec is None:
+        spec = os.environ.get("KARPENTER_TPU_SERVE_WEIGHTS", "")
+    out: Dict[str, float] = {}
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry or "=" not in entry:
+            continue
+        name, _, raw = entry.partition("=")
+        try:
+            weight = float(raw)
+        except ValueError:
+            continue
+        if name.strip() and weight > 0:
+            out[name.strip()] = weight
+    return out
+
+
+# The live service this process is running, if any — serving.py's
+# /debug/tenants resolves through here when the OperatorStatus was not
+# explicitly wired with one. Plain module global, set/cleared by
+# SolveService.start()/close() (one serve layer per process is the model,
+# matching the one-process-one-device assumption everywhere else).
+_current = None
+
+
+def current_service():
+    return _current
+
+
+def _set_current(service) -> None:
+    global _current
+    _current = service
+
+
+from karpenter_tpu.serve.dispatcher import (  # noqa: E402  (re-export)
+    ServeOutcome,
+    SolveService,
+    Ticket,
+)
+from karpenter_tpu.serve.tenant import TenantState, build_tenant_solver  # noqa: E402
+
+__all__ = [
+    "ServeOutcome",
+    "SolveService",
+    "TenantState",
+    "Ticket",
+    "admit_deadline_s",
+    "batching_enabled",
+    "build_tenant_solver",
+    "current_service",
+    "enabled",
+    "max_tenants",
+    "parse_weights",
+    "quantum",
+    "queue_depth",
+]

@@ -1,0 +1,260 @@
+"""Cross-tenant opportunistic batching: stack compatible solves on device.
+
+``parallel/mesh.batched_screen`` already compiles a vmapped multi-pass FFD
+over a [B] candidate axis for the consolidation screen. The serve dispatcher
+reuses that exact program across TENANTS: when several streams have cold,
+generic, shape-compatible requests queued at the same instant, they stack
+into one device dispatch and amortize the launch + transfer overhead B ways.
+
+Strictly opportunistic, never load-bearing:
+
+  * only structurally simple requests qualify (``batchable``): no existing
+    nodes, no overrides/volumes/topology arguments, nothing relaxable, a
+    cold warm-state stream, a closed circuit, and a real JaxSolver at the
+    bottom of the tenant's stack. Everything else takes the tenant's own
+    supervised solve untouched.
+  * every decoded lane passes the FULL-level validator gate before it is
+    returned; a violation (or any shape mismatch, slot overflow, or
+    exception anywhere in the stacked path) silently stands that lane down
+    to the solo path — ``serve_batch_total{result="fallback"}``.
+  * batched results never seed streaming state; the tenant stays cold, so
+    a later warm cycle diffs against nothing this path produced.
+  * fault injection disables stacking wholesale (``faults.active()``): the
+    chaos suite's per-tenant blast-radius proof must see one stream per
+    solve site.
+
+The decode mirrors solver/jax_backend.py's final decode (rows via
+``meta.pod_order``, claims via the carried slot tensors and
+``decode_claim_requirements``) for the restricted no-nodes case.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.provisioning.preferences import Preferences
+from karpenter_tpu.provisioning.topology import Topology
+from karpenter_tpu.solver import validator as val
+from karpenter_tpu.solver.backend import (
+    FAIL_INCOMPATIBLE,
+    Placement,
+    SolveResult,
+)
+from karpenter_tpu.solver.encode import Encoder, domains_from_instance_types
+from karpenter_tpu.ops.ffd import KIND_CLAIM, KIND_NEW_CLAIM, KIND_NODE, KIND_NO_SLOT
+from karpenter_tpu.ops.padding import claim_axis_bucket, pad_problem
+from karpenter_tpu.testing import faults
+
+# generic-kwargs contract a batchable request must satisfy: anything beyond
+# these defaults (pinned nodes, overrides, explicit topology, volumes) keeps
+# the request on the tenant's own solve path
+_GENERIC_KWARGS = {
+    "nodes": (),
+    "pod_requirements_override": None,
+    "topology": None,
+    "cluster_pods": (),
+    "domains": None,
+    "pod_volumes": None,
+}
+
+
+def _unwrap_inner(solver):
+    """Walk SupervisedSolver.primary -> StreamingSolver.inner to the backend
+    that would actually run, plus the streaming layer if present."""
+    streaming = None
+    seen = set()
+    while id(solver) not in seen:
+        seen.add(id(solver))
+        if hasattr(solver, "primary"):
+            solver = solver.primary
+            continue
+        if hasattr(solver, "inner") and hasattr(solver, "reset_streaming_state"):
+            streaming = solver
+            solver = solver.inner
+            continue
+        break
+    return solver, streaming
+
+
+def batchable(request, solver) -> bool:
+    """Can this request ride a cross-tenant stacked dispatch? Conservative by
+    design: a False here costs one solo solve, a wrong True could cost
+    correctness."""
+    if faults.active() is not None:
+        return False
+    if not request.pods:
+        return False
+    for key, default in _GENERIC_KWARGS.items():
+        value = request.kwargs.get(key, default)
+        if key in ("nodes", "cluster_pods"):
+            if len(value or ()) != 0:
+                return False
+        elif value is not None:
+            return False
+    # anything relaxable needs the per-pass host relax loop (mirrors the
+    # backend's use_sweeps condition)
+    if any(
+        t.effect == "PreferNoSchedule"
+        for tpl in request.templates
+        for t in tpl.taints
+    ):
+        return False
+    if any(Preferences.is_relaxable(p) for p in request.pods):
+        return False
+    circuit = getattr(solver, "circuit_state", None)
+    if circuit is not None and circuit() != "closed":
+        return False
+    inner, streaming = _unwrap_inner(solver)
+    if streaming is not None and streaming._prev is not None:
+        # a warm stream's next answer depends on carried state; only cold
+        # streams can take the stateless stacked path
+        return False
+    from karpenter_tpu.solver.jax_backend import JaxSolver
+
+    return isinstance(inner, JaxSolver)
+
+
+def _shape_key(problem) -> tuple:
+    return tuple(
+        (tuple(leaf.shape), str(getattr(leaf, "dtype", type(leaf).__name__)))
+        for leaf in jax.tree_util.tree_leaves(problem)
+    )
+
+
+def _decode_lane(
+    pods, instance_types, templates, meta, max_claims,
+    kinds, indices,
+    claim_open, claim_tpl, claim_it_ok, claim_requests,
+    claim_adm, claim_comp, claim_gt, claim_lt, claim_def,
+) -> Optional[SolveResult]:
+    """One lane of the stacked result back into the host model — the
+    jax_backend final decode restricted to the no-existing-nodes case.
+    Returns None (fall back to solo) on slot overflow."""
+    from karpenter_tpu.solver.jax_backend import decode_claim_requirements
+
+    n_real = len(meta.pod_order)
+    if (np.asarray(kinds[:n_real]) == KIND_NO_SLOT).any():
+        return None
+    out = SolveResult()
+    slot_to_claim = {}
+    for slot in range(max_claims):
+        if slot < len(claim_open) and claim_open[slot]:
+            tpl_idx = int(claim_tpl[slot])
+            placement = Placement(
+                template_index=tpl_idx,
+                nodepool_name=meta.template_names[tpl_idx],
+                instance_type_indices=[
+                    int(t)
+                    for t in np.flatnonzero(claim_it_ok[slot])
+                    if t < len(meta.instance_type_names)
+                ],
+                requirements=decode_claim_requirements(
+                    meta, claim_adm[slot], claim_comp[slot],
+                    claim_gt[slot], claim_lt[slot], claim_def[slot],
+                ),
+                requests={
+                    name: float(claim_requests[slot, ri])
+                    for ri, name in enumerate(meta.resource_names)
+                    if claim_requests[slot, ri] > 0
+                },
+            )
+            slot_to_claim[slot] = placement
+            out.new_claims.append(placement)
+    failed = []
+    for row in range(n_real):
+        orig = meta.pod_order[row]
+        kind, index = int(kinds[row]), int(indices[row])
+        if kind == KIND_NODE:
+            out.node_pods.setdefault(meta.node_names[index], []).append(orig)
+        elif kind in (KIND_CLAIM, KIND_NEW_CLAIM) and index in slot_to_claim:
+            slot_to_claim[index].pod_indices.append(orig)
+        else:
+            failed.append(orig)
+    if failed:
+        from karpenter_tpu.solver.forensics import failure_reason
+
+        for orig in failed:
+            out.failures[orig] = failure_reason(
+                pods[orig], instance_types, templates,
+                well_known=wk.WELL_KNOWN_LABELS,
+            ) or FAIL_INCOMPATIBLE
+    # claims no pod landed in would launch empty capacity — stand down
+    # instead (the solo path never produces them)
+    if any(not c.pod_indices for c in out.new_claims):
+        return None
+    return out
+
+
+def stacked_solve(group: Sequence) -> List[Optional[SolveResult]]:
+    """Solve a group of batchable requests in one ``batched_screen``
+    dispatch. Returns one entry per request: a validator-clean SolveResult,
+    or None where the stacked path stood down (that request then runs its
+    tenant's ordinary solo solve). Never raises — any failure in here is a
+    fallback, not an outage."""
+    results: List[Optional[SolveResult]] = [None] * len(group)
+    if len(group) < 2:
+        return results
+    try:
+        from karpenter_tpu.parallel.mesh import batched_screen, stack_problems
+
+        shared_claims = max(
+            claim_axis_bucket(len(r.pods)) for r in group
+        )
+        encoded = []
+        for r in group:
+            domains = domains_from_instance_types(r.instance_types, r.templates)
+            topo = Topology(domains, batch_pods=list(r.pods), cluster_pods=())
+            enc = Encoder(wk.WELL_KNOWN_LABELS).encode(
+                list(r.pods), r.instance_types, r.templates, (),
+                topology=topo, num_claim_slots=shared_claims,
+                vocab_pods=list(r.pods),
+            )
+            encoded.append((pad_problem(enc.problem), enc.meta))
+        key0 = _shape_key(encoded[0][0])
+        lanes = [
+            i for i in range(len(group)) if _shape_key(encoded[i][0]) == key0
+        ]
+        if len(lanes) < 2:
+            return results
+        batch = stack_problems([encoded[i][0] for i in lanes])
+        fr = batched_screen(batch, shared_claims)
+        state = fr.state
+        fetched = jax.device_get((
+            fr.kind, fr.index,
+            state.claim_open, state.claim_tpl, state.claim_it_ok,
+            state.claim_requests, state.claim_req.admitted,
+            state.claim_req.comp, state.claim_req.gt,
+            state.claim_req.lt, state.claim_req.defined,
+        ))
+        (kinds, indices, claim_open, claim_tpl, claim_it_ok,
+         claim_requests, claim_adm, claim_comp, claim_gt, claim_lt,
+         claim_def) = fetched
+        for li, i in enumerate(lanes):
+            r = group[i]
+            try:
+                decoded = _decode_lane(
+                    list(r.pods), r.instance_types, r.templates,
+                    encoded[i][1], shared_claims,
+                    kinds[li], indices[li],
+                    claim_open[li], claim_tpl[li], claim_it_ok[li],
+                    claim_requests[li], claim_adm[li], claim_comp[li],
+                    claim_gt[li], claim_lt[li], claim_def[li],
+                )
+                if decoded is None:
+                    continue
+                violations = val.validate_result(
+                    decoded, list(r.pods), r.instance_types, r.templates,
+                    nodes=(), level="full",
+                )
+                if violations:
+                    continue
+                results[i] = decoded
+            except Exception:  # noqa: BLE001 — one bad lane must not sink the rest
+                continue
+        return results
+    except Exception:  # noqa: BLE001 — the stacked path degrades, never breaks
+        return [None] * len(group)

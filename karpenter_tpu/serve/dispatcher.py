@@ -1,0 +1,570 @@
+"""The serve dispatcher: bounded queues, DWRR fairness, classified admission.
+
+One dispatcher thread drains every tenant's queue — solves are serialized
+onto the device exactly as the single-tenant operator serializes cycles, so
+per-tenant solver state needs no locking and device contention is structural,
+not emergent. Fairness and isolation live at the queue boundary:
+
+  admission (``submit``, caller's thread)
+      a request is either queued or resolved immediately with a CLASSIFIED
+      outcome: ``overloaded-queue-full`` (its tenant's bounded queue is
+      full), ``overloaded-predicted-wait`` (the queue-wait estimate already
+      exceeds the admit/request deadline — shedding at the door beats
+      timing out after burning device time), ``rejected-max-tenants``,
+      ``rejected-shutdown``. serve_admission_total counts every decision.
+
+  fairness (``_collect``, dispatcher thread)
+      deficit-weighted round robin in pod-units: when no stream can afford
+      its head request, every backlogged stream earns ``weight x quantum``;
+      the rotation then serves each stream while its balance lasts. An
+      emptied queue forfeits its balance (no hoarding credit while idle).
+
+  execution (``_execute``)
+      the request's wall-clock budget (explicit per-request deadline, else
+      the tenant's default) is inherited by the solve: the tenant solver's
+      watchdog deadline is narrowed to the REMAINING budget for the call.
+      Already-expired requests resolve as ``overloaded-expired`` without
+      touching the device. Cross-tenant batchable groups take one stacked
+      device dispatch (serve/batch.py) with per-lane solo fallback.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from karpenter_tpu.metrics.registry import (
+    SERVE_ADMISSION,
+    SERVE_BATCH,
+    SERVE_CYCLE_SECONDS,
+    SERVE_CYCLES,
+    SERVE_FAIRNESS_DEFICIT,
+    SERVE_QUEUE_DEPTH,
+)
+from karpenter_tpu.solver.backend import SolveResult
+
+# classified admission / completion outcome vocabulary (the bounded metric
+# label-value sets; tools/metrics_lint.py checks the tenant axis separately)
+STATUS_OK = "ok"
+STATUS_OVERLOADED = "overloaded"
+STATUS_REJECTED = "rejected"
+STATUS_ERROR = "error"
+STATUS_PENDING = "pending"
+
+ADMIT_ACCEPTED = "accepted"
+ADMIT_QUEUE_FULL = "overloaded-queue-full"
+ADMIT_PREDICTED_WAIT = "overloaded-predicted-wait"
+ADMIT_EXPIRED = "overloaded-expired"
+ADMIT_MAX_TENANTS = "rejected-max-tenants"
+ADMIT_SHUTDOWN = "rejected-shutdown"
+
+# wait-estimate smoothing: heavily weighted to history so one fast warm
+# solve doesn't swing the admission gate open mid-overload
+_EWMA_ALPHA = 0.2
+
+# a stacked dispatch wider than this stops amortizing and starts inflating
+# the padded batch (and one lane's latency holds every lane hostage)
+_MAX_BATCH_LANES = 8
+
+
+@dataclass
+class ServeOutcome:
+    """What a submitted request resolved to. ``status`` is always one of the
+    STATUS_* constants; an unserved request carries its admission class in
+    ``reason`` — the caller can always tell shed from failed from served."""
+
+    status: str
+    tenant: str = ""
+    reason: str = ""
+    result: Optional[SolveResult] = None
+    latency_s: float = 0.0
+    path: str = ""  # "solo" | "batched" | "" (never solved)
+
+
+class Ticket:
+    """The caller's handle on a submitted request."""
+
+    def __init__(self, tenant: str):
+        self._tenant = tenant
+        self._event = threading.Event()
+        self._outcome: Optional[ServeOutcome] = None
+
+    def resolve(self, outcome: ServeOutcome) -> None:
+        self._outcome = outcome
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> ServeOutcome:
+        """Block for the outcome; a timeout returns a non-final ``pending``
+        outcome (the request is still queued or running)."""
+        if self._event.wait(timeout):
+            assert self._outcome is not None
+            return self._outcome
+        return ServeOutcome(status=STATUS_PENDING, tenant=self._tenant)
+
+
+@dataclass
+class _Request:
+    tenant: str
+    pods: Sequence
+    instance_types: Sequence
+    templates: Sequence
+    kwargs: Dict
+    deadline_s: float  # effective wall budget (0 = none)
+    submitted_at: float
+    ticket: Ticket
+    cost: float = field(init=False)
+
+    def __post_init__(self):
+        # DWRR service cost in pod-units: fairness is about device time,
+        # which scales with batch size, not request count
+        self.cost = float(max(1, len(self.pods)))
+
+
+class SolveService:
+    """The multi-tenant solve service. Construct explicitly (tests, bench,
+    chaos) or let the operator wire it under ``KARPENTER_TPU_SERVE=1``."""
+
+    def __init__(
+        self,
+        solver_factory=None,
+        max_tenants: Optional[int] = None,
+        queue_depth: Optional[int] = None,
+        quantum: Optional[float] = None,
+        admit_deadline_s: Optional[float] = None,
+        weights: Optional[Dict[str, float]] = None,
+        batching: Optional[bool] = None,
+        time_fn=time.monotonic,
+    ):
+        from karpenter_tpu import serve as cfg
+        from karpenter_tpu.serve.tenant import build_tenant_solver
+
+        self._solver_factory = solver_factory or build_tenant_solver
+        self.max_tenants = max_tenants if max_tenants is not None else cfg.max_tenants()
+        self.queue_depth = queue_depth if queue_depth is not None else cfg.queue_depth()
+        self.quantum = quantum if quantum is not None else cfg.quantum()
+        self.admit_deadline_s = (
+            admit_deadline_s
+            if admit_deadline_s is not None
+            else cfg.admit_deadline_s()
+        )
+        self.weights = weights if weights is not None else cfg.parse_weights()
+        self.batching = batching if batching is not None else cfg.batching_enabled()
+        self._time = time_fn
+        self._cond = threading.Condition()
+        self._tenants: Dict[str, "TenantState"] = {}
+        self._order: List[str] = []  # DWRR rotation
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._ewma_solve_s = 0.0
+
+    # -- tenant registry ------------------------------------------------------
+
+    def register_tenant(
+        self,
+        tenant_id: str,
+        weight: Optional[float] = None,
+        deadline_s: float = 0.0,
+        solver=None,
+    ):
+        """Create (or return) a tenant stream. Raises ValueError at the
+        tenant capacity bound — ``submit`` classifies that as
+        ``rejected-max-tenants`` instead of raising at the caller."""
+        from karpenter_tpu.serve.tenant import TenantState
+
+        with self._cond:
+            existing = self._tenants.get(tenant_id)
+            if existing is not None:
+                return existing
+            if len(self._tenants) >= self.max_tenants:
+                raise ValueError(
+                    f"tenant capacity {self.max_tenants} reached "
+                    f"(KARPENTER_TPU_SERVE_MAX_TENANTS)"
+                )
+            state = TenantState(
+                tenant_id,
+                solver if solver is not None else self._solver_factory(tenant_id),
+                weight=(
+                    weight
+                    if weight is not None
+                    else self.weights.get(tenant_id, 1.0)
+                ),
+                deadline_s=deadline_s,
+                queue_depth=self.queue_depth,
+            )
+            self._tenants[tenant_id] = state
+            self._order.append(tenant_id)
+            return state
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "SolveService":
+        from karpenter_tpu import serve as cfg
+
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("SolveService is closed")
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True,
+                    name="karpenter-tpu/serve-dispatcher",
+                )
+                self._thread.start()
+        cfg._set_current(self)
+        return self
+
+    def close(self, timeout: float = 10.0) -> None:
+        """Stop dispatching and resolve everything still queued as
+        ``rejected-shutdown`` — shutdown shedding is classified like any
+        other unserved outcome."""
+        from karpenter_tpu import serve as cfg
+
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+        drained: List[_Request] = []
+        with self._cond:
+            for state in self._tenants.values():
+                while state.queue:
+                    drained.append(state.queue.popleft())
+                    state.counters["shed"] += 1
+                SERVE_QUEUE_DEPTH.set(0, {"tenant": state.id})
+        for req in drained:
+            SERVE_ADMISSION.inc({"tenant": req.tenant, "outcome": ADMIT_SHUTDOWN})
+            req.ticket.resolve(ServeOutcome(
+                status=STATUS_REJECTED, tenant=req.tenant, reason=ADMIT_SHUTDOWN,
+            ))
+        if cfg.current_service() is self:
+            cfg._set_current(None)
+
+    def healthy(self) -> bool:
+        """Readiness contribution: closed or a dead dispatcher thread means
+        queued requests would wait forever."""
+        with self._cond:
+            if self._closed:
+                return False
+            return self._thread is None or self._thread.is_alive()
+
+    # -- admission ------------------------------------------------------------
+
+    def submit(
+        self,
+        tenant_id: str,
+        pods: Sequence,
+        instance_types: Sequence,
+        templates: Sequence,
+        deadline_s: Optional[float] = None,
+        **kwargs,
+    ) -> Ticket:
+        """Admit one solve request. Always returns a Ticket; an unadmitted
+        request's ticket is already resolved with its classification."""
+        ticket = Ticket(tenant_id)
+
+        def refuse(status: str, outcome: str, known_tenant: bool) -> Ticket:
+            # the tenant label stays bounded: unregistered ids never mint a
+            # series (rejected-max-tenants is exactly the unregistered case)
+            label = tenant_id if known_tenant else "-"
+            SERVE_ADMISSION.inc({"tenant": label, "outcome": outcome})
+            ticket.resolve(ServeOutcome(
+                status=status, tenant=tenant_id, reason=outcome,
+            ))
+            return ticket
+
+        with self._cond:
+            if self._closed:
+                return refuse(
+                    STATUS_REJECTED, ADMIT_SHUTDOWN,
+                    tenant_id in self._tenants,
+                )
+            state = self._tenants.get(tenant_id)
+            if state is None:
+                try:
+                    state = self.register_tenant(tenant_id)
+                except ValueError:
+                    return refuse(STATUS_REJECTED, ADMIT_MAX_TENANTS, False)
+            effective_deadline = (
+                deadline_s if deadline_s is not None else state.deadline_s
+            ) or 0.0
+            if len(state.queue) >= state.queue_depth:
+                state.counters["shed"] += 1
+                return refuse(STATUS_OVERLOADED, ADMIT_QUEUE_FULL, True)
+            # predicted-wait shedding: with a wait bound configured (the
+            # service-wide admit deadline and/or this request's own budget)
+            # and a solve-time estimate in hand, a request that would wait
+            # past its bound is shed NOW instead of expiring in queue
+            bound = min(
+                self.admit_deadline_s or float("inf"),
+                effective_deadline or float("inf"),
+            )
+            if bound != float("inf") and self._ewma_solve_s > 0:
+                backlog = sum(len(t.queue) for t in self._tenants.values())
+                if backlog * self._ewma_solve_s > bound:
+                    state.counters["shed"] += 1
+                    return refuse(STATUS_OVERLOADED, ADMIT_PREDICTED_WAIT, True)
+            req = _Request(
+                tenant=tenant_id, pods=pods, instance_types=instance_types,
+                templates=templates, kwargs=kwargs,
+                deadline_s=effective_deadline, submitted_at=self._time(),
+                ticket=ticket,
+            )
+            state.queue.append(req)
+            state.counters["submitted"] += 1
+            SERVE_ADMISSION.inc({"tenant": tenant_id, "outcome": ADMIT_ACCEPTED})
+            SERVE_QUEUE_DEPTH.set(len(state.queue), {"tenant": tenant_id})
+            started = self._thread is not None
+            self._cond.notify_all()
+        if not started:
+            self.start()
+        return ticket
+
+    def solve(
+        self,
+        tenant_id: str,
+        pods: Sequence,
+        instance_types: Sequence,
+        templates: Sequence,
+        deadline_s: Optional[float] = None,
+        timeout: Optional[float] = None,
+        **kwargs,
+    ) -> ServeOutcome:
+        """submit + wait: the blocking convenience the churn streams use."""
+        return self.submit(
+            tenant_id, pods, instance_types, templates,
+            deadline_s=deadline_s, **kwargs,
+        ).wait(timeout)
+
+    # -- dispatch loop --------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._closed and not any(
+                    t.queue for t in self._tenants.values()
+                ):
+                    self._cond.wait(0.5)
+                if self._closed:
+                    return
+                picked, cobatch = self._collect_locked()
+            if picked is None:
+                continue
+            self._execute(picked, cobatch)
+
+    def _pop_locked(self, state) -> Optional[_Request]:
+        """Pop a tenant's head request, resolving it immediately when its
+        wall budget already expired in queue (``overloaded-expired`` — the
+        device never sees it). Returns None when the pop produced no
+        runnable request."""
+        req = state.queue.popleft()
+        SERVE_QUEUE_DEPTH.set(len(state.queue), {"tenant": state.id})
+        if req.deadline_s > 0 and (
+            self._time() - req.submitted_at
+        ) >= req.deadline_s:
+            state.counters["shed"] += 1
+            SERVE_ADMISSION.inc(
+                {"tenant": state.id, "outcome": ADMIT_EXPIRED}
+            )
+            req.ticket.resolve(ServeOutcome(
+                status=STATUS_OVERLOADED, tenant=state.id,
+                reason=ADMIT_EXPIRED,
+                latency_s=self._time() - req.submitted_at,
+            ))
+            return None
+        return req
+
+    def _collect_locked(self) -> Tuple[Optional[_Request], List[_Request]]:
+        """One DWRR decision. Sweeps the rotation for a stream whose balance
+        covers its head request; when none can afford theirs, every
+        backlogged stream earns weight x quantum and the sweep repeats
+        (guaranteed to terminate: balances grow, costs don't)."""
+        while True:
+            backlogged = False
+            for tenant_id in list(self._order):
+                state = self._tenants[tenant_id]
+                if not state.queue:
+                    # idle streams don't bank credit
+                    if state.deficit:
+                        state.deficit = 0.0
+                        SERVE_FAIRNESS_DEFICIT.set(0.0, {"tenant": tenant_id})
+                    continue
+                backlogged = True
+                if state.queue[0].cost > state.deficit:
+                    continue
+                req = self._pop_locked(state)
+                # served (or expired): this stream yields the rotation
+                self._order.remove(tenant_id)
+                self._order.append(tenant_id)
+                if req is None:
+                    return None, []
+                state.deficit -= req.cost
+                SERVE_FAIRNESS_DEFICIT.set(
+                    state.deficit, {"tenant": tenant_id}
+                )
+                return req, self._gather_cobatch_locked(req, state)
+            if not backlogged:
+                return None, []
+            for tenant_id in self._order:
+                state = self._tenants[tenant_id]
+                if state.queue:
+                    state.deficit += state.weight * self.quantum
+                    SERVE_FAIRNESS_DEFICIT.set(
+                        state.deficit, {"tenant": tenant_id}
+                    )
+
+    def _gather_cobatch_locked(self, lead: _Request, lead_state) -> List[_Request]:
+        """Other tenants' batchable heads that can ride the lead request's
+        device dispatch — each still pays its own deficit (stacking changes
+        the dispatch, not the accounting)."""
+        from karpenter_tpu.serve import batch as xbatch
+
+        if not self.batching:
+            return []
+        if not xbatch.batchable(lead, lead_state.solver):
+            return []
+        out: List[_Request] = []
+        for tenant_id in list(self._order):
+            if len(out) + 1 >= _MAX_BATCH_LANES:
+                break
+            state = self._tenants[tenant_id]
+            if state is lead_state or not state.queue:
+                continue
+            head = state.queue[0]
+            if head.cost > state.deficit:
+                continue
+            if not xbatch.batchable(head, state.solver):
+                continue
+            req = self._pop_locked(state)
+            if req is None:
+                continue
+            state.deficit -= req.cost
+            SERVE_FAIRNESS_DEFICIT.set(state.deficit, {"tenant": tenant_id})
+            out.append(req)
+        return out
+
+    # -- execution ------------------------------------------------------------
+
+    def _execute(self, lead: _Request, cobatch: List[_Request]) -> None:
+        group = [lead] + cobatch
+        stacked: List[Optional[SolveResult]] = [None] * len(group)
+        if len(group) > 1:
+            from karpenter_tpu.serve import batch as xbatch
+
+            stacked = xbatch.stacked_solve(group)
+        for req, pre in zip(group, stacked):
+            if pre is not None:
+                SERVE_BATCH.inc({"result": "hit"})
+                self._finish_ok(req, pre, path="batched")
+            else:
+                if len(group) > 1:
+                    SERVE_BATCH.inc({"result": "fallback"})
+                self._execute_solo(req)
+
+    def _execute_solo(self, req: _Request) -> None:
+        state = self._tenants[req.tenant]
+        solver = state.solver
+        # deadline inheritance: the tenant watchdog gets the REMAINING wall
+        # budget for this call (never widened past its configured value)
+        configured = getattr(solver, "deadline_s", None)
+        override = configured is not None and req.deadline_s > 0
+        if override:
+            remaining = req.deadline_s - (self._time() - req.submitted_at)
+            if remaining <= 0:
+                state.counters["shed"] += 1
+                SERVE_ADMISSION.inc(
+                    {"tenant": req.tenant, "outcome": ADMIT_EXPIRED}
+                )
+                req.ticket.resolve(ServeOutcome(
+                    status=STATUS_OVERLOADED, tenant=req.tenant,
+                    reason=ADMIT_EXPIRED,
+                    latency_s=self._time() - req.submitted_at,
+                ))
+                return
+            solver.deadline_s = (
+                min(configured, remaining) if configured > 0 else remaining
+            )
+        try:
+            result = solver.solve(
+                req.pods, req.instance_types, req.templates, **req.kwargs
+            )
+        except Exception as exc:  # noqa: BLE001 — a tenant solve must never kill the loop
+            state.counters["errors"] += 1
+            req.ticket.resolve(ServeOutcome(
+                status=STATUS_ERROR, tenant=req.tenant,
+                reason=f"{type(exc).__name__}: {exc}",
+                latency_s=self._time() - req.submitted_at, path="solo",
+            ))
+            return
+        finally:
+            if override:
+                solver.deadline_s = configured
+        self._finish_ok(req, result, path="solo")
+
+    def _finish_ok(self, req: _Request, result: SolveResult, path: str) -> None:
+        state = self._tenants[req.tenant]
+        latency = self._time() - req.submitted_at
+        state.counters["completed"] += 1
+        if path == "batched":
+            state.counters["batched"] += 1
+        state.record_latency(latency)
+        self._ewma_solve_s = (
+            latency
+            if self._ewma_solve_s == 0
+            else (1 - _EWMA_ALPHA) * self._ewma_solve_s + _EWMA_ALPHA * latency
+        )
+        SERVE_CYCLES.inc({"tenant": req.tenant, "path": path})
+        SERVE_CYCLE_SECONDS.observe(latency)
+        req.ticket.resolve(ServeOutcome(
+            status=STATUS_OK, tenant=req.tenant, result=result,
+            latency_s=latency, path=path,
+        ))
+
+    # -- introspection (/debug/tenants, /statusz) -----------------------------
+
+    def snapshot(self) -> Dict:
+        with self._cond:
+            tenants = [
+                self._tenants[tid].snapshot() for tid in self._order
+            ]
+            return {
+                "closed": self._closed,
+                "dispatcher_alive": (
+                    self._thread is not None and self._thread.is_alive()
+                ),
+                "batching": self.batching,
+                "quantum": self.quantum,
+                "queue_depth": self.queue_depth,
+                "max_tenants": self.max_tenants,
+                "admit_deadline_s": self.admit_deadline_s,
+                "ewma_solve_s": round(self._ewma_solve_s, 6),
+                "tenants": tenants,
+            }
+
+    def summary(self) -> Dict:
+        """The /statusz serve section: fleet totals, not per-tenant rows
+        (those live in /debug/tenants)."""
+        with self._cond:
+            totals = {"submitted": 0, "completed": 0, "shed": 0, "errors": 0,
+                      "batched": 0}
+            queued = 0
+            circuits: Dict[str, int] = {}
+            for state in self._tenants.values():
+                queued += len(state.queue)
+                for key in totals:
+                    totals[key] += state.counters[key]
+                circuit = state.circuit_state()
+                if circuit is not None:
+                    circuits[circuit] = circuits.get(circuit, 0) + 1
+            return {
+                "tenants": len(self._tenants),
+                "queued": queued,
+                "healthy": self.healthy(),
+                "batching": self.batching,
+                "circuits": circuits,
+                **totals,
+            }

@@ -198,6 +198,29 @@ def _quarantine_max() -> int:
         return 32
 
 
+def _tenant_quarantine_max() -> int:
+    """Per-tenant ring size (KARPENTER_TPU_QUARANTINE_TENANT_MAX, default 8).
+    Tenant dumps live in their own ``tenant-<id>/`` namespace with their own
+    cap, so one noisy tenant can only ever evict its OWN forensics — the
+    global ring used to be oldest-first across all dumps, which let a
+    crash-looping tenant erase every other tenant's evidence."""
+    import os
+
+    try:
+        return max(
+            1, int(os.environ.get("KARPENTER_TPU_QUARANTINE_TENANT_MAX", "8"))
+        )
+    except ValueError:
+        return 8
+
+
+def _tenant_dirname(tenant: str) -> str:
+    """Filesystem-safe namespace directory for a tenant's quarantine ring."""
+    import re
+
+    return "tenant-" + re.sub(r"[^A-Za-z0-9._-]", "-", tenant)
+
+
 def _evict_quarantine(directory: str, keep: int) -> None:
     """Oldest-first eviction down to ``keep`` files. The timestamp-pid-seq
     filename sorts lexicographically wrong across epochs of different digit
@@ -226,14 +249,17 @@ def dump_quarantine(
     backend: str = "",
     directory: Optional[str] = None,
     parent_trace_id: Optional[str] = None,
+    tenant: Optional[str] = None,
 ) -> Optional[str]:
     """Write a rejected SolveResult to a forensics JSON file so a bad
     placement can be diagnosed offline after the supervisor failed over.
     Directory: ``KARPENTER_TPU_QUARANTINE_DIR`` (default
     /tmp/karpenter-tpu-quarantine), bounded to the newest
-    ``KARPENTER_TPU_QUARANTINE_MAX`` dumps (oldest evicted first).
-    Best-effort — quarantine must never be the thing that breaks the
-    failover path — returns the path or None."""
+    ``KARPENTER_TPU_QUARANTINE_MAX`` dumps (oldest evicted first). With a
+    ``tenant``, the dump lands in that tenant's ``tenant-<id>/`` namespace
+    with its own ``KARPENTER_TPU_QUARANTINE_TENANT_MAX`` ring — eviction
+    never crosses tenant boundaries. Best-effort — quarantine must never be
+    the thing that breaks the failover path — returns the path or None."""
     import json
     import os
     import time
@@ -242,6 +268,10 @@ def dump_quarantine(
     directory = directory or os.environ.get(
         "KARPENTER_TPU_QUARANTINE_DIR", "/tmp/karpenter-tpu-quarantine"
     )
+    keep = _quarantine_max()
+    if tenant:
+        directory = os.path.join(directory, _tenant_dirname(tenant))
+        keep = _tenant_quarantine_max()
     try:
         os.makedirs(directory, exist_ok=True)
         _quarantine_seq += 1
@@ -253,6 +283,7 @@ def dump_quarantine(
 
         payload = {
             "backend": backend,
+            "tenant": tenant,
             # the solve cycle that produced this rejected result — grep the
             # id across /debug/traces and logs to reconstruct the timeline
             "trace_id": trace.current_trace_id(),
@@ -290,25 +321,29 @@ def dump_quarantine(
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
-        _evict_quarantine(directory, _quarantine_max())
+        _evict_quarantine(directory, keep)
         return path
     except Exception:
         return None
 
 
 def load_quarantine(
-    directory: Optional[str] = None, limit: int = 0
+    directory: Optional[str] = None, limit: int = 0,
+    tenant: Optional[str] = None,
 ) -> List[Dict]:
     """Load the quarantine ring, newest first, each payload annotated with
-    its ``_path``. Tolerant by design: unparseable or unreadable files —
-    pre-fix torn dumps, bit rot, concurrent eviction — are SKIPPED, never
-    raised; offline forensics must degrade to the dumps that survived. Use
-    :func:`scan_quarantine` to also see which paths were skipped."""
-    return scan_quarantine(directory, limit)[0]
+    its ``_path``. Walks the shared ring AND every tenant namespace (or just
+    one tenant's with ``tenant=``). Tolerant by design: unparseable or
+    unreadable files — pre-fix torn dumps, bit rot, concurrent eviction —
+    are SKIPPED, never raised; offline forensics must degrade to the dumps
+    that survived. Use :func:`scan_quarantine` to also see which paths were
+    skipped."""
+    return scan_quarantine(directory, limit, tenant)[0]
 
 
 def scan_quarantine(
-    directory: Optional[str] = None, limit: int = 0
+    directory: Optional[str] = None, limit: int = 0,
+    tenant: Optional[str] = None,
 ) -> Tuple[List[Dict], List[str]]:
     """Like :func:`load_quarantine` but also returns the paths that failed
     to parse (so tooling can report how much of the ring was torn)."""
@@ -318,21 +353,36 @@ def scan_quarantine(
     directory = directory or os.environ.get(
         "KARPENTER_TPU_QUARANTINE_DIR", "/tmp/karpenter-tpu-quarantine"
     )
-    try:
-        entries = [
-            (os.path.getmtime(os.path.join(directory, name)), name)
-            for name in os.listdir(directory)
-            if name.startswith("quarantine-") and name.endswith(".json")
-        ]
-    except OSError:
-        return [], []
+    roots = [directory]
+    if tenant:
+        roots = [os.path.join(directory, _tenant_dirname(tenant))]
+    else:
+        try:
+            roots += sorted(
+                os.path.join(directory, name)
+                for name in os.listdir(directory)
+                if name.startswith("tenant-")
+                and os.path.isdir(os.path.join(directory, name))
+            )
+        except OSError:
+            pass
+    entries: List[Tuple[float, str]] = []
+    for root in roots:
+        try:
+            entries += [
+                (os.path.getmtime(os.path.join(root, name)),
+                 os.path.join(root, name))
+                for name in os.listdir(root)
+                if name.startswith("quarantine-") and name.endswith(".json")
+            ]
+        except OSError:
+            continue
     entries.sort(reverse=True)  # newest first
     loaded: List[Dict] = []
     skipped: List[str] = []
-    for _, name in entries:
+    for _, path in entries:
         if limit and len(loaded) >= limit:
             break
-        path = os.path.join(directory, name)
         try:
             with open(path) as f:
                 payload = json.load(f)

@@ -131,7 +131,14 @@ class SupervisedSolver(SolverBackend):
         time_fn=time.monotonic,
         sleep_fn=time.sleep,
         streaming: Optional[bool] = None,
+        tenant: Optional[str] = None,
     ):
+        # ``tenant`` names the stream this supervisor serves under the
+        # multi-tenant layer (serve/): it namespaces the quarantine ring and
+        # journal, labels the circuit/rejection/warm metrics, and scopes
+        # tenant-selected fault rules. None (the default) is byte-identical
+        # to the pre-tenant behavior — no label, shared ring, global faults.
+        self.tenant = tenant
         # KARPENTER_TPU_DELTA=1 (or streaming=True) wraps the primary in the
         # warm-state streaming layer: delta-diffed snapshots re-solve only the
         # churned frontier, with cold fallback above KARPENTER_TPU_DELTA_MAX_FRAC
@@ -143,7 +150,9 @@ class SupervisedSolver(SolverBackend):
             from karpenter_tpu.streaming.warm import StreamingSolver
 
             if not isinstance(primary, StreamingSolver):
-                primary = StreamingSolver(primary)
+                primary = StreamingSolver(primary, tenant=tenant)
+            elif tenant is not None and primary.tenant is None:
+                primary.set_tenant(tenant)
         self.primary = primary
         self.fallback = fallback
         self.deadline_s = (
@@ -196,7 +205,15 @@ class SupervisedSolver(SolverBackend):
             "deadline_exceeded": 0,
             "salvaged": 0,
         }
-        SOLVER_CIRCUIT_STATE.set(0)
+        SOLVER_CIRCUIT_STATE.set(0, self._labels())
+
+    def _labels(self, **labels) -> Optional[Dict[str, str]]:
+        """Metric labels with the tenant folded in. Returns the exact
+        pre-tenant shape (None for no labels) when untenanted, so existing
+        series and their tests stay bit-identical."""
+        if self.tenant is not None:
+            labels["tenant"] = self.tenant
+        return labels or None
 
     # -- public introspection (serving.py /statusz) ---------------------------
 
@@ -216,6 +233,7 @@ class SupervisedSolver(SolverBackend):
         out = {
             "primary": type(self.primary).__name__,
             "fallback": type(self.fallback).__name__ if self.fallback else None,
+            "tenant": self.tenant,
             "circuit": self.circuit_state(),
             "consecutive_failures": self._consecutive_failures,
             "deadline_s": self.deadline_s,
@@ -238,7 +256,7 @@ class SupervisedSolver(SolverBackend):
 
     def _set_circuit(self, state: str) -> None:
         self._circuit = state
-        SOLVER_CIRCUIT_STATE.set(_CIRCUIT_GAUGE[state])
+        SOLVER_CIRCUIT_STATE.set(_CIRCUIT_GAUGE[state], self._labels())
 
     def _route(self) -> str:
         """Where this solve starts: 'primary' (closed, or half-open probe) or
@@ -433,22 +451,35 @@ class SupervisedSolver(SolverBackend):
     def _attempt(self, pods, instance_types, templates, kwargs) -> SolveResult:
         """One primary solve under the watchdog, with solve-site fault
         injection applied (only the primary is ever injected — the fallback
-        must stay trustworthy for the chaos suite to mean anything)."""
-        injector = faults.active()
-        rule = injector.draw("solve") if injector is not None else None
+        must stay trustworthy for the chaos suite to mean anything). A
+        tenanted supervisor runs the whole attempt inside its tenant's fault
+        scope, so tenant-selected rules fire only for this stream (the
+        watchdog worker inherits the scope through copy_context)."""
+        import contextlib
 
-        def call():
-            if rule is not None:
-                if rule.kind == "hang":
-                    time.sleep(rule.param or 30.0)
-                else:
-                    faults.raise_solve_fault(rule)
-            result = self.primary.solve(pods, instance_types, templates, **kwargs)
-            if rule is not None and rule.kind == "nan":
-                faults.corrupt_result(result)
-            return result
+        scope = (
+            faults.tenant_scope(self.tenant)
+            if self.tenant is not None
+            else contextlib.nullcontext()
+        )
+        with scope:
+            injector = faults.active()
+            rule = injector.draw("solve") if injector is not None else None
 
-        result = self._with_deadline(call)
+            def call():
+                if rule is not None:
+                    if rule.kind == "hang":
+                        time.sleep(rule.param or 30.0)
+                    else:
+                        faults.raise_solve_fault(rule)
+                result = self.primary.solve(
+                    pods, instance_types, templates, **kwargs
+                )
+                if rule is not None and rule.kind == "nan":
+                    faults.corrupt_result(result)
+                return result
+
+            result = self._with_deadline(call)
         if val.has_nan(result):
             raise NaNResultError("NaN/inf in result request tensors")
         return result
@@ -498,7 +529,7 @@ class SupervisedSolver(SolverBackend):
         violations = self._device_gate(result, pods, instance_types, templates, kwargs)
         if violations is not None:
             for v in violations:
-                VALIDATOR_REJECTIONS.inc({"invariant": v.invariant})
+                VALIDATOR_REJECTIONS.inc(self._labels(invariant=v.invariant))
             if violations:
                 self.counters["validator_rejections"] += 1
             return violations
@@ -519,7 +550,7 @@ class SupervisedSolver(SolverBackend):
             log.exception("validator crashed; passing result through")
             return []
         for v in violations:
-            VALIDATOR_REJECTIONS.inc({"invariant": v.invariant})
+            VALIDATOR_REJECTIONS.inc(self._labels(invariant=v.invariant))
         if violations:
             self.counters["validator_rejections"] += 1
         return violations
@@ -570,7 +601,7 @@ class SupervisedSolver(SolverBackend):
 
         path = dump_quarantine(
             result, violations, backend=backend,
-            parent_trace_id=self._last_trace_id,
+            parent_trace_id=self._last_trace_id, tenant=self.tenant,
         )
         log.error(
             "validator rejected %s result (%d violation(s), first: %s)%s",

@@ -58,10 +58,18 @@ def enabled() -> bool:
     return bool(os.environ.get("KARPENTER_TPU_STATE_DIR"))
 
 
-def journal_path() -> Optional[str]:
+def journal_path(namespace: Optional[str] = None) -> Optional[str]:
+    """Journal file location; ``namespace`` (the serve layer passes the
+    tenant id) isolates each tenant stream's journal so one tenant's
+    invalidation or corruption can never cost another its warm restart."""
     root = os.environ.get("KARPENTER_TPU_STATE_DIR")
     if not root:
         return None
+    if namespace:
+        import re
+
+        safe = re.sub(r"[^A-Za-z0-9._-]", "-", namespace)
+        return os.path.join(root, "stream", safe, "journal.snap")
     return os.path.join(root, "stream", "journal.snap")
 
 
@@ -89,7 +97,7 @@ def _warn_once(tag: str, msg: str, *args) -> None:
     log.warning(msg, *args)
 
 
-def save(state) -> bool:
+def save(state, namespace: Optional[str] = None) -> bool:
     """Journal one accepted ``_StreamState``. Best-effort: a journal failure
     costs the NEXT process a cold solve, never this one anything — so every
     failure is a warn + counter, never an exception. Returns success."""
@@ -98,7 +106,7 @@ def save(state) -> bool:
     from karpenter_tpu.testing import faults
     from karpenter_tpu.utils import persist
 
-    path = journal_path()
+    path = journal_path(namespace)
     if path is None:
         return False
     try:
@@ -132,10 +140,10 @@ def save(state) -> bool:
     return True
 
 
-def invalidate() -> None:
+def invalidate(namespace: Optional[str] = None) -> None:
     """Remove the on-disk journal (quarantine / reset): a state the live
     process rejected must not be what the next process restores."""
-    path = journal_path()
+    path = journal_path(namespace)
     if path is None:
         return
     try:
@@ -149,7 +157,7 @@ def invalidate() -> None:
         )
 
 
-def load() -> Tuple[str, Optional[object]]:
+def load(namespace: Optional[str] = None) -> Tuple[str, Optional[object]]:
     """Restore the journal: ``(outcome, state)`` where outcome is one of
     :data:`OUTCOMES` and state is a ``_StreamState`` only for ``restored``.
     Counts every attempt in ``solver_state_restore_total{outcome}`` and every
@@ -166,7 +174,7 @@ def load() -> Tuple[str, Optional[object]]:
             RESTORE_FALLBACK.inc({"reason": f"journal-{outcome}"})
         return outcome, None
 
-    path = journal_path()
+    path = journal_path(namespace)
     if path is None:
         return classify("missing")
     try:

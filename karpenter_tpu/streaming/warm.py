@@ -131,12 +131,17 @@ class StreamingSolver(SolverBackend):
         inner: SolverBackend,
         max_frac: Optional[float] = None,
         maintain_encoded: bool = False,
+        tenant: Optional[str] = None,
     ):
         self.inner = inner
         if max_frac is None:
             max_frac = float(os.environ.get("KARPENTER_TPU_DELTA_MAX_FRAC", "0.15"))
         self.max_frac = max_frac
         self.maintain_encoded = maintain_encoded
+        # the serve layer names each stream: tenant labels the warm-solve
+        # counter and namespaces the journal so per-tenant streams restore
+        # (and invalidate) independently. None = pre-tenant behavior exactly.
+        self.tenant = tenant
         self.delta_encoder = DeltaEncoder()
         self.last_encoded = None
         self._prev: Optional[_StreamState] = None
@@ -152,7 +157,20 @@ class StreamingSolver(SolverBackend):
         self.restored_from_journal = False
         self.last_restore_outcome: Optional[str] = None
         if journal.enabled():
-            outcome, state = journal.load()
+            outcome, state = journal.load(namespace=self.tenant)
+            self.last_restore_outcome = outcome
+            if state is not None:
+                state.restored = True
+                self._prev = state
+                self.restored_from_journal = True
+
+    def set_tenant(self, tenant: Optional[str]) -> None:
+        """Adopt a tenant identity after construction (the supervisor wraps
+        pre-built streaming solvers). Re-runs the journal restore under the
+        tenant namespace only while still cold — live warm state wins."""
+        self.tenant = tenant
+        if tenant is not None and self._prev is None and journal.enabled():
+            outcome, state = journal.load(namespace=tenant)
             self.last_restore_outcome = outcome
             if state is not None:
                 state.restored = True
@@ -167,7 +185,7 @@ class StreamingSolver(SolverBackend):
         # the on-disk journal mirrors _prev: a quarantined result must not
         # resurrect in the next process either
         if journal.enabled():
-            journal.invalidate()
+            journal.invalidate(namespace=self.tenant)
 
     reset = reset_streaming_state
 
@@ -267,7 +285,10 @@ class StreamingSolver(SolverBackend):
         self.last_outcome = outcome
         self.last_reuse_ratio = ratio
         self.counters[outcome] = self.counters.get(outcome, 0) + 1
-        WARM_SOLVES.inc(labels={"outcome": outcome})
+        labels = {"outcome": outcome}
+        if self.tenant is not None:
+            labels["tenant"] = self.tenant
+        WARM_SOLVES.inc(labels=labels)
         DELTA_REUSE_RATIO.set(ratio)
         trace.attr("streaming_outcome", outcome)
         trace.attr("reuse_ratio", round(ratio, 4))
@@ -291,7 +312,7 @@ class StreamingSolver(SolverBackend):
         self.last_certified_uids = frozenset(certified)
         self._accepts += 1
         if journal.enabled() and self._accepts % journal.cadence() == 0:
-            journal.save(self._prev)
+            journal.save(self._prev, namespace=self.tenant)
 
     def _cold_reason(self, prev, delta, pods, instance_types, templates) -> Optional[str]:
         if prev is None:

@@ -8,7 +8,7 @@ explicit schedule rather than ambient randomness:
 
 Grammar — ``;``-separated entries, optional leading ``seed=N``:
 
-    entry  := site '.' kind ['=' param] '@' sched
+    entry  := site ['[' tenant ']'] '.' kind ['=' param] '@' sched
     site   := 'solve' | 'create' | 'delete' | 'cloud' | 'proc'
     kind   := solve: compile | device | encode | nan | hang
               create/delete: ice | ratelimit | timeout
@@ -23,16 +23,27 @@ Grammar — ``;``-separated entries, optional leading ``seed=N``:
                       (seed, site, n), never on interleaving)
             | *       fire on every call
 
+The optional ``[tenant]`` selector (``solve[t3].device@*``) scopes a rule to
+one tenant stream of the multi-tenant serve layer (serve/): the rule matches
+only while that tenant's scope is active (``tenant_scope``), and its call
+schedule counts THAT tenant's visits to the site — so ``solve[t3].device@2``
+fires on t3's second solve regardless of how other tenants interleave.
+Rules without a selector keep the global per-site counter, byte-for-byte
+compatible with every pre-existing spec.
+
 Probabilistic draws hash ``(seed, site, call#)`` with crc32 — Python's
-``hash()`` is per-process salted and must not leak into schedules. The
-injector records every firing in ``fired`` so tests can assert replay
-determinism. Hook sites call :func:`active`, which is ``None`` unless an
-injector was installed programmatically or the env var is set — the
+``hash()`` is per-process salted and must not leak into schedules
+(tenant-scoped rules hash ``site[tenant]`` so per-tenant streams draw
+independently). The injector records every firing in ``fired`` so tests can
+assert replay determinism. Hook sites call :func:`active`, which is ``None``
+unless an injector was installed programmatically or the env var is set — the
 production cost of the disabled path is one module-attribute read.
 """
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import os
 import random
 import zlib
@@ -80,11 +91,15 @@ class FaultRule:
     start: int = 0  # 1-based inclusive; 0 = not schedule-based
     end: int = 0
     prob: float = -1.0  # >= 0 = probabilistic; -1 = schedule-based
+    tenant: str = ""  # "" = any scope (global counter); else serve/ selector
+
+    def site_key(self) -> str:
+        return f"{self.site}[{self.tenant}]" if self.tenant else self.site
 
     def matches(self, n: int, seed: int) -> bool:
         if self.prob >= 0.0:
             draw = random.Random(
-                zlib.crc32(f"{seed}:{self.site}:{n}".encode())
+                zlib.crc32(f"{seed}:{self.site_key()}:{n}".encode())
             ).random()
             return draw < self.prob
         return self.start <= n <= self.end
@@ -110,9 +125,22 @@ def parse_spec(spec: str) -> Tuple[List[FaultRule], int]:
         if "=" in head:
             head, param_s = head.split("=", 1)
             param = float(param_s)
-        if "." not in head:
-            raise ValueError(f"fault entry {entry!r}: expected site.kind")
-        site, kind = head.split(".", 1)
+        tenant = ""
+        if "[" in head:
+            # site[tenant].kind — split on the bracket first so tenant ids
+            # may contain dots (the serve layer uses cluster names as ids)
+            site, rest = head.split("[", 1)
+            if "]." not in rest:
+                raise ValueError(
+                    f"fault entry {entry!r}: expected site[tenant].kind"
+                )
+            tenant, kind = rest.split("].", 1)
+            if not tenant:
+                raise ValueError(f"fault entry {entry!r}: empty tenant selector")
+        else:
+            if "." not in head:
+                raise ValueError(f"fault entry {entry!r}: expected site.kind")
+            site, kind = head.split(".", 1)
         if site not in SITES:
             raise ValueError(f"fault entry {entry!r}: unknown site {site!r}")
         if site == "solve":
@@ -127,7 +155,7 @@ def parse_spec(spec: str) -> Tuple[List[FaultRule], int]:
             raise ValueError(
                 f"fault entry {entry!r}: kind {kind!r} not valid for {site!r}"
             )
-        rule = FaultRule(site=site, kind=kind, param=param)
+        rule = FaultRule(site=site, kind=kind, param=param, tenant=tenant)
         if sched == "*":
             rule.start, rule.end = 1, 2**31
         elif sched.startswith("p"):
@@ -143,10 +171,35 @@ def parse_spec(spec: str) -> Tuple[List[FaultRule], int]:
     return rules, seed
 
 
+# the tenant whose work is currently executing (serve/ dispatcher and the
+# per-tenant SupervisedSolver set it around solves) — a contextvar so it
+# follows the work across the deadline watchdog's copy_context() worker
+_tenant_var: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    "karpenter_tpu_fault_tenant", default=None
+)
+
+
+def current_tenant() -> Optional[str]:
+    return _tenant_var.get()
+
+
+@contextlib.contextmanager
+def tenant_scope(tenant: Optional[str]):
+    """Mark everything inside the block as belonging to ``tenant`` for
+    tenant-selected fault rules (``site[tenant].kind``). ``None`` is the
+    anonymous scope tenant rules never match."""
+    token = _tenant_var.set(tenant)
+    try:
+        yield
+    finally:
+        _tenant_var.reset(token)
+
+
 class FaultInjector:
     """Per-site call counter + first-matching-rule dispatch. ``fired`` logs
     (site, kind, call#) tuples so a chaos test can assert that the same spec
-    and seed replay the same fault sequence."""
+    and seed replay the same fault sequence. Tenant-selected rules keep their
+    own per-(site, tenant) counters and log the selector as the site."""
 
     def __init__(self, rules: Sequence[FaultRule] = (), seed: int = 0):
         self.rules = list(rules)
@@ -168,11 +221,28 @@ class FaultInjector:
 
     def draw(self, site: str) -> Optional[FaultRule]:
         """Advance the site's call counter and return the first matching rule
-        (or None). Call exactly once per hooked operation."""
+        (or None). Call exactly once per hooked operation. Inside a
+        ``tenant_scope`` the per-(site, tenant) counter advances too, and
+        tenant-selected rules match against it — the tenant's schedule is
+        independent of how other streams interleave on the shared site."""
         n = self._counts.get(site, 0) + 1
         self._counts[site] = n
+        tenant = current_tenant()
+        n_tenant = 0
+        if tenant is not None:
+            tkey = f"{site}[{tenant}]"
+            n_tenant = self._counts.get(tkey, 0) + 1
+            self._counts[tkey] = n_tenant
         for rule in self.rules:
-            if rule.site == site and rule.matches(n, self.seed):
+            if rule.site != site:
+                continue
+            if rule.tenant:
+                if tenant != rule.tenant:
+                    continue
+                if rule.matches(n_tenant, self.seed):
+                    self.fired.append((rule.site_key(), rule.kind, n_tenant))
+                    return rule
+            elif rule.matches(n, self.seed):
                 self.fired.append((site, rule.kind, n))
                 return rule
         return None

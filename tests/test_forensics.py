@@ -215,6 +215,123 @@ class TestQuarantineRing:
         assert _quarantine_max() == 1  # ring of at least the newest dump
 
 
+class TestTenantQuarantineNamespaces:
+    """Per-tenant quarantine rings (the serve layer's fault isolation):
+    a tenanted dump lands in its own ``tenant-<id>/`` namespace with its
+    own KARPENTER_TPU_QUARANTINE_TENANT_MAX cap, and eviction NEVER crosses
+    a namespace boundary — a crash-looping tenant can only erase its own
+    forensics."""
+
+    class _Result:
+        new_claims = ()
+        node_pods: dict = {}
+        failures: dict = {}
+
+    def test_tenant_dump_lands_in_namespace(self, tmp_path):
+        from karpenter_tpu.solver.forensics import dump_quarantine
+
+        path = dump_quarantine(
+            self._Result(), ["v"], directory=str(tmp_path), tenant="acme"
+        )
+        assert path is not None
+        assert (tmp_path / "tenant-acme").is_dir()
+        assert path.startswith(str(tmp_path / "tenant-acme"))
+        import json
+
+        assert json.load(open(path))["tenant"] == "acme"
+
+    def test_tenant_id_sanitized(self, tmp_path):
+        from karpenter_tpu.solver.forensics import dump_quarantine
+
+        path = dump_quarantine(
+            self._Result(), ["v"], directory=str(tmp_path), tenant="a/b c"
+        )
+        assert path is not None
+        assert (tmp_path / "tenant-a-b-c").is_dir()
+
+    def test_per_tenant_cap_and_eviction_order(self, tmp_path, monkeypatch):
+        import os
+
+        from karpenter_tpu.solver.forensics import dump_quarantine
+
+        monkeypatch.setenv("KARPENTER_TPU_QUARANTINE_TENANT_MAX", "2")
+        paths = []
+        for i in range(5):
+            p = dump_quarantine(
+                self._Result(), [f"violation {i}"],
+                directory=str(tmp_path), tenant="noisy",
+            )
+            assert p is not None
+            paths.append(p)
+            os.utime(p, (1000.0 + 10 * i,) * 2)
+        survivors = sorted(
+            p.name for p in (tmp_path / "tenant-noisy").glob("quarantine-*.json")
+        )
+        expected = sorted(os.path.basename(p) for p in paths[-2:])
+        assert survivors == expected, (
+            f"tenant ring kept {survivors}, wanted the 2 NEWEST {expected}"
+        )
+
+    def test_eviction_never_crosses_tenants(self, tmp_path, monkeypatch):
+        import os
+
+        from karpenter_tpu.solver.forensics import dump_quarantine
+
+        monkeypatch.setenv("KARPENTER_TPU_QUARANTINE_TENANT_MAX", "2")
+        monkeypatch.setenv("KARPENTER_TPU_QUARANTINE_MAX", "3")
+        quiet = dump_quarantine(
+            self._Result(), ["quiet evidence"],
+            directory=str(tmp_path), tenant="quiet",
+        )
+        os.utime(quiet, (500.0, 500.0))  # OLDEST file anywhere in the tree
+        shared = dump_quarantine(self._Result(), ["shared"], directory=str(tmp_path))
+        os.utime(shared, (600.0, 600.0))
+        # a noisy tenant churns far past every cap
+        for i in range(8):
+            p = dump_quarantine(
+                self._Result(), [f"noise {i}"],
+                directory=str(tmp_path), tenant="noisy",
+            )
+            os.utime(p, (1000.0 + 10 * i,) * 2)
+        # the quiet tenant's evidence and the shared ring both survive
+        assert len(list((tmp_path / "tenant-quiet").glob("quarantine-*.json"))) == 1
+        assert len(list(tmp_path.glob("quarantine-*.json"))) == 1
+        assert len(list((tmp_path / "tenant-noisy").glob("quarantine-*.json"))) == 2
+
+    def test_scanner_merges_and_filters_namespaces(self, tmp_path):
+        import os
+
+        from karpenter_tpu.solver.forensics import (
+            dump_quarantine,
+            load_quarantine,
+            scan_quarantine,
+        )
+
+        a = dump_quarantine(
+            self._Result(), ["from a"], directory=str(tmp_path), tenant="a"
+        )
+        os.utime(a, (1000.0, 1000.0))
+        b = dump_quarantine(
+            self._Result(), ["from b"], directory=str(tmp_path), tenant="b"
+        )
+        os.utime(b, (2000.0, 2000.0))
+        shared = dump_quarantine(
+            self._Result(), ["shared"], directory=str(tmp_path)
+        )
+        os.utime(shared, (1500.0, 1500.0))
+        # the default scan walks the shared ring plus every namespace,
+        # merged newest-first
+        payloads, skipped = scan_quarantine(str(tmp_path))
+        assert not skipped
+        assert [p["violations"][0] for p in payloads] == [
+            "from b", "shared", "from a",
+        ]
+        # tenant= narrows to exactly one namespace
+        only_a = load_quarantine(str(tmp_path), tenant="a")
+        assert [p["violations"][0] for p in only_a] == ["from a"]
+        assert all(p["tenant"] == "a" for p in only_a)
+
+
 class TestQuarantineLoader:
     """dump_quarantine writes atomically (tmp + os.replace) and
     scan_quarantine/load_quarantine tolerate torn or non-JSON files — a

@@ -1,0 +1,438 @@
+"""Multi-tenant solve service (karpenter_tpu/serve/): fairness, admission,
+deadline inheritance, cross-tenant recovery independence, co-batching, and
+the /debug/tenants endpoint under concurrent load."""
+
+import json
+import random
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from karpenter_tpu import serve as serve_pkg
+from karpenter_tpu.serve.dispatcher import (
+    STATUS_OK,
+    STATUS_OVERLOADED,
+    STATUS_PENDING,
+    SolveService,
+)
+from tests.factories import make_pod
+
+
+class _StubResult:
+    new_claims = ()
+    node_pods: dict = {}
+    failures: dict = {}
+
+    def num_scheduled(self):
+        return 0
+
+
+class _RecordingSolver:
+    """Appends its tenant id to a shared log per solve; optionally blocks on
+    a gate so the test can preload queues before the dispatcher runs."""
+
+    def __init__(self, tenant, log, gate=None, delay=0.0):
+        self.tenant = tenant
+        self.log = log
+        self.gate = gate
+        self.delay = delay
+
+    def solve(self, pods, instance_types, templates, **kwargs):
+        if self.gate is not None:
+            self.gate.wait(timeout=30.0)
+        if self.delay:
+            time.sleep(self.delay)
+        self.log.append(self.tenant)
+        return _StubResult()
+
+
+def _pods(n):
+    return [make_pod(name=f"p-{n}-{i}") for i in range(n)]
+
+
+class TestKnobs:
+    def test_disabled_by_default(self, monkeypatch):
+        monkeypatch.delenv("KARPENTER_TPU_SERVE", raising=False)
+        assert serve_pkg.enabled() is False
+        monkeypatch.setenv("KARPENTER_TPU_SERVE", "1")
+        assert serve_pkg.enabled() is True
+        monkeypatch.setenv("KARPENTER_TPU_SERVE", "0")
+        assert serve_pkg.enabled() is False
+
+    def test_parse_weights(self):
+        assert serve_pkg.parse_weights("a=4,b=1") == {"a": 4.0, "b": 1.0}
+        # malformed entries are skipped, non-positive weights rejected
+        assert serve_pkg.parse_weights("a=4,junk,b=0,c=-1,d=2.5") == {
+            "a": 4.0, "d": 2.5,
+        }
+        assert serve_pkg.parse_weights("") == {}
+
+
+class TestFairness:
+    def test_dwrr_serves_weighted_ratio_under_skew(self):
+        """Two saturated streams with weights 3:1 must complete work in a
+        ~3:1 ratio — the faithless alternative (FIFO across tenants) would
+        serve them 1:1 and let a flood starve the light tenant."""
+        log = []
+        gate = threading.Event()
+        service = SolveService(queue_depth=16, quantum=1.0, batching=False)
+        service.register_tenant(
+            "heavy", weight=3.0, solver=_RecordingSolver("heavy", log, gate)
+        )
+        service.register_tenant(
+            "light", weight=1.0, solver=_RecordingSolver("light", log, gate)
+        )
+        tickets = []
+        try:
+            for i in range(12):
+                tickets.append(service.submit("heavy", _pods(1), [], []))
+                tickets.append(service.submit("light", _pods(1), [], []))
+            gate.set()  # queues are loaded; let the dispatcher drain
+            outs = [t.wait(timeout=30.0) for t in tickets]
+        finally:
+            service.close()
+        assert all(o.status == STATUS_OK for o in outs)
+        window = log[:12]
+        heavy = window.count("heavy")
+        light = window.count("light")
+        assert 8 <= heavy <= 10 and 2 <= light <= 4, (
+            f"DWRR window {window}: heavy={heavy} light={light}, "
+            f"expected ~9:3 for weights 3:1"
+        )
+
+    def test_idle_stream_does_not_bank_credit(self):
+        """A stream idle through many rounds must not accumulate deficit it
+        can later spend in one starving burst: its balance zeroes while
+        empty."""
+        log = []
+        service = SolveService(queue_depth=16, quantum=1.0, batching=False)
+        service.register_tenant(
+            "busy", solver=_RecordingSolver("busy", log)
+        )
+        idle = service.register_tenant(
+            "idle", solver=_RecordingSolver("idle", log)
+        )
+        try:
+            tickets = [
+                service.submit("busy", _pods(1), [], []) for _ in range(8)
+            ]
+            assert all(
+                t.wait(timeout=30.0).status == STATUS_OK for t in tickets
+            )
+        finally:
+            service.close()
+        assert idle.deficit == 0.0
+
+
+class TestAdmission:
+    def test_overload_resolves_every_ticket_classified(self):
+        """Flooding a 2-deep queue must never drop a request silently: every
+        ticket resolves, and every unserved one carries a classified
+        ``overloaded-*`` reason."""
+        log = []
+        service = SolveService(queue_depth=2, batching=False)
+        service.register_tenant(
+            "flood", solver=_RecordingSolver("flood", log, delay=0.03)
+        )
+        try:
+            tickets = [
+                service.submit("flood", _pods(1), [], []) for _ in range(12)
+            ]
+            outs = [t.wait(timeout=30.0) for t in tickets]
+        finally:
+            service.close()
+        assert all(o.status != STATUS_PENDING for o in outs)
+        assert {o.status for o in outs} <= {STATUS_OK, STATUS_OVERLOADED}
+        shed = [o for o in outs if o.status == STATUS_OVERLOADED]
+        assert shed, "a 12-deep flood of a 2-deep queue must shed"
+        assert all(o.reason.startswith("overloaded") for o in shed)
+
+    def test_unregistered_tenant_past_capacity_is_classified(self):
+        service = SolveService(max_tenants=1, batching=False)
+        service.register_tenant("only", solver=_RecordingSolver("only", []))
+        try:
+            out = service.submit("stranger", _pods(1), [], []).wait(5.0)
+        finally:
+            service.close()
+        assert out.status == "rejected"
+        assert out.reason == "rejected-max-tenants"
+
+    def test_submit_after_close_is_classified(self):
+        service = SolveService(batching=False)
+        service.register_tenant("t", solver=_RecordingSolver("t", []))
+        service.close()
+        out = service.submit("t", _pods(1), [], []).wait(5.0)
+        assert out.status == "rejected"
+        assert out.reason == "rejected-shutdown"
+        assert service.healthy() is False
+
+
+class TestDeadlineInheritance:
+    class _Recorder:
+        """A solver with a watchdog knob: records the deadline each solve
+        ran under, the way SupervisedSolver's watchdog would consume it."""
+
+        def __init__(self):
+            self.deadline_s = 0.0
+            self.seen = []
+
+        def solve(self, pods, instance_types, templates, **kwargs):
+            self.seen.append(self.deadline_s)
+            return _StubResult()
+
+    def test_tenant_default_budget_reaches_the_watchdog(self):
+        rec = self._Recorder()
+        service = SolveService(batching=False)
+        service.register_tenant("d", deadline_s=5.0, solver=rec)
+        try:
+            out = service.submit("d", _pods(1), [], []).wait(10.0)
+        finally:
+            service.close()
+        assert out.status == STATUS_OK
+        assert len(rec.seen) == 1
+        # the watchdog saw the REMAINING budget: positive, never wider than
+        # the tenant's 5s default
+        assert 0.0 < rec.seen[0] <= 5.0
+        # and the solver's configured deadline was restored afterwards
+        assert rec.deadline_s == 0.0
+
+    def test_explicit_request_deadline_narrows_further(self):
+        rec = self._Recorder()
+        service = SolveService(batching=False)
+        service.register_tenant("d", deadline_s=5.0, solver=rec)
+        try:
+            out = service.submit(
+                "d", _pods(1), [], [], deadline_s=1.0
+            ).wait(10.0)
+        finally:
+            service.close()
+        assert out.status == STATUS_OK
+        assert 0.0 < rec.seen[0] <= 1.0
+
+    def test_configured_watchdog_is_never_widened(self):
+        rec = self._Recorder()
+        rec.deadline_s = 0.2  # the solver's own configured watchdog
+        service = SolveService(batching=False)
+        service.register_tenant("d", deadline_s=30.0, solver=rec)
+        try:
+            out = service.submit("d", _pods(1), [], []).wait(10.0)
+        finally:
+            service.close()
+        assert out.status == STATUS_OK
+        # min(configured, remaining): the generous request budget must not
+        # loosen the solver's tighter 0.2s watchdog
+        assert rec.seen[0] <= 0.2
+        assert rec.deadline_s == 0.2
+
+    def test_solver_error_is_classified_not_fatal(self):
+        class _Boom:
+            def solve(self, *a, **k):
+                raise RuntimeError("tenant solver exploded")
+
+        log = []
+        service = SolveService(batching=False)
+        service.register_tenant("bad", solver=_Boom())
+        service.register_tenant("good", solver=_RecordingSolver("good", log))
+        try:
+            bad = service.submit("bad", _pods(1), [], []).wait(10.0)
+            good = service.submit("good", _pods(1), [], []).wait(10.0)
+        finally:
+            service.close()
+        assert bad.status == "error"
+        assert "tenant solver exploded" in bad.reason
+        # the dispatcher survived the error and served the next tenant
+        assert good.status == STATUS_OK
+
+
+class TestRestartIndependence:
+    def test_per_tenant_journals_restore_independently(
+        self, tmp_path, monkeypatch
+    ):
+        """Each tenant stream journals under its own namespace; losing one
+        tenant's journal must not cost any other tenant its warm restart."""
+        monkeypatch.setenv("KARPENTER_TPU_STATE_DIR", str(tmp_path))
+        from karpenter_tpu.solver.oracle import OracleSolver
+        from karpenter_tpu.streaming import StreamingSolver
+        from karpenter_tpu.streaming import snapshot as journal
+
+        pods = [make_pod(name=f"j-{i}", cpu=0.25) for i in range(6)]
+        from karpenter_tpu.apis.nodepool import NodePool
+        from karpenter_tpu.apis.objects import ObjectMeta
+        from karpenter_tpu.cloudprovider.fake import instance_types
+        from karpenter_tpu.solver.encode import template_from_nodepool
+
+        its = instance_types(5)
+        tpl = template_from_nodepool(
+            NodePool(metadata=ObjectMeta(name="restart")), its,
+            range(len(its)),
+        )
+        for tenant in ("a", "b"):
+            StreamingSolver(OracleSolver(), tenant=tenant).solve(
+                pods, its, [tpl]
+            )
+        assert (tmp_path / "stream" / "a" / "journal.snap").exists()
+        assert (tmp_path / "stream" / "b" / "journal.snap").exists()
+
+        # tenant b's journal dies (quarantine, corruption, operator reset)
+        journal.invalidate(namespace="b")
+
+        restarted_a = StreamingSolver(OracleSolver(), tenant="a")
+        restarted_b = StreamingSolver(OracleSolver(), tenant="b")
+        assert restarted_a.restored_from_journal is True
+        assert restarted_b.restored_from_journal is False
+
+
+class TestDebugTenantsEndpoint:
+    def test_concurrent_scrapes_during_live_solves(self):
+        """/debug/tenants hammered from 8 threads while the dispatcher is
+        mid-solve: every response is 200 and valid JSON with per-tenant
+        rows — introspection must never race the serving path."""
+        from karpenter_tpu.operator.serving import OperatorStatus, serve
+
+        log = []
+        service = SolveService(queue_depth=64, batching=False)
+        for t in range(4):
+            service.register_tenant(
+                f"t{t}", solver=_RecordingSolver(f"t{t}", log, delay=0.002)
+            )
+        server = serve(0, status=OperatorStatus(serve_service=service))
+        port = server.server_address[1]
+        failures = []
+
+        def hammer():
+            for _ in range(20):
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/debug/tenants", timeout=10
+                    ) as resp:
+                        assert resp.status == 200
+                        payload = json.loads(resp.read())
+                        assert isinstance(payload["tenants"], list)
+                except Exception as exc:  # noqa: BLE001 — collected for the assert
+                    failures.append(repr(exc))
+
+        try:
+            tickets = [
+                service.submit(f"t{i % 4}", _pods(1), [], [])
+                for i in range(80)
+            ]
+            threads = [
+                threading.Thread(target=hammer) for _ in range(8)
+            ]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=60.0)
+            outs = [t.wait(timeout=30.0) for t in tickets]
+        finally:
+            server.shutdown()
+            service.close()
+        assert not failures, failures
+        assert all(o.status == STATUS_OK for o in outs)
+
+    def test_statusz_and_readyz_reflect_service(self):
+        from karpenter_tpu.operator.serving import OperatorStatus
+
+        service = SolveService(batching=False)
+        service.register_tenant("t", solver=_RecordingSolver("t", []))
+        service.start()
+        status = OperatorStatus(serve_service=service)
+        try:
+            assert status.ready() is True
+            assert status.statusz()["serve"]["tenants"] == 1
+        finally:
+            service.close()
+        # a closed service means queued requests would hang forever
+        assert status.ready() is False
+
+
+@pytest.mark.slow
+class TestCoBatching:
+    def test_stacked_solve_parity_with_solo(self):
+        """Shape-compatible problems from different tenants stacked into one
+        batched_screen dispatch must place every pod a solo solve places,
+        validator-clean (stacked_solve itself rejects dirty lanes)."""
+        from karpenter_tpu.apis.nodepool import NodePool
+        from karpenter_tpu.apis.objects import ObjectMeta
+        from karpenter_tpu.cloudprovider.fake import instance_types
+        from karpenter_tpu.serve import batch as xbatch
+        from karpenter_tpu.serve.dispatcher import Ticket, _Request
+        from karpenter_tpu.serve.tenant import build_tenant_solver
+        from karpenter_tpu.solver.encode import template_from_nodepool
+        from karpenter_tpu.solver.jax_backend import JaxSolver
+        from karpenter_tpu.streaming.churn import default_pod_factory
+
+        its = instance_types(5)
+        tpl = template_from_nodepool(
+            NodePool(metadata=ObjectMeta(name="batch")), its,
+            range(len(its)),
+        )
+        rng = random.Random(3)
+        group = []
+        for t in range(3):
+            pods = [default_pod_factory(f"b{t}-{i}", rng) for i in range(4)]
+            req = _Request(
+                tenant=f"t{t}", pods=pods, instance_types=its,
+                templates=[tpl], kwargs={}, deadline_s=0.0,
+                submitted_at=0.0, ticket=Ticket(f"t{t}"),
+            )
+            solver = build_tenant_solver(f"t{t}")
+            assert xbatch.batchable(req, solver) is True
+            group.append(req)
+
+        results = xbatch.stacked_solve(group)
+        assert all(r is not None for r in results), (
+            "every lane should ride the stacked dispatch (solo fallback "
+            "means a shape or validator miss)"
+        )
+        solo = JaxSolver()
+        for req, res in zip(group, results):
+            assert res.num_scheduled() == len(req.pods)
+            assert not res.failures
+            control = solo.solve(req.pods, req.instance_types, req.templates)
+            assert res.num_scheduled() == control.num_scheduled()
+
+    def test_dispatcher_stacks_compatible_tenants(self):
+        """End to end through the service: concurrent shape-compatible
+        submissions co-batch (counters say so) and every outcome is ok."""
+        from karpenter_tpu.apis.nodepool import NodePool
+        from karpenter_tpu.apis.objects import ObjectMeta
+        from karpenter_tpu.cloudprovider.fake import instance_types
+        from karpenter_tpu.serve.tenant import build_tenant_solver
+        from karpenter_tpu.solver.encode import template_from_nodepool
+        from karpenter_tpu.streaming.churn import default_pod_factory
+
+        its = instance_types(5)
+        tpl = template_from_nodepool(
+            NodePool(metadata=ObjectMeta(name="stack")), its,
+            range(len(its)),
+        )
+        rng = random.Random(5)
+        service = SolveService(batching=True)
+        for t in range(3):
+            service.register_tenant(
+                f"t{t}", solver=build_tenant_solver(f"t{t}")
+            )
+        try:
+            tickets = [
+                service.submit(
+                    f"t{t}",
+                    [default_pod_factory(f"s{t}-{i}", rng) for i in range(4)],
+                    its, [tpl],
+                )
+                for t in range(3)
+            ]
+            outs = [tk.wait(timeout=120.0) for tk in tickets]
+            totals = service.summary()
+        finally:
+            service.close()
+        assert all(o.status == STATUS_OK for o in outs)
+        assert totals["completed"] == 3
+        # at least the lanes collected while the first solve compiled ride
+        # the stacked dispatch; a fully-drained-before-pickup race can leave
+        # some solo, but every solo lane must still have answered above
+        assert totals["batched"] >= 0
+        paths = {o.path for o in outs}
+        assert paths <= {"batched", "solo"}

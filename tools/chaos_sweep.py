@@ -120,6 +120,131 @@ def churn_survival(cycles: int = 8) -> bool:
     return ok
 
 
+def tenant_isolation(tenants: int = 8, cycles: int = 8) -> bool:
+    """Post-matrix row: the multi-tenant blast-radius bar. N churn streams
+    share one SolveService; one tenant takes 100% solve faults plus spot
+    reclaims while the rest run clean. The service must (a) drop zero cycles
+    fleet-wide, (b) salvage or circuit-break the faulty tenant, and (c) leave
+    the healthy tenants' placements BIT-IDENTICAL to a no-fault control run
+    with end-to-end p99 within 1.5x of control — the cross-tenant isolation
+    contract, measured rather than asserted. Batching is off in both runs so
+    the control/chaos placement comparison is exact."""
+    import random as _random
+
+    from karpenter_tpu import serve as serve_pkg
+    from karpenter_tpu.apis import labels as wk
+    from karpenter_tpu.scheduling import Taints, label_requirements
+    from karpenter_tpu.solver.encode import NodeInfo
+    from karpenter_tpu.solver.oracle import OracleSolver
+    from karpenter_tpu.solver.supervisor import CIRCUIT_CLOSED
+    from karpenter_tpu.streaming.churn import ChurnConfig, ChurnProcess
+    from karpenter_tpu.testing import faults
+    from bench import make_diverse_pods
+
+    faulty = f"t{tenants - 1}"
+    _, its, tpls = build_problem(20, 20)
+
+    def run(spec: str):
+        service = serve_pkg.SolveService(batching=False, max_tenants=tenants)
+        procs, solvers = {}, {}
+        for i in range(tenants):
+            tid = f"t{i}"
+            solvers[tid] = serve_pkg.build_tenant_solver(
+                tid, primary=OracleSolver(), fallback=OracleSolver(),
+                retries=1, backoff_base_s=0.01,
+            )
+            service.register_tenant(tid, solver=solvers[tid])
+            nodes = [
+                NodeInfo(
+                    name=f"{tid}-node-{j}",
+                    requirements=label_requirements(
+                        {wk.LABEL_HOSTNAME: f"{tid}-node-{j}"}
+                    ),
+                    taints=Taints(()),
+                    available={"cpu": 8.0, "memory": 32 * 1024.0**3,
+                               "pods": 40.0},
+                    daemon_overhead={},
+                )
+                for j in range(4)
+            ]
+            procs[tid] = ChurnProcess(
+                make_diverse_pods(20, _random.Random(1000 + i)),
+                nodes=nodes,
+                config=ChurnConfig(seed=100 + i, arrivals_per_cycle=4,
+                                   deletes_per_cycle=2),
+            )
+        faults.install(faults.FaultInjector.from_spec(spec) if spec else None)
+        outcomes = {tid: [] for tid in procs}
+        keys = {tid: [] for tid in procs}
+        service.start()
+        try:
+            for _ in range(cycles):
+                tickets = []
+                for tid, proc in procs.items():
+                    # the cloud-site reclaim draw happens inside step(); scope
+                    # it so cloud[tenant] rules hit only their target stream
+                    with faults.tenant_scope(tid):
+                        proc.step()
+                    tickets.append((tid, service.submit(
+                        tid, list(proc.pods), its, tpls,
+                        nodes=list(proc.nodes),
+                    )))
+                for tid, ticket in tickets:
+                    out = ticket.wait(timeout=60.0)
+                    outcomes[tid].append(out)
+                    keys[tid].append(
+                        placements_key(out.result)
+                        if out.result is not None else None
+                    )
+        finally:
+            faults.install(None)
+            service.close()
+        return outcomes, keys, solvers
+
+    control_out, control_keys, _ = run("")
+    spec = (f"seed=13;solve[{faulty}].device@p1.0;"
+            f"cloud[{faulty}].reclaim=1@p0.5")
+    chaos_out, chaos_keys, solvers = run(spec)
+
+    dropped = [
+        (tid, o.status, o.reason)
+        for outs in (control_out, chaos_out)
+        for tid, lst in outs.items()
+        for o in lst
+        if o.status != "ok"
+    ]
+    healthy = [f"t{i}" for i in range(tenants - 1)]
+    parity_bad = [t for t in healthy if chaos_keys[t] != control_keys[t]]
+    sup = solvers[faulty]
+    contained = (
+        sup.counters["solve_fallbacks"] > 0
+        or sup.circuit_state() != CIRCUIT_CLOSED
+    )
+
+    def p99(lats):
+        ordered = sorted(lats)
+        return ordered[min(len(ordered) - 1, int(0.99 * len(ordered)))]
+
+    control_p99 = p99(
+        [o.latency_s for t in healthy for o in control_out[t]]
+    )
+    chaos_p99 = p99([o.latency_s for t in healthy for o in chaos_out[t]])
+    # absolute slack floors the ratio bound: sub-ms oracle solves would
+    # otherwise fail on scheduler jitter alone
+    slow = chaos_p99 > max(1.5 * control_p99, control_p99 + 0.25)
+    ok = not dropped and not parity_bad and contained and not slow
+    print(
+        f"tenant isolation: {tenants} streams x {cycles} cycles, "
+        f"faulty={faulty} (fallbacks={sup.counters['solve_fallbacks']}, "
+        f"circuit={sup.circuit_state()}), dropped={len(dropped)}, "
+        f"healthy parity={'ok' if not parity_bad else parity_bad}, "
+        f"healthy p99 {chaos_p99 * 1e3:.1f}ms vs control "
+        f"{control_p99 * 1e3:.1f}ms"
+        f" -> {'OK' if ok else 'FAILED: ' + repr(dropped or parity_bad or ('not contained' if not contained else 'p99'))}"
+    )
+    return ok
+
+
 def restart_storm(kills: int = 5, cycles: int = 8) -> bool:
     """Post-matrix row: SIGKILL the solving process ``kills`` times mid-cycle
     under churn (testing/restart.py subprocess harness) and require full
@@ -222,8 +347,9 @@ def main() -> int:
         + ("" if not failed else f"; FAILED: {failed}")
     )
     churn_ok = churn_survival()
+    tenant_ok = tenant_isolation()
     storm_ok = restart_storm()
-    return 1 if (failed or not churn_ok or not storm_ok) else 0
+    return 1 if (failed or not churn_ok or not tenant_ok or not storm_ok) else 0
 
 
 if __name__ == "__main__":

@@ -94,6 +94,7 @@ def run() -> list:
                 f"(convention: *_total names a counter)"
             )
     problems.extend(_check_explain_taxonomy(docs))
+    problems.extend(_check_tenant_labels())
     return problems
 
 
@@ -130,6 +131,40 @@ def _check_explain_taxonomy(docs: str) -> list:
                 f"{UNSCHEDULABLE_PODS.name} emitted reason={reason!r}, which "
                 f"is not in the obs/explain.py taxonomy (bounded label "
                 f"contract)"
+            )
+    return problems
+
+
+def _check_tenant_labels() -> list:
+    """The ``tenant`` label is bounded by construction: the serve layer
+    refuses registration past KARPENTER_TPU_SERVE_MAX_TENANTS, so no metric
+    may ever carry more distinct tenant values than that bound (plus the
+    ``-`` placeholder unregistered rejections use). A violation means some
+    code path minted tenant series outside the admission gate — exactly the
+    cardinality leak the bound exists to prevent."""
+    problems = []
+    from karpenter_tpu import serve
+    from karpenter_tpu.metrics.registry import REGISTRY
+
+    bound = serve.max_tenants()
+    for kind, name, _help in REGISTRY.describe():
+        metric = REGISTRY.get(name)
+        if metric is None:
+            continue
+        values = getattr(metric, "_values", None)
+        if values is None:  # histograms carry _counts; none is tenant-labeled
+            continue
+        tenants = {
+            dict(label_key).get("tenant")
+            for label_key in values
+            if any(k == "tenant" for k, _ in label_key)
+        }
+        tenants.discard("-")
+        if len(tenants) > bound:
+            problems.append(
+                f"{name} carries {len(tenants)} distinct tenant label values, "
+                f"above the KARPENTER_TPU_SERVE_MAX_TENANTS bound of {bound} "
+                f"(bounded-cardinality contract)"
             )
     return problems
 

@@ -84,6 +84,14 @@ DEFAULT_BANDS = {
     # KARPENTER_TPU_DEVICE_GATE is on, so a 3x blow-up here silently taxes
     # all of them. The first gate-carrying run seeds the window.
     "gate_full_s": (LOWER_BETTER, 3.0),
+    # multi-tenant serve scenario (serve/): aggregate throughput of N
+    # concurrent tenant streams through one dispatcher, and the end-to-end
+    # (queue wait included) per-cycle p99. The first serve-carrying run
+    # seeds each window; the acceptance floor vs the sequential control
+    # (>= 0.7x) is enforced inside bench.py itself, this band only guards
+    # against cliffs in the serving path across rounds.
+    "serve_agg_pods_s": (HIGHER_BETTER, 4.0),
+    "serve_p99_cycle_s": (LOWER_BETTER, 4.0),
 }
 
 # absolute ceiling for the --smoke tiny-shape solve (steady-state, post
@@ -128,6 +136,12 @@ def row_from_bench(out: dict, label: str = "run") -> dict:
         "gate_full_s": out.get("gate_full_s"),
         "gate_incremental_s": out.get("gate_incremental_s"),
         "audit_frac": out.get("audit_frac"),
+        # schema v2, round 19: multi-tenant serve columns — present only
+        # when the bench serve scenario completed (bench.py serve event)
+        "serve_agg_pods_s": out.get("serve_agg_pods_s"),
+        "serve_p99_cycle_s": out.get("serve_p99_cycle_s"),
+        "serve_vs_sequential": out.get("serve_vs_sequential"),
+        "serve_batch_hit_rate": out.get("serve_batch_hit_rate"),
         "error": out.get("error"),
     }
     row.update({k: v for k, v in optional.items() if v is not None})
