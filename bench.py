@@ -38,6 +38,13 @@ Env knobs:
                         docs/PERF_NOTES.md) to this jsonl file — unset means
                         emit-only, so CI runs never mutate the committed
                         bench_history.jsonl
+  BENCH_SHARD_MAX_PODS=n  extend the mesh-sharded shape family past 100k
+                        (the 1M row is opt-in — it needs its own budget)
+  BENCH_SHARD_REPS=n    measured reps per fleet-scale shard shape (default
+                        1: each rep is a full 100k-pod solve on BOTH sides
+                        of the A/B, so the grid's default of 3 is too hot)
+  BENCH_SHARD_NEIGHBORHOODS=n  label namespaces in the fleet corpus
+                        (default 32; see make_fleet_pods)
 
 Solver flags flow through to the child unchanged; notably
 KARPENTER_TPU_RELAX=1 makes the run measure the two-phase relaxation solve,
@@ -60,7 +67,7 @@ DEADLINE = float(os.environ.get("BENCH_DEADLINE", "2400"))
 STALL = float(os.environ.get("BENCH_STALL", "600"))
 
 
-def make_diverse_pods(count: int, rng: random.Random):
+def make_diverse_pods(count: int, rng: random.Random, ns: str = ""):
     from karpenter_tpu.apis import labels as wk
     from karpenter_tpu.apis.objects import (
         Affinity,
@@ -81,24 +88,27 @@ def make_diverse_pods(count: int, rng: random.Random):
     def random_memory():
         return rng.choice([100, 256, 512, 1024, 2048, 4096]) * 1024.0**2
 
+    # ns scopes the selector alphabets (and pod names) to one label
+    # namespace — "" keeps the classic corpus byte-identical; a non-empty
+    # prefix makes two calls' spread/affinity constraints provably disjoint
     def random_labels():
-        return {"my-label": rng.choice("abcdefg")}
+        return {"my-label": ns + rng.choice("abcdefg")}
 
     def random_affinity_labels():
-        return {"my-affininity": rng.choice("abcdefg")}
+        return {"my-affininity": ns + rng.choice("abcdefg")}
 
     def container():
         return Container(requests={"cpu": random_cpu(), "memory": random_memory()})
 
     def generic(i):
         return Pod(
-            metadata=ObjectMeta(name=f"pod-{i}", labels=random_labels()),
+            metadata=ObjectMeta(name=f"pod-{ns}{i}", labels=random_labels()),
             spec=PodSpec(containers=[container()]),
         )
 
     def spread(i, key):
         return Pod(
-            metadata=ObjectMeta(name=f"pod-{i}", labels=random_labels()),
+            metadata=ObjectMeta(name=f"pod-{ns}{i}", labels=random_labels()),
             spec=PodSpec(
                 containers=[container()],
                 topology_spread_constraints=[
@@ -114,7 +124,7 @@ def make_diverse_pods(count: int, rng: random.Random):
 
     def affine(i, key):
         return Pod(
-            metadata=ObjectMeta(name=f"pod-{i}", labels=random_affinity_labels()),
+            metadata=ObjectMeta(name=f"pod-{ns}{i}", labels=random_affinity_labels()),
             spec=PodSpec(
                 containers=[container()],
                 affinity=Affinity(
@@ -140,6 +150,48 @@ def make_diverse_pods(count: int, rng: random.Random):
     pods += [affine(len(pods) + i, wk.LABEL_HOSTNAME) for i in range(n)]
     pods += [affine(len(pods) + i, wk.LABEL_TOPOLOGY_ZONE) for i in range(n)]
     pods += [generic(len(pods) + i) for i in range(count - len(pods))]
+    return pods
+
+
+def make_fleet_pods(
+    count: int,
+    rng: random.Random,
+    neighborhoods: int = 32,
+    constrained_frac: float = 0.15,
+):
+    """The fleet-scale corpus: the diverse constrained mix replicated across
+    N independent label namespaces, plus a bulk of unconstrained service
+    pods. A real 100k-pod fleet is not one giant spread group — selectors
+    scope to team/namespace alphabets and most pods carry no topology
+    constraint at all; that independence is exactly what the partitioned
+    solve exploits. The unsharded control solves the SAME pods, so the A/B
+    stays fair."""
+    from karpenter_tpu.apis.objects import Container, ObjectMeta, Pod, PodSpec
+
+    constrained = int(count * constrained_frac)
+    pods = []
+    base = max(constrained // max(neighborhoods, 1), 1)
+    nb = 0
+    while len(pods) < constrained:
+        n = (
+            min(base, constrained - len(pods))
+            if nb < neighborhoods - 1
+            else constrained - len(pods)
+        )
+        pods += make_diverse_pods(n, rng, ns=f"t{nb}-")
+        nb += 1
+    while len(pods) < count:
+        pods.append(Pod(
+            metadata=ObjectMeta(
+                name=f"pod-bulk-{len(pods)}",
+                labels={"app": f"svc-{rng.randrange(64)}"},
+            ),
+            spec=PodSpec(containers=[Container(requests={
+                "cpu": rng.choice([0.1, 0.25, 0.5, 1.0]),
+                "memory": rng.choice([128, 256, 512, 1024]) * 1024.0**2,
+            })]),
+        ))
+    rng.shuffle(pods)
     return pods
 
 
@@ -853,7 +905,145 @@ def run_child():
         emit(ev)
     except Exception as exc:
         emit({"event": "serve", "error": repr(exc)})
+
+    # mesh-sharded partitioned solve (shard/): the fleet-scale shape family,
+    # A/B against the unsharded control on the same diverse mix. Each shape
+    # runs in a fresh subprocess so a CPU host can be forced to an 8-device
+    # topology (one process = one XLA CPU device otherwise, and the shard
+    # path would classify every attempt single-device) without disturbing
+    # the grid's device count — the grid numbers stay comparable with the
+    # committed history.
+    # 10k anchors the A/B on modest hosts (it fits the per-shape budget even
+    # on an emulated CPU mesh); 100k is the fleet wall a real multi-device
+    # mesh is sized for — on a slow host it times out into a classified
+    # event error instead of eating the grid's budget
+    shard_shapes = [2000] if os.environ.get("BENCH_QUICK") else [10000, 100000]
+    extra = int(os.environ.get("BENCH_SHARD_MAX_PODS", "0"))
+    if extra > shard_shapes[-1]:
+        shard_shapes.append(extra)  # the opt-in 1M-capable row
+    for n in shard_shapes:
+        shard_env = dict(os.environ)
+        shard_env["BENCH_SHARD_PODS"] = str(n)
+        if dev.platform == "cpu":
+            flags = shard_env.get("XLA_FLAGS", "")
+            if "host_platform_device_count" not in flags:
+                shard_env["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count=8"
+                ).strip()
+        try:
+            out = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--shard-child"],
+                capture_output=True,
+                text=True,
+                timeout=int(os.environ.get("BENCH_SHARD_TIMEOUT", "570")),
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                env=shard_env,
+            )
+            line = next(
+                (l for l in out.stdout.splitlines()
+                 if l.startswith('{"event": "shard"')), None
+            )
+            if line:
+                emit(json.loads(line))
+            else:
+                emit({"event": "shard", "pods": n,
+                      "error": f"rc={out.returncode}: {out.stderr[-300:]}"})
+        except subprocess.TimeoutExpired:
+            emit({"event": "shard", "pods": n, "error": "timeout"})
     emit({"event": "done"})
+
+
+def run_shard_child():
+    """One fleet-scale shape of the mesh-sharded A/B: the partitioned solve
+    (KARPENTER_TPU_SHARD=1) and the unsharded control on the SAME diverse
+    mix, same process, same warm XLA client. Spawned by run_child with the
+    host forced multi-device; prints exactly one JSON shard event."""
+    from karpenter_tpu.operator.logging import quiet_xla_warnings
+
+    quiet_xla_warnings()
+    # run_child setdefaults EXPLAIN=1 for the grid and this process inherits
+    # it, but the partitioned path classifies explain as unsupported-args and
+    # would stand down every shape. The A/B measures the solve, not the
+    # attribution pass — off on BOTH sides keeps it fair.
+    os.environ["KARPENTER_TPU_EXPLAIN"] = "0"
+
+    import __graft_entry__
+
+    __graft_entry__._respect_platform_env()
+
+    import jax
+
+    from karpenter_tpu.apis.nodepool import NodePool
+    from karpenter_tpu.apis.objects import ObjectMeta
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.parallel.mesh import default_mesh
+    from karpenter_tpu.solver.encode import template_from_nodepool
+    from karpenter_tpu.solver.jax_backend import JaxSolver
+
+    n = int(os.environ.get("BENCH_SHARD_PODS", "100000"))
+    reps = max(int(os.environ.get("BENCH_SHARD_REPS", "1")), 1)
+    neighborhoods = int(os.environ.get("BENCH_SHARD_NEIGHBORHOODS", "32"))
+    rng = random.Random(42)
+    its = instance_types(400)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="default")), its, range(len(its))
+    )
+    pods = make_fleet_pods(n, rng, neighborhoods=neighborhoods)
+    mesh = default_mesh(2)
+    ev = {
+        "event": "shard",
+        "pods": n,
+        "neighborhoods": neighborhoods,
+        "devices": len(jax.devices()),
+        "mesh_devices": int(mesh.devices.size) if mesh is not None else 1,
+    }
+
+    # A side: the partitioned path. A fresh solver per side so neither
+    # shares compile-cache state the other warmed.
+    os.environ["KARPENTER_TPU_SHARD"] = "1"
+    sharded = JaxSolver()
+    t0 = time.perf_counter()
+    result = sharded.solve(pods, its, [tpl])
+    warm_s = time.perf_counter() - t0
+    samples, median, result = _measure(
+        lambda: sharded.solve(pods, its, [tpl]), reps
+    )
+    last = getattr(sharded, "last_shard", None) or {}
+    ev.update({
+        "solve_s": round(median, 4),
+        "solve_min_s": round(samples[0], 4),
+        "solve_max_s": round(samples[-1], 4),
+        "reps": len(samples),
+        "compile_s": round(max(warm_s - median, 0.0), 2),
+        "scheduled": result.num_scheduled(),
+        "scheduled_frac": round(result.num_scheduled() / max(n, 1), 4),
+        # None = the partitioned path served; anything else is the
+        # classified standdown reason (shard/__init__.py vocabulary)
+        "reason": last.get("reason", "never-attempted"),
+        "partitions": last.get("partitions"),
+        "lanes": last.get("lanes"),
+        "pad_frac": last.get("pad_frac"),
+        "merged_claims": last.get("merged_claims"),
+        "gate_rejections": last.get("gate_rejections"),
+        "splittable_pods": last.get("splittable_pods"),
+        "atomic_components": last.get("atomic_components"),
+    })
+
+    # B side: the unsharded control — the exact code path a flag-off
+    # deployment runs, so the speedup column is an honest A/B
+    os.environ["KARPENTER_TPU_SHARD"] = "0"
+    control = JaxSolver()
+    control.solve(pods, its, [tpl])  # compile warmup
+    c_samples, c_median, c_result = _measure(
+        lambda: control.solve(pods, its, [tpl]), reps
+    )
+    ev.update({
+        "control_s": round(c_median, 4),
+        "control_scheduled": c_result.num_scheduled(),
+        "control_scheduled_frac": round(c_result.num_scheduled() / max(n, 1), 4),
+        "speedup_vs_control": round(c_median / max(median, 1e-9), 3),
+    })
+    print(json.dumps(ev), flush=True)
 
 
 # ---------------------------------------------------------------------------
@@ -1245,6 +1435,59 @@ def main():
                     f"{serve['overload']['unclassified']} outcomes without a "
                     f"classified status (admission contract violated)"
                 )
+    shard_evs = [
+        e for e in events if e.get("event") == "shard" and "error" not in e
+    ]
+    if shard_evs:
+        # mesh-sharded shape family (shard/, schema v2 round-18 columns):
+        # per-shape A/B plus the headline numbers of the LARGEST shape —
+        # partition count, pad waste, and the wall vs the unsharded control
+        out["per_shape_shard"] = {
+            str(e["pods"]): {
+                k: e[k]
+                for k in (
+                    "solve_s", "control_s", "speedup_vs_control",
+                    "scheduled_frac", "control_scheduled_frac", "reason",
+                    "partitions", "lanes", "pad_frac", "merged_claims",
+                    "gate_rejections", "mesh_devices", "reps",
+                )
+                if k in e
+            }
+            for e in shard_evs
+        }
+        big = max(shard_evs, key=lambda e: e["pods"])
+        out["shard_mesh_devices"] = big.get("mesh_devices")
+        if big.get("reason") is not None:
+            # a standdown is not a perf number — record it loudly (and emit
+            # NO shard perf columns) so a run where the fleet path silently
+            # fell back never publishes the control's wall as the sharded
+            # trajectory
+            out["shard_standdown_reason"] = big["reason"]
+        elif big.get("gate_rejections"):
+            out["error"] = (
+                f"shard path served with {big['gate_rejections']} device-gate"
+                f" rejections at {big['pods']} pods (acceptance: zero)"
+            )
+        elif big.get("scheduled_frac", 0.0) < big.get("control_scheduled_frac", 0.0):
+            # the partitioned path must never schedule fewer pods than the
+            # unsharded control — a faster solver that drops pods is a bug
+            out["error"] = (
+                f"shard path scheduled {big['scheduled_frac']} vs control "
+                f"{big['control_scheduled_frac']} at {big['pods']} pods"
+            )
+        else:
+            if big["pods"] >= 100000:
+                out["solve_100k_s"] = big["solve_s"]
+            out["shard_partitions"] = big.get("partitions")
+            out["shard_pad_frac"] = big.get("pad_frac")
+            out["shard_speedup_vs_control"] = big.get("speedup_vs_control")
+    shard_errs = [
+        e for e in events if e.get("event") == "shard" and "error" in e
+    ]
+    if shard_errs and "error" not in out:
+        out["shard_errors"] = {
+            str(e.get("pods")): e["error"] for e in shard_errs
+        }
     if scheduled_frac < 0.95:
         # a solver that drops pods must not read as a throughput win
         # (reference asserts full schedulability of the diverse mix)
@@ -1279,5 +1522,7 @@ def _emit_history_row(out: dict) -> None:
 if __name__ == "__main__":
     if "--child" in sys.argv:
         run_child()
+    elif "--shard-child" in sys.argv:
+        run_shard_child()
     else:
         sys.exit(main())

@@ -224,6 +224,30 @@ RELAX_FALLBACK = REGISTRY.counter(
     "the full-level validator rejected the relaxed result",
 )
 
+# -- mesh-sharded partitioned solve series (shard/, KARPENTER_TPU_SHARD) ------
+SHARD_PARTITIONS = REGISTRY.gauge(
+    "solver_shard_partitions",
+    "Independent sub-problems the last partitioned solve distributed over "
+    "the device mesh (0 until a solve takes the shard path)",
+)
+SHARD_PAD_FRACTION = REGISTRY.gauge(
+    "solver_shard_pad_fraction",
+    "Fraction of the last partitioned solve's stacked pod rows that were "
+    "padding (bucket waste + inert mesh-alignment lanes)",
+)
+SHARD_MERGE_REJECTIONS = REGISTRY.counter(
+    "solver_shard_merge_rejections_total",
+    "Partitioned solves stood down after a per-partition device gate or a "
+    "cross-partition claim-merge check rejected the result",
+)
+SHARD_FALLBACK = REGISTRY.counter(
+    "solver_shard_fallback_total",
+    "Partitioned solves that stood down to the unsharded path, by "
+    "classified reason (single-device, small-batch, relaxable, "
+    "unsupported-args, single-partition, cross-partition-claims, "
+    "shape-mismatch, slot-overflow, merge-rejected, error)",
+)
+
 # -- verification gate series (verify/, KARPENTER_TPU_DEVICE_GATE) ------------
 GATE_DURATION = REGISTRY.histogram(
     "solver_gate_duration_seconds",

@@ -268,6 +268,9 @@ class ProgramRegistry:
         self._seen: set = set()
         self._memory: deque = deque(maxlen=max(1, memory_samples))
         self._live_peak = 0  # running peak for the live-array fallback
+        # last partitioned-solve lane layout (shard/solve.py) — one bounded
+        # dict, refreshed per shard dispatch, surfaced under /debug/programs
+        self._shard: Optional[Dict] = None
 
     # -- dispatch accounting ---------------------------------------------------
 
@@ -399,6 +402,23 @@ class ProgramRegistry:
         DEVICE_BYTES.set(int(donated_bytes), {"kind": "donated"})
         return sample
 
+    def note_shard_lanes(
+        self, partitions: int, lanes: int,
+        pod_counts, node_counts,
+    ) -> None:
+        """Record the last partitioned solve's lane layout: how many
+        independent sub-problems, how many stacked lanes (including inert
+        mesh-alignment lanes), and the per-partition pod/node row counts —
+        the balance picture behind solver_shard_pad_fraction."""
+        with self._lock:
+            self._shard = {
+                "unix": _wall(),
+                "partitions": int(partitions),
+                "lanes": int(lanes),
+                "pods_per_partition": [int(c) for c in pod_counts],
+                "nodes_per_partition": [int(c) for c in node_counts],
+            }
+
     # -- views -----------------------------------------------------------------
 
     def snapshot(self) -> Dict:
@@ -406,6 +426,7 @@ class ProgramRegistry:
         with self._lock:
             programs = [r.to_dict() for r in self._programs.values()]
             memory = list(self._memory)
+            shard = dict(self._shard) if self._shard else None
         programs.sort(key=lambda r: (-r["compile_s_total"], r["key"]))
         return {
             "enabled": enabled(),
@@ -425,6 +446,7 @@ class ProgramRegistry:
                 "samples": memory,
                 "last": memory[-1] if memory else None,
             },
+            "shard": shard,
         }
 
     def summary(self) -> Dict:
@@ -456,6 +478,7 @@ class ProgramRegistry:
             self._seen.clear()
             self._memory.clear()
             self._live_peak = 0
+            self._shard = None
 
 
 _registry: Optional[ProgramRegistry] = None
@@ -552,6 +575,15 @@ def sample_memory(
     return registry().sample_memory(
         carried_bytes, pods=pods, cycle=cycle, donated_bytes=donated_bytes
     )
+
+
+def note_shard_lanes(
+    partitions: int, lanes: int, pod_counts, node_counts
+) -> None:
+    """Module-level convenience with the off-path short-circuit."""
+    if not enabled():
+        return
+    registry().note_shard_lanes(partitions, lanes, pod_counts, node_counts)
 
 
 # -- jaxpr equation counting (KARPENTER_TPU_PROGRAMS_EQNS) --------------------

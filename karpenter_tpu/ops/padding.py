@@ -118,18 +118,34 @@ def _pad_reqs(r: ReqTensor, e: int, k: int, v: int) -> ReqTensor:
     )
 
 
-def pad_problem(p: SchedulingProblem, min_pods: int = 0) -> SchedulingProblem:
+def pad_problem(
+    p: SchedulingProblem,
+    min_pods: int = 0,
+    min_nodes: int = 0,
+    min_runs: int = 0,
+) -> SchedulingProblem:
     """``min_pods`` raises the pod-axis bucket floor: callers that stack many
     problems into one batch (parallel/mesh.py stack_problems) pad them all to
     a common bucket so the shapes line up. The solver's relax-and-retry passes
     pass no floor — each pass buckets to its own queue size and reuses the
     compiled kernel for that bucket. Padded pod rows tolerate nothing, so
-    they resolve to KIND_FAIL without touching state."""
+    they resolve to KIND_FAIL without touching state.
+
+    ``min_nodes`` / ``min_runs`` extend the same floor to the node and run
+    axes for callers that stack problems with DIFFERENT node sets and run
+    segmentations (shard/solve.py pads every partition to the widest
+    partition's buckets). The N=0 static elision is preserved only when both
+    the problem and the floor are node-free."""
     P = pod_axis_bucket(max(p.num_pods, min_pods))
     T = pow2_bucket(p.num_instance_types)
     # N=0 stays 0: provisioning batches without existing nodes skip the
     # whole node branch statically instead of scanning 8 inert rows
-    N = pow2_bucket(p.num_nodes, lo=8) if p.num_nodes else 0
+    N = (
+        pow2_bucket(max(p.num_nodes, min_nodes), lo=8)
+        if (p.num_nodes or min_nodes)
+        else 0
+    )
+    RN = pow2_bucket(max(p.num_runs, min_runs), lo=4)
     TPL = pow2_bucket(p.num_templates, lo=4)
     K = pow2_bucket(p.num_keys, lo=4)
     # V must stay a multiple of 32: the solver bitpacks value lanes into
@@ -200,10 +216,10 @@ def pad_problem(p: SchedulingProblem, min_pods: int = 0) -> SchedulingProblem:
         # NOT covered by any run — their outputs stay at the initial
         # KIND_FAIL and decode drops them anyway.
         pod_active=_pad(p.pod_active, (P,), False),
-        run_start=_pad(p.run_start, (pow2_bucket(p.num_runs, lo=4),), 0),
-        run_len=_pad(p.run_len, (pow2_bucket(p.num_runs, lo=4),), 0),
+        run_start=_pad(p.run_start, (RN,), 0),
+        run_len=_pad(p.run_len, (RN,), 0),
         # padding runs are length-0 analytic commits (pure no-ops)
-        run_mode=_pad(p.run_mode, (pow2_bucket(p.num_runs, lo=4),), 1),
+        run_mode=_pad(p.run_mode, (RN,), 1),
         # padded instance-type rows have no offerings at all
         offer_zc=(
             _pad(p.offer_zc, (T,) + p.offer_zc.shape[1:], False)

@@ -76,6 +76,37 @@ def _batched_solve_jit(
     )(batch)
 
 
+def _pad_lane_axis(tree, mesh: Mesh):
+    """Pad every leaf's leading (candidate) axis up to a multiple of the mesh
+    size by repeating the last lane — NamedSharding needs the sharded axis
+    divisible by the device count, and a duplicated valid lane is inert (its
+    rows are sliced off the result by ``_trim_lane_axis``). Returns the padded
+    tree and the original lane count."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return tree, 0
+    b = int(leaves[0].shape[0])
+    n_dev = mesh.devices.size
+    pad = (-b) % n_dev
+    if pad == 0:
+        return tree, b
+    padded = jax.tree_util.tree_map(
+        lambda x: np.concatenate(
+            [np.asarray(x), np.repeat(np.asarray(x[-1:]), pad, axis=0)]
+        ),
+        tree,
+    )
+    return padded, b
+
+
+def _trim_lane_axis(result, b: int):
+    """Drop the lanes ``_pad_lane_axis`` added (no-op when nothing was)."""
+    leaves = jax.tree_util.tree_leaves(result)
+    if not leaves or int(leaves[0].shape[0]) == b:
+        return result
+    return jax.tree_util.tree_map(lambda x: x[:b], result)
+
+
 def batched_solve(
     batch: SchedulingProblem, max_claims: int, mesh: Optional[Mesh] = None
 ) -> FFDResult:
@@ -84,13 +115,17 @@ def batched_solve(
     its slice of the scan batch."""
     max_run = _max_run_bucket(batch)
     with_topo = _has_topo_runs(batch)
+    b_orig = 0
     if mesh is not None:
+        batch, b_orig = _pad_lane_axis(batch, mesh)
         batch = shard_batch(batch, mesh)
     obs = programs.begin_dispatch(
         "batched_solve", max_claims, batch,
         statics={"max_run": max_run, "with_topo": with_topo},
     )
     result = _batched_solve_jit(batch, max_claims, max_run, with_topo)
+    if mesh is not None:
+        result = _trim_lane_axis(result, b_orig)
     if obs is not None:
         obs.finish(problem_bytes=_tree_bytes(batch))
     return result
@@ -137,13 +172,21 @@ def batched_screen(
     _batched_screen_jit) — the consolidation scorer's workhorse."""
     max_run = _max_run_bucket(batch)
     with_topo = _has_topo_runs(batch)
+    b_orig = 0
     if mesh is not None:
+        # actually distribute the candidate lanes: pad B to a device multiple
+        # (a 100-candidate screen on 8 devices was previously unshardable)
+        # and place the stacked tree with NamedSharding so each device runs
+        # its slice of the vmapped scan
+        batch, b_orig = _pad_lane_axis(batch, mesh)
         batch = shard_batch(batch, mesh)
     obs = programs.begin_dispatch(
         "batched_screen", max_claims, batch,
         statics={"passes": passes, "max_run": max_run, "with_topo": with_topo},
     )
     result = _batched_screen_jit(batch, max_claims, passes, max_run, with_topo)
+    if mesh is not None:
+        result = _trim_lane_axis(result, b_orig)
     if obs is not None:
         obs.finish(problem_bytes=_tree_bytes(batch))
     return result
@@ -217,7 +260,9 @@ def lean_screen(
     max_run = _max_run_bucket(base)
     with_topo = _has_topo_runs(base)
     tree = variants.tree()
+    b_orig = 0
     if mesh is not None:
+        tree, b_orig = _pad_lane_axis(tree, mesh)
         sharding = NamedSharding(mesh, P(CANDIDATE_AXIS))
         tree = tuple(jax.device_put(a, sharding) for a in tree)
         replicate = NamedSharding(mesh, P())
@@ -229,6 +274,8 @@ def lean_screen(
         statics={"passes": passes, "max_run": max_run, "with_topo": with_topo},
     )
     result = _lean_screen_jit(base, tree, max_claims, passes, max_run, with_topo)
+    if mesh is not None:
+        result = _trim_lane_axis(result, b_orig)
     if obs is not None:
         obs.finish(problem_bytes=_tree_bytes((base, tree)))
     return result
@@ -240,6 +287,55 @@ def default_mesh(min_devices: int = 2) -> Optional[Mesh]:
     if len(jax.devices()) < min_devices:
         return None
     return make_mesh()
+
+
+@functools.lru_cache(maxsize=None)
+def shard_sweeps_program(
+    mesh: Mesh, max_claims: int, bounds_free: bool, wavefront: int
+):
+    """ONE compiled program running a batch of independent sweeps solves with
+    the partition axis laid across ``mesh`` (shard/solve.py).
+
+    ``shard_map`` (not plain vmap-of-sharded-batch) is the load-bearing
+    choice: the sweeps solve is a data-dependent ``while_loop``, and under a
+    single partitioned program every device would iterate in lockstep to the
+    GLOBAL worst-case sweep count. ``shard_map`` gives each device its own
+    while-loop over its local partitions, so a device whose sub-problems
+    converge early goes idle instead of replaying dead sweeps
+    (check_rep=False — the outputs are genuinely per-shard, nothing is
+    replicated). The jit wrapper pins in_shardings/out_shardings to the mesh
+    and donates the stacked problem: the batch is consumed by the dispatch,
+    so XLA reuses its device pages for the result landscape.
+
+    Cached per (mesh, claim bucket, bounds_free, wavefront): Mesh is hashable
+    and each distinct static tuple is its own executable, mirroring the
+    unsharded program-key discipline."""
+    from jax.experimental.shard_map import shard_map
+
+    from karpenter_tpu.ops.ffd_sweeps import _solve_ffd_sweeps_fresh_jit
+
+    def _local(batch: SchedulingProblem) -> FFDResult:
+        return jax.vmap(
+            lambda p: _solve_ffd_sweeps_fresh_jit.__wrapped__(
+                p, max_claims, bounds_free, wavefront
+            )
+        )(batch)
+
+    spec = P(CANDIDATE_AXIS)
+    mapped = shard_map(
+        _local, mesh=mesh, in_specs=spec, out_specs=spec, check_rep=False
+    )
+    sharding = NamedSharding(mesh, spec)
+
+    def shard_sweeps(batch: SchedulingProblem) -> FFDResult:
+        return mapped(batch)
+
+    return jax.jit(
+        shard_sweeps,
+        in_shardings=sharding,
+        out_shardings=sharding,
+        donate_argnums=(0,),
+    )
 
 
 def scheduled_counts(result: FFDResult) -> jnp.ndarray:

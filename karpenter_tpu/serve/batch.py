@@ -199,7 +199,11 @@ def stacked_solve(group: Sequence) -> List[Optional[SolveResult]]:
     if len(group) < 2:
         return results
     try:
-        from karpenter_tpu.parallel.mesh import batched_screen, stack_problems
+        from karpenter_tpu.parallel.mesh import (
+            batched_screen,
+            default_mesh,
+            stack_problems,
+        )
 
         shared_claims = max(
             claim_axis_bucket(len(r.pods)) for r in group
@@ -221,7 +225,12 @@ def stacked_solve(group: Sequence) -> List[Optional[SolveResult]]:
         if len(lanes) < 2:
             return results
         batch = stack_problems([encoded[i][0] for i in lanes])
-        fr = batched_screen(batch, shared_claims)
+        # the SAME mesh-sharded screen dispatch the consolidation scorer uses
+        # (parallel/mesh.py batched_screen with lane-axis padding): one
+        # program per shape family in the census, and on multi-device hosts
+        # the tenant lanes actually distribute instead of vmapping on one
+        # device
+        fr = batched_screen(batch, shared_claims, mesh=default_mesh())
         state = fr.state
         fetched = jax.device_get((
             fr.kind, fr.index,

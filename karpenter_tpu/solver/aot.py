@@ -149,6 +149,33 @@ def _call_spec(solve_name: str, problem, max_claims: int, init) -> Optional[_Spe
             (problem, carry),
             (f"C{int(max_claims)}", f"bf{int(bf)}", f"wf{int(wf)}", "carried"),
         )
+    if solve_name == "shard_sweeps":
+        # the mesh-partitioned stacked-sweeps program (shard/solve.py): the
+        # jitted fn is reconstructed from the SAME statics the factory cache
+        # keys on — default mesh + claim bucket + bounds_free(stacked batch)
+        # + wavefront — so the lowered call is the exact dispatch
+        from karpenter_tpu.ops.ffd_sweeps import _wavefront_lanes
+        from karpenter_tpu.parallel.mesh import (
+            default_mesh,
+            shard_sweeps_program,
+        )
+        from karpenter_tpu import shard as shard_flags
+
+        mesh = default_mesh(shard_flags.min_devices())
+        if mesh is None:
+            return None
+        bf = problem_bounds_free(problem)
+        wf = _wavefront_lanes()
+        fn = shard_sweeps_program(mesh, int(max_claims), bf, wf)
+        return _Spec(
+            fn,
+            (problem,),
+            (problem,),
+            (
+                f"C{int(max_claims)}", f"bf{int(bf)}", f"wf{int(wf)}",
+                f"mesh{mesh.devices.size}", "shard",
+            ),
+        )
     if solve_name == "relax_place":
         from karpenter_tpu.ops.relax import _relax_place_jit, relax_passes
 

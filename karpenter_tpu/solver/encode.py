@@ -550,13 +550,21 @@ class Encoder:
         vocab_pods: Optional[Sequence[Pod]] = None,
         vocab_reqs: Optional[Sequence[Requirements]] = None,
         pod_volumes: Optional[Sequence[Dict[str, frozenset]]] = None,
+        vocab_nodes: Optional[Sequence[NodeInfo]] = None,
+        vocab_resources: Optional[Sequence[str]] = None,
     ) -> EncodedProblem:
         """``vocab_pods`` seeds the vocabulary (defaults to ``pods``): across
         the relax-and-retry passes the vocabulary must stay identical so the
         carried solver state keeps valid lane indices — callers pass the
         original unrelaxed batch there while ``pods`` shrinks to the retry
         queue. ``vocab_reqs`` seeds requirement sets that exist outside any pod
-        spec (the full pod_reqs_override universe) for the same reason."""
+        spec (the full pod_reqs_override universe) for the same reason.
+        ``vocab_nodes`` and ``vocab_resources`` extend the same freeze to the
+        node-label / host-port / CSI-driver vocabularies and the resource-axis
+        ordering: the partitioned solve (shard/) encodes disjoint pod/node
+        slices that must stack into ONE batched program, so every
+        shape-determining dictionary is seeded from the full batch while the
+        tensor sections cover only this partition's rows."""
         # -- 1. FFD queue order: cpu desc, mem desc, creation, uid (queue.go:76-111)
         pod_reqs_list = (
             list(pod_reqs_override)
@@ -591,6 +599,8 @@ class Encoder:
         )
         if vocab_pods is None:
             vocab_pods = pods
+        if vocab_nodes is None:
+            vocab_nodes = nodes
 
         groups = []
         if topology is not None:
@@ -606,7 +616,7 @@ class Encoder:
         vocab = build_vocab(
             vocab_pods,
             templates,
-            nodes,
+            vocab_nodes,
             groups,
             claim_hostnames,
             instance_types,
@@ -635,7 +645,11 @@ class Encoder:
         key_wellknown = np.array([k in self.well_known for k in vocab.keys], dtype=bool)
 
         # -- 3. resource axis
-        resource_names = [res.CPU, res.MEMORY, res.PODS, res.EPHEMERAL_STORAGE]
+        resource_names = (
+            list(vocab_resources)
+            if vocab_resources is not None
+            else [res.CPU, res.MEMORY, res.PODS, res.EPHEMERAL_STORAGE]
+        )
         seen = set(resource_names)
 
         def note_resources(rl):
@@ -650,7 +664,7 @@ class Encoder:
             note_resources(it.capacity)
         for t in templates:
             note_resources(t.daemon_overhead)
-        for n in nodes:
+        for n in vocab_nodes:
             note_resources(n.available)
 
         # -- 4. requirement tensors (encode_reqs_with_vocab — shared with the
@@ -765,7 +779,7 @@ class Encoder:
         for p in vocab_pods:
             for hp in get_host_ports(p):
                 port_vocab.setdefault(hp, len(port_vocab))
-        for n in nodes:
+        for n in vocab_nodes:
             for hp in n.host_ports:
                 port_vocab.setdefault(hp, len(port_vocab))
         PT = max(len(port_vocab), 1)
@@ -795,7 +809,7 @@ class Encoder:
             pod_port_conflict[pi] = rows[1]
         # -- CSI attach limits: one lane per driver that is limited on some
         # node (drivers no node limits never gate; see volumeusage.py)
-        drivers = sorted({d for n in nodes for d in n.volume_limits})
+        drivers = sorted({d for n in vocab_nodes for d in n.volume_limits})
         D = len(drivers)
         driver_idx = {d: i for i, d in enumerate(drivers)}
         pod_vol_counts = np.zeros((len(pods), D), dtype=np.int32)

@@ -237,6 +237,11 @@ class JaxSolver(SolverBackend):
         # lifetime count of full-gate rejections that forced a re-solve with
         # relaxation off (mirrors solver_relax_fallback_total per backend)
         self.relax_fallbacks = 0
+        # telemetry dict of the LAST partitioned-solve attempt
+        # (KARPENTER_TPU_SHARD, shard/solve.py): {"reason": None, partitions,
+        # lanes, pad_frac, ...} on success, {"reason": <classified>} on a
+        # standdown, None when the shard path never ran
+        self.last_shard = None
 
     def solve(
         self,
@@ -272,6 +277,21 @@ class JaxSolver(SolverBackend):
         with trace.cycle(
             "solve", backend=type(self).__name__, passthrough=True, pods=len(pods)
         ), self._dispatch_device(len(pods), len(nodes)):
+            if _os.environ.get("KARPENTER_TPU_SHARD", "0") not in ("", "0"):
+                # partitioned fleet-scale path (KARPENTER_TPU_SHARD): split
+                # the batch into independent sub-problems and run them as ONE
+                # mesh-partitioned program. None = classified standdown
+                # (solver_shard_fallback_total) — fall through unchanged.
+                # Lazy import: flag off, the subsystem is never even loaded.
+                from karpenter_tpu.shard import try_shard_solve
+
+                sharded = try_shard_solve(
+                    self, pods, instance_types, templates, nodes,
+                    pod_requirements_override, topology, cluster_pods,
+                    domains, pod_volumes,
+                )
+                if sharded is not None:
+                    return sharded
             while True:
                 try:
                     result = self._solve_with_slots(

@@ -250,6 +250,13 @@ class SupervisedSolver(SolverBackend):
         if obs_explain.enabled() or len(obs_explain.ring()):
             # decision provenance of recent solves (/debug/explain drills in)
             out["explain"] = obs_explain.summary()
+        last_shard = getattr(self.primary, "last_shard", None)
+        if last_shard is not None:
+            # the partitioned-solve attempt of the last supervised solve
+            # (KARPENTER_TPU_SHARD): reason=None means the mesh path served
+            # it; otherwise the classified standdown that sent the solve to
+            # the ordinary unsharded program
+            out["shard"] = last_shard
         return out
 
     # -- circuit transitions --------------------------------------------------

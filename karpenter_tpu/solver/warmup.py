@@ -258,6 +258,56 @@ def prewarm_screen(n_candidates: int) -> bool:
         return False
 
 
+def prewarm_shard(n_pods: int = 256, instance_types_n: int = 100, catalog=None) -> bool:
+    """Compile the mesh-partitioned solve program (KARPENTER_TPU_SHARD,
+    shard/solve.py) at the per-device bucket a fleet batch of ``n_pods``
+    splittable pods lands on. The shard program is its own executable family
+    (shard_map over the mesh; cached per mesh/claim-bucket/bounds_free/
+    wavefront in parallel/mesh.py), so an unwarmed server pays its first
+    fleet-scale compile on the scale-out burst it exists to absorb. No-op
+    (False) when the flag is off or the host has a single device; failures
+    are swallowed — warming is an optimization, never a liveness
+    dependency."""
+    import random
+
+    from karpenter_tpu import shard as shard_flags
+    from karpenter_tpu.apis.nodepool import NodePool
+    from karpenter_tpu.apis.objects import Container, ObjectMeta, Pod, PodSpec
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.obs import trace
+    from karpenter_tpu.parallel.mesh import default_mesh
+    from karpenter_tpu.solver.encode import template_from_nodepool
+    from karpenter_tpu.solver.jax_backend import JaxSolver
+
+    if not shard_flags.enabled():
+        return False
+    if default_mesh(shard_flags.min_devices()) is None:
+        return False
+    its = catalog if catalog else instance_types(instance_types_n)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="prewarm-shard")), its, range(len(its))
+    )
+    rng = random.Random(2)
+    pods = [
+        Pod(
+            metadata=ObjectMeta(name=f"warm-shard-{i}"),
+            spec=PodSpec(
+                containers=[Container(requests={"cpu": rng.choice([0.1, 0.5, 1.0])})]
+            ),
+        )
+        for i in range(max(n_pods, shard_flags.min_pods()))
+    ]
+    try:
+        with trace.cycle("warmup", kind="shard", pods=len(pods)):
+            solver = JaxSolver()
+            solver.solve(pods, its, [tpl])
+            return bool(
+                solver.last_shard and solver.last_shard.get("reason") is None
+            )
+    except Exception:
+        return False
+
+
 def _probe_solve(n_pods: int = 12, instance_types_n: int = 20) -> bool:
     """One small solve through the REAL backend entrypoint, checked hard:
     every pod accounted exactly once and the fast validator gate clean. This
@@ -466,6 +516,13 @@ def maybe_prewarm_in_background(options, cloud_provider=None) -> Optional["objec
                 prewarm_screen(n_screen)
             except Exception:
                 log.warning("prewarm: screen warm failed", exc_info=True)
+        try:
+            # fleet-scale partitioned program (no-op unless KARPENTER_TPU_SHARD
+            # is on and a mesh exists): first scale-out burst should hit a
+            # warm executable, not a cold shard_map compile
+            prewarm_shard(catalog=catalog)
+        except Exception:
+            log.warning("prewarm: shard warm failed", exc_info=True)
         # the startup compile bill, itemized (obs/programs.py): how many
         # programs the warm compiled, what they cost, and how many came
         # back from the persistent cache instead of a cold trace

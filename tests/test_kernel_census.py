@@ -22,6 +22,7 @@ from tools.kernel_census import (
     gate_jaxpr_eqns,
     narrow_jaxpr_eqns,
     relax_jaxpr_eqns,
+    shard_jaxpr_eqns,
 )
 
 # measured 2394 at the round-7 commit (P=64 T=64 K=4 V=32 C=16 after
@@ -52,6 +53,14 @@ RELAX_EQN_BUDGET = 1450
 # invariants over a decoded result — ~0.14x of ONE narrow iteration, which
 # is why re-verifying every accept on device is affordable at all
 GATE_EQN_BUDGET = 400
+
+# round-18 mesh-partitioned solve program (KARPENTER_TPU_SHARD): measured
+# 3702 at the round-18 commit. This is the WHOLE per-device body the
+# shard_map program runs — the vmapped sweeps solve, while-loop included —
+# so it sits a bit above one narrow iteration (~2394) plus the loop/scan
+# scaffolding. It is lane-count invariant: more partitions widen the batch,
+# never the program
+SHARD_EQN_BUDGET = 3900
 
 
 @pytest.fixture(scope="module")
@@ -291,3 +300,53 @@ class TestGateBudget:
                 os.environ.pop("KARPENTER_TPU_DEVICE_GATE", None)
             else:
                 os.environ["KARPENTER_TPU_DEVICE_GATE"] = old
+
+
+class TestShardBudget:
+    """Round-18 mesh-partitioned solve: the sharded program body gets its
+    own pinned budget, and the flag must not touch the narrow body — the
+    shard entry lives at the backend seam (solver/jax_backend.py), so
+    KARPENTER_TPU_SHARD=1 dispatches a DIFFERENT program
+    (parallel/mesh.py shard_sweeps_program) rather than editing any
+    unsharded kernel."""
+
+    def test_shard_program_under_budget(self, census_problem):
+        eqns = shard_jaxpr_eqns(census_problem)
+        assert eqns <= SHARD_EQN_BUDGET, (
+            f"mesh-partitioned solve body grew to {eqns} jaxpr eqns "
+            f"(budget {SHARD_EQN_BUDGET}); every partition lane pays this "
+            f"per sweeps iteration — see tools/kernel_census.py "
+            f"shard_jaxpr_eqns to attribute the growth"
+        )
+
+    def test_shard_budget_is_tight(self, census_problem):
+        eqns = shard_jaxpr_eqns(census_problem)
+        assert eqns >= SHARD_EQN_BUDGET * 0.8, (
+            f"mesh-partitioned solve body shrank to {eqns} jaxpr eqns — "
+            f"nice! tighten SHARD_EQN_BUDGET to keep the guard meaningful"
+        )
+
+    def test_shard_flag_on_narrow_body_unchanged(self, census_problem):
+        """With the shard subsystem imported AND the flag forced on, the
+        flag-off narrow body must still count EXACTLY 2394 equations — the
+        partitioned path selects its own program at the backend seam, and a
+        flag-off process never even imports karpenter_tpu.shard."""
+        import karpenter_tpu.shard  # noqa: F401 — import must be inert too
+
+        old = os.environ.get("KARPENTER_TPU_SHARD")
+        os.environ["KARPENTER_TPU_SHARD"] = "1"
+        try:
+            assert narrow_jaxpr_eqns(census_problem, wavefront=0) == 2394
+        finally:
+            if old is None:
+                os.environ.pop("KARPENTER_TPU_SHARD", None)
+            else:
+                os.environ["KARPENTER_TPU_SHARD"] = old
+
+    def test_lane_count_invariant(self, census_problem):
+        """The per-device body must not grow with the partition count —
+        that's the whole scaling story: more partitions widen the data,
+        never the program."""
+        assert shard_jaxpr_eqns(census_problem, lanes=8) == shard_jaxpr_eqns(
+            census_problem, lanes=16
+        )

@@ -1,0 +1,473 @@
+"""Partition-parity fuzz — the mesh-sharded solve guard (round 18).
+
+KARPENTER_TPU_SHARD splits a batch into independent sub-problems
+(shard/partition.py) and runs them as ONE shard_map program over the mesh
+(shard/solve.py). The correctness contract is scheduled-SET parity: the
+partitioned solve must schedule exactly the pods the unsharded solve
+schedules, with identical failures and identical existing-node placements
+— claim GROUPINGS may differ (pods split across partitions open separate
+claims from the same infinite template; the post-solve merge may re-join
+some), but never whether a pod schedules.
+
+Three suites:
+
+- ``TestPartitioner``: host-side unit checks of the union-find plan —
+  conservation (every pod exactly once), co-partitioning of anything that
+  shares state (a node, a group, a finite-template budget), node routing,
+  unreachable-node drops, and the two-stage non-decomposable classification.
+- ``TestShardParityFuzz``: runtime differentials over plain / topology-heavy
+  / port-heavy / claim-heavy corpora on the 8-device test mesh, each arm
+  behind the full-level device gate (conftest leaves the gate at its
+  default-ON), asserting set parity plus zero gate rejections.
+- ``TestClassifiedFallbacks``: every classified standdown reason in
+  shard.REASONS fires on a purpose-built adversarial input (or a surgical
+  monkeypatch for the defense-in-depth reasons no natural input reaches),
+  and every standdown is transparent — the returned result is the
+  unsharded path's result.
+"""
+
+import contextlib
+import os
+import random
+import types
+
+import pytest
+
+from karpenter_tpu import shard
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.objects import (
+    Container,
+    ContainerPort,
+    DO_NOT_SCHEDULE,
+    LabelSelector,
+    ObjectMeta,
+    Pod,
+    PodSpec,
+    SCHEDULE_ANYWAY,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.cloudprovider.fake import (
+    FAKE_WELL_KNOWN_LABELS,
+    GI,
+    instance_types,
+    make_instance_type,
+)
+from karpenter_tpu.scheduling import Requirements, Taints
+from karpenter_tpu.solver.encode import NodeInfo
+from karpenter_tpu.solver.jax_backend import JaxSolver
+from karpenter_tpu.utils import resources as res
+from tests.test_solver_parity import make_pod, simple_template
+
+ZONES = ["test-zone-1", "test-zone-2", "test-zone-3"]
+
+
+@contextlib.contextmanager
+def shard_on(**env):
+    """Flip the shard flag (and any extra knobs) for one solve, restoring
+    the ambient environment after — the suite must not leak flags into the
+    census/parity suites that pin the flag-off path."""
+    values = {"KARPENTER_TPU_SHARD": "1", "KARPENTER_TPU_SHARD_MIN_PODS": "2"}
+    values.update(env)
+    old = {k: os.environ.get(k) for k in values}
+    os.environ.update(values)
+    try:
+        yield
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def solve_pair(pods, its, templates, nodes=(), cluster_pods=(), **env):
+    """One sharded solve and one unsharded control over the same input.
+    Returns (shard_solver, sharded_result, plain_result)."""
+    with shard_on(**env):
+        s = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS)
+        sharded = s.solve(pods, its, templates, nodes, cluster_pods=cluster_pods)
+    plain = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(
+        pods, its, templates, nodes, cluster_pods=cluster_pods
+    )
+    return s, sharded, plain
+
+
+def scheduled_set(result):
+    out = sorted(i for c in result.new_claims for i in c.pod_indices)
+    assert len(out) == len(set(out)), "pod claimed twice"
+    return out
+
+
+def assert_parity(pods, result, control):
+    """Scheduled-set parity: same claimed pods, same failures, same
+    existing-node placements (per-node membership; FFD visit order within a
+    partition is local, so list order is not part of the contract)."""
+    assert scheduled_set(result) == scheduled_set(control)
+    assert result.failures == control.failures
+    assert set(result.node_pods) == set(control.node_pods)
+    for name, plist in control.node_pods.items():
+        assert sorted(result.node_pods[name]) == sorted(plist), name
+    covered = (
+        set(scheduled_set(result))
+        | set(result.failures)
+        | {i for plist in result.node_pods.values() for i in plist}
+    )
+    assert covered == set(range(len(pods)))
+
+
+def assert_served_by_shard(solver, parts_at_least=2):
+    info = solver.last_shard
+    assert info is not None and info["reason"] is None, info
+    assert info["partitions"] >= parts_at_least
+    assert info["gate_rejections"] == 0
+    return info
+
+
+def make_node(name, cpu=8.0, labels=None, taints=None, zone="test-zone-1"):
+    return NodeInfo(
+        name=name,
+        requirements=Requirements.from_labels(
+            {
+                **(labels or {}),
+                wk.LABEL_HOSTNAME: name,
+                wk.LABEL_TOPOLOGY_ZONE: zone,
+                wk.CAPACITY_TYPE_LABEL_KEY: "on-demand",
+            }
+        ),
+        taints=Taints(taints or []),
+        available={res.CPU: cpu, res.MEMORY: 16 * GI, res.PODS: 100.0},
+        daemon_overhead={},
+    )
+
+
+def port_pod(i, host_port, cpu=0.5, selector=None):
+    return Pod(
+        metadata=ObjectMeta(name=f"pp{i}"),
+        spec=PodSpec(
+            containers=[
+                Container(
+                    requests={"cpu": cpu, "memory": 1e8},
+                    ports=[ContainerPort(host_port=host_port)],
+                )
+            ],
+            node_selector=selector or {},
+        ),
+    )
+
+
+def spread_pod(i, letter, max_skew=1, when=DO_NOT_SCHEDULE, cpu=0.5):
+    return Pod(
+        metadata=ObjectMeta(name=f"sp{i}", labels={"my-label": letter}),
+        spec=PodSpec(
+            containers=[Container(requests={"cpu": cpu, "memory": 1e8})],
+            topology_spread_constraints=[
+                TopologySpreadConstraint(
+                    max_skew=max_skew,
+                    topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                    when_unsatisfiable=when,
+                    label_selector=LabelSelector(match_labels={"my-label": letter}),
+                )
+            ],
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# host-side partitioner units
+# ---------------------------------------------------------------------------
+
+
+class TestPartitioner:
+    def _plan(self, pods, templates, nodes=(), groups=(), n_parts=4, override=None):
+        return shard.partition_pods(pods, templates, list(nodes), list(groups), n_parts, override)
+
+    def test_splittable_pods_conserved_and_balanced(self):
+        its = instance_types(4)
+        pods = [make_pod(i) for i in range(17)]
+        plan = self._plan(pods, [simple_template(its)], n_parts=4)
+        assert plan.reason is None
+        assert len(plan.parts) == 4
+        seen = sorted(i for pt in plan.parts for i in pt.pod_idx)
+        assert seen == list(range(17))
+        # leveling contract: no bin exceeds the ideal share ceil(17/4)=5
+        # (the pad bucket is set by the LARGEST partition, so the ceiling is
+        # what bounds pad waste; a light tail bin costs nothing)
+        assert max(len(pt.pod_idx) for pt in plan.parts) <= 5
+
+    def test_node_sharers_co_partitioned(self):
+        its = instance_types(4)
+        # two distinct classes, both compatible with one node => one atomic
+        # component; a third class selecting elsewhere stays separate
+        pods = [make_pod(0), make_pod(1, tolerations=[Toleration(key="t", operator="Exists")])]
+        pods += [make_pod(i, selector={wk.LABEL_TOPOLOGY_ZONE: "test-zone-2"}) for i in (2, 3)]
+        nodes = [make_node("n1", zone="test-zone-1")]
+        plan = self._plan(pods, [simple_template(its)], nodes=nodes)
+        assert plan.reason is None
+        by_pod = {i: pi for pi, pt in enumerate(plan.parts) for i in pt.pod_idx}
+        assert by_pod[0] == by_pod[1]
+        assert plan.parts[by_pod[0]].node_idx == [0]
+        for pt in plan.parts:
+            if 0 not in pt.pod_idx:
+                assert pt.node_idx == []
+
+    def test_unreachable_node_dropped(self):
+        its = instance_types(4)
+        pods = [make_pod(i) for i in range(4)]
+        nodes = [make_node("n1", taints=[Taint(key="no", effect="NoSchedule")])]
+        plan = self._plan(pods, [simple_template(its)], nodes=nodes)
+        assert plan.reason is None
+        assert plan.dropped_nodes == 1
+        assert all(pt.node_idx == [] for pt in plan.parts)
+
+    def test_finite_template_budget_glues(self):
+        its = instance_types(4)
+        tpl = simple_template(its)
+        tpl.remaining_resources = {"cpu": 40.0}
+        pods = [make_pod(i) for i in range(4)] + [
+            make_pod(i, selector={wk.LABEL_TOPOLOGY_ZONE: "test-zone-2"}) for i in (4, 5)
+        ]
+        plan = self._plan(pods, [tpl])
+        # without the budget the two classes split; with it they collapse
+        assert plan.reason == shard.REASON_CROSS_PARTITION_CLAIMS
+        assert not plan.parts
+
+    def test_anchored_monolith_is_single_partition(self):
+        its = instance_types(4)
+        pods = [make_pod(i) for i in range(6)]
+        nodes = [make_node("n1")]
+        plan = self._plan(pods, [simple_template(its)], nodes=nodes)
+        assert plan.reason == shard.REASON_SINGLE_PARTITION
+
+    def test_tiny_batch_is_single_partition(self):
+        its = instance_types(4)
+        plan = self._plan([make_pod(0)], [simple_template(its)])
+        assert plan.reason == shard.REASON_SINGLE_PARTITION
+
+
+# ---------------------------------------------------------------------------
+# runtime differentials (8-device CPU mesh, device gate at default-ON)
+# ---------------------------------------------------------------------------
+
+
+class TestShardParityFuzz:
+    @pytest.mark.parametrize("seed", range(4))
+    def test_fuzz_plain(self, seed):
+        rng = random.Random(seed)
+        its = instance_types(rng.randint(3, 8))
+        templates = [simple_template(its, name="a")]
+        if rng.random() < 0.5:
+            taint = Taint(key="team", value="x", effect="NoSchedule")
+            templates.append(simple_template(its, name="b", taints=[taint]))
+        pods = []
+        for i in range(rng.randint(24, 48)):
+            selector = {}
+            if rng.random() < 0.3:
+                selector[wk.LABEL_TOPOLOGY_ZONE] = rng.choice(ZONES)
+            if rng.random() < 0.15:
+                selector[wk.CAPACITY_TYPE_LABEL_KEY] = rng.choice(["spot", "on-demand"])
+            tols = [Toleration(key="team", operator="Exists")] if rng.random() < 0.3 else []
+            pods.append(
+                make_pod(
+                    i,
+                    cpu=rng.choice([0.1, 0.25, 0.5, 1.0, 1.5, 3.0]),
+                    mem=rng.choice([1e8, 2.5e8, 1e9]),
+                    selector=selector,
+                    tolerations=tols,
+                )
+            )
+        s, sharded, plain = solve_pair(pods, its, templates)
+        assert_served_by_shard(s)
+        assert_parity(pods, sharded, plain)
+
+    @pytest.mark.parametrize("seed", range(2))
+    def test_fuzz_topology(self, seed):
+        """Disjoint hard-spread families: each letter is its own G-group, so
+        the partitioner may separate letters but never split one."""
+        rng = random.Random(100 + seed)
+        its = instance_types(6)
+        pods = []
+        for i in range(36):
+            letter = rng.choice("abcdef")
+            pods.append(
+                spread_pod(
+                    i, letter,
+                    max_skew=rng.choice([1, 1, 2]),
+                    cpu=rng.choice([0.25, 0.5, 1.0]),
+                )
+            )
+        s, sharded, plain = solve_pair(pods, its, [simple_template(its)])
+        assert_served_by_shard(s)
+        assert_parity(pods, sharded, plain)
+
+    @pytest.mark.parametrize("seed", range(2))
+    def test_fuzz_ports(self, seed):
+        """Host-port-heavy mix over existing nodes: port conflicts pin pods
+        apart on shared capacity; port pods are excluded from the merge."""
+        rng = random.Random(200 + seed)
+        its = instance_types(6)
+        pods, nodes = [], [make_node("n1", cpu=6.0), make_node("n2", cpu=6.0, zone="test-zone-2")]
+        for i in range(28):
+            # every pod pins a zone so the two node neighborhoods stay
+            # disjoint components (an unselective pod reaches both nodes and
+            # would glue the whole batch into one atomic partition)
+            zone = rng.choice(["test-zone-1", "test-zone-2"])
+            selector = {wk.LABEL_TOPOLOGY_ZONE: zone}
+            if rng.random() < 0.4:
+                pods.append(port_pod(i, host_port=rng.choice([80, 443, 8080]), selector=selector))
+            else:
+                pods.append(make_pod(i, cpu=rng.choice([0.25, 0.5, 1.0]), selector=selector))
+        s, sharded, plain = solve_pair(pods, its, [simple_template(its)], nodes=nodes)
+        info = s.last_shard
+        assert info is not None and info["reason"] is None, info
+        assert_parity(pods, sharded, plain)
+
+    def test_fuzz_claims_and_merge(self):
+        """Claim-heavy batch: identical free pods split across partitions
+        open per-partition claims; the merge re-joins only what fits."""
+        its = instance_types(4)
+        pods = [make_pod(i, cpu=0.5 + (i % 3) * 0.25) for i in range(40)]
+        s, sharded, plain = solve_pair(pods, its, [simple_template(its)])
+        info = assert_served_by_shard(s)
+        assert_parity(pods, sharded, plain)
+        assert info["merged_claims"] >= 1
+        # merged claims never outnumber the unsharded packing's claims by
+        # more than the partition count (each partition adds at most one
+        # under-filled tail claim per shape class)
+        assert len(sharded.new_claims) <= len(plain.new_claims) + info["partitions"]
+
+    def test_merge_disabled_still_parity(self):
+        its = instance_types(4)
+        pods = [make_pod(i) for i in range(24)]
+        s, sharded, plain = solve_pair(
+            pods, its, [simple_template(its)], KARPENTER_TPU_SHARD_MERGE="0"
+        )
+        info = assert_served_by_shard(s)
+        assert info["merged_claims"] == 0
+        assert_parity(pods, sharded, plain)
+
+    def test_flag_off_never_attempts(self):
+        its = instance_types(4)
+        s = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS)
+        s.solve([make_pod(i) for i in range(8)], its, [simple_template(its)])
+        assert s.last_shard is None
+
+
+# ---------------------------------------------------------------------------
+# classified standdowns — every reason in shard.REASONS
+# ---------------------------------------------------------------------------
+
+
+class TestClassifiedFallbacks:
+    def _expect_standdown(self, reason, pods, its, templates, nodes=(), **env):
+        s, sharded, plain = solve_pair(pods, its, templates, nodes=nodes, **env)
+        assert s.last_shard is not None and s.last_shard["reason"] == reason, s.last_shard
+        assert_parity(pods, sharded, plain)  # the standdown is transparent
+        return s
+
+    def test_small_batch(self):
+        its = instance_types(4)
+        pods = [make_pod(i) for i in range(6)]
+        self._expect_standdown(
+            shard.REASON_SMALL_BATCH, pods, its, [simple_template(its)],
+            KARPENTER_TPU_SHARD_MIN_PODS="512",
+        )
+
+    def test_single_device(self):
+        its = instance_types(4)
+        pods = [make_pod(i) for i in range(12)]
+        self._expect_standdown(
+            shard.REASON_SINGLE_DEVICE, pods, its, [simple_template(its)],
+            KARPENTER_TPU_SHARD_MIN_DEVICES="16",
+        )
+
+    def test_relaxable(self):
+        its = instance_types(4)
+        pods = [make_pod(i) for i in range(10)]
+        pods.append(spread_pod(10, "a", when=SCHEDULE_ANYWAY))
+        self._expect_standdown(shard.REASON_RELAXABLE, pods, its, [simple_template(its)])
+
+    def test_unsupported_args_explain(self):
+        its = instance_types(4)
+        pods = [make_pod(i) for i in range(10)]
+        s = self._expect_standdown(
+            shard.REASON_UNSUPPORTED_ARGS, pods, its, [simple_template(its)],
+            KARPENTER_TPU_EXPLAIN="1",
+        )
+        assert s.last_shard.get("arg") == "explain"
+
+    def test_single_partition(self):
+        its = instance_types(4)
+        pods = [make_pod(i) for i in range(12)]
+        self._expect_standdown(
+            shard.REASON_SINGLE_PARTITION, pods, its, [simple_template(its)],
+            nodes=[make_node("n1", cpu=64.0)],
+        )
+
+    def test_cross_partition_claims(self):
+        its = instance_types(4)
+        tpl = simple_template(its)
+        tpl.remaining_resources = {"cpu": 100.0}
+        pods = [make_pod(i) for i in range(8)] + [
+            make_pod(i, selector={wk.LABEL_TOPOLOGY_ZONE: "test-zone-2"})
+            for i in range(8, 16)
+        ]
+        self._expect_standdown(shard.REASON_CROSS_PARTITION_CLAIMS, pods, its, [tpl])
+
+    def test_shape_mismatch(self, monkeypatch):
+        # unreachable by construction (one shared vocabulary) — force the
+        # defensive check to prove it stands down instead of crashing
+        import karpenter_tpu.shard.solve as shard_solve
+
+        counter = iter(range(10**6))
+        monkeypatch.setattr(
+            shard_solve, "_tree_shapes", lambda problem: next(counter)
+        )
+        its = instance_types(4)
+        pods = [make_pod(i) for i in range(12)]
+        self._expect_standdown(shard.REASON_SHAPE_MISMATCH, pods, its, [simple_template(its)])
+
+    def test_slot_overflow(self, monkeypatch):
+        # pin the claim bucket at 1 so a partition needing two claims hits
+        # NO_SLOT with no escalation headroom
+        import karpenter_tpu.shard.solve as shard_solve
+
+        monkeypatch.setattr(shard_solve, "claim_axis_bucket", lambda n: 1)
+        its = [make_instance_type("one")]  # 4cpu default: one 3cpu pod per claim
+        pods = [make_pod(i, cpu=3.0) for i in range(16)]
+        self._expect_standdown(shard.REASON_SLOT_OVERFLOW, pods, its, [simple_template(its)])
+
+    def test_merge_rejected(self, monkeypatch):
+        from karpenter_tpu import verify
+
+        monkeypatch.setattr(
+            verify, "full_gate",
+            lambda *a, **kw: types.SimpleNamespace(violations=["forced"]),
+        )
+        its = instance_types(4)
+        pods = [make_pod(i) for i in range(12)]
+        self._expect_standdown(shard.REASON_MERGE_REJECTED, pods, its, [simple_template(its)])
+
+    def test_error_degrades_not_raises(self, monkeypatch):
+        import karpenter_tpu.shard.solve as shard_solve
+
+        def boom(*a, **kw):
+            raise RuntimeError("forced partitioner failure")
+
+        monkeypatch.setattr(shard_solve, "partition_pods", boom)
+        its = instance_types(4)
+        pods = [make_pod(i) for i in range(12)]
+        s = self._expect_standdown(shard.REASON_ERROR, pods, its, [simple_template(its)])
+        assert "forced partitioner failure" in s.last_shard["error"]
+
+    def test_every_reason_classified(self):
+        """The suite above must cover the full label-value vocabulary."""
+        exercised = {
+            shard.REASON_SMALL_BATCH, shard.REASON_SINGLE_DEVICE,
+            shard.REASON_RELAXABLE, shard.REASON_UNSUPPORTED_ARGS,
+            shard.REASON_SINGLE_PARTITION, shard.REASON_CROSS_PARTITION_CLAIMS,
+            shard.REASON_SHAPE_MISMATCH, shard.REASON_SLOT_OVERFLOW,
+            shard.REASON_MERGE_REJECTED, shard.REASON_ERROR,
+        }
+        assert exercised == set(shard.REASONS)

@@ -228,6 +228,41 @@ def gate_jaxpr_eqns(problem=None, C: int = 16) -> int:
     return _count_jaxpr_eqns(jaxpr)
 
 
+def shard_jaxpr_eqns(problem=None, C: int = 16, lanes: int = 8, wavefront: int = 0) -> int:
+    """Flattened jaxpr equation count of the WHOLE mesh-partitioned solve
+    program (parallel/mesh.py shard_sweeps_program, KARPENTER_TPU_SHARD).
+    Unlike the narrow step this traces the full per-device body — the
+    shard_map-wrapped vmap over each device's local partitions, sweeps
+    while-loop included — so the count covers everything a partition lane
+    executes. The count is lane-count invariant (shard_map traces one
+    device's slice); ``lanes`` only sets the batch the trace sees. Pinned by
+    tests/test_kernel_census.py, which also proves KARPENTER_TPU_SHARD=1
+    leaves the narrow body untouched — the shard flag SELECTS a different
+    program at the backend seam, it never edits the unsharded kernels."""
+    import jax
+
+    from karpenter_tpu.ops.ffd_core import problem_bounds_free
+    from karpenter_tpu.parallel.mesh import (
+        default_mesh,
+        shard_sweeps_program,
+        stack_problems,
+    )
+
+    if problem is None:
+        problem = build_census_problem(claim_slots=C)
+    mesh = default_mesh(2)
+    if mesh is None:
+        raise RuntimeError("shard census needs a multi-device host (tests "
+                           "force an 8-device CPU mesh via XLA_FLAGS)")
+    n = max(lanes, mesh.devices.size)
+    n -= n % mesh.devices.size
+    batch = stack_problems([problem] * n)
+    bounds_free = problem_bounds_free(batch)
+    fn = shard_sweeps_program(mesh, C, bounds_free, wavefront)
+    jaxpr = jax.make_jaxpr(lambda b: fn(b))(batch)
+    return _count_jaxpr_eqns(jaxpr)
+
+
 def _count_hlo_ops(text: str):
     """(entry_ops, total_ops) over an HLO text dump. Post-optimization each
     ENTRY instruction is roughly one kernel launch (fusions count once)."""
@@ -277,6 +312,12 @@ def main(argv):
     gate_eqns = gate_jaxpr_eqns(problem, C)
     print(f"  jaxpr_eqns_gate      = {gate_eqns}  (whole verification gate "
           f"program)")
+    try:
+        shard_eqns = shard_jaxpr_eqns(problem, C)
+        print(f"  jaxpr_eqns_shard     = {shard_eqns}  (whole mesh-partitioned "
+              f"solve program, per-device body)")
+    except RuntimeError as exc:
+        print(f"  jaxpr_eqns_shard     = n/a ({exc})")
     if not quick:
         entry, total = narrow_hlo_ops(problem, C)
         print(f"  hlo_entry_ops  = {entry}")

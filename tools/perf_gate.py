@@ -92,6 +92,16 @@ DEFAULT_BANDS = {
     # against cliffs in the serving path across rounds.
     "serve_agg_pods_s": (HIGHER_BETTER, 4.0),
     "serve_p99_cycle_s": (LOWER_BETTER, 4.0),
+    # round-18 mesh-sharded partitioned solve (shard/): the fleet-scale
+    # 100k-pod wall through the partitioned path, its pad waste, and the
+    # A/B ratio vs the unsharded control. The first shard-carrying run
+    # seeds each window; bands start wide for the same seed-heterogeneity
+    # reason as the rest. shard_partitions is recorded in the row but not
+    # banded — it is a topology fact (devices x splittability), not a perf
+    # curve.
+    "solve_100k_s": (LOWER_BETTER, 4.0),
+    "shard_pad_frac": (LOWER_BETTER, 3.0),
+    "shard_speedup_vs_control": (HIGHER_BETTER, 3.0),
 }
 
 # absolute ceiling for the --smoke tiny-shape solve (steady-state, post
@@ -142,6 +152,14 @@ def row_from_bench(out: dict, label: str = "run") -> dict:
         "serve_p99_cycle_s": out.get("serve_p99_cycle_s"),
         "serve_vs_sequential": out.get("serve_vs_sequential"),
         "serve_batch_hit_rate": out.get("serve_batch_hit_rate"),
+        # schema v2, round 18: mesh-sharded partitioned solve columns —
+        # present only when the bench shard shape family ran and the
+        # partitioned path actually served (standdowns omit the columns)
+        "solve_100k_s": out.get("solve_100k_s"),
+        "shard_partitions": out.get("shard_partitions"),
+        "shard_pad_frac": out.get("shard_pad_frac"),
+        "shard_speedup_vs_control": out.get("shard_speedup_vs_control"),
+        "shard_mesh_devices": out.get("shard_mesh_devices"),
         "error": out.get("error"),
     }
     row.update({k: v for k, v in optional.items() if v is not None})
