@@ -1294,6 +1294,11 @@ def main():
         # the BASELINE north star: 10k pods x 400+ ITs Solve() latency
         out["solve_10k_pods_s"] = round(north["solve_s"], 3)
         out["solve_10k_vs_100ms_target"] = round(0.1 / max(north["solve_s"], 1e-9), 4)
+        if "narrow_iterations" in north:
+            # round-19 ordering-policy headline column: sequential depth of
+            # the north-star shape, banded by tools/perf_gate.py so an
+            # ordering regression fails the gate even when wall time hides it
+            out["narrow_iterations_10k"] = north["narrow_iterations"]
     # round-15 two-phase columns (schema v2): phase-1 coverage, the repair
     # tail, and the relax dispatch's wall. Present only when the run had
     # KARPENTER_TPU_RELAX on — flag-off rows simply lack them, and the gate
@@ -1519,10 +1524,176 @@ def _emit_history_row(out: dict) -> None:
             out["history_row_error"] = repr(exc)
 
 
+# -- learned-ordering corpus recorder (tools/train_order.py input) -------------
+
+ORDER_CORPUS_SCHEMA = 1
+
+
+def record_order_corpus(path: str) -> int:
+    """``bench.py --record-order-corpus out.jsonl``: record the training
+    corpus for the learned ordering policy (solver/ordering.py).
+
+    For each seeded bench instance (diverse mix; shapes/seeds/candidate count
+    via BENCH_CORPUS_SHAPES / BENCH_CORPUS_SEEDS / BENCH_CORPUS_CANDIDATES)
+    the recorder solves once under the static order, then once per seeded
+    random candidate weight vector installed as the HOST tie-break — realized
+    narrow iterations are the training signal. The device half
+    (KARPENTER_TPU_ORDER_POLICY_LANES) stays OFF during the search on
+    purpose: candidate weights only permute the encode order, which is data,
+    so the whole search reuses one compiled program per shape bucket instead
+    of recompiling per candidate.
+
+    Every row is schema'd JSONL and everything is seeded (pod generator,
+    candidate sampler), so re-recording from the committed settings
+    reproduces the committed corpus byte-for-byte — the determinism
+    tools/train_order.py's round-trip test stands on.
+    """
+    from karpenter_tpu.operator.logging import quiet_xla_warnings
+
+    quiet_xla_warnings(notify_stderr=True)
+    import __graft_entry__
+
+    __graft_entry__._respect_platform_env()
+
+    import numpy as np
+
+    from karpenter_tpu.apis import labels as wk
+    from karpenter_tpu.apis.nodepool import NodePool
+    from karpenter_tpu.apis.objects import ObjectMeta
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.ops import policy as dev_policy
+    from karpenter_tpu.ops.padding import pad_problem
+    from karpenter_tpu.provisioning.topology import Topology
+    from karpenter_tpu.solver import ordering
+    from karpenter_tpu.solver.encode import (
+        Encoder,
+        domains_from_instance_types,
+        template_from_nodepool,
+    )
+    from karpenter_tpu.solver.jax_backend import JaxSolver
+
+    shapes = [
+        int(x)
+        for x in os.environ.get("BENCH_CORPUS_SHAPES", "500,1000,2000").split(",")
+    ]
+    seeds = [
+        int(x) for x in os.environ.get("BENCH_CORPUS_SEEDS", "0,1,2").split(",")
+    ]
+    n_cand = int(os.environ.get("BENCH_CORPUS_CANDIDATES", "16"))
+
+    its = instance_types(400)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="default")), its, range(len(its))
+    )
+    solver = JaxSolver()
+    # one candidate set shared across every instance so the trainer can
+    # aggregate a candidate's fitness over the whole corpus. Structured
+    # single-feature directions lead (the tie-break only reorders classes
+    # WITHIN a resource tier, so per-feature probes map the whole lever),
+    # then small seeded random combinations — large random weights measure
+    # uniformly worse than static on this family, so the random tail stays
+    # near zero where the stable sort keeps candidates static-adjacent.
+    cand_rng = np.random.RandomState(
+        int(os.environ.get("BENCH_CORPUS_CANDIDATE_SEED", "7"))
+    )
+    eye = np.eye(ordering.N_HOST_FEATURES, dtype=np.float32)
+    structured = [s * eye[f] for f in range(ordering.N_HOST_FEATURES) for s in (1.0, -1.0)]
+    candidates = (structured + [
+        np.round(
+            cand_rng.normal(0.0, 0.25, ordering.N_HOST_FEATURES), 4
+        ).astype(np.float32)
+        for _ in range(max(0, n_cand - len(structured)))
+    ])[:n_cand]
+
+    old_flag = os.environ.get(ordering.FLAG)
+    old_lanes = os.environ.get(ordering.LANES_FLAG)
+    rows = []
+    t_start = time.perf_counter()
+    try:
+        os.environ[ordering.LANES_FLAG] = "0"
+        for shape in shapes:
+            for seed in seeds:
+                pods = make_diverse_pods(shape, random.Random(seed))
+                os.environ.pop(ordering.FLAG, None)
+                ordering.set_override(None)
+                solver.solve(pods, its, [tpl])  # warm the shape bucket
+                r0 = solver.solve(pods, its, [tpl])
+                static_narrow = int(solver.last_iters.narrow)
+                host_feats = ordering.host_features(pods)
+                # lane features in problem-row order, with the row->pod map,
+                # so the trainer can align both heads over the same pods
+                domains = domains_from_instance_types(its, [tpl])
+                topo = Topology(domains, batch_pods=pods, cluster_pods=[])
+                encoded = Encoder(wk.WELL_KNOWN_LABELS).encode(
+                    pods, its, [tpl], [], topology=topo, num_claim_slots=128
+                )
+                problem = pad_problem(encoded.problem)
+                lane_feats = np.asarray(
+                    dev_policy.lane_features(problem)[: len(pods)]
+                )
+                rows.append({
+                    "schema": ORDER_CORPUS_SCHEMA,
+                    "event": "instance",
+                    "family": "diverse",
+                    "pods": shape,
+                    "seed": seed,
+                    "static_narrow": static_narrow,
+                    "static_scheduled": r0.num_scheduled(),
+                    "host_feature_version": ordering.HOST_FEATURE_VERSION,
+                    "lane_feature_version": dev_policy.LANE_FEATURE_VERSION,
+                    "host_features": np.round(host_feats, 4).tolist(),
+                    "lane_features": np.round(lane_feats, 4).tolist(),
+                    "pod_order": list(encoded.meta.pod_order[: len(pods)]),
+                })
+                os.environ[ordering.FLAG] = "1"
+                for c, w in enumerate(candidates):
+                    ordering.set_override({
+                        "arch": "linear",
+                        "feature_version": ordering.HOST_FEATURE_VERSION,
+                        "lane_feature_version": dev_policy.LANE_FEATURE_VERSION,
+                        "host": {"w": w.tolist(), "b": 0.0, "hidden": None},
+                        "lane": {"w": [0.0] * 10, "b": 0.0, "hidden": None},
+                    })
+                    rc = solver.solve(pods, its, [tpl])
+                    rows.append({
+                        "schema": ORDER_CORPUS_SCHEMA,
+                        "event": "eval",
+                        "family": "diverse",
+                        "pods": shape,
+                        "seed": seed,
+                        "candidate": c,
+                        "host_w": w.tolist(),
+                        "host_b": 0.0,
+                        "narrow": int(solver.last_iters.narrow),
+                        "scheduled": rc.num_scheduled(),
+                    })
+                os.environ.pop(ordering.FLAG, None)
+                print(
+                    f"corpus: shape={shape} seed={seed} static={static_narrow} "
+                    f"evals={n_cand} ({time.perf_counter() - t_start:.0f}s)",
+                    file=sys.stderr, flush=True,
+                )
+    finally:
+        ordering.set_override(None)
+        for env, old in ((ordering.FLAG, old_flag), (ordering.LANES_FLAG, old_lanes)):
+            if old is None:
+                os.environ.pop(env, None)
+            else:
+                os.environ[env] = old
+    with open(path, "w") as f:
+        for row in rows:
+            f.write(json.dumps(row) + "\n")
+    print(f"corpus: wrote {len(rows)} rows to {path}", file=sys.stderr)
+    return 0
+
+
 if __name__ == "__main__":
     if "--child" in sys.argv:
         run_child()
     elif "--shard-child" in sys.argv:
         run_shard_child()
+    elif "--record-order-corpus" in sys.argv:
+        _i = sys.argv.index("--record-order-corpus")
+        sys.exit(record_order_corpus(sys.argv[_i + 1]))
     else:
         sys.exit(main())

@@ -411,13 +411,14 @@ class UnionScorer:
         )
         # per-subset variant arrays only (the base problem is shared and
         # uploaded once) — see parallel/mesh.py ScreenVariants. The subset
-        # axis pads to a quarter-pow2 bucket so a reconcile pass with a
+        # axis pads to an eighth-pow2 bucket so a reconcile pass with a
         # varying candidate count reuses compiled screens (prewarmable,
-        # solver/warmup.py prewarm_screen) instead of recompiling per B.
-        from karpenter_tpu.ops.padding import quarter_bucket
+        # solver/warmup.py prewarm_screen) instead of recompiling per B,
+        # while capping the per-lane dummy-solve waste at 12.5%.
+        from karpenter_tpu.ops.padding import screen_axis_bucket
 
         B = len(subsets)
-        pad_to = quarter_bucket(B)
+        pad_to = screen_axis_bucket(B)
         if mesh is not None:
             n_dev = mesh.devices.size
             pad_to = ((pad_to + n_dev - 1) // n_dev) * n_dev

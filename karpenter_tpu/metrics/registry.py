@@ -378,6 +378,26 @@ RESTORE_FALLBACK = REGISTRY.counter(
     "failures; every recovery is classified — 'unknown' never appears)",
 )
 
+# -- learned ordering policy series (solver/ordering.py, ops/policy.py) -------
+ORDER_POLICY_LOADS = REGISTRY.counter(
+    "solver_order_policy_loads_total",
+    "Ordering-policy weight artifact load resolutions, by outcome (loaded, "
+    "or the classified degrade to built-in zero weights: missing, truncated, "
+    "corrupt, checksum, version-skew) — a bad artifact costs nothing, not "
+    "even iterations",
+)
+ORDER_POLICY_SOLVES = REGISTRY.counter(
+    "solver_order_policy_solves_total",
+    "Learned-ordering score evaluations, by part (host = FFD tie-break over "
+    "Pod objects, lane = policy solve program dispatched with the jitted "
+    "requeue scorer)",
+)
+ORDER_POLICY_SCORE_SECONDS = REGISTRY.histogram(
+    "solver_order_policy_score_seconds",
+    "Wall time of the host-side ordering-policy score pass (feature "
+    "extraction + scorer head) per ffd_order call",
+)
+
 # -- placement explainability series (obs/explain.py) -------------------------
 UNSCHEDULABLE_PODS = REGISTRY.counter(
     "unschedulable_pods_total",

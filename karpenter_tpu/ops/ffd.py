@@ -67,10 +67,14 @@ from karpenter_tpu.ops.ffd_step import (  # noqa: F401
 from karpenter_tpu.ops.ffd_sweeps import (  # noqa: F401
     _make_stride,
     _solve_ffd_sweeps_carried_jit,
+    _solve_ffd_sweeps_carried_policy_jit,
     _solve_ffd_sweeps_fresh_jit,
+    _solve_ffd_sweeps_fresh_policy_jit,
     _sweeps_impl,
     solve_ffd_sweeps,
     solve_ffd_sweeps_carried,
+    solve_ffd_sweeps_carried_policy,
+    solve_ffd_sweeps_policy,
 )
 from karpenter_tpu.ops.ffd_runs import (  # noqa: F401
     _make_run_commit,

@@ -1631,7 +1631,7 @@ def _make_stride(
 
 def _sweeps_impl(
     problem: SchedulingProblem, init: FFDState, C: int, bounds_free: bool = False,
-    wavefront: int = 0, kinds0=None, idxs0=None,
+    wavefront: int = 0, kinds0=None, idxs0=None, order_scores=None,
 ) -> FFDResult:
     """All retry passes of a solve in ONE device program.
 
@@ -1656,6 +1656,19 @@ def _sweeps_impl(
     topology-blind identical pods; verdict replication for strict-identical
     pods); KIND_NO_SLOT stops sweeping so the backend's slot-doubling retry
     sees it at the same pass boundary it used to.
+
+    ``order_scores`` (f32[P], the learned per-pod priority from
+    ops/policy.lane_scores; KARPENTER_TPU_ORDER_POLICY) turns the requeue
+    into a learned lane picker: each sweep's failed-pod queue is re-sorted by
+    descending score before the next sweep walks it — and the wavefront's
+    extra lanes are exactly the chain heads ahead in that queue, so the sort
+    IS the lane-picking policy. The sort lives at the sweep boundary, outside
+    ``narrow_iter``: the narrow body the census pins (2394 eqns) is untouched
+    even with the policy compiled in. Correctness is order-free — a retry
+    pass already processes pods in an order the reference treats as
+    arbitrary, the sort is stable, and identical rows score identically, so
+    original-row adjacency within a pod class (the chain-commit invariant)
+    survives any weight vector.
     """
     P = problem.num_pods
     if _CHAIN_DISPATCH:
@@ -1827,6 +1840,14 @@ def _sweeps_impl(
                 _i, state, nq, nqlen, kinds, idxs, noslot, it_ct, cc_ct, cp_ct = (
                     lax.while_loop(inner_cond, inner_body, i0 + (it_ct, cc_ct, cp_ct))
                 )
+        if order_scores is not None:
+            # learned requeue (the policy entries below): next sweep walks the
+            # failed pods in descending-score order. Dead tail rows key to
+            # +inf so the live prefix stays compact; the stable argsort keeps
+            # equal-scored rows in original row order.
+            live = jnp.arange(P, dtype=jnp.int32) < nqlen
+            skey = jnp.where(live, -order_scores[jnp.clip(nq, 0, P - 1)], jnp.inf)
+            nq = jnp.take(nq, jnp.argsort(skey, stable=True).astype(jnp.int32))
         progress = nqlen < qlen
         # iters[1] counts sweeps in the low bits: encode as it_ct plus a
         # sweep counter carried in the same scalar is not worth the reshape —
@@ -1960,3 +1981,82 @@ def solve_ffd_sweeps(
     return _solve_ffd_sweeps_fresh_jit(
         problem, max_claims, problem_bounds_free(problem), wavefront
     )
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4))
+def _solve_ffd_sweeps_fresh_policy_jit(
+    problem: SchedulingProblem, max_claims: int, bounds_free: bool,
+    wavefront: int, policy_w,
+) -> FFDResult:
+    """The learned-ordering fresh solve: identical to
+    _solve_ffd_sweeps_fresh_jit plus the policy scorer traced INTO the program
+    (ops/policy.lane_scores — a few fused element-wise kernels, no host
+    round-trip) and the per-sweep requeue sort it feeds. ``policy_w`` is the
+    hashable weights tuple (solver/ordering.lane_weights_static): the floats
+    bake in as constants and a weight change is a new program. A SEPARATE jit
+    entry on purpose — the flag-off program object is never retraced, so the
+    census pin and bit-identity guarantee hold structurally."""
+    from karpenter_tpu.ops.policy import lane_scores
+
+    problem = _pad_lanes_mult32(problem)
+    return _sweeps_impl(
+        problem, initial_state(problem, max_claims), max_claims, bounds_free,
+        wavefront, order_scores=lane_scores(problem, policy_w),
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5), donate_argnums=(1,))
+def _solve_ffd_sweeps_carried_policy_jit(
+    problem: SchedulingProblem, carry, max_claims: int, bounds_free: bool,
+    wavefront: int, policy_w,
+) -> FFDResult:
+    """Learned-ordering repair pass (relaxation phase 2): the carried-state
+    twin of _solve_ffd_sweeps_carried_jit, same donation contract."""
+    from karpenter_tpu.ops.policy import lane_scores
+
+    state, kinds0, idxs0 = carry
+    problem, state = _lane_align(problem, state)
+    return _sweeps_impl(
+        problem, state, max_claims, bounds_free, wavefront, kinds0, idxs0,
+        order_scores=lane_scores(problem, policy_w),
+    )
+
+
+def solve_ffd_sweeps_policy(
+    problem: SchedulingProblem, max_claims: int, init: Optional[FFDState] = None,
+    wavefront: Optional[int] = None,
+) -> FFDResult:
+    """solve_ffd_sweeps with the learned requeue ordering compiled in
+    (KARPENTER_TPU_ORDER_POLICY; solver/ordering.py loads the weights). Same
+    signature as solve_ffd_sweeps so the backend swaps entries 1:1; a
+    distinct __name__ so program keys, the AOT table, and the registry see a
+    different program."""
+    assert init is None, "sweeps mode always runs a whole solve in one launch"
+    if wavefront is None:
+        wavefront = _wavefront_lanes()
+    from karpenter_tpu.solver import ordering
+
+    return _solve_ffd_sweeps_fresh_policy_jit(
+        problem, max_claims, problem_bounds_free(problem), wavefront,
+        ordering.lane_weights_static(),
+    )
+
+
+def solve_ffd_sweeps_carried_policy(
+    problem: SchedulingProblem, max_claims: int, init=None,
+    wavefront: Optional[int] = None,
+) -> FFDResult:
+    """solve_ffd_sweeps_carried with the learned requeue ordering compiled in
+    — the repair-pass twin of solve_ffd_sweeps_policy."""
+    assert init is not None, "the repair pass always carries phase-1 state"
+    if wavefront is None:
+        wavefront = _wavefront_lanes()
+    from karpenter_tpu.solver import ordering
+
+    return _solve_ffd_sweeps_carried_policy_jit(
+        problem, tuple(init), max_claims, problem_bounds_free(problem),
+        wavefront, ordering.lane_weights_static(),
+    )
+
+
+solve_ffd_sweeps_carried_policy._donates_carry = True

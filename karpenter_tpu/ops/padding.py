@@ -92,6 +92,25 @@ def quarter_bucket(n: int, lo: int = 8) -> int:
     return base * 2
 
 
+def screen_axis_bucket(n: int, lo: int = 8) -> int:
+    """Eighth-pow2 bucket (1.125/1.25/.../2.0 x 2^k steps above ``lo``) for
+    the consolidation screen's candidate-subset axis. Every padded subset
+    lane is a full dummy solve and the per-lane cost is flat (~1.7ms/lane on
+    CPU), so pad waste there is pure wall time — eighth steps cap it at 12.5%
+    (quarter steps allow 25%: B=100 padded to 112, not 104) for ~2x the
+    compiled-screen variants, which solver/warmup.prewarm_screen walks."""
+    if n <= lo:
+        return lo
+    base = lo
+    while base * 2 < n:
+        base *= 2
+    for mantissa in (9, 10, 11, 12, 13, 14, 15):
+        b = base * mantissa // 8
+        if b >= n:
+            return b
+    return base * 2
+
+
 def _pad(arr: np.ndarray, target_shape, fill) -> np.ndarray:
     arr = np.asarray(arr)
     pads = [(0, t - s) for s, t in zip(arr.shape, target_shape)]

@@ -1,0 +1,106 @@
+"""Learned ordering policy: the device-side pod scorer (KARPENTER_TPU_ORDER_POLICY).
+
+Round-8's wavefront post-mortem and the round-15 relaxation measurements both
+concluded that ORDERING quality — which pod class the sweep steps next, which
+chain heads the wavefront lanes pick up — is the binding constraint on narrow
+iterations, not lane width. This module is the device half of the learned
+replacement for the hand-tuned static order: a small scorer (linear head,
+optionally one tanh hidden layer) evaluated over feature columns that already
+exist in the encoded :class:`SchedulingProblem` tensors, so scoring is a few
+fused element-wise kernels traced INTO the solve program — no host round-trip,
+no extra dispatch.
+
+The two consumers:
+
+  * ``ops/ffd_sweeps._sweeps_impl`` sorts each sweep's requeue (the failed-pod
+    queue the next sweep walks, whose heads are exactly the wavefront's lanes)
+    by descending score. The sort is stable and identical rows produce
+    identical features, so original-row adjacency inside a pod class — the
+    invariant the chain commits batch over — survives any weight vector.
+  * the host-side FFD tie-break (solver/ordering.py) uses a sibling feature
+    head over the un-encoded Pod objects; weights for both heads travel in one
+    versioned artifact.
+
+Weights arrive as a HASHABLE nested tuple (solver/ordering.lane_weights_static)
+passed through jit static_argnums: the floats are baked into the program as
+constants, a weight change is a new program, and the flag-off entry points
+never see any of this — the narrow body census (2394 eqns) is untouched
+because the requeue sort lives at the sweep boundary, outside ``narrow_iter``.
+
+Safety is structural, not behavioral: a bad score vector can only permute the
+processing order, which the solver already treats as arbitrary across retry
+passes — placements stay gated by the same fit/topology kernels, so the worst
+case is extra iterations, never a wrong placement.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# Bump when the lane feature columns change meaning: weights trained against
+# one layout must not silently score another (solver/ordering.py checks the
+# artifact's feature_version against this).
+LANE_FEATURE_VERSION = 1
+N_LANE_FEATURES = 10
+
+
+def lane_features(problem) -> jnp.ndarray:
+    """f32[P, N_LANE_FEATURES] feature matrix over the encoded pod tensors.
+
+    Every column is already resident on device as part of the problem bundle:
+    request magnitudes, requirement-lane fan-out, toleration/port reach,
+    topology participation, and the chain-head bits the stride commits key on.
+    Columns are roughly unit-scaled (log1p for magnitudes, fractions for
+    fan-outs) so one weight scale serves all of them.
+    """
+    f32 = jnp.float32
+    req = jnp.asarray(problem.pod_requests, f32)  # [P, R]
+    defined = jnp.asarray(problem.pod_reqs.defined, f32)  # [P, K]
+    admitted = jnp.asarray(problem.pod_reqs.admitted, f32)  # [P, K, V]
+    lane_valid = jnp.asarray(problem.lane_valid, f32)  # [K, V]
+    n_valid = jnp.maximum(jnp.sum(lane_valid), 1.0)
+    P = req.shape[0]
+
+    def bit(x, default=0.0):
+        if x is None:
+            return jnp.full((P,), default, f32)
+        return jnp.asarray(x, f32)
+
+    cols = [
+        jnp.log1p(jnp.sum(req, axis=1)),  # 0 total request magnitude
+        jnp.log1p(jnp.max(req, axis=1)),  # 1 dominant resource magnitude
+        jnp.mean(defined, axis=1),  # 2 requirement-key fan-out
+        # 3 admitted-lane fan-out: how much of the closed vocabulary the pod's
+        # requirements still admit (1.0 = unconstrained)
+        jnp.sum(admitted * lane_valid[None, :, :], axis=(1, 2)) / n_valid,
+        jnp.max(jnp.asarray(problem.pod_ports, f32), axis=1),  # 4 reserves ports
+        jnp.mean(jnp.asarray(problem.pod_tol_tpl, f32), axis=1),  # 5 tpl tolerance reach
+        jnp.sum(jnp.asarray(problem.pod_grp_match, f32), axis=1),  # 6 topology participation
+        jnp.sum(jnp.asarray(problem.pod_grp_owned, f32), axis=1),  # 7 inverse-group ownership
+        1.0 - bit(problem.pod_eqprev_chain),  # 8 chain head
+        bit(problem.pod_eqprev_gate),  # 9 gate-identical to prev row
+    ]
+    return jnp.stack(cols, axis=1)
+
+
+def score_features(feats: jnp.ndarray, weights_static) -> jnp.ndarray:
+    """Evaluate the scorer head over a feature matrix. ``weights_static`` is
+    the hashable tuple form ``(arch, w, b, hidden)`` (hidden is
+    ``((row, ...), (bias, ...))`` for the MLP arch, None for linear); the
+    floats become program constants under jit."""
+    arch, w, b, hidden = weights_static
+    x = feats
+    if arch == "mlp" and hidden is not None:
+        h_w, h_b = hidden
+        w1 = jnp.asarray(h_w, jnp.float32)  # [H, F]
+        b1 = jnp.asarray(h_b, jnp.float32)  # [H]
+        x = jnp.tanh(x @ w1.T + b1)
+    wv = jnp.asarray(w, jnp.float32)
+    return x @ wv + jnp.float32(b)
+
+
+def lane_scores(problem, weights_static) -> jnp.ndarray:
+    """f32[P] learned priority per pod row — higher steps earlier. The single
+    entry the policy solve programs trace (tools/kernel_census.py pins its
+    equation count)."""
+    return score_features(lane_features(problem), weights_static)

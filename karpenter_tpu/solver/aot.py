@@ -149,6 +149,43 @@ def _call_spec(solve_name: str, problem, max_claims: int, init) -> Optional[_Spe
             (problem, carry),
             (f"C{int(max_claims)}", f"bf{int(bf)}", f"wf{int(wf)}", "carried"),
         )
+    if solve_name == "solve_ffd_sweeps_policy":
+        from karpenter_tpu.ops.ffd_sweeps import (
+            _solve_ffd_sweeps_fresh_policy_jit,
+            _wavefront_lanes,
+        )
+        from karpenter_tpu.solver import ordering
+
+        bf = problem_bounds_free(problem)
+        wf = _wavefront_lanes()
+        pw = ordering.lane_weights_static()
+        return _Spec(
+            _solve_ffd_sweeps_fresh_policy_jit,
+            (problem, int(max_claims), bf, wf, pw),
+            (problem,),
+            # the weights digest keys the table: the floats are baked into the
+            # executable, so two artifacts must never share a snapshot entry
+            (f"C{int(max_claims)}", f"bf{int(bf)}", f"wf{int(wf)}",
+             f"pol{ordering.weights_digest()}"),
+        )
+    if solve_name == "solve_ffd_sweeps_carried_policy":
+        from karpenter_tpu.ops.ffd_sweeps import (
+            _solve_ffd_sweeps_carried_policy_jit,
+            _wavefront_lanes,
+        )
+        from karpenter_tpu.solver import ordering
+
+        bf = problem_bounds_free(problem)
+        wf = _wavefront_lanes()
+        pw = ordering.lane_weights_static()
+        carry = tuple(init)
+        return _Spec(
+            _solve_ffd_sweeps_carried_policy_jit,
+            (problem, carry, int(max_claims), bf, wf, pw),
+            (problem, carry),
+            (f"C{int(max_claims)}", f"bf{int(bf)}", f"wf{int(wf)}",
+             f"pol{ordering.weights_digest()}", "carried"),
+        )
     if solve_name == "shard_sweeps":
         # the mesh-partitioned stacked-sweeps program (shard/solve.py): the
         # jitted fn is reconstructed from the SAME statics the factory cache

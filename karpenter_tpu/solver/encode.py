@@ -146,22 +146,41 @@ def ffd_order(pods: Sequence[Pod], requests_of=None) -> List[int]:
     equal-signature pods lets the device solver commit whole runs per scan
     step. Shared by every backend — parity depends on a single definition.
     ``requests_of`` lets callers share a memoized pod_requests (the encoder
-    computes requests for several tensors; pods are immutable within a call)."""
+    computes requests for several tensors; pods are immutable within a call).
+
+    KARPENTER_TPU_ORDER_POLICY inserts a learned score (solver/ordering.py)
+    BETWEEN the resource keys and the signature: the policy reorders pod
+    CLASSES within a resource tier — the seam the round-6 signature A/B
+    identified as the lever on the claim landscape — while FFD's
+    resource-descending property and the identical-pod adjacency the chain
+    commits need both survive (identical pods get identical features, and
+    the signature still groups them below the score). Because every backend
+    shares this one definition, the flag moves the device solver, the host
+    oracle, and the streaming delta/warm re-solves in lockstep. Flag off,
+    the keys below are built exactly as before — bit-identical ordering."""
     if requests_of is None:
         requests_of = res.pod_requests
+    from karpenter_tpu.solver import ordering
+
+    scores = ordering.order_scores(pods, requests_of) if ordering.enabled() else None
     keys = []
     for i, p in enumerate(pods):
         requests = requests_of(p)
-        keys.append(
+        key = [
+            -requests.get(res.CPU, 0.0),
+            -requests.get(res.MEMORY, 0.0),
+        ]
+        if scores is not None:
+            key.append(-float(scores[i]))
+        key.extend(
             (
-                -requests.get(res.CPU, 0.0),
-                -requests.get(res.MEMORY, 0.0),
                 constraint_signature(p),
                 p.metadata.creation_timestamp or 0.0,
                 p.metadata.creation_seq,
                 i,
             )
         )
+        keys.append(tuple(key))
     return sorted(range(len(pods)), key=lambda i: keys[i])
 
 

@@ -37,6 +37,7 @@ from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.apis.objects import Pod
 from karpenter_tpu.metrics.registry import (
     COMPILE_CACHE,
+    ORDER_POLICY_SOLVES,
     RELAX_FALLBACK,
     TRANSFER_BYTES,
 )
@@ -70,7 +71,10 @@ from karpenter_tpu.ops.ffd import (
     solve_ffd_runs,
     solve_ffd_sweeps,
     solve_ffd_sweeps_carried,
+    solve_ffd_sweeps_carried_policy,
+    solve_ffd_sweeps_policy,
 )
+from karpenter_tpu.solver import ordering
 
 # The per-pod scan is the production default. Measured on the reference's
 # diverse bench mix AFTER the claim-slot-growth fix (both paths correct,
@@ -632,7 +636,15 @@ class JaxSolver(SolverBackend):
             if _USE_RUNS:
                 solve = solve_ffd_runs
             elif use_sweeps:
-                solve = solve_ffd_sweeps
+                # learned ordering (KARPENTER_TPU_ORDER_POLICY): the policy
+                # entries are signature-identical twins with the scorer and
+                # requeue sort compiled in; distinct __name__ keeps program
+                # keys, AOT table entries, and registry rows separate
+                if ordering.lanes_enabled():
+                    solve = solve_ffd_sweeps_policy
+                    ORDER_POLICY_SOLVES.inc({"part": "lane"})
+                else:
+                    solve = solve_ffd_sweeps
             else:
                 solve = solve_ffd
             if (
@@ -651,7 +663,11 @@ class JaxSolver(SolverBackend):
                 if rout is not None:
                     import dataclasses
 
-                    solve = solve_ffd_sweeps_carried
+                    if ordering.lanes_enabled():
+                        solve = solve_ffd_sweeps_carried_policy
+                        ORDER_POLICY_SOLVES.inc({"part": "lane"})
+                    else:
+                        solve = solve_ffd_sweeps_carried
                     state = (rout.state, rout.kind, rout.index)
                     problem = dataclasses.replace(
                         problem, pod_active=rout.residue_active

@@ -1,0 +1,258 @@
+"""Learned ordering policy: flags, weights artifact, host-side FFD tie-break.
+
+This is the host half of KARPENTER_TPU_ORDER_POLICY (the device half — the
+jitted lane scorer the sweep requeue sorts by — is ops/policy.py). It owns:
+
+  * the flag reads. ``enabled()`` turns on the learned tie-break inside
+    ``solver/encode.ffd_order`` — the ONE ordering definition every backend
+    shares (device solver, host oracle, streaming delta/warm re-solves), so
+    flipping the flag keeps all of them in lockstep and the oracle
+    differential stays an equality test. ``lanes_enabled()`` additionally
+    routes the backend to the policy solve entries
+    (ops/ffd_sweeps.solve_ffd_sweeps_policy), whose per-sweep requeue sort is
+    the learned wavefront lane picker. ``KARPENTER_TPU_ORDER_POLICY_LANES=0``
+    isolates the host tie-break for A/Bs and for the corpus recorder, which
+    must evaluate many candidate weight vectors without recompiling the solve
+    program per candidate (the host order is data, not program).
+  * the weights artifact: one versioned ``utils/persist.py``-framed file
+    carrying both heads — ``host`` (features from un-encoded Pod objects,
+    scored before the FFD sort) and ``lane`` (features from the encoded
+    problem tensors, baked into the policy programs as jit-static constants).
+    Load failures are CLASSIFIED (the persist reasons) and degrade to the
+    built-in zero weights — score ties everywhere, which the stable sort
+    resolves to exactly the static order, so a corrupt artifact costs
+    nothing, not even iterations. ``solver_order_policy_loads_total{outcome}``
+    records every resolution.
+  * the score evaluation for the tie-break: batched numpy over the pod list,
+    one matmul — ``solver_order_policy_score_seconds`` keeps its cost honest.
+
+The committed artifact (``order_policy.v1.bin``) is produced by
+``tools/train_order.py`` from corpora recorded with
+``bench.py --record-order-corpus``; both are seeded and replay-deterministic,
+so retraining from the committed corpus reproduces the committed bytes.
+
+Flag off, every public function here short-circuits on one env read and
+``ffd_order`` builds the exact pre-policy sort keys — bit-identical ordering,
+untouched solve programs (census-pinned).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from karpenter_tpu.metrics.registry import (
+    ORDER_POLICY_LOADS,
+    ORDER_POLICY_SCORE_SECONDS,
+    ORDER_POLICY_SOLVES,
+)
+
+FLAG = "KARPENTER_TPU_ORDER_POLICY"
+LANES_FLAG = "KARPENTER_TPU_ORDER_POLICY_LANES"
+WEIGHTS_ENV = "KARPENTER_TPU_ORDER_POLICY_WEIGHTS"
+
+WEIGHTS_KIND = "order-policy"
+WEIGHTS_VERSION = 1
+HOST_FEATURE_VERSION = 1
+N_HOST_FEATURES = 10
+
+_DEFAULT_ARTIFACT = os.path.join(os.path.dirname(__file__), "order_policy.v1.bin")
+
+
+def enabled() -> bool:
+    """Learned tie-break active (read per call, like the wavefront flag, so
+    tests and the corpus recorder can toggle without reimports)."""
+    return os.environ.get(FLAG, "") not in ("", "0")
+
+
+def lanes_enabled() -> bool:
+    """Device half active: the backend dispatches the policy solve entries
+    whose requeue sort replaces the wavefront lane picker."""
+    return enabled() and os.environ.get(LANES_FLAG, "1") != "0"
+
+
+def builtin_weights() -> Dict:
+    """Zero weights: every score ties, the stable sort preserves the static
+    order exactly. The classified-fallback target — flag-on with a missing or
+    corrupt artifact must cost nothing."""
+    return {
+        "arch": "linear",
+        "feature_version": HOST_FEATURE_VERSION,
+        "lane_feature_version": 1,
+        "host": {"w": [0.0] * N_HOST_FEATURES, "b": 0.0, "hidden": None},
+        "lane": {"w": [0.0] * 10, "b": 0.0, "hidden": None},
+    }
+
+
+_lock = threading.Lock()
+_cache: Optional[Dict] = None
+_cache_path: Optional[str] = None
+_override: Optional[Dict] = None
+
+
+def artifact_path() -> str:
+    return os.environ.get(WEIGHTS_ENV) or _DEFAULT_ARTIFACT
+
+
+def set_override(weights: Optional[Dict]) -> None:
+    """Install an in-process weight dict (corpus recorder / trainer candidate
+    evaluation). None restores artifact loading."""
+    global _override
+    with _lock:
+        _override = weights
+
+
+def reset_for_tests() -> None:
+    global _cache, _cache_path, _override
+    with _lock:
+        _cache = None
+        _cache_path = None
+        _override = None
+
+
+def _load_artifact(path: str) -> Dict:
+    import json
+
+    from karpenter_tpu.ops.policy import LANE_FEATURE_VERSION
+    from karpenter_tpu.utils.persist import PersistError, load_framed
+
+    try:
+        _header, payload = load_framed(
+            path, kind=WEIGHTS_KIND, min_version=WEIGHTS_VERSION
+        )
+        weights = json.loads(payload.decode())
+    except PersistError as exc:
+        ORDER_POLICY_LOADS.inc({"outcome": exc.reason})
+        return builtin_weights()
+    except Exception:  # noqa: BLE001 — malformed payload is corruption too
+        ORDER_POLICY_LOADS.inc({"outcome": "corrupt"})
+        return builtin_weights()
+    if (
+        weights.get("feature_version") != HOST_FEATURE_VERSION
+        or weights.get("lane_feature_version") != LANE_FEATURE_VERSION
+    ):
+        # weights trained against a different feature layout must not score
+        # this one — same classified degrade as a frame version skew
+        ORDER_POLICY_LOADS.inc({"outcome": "version-skew"})
+        return builtin_weights()
+    ORDER_POLICY_LOADS.inc({"outcome": "loaded"})
+    return weights
+
+
+def active_weights() -> Dict:
+    """The weight dict in force: override > artifact (cached per path) >
+    built-in zeros. Never raises."""
+    global _cache, _cache_path
+    with _lock:
+        if _override is not None:
+            return _override
+        path = artifact_path()
+        if _cache is not None and _cache_path == path:
+            return _cache
+    loaded = _load_artifact(path)
+    with _lock:
+        _cache = loaded
+        _cache_path = path
+        return _cache
+
+
+def _head_static(head: Dict):
+    hidden = head.get("hidden")
+    hidden_t = None
+    if hidden:
+        hidden_t = (
+            tuple(tuple(float(x) for x in row) for row in hidden["w"]),
+            tuple(float(x) for x in hidden["b"]),
+        )
+    arch = "mlp" if hidden_t is not None else "linear"
+    return (arch, tuple(float(x) for x in head["w"]), float(head["b"]), hidden_t)
+
+
+def lane_weights_static():
+    """The lane head as a hashable nested tuple — the jit-static argument of
+    the policy solve entries (ops/ffd_sweeps.py). Equal weights hash equal, so
+    program caching and the AOT table key off content, not load events."""
+    return _head_static(active_weights()["lane"])
+
+
+def weights_digest(weights: Optional[Dict] = None) -> str:
+    """Short content digest of the active weights — AOT table entries and the
+    program registry use it so two processes with different artifacts never
+    share an executable."""
+    w = weights if weights is not None else active_weights()
+    blob = repr((_head_static(w["host"]), _head_static(w["lane"]))).encode()
+    return hashlib.sha256(blob).hexdigest()[:12]
+
+
+# -- host-side feature head (un-encoded Pod objects) ---------------------------
+
+
+def host_features(pods: Sequence, requests_of=None, signatures=None) -> np.ndarray:
+    """f32[n, N_HOST_FEATURES] over API Pod objects — the pre-encode sibling
+    of ops/policy.lane_features. Identical pods produce identical rows (the
+    adjacency guarantee the chain commits need survives any weights).
+    ``signatures`` shares encode.constraint_signature results when the caller
+    already computed them."""
+    from karpenter_tpu.utils import resources as res
+    from karpenter_tpu.solver.encode import constraint_signature
+
+    if requests_of is None:
+        requests_of = res.pod_requests
+    n = len(pods)
+    if signatures is None:
+        signatures = [constraint_signature(p) for p in pods]
+    sig_count: Dict[str, int] = {}
+    for s in signatures:
+        sig_count[s] = sig_count.get(s, 0) + 1
+    feats = np.zeros((n, N_HOST_FEATURES), np.float32)
+    for i, p in enumerate(pods):
+        requests = requests_of(p)
+        spec = p.spec
+        aff = spec.affinity
+        node_terms = len(aff.node_affinity.required) if aff and aff.node_affinity else 0
+        pod_aff = len(aff.pod_affinity.required) if aff and aff.pod_affinity else 0
+        pod_anti = (
+            len(aff.pod_anti_affinity.required) if aff and aff.pod_anti_affinity else 0
+        )
+        has_ports = any(c.ports for c in spec.containers)
+        extra = sum(1 for k in requests if k not in (res.CPU, res.MEMORY))
+        feats[i] = (
+            np.log1p(requests.get(res.CPU, 0.0)),
+            np.log1p(requests.get(res.MEMORY, 0.0) / 2.0**20),
+            float(extra),
+            float(len(spec.node_selector) + node_terms),
+            float(len(spec.tolerations)),
+            float(has_ports),
+            float(len(spec.topology_spread_constraints)),
+            float(pod_aff),
+            float(pod_anti),
+            sig_count[signatures[i]] / max(n, 1),
+        )
+    return feats
+
+
+def _eval_head(feats: np.ndarray, head_static) -> np.ndarray:
+    arch, w, b, hidden = head_static
+    x = feats
+    if arch == "mlp" and hidden is not None:
+        w1 = np.asarray(hidden[0], np.float32)
+        b1 = np.asarray(hidden[1], np.float32)
+        x = np.tanh(x @ w1.T + b1)
+    return (x @ np.asarray(w, np.float32) + np.float32(b)).astype(np.float32)
+
+
+def order_scores(pods: Sequence, requests_of=None, signatures=None) -> np.ndarray:
+    """f32[n] learned priority per pod (higher sorts earlier within its
+    resource tier). The ffd_order hook — one batched feature pass + one
+    matmul, timed by solver_order_policy_score_seconds."""
+    t0 = time.perf_counter()
+    feats = host_features(pods, requests_of, signatures)
+    scores = _eval_head(feats, _head_static(active_weights()["host"]))
+    ORDER_POLICY_SCORE_SECONDS.observe(time.perf_counter() - t0)
+    ORDER_POLICY_SOLVES.inc({"part": "host"})
+    return scores

@@ -28,6 +28,12 @@ sweeps program instead, so a mismatched server recompiles on first contact
 either way. With ``KARPENTER_TPU_DEVICE_GATE`` on (the default), each warm
 solve additionally drives the device verification gate (verify/), so the
 gate program compiles and AOT-serializes at the same buckets too.
+``KARPENTER_TPU_ORDER_POLICY`` joins the same contract: with it on, every
+warm routes through the policy solve entries (solve_ffd_sweeps_policy and
+the carried repair twin), whose baked-in scorer weights are part of the
+program — so the warming process must also see the SAME weights artifact
+(solver/ordering.py) as the server, or the warmed executables are keyed to
+the wrong weight digest and the server recompiles.
 """
 
 from __future__ import annotations
@@ -234,20 +240,20 @@ def _warm_gate(result, pods, its, tpls) -> None:
 
 
 def prewarm_screen(n_candidates: int) -> bool:
-    """Compile the consolidation screen program for the quarter-pow2
+    """Compile the consolidation screen program for the eighth-pow2
     candidate buckets up to ``n_candidates`` (disruption/batch.py pads the
-    subset axis with ops/padding.quarter_bucket, so these are the executables
-    a reconcile pass will request). Synthetic-shape caveat as in
+    subset axis with ops/padding.screen_axis_bucket, so these are the
+    executables a reconcile pass will request). Synthetic-shape caveat as in
     prewarm_solver."""
     from karpenter_tpu.disruption.batch import bench_candidate_scoring
     from karpenter_tpu.obs import trace
-    from karpenter_tpu.ops.padding import quarter_bucket
+    from karpenter_tpu.ops.padding import screen_axis_bucket
 
     try:
         with trace.cycle("warmup", kind="screen", candidates=n_candidates):
             n = 8
             while n <= n_candidates:
-                b = quarter_bucket(n)
+                b = screen_axis_bucket(n)
                 # mesh="auto" matches production score_subsets: on multi-device
                 # hosts the sharded program (and its device-rounded B) is the
                 # executable a reconcile pass will actually request

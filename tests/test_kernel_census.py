@@ -21,6 +21,7 @@ from tools.kernel_census import (
     build_census_problem,
     gate_jaxpr_eqns,
     narrow_jaxpr_eqns,
+    policy_scorer_jaxpr_eqns,
     relax_jaxpr_eqns,
     shard_jaxpr_eqns,
 )
@@ -53,6 +54,14 @@ RELAX_EQN_BUDGET = 1450
 # invariants over a decoded result — ~0.14x of ONE narrow iteration, which
 # is why re-verifying every accept on device is affordable at all
 GATE_EQN_BUDGET = 400
+
+# round-19 learned-ordering scorer (KARPENTER_TPU_ORDER_POLICY): measured 40
+# at the round-19 commit. This is the WHOLE feature-extraction + head pass
+# the policy solve entries trace in, once per solve — ~0.017x of ONE narrow
+# iteration, which is why scoring inline is free next to the iterations it
+# saves. The per-sweep requeue argsort adds a handful more at the sweep
+# boundary, never inside the narrow body
+POLICY_SCORER_EQN_BUDGET = 50
 
 # round-18 mesh-partitioned solve program (KARPENTER_TPU_SHARD): measured
 # 3702 at the round-18 commit. This is the WHOLE per-device body the
@@ -300,6 +309,50 @@ class TestGateBudget:
                 os.environ.pop("KARPENTER_TPU_DEVICE_GATE", None)
             else:
                 os.environ["KARPENTER_TPU_DEVICE_GATE"] = old
+
+
+class TestOrderPolicyBudget:
+    """Round-19 learned ordering: the scorer gets its own pinned budget, and
+    the flag must not touch the narrow body — the policy entries
+    (ops/ffd_sweeps.solve_ffd_sweeps_policy) are SEPARATE jit programs whose
+    requeue sort lives at the sweep boundary, outside narrow_iter, so even
+    the policy-on program carries the exact flag-off narrow body."""
+
+    def test_policy_scorer_under_budget(self, census_problem):
+        eqns = policy_scorer_jaxpr_eqns(census_problem)
+        assert eqns <= POLICY_SCORER_EQN_BUDGET, (
+            f"ordering-policy scorer grew to {eqns} jaxpr eqns "
+            f"(budget {POLICY_SCORER_EQN_BUDGET}); the scorer runs once per "
+            f"solve and must stay a rounding error next to one narrow "
+            f"iteration — see tools/kernel_census.py policy_scorer_jaxpr_eqns"
+        )
+
+    def test_policy_scorer_budget_is_tight(self, census_problem):
+        eqns = policy_scorer_jaxpr_eqns(census_problem)
+        assert eqns >= POLICY_SCORER_EQN_BUDGET * 0.8, (
+            f"ordering-policy scorer shrank to {eqns} jaxpr eqns — nice! "
+            f"tighten POLICY_SCORER_EQN_BUDGET to keep the guard meaningful"
+        )
+
+    def test_policy_flag_on_narrow_body_unchanged(self, census_problem):
+        """With KARPENTER_TPU_ORDER_POLICY forced on (module imported, scorer
+        weights resolved), the narrow body must still count EXACTLY 2394
+        equations — including when traced through the policy-on census path,
+        because the learned requeue reorders the queue BETWEEN sweeps and
+        never edits the solve body. This is the structural half of the
+        bit-identity guarantee: the flag-off program object is a different
+        jit entry the policy code never touches."""
+        from karpenter_tpu.solver import ordering  # noqa: F401 — import inert
+
+        old = os.environ.get(ordering.FLAG)
+        os.environ[ordering.FLAG] = "1"
+        try:
+            assert narrow_jaxpr_eqns(census_problem, wavefront=0) == 2394
+        finally:
+            if old is None:
+                os.environ.pop(ordering.FLAG, None)
+            else:
+                os.environ[ordering.FLAG] = old
 
 
 class TestShardBudget:

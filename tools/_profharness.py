@@ -124,6 +124,28 @@ def bench_problem(pods_n: int = 10000, num_its: int = 400,
     return pad_problem(encoded.problem), pods, its, tpl
 
 
+def corpus_problem(index: int = 0, path: str | None = None,
+                   num_claim_slots: int = 128):
+    """One recorded corpus instance encoded to a padded device problem, for
+    kernel-level profilers that bypass JaxSolver. Returns
+    (problem, instance_row, pods, its, tpl)."""
+    from karpenter_tpu.apis import labels as wk
+    from karpenter_tpu.ops.padding import pad_problem
+    from karpenter_tpu.provisioning.topology import Topology
+    from karpenter_tpu.solver.encode import Encoder, domains_from_instance_types
+
+    for i, (inst, pods, its, tpl) in enumerate(corpus_instances(path)):
+        if i == index:
+            domains = domains_from_instance_types(its, [tpl])
+            topo = Topology(domains, batch_pods=pods, cluster_pods=[])
+            encoded = Encoder(wk.WELL_KNOWN_LABELS).encode(
+                pods, its, [tpl], [], topology=topo,
+                num_claim_slots=num_claim_slots,
+            )
+            return pad_problem(encoded.problem), inst, pods, its, tpl
+    raise IndexError(f"corpus has no instance {index}")
+
+
 def kernel_trace(fn, trace_dir: str):
     """Run ``fn`` under a jax.profiler trace and parse the perfetto gz into
     per-op-name (seconds, count, sample-args) maps."""
@@ -152,6 +174,73 @@ def kernel_trace(fn, trace_dir: str):
             counts[name] += 1
             samples[name] = ev.get("args", {})
     return buckets, counts, samples
+
+
+# -- recorded ordering corpora (bench.py --record-order-corpus) ----------------
+
+ORDER_CORPUS_SCHEMA = 1
+DEFAULT_ORDER_CORPUS = os.path.join(
+    REPO_ROOT, "tools", "corpora", "order_corpus.v1.jsonl"
+)
+
+
+def load_order_corpus(path: str | None = None):
+    """Schema-checked loader for the ordering-policy corpus JSONL
+    (``bench.py --record-order-corpus``). Returns the instance rows in file
+    order, each with its candidate ``eval`` rows attached under ``"evals"``.
+    Raises ValueError on schema skew — profilers must not silently replay a
+    corpus they misread."""
+    path = path or os.environ.get("KARPENTER_TPU_PROF_CORPUS") or DEFAULT_ORDER_CORPUS
+    instances, by_key = [], {}
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            if row.get("schema") != ORDER_CORPUS_SCHEMA:
+                raise ValueError(
+                    f"{path}:{lineno}: corpus schema {row.get('schema')!r}, "
+                    f"loader speaks {ORDER_CORPUS_SCHEMA}"
+                )
+            key = (row.get("family"), row.get("pods"), row.get("seed"))
+            if row.get("event") == "instance":
+                row = dict(row, evals=[])
+                instances.append(row)
+                by_key[key] = row
+            elif row.get("event") == "eval":
+                by_key[key]["evals"].append(row)
+            else:
+                raise ValueError(
+                    f"{path}:{lineno}: unknown event {row.get('event')!r}"
+                )
+    if not instances:
+        raise ValueError(f"{path}: no instance rows")
+    return instances
+
+
+def corpus_instances(path: str | None = None, num_its: int = 400):
+    """Replay generator: yields ``(instance_row, pods, its, tpl)`` for each
+    recorded instance, rebuilding the exact pod population from the recorded
+    (family, pods, seed) — the recorder is seeded, so the rebuild reproduces
+    the pods the recorded narrow counts were measured on."""
+    import random
+
+    from bench import make_diverse_pods
+    from karpenter_tpu.apis.nodepool import NodePool
+    from karpenter_tpu.apis.objects import ObjectMeta
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.solver.encode import template_from_nodepool
+
+    its = instance_types(num_its)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="default")), its, range(len(its))
+    )
+    for inst in load_order_corpus(path):
+        if inst["family"] != "diverse":
+            raise ValueError(f"unknown corpus family {inst['family']!r}")
+        pods = make_diverse_pods(inst["pods"], random.Random(inst["seed"]))
+        yield inst, pods, its, tpl
 
 
 # -- program registry bridge ---------------------------------------------------

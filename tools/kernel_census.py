@@ -201,6 +201,30 @@ def relax_jaxpr_eqns(problem=None, C: int = 16, passes: int = 2) -> int:
     return _count_jaxpr_eqns(jaxpr)
 
 
+def policy_scorer_jaxpr_eqns(problem=None, C: int = 16) -> int:
+    """Flattened jaxpr equation count of the learned-ordering scorer
+    (ops/policy.lane_scores, KARPENTER_TPU_ORDER_POLICY) — the feature
+    extraction + head evaluation the policy solve entries trace INTO the
+    sweeps program. One-shot per solve (not per iteration), so the meaningful
+    comparison is against a single narrow step, and the per-sweep requeue
+    argsort it feeds adds a handful more. Pinned by
+    tests/test_kernel_census.py, which also proves the policy flag leaves the
+    narrow body itself at exactly its flag-off count: the policy reorders the
+    queue at the sweep boundary, it never edits the solve body."""
+    import jax
+
+    from karpenter_tpu.ops.ffd_core import _pad_lanes_mult32
+    from karpenter_tpu.ops import policy
+    from karpenter_tpu.solver import ordering
+
+    if problem is None:
+        problem = build_census_problem(claim_slots=C)
+    padded = _pad_lanes_mult32(jax.device_put(problem))
+    w = ordering.lane_weights_static()
+    jaxpr = jax.make_jaxpr(lambda p: policy.lane_scores(p, w))(padded)
+    return _count_jaxpr_eqns(jaxpr)
+
+
 def gate_jaxpr_eqns(problem=None, C: int = 16) -> int:
     """Flattened jaxpr equation count of the device verification gate
     program (verify/device.py, KARPENTER_TPU_DEVICE_GATE). Like the relax
@@ -312,6 +336,9 @@ def main(argv):
     gate_eqns = gate_jaxpr_eqns(problem, C)
     print(f"  jaxpr_eqns_gate      = {gate_eqns}  (whole verification gate "
           f"program)")
+    policy_eqns = policy_scorer_jaxpr_eqns(problem, C)
+    print(f"  jaxpr_eqns_policy    = {policy_eqns}  (learned-ordering scorer, "
+          f"once per solve)")
     try:
         shard_eqns = shard_jaxpr_eqns(problem, C)
         print(f"  jaxpr_eqns_shard     = {shard_eqns}  (whole mesh-partitioned "

@@ -102,6 +102,14 @@ DEFAULT_BANDS = {
     "solve_100k_s": (LOWER_BETTER, 4.0),
     "shard_pad_frac": (LOWER_BETTER, 3.0),
     "shard_speedup_vs_control": (HIGHER_BETTER, 3.0),
+    # round-19 learned ordering: device narrow-iteration count at the 10k
+    # bench shape. An ITERATION count, not a wall — near-deterministic for a
+    # fixed corpus and order, so the band is the tightest here: drift means
+    # the ordering (or the chain/wavefront structure it feeds) changed, not
+    # that the host was noisy. The first row carrying the column seeds the
+    # window; policy-on and policy-off runs both emit it and gate against
+    # their own trajectory.
+    "narrow_iterations_10k": (LOWER_BETTER, 1.5),
 }
 
 # absolute ceiling for the --smoke tiny-shape solve (steady-state, post
@@ -155,6 +163,9 @@ def row_from_bench(out: dict, label: str = "run") -> dict:
         # schema v2, round 18: mesh-sharded partitioned solve columns —
         # present only when the bench shard shape family ran and the
         # partitioned path actually served (standdowns omit the columns)
+        # schema v2, round 19: learned-ordering iteration floor — the summed
+        # narrow iterations of the 10k diverse solve (per_shape aggregation)
+        "narrow_iterations_10k": out.get("narrow_iterations_10k"),
         "solve_100k_s": out.get("solve_100k_s"),
         "shard_partitions": out.get("shard_partitions"),
         "shard_pad_frac": out.get("shard_pad_frac"),

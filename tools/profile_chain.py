@@ -46,7 +46,23 @@ import numpy as np
 
 from karpenter_tpu.ops.ffd import solve_ffd_sweeps
 
-problem, _, _, _ = H.bench_problem()
+# KARPENTER_TPU_PROF_CORPUS replays a recorded ordering-corpus instance
+# (=1 for the committed default, =path otherwise; _INDEX picks which) so the
+# chain-flag grid can be re-measured on the exact population a training
+# corpus was recorded against, not just the 10k bench mix.
+if os.environ.get("KARPENTER_TPU_PROF_CORPUS"):
+    _corpus = os.environ["KARPENTER_TPU_PROF_CORPUS"]
+    problem, _inst, _, _, _ = H.corpus_problem(
+        index=int(os.environ.get("KARPENTER_TPU_PROF_CORPUS_INDEX", "0")),
+        path=None if _corpus == "1" else _corpus,
+    )
+    print(
+        f"corpus instance: pods={_inst['pods']} seed={_inst['seed']} "
+        f"recorded static narrow={_inst['static_narrow']}",
+        flush=True,
+    )
+else:
+    problem, _, _, _ = H.bench_problem()
 
 t0 = time.perf_counter()
 r = solve_ffd_sweeps(problem, 128)

@@ -3,6 +3,12 @@
 Usage: KARPENTER_TPU_TIMING=1 python tools/profile_solve.py [pods ...]
 Runs each shape twice (warm compile, then steady) against the bench workload
 (400 fake instance types, makeDiversePods mix) and prints the pass structure.
+
+Set KARPENTER_TPU_PROF_CORPUS=path (or =1 for the committed default corpus)
+to replay a recorded ordering corpus instead: each recorded instance's exact
+seeded pod population is rebuilt and solved, and the realized narrow
+iterations are printed next to the recorded static baseline — drift between
+them means the solver changed since the corpus was recorded.
 """
 
 import os
@@ -21,6 +27,28 @@ from karpenter_tpu.apis.objects import ObjectMeta
 from karpenter_tpu.cloudprovider.fake import instance_types
 from karpenter_tpu.solver.encode import template_from_nodepool
 from karpenter_tpu.solver.jax_backend import JaxSolver
+
+if os.environ.get("KARPENTER_TPU_PROF_CORPUS"):
+    corpus = os.environ["KARPENTER_TPU_PROF_CORPUS"]
+    solver = JaxSolver()
+    for inst, pods, its, tpl in H.corpus_instances(
+        None if corpus == "1" else corpus
+    ):
+        solver.solve(pods, its, [tpl])  # warm the shape bucket
+        t0 = time.perf_counter()
+        r = solver.solve(pods, its, [tpl])
+        steady = time.perf_counter() - t0
+        narrow = int(solver.last_iters.narrow) if solver.last_iters else -1
+        drift = "" if narrow == inst["static_narrow"] else (
+            f" DRIFT(recorded {inst['static_narrow']})"
+        )
+        print(
+            f"=== corpus pods={inst['pods']} seed={inst['seed']} "
+            f"steady={steady:.3f}s narrow={narrow}{drift} "
+            f"scheduled={r.num_scheduled()}/{inst['static_scheduled']}",
+            file=sys.stderr,
+        )
+    sys.exit(0)
 
 shapes = [int(a) for a in sys.argv[1:]] or [10, 100, 10000]
 rng = random.Random(42)

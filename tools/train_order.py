@@ -1,0 +1,216 @@
+"""Train the learned ordering policy from a recorded corpus.
+
+    python tools/train_order.py tools/corpora/order_corpus.v1.jsonl \
+        --out karpenter_tpu/solver/order_policy.v1.bin
+
+Input is the schema'd JSONL that ``bench.py --record-order-corpus`` writes:
+``instance`` rows (static-order baseline narrow iterations + per-pod host and
+lane feature matrices + the encode row->pod map) and ``eval`` rows (realized
+narrow iterations for each candidate host weight vector, every candidate
+evaluated on every instance).
+
+Training is SELECTION, not gradient descent, and every step is deterministic
+from the corpus bytes plus ``--seed``:
+
+  * host head — elite selection. Each candidate's fitness is its mean
+    narrow-iteration ratio vs the static order across instances; candidates
+    that lose ANY scheduled pod on ANY instance are disqualified outright
+    (the policy must never trade placements for iterations). The elite is the
+    argmin with ties broken by candidate index. If no candidate beats static
+    (ratio < 1.0), the host head is the zero vector — score ties everywhere
+    and the stable sort reproduces the static order exactly, so the shipped
+    artifact is never worse than no artifact.
+  * lane head — deterministic ridge regression distilling the host scores
+    onto the encoded lane features, rows aligned through each instance's
+    ``pod_order`` (problem row -> input pod). The device requeue then ranks
+    lanes the way the host tie-break ranks pods, without a host round-trip.
+    ``--arch mlp`` inserts a fixed seeded random tanh hidden layer (random
+    features, NOT backprop) and ridge-fits the output weights on top.
+
+The payload is canonical JSON (sorted keys, no whitespace) framed by
+``utils/persist.write_framed`` — the frame header carries a timestamp, so
+byte-level determinism is defined over the PAYLOAD, which
+``tests/test_order_policy.py`` round-trips: same corpus + same seed =>
+identical payload bytes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from karpenter_tpu.solver import ordering  # noqa: E402
+from karpenter_tpu.utils.persist import write_framed  # noqa: E402
+
+CORPUS_SCHEMA = 1
+
+
+def load_corpus(path: str):
+    """Parse the recorder's JSONL into (instances, evals); every row is
+    schema-checked. Raises ValueError on skew — a trainer must never fit
+    against rows it does not understand."""
+    instances, evals = [], []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            row = json.loads(line)
+            if row.get("schema") != CORPUS_SCHEMA:
+                raise ValueError(
+                    f"{path}:{lineno}: corpus schema {row.get('schema')!r}, "
+                    f"trainer speaks {CORPUS_SCHEMA}"
+                )
+            if row.get("event") == "instance":
+                instances.append(row)
+            elif row.get("event") == "eval":
+                evals.append(row)
+            else:
+                raise ValueError(f"{path}:{lineno}: unknown event {row.get('event')!r}")
+    if not instances or not evals:
+        raise ValueError(f"{path}: needs at least one instance and one eval row")
+    versions = {
+        (r["host_feature_version"], r["lane_feature_version"]) for r in instances
+    }
+    if len(versions) != 1:
+        raise ValueError(f"{path}: mixed feature versions {sorted(versions)}")
+    return instances, evals
+
+
+def _instance_key(row):
+    return (row["family"], row["pods"], row["seed"])
+
+
+def select_host_head(instances, evals):
+    """Elite selection over the shared candidate set. Returns
+    (w, fitness_table) where fitness is mean narrow/static ratio across the
+    instances a candidate was evaluated on (disqualified => inf)."""
+    static = {
+        _instance_key(r): (r["static_narrow"], r["static_scheduled"])
+        for r in instances
+    }
+    by_cand = {}
+    for e in evals:
+        by_cand.setdefault(e["candidate"], []).append(e)
+    table = []
+    for cand in sorted(by_cand):
+        rows = by_cand[cand]
+        ratios, ok = [], True
+        for e in rows:
+            narrow0, sched0 = static[_instance_key(e)]
+            if e["scheduled"] != sched0:
+                ok = False  # never trade placements for iterations
+                break
+            ratios.append(e["narrow"] / max(narrow0, 1))
+        fitness = float(np.mean(ratios)) if ok and ratios else float("inf")
+        table.append((cand, fitness, rows[0]["host_w"]))
+    elite_cand, elite_fit, elite_w = min(table, key=lambda t: (t[1], t[0]))
+    if elite_fit >= 1.0:
+        # honest fallback: nothing beat static, ship the zero head (stable
+        # sort => exact static order) rather than a measured regression
+        elite_cand, elite_w = -1, [0.0] * len(elite_w)
+    return elite_cand, elite_fit, [float(x) for x in elite_w], table
+
+
+def _ridge(X: np.ndarray, y: np.ndarray, lam: float) -> np.ndarray:
+    A = X.T @ X + lam * np.eye(X.shape[1], dtype=np.float64)
+    return np.linalg.solve(A, X.T @ y)
+
+
+def fit_lane_head(instances, host_w, arch, hidden_units, seed, lam):
+    """Distill the host scores onto the lane features by ridge regression,
+    aligned per instance via pod_order. Zero host head => zero lane head
+    (there is nothing to distill; zeros reproduce the static requeue)."""
+    host_w = np.asarray(host_w, np.float64)
+    n_lane = len(instances[0]["lane_features"][0])
+    if not np.any(host_w):
+        return {"w": [0.0] * n_lane, "b": 0.0, "hidden": None}
+    Xs, ys = [], []
+    for r in instances:
+        hf = np.asarray(r["host_features"], np.float64)
+        lf = np.asarray(r["lane_features"], np.float64)
+        order = np.asarray(r["pod_order"], np.int64)
+        scores = hf @ host_w
+        Xs.append(lf)
+        ys.append(scores[order])  # lane row i describes input pod order[i]
+    X = np.concatenate(Xs)
+    y = np.concatenate(ys)
+    hidden = None
+    if arch == "mlp":
+        rng = np.random.RandomState(seed)
+        w1 = rng.normal(0.0, 1.0 / np.sqrt(X.shape[1]), (hidden_units, X.shape[1]))
+        w1 = np.round(w1, 6)
+        b1 = np.zeros(hidden_units)
+        hidden = {"w": w1.tolist(), "b": b1.tolist()}
+        X = np.tanh(X @ w1.T + b1)
+    Xb = np.concatenate([X, np.ones((X.shape[0], 1))], axis=1)
+    wb = _ridge(Xb, y, lam)
+    w, b = np.round(wb[:-1], 6), round(float(wb[-1]), 6)
+    return {"w": w.tolist(), "b": b, "hidden": hidden}
+
+
+def train(corpus_path, out_path, arch="linear", hidden_units=8, seed=0, lam=1e-3):
+    instances, evals = load_corpus(corpus_path)
+    elite_cand, elite_fit, host_w, table = select_host_head(instances, evals)
+    lane = fit_lane_head(instances, host_w, arch, hidden_units, seed, lam)
+    weights = {
+        "arch": arch if lane["hidden"] else "linear",
+        "feature_version": instances[0]["host_feature_version"],
+        "lane_feature_version": instances[0]["lane_feature_version"],
+        "host": {"w": [round(float(x), 6) for x in host_w], "b": 0.0, "hidden": None},
+        "lane": lane,
+        "trained": {
+            "corpus_instances": len(instances),
+            "candidates": len(table),
+            "elite_candidate": elite_cand,
+            "elite_mean_narrow_ratio": round(elite_fit, 6),
+            "seed": seed,
+        },
+    }
+    payload = json.dumps(weights, sort_keys=True, separators=(",", ":")).encode()
+    if out_path:
+        write_framed(
+            out_path,
+            payload,
+            kind=ordering.WEIGHTS_KIND,
+            version=ordering.WEIGHTS_VERSION,
+            meta={"trainer": "tools/train_order.py", "corpus": os.path.basename(corpus_path)},
+        )
+    return weights, payload, table
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("corpus", help="JSONL from bench.py --record-order-corpus")
+    ap.add_argument("--out", default=None, help="framed weights artifact path")
+    ap.add_argument("--arch", choices=("linear", "mlp"), default="linear")
+    ap.add_argument("--hidden", type=int, default=8, help="mlp hidden units")
+    ap.add_argument("--seed", type=int, default=0, help="mlp random-feature seed")
+    ap.add_argument("--ridge", type=float, default=1e-3, help="ridge lambda")
+    args = ap.parse_args(argv)
+    weights, payload, table = train(
+        args.corpus, args.out, args.arch, args.hidden, args.seed, args.ridge
+    )
+    for cand, fitness, _w in table:
+        marker = " <= elite" if cand == weights["trained"]["elite_candidate"] else ""
+        print(f"candidate {cand:3d}: mean narrow ratio {fitness:.4f}{marker}")
+    t = weights["trained"]
+    if t["elite_candidate"] < 0:
+        print("no candidate beat the static order; shipping zero weights "
+              "(policy-on reproduces the static order exactly)")
+    print(f"host w: {weights['host']['w']}")
+    print(f"lane w: {[round(x, 4) for x in weights['lane']['w']]} b {weights['lane']['b']}")
+    if args.out:
+        print(f"wrote {args.out} ({len(payload)} payload bytes, "
+              f"digest {ordering.weights_digest(weights)})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
