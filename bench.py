@@ -583,19 +583,28 @@ def run_child():
             samples, median, stats = _measure(
                 lambda: bench_candidate_scoring(n_candidates), reps
             )
-            emit(
-                {
-                    "event": "consolidation",
-                    "candidates": n_candidates,
-                    "solve_s": round(median, 4),
-                    "solve_min_s": round(samples[0], 4),
-                    "solve_max_s": round(samples[-1], 4),
-                    "reps": len(samples),
-                    "compile_s": round(max(warm_s - median, 0.0), 2),
-                    "consolidatable": stats.get("consolidatable", -1),
-                    "mesh_devices": stats.get("mesh_devices", 1),
-                }
-            )
+            event = {
+                "event": "consolidation",
+                "candidates": n_candidates,
+                "solve_s": round(median, 4),
+                "solve_min_s": round(samples[0], 4),
+                "solve_max_s": round(samples[-1], 4),
+                "reps": len(samples),
+                "compile_s": round(max(warm_s - median, 0.0), 2),
+                "consolidatable": stats.get("consolidatable", -1),
+                "mesh_devices": stats.get("mesh_devices", 1),
+            }
+            # round-20 shared-vs-lane telemetry split: which screen path ran
+            # (full / delta), host+base-world time vs device lane time, and
+            # the per-lane resident-row histogram — the numbers the
+            # KARPENTER_TPU_SCREEN_DELTA A/B verdict reads
+            for key in (
+                "screen_mode", "screen_shared_ms", "screen_lane_ms",
+                "resident_counts", "delta_lanes", "fallback_lanes",
+            ):
+                if key in stats:
+                    event[key] = stats[key]
+            emit(event)
     except ImportError:
         pass
 
@@ -1391,6 +1400,18 @@ def main():
             }
             for e in consol
         }
+        # round-20 schema columns: the screen's shared/lane wall split and
+        # resident-count histogram from the best event, so a perf_gate A/B
+        # can attribute a rate change to host build vs device lanes
+        if "screen_mode" in best:
+            out["screen_mode"] = best["screen_mode"]
+            out["screen_shared_ms"] = best.get("screen_shared_ms")
+            out["screen_lane_ms"] = best.get("screen_lane_ms")
+            if "resident_counts" in best:
+                out["screen_resident_counts"] = best["resident_counts"]
+            if best["screen_mode"] == "delta":
+                out["screen_delta_lanes"] = best.get("delta_lanes")
+                out["screen_fallback_lanes"] = best.get("fallback_lanes")
     gate = next((e for e in events if e.get("event") == "gate"), None)
     if gate is not None and "gate_full_s" in gate:
         # round-16 device-gate columns (schema v2): the composite full-gate

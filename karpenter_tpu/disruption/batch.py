@@ -254,6 +254,12 @@ class UnionScorer:
             [self._node_idx.get(c.name, -1) for c in self.candidates], dtype=np.int64
         )
         self.deltas = [self._delta_for(c, n) for c, n in zip(self.candidates, self.cand_nodes)]
+        # incremental-screen state (KARPENTER_TPU_SCREEN_DELTA): the per-scorer
+        # planning context is built lazily on the first flag-on score call;
+        # last_screen_stats is the shared-vs-lane telemetry split bench.py
+        # publishes (screen_shared_ms / screen_lane_ms / resident counts)
+        self._delta_ctx = None
+        self.last_screen_stats: Optional[Dict] = None
 
     # -- survivor screen ------------------------------------------------------
 
@@ -387,6 +393,28 @@ class UnionScorer:
             or np.any(base.pod_grp_owned)
         ):
             passes = 1
+        from karpenter_tpu.disruption import screen_delta
+
+        if screen_delta.enabled():
+            out = self._score_subsets_delta(subsets, mesh, passes)
+            if out is not None:
+                return out
+        return self._score_full(subsets, mesh, passes)
+
+    def _score_full(
+        self,
+        subsets: Sequence[Sequence[int]],
+        mesh,
+        passes: int,
+    ) -> List[SubsetVerdict]:
+        """The full (non-incremental) screen: every lane re-solves the whole
+        union problem. This is the flag-off path — byte-for-byte the round-19
+        construction — and the classified-standdown fallback of the delta
+        path."""
+        import time as _time
+
+        base = self.base_problem
+        t0 = _time.perf_counter()
         # every-candidate-stays census, computed once: a subset then only
         # SUBTRACTS its own members' deltas (boolean OR over the outside set
         # == integer sum over it > 0, since deltas are non-negative), making
@@ -450,6 +478,8 @@ class UnionScorer:
             grp_counts0=counts_b,
             grp_registered0=np.asarray(base.grp_registered0)[None] | (reg_int_b > 0),
         )
+        t_shared = _time.perf_counter() - t0
+        t1 = _time.perf_counter()
         result = lean_screen(
             base, variants, self.num_claim_slots, mesh=mesh, passes=passes
         )
@@ -464,17 +494,50 @@ class UnionScorer:
                 result.state.claim_req.admitted,  # [B, C, K, V]
             )
         )
+        t_lane = _time.perf_counter() - t1
+        self.last_screen_stats = {
+            "mode": "full",
+            "lanes": B,
+            "pad_to": pad_to,
+            "screen_shared_ms": t_shared * 1e3,
+            "screen_lane_ms": t_lane * 1e3,
+            # the full screen re-solves every active row per lane; the
+            # resident count is what the delta path would have re-solved
+            "resident_counts": (m8 @ self._cand_row_mask_i32)[:B]
+            .clip(max=1)
+            .sum(axis=1)
+            .tolist(),
+            "mesh_devices": 1 if mesh is None else int(mesh.devices.size),
+        }
+        return self._decode_verdicts(
+            subsets, member[:B], kinds[:B], claim_open[:B], claim_it_ok[:B],
+            claim_adm[:B],
+        )
 
+    def _decode_verdicts(
+        self,
+        subsets: Sequence[Sequence[int]],
+        member: np.ndarray,
+        kinds: np.ndarray,
+        claim_open: np.ndarray,
+        claim_it_ok: np.ndarray,
+        claim_adm: np.ndarray,
+    ) -> List[SubsetVerdict]:
+        """Shared verdict decode of a screen result's host rows. Used by both
+        the full and the residual path: a residual lane only ever changes its
+        own resident rows' kinds and the claim state, which are exactly the
+        arrays this reads — so verdict parity between the paths is parity of
+        these inputs."""
         T_real = len(self.meta.instance_type_names)
         zone_k = self.meta.zone_key_idx
         ct_k = self.meta.ct_key_idx
         # vectorized verdicts: a subset passes iff none of its members' pod
         # rows failed — one [B, P] x [P, n_cand] product instead of the
         # O(B x |subset|) row-scan loop
-        fail_b = (kinds[:B] >= KIND_FAIL).astype(np.int32)
+        fail_b = (kinds >= KIND_FAIL).astype(np.int32)
         cand_failed = fail_b @ self._cand_row_mask_i32.T > 0
-        ok_b = ~np.any(cand_failed & member[:B], axis=1)
-        n_claims_b = claim_open[:B].sum(axis=1).astype(np.int64)
+        ok_b = ~np.any(cand_failed & member, axis=1)
+        n_claims_b = claim_open.sum(axis=1).astype(np.int64)
         verdicts = []
         for bi, subset in enumerate(subsets):
             ok = bool(ok_b[bi])
@@ -492,6 +555,177 @@ class UnionScorer:
                     claim_adm[bi, slot], ct_k
                 )
             verdicts.append(verdict)
+        return verdicts
+
+    def _score_subsets_delta(
+        self,
+        subsets: Sequence[Sequence[int]],
+        mesh,
+        passes: int,
+    ) -> Optional[List[SubsetVerdict]]:
+        """The incremental screen (KARPENTER_TPU_SCREEN_DELTA): solve the
+        shared base world once, then re-solve each lane as a residual program
+        over only its resident rows and their runs (disruption/screen_delta.py
+        states the decomposability argument and the standdown taxonomy).
+        Returns None when the WHOLE batch stands down (caller runs the full
+        screen); per-lane standdowns and gate-mismatch lanes are re-scored
+        through _score_full inside this call, so every published verdict is
+        either residual-with-gate or literally the full screen's."""
+        import time as _time
+
+        import jax
+
+        from karpenter_tpu import verify
+        from karpenter_tpu.disruption import screen_delta
+        from karpenter_tpu.metrics.registry import (
+            SCREEN_DELTA,
+            SCREEN_DELTA_LANE,
+        )
+        from karpenter_tpu.ops.padding import screen_axis_bucket
+        from karpenter_tpu.parallel.mesh import ResidualVariants, residual_screen
+
+        base = self.base_problem
+        t0 = _time.perf_counter()
+        if self._delta_ctx is None:
+            self._delta_ctx = screen_delta.DeltaContext(self)
+        ctx = self._delta_ctx
+        reason = ctx.batch_standdown(base, passes)
+        if reason is not None:
+            SCREEN_DELTA.inc({"outcome": reason}, float(len(subsets)))
+            return None
+        world = ctx.base_world(self)
+        plan = ctx.plan_lanes(self, subsets, world)
+        delta_ix = [i for i, r in enumerate(plan.reasons) if r is None]
+        fb_ix = [i for i, r in enumerate(plan.reasons) if r is not None]
+        for i in fb_ix:
+            SCREEN_DELTA.inc({"outcome": plan.reasons[i]})
+        verdicts: List[Optional[SubsetVerdict]] = [None] * len(subsets)
+        stats = {
+            "mode": "delta",
+            "lanes": len(subsets),
+            "mesh_devices": 1 if mesh is None else int(mesh.devices.size),
+        }
+        reason_counts: Dict[str, int] = {}
+        for i in fb_ix:
+            reason_counts[plan.reasons[i]] = reason_counts.get(plan.reasons[i], 0) + 1
+        t_lane = 0.0
+        if delta_ix:
+            B = len(delta_ix)
+            pad_to = screen_axis_bucket(B)
+            if mesh is not None:
+                n_dev = mesh.devices.size
+                pad_to = ((pad_to + n_dev - 1) // n_dev) * n_dev
+            n_cand = len(self.candidates)
+            member = np.zeros((pad_to, n_cand), dtype=bool)
+            member[:B] = plan.member[delta_ix]
+            m8 = member.astype(np.int32)
+            member_node = np.zeros((pad_to, base.node_avail.shape[0]), dtype=bool)
+            valid_ni = self._cand_node_idx >= 0
+            member_node[:, self._cand_node_idx[valid_ni]] = member[:, valid_ni]
+            node_avail_b = np.where(
+                member_node[:, :, None], -1.0, np.asarray(base.node_avail)[None]
+            )
+            # residents ONLY — the base rows' verdicts live in the carried
+            # world and never re-enter the program
+            pod_active_b = (m8 @ self._cand_row_mask_i32) > 0
+            # SHARED run trim: the union of every delta lane's touched runs,
+            # in run order. Shared (not per-lane) so the run arrays stay
+            # unbatched and vmap hoists the per-run representative work out
+            # of the lane axis — see _residual_screen_jit. A lane's rows in
+            # another lane's runs are inert via pod_active.
+            union_runs = np.flatnonzero(plan.touched[delta_ix].any(axis=0))
+            rnr = screen_delta.residual_run_bucket(len(union_runs))
+            run_idx = np.full(rnr, -1, dtype=np.int32)
+            run_idx[: len(union_runs)] = union_runs
+            counts = plan.run_counts[delta_ix]
+            variants = ResidualVariants(
+                node_avail=node_avail_b,
+                pod_active=pod_active_b,
+            )
+            t_shared = _time.perf_counter() - t0
+            t1 = _time.perf_counter()
+            result = residual_screen(
+                base, world.carried, variants, run_idx, self.num_claim_slots,
+                mesh=mesh,
+            )
+            fetch = [
+                result.kind,  # [B, P]
+                result.index,  # [B, P]
+                result.state.claim_open,  # [B, C]
+                result.state.claim_it_ok,  # [B, C, T]
+                result.state.claim_req.admitted,  # [B, C, K, V]
+            ]
+            deep = verify.enabled()
+            if deep:
+                fetch.append(result.state.node_requests)  # [B, N, R]
+                fetch.append(world.carried.node_requests)  # [N, R]
+            got = jax.device_get(tuple(fetch))
+            t_lane = _time.perf_counter() - t1
+            kinds, idxs, claim_open, claim_it_ok, claim_adm = got[:5]
+            SCREEN_DELTA_LANE.observe(t_lane / max(B, 1))
+            scope = verify.ScreenLaneScope(
+                resident_mask=pod_active_b[:B], masked_nodes=member_node[:B]
+            )
+            gate_ok = verify.screen_lane_gate(
+                kinds[:B],
+                idxs[:B],
+                scope,
+                node_requests=got[5][:B] if deep else None,
+                node_avail=node_avail_b[:B] if deep else None,
+                carried_node_requests=got[6] if deep else None,
+            )
+            good = [bi for bi in range(B) if gate_ok[bi]]
+            bad = [bi for bi in range(B) if not gate_ok[bi]]
+            if good:
+                SCREEN_DELTA.inc({"outcome": "delta"}, float(len(good)))
+                rows = np.array(good, dtype=np.int64)
+                for key, verdict in zip(
+                    good,
+                    self._decode_verdicts(
+                        [subsets[delta_ix[bi]] for bi in good],
+                        member[rows],
+                        kinds[rows],
+                        claim_open[rows],
+                        claim_it_ok[rows],
+                        claim_adm[rows],
+                    ),
+                ):
+                    verdicts[delta_ix[key]] = verdict
+            if bad:
+                SCREEN_DELTA.inc({"outcome": "gate-mismatch"}, float(len(bad)))
+                reason_counts["gate-mismatch"] = len(bad)
+                fb_ix = fb_ix + [delta_ix[bi] for bi in bad]
+            stats.update(
+                {
+                    "pad_to": pad_to,
+                    "rnr": rnr,
+                    "resident_counts": pod_active_b[:B].sum(axis=1).tolist(),
+                    "run_counts": counts[:B].tolist(),
+                }
+            )
+        else:
+            t_shared = _time.perf_counter() - t0
+        if fb_ix:
+            fb_sorted = sorted(fb_ix)
+            for key, verdict in zip(
+                fb_sorted,
+                self._score_full([subsets[i] for i in fb_sorted], mesh, passes),
+            ):
+                verdicts[key] = verdict
+            full_stats = self.last_screen_stats or {}
+            t_lane += full_stats.get("screen_lane_ms", 0.0) / 1e3
+            t_shared += full_stats.get("screen_shared_ms", 0.0) / 1e3
+        stats.update(
+            {
+                "screen_shared_ms": t_shared * 1e3,
+                "screen_lane_ms": t_lane * 1e3,
+                "delta_lanes": len(delta_ix)
+                - reason_counts.get("gate-mismatch", 0),
+                "fallback_lanes": len(fb_ix),
+                "standdowns": reason_counts,
+            }
+        )
+        self.last_screen_stats = stats
         return verdicts
 
     def _admitted_values(self, adm_row: np.ndarray, key_idx: int) -> Set[str]:
@@ -529,7 +763,18 @@ def build_scorer(provisioner, candidates) -> Optional[UnionScorer]:
 # 100-node cluster the way MultiNodeConsolidation would
 # ---------------------------------------------------------------------------
 
-def bench_candidate_scoring(n_candidates: int = 100, mesh="auto") -> Dict[str, int]:
+def build_bench_scorer(
+    n_candidates: int = 100,
+    base_pods: Sequence = (),
+    rng_seed: int = 7,
+    num_claim_slots: int = MAX_SCREEN_CLAIMS,
+):
+    """The synthetic consolidation cluster the bench scores, as a reusable
+    scorer: n_candidates small nodes (1-4 residents each) + 8 roomy
+    survivors, 100 instance types, one default NodePool. ``base_pods`` ride
+    as the pending reschedule set (tests/test_screen_delta.py uses them to
+    drive the base-world solve and the per-lane standdown reasons). Returns
+    (scorer, instance_types, candidates)."""
     import random
 
     from karpenter_tpu.apis.nodepool import NodePool
@@ -543,7 +788,7 @@ def bench_candidate_scoring(n_candidates: int = 100, mesh="auto") -> Dict[str, i
         template_from_nodepool,
     )
 
-    rng = random.Random(7)
+    rng = random.Random(rng_seed)
     its = instance_types(100)
     tpl = template_from_nodepool(
         NodePool(metadata=ObjectMeta(name="default")), its, range(len(its))
@@ -620,14 +865,18 @@ def bench_candidate_scoring(n_candidates: int = 100, mesh="auto") -> Dict[str, i
         )
     cluster_pods = []
     inputs = SchedulerInputs(
-        pods=[],
+        pods=list(base_pods),
         instance_types=list(its),
         templates=[tpl],
         nodes=nodes,
         domains=domains_from_instance_types(its, [tpl]),
         cluster_pods=cluster_pods,
     )
-    scorer = UnionScorer(inputs, candidates)
+    return UnionScorer(inputs, candidates, num_claim_slots), its, candidates
+
+
+def bench_candidate_scoring(n_candidates: int = 100, mesh="auto") -> Dict[str, int]:
+    scorer, its, candidates = build_bench_scorer(n_candidates)
     subsets = [list(range(k + 1)) for k in range(n_candidates)]
     if mesh == "auto":
         mesh = default_mesh()
@@ -637,13 +886,35 @@ def bench_candidate_scoring(n_candidates: int = 100, mesh="auto") -> Dict[str, i
         for v, s in zip(verdicts, subsets)
         if v.consolidatable_with([candidates[i] for i in s], its)
     )
-    return {
+    out = {
         "candidates": n_candidates,
         "consolidatable": consolidatable,
         # the subset axis shards across this mesh when devices > 1
         # (parallel/mesh.py batched_screen); 1x means vmap on a single device
+        # — the SAME key/meaning as the round-18 consolidation event, and the
+        # same mesh the dispatch actually used (score_subsets received it
+        # explicitly; the delta path threads it to residual_screen too)
         "mesh_devices": 1 if mesh is None else int(mesh.devices.size),
     }
+    # shared-vs-per-lane telemetry split (bench.py schema columns): which
+    # path ran, host/base-world time vs device lane time, and how many rows
+    # each lane actually re-solved
+    stats = scorer.last_screen_stats
+    if stats is not None:
+        out["screen_mode"] = stats.get("mode")
+        out["screen_shared_ms"] = round(stats.get("screen_shared_ms", 0.0), 3)
+        out["screen_lane_ms"] = round(stats.get("screen_lane_ms", 0.0), 3)
+        residents = stats.get("resident_counts") or []
+        if residents:
+            out["resident_counts"] = {
+                "min": int(np.min(residents)),
+                "p50": float(np.percentile(residents, 50)),
+                "max": int(np.max(residents)),
+            }
+        if stats.get("mode") == "delta":
+            out["delta_lanes"] = stats.get("delta_lanes")
+            out["fallback_lanes"] = stats.get("fallback_lanes")
+    return out
 
 
 class ScreenSession:
@@ -668,11 +939,13 @@ class ScreenSession:
             self._verdicts = {}
         return self._scorer
 
-    def score(self, subsets, extra=()) -> List[SubsetVerdict]:
+    def score(self, subsets, extra=(), mesh="auto") -> List[SubsetVerdict]:
         """Verdicts for ``subsets``; cache misses are batched into ONE device
         launch together with ``extra`` speculative subsets (a later method's
         expected queries — Multi passes the singleton probes Single will ask
-        for, so the whole pass usually costs one launch)."""
+        for, so the whole pass usually costs one launch). ``mesh`` threads
+        through to the dispatch site (lean_screen / residual_screen) so the
+        session and the bench report the same ``mesh_devices``."""
         assert self._scorer is not None
         want = [tuple(s) for s in subsets]
         missing = [s for s in want if s not in self._verdicts]
@@ -682,7 +955,10 @@ class ScreenSession:
         ]
         if missing:
             for key, verdict in zip(
-                missing, self._scorer.score_subsets([list(s) for s in missing])
+                missing,
+                self._scorer.score_subsets(
+                    [list(s) for s in missing], mesh=mesh
+                ),
             ):
                 self._verdicts[key] = verdict
         return [self._verdicts[s] for s in want]

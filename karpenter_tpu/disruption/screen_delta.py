@@ -1,0 +1,277 @@
+"""Incremental consolidation screen — residual-world lane planning.
+
+The full screen (disruption/batch.py score_subsets -> parallel/mesh.py
+lean_screen) re-solves the ENTIRE union problem per candidate lane, even
+though each lane differs from the shared base world only by deleting the
+subset's nodes and re-queueing their residents. With the run-structured
+solve the cost of a lane is linear in the RUN axis and independent of how
+many pods are active (profiled in docs/PERF_NOTES.md round 20), so the win
+is to solve the shared base world ONCE per scorer and re-run each lane over
+only the runs its residents occupy:
+
+  - base world: every base (pending/deleting) pod solved once against the
+    unmasked cluster via the carried sweeps entry
+    (ops/ffd_sweeps.solve_ffd_sweeps_carried, the same entry the relax
+    repair dispatches); the resulting FFDState pins the base placement's
+    consumption exactly the way streaming/warm.py pins kept bins for churn
+    (streaming/residual.py is the shared statement of that construction).
+  - per lane: mask the subset's node rows, activate only its resident pod
+    rows, and gather JUST the runs those rows live in (run_idx indices into
+    the shared run arrays). Skipped runs never enter the program; gathered
+    padding reuses the (start=0, len=0, mode=ANALYTIC) no-op convention
+    ops/padding.pad_problem established for the run axis.
+
+Soundness is first-fit prefix decomposability: the runs scan threads state
+through rows in queue order, so [solve base rows] then [solve resident rows
+against the carried state] equals the full interleaved solve PROVIDED the
+base rows' decisions transfer to the lane world. Each condition below that
+could break the transfer is a CLASSIFIED standdown — the lane (or batch)
+falls back to the full lean_screen and the reason lands in
+solver_screen_delta_total{outcome}. A delta bug costs latency, never a
+wrong consolidation decision:
+
+  standdown-topology        the batch needs >1 placement pass (some pod
+                            reads/writes the topology census) or the base
+                            problem has topology-coupled runs; residual
+                            lanes carry the BASE census, which is only
+                            provably inert when no pod consults it.
+  standdown-ports           some pod declares host ports; port reservations
+                            made by base pods could collide differently
+                            across the candidate boundary.
+  standdown-pool            a template pool is finite (tpl_remaining not
+                            +inf); claim opens drain shared pool state
+                            across the base/resident boundary.
+  standdown-base-on-candidate  (per lane) the base solve placed a pod (or
+                            would have, before claiming) on a node this
+                            lane deletes — masking only ever REMOVES
+                            options, so a base pod whose chosen node
+                            survives keeps its choice, but one whose node
+                            is deleted must re-route and the carried state
+                            is wrong for this lane.
+  standdown-resident-order  (per lane) a resident row precedes an active
+                            base row in the FFD queue, so "base first,
+                            residents after" is not the interleaved order
+                            and prefix decomposability does not apply.
+  standdown-resident-overflow  (per lane) the lane touches more runs than
+                            KARPENTER_TPU_SCREEN_DELTA_MAX_RUNS (default
+                            64) or a resident row is not covered by any
+                            run — the residual program's run axis would
+                            stop being small, which is the entire win.
+
+Flag: KARPENTER_TPU_SCREEN_DELTA, default ON since the round-20 A/B
+verdict (docs/PERF_NOTES.md: 1.71x at B=100 with zero fallback lanes and
+gate-checked parity on every corpus). Flag off (=0), score_subsets never
+enters this module's planning path and the published verdicts are
+bit-identical to round 19.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from karpenter_tpu.ops.ffd import KIND_NODE
+
+
+def enabled() -> bool:
+    """KARPENTER_TPU_SCREEN_DELTA, default ON: every lane verdict is either
+    gate-checked residual or literally the full screen's (classified
+    standdown), so the flag trades latency only — and the round-20 A/B read
+    1.71x in the delta path's favor."""
+    return os.environ.get("KARPENTER_TPU_SCREEN_DELTA", "1") not in ("", "0")
+
+
+def residual_run_bucket(n: int) -> int:
+    """Eighth-pow2 bucket for the residual program's gathered-run axis, floor
+    4 (a singleton candidate's residents usually occupy 1-3 runs). Same
+    bucketing discipline as the subset axis: per-lane cost is linear in the
+    run axis, so pad waste is pure wall time — eighth steps cap it at 12.5%
+    and solver/warmup.prewarm_screen walks the ladder."""
+    from karpenter_tpu.ops.padding import screen_axis_bucket
+
+    return screen_axis_bucket(max(int(n), 1), lo=4)
+
+
+def max_residual_runs() -> int:
+    """Largest per-lane touched-run count the residual program will carry;
+    beyond it the lane stands down (standdown-resident-overflow)."""
+    return int(os.environ.get("KARPENTER_TPU_SCREEN_DELTA_MAX_RUNS", "64"))
+
+
+@dataclasses.dataclass
+class BaseWorld:
+    """The once-per-scorer shared solve: carried FFDState with every base
+    pod's consumption pinned, plus which node rows base pods landed on (the
+    per-lane base-on-candidate test) and whether any base pod failed or
+    claimed — claim/fail rows transfer to every lane unchanged."""
+
+    carried: object  # FFDState on device
+    nodes_used: np.ndarray  # i64 sorted unique node indices base pods occupy
+    kinds: Optional[np.ndarray]  # i32[P] base verdict rows (None: no base pods)
+    indexes: Optional[np.ndarray]
+
+
+@dataclasses.dataclass
+class LanePlan:
+    """Host-side plan for one score_subsets call under the delta path."""
+
+    reasons: List[Optional[str]]  # per lane; None = residual-eligible
+    member: np.ndarray  # bool [B, n_cand]
+    touched: np.ndarray  # bool [B, RN] runs each lane's residents occupy
+    run_counts: np.ndarray  # i64 [B]
+
+
+class DeltaContext:
+    """Per-scorer host precompute for the residual screen. Built lazily on
+    the first flag-on score_subsets call and cached on the UnionScorer (the
+    base world is a per-scorer constant: ScreenSession reuses one scorer
+    across every probe of a reconcile pass, and no command executes between
+    probes)."""
+
+    def __init__(self, scorer) -> None:
+        base = scorer.base_problem
+        run_start = np.asarray(base.run_start)
+        run_len = np.asarray(base.run_len)
+        self.RN = int(run_start.shape[0])
+        P = int(base.pod_active.shape[0])
+
+        # row -> run id map (-1: covered by no run, e.g. pad rows past the
+        # last run); vectorized scatter over run extents
+        rid = np.full(P, -1, dtype=np.int64)
+        for r in range(self.RN):
+            ln = int(run_len[r])
+            if ln > 0:
+                rid[int(run_start[r]): int(run_start[r]) + ln] = r
+        self.run_of_row = rid
+
+        n_cand = len(scorer.candidates)
+        self.cand_runs = np.zeros((n_cand, self.RN), dtype=bool)
+        self.cand_min_row = np.full(n_cand, P, dtype=np.int64)
+        self.cand_uncovered = np.zeros(n_cand, dtype=bool)
+        for ci, rows in enumerate(scorer.cand_rows):
+            if len(rows) == 0:
+                continue
+            self.cand_min_row[ci] = rows.min()
+            rr = rid[rows]
+            if np.any(rr < 0):
+                self.cand_uncovered[ci] = True
+            self.cand_runs[ci, rr[rr >= 0]] = True
+        self.cand_runs_i32 = self.cand_runs.astype(np.int32)
+
+        # active base rows = the union problem's active rows minus every
+        # candidate's resident rows (same masking score_subsets applies)
+        base_active = np.asarray(base.pod_active).copy()
+        all_cand = (
+            np.concatenate(scorer.cand_rows)
+            if scorer.cand_rows
+            else np.zeros(0, dtype=np.int64)
+        )
+        base_active[all_cand] = False
+        self.base_active = base_active
+        nz = np.flatnonzero(base_active)
+        self.max_base_row = int(nz.max()) if nz.size else -1
+        self._world: Optional[BaseWorld] = None
+
+    # -- batch-level applicability -------------------------------------------
+
+    def batch_standdown(self, base, passes: int) -> Optional[str]:
+        """One classified reason that disqualifies the WHOLE batch, or None.
+        All three tests are conservative over-approximations (any port row,
+        any finite pool) — cheap, and a false standdown only costs latency."""
+        from karpenter_tpu.ops.ffd import has_topo_runs
+
+        if passes != 1 or has_topo_runs(base):
+            return "standdown-topology"
+        if np.any(np.asarray(base.pod_ports)):
+            return "standdown-ports"
+        if np.any(np.isfinite(np.asarray(base.tpl_remaining))):
+            return "standdown-pool"
+        return None
+
+    # -- shared base world ----------------------------------------------------
+
+    def base_world(self, scorer) -> BaseWorld:
+        """Solve the base (pending/deleting) pods once against the unmasked
+        cluster and pin their consumption in a carried FFDState. Cached: every
+        score_subsets call of the scorer's lifetime reuses it."""
+        if self._world is not None:
+            return self._world
+        from karpenter_tpu.ops.ffd import initial_state
+
+        base = scorer.base_problem
+        C = scorer.num_claim_slots
+        if self.max_base_row < 0:
+            # no base pods (e.g. the bench corpus): the carried state is the
+            # plain initial state — no device solve needed
+            self._world = BaseWorld(
+                carried=initial_state(base, C),
+                nodes_used=np.zeros(0, dtype=np.int64),
+                kinds=None,
+                indexes=None,
+            )
+            return self._world
+        from karpenter_tpu.ops.ffd_sweeps import (
+            fresh_carry,
+            solve_ffd_sweeps_carried,
+        )
+
+        p_base = dataclasses.replace(base, pod_active=self.base_active)
+        r = solve_ffd_sweeps_carried(p_base, C, init=fresh_carry(p_base, C))
+        import jax
+
+        kinds, indexes = jax.device_get((r.kind, r.index))
+        kinds = np.asarray(kinds)
+        indexes = np.asarray(indexes)
+        on_node = self.base_active & (kinds == KIND_NODE)
+        self._world = BaseWorld(
+            carried=r.state,
+            nodes_used=np.unique(indexes[on_node]),
+            kinds=kinds,
+            indexes=indexes,
+        )
+        return self._world
+
+    # -- per-lane classification ----------------------------------------------
+
+    def plan_lanes(self, scorer, subsets, world: BaseWorld) -> LanePlan:
+        """Classify every lane: residual-eligible or a named standdown.
+        Fully vectorized over the membership matrix (no per-lane python)."""
+        n_cand = len(scorer.candidates)
+        B = len(subsets)
+        member = np.zeros((B, n_cand), dtype=bool)
+        for bi, subset in enumerate(subsets):
+            member[bi, list(subset)] = True
+        m8 = member.astype(np.int32)
+
+        touched = (m8 @ self.cand_runs_i32) > 0  # [B, RN]
+        run_counts = touched.sum(axis=1).astype(np.int64)
+
+        # base-on-candidate: lane deletes a node the base solve occupies
+        cand_node_used = np.isin(scorer._cand_node_idx, world.nodes_used)
+        base_on_cand = (m8 @ cand_node_used.astype(np.int32)) > 0
+
+        # resident-order: every resident row must follow every active base row
+        lane_min_row = np.where(
+            member, self.cand_min_row[None, :], np.iinfo(np.int64).max
+        ).min(axis=1)
+        order_bad = lane_min_row <= self.max_base_row
+
+        # resident-overflow: too many touched runs, or an uncovered row
+        cap = max_residual_runs()
+        uncovered = (m8 @ self.cand_uncovered.astype(np.int32)) > 0
+        overflow = (run_counts > cap) | uncovered
+
+        reasons: List[Optional[str]] = [None] * B
+        for bi in range(B):
+            if base_on_cand[bi]:
+                reasons[bi] = "standdown-base-on-candidate"
+            elif order_bad[bi]:
+                reasons[bi] = "standdown-resident-order"
+            elif overflow[bi]:
+                reasons[bi] = "standdown-resident-overflow"
+        return LanePlan(
+            reasons=reasons, member=member, touched=touched, run_counts=run_counts
+        )

@@ -263,6 +263,24 @@ GATE_AUDIT = REGISTRY.counter(
     "reject_overturned for host confirmation of device rejections)",
 )
 
+# -- incremental consolidation screen (disruption/screen_delta.py) ------------
+SCREEN_DELTA = REGISTRY.counter(
+    "solver_screen_delta_total",
+    "Incremental consolidation screen lane outcomes "
+    "(KARPENTER_TPU_SCREEN_DELTA), by classified outcome: delta (residual "
+    "verdict published), standdown-topology / standdown-ports / "
+    "standdown-pool / standdown-base-on-candidate / "
+    "standdown-resident-order / standdown-resident-overflow (lane or batch "
+    "fell back to the full screen), gate-mismatch (the row-scoped lane gate "
+    "rejected a residual verdict; the full screen re-solve was published "
+    "instead)",
+)
+SCREEN_DELTA_LANE = REGISTRY.histogram(
+    "solver_screen_delta_lane_seconds",
+    "Residual consolidation screen device wall time per lane (dispatch "
+    "wall / lane count, observed once per residual dispatch)",
+)
+
 # -- solve-cycle tracing series (obs/trace.py, solver/jax_backend.py) ---------
 SOLVER_PHASE_DURATION = REGISTRY.histogram(
     "solver_phase_duration_seconds",

@@ -106,6 +106,8 @@ PROGRAM_FLAGS = (
     "KARPENTER_TPU_ABLATE",
     "KARPENTER_TPU_RELAX",
     "KARPENTER_TPU_RELAX_PASSES",
+    "KARPENTER_TPU_SCREEN_DELTA",
+    "KARPENTER_TPU_SCREEN_DELTA_MAX_RUNS",
 )
 
 

@@ -1962,6 +1962,21 @@ def solve_ffd_sweeps_carried(
 solve_ffd_sweeps_carried._donates_carry = True
 
 
+def fresh_carry(problem: SchedulingProblem, max_claims: int):
+    """A cold RelaxCarry for solve_ffd_sweeps_carried: the plain initial
+    state plus all-FAIL verdict seeds. Lets callers with NO phase-1 result
+    (the incremental screen's base-world solve, disruption/screen_delta.py)
+    ride the carried entry — which is the one whose output state they need
+    to keep — instead of the fresh entry. The carry is donated by the
+    dispatch, so build it fresh per call."""
+    P = problem.pod_active.shape[0]
+    return (
+        initial_state(problem, max_claims),
+        jnp.full((P,), KIND_FAIL, dtype=jnp.int32),
+        jnp.full((P,), -1, dtype=jnp.int32),
+    )
+
+
 def solve_ffd_sweeps(
     problem: SchedulingProblem, max_claims: int, init: Optional[FFDState] = None,
     wavefront: Optional[int] = None,

@@ -281,6 +281,124 @@ def lean_screen(
     return result
 
 
+class ResidualVariants:
+    """The two arrays a residual-screen lane changes on the shared union
+    problem (disruption/screen_delta.py): the subset's node rows masked out
+    and ONLY its resident pod rows active. The lane's evicted residents are
+    the active rows; everything the base world placed rides along pinned in
+    the carried state. Group census arrays are deliberately absent: the
+    delta path stands down whenever any pod consults the census, so the base
+    problem's arrays ride along inert."""
+
+    def __init__(self, node_avail, pod_active):
+        self.node_avail = node_avail
+        self.pod_active = pod_active
+
+    def tree(self):
+        return (self.node_avail, self.pod_active)
+
+
+@functools.partial(jax.jit, static_argnums=(4, 5))
+def _residual_screen_jit(
+    base: SchedulingProblem,
+    carried,  # FFDState: base-world consumption pinned, broadcast per lane
+    variants,  # 2-tuple of [B, ...] arrays (ResidualVariants.tree())
+    run_idx,  # i32[RNr] SHARED across lanes: union of touched runs, -1 pads
+    max_run: int,
+    with_topo: bool,
+) -> FFDResult:
+    import dataclasses
+
+    # the run trim is SHARED across lanes on purpose: a batched (per-lane)
+    # run axis would batch the scan's xs, so vmap could no longer hoist the
+    # per-run representative computation out of the lane axis — measured
+    # 2.4x slower than this form at B=100, wiping out the trim. Per-lane
+    # trimming also buys nothing a shared trim doesn't: lane cost is linear
+    # in the run axis and independent of how many rows are active
+    # (docs/PERF_NOTES.md round 20), and skipped lanes' rows in a shared
+    # run are inert via pod_active. -1 entries gather run 0 with length
+    # forced to 0 — the same (start=0, len=0, mode=ANALYTIC) no-op the
+    # padded run axis already proves out (ops/padding.pad_problem).
+    valid = run_idx >= 0
+    ridx = jnp.where(valid, run_idx, 0)
+    p0 = dataclasses.replace(
+        base,
+        run_start=jnp.asarray(base.run_start)[ridx],
+        run_len=jnp.where(valid, jnp.asarray(base.run_len)[ridx], 0),
+        run_mode=jnp.where(valid, jnp.asarray(base.run_mode)[ridx], 1),
+    )
+
+    # single pass by construction: the delta path only dispatches when one
+    # placement pass is a fixed point (no topology interaction — the same
+    # passes=1 condition score_subsets already proves)
+    def one(node_avail, pod_active) -> FFDResult:
+        p = dataclasses.replace(p0, node_avail=node_avail, pod_active=pod_active)
+        return _solve_ffd_runs_jit.__wrapped__(p, carried, max_run, with_topo)
+
+    return jax.vmap(one)(*variants)
+
+
+def residual_screen(
+    base: SchedulingProblem,
+    carried,
+    variants: ResidualVariants,
+    run_idx,
+    max_claims: int,
+    mesh: Optional[Mesh] = None,
+) -> FFDResult:
+    """The incremental consolidation screen: every lane re-solves ONLY its
+    resident rows, over the shared union of touched runs, against the shared
+    carried base world. Same dispatch shape as lean_screen — variant axis
+    sharded across the mesh; base problem, carried state, and the run-trim
+    indices replicated."""
+    max_run = _max_run_bucket(base)
+    # with_topo is False by contract: screen_delta.batch_standdown rejects
+    # any base problem with topology-coupled runs before this is reached
+    # (lax.switch would silently clamp a RUN_TOPO mode into the analytic
+    # branch otherwise)
+    with_topo = False
+    tree = variants.tree()
+    run_idx = np.asarray(run_idx, dtype=np.int32)
+    b_orig = 0
+    if mesh is not None:
+        tree, b_orig = _pad_lane_axis(tree, mesh)
+        sharding = NamedSharding(mesh, P(CANDIDATE_AXIS))
+        tree = tuple(jax.device_put(a, sharding) for a in tree)
+        replicate = NamedSharding(mesh, P())
+        base = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, replicate), base
+        )
+        carried = jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, replicate), carried
+        )
+        run_idx = jax.device_put(run_idx, replicate)
+    from karpenter_tpu.solver import aot
+
+    handle = aot.maybe_begin(
+        residual_screen, (base, carried, tree, run_idx), max_claims, None
+    )
+    obs = programs.begin_dispatch(
+        "residual_screen", max_claims, (base, carried, tree, run_idx),
+        statics={"max_run": max_run, "with_topo": with_topo},
+    )
+    if handle is not None:
+        result = handle.call()
+    else:
+        result = _residual_screen_jit(
+            base, carried, tree, run_idx, max_run, with_topo
+        )
+    if mesh is not None:
+        result = _trim_lane_axis(result, b_orig)
+    if obs is not None:
+        obs.finish(
+            problem_bytes=_tree_bytes((base, carried, tree, run_idx)),
+            source_override=(
+                handle.source_override if handle is not None else None
+            ),
+        )
+    return result
+
+
 def default_mesh(min_devices: int = 2) -> Optional[Mesh]:
     """A 1-D candidate mesh over every local device, or None on a single
     device (vmap alone already uses the whole chip)."""

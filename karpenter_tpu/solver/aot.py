@@ -213,6 +213,23 @@ def _call_spec(solve_name: str, problem, max_claims: int, init) -> Optional[_Spe
                 f"mesh{mesh.devices.size}", "shard",
             ),
         )
+    if solve_name == "residual_screen":
+        # the incremental consolidation screen (parallel/mesh.py): ``problem``
+        # packs (base union problem, carried base-world state, variants tree,
+        # shared run-trim indices). with_topo is False by the delta path's
+        # standdown contract — a base problem with topology runs never
+        # reaches this dispatch
+        from karpenter_tpu.ops.ffd_runs import max_run_bucket
+        from karpenter_tpu.parallel.mesh import _residual_screen_jit
+
+        base, carried, tree, run_idx = problem
+        mr = max_run_bucket(base)
+        return _Spec(
+            _residual_screen_jit,
+            (base, carried, tree, run_idx, mr, False),
+            (base, carried, tree, run_idx),
+            (f"C{int(max_claims)}", f"mr{int(mr)}", "residual"),
+        )
     if solve_name == "relax_place":
         from karpenter_tpu.ops.relax import _relax_place_jit, relax_passes
 

@@ -243,8 +243,10 @@ def prewarm_screen(n_candidates: int) -> bool:
     """Compile the consolidation screen program for the eighth-pow2
     candidate buckets up to ``n_candidates`` (disruption/batch.py pads the
     subset axis with ops/padding.screen_axis_bucket, so these are the
-    executables a reconcile pass will request). Synthetic-shape caveat as in
-    prewarm_solver."""
+    executables a reconcile pass will request). When the incremental screen
+    is on (KARPENTER_TPU_SCREEN_DELTA) the scorer routes through the
+    residual-lane program instead, so this same walk compiles that program's
+    lane/run buckets too. Synthetic-shape caveat as in prewarm_solver."""
     from karpenter_tpu.disruption.batch import bench_candidate_scoring
     from karpenter_tpu.obs import trace
     from karpenter_tpu.ops.padding import screen_axis_bucket

@@ -431,53 +431,25 @@ class StreamingSolver(SolverBackend):
                 pinned.append((i, _WARM_CLAIM_PREFIX + str(ci), labels))
 
         # residual world: real nodes with pinned consumption folded into the
-        # overhead side, surviving claims as joinable pseudo-nodes
-        sub_nodes: List[NodeInfo] = []
+        # overhead side, surviving claims as joinable pseudo-nodes — the
+        # shared construction streaming/residual.py states (the incremental
+        # consolidation screen pins the same world at the FFDState level)
+        from karpenter_tpu.streaming.residual import (
+            claim_pseudo_node,
+            pinned_node_residuals,
+        )
+
         pinned_by_bin: Dict[str, List[int]] = {}
         for i, bin_name, _ in pinned:
             pinned_by_bin.setdefault(bin_name, []).append(i)
-        for n in nodes:
-            overhead = dict(n.daemon_overhead)
-            ports = list(n.host_ports)
-            for i in pinned_by_bin.get(n.name, ()):
-                overhead = res.merge(overhead, {**res.pod_requests(pods[i]), res.PODS: 1.0})
-                ports.extend(get_host_ports(pods[i]))
-            sub_nodes.append(
-                NodeInfo(
-                    name=n.name,
-                    requirements=n.requirements.copy(),
-                    taints=n.taints,
-                    available=dict(n.available),
-                    daemon_overhead=overhead,
-                    host_ports=ports,
-                    volume_used=dict(n.volume_used),
-                    volume_limits=dict(n.volume_limits),
-                )
-            )
+        sub_nodes: List[NodeInfo] = pinned_node_residuals(
+            nodes, pods, pinned_by_bin
+        )
         for ci, pl in sorted(surviving_claims.items()):
-            name = _WARM_CLAIM_PREFIX + str(ci)
-            reqs = pl.requirements.copy()
-            reqs.add(Requirement(wk.LABEL_HOSTNAME, IN, [name]))
-            # conservative capacity: a joining pod must fit EVERY surviving
-            # instance type, so actuation keeps its full choice set
-            alloc = None
-            for ti in pl.instance_type_indices:
-                a = instance_types[ti].allocatable()
-                alloc = a if alloc is None else {
-                    k: min(alloc.get(k, float("inf")), a.get(k, float("inf")))
-                    for k in set(alloc) | set(a)
-                }
-            ports = []
-            for i in pl.pod_indices:
-                ports.extend(get_host_ports(pods[i]))
             sub_nodes.append(
-                NodeInfo(
-                    name=name,
-                    requirements=reqs,
-                    taints=templates[pl.template_index].taints,
-                    available=alloc or {},
-                    daemon_overhead=dict(pl.requests),
-                    host_ports=ports,
+                claim_pseudo_node(
+                    ci, pl, pods, instance_types, templates,
+                    prefix=_WARM_CLAIM_PREFIX,
                 )
             )
 

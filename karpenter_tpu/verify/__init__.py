@@ -39,16 +39,23 @@ from karpenter_tpu.verify.gate import (
     gate_relaxed,
     make_context,
 )
-from karpenter_tpu.verify.incremental import IncrementalScope, incremental_gate
+from karpenter_tpu.verify.incremental import (
+    IncrementalScope,
+    ScreenLaneScope,
+    incremental_gate,
+    screen_lane_gate,
+)
 
 __all__ = [
     "GateContext",
     "GateOutcome",
     "IncrementalScope",
+    "ScreenLaneScope",
     "audit_frac",
     "enabled",
     "full_gate",
     "gate_relaxed",
     "incremental_gate",
+    "screen_lane_gate",
     "make_context",
 ]

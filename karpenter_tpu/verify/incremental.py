@@ -100,3 +100,71 @@ def incremental_gate(
         )
         GATE_AUDIT.inc({"outcome": "mismatch" if audit_hit else "match"})
     return violations
+
+
+# -- residual-screen lane gate -------------------------------------------------
+
+
+@dataclasses.dataclass
+class ScreenLaneScope:
+    """What one residual-screen dispatch changed per lane
+    (disruption/screen_delta.py): which pod rows each lane re-solved and
+    which node rows it deleted. Everything else came carried from the base
+    world, whose solve went through the solver's own gates."""
+
+    resident_mask: "np.ndarray"  # bool [B, P] rows the lane re-solved
+    masked_nodes: "np.ndarray"  # bool [B, N] node rows the lane deleted
+
+
+def screen_lane_gate(
+    kinds,
+    indexes,
+    scope: ScreenLaneScope,
+    *,
+    node_requests=None,
+    node_avail=None,
+    carried_node_requests=None,
+    eps: float = 1e-4,
+):
+    """Row-scoped check of a residual-screen result: bool[B], True = lane
+    verdict publishable. Structural checks are unconditional and free (the
+    kinds/index arrays are already on host for verdict decode): no resident
+    placed onto a node its own lane deleted, and every node placement's
+    index is in range. When verification is enabled AND the caller fetched
+    the state tensors, a capacity recheck rides along: accumulated node
+    requests fit available capacity on surviving rows, and deleted rows'
+    accounting is bit-equal to the carried base world (nothing leaked onto a
+    dead node). A failed lane is not an error — the caller re-scores it
+    through the full screen and counts it as gate-mismatch, so a residual
+    bug costs one extra solve, never a wrong verdict."""
+    import numpy as np
+
+    from karpenter_tpu.metrics.registry import GATE_DURATION, measure
+    from karpenter_tpu.ops.ffd import KIND_NODE
+
+    with measure(GATE_DURATION, {"mode": "screen-lane"}):
+        kinds = np.asarray(kinds)
+        indexes = np.asarray(indexes)
+        B = kinds.shape[0]
+        N = scope.masked_nodes.shape[1]
+        placed_node = scope.resident_mask & (kinds == KIND_NODE)
+        in_range = (indexes >= 0) & (indexes < N)
+        idx = np.clip(indexes, 0, max(N - 1, 0))
+        on_masked = scope.masked_nodes[np.arange(B)[:, None], idx]
+        ok = ~np.any(placed_node & (~in_range | on_masked), axis=1)
+        if node_requests is not None:
+            node_requests = np.asarray(node_requests)
+            node_avail = np.asarray(node_avail)
+            carried = np.asarray(carried_node_requests)
+            # surviving rows: accumulated requests (daemon overhead included,
+            # ops/ffd_core.initial_state) must fit availability; deleted and
+            # pad rows carry avail < 0 and are exempt from the fit check
+            fits = np.where(
+                node_avail >= 0.0,
+                node_requests <= node_avail + eps,
+                True,
+            )
+            ok &= np.all(fits, axis=(1, 2))
+            untouched = np.all(node_requests == carried[None], axis=2)
+            ok &= np.all(~scope.masked_nodes | untouched, axis=1)
+        return ok

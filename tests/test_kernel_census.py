@@ -23,6 +23,7 @@ from tools.kernel_census import (
     narrow_jaxpr_eqns,
     policy_scorer_jaxpr_eqns,
     relax_jaxpr_eqns,
+    residual_screen_jaxpr_eqns,
     shard_jaxpr_eqns,
 )
 
@@ -70,6 +71,14 @@ POLICY_SCORER_EQN_BUDGET = 50
 # scaffolding. It is lane-count invariant: more partitions widen the batch,
 # never the program
 SHARD_EQN_BUDGET = 3900
+
+# round-20 residual-lane screen body (KARPENTER_TPU_SCREEN_DELTA): measured
+# 3754 at the round-20 commit. This is the WHOLE per-dispatch program — the
+# shared run-trim rebuild plus one vmapped lane body (runs scan included) —
+# so like the shard body it sits a bit above one narrow iteration. It is
+# lane-count AND run-window invariant: more lanes widen the vmap batch and
+# more touched runs lengthen the scan's xs, never the program
+RESIDUAL_EQN_BUDGET = 4000
 
 
 @pytest.fixture(scope="module")
@@ -403,3 +412,57 @@ class TestShardBudget:
         assert shard_jaxpr_eqns(census_problem, lanes=8) == shard_jaxpr_eqns(
             census_problem, lanes=16
         )
+
+
+class TestScreenDeltaBudget:
+    """Round-20 incremental consolidation screen: the residual-lane program
+    gets its own pinned budget, and the flag must not touch the narrow body
+    — the delta path lives at the scorer seam (disruption/batch.py
+    score_subsets), so KARPENTER_TPU_SCREEN_DELTA=1 SELECTS a different
+    program (parallel/mesh.py _residual_screen_jit) rather than editing any
+    solve kernel. The narrow body pinned here is the same one the base-world
+    solve (solve_ffd_sweeps_carried) and the full-screen fallback run."""
+
+    def test_residual_program_under_budget(self, census_problem):
+        eqns = residual_screen_jaxpr_eqns(census_problem)
+        assert eqns <= RESIDUAL_EQN_BUDGET, (
+            f"residual-lane screen body grew to {eqns} jaxpr eqns "
+            f"(budget {RESIDUAL_EQN_BUDGET}); every consolidation lane pays "
+            f"this per dispatch — see tools/kernel_census.py "
+            f"residual_screen_jaxpr_eqns to attribute the growth"
+        )
+
+    def test_residual_budget_is_tight(self, census_problem):
+        eqns = residual_screen_jaxpr_eqns(census_problem)
+        assert eqns >= RESIDUAL_EQN_BUDGET * 0.8, (
+            f"residual-lane screen body shrank to {eqns} jaxpr eqns — nice! "
+            f"tighten RESIDUAL_EQN_BUDGET to keep the guard meaningful"
+        )
+
+    def test_delta_flag_on_narrow_body_unchanged(self, census_problem):
+        """With the delta subsystem imported AND the flag forced on, the
+        flag-off narrow body must still count EXACTLY 2394 equations — the
+        incremental screen selects its own program at the scorer seam and
+        rides the UNMODIFIED runs/sweeps kernels for both the base world and
+        the residual lanes."""
+        from karpenter_tpu.disruption import screen_delta  # noqa: F401
+
+        old = os.environ.get("KARPENTER_TPU_SCREEN_DELTA")
+        os.environ["KARPENTER_TPU_SCREEN_DELTA"] = "1"
+        try:
+            assert screen_delta.enabled()
+            assert narrow_jaxpr_eqns(census_problem, wavefront=0) == 2394
+        finally:
+            if old is None:
+                os.environ.pop("KARPENTER_TPU_SCREEN_DELTA", None)
+            else:
+                os.environ["KARPENTER_TPU_SCREEN_DELTA"] = old
+
+    def test_lane_and_run_invariant(self, census_problem):
+        """The per-dispatch body must not grow with the lane batch or the
+        touched-run window — the economics of the delta path: more
+        candidates widen the vmap, more touched runs lengthen the scan xs,
+        never the program."""
+        assert residual_screen_jaxpr_eqns(
+            census_problem, lanes=4, runs=4
+        ) == residual_screen_jaxpr_eqns(census_problem, lanes=8, runs=8)

@@ -127,6 +127,9 @@ class TestRowFromBench:
             "coldstart_2500_s": 14.0,
             "first_solve_s": 1.7,
             "consolidation_per_s": 200.0,
+            # round 20: the same value under its own banded name (the legacy
+            # alias above stays for pre-round-20 history rows)
+            "consolidation_candidates_per_sec": 200.0,
             "device_peak_bytes_2500": 123456,
         }
         assert json.loads(json.dumps(row)) == row

@@ -1,0 +1,537 @@
+"""Incremental consolidation screen (KARPENTER_TPU_SCREEN_DELTA): the
+residual-lane path must publish verdicts BIT-IDENTICAL to the full screen —
+its contract is "a delta bug costs latency, never a wrong consolidation
+decision" (disruption/screen_delta.py). This suite proves the three legs:
+
+  - verdict parity: flag-on == flag-off on every field of every verdict,
+    fuzzed over seeded corpora (prefix ladders, random subsets, base-pod
+    variants) and cross-checked against the sequential simulate path — the
+    same oracle tests/test_batch.py holds the full screen to;
+  - classified standdowns: one test per reason in the taxonomy, each
+    asserting BOTH the classification (counter/stats) and that the fallback
+    verdicts still match the full screen;
+  - flag-off inertness: with the flag off the delta path is never entered
+    and the published stats are the full screen's.
+
+The kernel-side half of the contract (flag-on leaves the narrow body at
+EXACTLY its flag-off equation count) lives in tests/test_kernel_census.py.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.objects import (
+    DO_NOT_SCHEDULE,
+    LabelSelector,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.disruption import screen_delta
+from karpenter_tpu.disruption.batch import UnionScorer, build_bench_scorer
+from karpenter_tpu.metrics.registry import SCREEN_DELTA
+
+from tests.factories import make_pod
+
+
+def verdict_key(v):
+    return (
+        v.all_pods_scheduled,
+        v.n_new_claims,
+        sorted(v.replacement_its or []),
+        sorted(v.replacement_zones or []),
+        sorted(v.replacement_cts or []),
+    )
+
+
+def score_both(monkeypatch, make_scorer, subsets):
+    """(full_verdicts, delta_verdicts, delta_stats) for the same subsets on
+    two fresh scorers — fresh so neither path sees the other's caches."""
+    monkeypatch.setenv("KARPENTER_TPU_SCREEN_DELTA", "0")
+    full = make_scorer().score_subsets(subsets, mesh=None)
+    monkeypatch.setenv("KARPENTER_TPU_SCREEN_DELTA", "1")
+    scorer = make_scorer()
+    delta = scorer.score_subsets(subsets, mesh=None)
+    monkeypatch.setenv("KARPENTER_TPU_SCREEN_DELTA", "0")
+    return full, delta, scorer.last_screen_stats
+
+
+def assert_parity(full, delta):
+    assert len(full) == len(delta)
+    for bi, (f, d) in enumerate(zip(full, delta)):
+        assert verdict_key(f) == verdict_key(d), (
+            f"lane {bi}: delta verdict {verdict_key(d)} != full "
+            f"{verdict_key(f)} — the residual screen published a different "
+            f"consolidation decision, which the contract forbids"
+        )
+
+
+def pinned_base_pods(n=6, cpu=2.0):
+    """Base pods hostname-pinned to the roomy survivors: they exercise the
+    carried base-world solve without ever landing on a candidate node
+    (no base-on-candidate standdown) and sort BEFORE every resident
+    (cpu 2.0 > the residents' 0.1-0.5, no resident-order standdown)."""
+    return [
+        make_pod(
+            name=f"base-{i}",
+            cpu=cpu,
+            node_selector={wk.LABEL_HOSTNAME: f"big-node-{i % 8}"},
+        )
+        for i in range(n)
+    ]
+
+
+class TestVerdictParity:
+    def test_prefix_ladder_no_base_pods(self, monkeypatch):
+        """The bench shape itself: every prefix of the candidate list, no
+        pending pods (base world = the plain initial state)."""
+        n = 32
+        subsets = [list(range(k + 1)) for k in range(n)]
+        full, delta, stats = score_both(
+            monkeypatch, lambda: build_bench_scorer(n)[0], subsets
+        )
+        assert_parity(full, delta)
+        assert stats["mode"] == "delta"
+        assert stats["fallback_lanes"] == 0, stats["standdowns"]
+        assert stats["delta_lanes"] == n
+
+    def test_random_subsets_seeded_fuzz(self, monkeypatch):
+        """Random subsets over multiple corpus seeds: the parity must hold
+        for arbitrary membership patterns, not just prefixes."""
+        for corpus_seed in (7, 11):
+            n = 24
+            rng = random.Random(100 + corpus_seed)
+            subsets = [
+                sorted(rng.sample(range(n), rng.randint(1, 6)))
+                for _ in range(30)
+            ]
+            full, delta, stats = score_both(
+                monkeypatch,
+                lambda: build_bench_scorer(n, rng_seed=corpus_seed)[0],
+                subsets,
+            )
+            assert_parity(full, delta)
+            assert stats["fallback_lanes"] == 0, stats["standdowns"]
+
+    def test_parity_with_carried_base_world(self, monkeypatch):
+        """Pending pods present: the delta path must solve them once through
+        the carried sweeps entry and pin their consumption for every lane —
+        parity here is the whole prefix-decomposability argument."""
+        n = 16
+        subsets = [list(range(k + 1)) for k in range(n)] + [[3, 7], [0, 5, 9]]
+        full, delta, stats = score_both(
+            monkeypatch,
+            lambda: build_bench_scorer(n, base_pods=pinned_base_pods())[0],
+            subsets,
+        )
+        assert_parity(full, delta)
+        assert stats["mode"] == "delta"
+        assert stats["delta_lanes"] == len(subsets), stats["standdowns"]
+
+    def test_delta_reuses_base_world_across_calls(self, monkeypatch):
+        """ScreenSession probes one scorer repeatedly; the base world must be
+        solved once and reused, with parity on every later call."""
+        monkeypatch.setenv("KARPENTER_TPU_SCREEN_DELTA", "1")
+        n = 12
+        scorer, _, _ = build_bench_scorer(n, base_pods=pinned_base_pods(3))
+        first = scorer.score_subsets([[0], [1]], mesh=None)
+        world = scorer._delta_ctx._world
+        assert world is not None
+        second = scorer.score_subsets([[0, 1], [2]], mesh=None)
+        assert scorer._delta_ctx._world is world  # cached, not re-solved
+        monkeypatch.setenv("KARPENTER_TPU_SCREEN_DELTA", "0")
+        ref, _, _ = build_bench_scorer(n, base_pods=pinned_base_pods(3))
+        assert_parity(ref.score_subsets([[0], [1]], mesh=None), first)
+        assert_parity(ref.score_subsets([[0, 1], [2]], mesh=None), second)
+
+
+class TestSequentialOracle:
+    """The delta screen against the ORACLE the full screen answers to: the
+    sequential simulate-and-price path (tests/test_batch.py holds the
+    flag-off screen to the same corpus)."""
+
+    def test_delta_screen_matches_sequential(self, monkeypatch):
+        from karpenter_tpu.apis.nodepool import Budget, Disruption
+        from karpenter_tpu.disruption.batch import build_scorer
+        from karpenter_tpu.disruption.consolidation import (
+            MultiNodeConsolidation,
+            sort_candidates,
+        )
+        from karpenter_tpu.disruption.helpers import get_candidates
+        from karpenter_tpu.disruption.types import DECISION_NONE
+
+        from tests.factories import make_nodepool
+        from tests.harness import Env
+
+        env = Env()
+        env.create(
+            make_nodepool(
+                disruption=Disruption(
+                    consolidation_policy="WhenUnderutilized",
+                    budgets=[Budget(nodes="100%")],
+                )
+            )
+        )
+        env.create_candidate_node(
+            "n1", it_name="small-instance-type", pods=[make_pod(name="a", cpu=0.1)]
+        )
+        env.create_candidate_node(
+            "n2", it_name="small-instance-type", pods=[make_pod(name="b", cpu=0.2)]
+        )
+        env.create_candidate_node(
+            "n3", it_name="default-instance-type", pods=[make_pod(name="c", cpu=3.5)]
+        )
+        env.create_candidate_node(
+            "n-host", it_name="default-instance-type", pods=[make_pod(name="d", cpu=1.0)]
+        )
+        method = MultiNodeConsolidation(env.provisioner, env.clock)
+        ordered = sort_candidates(
+            get_candidates(
+                env.clock, env.kube, env.cluster, env.cloud_provider,
+                method.should_disrupt,
+            )
+        )
+        assert len(ordered) == 4
+        seq = [
+            method.compute_consolidation(ordered[: k + 1]).decision
+            != DECISION_NONE
+            for k in range(len(ordered))
+        ]
+        monkeypatch.setenv("KARPENTER_TPU_SCREEN_DELTA", "1")
+        scorer = build_scorer(env.provisioner, ordered)
+        assert scorer is not None
+        verdicts = scorer.score_subsets(
+            [list(range(k + 1)) for k in range(len(ordered))], mesh=None
+        )
+        scr = [
+            v.consolidatable_with(ordered[: k + 1], scorer.inputs.instance_types)
+            for k, v in enumerate(verdicts)
+        ]
+        assert scr == seq, f"delta screen {scr} != sequential {seq}"
+        assert any(seq) and not all(seq)  # both verdict kinds exercised
+
+
+class TestClassifiedStanddowns:
+    """One test per taxonomy entry: the reason must be CLASSIFIED (counter +
+    stats), and the fallback verdicts must still match the full screen —
+    standing down is allowed, silently diverging is not."""
+
+    def _batch_standdown(self, monkeypatch, base_pods, reason, n=8):
+        subsets = [list(range(k + 1)) for k in range(n)]
+        before = SCREEN_DELTA.value({"outcome": reason})
+        full, delta, stats = score_both(
+            monkeypatch,
+            lambda: build_bench_scorer(n, base_pods=base_pods)[0],
+            subsets,
+        )
+        assert_parity(full, delta)
+        # batch-level standdown: the delta path returned None and the FULL
+        # screen produced the published stats
+        assert stats["mode"] == "full"
+        assert SCREEN_DELTA.value({"outcome": reason}) == before + len(subsets)
+
+    def test_standdown_topology(self, monkeypatch):
+        """A zonal DoNotSchedule spread makes placement multi-pass; residual
+        lanes carry the base census, so the whole batch must stand down."""
+        spread_pods = [
+            make_pod(
+                name=f"spread-{i}",
+                cpu=0.2,
+                labels={"spread": "s"},
+                topology_spread=[
+                    TopologySpreadConstraint(
+                        max_skew=1,
+                        topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                        when_unsatisfiable=DO_NOT_SCHEDULE,
+                        label_selector=LabelSelector(match_labels={"spread": "s"}),
+                    )
+                ],
+            )
+            for i in range(3)
+        ]
+        self._batch_standdown(
+            monkeypatch, spread_pods, "standdown-topology"
+        )
+
+    def test_standdown_ports(self, monkeypatch):
+        """Any host-port reservation can collide differently across the
+        candidate boundary; the whole batch must stand down."""
+        port_pods = [make_pod(name="portly", cpu=0.2, host_ports=[8080])]
+        self._batch_standdown(monkeypatch, port_pods, "standdown-ports")
+
+    def test_standdown_pool(self, monkeypatch):
+        """A finite NodePool limit makes claim opens drain shared pool state;
+        the whole batch must stand down."""
+        from karpenter_tpu.apis.nodepool import Budget, Disruption
+        from karpenter_tpu.disruption.batch import build_scorer
+        from karpenter_tpu.disruption.consolidation import (
+            MultiNodeConsolidation,
+            sort_candidates,
+        )
+        from karpenter_tpu.disruption.helpers import get_candidates
+
+        from tests.factories import make_nodepool
+        from tests.harness import Env
+
+        env = Env()
+        env.create(
+            make_nodepool(
+                limits={"cpu": 100.0},
+                disruption=Disruption(
+                    consolidation_policy="WhenUnderutilized",
+                    budgets=[Budget(nodes="100%")],
+                ),
+            )
+        )
+        env.create_candidate_node(
+            "f1", it_name="small-instance-type", pods=[make_pod(name="fa", cpu=0.1)]
+        )
+        env.create_candidate_node(
+            "f-host", it_name="default-instance-type", pods=[make_pod(name="fb", cpu=1.0)]
+        )
+        method = MultiNodeConsolidation(env.provisioner, env.clock)
+        ordered = sort_candidates(
+            get_candidates(
+                env.clock, env.kube, env.cluster, env.cloud_provider,
+                method.should_disrupt,
+            )
+        )
+        assert ordered
+        subsets = [[0]]
+        monkeypatch.setenv("KARPENTER_TPU_SCREEN_DELTA", "0")
+        full = build_scorer(env.provisioner, ordered).score_subsets(
+            subsets, mesh=None
+        )
+        before = SCREEN_DELTA.value({"outcome": "standdown-pool"})
+        monkeypatch.setenv("KARPENTER_TPU_SCREEN_DELTA", "1")
+        scorer = build_scorer(env.provisioner, ordered)
+        delta = scorer.score_subsets(subsets, mesh=None)
+        assert_parity(full, delta)
+        assert scorer.last_screen_stats["mode"] == "full"
+        assert (
+            SCREEN_DELTA.value({"outcome": "standdown-pool"})
+            == before + len(subsets)
+        )
+
+    def test_standdown_base_on_candidate(self, monkeypatch):
+        """Unpinned fat base pods land on the first candidate nodes
+        (first-fit), so lanes deleting those nodes must stand down per lane
+        while untouched lanes still take the residual path."""
+        n = 8
+        base = [make_pod(name=f"fat-{i}", cpu=3.0) for i in range(2)]
+        subsets = [[0], [1], [0, 1], [4], [5], [4, 5]]
+        full, delta, stats = score_both(
+            monkeypatch,
+            lambda: build_bench_scorer(n, base_pods=base)[0],
+            subsets,
+        )
+        assert_parity(full, delta)
+        assert stats["mode"] == "delta"
+        assert stats["standdowns"].get("standdown-base-on-candidate", 0) >= 3
+        assert stats["delta_lanes"] >= 1  # the mix: some lanes stay residual
+
+    def test_standdown_resident_order(self, monkeypatch):
+        """Base pods TINIER than every resident sort after them in the FFD
+        queue, so 'base first, residents after' is not the interleaved order
+        and every lane must stand down per lane."""
+        n = 6
+        tiny = [
+            make_pod(
+                name=f"tiny-{i}",
+                cpu=0.05,
+                node_selector={wk.LABEL_HOSTNAME: f"big-node-{i}"},
+            )
+            for i in range(2)
+        ]
+        subsets = [[0], [1], [2], [0, 1]]
+        full, delta, stats = score_both(
+            monkeypatch,
+            lambda: build_bench_scorer(n, base_pods=tiny)[0],
+            subsets,
+        )
+        assert_parity(full, delta)
+        assert stats["mode"] == "delta"
+        assert stats["standdowns"].get("standdown-resident-order", 0) == len(
+            subsets
+        )
+        assert stats["delta_lanes"] == 0
+
+    def test_standdown_resident_overflow(self, monkeypatch):
+        """With the touched-run cap forced to 1, any lane whose residents
+        span more than one run must stand down per lane."""
+        monkeypatch.setenv("KARPENTER_TPU_SCREEN_DELTA_MAX_RUNS", "1")
+        assert screen_delta.max_residual_runs() == 1
+        n = 12
+        subsets = [list(range(n))]  # the widest lane: every candidate's pods
+        full, delta, stats = score_both(
+            monkeypatch, lambda: build_bench_scorer(n)[0], subsets
+        )
+        assert_parity(full, delta)
+        assert stats["standdowns"].get("standdown-resident-overflow", 0) == 1
+
+    def test_delta_outcome_counted(self, monkeypatch):
+        """Residual-eligible lanes land in the 'delta' outcome bucket —
+        the A/B observability the flag decision rides on."""
+        before = SCREEN_DELTA.value({"outcome": "delta"})
+        n = 8
+        subsets = [[k] for k in range(n)]
+        _, _, stats = score_both(
+            monkeypatch, lambda: build_bench_scorer(n)[0], subsets
+        )
+        assert stats["delta_lanes"] == n
+        assert SCREEN_DELTA.value({"outcome": "delta"}) == before + n
+
+
+class TestFlagOff:
+    def test_flag_off_never_enters_delta_path(self, monkeypatch):
+        """Flag off, the delta scorer path must not run at all — zero
+        overhead, and trivially bit-identical."""
+        monkeypatch.setenv("KARPENTER_TPU_SCREEN_DELTA", "0")
+
+        def boom(self, *a, **k):  # pragma: no cover - must not be reached
+            raise AssertionError("delta path entered with flag off")
+
+        monkeypatch.setattr(UnionScorer, "_score_subsets_delta", boom)
+        scorer, _, _ = build_bench_scorer(8)
+        verdicts = scorer.score_subsets([[0], [1, 2]], mesh=None)
+        assert len(verdicts) == 2
+        assert scorer.last_screen_stats["mode"] == "full"
+        assert scorer._delta_ctx is None
+
+    def test_flag_off_stats_schema(self, monkeypatch):
+        """The telemetry split exists in BOTH modes (bench.py schema columns
+        read it unconditionally)."""
+        monkeypatch.setenv("KARPENTER_TPU_SCREEN_DELTA", "0")
+        scorer, _, _ = build_bench_scorer(8)
+        scorer.score_subsets([[0], [1]], mesh=None)
+        stats = scorer.last_screen_stats
+        for key in ("screen_shared_ms", "screen_lane_ms", "resident_counts"):
+            assert key in stats, key
+
+
+class TestLaneGate:
+    """verify.screen_lane_gate unit surface: fabricated violations must fail
+    the lane (which the scorer then classifies as gate-mismatch and re-scores
+    through the full screen)."""
+
+    def _clean(self, B=2, P=6, N=3, R=2):
+        from karpenter_tpu.ops.ffd import KIND_NODE
+
+        from karpenter_tpu import verify
+
+        kinds = np.full((B, P), 9, dtype=np.int32)  # inert rows
+        idxs = np.full((B, P), -1, dtype=np.int32)
+        resident = np.zeros((B, P), dtype=bool)
+        masked = np.zeros((B, N), dtype=bool)
+        resident[:, 0] = True
+        kinds[:, 0] = KIND_NODE
+        idxs[:, 0] = 1  # resident placed on node 1
+        masked[:, 2] = True  # node 2 deleted in every lane
+        scope = verify.ScreenLaneScope(resident_mask=resident, masked_nodes=masked)
+        return kinds, idxs, scope
+
+    def test_clean_lanes_pass(self):
+        from karpenter_tpu import verify
+
+        kinds, idxs, scope = self._clean()
+        assert verify.screen_lane_gate(kinds, idxs, scope).all()
+
+    def test_placement_on_masked_node_fails(self):
+        from karpenter_tpu import verify
+
+        kinds, idxs, scope = self._clean()
+        idxs[1, 0] = 2  # lane 1's resident lands on its own deleted node
+        ok = verify.screen_lane_gate(kinds, idxs, scope)
+        assert ok[0] and not ok[1]
+
+    def test_out_of_range_index_fails(self):
+        from karpenter_tpu import verify
+
+        kinds, idxs, scope = self._clean()
+        idxs[0, 0] = 7  # beyond the node axis
+        ok = verify.screen_lane_gate(kinds, idxs, scope)
+        assert not ok[0] and ok[1]
+
+    def test_deep_capacity_violation_fails(self):
+        from karpenter_tpu import verify
+
+        kinds, idxs, scope = self._clean(N=3, R=2)
+        B, N, R = 2, 3, 2
+        carried = np.zeros((N, R))
+        reqs = np.zeros((B, N, R))
+        avail = np.full((B, N, R), 4.0)
+        reqs[1, 1, 0] = 5.0  # lane 1 books more than node 1 holds
+        ok = verify.screen_lane_gate(
+            kinds, idxs, scope,
+            node_requests=reqs, node_avail=avail, carried_node_requests=carried,
+        )
+        assert ok[0] and not ok[1]
+
+    def test_deep_masked_row_drift_fails(self):
+        from karpenter_tpu import verify
+
+        kinds, idxs, scope = self._clean(N=3, R=2)
+        B, N, R = 2, 3, 2
+        carried = np.zeros((N, R))
+        reqs = np.zeros((B, N, R))
+        avail = np.full((B, N, R), 4.0)
+        reqs[0, 2, 0] = 0.5  # lane 0 booked capacity on its DELETED node 2
+        ok = verify.screen_lane_gate(
+            kinds, idxs, scope,
+            node_requests=reqs, node_avail=avail, carried_node_requests=carried,
+        )
+        assert not ok[0] and ok[1]
+
+    def test_gate_mismatch_lane_falls_back(self, monkeypatch):
+        """A lane the gate rejects must be re-scored through the full screen
+        (classified gate-mismatch), still ending with parity."""
+        from karpenter_tpu import verify
+
+        monkeypatch.setenv("KARPENTER_TPU_SCREEN_DELTA", "0")
+        n = 8
+        subsets = [[0], [1], [2]]
+        full = build_bench_scorer(n)[0].score_subsets(subsets, mesh=None)
+
+        real_gate = verify.screen_lane_gate
+
+        def veto_first(kinds, idxs, scope, **kw):
+            ok = real_gate(kinds, idxs, scope, **kw)
+            ok = np.asarray(ok).copy()
+            ok[0] = False
+            return ok
+
+        before = SCREEN_DELTA.value({"outcome": "gate-mismatch"})
+        monkeypatch.setattr(verify, "screen_lane_gate", veto_first)
+        monkeypatch.setenv("KARPENTER_TPU_SCREEN_DELTA", "1")
+        scorer = build_bench_scorer(n)[0]
+        delta = scorer.score_subsets(subsets, mesh=None)
+        assert_parity(full, delta)
+        stats = scorer.last_screen_stats
+        assert stats["standdowns"].get("gate-mismatch") == 1
+        assert stats["fallback_lanes"] == 1
+        assert SCREEN_DELTA.value({"outcome": "gate-mismatch"}) == before + 1
+
+
+class TestPlanMechanics:
+    def test_residual_run_bucket_ladder(self):
+        assert screen_delta.residual_run_bucket(0) == 4
+        assert screen_delta.residual_run_bucket(4) == 4
+        assert screen_delta.residual_run_bucket(5) >= 5
+        b9 = screen_delta.residual_run_bucket(9)
+        assert b9 >= 9 and (b9 - 9) / 9 <= 0.25  # eighth-pow2: bounded waste
+
+    def test_plan_touches_only_member_runs(self, monkeypatch):
+        """The lane plan's touched-run sets must cover exactly the member
+        candidates' resident rows — the delta path's residual program never
+        sees any other run."""
+        monkeypatch.setenv("KARPENTER_TPU_SCREEN_DELTA", "1")
+        scorer, _, _ = build_bench_scorer(10)
+        scorer.score_subsets([[0]], mesh=None)  # builds+caches the context
+        ctx = scorer._delta_ctx
+        world = ctx.base_world(scorer)
+        plan = ctx.plan_lanes(scorer, [[2, 5], [7]], world)
+        for bi, subset in enumerate([[2, 5], [7]]):
+            rows = np.concatenate([scorer.cand_rows[c] for c in subset])
+            runs = set(ctx.run_of_row[rows].tolist())
+            assert runs == set(np.flatnonzero(plan.touched[bi]).tolist())

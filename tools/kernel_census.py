@@ -252,6 +252,47 @@ def gate_jaxpr_eqns(problem=None, C: int = 16) -> int:
     return _count_jaxpr_eqns(jaxpr)
 
 
+def residual_screen_jaxpr_eqns(problem=None, C: int = 16, lanes: int = 4,
+                               runs: int = 4) -> int:
+    """Flattened jaxpr equation count of the residual-lane screen program
+    (parallel/mesh.py _residual_screen_jit, KARPENTER_TPU_SCREEN_DELTA).
+    This is the per-dispatch body of the incremental consolidation screen:
+    a shared run-trimmed problem rebuilt once, then a vmap over the lane
+    variants (node mask + resident rows). Like the shard program the count
+    is lane-count invariant (vmap traces one lane's body); ``lanes`` and
+    ``runs`` only set the batch/window the trace sees. Pinned by
+    tests/test_kernel_census.py, which also proves KARPENTER_TPU_SCREEN_DELTA=1
+    leaves the narrow body untouched — the delta flag SELECTS this program
+    at the scorer seam, it never edits the solve kernels."""
+    import jax
+    import jax.numpy as jnp
+
+    from karpenter_tpu.ops.ffd_core import _pad_lanes_mult32, initial_state
+    from karpenter_tpu.ops.ffd_runs import max_run_bucket
+    from karpenter_tpu.parallel.mesh import _residual_screen_jit
+
+    if problem is None:
+        problem = build_census_problem(claim_slots=C)
+    padded = _pad_lanes_mult32(jax.device_put(problem))
+    carried = initial_state(padded, C)
+    B = lanes
+    variants = (
+        jnp.broadcast_to(padded.node_avail, (B,) + padded.node_avail.shape),
+        jnp.broadcast_to(padded.pod_active, (B,) + padded.pod_active.shape),
+    )
+    RN = padded.run_start.shape[0]
+    run_idx = jnp.where(jnp.arange(runs) < RN, jnp.arange(runs), -1).astype(
+        jnp.int32
+    )
+    mr = max_run_bucket(padded)
+    jaxpr = jax.make_jaxpr(
+        lambda b, cr, v, ri: _residual_screen_jit.__wrapped__(
+            b, cr, v, ri, mr, False
+        )
+    )(padded, carried, variants, run_idx)
+    return _count_jaxpr_eqns(jaxpr)
+
+
 def shard_jaxpr_eqns(problem=None, C: int = 16, lanes: int = 8, wavefront: int = 0) -> int:
     """Flattened jaxpr equation count of the WHOLE mesh-partitioned solve
     program (parallel/mesh.py shard_sweeps_program, KARPENTER_TPU_SHARD).
@@ -339,6 +380,9 @@ def main(argv):
     policy_eqns = policy_scorer_jaxpr_eqns(problem, C)
     print(f"  jaxpr_eqns_policy    = {policy_eqns}  (learned-ordering scorer, "
           f"once per solve)")
+    residual_eqns = residual_screen_jaxpr_eqns(problem, C)
+    print(f"  jaxpr_eqns_residual  = {residual_eqns}  (residual-lane screen "
+          f"body, per dispatch)")
     try:
         shard_eqns = shard_jaxpr_eqns(problem, C)
         print(f"  jaxpr_eqns_shard     = {shard_eqns}  (whole mesh-partitioned "

@@ -65,6 +65,12 @@ DEFAULT_BANDS = {
     "coldstart_2500_s": (LOWER_BETTER, 3.0),
     "first_solve_s": (LOWER_BETTER, 3.0),
     "consolidation_per_s": (HIGHER_BETTER, 4.0),
+    # round-20 incremental screen: the consolidation rate under its OWN
+    # schema name gates against its own window at 2x — tighter than the
+    # legacy 4x alias above, because the residual-lane path made the number
+    # steady enough to hold (docs/PERF_NOTES.md round 20). The alias stays
+    # for old history rows; new rows carry both names from the same value.
+    "consolidation_candidates_per_sec": (HIGHER_BETTER, 2.0),
     # exec-to-answer with AOT restore + journal on (bench.py restart
     # scenario). Old rows simply lack the field and the gate skips it.
     "restart_recovery_s": (LOWER_BETTER, 3.0),
@@ -138,6 +144,16 @@ def row_from_bench(out: dict, label: str = "run") -> dict:
         "first_solve_s": out.get("first_solve_after_start_s"),
         "restart_recovery_s": out.get("restart_recovery_s"),
         "consolidation_per_s": out.get("consolidation_candidates_per_sec"),
+        # schema v2, round 20: the same value under its own banded name
+        # (2x window, see DEFAULT_BANDS) plus the screen's shared/lane wall
+        # split so a band trip can be attributed to host build vs device
+        # lanes without re-running the bench
+        "consolidation_candidates_per_sec": out.get(
+            "consolidation_candidates_per_sec"
+        ),
+        "screen_mode": out.get("screen_mode"),
+        "screen_shared_ms": out.get("screen_shared_ms"),
+        "screen_lane_ms": out.get("screen_lane_ms"),
         "device_peak_bytes_2500": out.get("device_peak_bytes_2500"),
         # schema v2: per-run UnschedulableReason histogram and the explain
         # pass's cost as a fraction of solve wall (acceptance: <= 0.05)
