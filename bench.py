@@ -769,6 +769,127 @@ def run_child():
     except Exception as exc:  # a broken scenario must not kill the grid run
         emit({"event": "churn", "error": repr(exc)})
 
+    # DeviceWorld steady-state churn scenario (streaming/device_world.py,
+    # KARPENTER_TPU_DEVICE_WORLD): the same kind of seeded arrival+delete
+    # stream, ~4% churn per cycle, driven through the DEVICE path with the
+    # world resident. The measured number is the HOST-INCLUSIVE cycle wall —
+    # encode + patch + fused dispatch + decode + verify, everything a
+    # controller reconcile pays — because the resident-world win is mostly a
+    # host-side one (no full re-encode, no full H2D, one dispatch instead of
+    # three) and a device-only number would hide exactly the cost it
+    # removes. p50 is taken over PATCHED cycles only; adopt cycles are the
+    # counted exception (cold_solves) — their count leaking up, not their
+    # wall, is the regression signal. The legacy control replays the
+    # byte-identical stream with the flag off.
+    try:
+        import statistics as _stats
+
+        from karpenter_tpu.streaming.churn import (
+            ChurnConfig,
+            ChurnProcess,
+            default_pod_factory,
+        )
+
+        dw_pods = 400 if os.environ.get("BENCH_QUICK") else 10000
+        dw_cycles = 8 if os.environ.get("BENCH_QUICK") else 24
+        _dw_env = {}
+        # fake-catalog templates are limitless, which makes phase-1
+        # relaxation applicable and would stand the resident path down every
+        # cycle (docs/SERVING.md: DeviceWorld users run KARPENTER_TPU_RELAX=0)
+        for k, v in (("KARPENTER_TPU_DEVICE_WORLD", "1"),
+                     ("KARPENTER_TPU_RELAX", "0")):
+            _dw_env[k] = os.environ.get(k)
+            os.environ[k] = v
+        try:
+            crng = random.Random(21)
+            initial = [
+                default_pod_factory(f"dw-{i}", crng) for i in range(dw_pods)
+            ]
+            cfg = ChurnConfig(
+                seed=21,
+                arrivals_per_cycle=dw_pods * 2 // 100,
+                deletes_per_cycle=dw_pods * 2 // 100,
+            )
+            dw_solver = JaxSolver()
+            proc = ChurnProcess(list(initial), config=cfg)
+            dw_cycle_recs = []
+            dw_result = None
+            for cyc in range(dw_cycles):
+                if cyc:
+                    proc.step()
+                t0 = time.perf_counter()
+                dw_result = dw_solver.solve(proc.pods, its, [tpl])
+                wall_ms = (time.perf_counter() - t0) * 1e3
+                dw = dw_solver._device_world
+                dw_cycle_recs.append({
+                    "wall_ms": wall_ms,
+                    "outcome": dw.last_outcome if dw is not None else "off",
+                    "detail": dict(dw.last_cycle) if dw is not None else {},
+                })
+            dw = dw_solver._device_world
+            steady = [
+                r for r in dw_cycle_recs
+                if r["outcome"] in ("patched", "repatched")
+            ]
+            os.environ["KARPENTER_TPU_DEVICE_WORLD"] = "0"
+            legacy_solver = JaxSolver()
+            lproc = ChurnProcess(list(initial), config=cfg)
+            legacy_ms = []
+            for cyc in range(dw_cycles):
+                if cyc:
+                    lproc.step()
+                t0 = time.perf_counter()
+                legacy_solver.solve(lproc.pods, its, [tpl])
+                legacy_ms.append((time.perf_counter() - t0) * 1e3)
+            ev = {
+                "event": "device_churn",
+                "pods": dw_pods,
+                "cycles": dw_cycles,
+                "churn_frac": round(
+                    (cfg.arrivals_per_cycle + cfg.deletes_per_cycle)
+                    / dw_pods, 4
+                ),
+                "outcomes": dict(dw.counters) if dw is not None else {},
+                "cold_solves": dw.cold_solves if dw is not None else None,
+                "scheduled_last": dw_result.num_scheduled(),
+            }
+            if steady:
+                walls = sorted(r["wall_ms"] for r in steady)
+                p50 = _stats.median(walls)
+                ev["cycle_host_ms_p50"] = round(p50, 2)
+                ev["cycle_host_ms_p99"] = round(
+                    walls[min(len(walls) - 1, int(0.99 * len(walls)))], 2
+                )
+                # phase split + telemetry of the patched cycles, from the
+                # DeviceWorld's own clock (obs: last_cycle)
+                for key in ("encode_ms", "patch_ms", "solve_ms", "decode_ms"):
+                    ev[f"steady_{key}_p50"] = round(
+                        _stats.median(r["detail"][key] for r in steady), 2
+                    )
+                ev["overlap_frac_mean"] = round(
+                    _stats.mean(r["detail"]["overlap_frac"] for r in steady), 4
+                )
+                ev["donated_bytes_p50"] = int(
+                    _stats.median(r["detail"]["donated_bytes"] for r in steady)
+                )
+                ev["world_bytes"] = steady[-1]["detail"]["world_bytes"]
+                # legacy control p50 skips cycle 0 (compile) so both arms
+                # compare steady-state against steady-state
+                legacy_steady = sorted(legacy_ms[1:])
+                if legacy_steady:
+                    lp50 = _stats.median(legacy_steady)
+                    ev["legacy_cycle_host_ms_p50"] = round(lp50, 2)
+                    ev["speedup_vs_legacy"] = round(lp50 / max(p50, 1e-9), 2)
+            emit(ev)
+        finally:
+            for k, v in _dw_env.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+    except Exception as exc:  # a broken scenario must not kill the grid run
+        emit({"event": "device_churn", "error": repr(exc)})
+
     # multi-tenant serve scenario (serve/): N concurrent tenant streams
     # multiplexed over ONE dispatcher vs the same problems solved
     # sequentially. The dispatcher serializes device access, so the ratio
@@ -1443,6 +1564,21 @@ def main():
         out["churn_outcomes"] = churn.get("outcomes")
         if "delta_encode_speedup" in churn:
             out["churn_delta_encode_speedup"] = churn["delta_encode_speedup"]
+    dchurn = next(
+        (e for e in events if e.get("event") == "device_churn"), None
+    )
+    if dchurn is not None and "error" not in dchurn:
+        # round-21 DeviceWorld columns (streaming/device_world.py,
+        # docs/SERVING.md): host-inclusive steady-state cycle wall through
+        # the resident path (the perf_gate-banded number), cold-solve count
+        # (the steady-state-leak signal, reported not banded), and the A/B
+        # vs the flag-off legacy control on the byte-identical stream
+        out["churn_cycle_host_ms"] = dchurn.get("cycle_host_ms_p50")
+        out["churn_cycle_host_p99_ms"] = dchurn.get("cycle_host_ms_p99")
+        out["churn_cold_solves"] = dchurn.get("cold_solves")
+        out["device_world_speedup"] = dchurn.get("speedup_vs_legacy")
+        out["device_world_overlap_frac"] = dchurn.get("overlap_frac_mean")
+        out["device_world_outcomes"] = dchurn.get("outcomes")
     serve = next((e for e in events if e.get("event") == "serve"), None)
     if serve is not None and "error" not in serve:
         # multi-tenant serve columns (serve/, docs/SERVING.md): aggregate

@@ -329,6 +329,16 @@ WARM_SOLVES = REGISTRY.counter(
     "cold-first, cold-threshold, cold-unsupported, cold-world-changed) and, "
     "under the multi-tenant serve layer, tenant",
 )
+WORLD_PATCH = REGISTRY.counter(
+    "solver_world_patch_total",
+    "Device-resident world cycles (KARPENTER_TPU_DEVICE_WORLD) by outcome: "
+    "patched/repatched (delta applied as an on-device row patch into the "
+    "donated carried world), adopt-* (cold world re-uploaded, suffixed with "
+    "the delta cold reason or shape/node-axis drift), or standdown-* "
+    "(classified reason — the legacy host path served the cycle: "
+    "unsupported-args, topology, not-sweeps, runs-mode, shard, order-policy, "
+    "relax-applicable, slot-overflow, gate-reject, error)",
+)
 
 # -- multi-tenant serve series (serve/, KARPENTER_TPU_SERVE) -------------------
 # The tenant label on these (and on solver_circuit_state,

@@ -376,11 +376,15 @@ class ProgramRegistry:
     def sample_memory(
         self, carried_bytes: int = 0, pods: Optional[int] = None,
         cycle: Optional[str] = None, donated_bytes: int = 0,
+        world_bytes: int = 0,
     ) -> Optional[Dict]:
         """One per-solve-cycle sample: live/peak device bytes + the carried
         FFDState footprint + the bytes donation reclaimed in place this
-        cycle. Feeds the solver_device_bytes gauge and the bounded sample
-        ring in /debug/programs."""
+        cycle. ``world_bytes`` is the resident DeviceWorld problem
+        (KARPENTER_TPU_DEVICE_WORLD) — carried device state like the
+        FFDState, so it reports under the same carried_state gauge kind and
+        gets its own sample field. Feeds the solver_device_bytes gauge and
+        the bounded sample ring in /debug/programs."""
         from karpenter_tpu.metrics.registry import DEVICE_BYTES
 
         live, peak, how = self._device_memory()
@@ -392,6 +396,8 @@ class ProgramRegistry:
             "donated_bytes": int(donated_bytes),
             "source": how,
         }
+        if world_bytes:
+            sample["world_bytes"] = int(world_bytes)
         if pods is not None:
             sample["pods"] = int(pods)
         if cycle is not None:
@@ -400,7 +406,9 @@ class ProgramRegistry:
             self._memory.append(sample)
         DEVICE_BYTES.set(live, {"kind": "live"})
         DEVICE_BYTES.set(peak, {"kind": "peak"})
-        DEVICE_BYTES.set(int(carried_bytes), {"kind": "carried_state"})
+        DEVICE_BYTES.set(
+            int(carried_bytes) + int(world_bytes), {"kind": "carried_state"}
+        )
         DEVICE_BYTES.set(int(donated_bytes), {"kind": "donated"})
         return sample
 
@@ -570,12 +578,14 @@ def begin_dispatch(
 def sample_memory(
     carried_bytes: int = 0, pods: Optional[int] = None,
     cycle: Optional[str] = None, donated_bytes: int = 0,
+    world_bytes: int = 0,
 ) -> Optional[Dict]:
     """Module-level convenience with the off-path short-circuit."""
     if not enabled():
         return None
     return registry().sample_memory(
-        carried_bytes, pods=pods, cycle=cycle, donated_bytes=donated_bytes
+        carried_bytes, pods=pods, cycle=cycle, donated_bytes=donated_bytes,
+        world_bytes=world_bytes,
     )
 
 

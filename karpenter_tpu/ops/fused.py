@@ -1,0 +1,334 @@
+"""Device-resident world programs: jitted delta patching + fused solve/gate.
+
+Two programs back the ``DeviceWorld`` handle (streaming/device_world.py):
+
+``patch_world``
+    Applies a ``DeltaEncoder`` row splice (streaming/delta.py) ON DEVICE: the
+    previous cycle's padded ``SchedulingProblem`` is DONATED and rewritten in
+    place from a small ``PatchArgs`` bundle — a gather index over surviving
+    rows, a fresh-row stack for arrivals/spec-changes, and the full (tiny)
+    run tables. Pad rows are synthesized from the same deterministic fills
+    ``ops/padding.pad_problem`` uses, so the patched device world is
+    bit-identical to ``pad_problem(spliced_host_problem)`` by construction —
+    the invariant tests/test_device_world.py fuzzes array-for-array.
+
+``solve_ffd_fused_gate``
+    The fresh sweeps solve (ops/ffd_sweeps._sweeps_impl) with the device
+    verification gate (verify/device._gate_impl) traced into the SAME
+    program: one dispatch returns (FFDResult, invariant counts). The gate
+    args are built on device from the final FFDState — the solver's own
+    claim rows, surviving instance types, and accumulated requests — so
+    verification reads exactly what the solve committed. The host screen,
+    skew check, and sampled float64 audit (verify/gate.py) still run on the
+    decoded result; the fused counts only displace the separate gate
+    dispatch.
+
+Both keep the flag-off programs untouched: they are NEW entry points the
+DeviceWorld path selects, never edits of ``_solve_ffd_sweeps_fresh_jit`` or
+``_gate_jit`` (the kernel-census pins in tests/test_kernel_census.py hold the
+line).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from karpenter_tpu.models.problem import (
+    GT_NONE,
+    LT_NONE,
+    ReqTensor,
+    SchedulingProblem,
+)
+from karpenter_tpu.ops.ffd_core import (
+    KIND_CLAIM,
+    KIND_NEW_CLAIM,
+    KIND_NODE,
+    _pad_lanes_mult32,
+    initial_state,
+)
+from karpenter_tpu.ops.padding import pow2_bucket
+
+
+class PatchArgs(NamedTuple):
+    """Everything one device row-patch ships: O(P) index/mask lanes, a
+    bucketed fresh-row stack per pod-axis leaf, and the full run tables
+    (small — host ``segment_runs`` output depends on neighbouring rows, so
+    it is recomputed host-side and shipped whole). All arrays are in PADDED
+    coordinates; the stack's tail rows hold pad_problem's fill constants so
+    pad rows and fresh rows share one gather."""
+
+    gather_idx: Any  # i32[P] previous-world row per surviving row
+    use_fresh: Any  # bool[P] row comes from the fresh stack (incl. pad rows)
+    fresh_sel: Any  # i32[P] stack row for fresh/pad rows
+    # fresh-row stacks [S, ...tail buckets...]
+    req_admitted: Any
+    req_comp: Any
+    req_gt: Any
+    req_lt: Any
+    req_defined: Any
+    strict_admitted: Any
+    strict_comp: Any
+    strict_gt: Any
+    strict_lt: Any
+    strict_defined: Any
+    requests: Any  # f32[S, R]
+    tol_tpl: Any  # bool[S, TPL]
+    tol_node: Any  # bool[S, N]
+    ports: Any  # bool[S, PT]
+    port_conflict: Any  # bool[S, PT]
+    vol_counts: Any  # i32[S, D]
+    grp_match: Any  # bool[S, G] (G=0 on every patchable world)
+    grp_selects: Any
+    grp_owned: Any
+    # full-ship small arrays
+    pod_active: Any  # bool[P]
+    eqprev: Any  # bool[P]
+    eqprev_gate: Any  # bool[P]
+    eqprev_chain: Any  # bool[P]
+    run_start: Any  # i32[RN]
+    run_len: Any  # i32[RN]
+    run_mode: Any  # i32[RN]
+
+
+# pad_problem's constant fills for the pod-axis leaves (ops/padding.py). The
+# fuzz suite holds these to the source of truth: any drift from pad_problem
+# breaks patched-vs-cold bit identity and fails tests/test_device_world.py.
+_REQ_FILLS = {
+    "admitted": False,
+    "comp": True,
+    "gt": GT_NONE,
+    "lt": LT_NONE,
+    "defined": False,
+}
+
+
+def build_patch_args(
+    spliced: SchedulingProblem, rows_prev: np.ndarray, resident: SchedulingProblem
+) -> PatchArgs:
+    """Host-side plan build (numpy): map the delta encoder's row splice onto
+    the resident padded world. ``spliced`` is the UNPADDED patched problem the
+    DeltaEncoder produced (the bit-identity reference), ``rows_prev`` its
+    per-row previous-world index (-1 = freshly encoded), ``resident`` the
+    device world whose leaf shapes fix every tail bucket. The caller has
+    already proven the pod/node/lane buckets match (streaming/device_world.py
+    adopt-on-drift)."""
+    P_cur = int(np.asarray(spliced.pod_requests).shape[0])
+    Pb = int(resident.pod_requests.shape[0])
+    rows_prev = np.asarray(rows_prev, dtype=np.int64)
+    fresh_pos = np.where(rows_prev < 0)[0]
+    F = len(fresh_pos)
+    # S > F always: the stack's tail rows ARE the pad-row template
+    S = pow2_bucket(F + 1, lo=8)
+
+    gather_idx = np.zeros(Pb, dtype=np.int32)
+    gather_idx[:P_cur] = np.maximum(rows_prev, 0)
+    use_fresh = np.ones(Pb, dtype=bool)  # pad rows gather the fill row
+    use_fresh[:P_cur] = rows_prev < 0
+    fresh_sel = np.full(Pb, F, dtype=np.int32)
+    fresh_sel[fresh_pos] = np.arange(F, dtype=np.int32)
+
+    def stack(arr, tail, fill):
+        arr = np.asarray(arr)
+        out = np.full((S,) + tuple(tail), fill, dtype=arr.dtype)
+        sub = arr[fresh_pos]
+        out[(slice(0, F),) + tuple(slice(0, d) for d in sub.shape[1:])] = sub
+        return out
+
+    def req_stacks(src: ReqTensor, ref: ReqTensor):
+        return {
+            f: stack(getattr(src, f), ref_leaf.shape[1:], _REQ_FILLS[f])
+            for f, ref_leaf in (
+                ("admitted", ref.admitted),
+                ("comp", ref.comp),
+                ("gt", ref.gt),
+                ("lt", ref.lt),
+                ("defined", ref.defined),
+            )
+        }
+
+    reqs = req_stacks(spliced.pod_reqs, resident.pod_reqs)
+    strict = req_stacks(spliced.pod_strict_reqs, resident.pod_strict_reqs)
+
+    def full(arr, length, fill):
+        arr = np.asarray(arr)
+        out = np.full((length,), fill, dtype=arr.dtype)
+        out[: arr.shape[0]] = arr
+        return out
+
+    RNb = pow2_bucket(int(np.asarray(spliced.run_len).shape[0]), lo=4)
+    return PatchArgs(
+        gather_idx=gather_idx,
+        use_fresh=use_fresh,
+        fresh_sel=fresh_sel,
+        req_admitted=reqs["admitted"],
+        req_comp=reqs["comp"],
+        req_gt=reqs["gt"],
+        req_lt=reqs["lt"],
+        req_defined=reqs["defined"],
+        strict_admitted=strict["admitted"],
+        strict_comp=strict["comp"],
+        strict_gt=strict["gt"],
+        strict_lt=strict["lt"],
+        strict_defined=strict["defined"],
+        requests=stack(
+            spliced.pod_requests, resident.pod_requests.shape[1:], 0.0
+        ),
+        tol_tpl=stack(spliced.pod_tol_tpl, resident.pod_tol_tpl.shape[1:], False),
+        tol_node=stack(
+            spliced.pod_tol_node, resident.pod_tol_node.shape[1:], False
+        ),
+        ports=stack(spliced.pod_ports, resident.pod_ports.shape[1:], False),
+        port_conflict=stack(
+            spliced.pod_port_conflict, resident.pod_port_conflict.shape[1:], False
+        ),
+        vol_counts=stack(
+            spliced.pod_vol_counts, resident.pod_vol_counts.shape[1:], 0
+        ),
+        grp_match=stack(spliced.pod_grp_match, resident.pod_grp_match.shape[1:], False),
+        grp_selects=stack(
+            spliced.pod_grp_selects, resident.pod_grp_selects.shape[1:], False
+        ),
+        grp_owned=stack(
+            spliced.pod_grp_owned, resident.pod_grp_owned.shape[1:], False
+        ),
+        pod_active=full(spliced.pod_active, Pb, False),
+        eqprev=full(spliced.pod_eqprev, Pb, False),
+        eqprev_gate=full(spliced.pod_eqprev_gate, Pb, False),
+        eqprev_chain=full(spliced.pod_eqprev_chain, Pb, False),
+        run_start=full(spliced.run_start, RNb, 0),
+        run_len=full(spliced.run_len, RNb, 0),
+        run_mode=full(spliced.run_mode, RNb, 1),
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _patch_world_jit(prev: SchedulingProblem, args: PatchArgs) -> SchedulingProblem:
+    """Rewrite the pod-axis leaves of the donated world in place: surviving
+    rows gather from the previous buffer, fresh/pad rows gather from the
+    shipped stack. Non-pod leaves pass through the donation untouched (the
+    patch preconditions proved them unchanged)."""
+
+    def rows(prev_leaf, stk):
+        mask = args.use_fresh.reshape((-1,) + (1,) * (prev_leaf.ndim - 1))
+        return jnp.where(mask, stk[args.fresh_sel], prev_leaf[args.gather_idx])
+
+    def req_rows(prev_t: ReqTensor, names) -> ReqTensor:
+        a, c, g, l, d = names
+        return ReqTensor(
+            admitted=rows(prev_t.admitted, a),
+            comp=rows(prev_t.comp, c),
+            gt=rows(prev_t.gt, g),
+            lt=rows(prev_t.lt, l),
+            defined=rows(prev_t.defined, d),
+        )
+
+    return dataclasses.replace(
+        prev,
+        pod_reqs=req_rows(
+            prev.pod_reqs,
+            (args.req_admitted, args.req_comp, args.req_gt, args.req_lt,
+             args.req_defined),
+        ),
+        pod_strict_reqs=req_rows(
+            prev.pod_strict_reqs,
+            (args.strict_admitted, args.strict_comp, args.strict_gt,
+             args.strict_lt, args.strict_defined),
+        ),
+        pod_requests=rows(prev.pod_requests, args.requests),
+        pod_tol_tpl=rows(prev.pod_tol_tpl, args.tol_tpl),
+        pod_tol_node=rows(prev.pod_tol_node, args.tol_node),
+        pod_ports=rows(prev.pod_ports, args.ports),
+        pod_port_conflict=rows(prev.pod_port_conflict, args.port_conflict),
+        pod_vol_counts=rows(prev.pod_vol_counts, args.vol_counts),
+        pod_grp_match=rows(prev.pod_grp_match, args.grp_match),
+        pod_grp_selects=rows(prev.pod_grp_selects, args.grp_selects),
+        pod_grp_owned=rows(prev.pod_grp_owned, args.grp_owned),
+        pod_active=args.pod_active,
+        pod_eqprev=args.eqprev,
+        pod_eqprev_gate=args.eqprev_gate,
+        pod_eqprev_chain=args.eqprev_chain,
+        run_start=args.run_start,
+        run_len=args.run_len,
+        run_mode=args.run_mode,
+    )
+
+
+def patch_world(prev: SchedulingProblem, args: PatchArgs) -> SchedulingProblem:
+    """Named entry for the device row patch — the name keys the program
+    cache, the AOT executable table (solver/aot.py), and the registry row."""
+    return _patch_world_jit(prev, args)
+
+
+patch_world._donates_carry = True  # the world is consumed in place
+
+
+def fused_gate_counts(problem, kind, index, state, pod_check, max_claims, gate_bf):
+    """The fused program's verification epilogue, traceable standalone (the
+    kernel census pins it separately from the narrow loop body): build
+    GateArgs from the final FFDState and run the invariant reduction.
+
+    The claim rows checked here are the solver's own requirement state —
+    including the minted hostname pin the published rows drop — so the gate
+    is consistent-by-construction with the solve; the decoded RESULT is still
+    covered by the host screen + skew + sampled audit (verify/gate.py)."""
+    from karpenter_tpu.verify.device import GateArgs, _gate_impl, gate_problem
+
+    C = int(max_claims)
+    on_claim = (kind == KIND_CLAIM) | (kind == KIND_NEW_CLAIM)
+    on_node = kind == KIND_NODE
+    pod_bin = jnp.where(
+        on_claim, index, jnp.where(on_node, C + index, -1)
+    ).astype(jnp.int32)
+    ga = GateArgs(
+        claim_req=state.claim_req,
+        claim_tpl=state.claim_tpl,
+        claim_active=state.claim_open,
+        claim_reported=state.claim_requests,
+        claim_its=state.claim_it_ok,
+        claim_has_reqs=state.claim_open,
+        pod_bin=pod_bin,
+        pod_check=pod_check,
+    )
+    return _gate_impl(gate_problem(problem), ga, bool(gate_bf))
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
+def _solve_ffd_fused_gate_jit(
+    problem: SchedulingProblem,
+    pod_check,
+    max_claims: int,
+    bounds_free: bool = False,
+    wavefront: int = 0,
+    gate_bf: bool = False,
+):
+    """Fresh sweeps solve + device gate in ONE dispatch. The world is NOT
+    donated here — it stays resident for the next cycle's patch (the patch
+    program owns the donation)."""
+    from karpenter_tpu.ops.ffd_sweeps import _sweeps_impl
+
+    problem = _pad_lanes_mult32(problem)
+    result = _sweeps_impl(
+        problem, initial_state(problem, max_claims), max_claims,
+        bounds_free, wavefront,
+    )
+    counts = fused_gate_counts(
+        problem, result.kind, result.index, result.state, pod_check,
+        max_claims, gate_bf,
+    )
+    return result, counts
+
+
+def solve_ffd_fused_gate(
+    problem, pod_check, max_claims, bounds_free=False, wavefront=0, gate_bf=False
+):
+    """Named entry for the fused solve+gate program (see patch_world)."""
+    return _solve_ffd_fused_gate_jit(
+        problem, pod_check, int(max_claims), bool(bounds_free), int(wavefront),
+        bool(gate_bf),
+    )

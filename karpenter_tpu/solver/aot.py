@@ -255,6 +255,33 @@ def _call_spec(solve_name: str, problem, max_claims: int, init) -> Optional[_Spe
             (problem, ga),
             (f"C{int(max_claims)}", f"bf{int(bf)}", "gate"),
         )
+    if solve_name == "solve_ffd_fused_gate":
+        # the DeviceWorld fused solve+gate dispatch (ops/fused.py): ``init``
+        # carries (pod_check, bounds_free, wavefront, gate_bounds_free) — the
+        # caller derived all three statics from the unpadded spliced problem,
+        # so respect them rather than rederiving from the padded world
+        from karpenter_tpu.ops.fused import _solve_ffd_fused_gate_jit
+
+        pod_check, bf, wf, gbf = init
+        return _Spec(
+            _solve_ffd_fused_gate_jit,
+            (problem, pod_check, int(max_claims), bool(bf), int(wf), bool(gbf)),
+            (problem, pod_check),
+            (f"C{int(max_claims)}", f"bf{int(bf)}", f"wf{int(wf)}",
+             f"gbf{int(gbf)}", "fused"),
+        )
+    if solve_name == "patch_world":
+        # the DeviceWorld row patch (ops/fused.py): donation of the carried
+        # world survives lowering, so the AOT-served call reclaims the prior
+        # world's buffers exactly like the plain jit dispatch
+        from karpenter_tpu.ops.fused import _patch_world_jit
+
+        return _Spec(
+            _patch_world_jit,
+            (problem, init),
+            (problem, init),
+            (f"C{int(max_claims)}", "patch"),
+        )
     if solve_name == "solve_ffd":
         from karpenter_tpu.ops.ffd_step import _solve_ffd_fresh_jit, _solve_ffd_jit
 

@@ -181,6 +181,48 @@ def decode_claim_requirements(meta, adm_row, comp_row, gt_row, lt_row, defined_r
     return out
 
 
+def decode_claim_placements(out, meta, max_claims, np_final, pod_kinds) -> None:
+    """Final bin-state decode shared by the per-pass path below and the
+    device-resident fused path (streaming/device_world.py): turn the fetched
+    claim tensors into published Placements and route every placed pod to its
+    node or claim. ``np_final`` is the 9-tuple fetched off the final FFDState
+    (claim_open, claim_tpl, claim_it_ok, claim_requests, then the five
+    claim_req leaves); None means no claim state exists (nothing placed on
+    claims)."""
+    slot_to_claim = {}
+    if np_final is not None:
+        (claim_open, claim_tpl, claim_it_ok, claim_requests,
+         claim_adm, claim_comp, claim_gt, claim_lt, claim_def) = np_final
+        for slot in range(max_claims):
+            if slot < len(claim_open) and claim_open[slot]:
+                tpl_idx = int(claim_tpl[slot])
+                placement = Placement(
+                    template_index=tpl_idx,
+                    nodepool_name=meta.template_names[tpl_idx],
+                    instance_type_indices=[
+                        int(t)
+                        for t in np.flatnonzero(claim_it_ok[slot])
+                        if t < len(meta.instance_type_names)
+                    ],
+                    requirements=decode_claim_requirements(
+                        meta, claim_adm[slot], claim_comp[slot],
+                        claim_gt[slot], claim_lt[slot], claim_def[slot],
+                    ),
+                    requests={
+                        name: float(claim_requests[slot, ri])
+                        for ri, name in enumerate(meta.resource_names)
+                        if claim_requests[slot, ri] > 0
+                    },
+                )
+                slot_to_claim[slot] = placement
+                out.new_claims.append(placement)
+    for orig, (kind, index) in pod_kinds.items():
+        if kind == KIND_NODE:
+            out.node_pods.setdefault(meta.node_names[index], []).append(orig)
+        else:
+            slot_to_claim[index].pod_indices.append(orig)
+
+
 def _remap_group_state(state, old_keys, new_keys, padded_problem):
     """Rebuild grp_counts/grp_registered for a changed group set: carried rows
     move to their new position (matched by group hash); new groups take their
@@ -246,6 +288,17 @@ class JaxSolver(SolverBackend):
         # lanes, pad_frac, ...} on success, {"reason": <classified>} on a
         # standdown, None when the shard path never ran
         self.last_shard = None
+        # device-resident continuous-solve handle (KARPENTER_TPU_DEVICE_WORLD,
+        # streaming/device_world.py): constructed on the first enabled cycle,
+        # dropped via reset_streaming_state. Flag off, stays None forever.
+        self._device_world = None
+
+    def reset_streaming_state(self) -> None:
+        """Quarantine/rejection hook (supervisor._reset_streaming): drop the
+        device-resident world and its delta state so a rejected result can
+        never seed the next patched cycle. No-op when DeviceWorld never ran."""
+        if self._device_world is not None:
+            self._device_world.reset()
 
     def solve(
         self,
@@ -261,6 +314,10 @@ class JaxSolver(SolverBackend):
     ) -> SolveResult:
         if not pods:
             return SolveResult()
+        # DeviceWorld eligibility must see the CALLER's domains: the derived
+        # default below is what a cold solve would use anyway, so it never
+        # blocks the resident path — only explicitly threaded domains do
+        caller_domains = domains
         if domains is None:
             domains = domains_from_instance_types(instance_types, templates)
 
@@ -281,6 +338,25 @@ class JaxSolver(SolverBackend):
         with trace.cycle(
             "solve", backend=type(self).__name__, passthrough=True, pods=len(pods)
         ), self._dispatch_device(len(pods), len(nodes)):
+            if _os.environ.get("KARPENTER_TPU_DEVICE_WORLD", "0") not in ("", "0"):
+                # device-resident continuous solve (streaming/device_world.py):
+                # the encoded world stays in donated device buffers across
+                # cycles; deltas are applied as jitted row patches and ONE
+                # fused dispatch returns solve + gate counts + decode tensors.
+                # None = classified standdown (solver_world_patch_total) —
+                # fall through to the legacy path unchanged. Lazy import:
+                # flag off, the subsystem is never even loaded.
+                from karpenter_tpu.streaming import device_world
+
+                if self._device_world is None:
+                    self._device_world = device_world.DeviceWorld(self)
+                resident = self._device_world.try_solve(
+                    pods, instance_types, templates, nodes,
+                    pod_requirements_override, topology, cluster_pods,
+                    caller_domains, pod_volumes, max_claims,
+                )
+                if resident is not None:
+                    return resident
             if _os.environ.get("KARPENTER_TPU_SHARD", "0") not in ("", "0"):
                 # partitioned fleet-scale path (KARPENTER_TPU_SHARD): split
                 # the batch into independent sub-problems and run them as ONE
@@ -856,51 +932,18 @@ class JaxSolver(SolverBackend):
         # -- decode final bin state (single batched fetch, see device_get note)
         t_dec = _now()
         with trace.span("decode", final=True):
-            if state is not None and np_final is not None:
-                (claim_open, claim_tpl, claim_it_ok, claim_requests,
-                 claim_adm, claim_comp, claim_gt, claim_lt, claim_def) = np_final
-            elif state is not None:
-                fetched = jax.device_get(
+            if state is not None and np_final is None:
+                np_final = jax.device_get(
                     (state.claim_open, state.claim_tpl, state.claim_it_ok,
                      state.claim_requests, state.claim_req.admitted,
                      state.claim_req.comp, state.claim_req.gt,
                      state.claim_req.lt, state.claim_req.defined)
                 )
-                TRANSFER_BYTES.inc({"direction": "d2h"}, _nbytes(fetched))
-                (claim_open, claim_tpl, claim_it_ok, claim_requests,
-                 claim_adm, claim_comp, claim_gt, claim_lt, claim_def) = fetched
-            else:
-                claim_open, claim_tpl, claim_it_ok, claim_requests = np.zeros(0), None, None, None
-                claim_adm = claim_comp = claim_gt = claim_lt = claim_def = None
-            slot_to_claim = {}
-            for slot in range(max_claims):
-                if slot < len(claim_open) and claim_open[slot]:
-                    tpl_idx = int(claim_tpl[slot])
-                    placement = Placement(
-                        template_index=tpl_idx,
-                        nodepool_name=meta.template_names[tpl_idx],
-                        instance_type_indices=[
-                            int(t)
-                            for t in np.flatnonzero(claim_it_ok[slot])
-                            if t < len(meta.instance_type_names)
-                        ],
-                        requirements=decode_claim_requirements(
-                            meta, claim_adm[slot], claim_comp[slot],
-                            claim_gt[slot], claim_lt[slot], claim_def[slot],
-                        ),
-                        requests={
-                            name: float(claim_requests[slot, ri])
-                            for ri, name in enumerate(meta.resource_names)
-                            if claim_requests[slot, ri] > 0
-                        },
-                    )
-                    slot_to_claim[slot] = placement
-                    out.new_claims.append(placement)
-            for orig, (kind, index) in pod_kinds.items():
-                if kind == KIND_NODE:
-                    out.node_pods.setdefault(meta.node_names[index], []).append(orig)
-                else:
-                    slot_to_claim[index].pod_indices.append(orig)
+                TRANSFER_BYTES.inc({"direction": "d2h"}, _nbytes(np_final))
+            decode_claim_placements(
+                out, meta, max_claims,
+                np_final if state is not None else None, pod_kinds,
+            )
         _t("final-decode", t_dec)
         # per-solve-cycle device-memory watermark (KARPENTER_TPU_PROGRAMS):
         # live/peak device bytes + the carried FFDState footprint — the

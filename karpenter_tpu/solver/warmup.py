@@ -239,6 +239,85 @@ def _warm_gate(result, pods, its, tpls) -> None:
         pass
 
 
+def prewarm_device_world(
+    solver=None,
+    pod_buckets: Sequence[int] = (9, 33),
+    instance_types_n: int = 100,
+    catalog=None,
+) -> int:
+    """Compile the DeviceWorld programs (ops/fused.py: the patched-scatter
+    ``patch_world`` and the fused ``solve_ffd_fused_gate``) at the standard
+    pod buckets by driving two real flag-on cycles per bucket: the first
+    adopts (fused compile), the second swaps one pod so the delta splices a
+    row and the patch program compiles too. No-op unless
+    KARPENTER_TPU_DEVICE_WORLD is on — the programs only exist on that path.
+    The warm templates carry a finite remaining-resource limit so the
+    relax-applicable standdown can't silently skip the compile (relax never
+    fires against limited templates; the executable cache keys on shapes, so
+    production's limitless templates still hit these executables). Returns
+    cycles served by the resident path; both entries also flow through the
+    AOT snapshot table (solver/aot.py) when KARPENTER_TPU_STATE_DIR is set,
+    so a restarted process restores them without a compile."""
+    import dataclasses
+    import random
+
+    from karpenter_tpu.apis.nodepool import NodePool
+    from karpenter_tpu.apis.objects import Container, ObjectMeta, Pod, PodSpec
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.solver.encode import template_from_nodepool
+    from karpenter_tpu.solver.jax_backend import JaxSolver
+    from karpenter_tpu.streaming import device_world
+
+    if not device_world.enabled():
+        return 0
+    if solver is None:
+        solver = JaxSolver()
+    its = catalog if catalog else instance_types(instance_types_n)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="prewarm-world")), its, range(len(its))
+    )
+    tpl = dataclasses.replace(tpl, remaining_resources={"cpu": 1e12})
+    rng = random.Random(2)
+
+    def make(n):
+        return [
+            Pod(
+                metadata=ObjectMeta(name=f"warm-world-{n}-{i}"),
+                spec=PodSpec(
+                    containers=[
+                        Container(requests={"cpu": rng.choice([0.1, 0.5, 1.0])})
+                    ]
+                ),
+            )
+            for i in range(n)
+        ]
+
+    served = 0
+    from karpenter_tpu.obs import trace
+
+    with trace.cycle("warmup", kind="device-world"):
+        for n in pod_buckets:
+            try:
+                pods = make(n)
+                solver.solve(pods, its, [tpl])  # adopt: fused program compiles
+                dw = solver._device_world
+                if dw is None or dw.last_outcome is None or (
+                    dw.last_outcome.startswith("standdown")
+                ):
+                    continue
+                served += 1
+                pods2 = list(pods)
+                pods2[0] = make(1)[0]  # one fresh row: patch program compiles
+                solver.solve(pods2, its, [tpl])
+                if dw.last_outcome in ("patched", "repatched"):
+                    served += 1
+                # the next bucket must re-adopt, not stand down on drift noise
+                solver.reset_streaming_state()
+            except Exception:
+                return served
+    return served
+
+
 def prewarm_screen(n_candidates: int) -> bool:
     """Compile the consolidation screen program for the eighth-pow2
     candidate buckets up to ``n_candidates`` (disruption/batch.py pads the
@@ -531,6 +610,13 @@ def maybe_prewarm_in_background(options, cloud_provider=None) -> Optional["objec
             prewarm_shard(catalog=catalog)
         except Exception:
             log.warning("prewarm: shard warm failed", exc_info=True)
+        try:
+            # device-resident continuous solve (no-op unless
+            # KARPENTER_TPU_DEVICE_WORLD is on): the steady-state churn path
+            # should never pay its first patch/fused compile mid-serving
+            prewarm_device_world(catalog=catalog)
+        except Exception:
+            log.warning("prewarm: device-world warm failed", exc_info=True)
         # the startup compile bill, itemized (obs/programs.py): how many
         # programs the warm compiled, what they cost, and how many came
         # back from the persistent cache instead of a cold trace

@@ -293,6 +293,10 @@ class DeltaEncoder:
         self.encoder = Encoder(**({} if well_known_labels is None else {"well_known_labels": well_known_labels}))
         self._state: Optional[_DeltaState] = None
         self.last_patch: Dict[str, object] = {}
+        # per-row previous-world index of the last _patch (-1 = fresh), or
+        # None after a cold encode — the DeviceWorld path turns this into a
+        # device gather plan (ops/fused.build_patch_args)
+        self.last_rows_prev: Optional[np.ndarray] = None
         self.stats = {"cold": 0, "patched": 0}
 
     def reset(self) -> None:
@@ -350,6 +354,7 @@ class DeltaEncoder:
             **kwargs,
         )
         self.stats["cold"] += 1
+        self.last_rows_prev = None
         self.last_patch = {
             "mode": "cold",
             "reason": reason,
@@ -678,6 +683,7 @@ class DeltaEncoder:
             hostname_key_idx=HOSTNAME_KEY,
         )
         self.stats["patched"] += 1
+        self.last_rows_prev = rows_prev
         self.last_patch = {
             "mode": "patched",
             "reason": "",

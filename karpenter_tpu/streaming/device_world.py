@@ -1,0 +1,546 @@
+"""DeviceWorld: the encoded world lives on device across solve cycles.
+
+The legacy cycle pays host encode -> full H2D -> solve dispatch -> gate
+dispatch -> D2H -> host decode every time, even when the DeltaEncoder
+(streaming/delta.py) proves that only a few pod rows changed. This handle
+keeps the padded ``SchedulingProblem`` RESIDENT in device buffers between
+cycles and turns each supported cycle into:
+
+  1. host delta encode — the existing row splice, which also yields
+     ``last_rows_prev``: the per-row map into the previous world;
+  2. ``patch_world`` (ops/fused.py) — a jitted gather that rewrites the
+     pod-axis leaves of the DONATED resident world in place from a small
+     fresh-row stack (O(changed) H2D instead of O(world));
+  3. ``solve_ffd_fused_gate`` — the sweeps solve and the device verification
+     gate (verify/device.py) in ONE dispatch, returning the placement AND
+     its invariant counts in a single batched fetch; explain attribution
+     reuses the resident tensors when enabled.
+
+Both dispatches are enqueued asynchronously (``KARPENTER_TPU_DEVICE_WORLD_
+PIPELINE``, default on): the host builds the next dispatch's arguments and
+runs its bookkeeping while the device executes, and ``last_cycle`` reports
+the measured overlap fraction. True encode(N+1)-against-solve(N) pipelining
+is bounded by snapshot arrival — the knob controls intra-cycle overlap.
+
+Round-11 discipline throughout: anything the patched path cannot prove is a
+CLASSIFIED standdown to the untouched legacy path
+(``solver_world_patch_total{outcome}``), and any post-solve surprise
+(slot overflow, nonzero gate counts, an exception) additionally drops the
+resident world and the delta state so a stale world can never serve a later
+cycle. A delta bug costs latency, never correctness; the bit-identity fuzz
+in tests/test_device_world.py holds the patched world to ``pad_problem(cold
+encode)`` array-for-array.
+
+Default OFF (``KARPENTER_TPU_DEVICE_WORLD``); flag off, the backend never
+constructs this object and every program it would dispatch stays untraced.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from karpenter_tpu.metrics.registry import (
+    COMPILE_CACHE,
+    TRANSFER_BYTES,
+    WORLD_PATCH,
+)
+from karpenter_tpu.obs import programs, trace
+from karpenter_tpu.ops import relax
+from karpenter_tpu.ops.ffd import (
+    KIND_CLAIM,
+    KIND_NEW_CLAIM,
+    KIND_NODE,
+    KIND_NO_SLOT,
+    IterCounts,
+)
+from karpenter_tpu.ops.ffd_core import problem_bounds_free
+from karpenter_tpu.ops.fused import (
+    build_patch_args,
+    patch_world,
+    solve_ffd_fused_gate,
+)
+from karpenter_tpu.ops.padding import pad_problem, pod_axis_bucket, pow2_bucket
+from karpenter_tpu.provisioning.preferences import Preferences
+from karpenter_tpu.solver import aot, ordering
+from karpenter_tpu.solver.backend import FAIL_INCOMPATIBLE, SolveResult
+from karpenter_tpu.streaming.delta import DeltaEncoder
+
+log = logging.getLogger(__name__)
+
+
+def enabled() -> bool:
+    """KARPENTER_TPU_DEVICE_WORLD, default OFF. Read per call so tests and
+    operators can toggle a live process; the first enabled cycle adopts a
+    world, the first disabled one simply stops consulting it."""
+    return os.environ.get("KARPENTER_TPU_DEVICE_WORLD", "0") not in ("", "0")
+
+
+def pipeline_depth() -> int:
+    """KARPENTER_TPU_DEVICE_WORLD_PIPELINE: 0 synchronizes after every
+    dispatch (debug/measurement baseline); >= 1 (default) enqueues the patch
+    and fused solve asynchronously so host argument-building and bookkeeping
+    overlap device execution."""
+    try:
+        return max(0, int(os.environ.get("KARPENTER_TPU_DEVICE_WORLD_PIPELINE", "1")))
+    except ValueError:
+        return 1
+
+
+def _relax_would_fire(templates) -> bool:
+    """Host mirror of ops/relax.relax_applicable WITHOUT encoding: the dense
+    phase-1 program fires exactly when no template carries a finite remaining
+    limit (tpl_remaining all +inf — solver/encode.py step 7). The fused
+    program has no relax phase, so those cycles stand down BEFORE the delta
+    encoder advances — a post-encode bail would desync the resident world
+    from the delta state."""
+    for t in templates:
+        rr = getattr(t, "remaining_resources", None)
+        if rr and any(np.isfinite(v) for v in rr.values()):
+            return False
+    return True
+
+
+class DeviceWorld:
+    """Per-backend handle owning the resident world, its DeltaEncoder, and
+    the patch/fused dispatch loop. Constructed lazily by JaxSolver on the
+    first enabled cycle; ``reset()`` is wired into the backend's
+    ``reset_streaming_state`` hook so validator rejection or a supervisor
+    quarantine drops the world the same way it drops streaming state."""
+
+    def __init__(self, backend):
+        self.backend = backend
+        self.delta = DeltaEncoder(well_known_labels=backend.well_known)
+        self.world = None  # device-resident padded SchedulingProblem
+        self.meta = None
+        self.node_names: Optional[List[str]] = None
+        self.max_claims: Optional[int] = None
+        # consecutive on-device patches since the last adopt (0 right after
+        # an adopt): the first patch reports "patched", later ones
+        # "repatched" so the steady state is visible at a glance
+        self.patched_streak = 0
+        self.cold_solves = 0  # full-world uploads (adopts) — the counted exception
+        self.cycles = 0  # cycles this handle actually served
+        self.counters: Dict[str, int] = {}
+        self.last_outcome: Optional[str] = None
+        self.last_cycle: Dict[str, float] = {}
+        # uid -> (pod digest, checked_requirements(pod) is not None): the
+        # host's only O(P) per-cycle obligation besides the delta diff
+        self._check_cache: Dict[str, tuple] = {}
+
+    # supervisor/backend reset hook: a quarantined or rejected result must
+    # never leave a stale world to patch against
+    def reset(self) -> None:
+        self.world = None
+        self.meta = None
+        self.node_names = None
+        self.max_claims = None
+        self.patched_streak = 0
+        self.delta.reset()
+        self._check_cache.clear()
+
+    def _record(self, outcome: str) -> None:
+        self.counters[outcome] = self.counters.get(outcome, 0) + 1
+        self.last_outcome = outcome
+        WORLD_PATCH.inc({"outcome": outcome})
+        trace.attr("world_outcome", outcome)
+
+    def _standdown(self, reason: str) -> None:
+        self._record("standdown-" + reason)
+        return None
+
+    # -- entry -----------------------------------------------------------------
+
+    def try_solve(
+        self,
+        pods: Sequence,
+        instance_types: Sequence,
+        templates: Sequence,
+        nodes: Sequence,
+        pod_requirements_override,
+        topology,
+        cluster_pods: Sequence,
+        domains,
+        pod_volumes,
+        max_claims: int,
+    ) -> Optional[SolveResult]:
+        """One cycle through the device-resident path, or None on a
+        classified standdown (the caller's legacy path then serves the cycle
+        unchanged). Every pre-encode standdown leaves BOTH the world and the
+        delta state untouched — they stay in lockstep for the next supported
+        cycle."""
+        from karpenter_tpu.solver import jax_backend as jb
+        from karpenter_tpu.streaming.warm import _has_topology_constraints
+
+        if (
+            pod_requirements_override is not None
+            or topology is not None
+            or len(cluster_pods) > 0
+            or domains is not None
+            or pod_volumes is not None
+        ):
+            return self._standdown("unsupported-args")
+        if jb._USE_RUNS:
+            return self._standdown("runs-mode")
+        if os.environ.get("KARPENTER_TPU_SHARD", "0") not in ("", "0"):
+            return self._standdown("shard")
+        if ordering.lanes_enabled():
+            return self._standdown("order-policy")
+        if any(
+            t.effect == "PreferNoSchedule" for tpl in templates for t in tpl.taints
+        ) or any(Preferences.is_relaxable(p) for p in pods):
+            # the per-pass relax ladder re-encodes between launches; the
+            # resident world models exactly one encode per cycle
+            return self._standdown("not-sweeps")
+        if any(_has_topology_constraints(p) for p in pods):
+            # delta worlds are G=0 by contract (streaming/delta.py)
+            return self._standdown("topology")
+        if relax.enabled() and _relax_would_fire(templates):
+            return self._standdown("relax-applicable")
+
+        try:
+            return self._cycle(pods, instance_types, templates, nodes, max_claims)
+        except Exception as exc:  # noqa: BLE001 — degrade to legacy, drop the world
+            log.warning(
+                "device_world: standdown on error, world dropped: %s: %s",
+                type(exc).__name__, exc, exc_info=True,
+            )
+            self.reset()
+            self._record("standdown-error")
+            return None
+
+    # -- the cycle -------------------------------------------------------------
+
+    def _cycle(self, pods, instance_types, templates, nodes, max_claims):
+        from karpenter_tpu.solver import jax_backend as jb
+
+        backend = self.backend
+        pipelined = pipeline_depth() >= 1
+        t0 = time.perf_counter()
+        with trace.span("encode", queue=len(pods)):
+            encoded = self.delta.encode(
+                pods, instance_types, templates, nodes=nodes,
+                num_claim_slots=max_claims,
+            )
+        spliced, meta = encoded.problem, encoded.meta
+        mode = self.delta.last_patch.get("mode")
+        rows_prev = self.delta.last_rows_prev
+        t_encode = time.perf_counter()
+
+        # -- stage 1: bring the resident world up to date ----------------------
+        donated = 0
+        h2d = 0
+        if mode == "patched" and rows_prev is not None and self.world is not None:
+            drift = self._drift(spliced, nodes, meta, max_claims)
+        else:
+            drift = self.delta.last_patch.get("reason") or "no-world"
+        if drift is None:
+            stage_outcome = "patched" if self.patched_streak == 0 else "repatched"
+            self.patched_streak += 1
+            args = build_patch_args(spliced, rows_prev, self.world)
+            h2d = jb._nbytes(args)
+            donated = jb._nbytes(self.world)
+            key = jb._program_key(patch_world, max_claims, (self.world, args))
+            cache_hit = key in jb._COMPILED_PROGRAMS
+            jb._COMPILED_PROGRAMS.add(key)
+            COMPILE_CACHE.inc({"result": "hit" if cache_hit else "miss"})
+            if cache_hit:
+                backend.compile_cache_hits += 1
+            else:
+                backend.compile_cache_misses += 1
+            TRANSFER_BYTES.inc({"direction": "h2d"}, h2d)
+            aot_handle = aot.maybe_begin(patch_world, self.world, max_claims, args)
+            obs = programs.begin_dispatch(
+                "patch_world", max_claims, (self.world, args)
+            )
+            with trace.span(
+                "patch" if cache_hit else "compile",
+                cache="hit" if cache_hit else "miss",
+                program="patch_world",
+            ) as sp:
+                if aot_handle is not None:
+                    self.world = aot_handle.call()
+                else:
+                    self.world = patch_world(self.world, args)
+                if not pipelined:
+                    jax.block_until_ready(self.world)
+                if obs is not None:
+                    source = obs.finish(
+                        problem_bytes=h2d,
+                        carried_bytes=donated,
+                        donated_bytes=donated,
+                        source_override=(
+                            aot_handle.source_override
+                            if aot_handle is not None else None
+                        ),
+                    )
+                    if sp is not None:
+                        sp.attrs["program_key"] = obs.key
+                        sp.attrs["cache_source"] = source
+                if sp is not None:
+                    sp.count("h2d_bytes", h2d)
+                    sp.count("donated_bytes", donated)
+        else:
+            stage_outcome = "adopt-" + drift
+            self.patched_streak = 0
+            self.cold_solves += 1
+            padded = pad_problem(spliced)
+            h2d = jb._nbytes(padded)
+            with trace.span("world_adopt", reason=drift) as sp:
+                self.world = jax.device_put(padded)
+                if not pipelined:
+                    jax.block_until_ready(self.world)
+                TRANSFER_BYTES.inc({"direction": "h2d"}, h2d)
+                if sp is not None:
+                    sp.count("h2d_bytes", h2d)
+        self.meta = meta
+        self.node_names = list(meta.node_names)
+        self.max_claims = max_claims
+        t_patch = time.perf_counter()
+
+        # -- stage 2 args: built on the host WHILE the device patches ----------
+        bf = problem_bounds_free(spliced)
+        gbf = self._gate_bounds_free(spliced)
+        from karpenter_tpu.ops.ffd_sweeps import _wavefront_lanes
+
+        wf = _wavefront_lanes()
+        pod_check = self._pod_check(pods, meta)
+        t_prep = time.perf_counter()
+
+        # -- stage 2: fused solve + gate, one dispatch, one batched fetch ------
+        solve_key = jb._program_key(solve_ffd_fused_gate, max_claims, self.world)
+        cache_hit = solve_key in jb._COMPILED_PROGRAMS
+        jb._COMPILED_PROGRAMS.add(solve_key)
+        COMPILE_CACHE.inc({"result": "hit" if cache_hit else "miss"})
+        if cache_hit:
+            backend.compile_cache_hits += 1
+        else:
+            backend.compile_cache_misses += 1
+        pc_bytes = int(pod_check.nbytes)
+        world_bytes = jb._nbytes(self.world)
+        TRANSFER_BYTES.inc({"direction": "h2d"}, pc_bytes)
+        reg_eqns = None
+        if not cache_hit and programs.eqns_enabled():
+            world, pc = self.world, pod_check
+            reg_eqns = programs.maybe_count_eqns(
+                lambda: jax.make_jaxpr(
+                    lambda: solve_ffd_fused_gate(world, pc, max_claims, bf, wf, gbf)
+                )()
+            )
+        aot_handle = aot.maybe_begin(
+            solve_ffd_fused_gate, self.world, max_claims, (pod_check, bf, wf, gbf)
+        )
+        obs = programs.begin_dispatch(
+            "solve_ffd_fused_gate", max_claims, self.world,
+            statics={"bf": int(bf), "wf": int(wf), "gbf": int(gbf)},
+        )
+        with trace.span(
+            "fused" if cache_hit else "compile",
+            cache="hit" if cache_hit else "miss",
+            program="solve_ffd_fused_gate",
+        ) as sp:
+            if aot_handle is not None:
+                result, counts = aot_handle.call()
+            else:
+                result, counts = solve_ffd_fused_gate(
+                    self.world, pod_check, max_claims, bf, wf, gbf
+                )
+            if not pipelined:
+                jax.block_until_ready(counts)
+            t_dispatch = time.perf_counter()
+            state = result.state
+            fetched = jax.device_get(
+                (
+                    result.kind, result.index, result.iters, result.wave_hist,
+                    counts,
+                    state.claim_open, state.claim_tpl, state.claim_it_ok,
+                    state.claim_requests, state.claim_req.admitted,
+                    state.claim_req.comp, state.claim_req.gt,
+                    state.claim_req.lt, state.claim_req.defined,
+                )
+            )
+            t_fetch = time.perf_counter()
+            kinds, indices, _iters, _whist, counts_np, *np_final = fetched
+            backend.last_iters = IterCounts(*(int(x) for x in _iters))
+            backend.last_wave_hist = (
+                [int(x) for x in _whist] if _whist is not None else None
+            )
+            d2h = jb._nbytes(fetched)
+            TRANSFER_BYTES.inc({"direction": "d2h"}, d2h)
+            if obs is not None:
+                source = obs.finish(
+                    problem_bytes=pc_bytes,
+                    carried_bytes=world_bytes,
+                    result_bytes=d2h,
+                    eqns=reg_eqns,
+                    source_override=(
+                        aot_handle.source_override
+                        if aot_handle is not None else None
+                    ),
+                )
+                if sp is not None:
+                    sp.attrs["program_key"] = obs.key
+                    sp.attrs["cache_source"] = source
+            if sp is not None:
+                sp.count("h2d_bytes", pc_bytes)
+                sp.count("d2h_bytes", d2h)
+                for field, value in zip(IterCounts._fields, backend.last_iters):
+                    sp.count(field, value)
+
+        # -- classified post-solve standdowns: reset, legacy serves the cycle --
+        if (np.asarray(kinds)[: len(pods)] == KIND_NO_SLOT).any():
+            # the legacy path owns the escalation ladder (and the recompile);
+            # a resident world at the old claim bucket is useless after it
+            self.reset()
+            return self._standdown("slot-overflow")
+        counts_np = np.asarray(counts_np)
+        if counts_np.any():
+            from karpenter_tpu.verify import device as vdev
+
+            nonzero = {
+                vdev.INVARIANTS[i]: int(counts_np[i])
+                for i in range(len(vdev.INVARIANTS))
+                if counts_np[i]
+            }
+            log.warning(
+                "device_world: fused gate rejected the patched-world solve "
+                "(%s) — world dropped, cycle served by the legacy path",
+                nonzero,
+            )
+            self.reset()
+            return self._standdown("gate-reject")
+
+        # -- decode ------------------------------------------------------------
+        out = SolveResult()
+        with trace.span("decode"):
+            pod_kinds: Dict[int, tuple] = {}
+            failed, failed_rows = [], []
+            for row in range(len(meta.pod_order)):
+                orig = meta.pod_order[row]  # the batch is the full pod list
+                kind, index = int(kinds[row]), int(indices[row])
+                if kind in (KIND_NODE, KIND_CLAIM, KIND_NEW_CLAIM):
+                    pod_kinds[orig] = (kind, index)
+                else:
+                    failed.append(orig)
+                    failed_rows.append(row)
+            from karpenter_tpu.solver.forensics import failure_reason
+
+            for orig in failed:
+                out.failures[orig] = failure_reason(
+                    pods[orig], instance_types, templates,
+                    well_known=backend.well_known,
+                ) or FAIL_INCOMPATIBLE
+            from karpenter_tpu.obs import explain as obs_explain
+
+            if obs_explain.enabled():
+                # attribution reads the RESIDENT tensors — no host re-upload
+                result.explain = backend._explain(
+                    out, self.world, state, meta, kinds, failed, failed_rows,
+                    pod_kinds, instance_types, len(pods),
+                )
+            jb.decode_claim_placements(out, meta, max_claims, np_final, pod_kinds)
+        t_decode = time.perf_counter()
+
+        # the composite gate consumes the fused counts instead of dispatching
+        # its own program; screen/skew/audit still run on the published decode
+        from karpenter_tpu import verify
+
+        out.verify_ctx = verify.make_context(
+            spliced, meta, max_claims, len(pods), False, fused_counts={}
+        )
+        backend.last_relax = None  # the fused path never runs phase 1
+        programs.sample_memory(
+            carried_bytes=jb._nbytes(state),
+            pods=len(pods),
+            cycle=trace.current_trace_id(),
+            donated_bytes=donated,
+            world_bytes=world_bytes,
+        )
+
+        overlapped = (t_prep - t_patch) if pipelined else 0.0
+        blocked = t_fetch - t_dispatch
+        self.cycles += 1
+        self.last_cycle = {
+            "outcome": stage_outcome,
+            "encode_ms": (t_encode - t0) * 1e3,
+            "patch_ms": (t_patch - t_encode) * 1e3,
+            "prep_ms": (t_prep - t_patch) * 1e3,
+            "solve_ms": (t_fetch - t_prep) * 1e3,
+            "decode_ms": (t_decode - t_fetch) * 1e3,
+            "cycle_ms": (t_decode - t0) * 1e3,
+            "h2d_bytes": h2d + pc_bytes,
+            "donated_bytes": donated,
+            "world_bytes": world_bytes,
+            "overlap_frac": (
+                overlapped / (overlapped + blocked)
+                if (overlapped + blocked) > 0 else 0.0
+            ),
+        }
+        self._record(stage_outcome)
+        return out
+
+    # -- helpers ---------------------------------------------------------------
+
+    def _drift(self, spliced, nodes, meta, max_claims) -> Optional[str]:
+        """None when the resident buffers can absorb this delta as a row
+        patch; else the adopt reason. The delta preconditions already pin the
+        K/V/R/T/TPL/O/PT axes (vocab/resource/port equality, template and
+        instance-type identity) — only the pod/node buckets and node order
+        can still move."""
+        if self.max_claims != max_claims:
+            return "claim-slots"
+        if int(self.world.pod_requests.shape[0]) != pod_axis_bucket(
+            int(np.asarray(spliced.pod_requests).shape[0])
+        ):
+            return "shape-drift"
+        n = len(nodes)
+        if int(self.world.node_avail.shape[0]) != (
+            pow2_bucket(n, lo=8) if n else 0
+        ):
+            return "shape-drift"
+        if self.node_names != list(meta.node_names):
+            return "node-axis-drift"
+        if spliced.pod_eqprev is None or self.world.pod_eqprev is None:
+            return "shape-drift"
+        return None
+
+    def _pod_check(self, pods, meta) -> np.ndarray:
+        """bool[P_bucket] per padded row: would the host validator check this
+        pod's requirement intersection (checked_requirements non-None)?
+        Digest-cached per uid so the steady state pays O(changed), matching
+        the delta encoder's own reuse."""
+        from karpenter_tpu.solver.validator import checked_requirements
+        from karpenter_tpu.streaming.delta import pod_digest
+
+        st = self.delta._state
+        digests = (
+            st.pod_digests if st is not None
+            else {p.uid: pod_digest(p) for p in pods}
+        )
+        Pb = int(self.world.pod_requests.shape[0])
+        pod_check = np.zeros(Pb, dtype=bool)
+        for row, orig in enumerate(meta.pod_order):
+            p = pods[orig]
+            d = digests.get(p.uid) or pod_digest(p)
+            ent = self._check_cache.get(p.uid)
+            if ent is None or ent[0] != d:
+                ent = (d, checked_requirements(p) is not None)
+                self._check_cache[p.uid] = ent
+            pod_check[row] = ent[1]
+        if len(self._check_cache) > 2 * len(pods) + 64:
+            live = {p.uid for p in pods}
+            self._check_cache = {
+                uid: ent for uid, ent in self._check_cache.items() if uid in live
+            }
+        return pod_check
+
+    @staticmethod
+    def _gate_bounds_free(spliced) -> bool:
+        from karpenter_tpu.verify import device as vdev
+
+        return vdev.gate_bounds_free(vdev.gate_problem(spliced))

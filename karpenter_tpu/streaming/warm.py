@@ -182,6 +182,12 @@ class StreamingSolver(SolverBackend):
     def reset_streaming_state(self) -> None:
         self._prev = None
         self.delta_encoder.reset()
+        # the inner backend may hold its own carried device state (JaxSolver's
+        # DeviceWorld): the quarantine contract covers the whole stack, so the
+        # reset propagates down the same hook
+        inner_reset = getattr(self.inner, "reset_streaming_state", None)
+        if callable(inner_reset):
+            inner_reset()
         # the on-disk journal mirrors _prev: a quarantined result must not
         # resurrect in the next process either
         if journal.enabled():
@@ -255,7 +261,7 @@ class StreamingSolver(SolverBackend):
                         prev, delta, pods, pod_digests, instance_types, templates, nodes
                     )
                     if out is not None:
-                        result, seeds, certified = out
+                        result, seeds, certified, order = out
                         ratio = (len(pods) - len(seeds)) / max(1, len(pods))
                         trace.attr("resolved", len(seeds))
                         trace.attr("reused", len(pods) - len(seeds))
@@ -263,6 +269,7 @@ class StreamingSolver(SolverBackend):
                         self._accept(
                             pods, pod_digests, nodes, node_digests,
                             instance_types, templates, result, certified,
+                            order=order,
                         )
                         self._finish("warm", ratio, len(pods))
                         return result
@@ -295,8 +302,13 @@ class StreamingSolver(SolverBackend):
 
     def _accept(
         self, pods, pod_digests, nodes, node_digests, instance_types, templates,
-        result, certified,
+        result, certified, order=None,
     ) -> None:
+        # the warm path already sorted the queue for _certify — reuse it
+        # rather than paying the O(P log P) constraint-signature sort twice
+        # per cycle; cold accepts (no order threaded) still compute their own
+        if order is None:
+            order = ffd_order(pods)
         self._prev = _StreamState(
             pods=pods,
             pod_digests=pod_digests,
@@ -305,7 +317,7 @@ class StreamingSolver(SolverBackend):
             instance_types=list(instance_types),
             templates=list(templates),
             result=result,
-            order_uids=[pods[i].uid for i in ffd_order(pods)],
+            order_uids=[pods[i].uid for i in order],
             certified_uids=frozenset(certified),
             placement_of=_index_placements(pods, result),
         )
@@ -565,17 +577,17 @@ class StreamingSolver(SolverBackend):
         if violations:
             return None
 
-        certified = self._certify(prev, delta, pods, seeds)
-        return merged, seeds, certified
+        order = ffd_order(pods)
+        certified = self._certify(prev, delta, pods, seeds, order)
+        return merged, seeds, certified, order
 
-    def _certify(self, prev, delta, pods, seeds) -> frozenset:
+    def _certify(self, prev, delta, pods, seeds, order) -> frozenset:
         """The FFD-queue prefix provably identical to a cold solve: positions
         matching the previous queue uid-for-uid, stopping at the first seed,
         the first pod outside the previous cycle's own certified set, or (when
         the node set shrank) the first topology-constrained pod — a removed
         node's hostname leaves every spread denominator, which can move any
         later constrained pick."""
-        order = ffd_order(pods)
         node_set_changed = bool(delta.removed_nodes)
         certified: List[str] = []
         for pos, i in enumerate(order):

@@ -100,6 +100,11 @@ class GateContext:
     max_claims: int
     num_pods: int
     has_override: bool
+    # device-resident fused solves (streaming/device_world.py) already ran
+    # the invariant program IN the solve dispatch; the nonzero-count dict
+    # (empty = device-accept) rides here so full_gate skips the separate
+    # gate dispatch. None = no fused counts, dispatch as usual.
+    fused_counts: Optional[Dict[str, int]] = None
 
 
 @dataclasses.dataclass
@@ -111,10 +116,13 @@ class GateOutcome:
     audit_outcome: Optional[str] = None
 
 
-def make_context(problem, meta, max_claims, num_pods, has_override) -> GateContext:
+def make_context(
+    problem, meta, max_claims, num_pods, has_override, fused_counts=None
+) -> GateContext:
     return GateContext(
         problem=problem, meta=meta, max_claims=int(max_claims),
         num_pods=int(num_pods), has_override=bool(has_override),
+        fused_counts=fused_counts,
     )
 
 
@@ -151,9 +159,17 @@ def full_gate(
             reject = _screen(result, pods, templates, instance_types, nodes, ctx)
             counts: Dict[str, int] = {}
             if reject is None:
-                counts = _device_counts(
-                    ctx, result, pods, pod_requirements_override
-                )
+                fused = getattr(ctx, "fused_counts", None)
+                if fused is not None:
+                    # the fused solve+gate dispatch already reduced the
+                    # invariants over the solver's own committed state; the
+                    # screen above + skew below + sampled audit still cover
+                    # the published decode
+                    counts = dict(fused)
+                else:
+                    counts = _device_counts(
+                        ctx, result, pods, pod_requirements_override
+                    )
                 if counts:
                     reject = "device:" + ",".join(sorted(counts))
             if reject is None:

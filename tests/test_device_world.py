@@ -1,0 +1,478 @@
+"""DeviceWorld (KARPENTER_TPU_DEVICE_WORLD) correctness and safety nets.
+
+Four contracts:
+
+1. **Bit identity.** After any served cycle — adopted or patched — the
+   device-resident world equals ``pad_problem(cold Encoder.encode)`` of the
+   same snapshot, array for array, over seeded churn corpora (arrivals,
+   deletes, spec changes, node reclaims). The on-device row patch is an
+   EXACT replay of the host splice, not an approximation of it.
+2. **Placement parity.** Every flag-on cycle produces placements identical
+   to the flag-off backend on the same snapshot, whether the cycle was
+   patched, adopted, or stood down.
+3. **Classified standdowns.** Each reason in the
+   ``solver_world_patch_total{outcome}`` vocabulary fires on its trigger,
+   serves the cycle through the legacy path, and — for post-dispatch
+   reasons — drops the resident world so a stale world can never patch.
+4. **No resurrection.** Validator-rejection resets (the supervisor's
+   ``reset_streaming_state`` chain) and process restarts always start from
+   ``adopt-first-encode``; DeviceWorld state is never journaled.
+"""
+
+import dataclasses
+import os
+import random
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+from test_streaming_parity import (
+    assert_problems_equal,
+    build_world,
+    make_node,
+    placement_map,
+)
+
+from karpenter_tpu.apis.objects import Taint
+from karpenter_tpu.metrics.registry import WORLD_PATCH
+from karpenter_tpu.ops.padding import pad_problem
+from karpenter_tpu.solver.encode import Encoder
+from karpenter_tpu.solver.jax_backend import JaxSolver
+from karpenter_tpu.streaming import device_world
+from karpenter_tpu.streaming.churn import ChurnConfig, ChurnProcess, default_pod_factory
+from karpenter_tpu.streaming.warm import StreamingSolver
+from karpenter_tpu.testing.restart import accounted, result_digest
+
+
+@pytest.fixture(autouse=True)
+def _dw_env(monkeypatch):
+    """Flag the resident path on; relax off (the fake catalog has no
+    remaining-resource limits, so relax-applicable would stand every cycle
+    down — its own test flips this back)."""
+    monkeypatch.setenv("KARPENTER_TPU_DEVICE_WORLD", "1")
+    monkeypatch.setenv("KARPENTER_TPU_RELAX", "0")
+    yield
+
+
+def spec_change(pod):
+    """Same uid, different requests: the digest diff classifies it as a
+    changed pod (a fresh row through the splice)."""
+    import copy
+
+    p = copy.deepcopy(pod)
+    p.spec.containers[0].requests["cpu"] = (
+        float(p.spec.containers[0].requests.get("cpu", 0.25)) + 0.25
+    )
+    return p
+
+
+def ref_solver():
+    """A flag-off twin for placement parity (its own process-wide caches are
+    shared; only the env flag differs at call time)."""
+    class _Off:
+        def __init__(self):
+            self.inner = JaxSolver()
+
+        def solve(self, *a, **kw):
+            prev = os.environ.get("KARPENTER_TPU_DEVICE_WORLD")
+            os.environ["KARPENTER_TPU_DEVICE_WORLD"] = "0"
+            try:
+                return self.inner.solve(*a, **kw)
+            finally:
+                os.environ["KARPENTER_TPU_DEVICE_WORLD"] = prev
+
+    return _Off()
+
+
+# -- 1 + 2: bit-identity and placement-parity fuzz -----------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_fuzz_patched_world_bit_identical_and_placements_match(seed):
+    its, tpls = build_world()
+    rng = random.Random(seed)
+    initial = [default_pod_factory(f"base-{i}", rng) for i in range(40)]
+    proc = ChurnProcess(
+        initial,
+        config=ChurnConfig(seed=seed, arrivals_per_cycle=4, deletes_per_cycle=3),
+    )
+    nodes = [make_node(f"n-{i}") for i in range(4)]
+    dev = JaxSolver()
+    ref = ref_solver()
+    patched = 0
+    for cycle in range(7):
+        proc.step()
+        if cycle >= 2:  # spec-change corpus rides along from cycle 2
+            idx = rng.randrange(len(proc.pods))
+            proc.pods[idx] = spec_change(proc.pods[idx])
+        if cycle == 5:  # node reclaim: vocabulary shrinks, checked cold adopt
+            nodes = nodes[:-1]
+        pods = list(proc.pods)
+        r_dev = dev.solve(pods, its, tpls, nodes=nodes)
+        dw = dev._device_world
+        assert dw is not None and dw.last_outcome is not None
+        assert not dw.last_outcome.startswith("standdown"), dw.last_outcome
+        if dw.last_outcome in ("patched", "repatched"):
+            patched += 1
+        # the resident world IS pad_problem(cold encode) — bit for bit
+        cold = Encoder().encode(
+            pods, its, tpls, nodes=nodes, num_claim_slots=dw.max_claims
+        )
+        assert_problems_equal(
+            jax.device_get(dw.world),
+            pad_problem(cold.problem),
+            ctx=f"seed {seed} cycle {cycle} ({dw.last_outcome})",
+        )
+        r_ref = ref.solve(pods, its, tpls, nodes=nodes)
+        assert placement_map(pods, r_dev) == placement_map(pods, r_ref), (
+            f"seed {seed} cycle {cycle}"
+        )
+        assert accounted(r_dev, len(pods))
+        # the fused gate ran in the solve dispatch and accepted
+        assert r_dev.verify_ctx is not None
+        assert r_dev.verify_ctx.fused_counts == {}
+    assert patched >= 4, f"fuzz vacuous: only {patched} patched cycles"
+
+
+def test_spec_change_only_cycle_patches():
+    """A pure spec-change cycle (same uids, one mutated pod) must take the
+    patch path, not adopt."""
+    its, tpls = build_world()
+    rng = random.Random(7)
+    pods = [default_pod_factory(f"p-{i}", rng) for i in range(24)]
+    dev = JaxSolver()
+    dev.solve(pods, its, tpls)
+    pods2 = list(pods)
+    pods2[3] = spec_change(pods2[3])
+    dev.solve(pods2, its, tpls)
+    assert dev._device_world.last_outcome in ("patched", "repatched")
+
+
+# -- 3: classified standdowns --------------------------------------------------
+
+
+def _world(pods=16, seed=11):
+    its, tpls = build_world()
+    rng = random.Random(seed)
+    return [default_pod_factory(f"p-{i}", rng) for i in range(pods)], its, tpls
+
+
+def test_standdown_unsupported_args_cluster_pods():
+    pods, its, tpls = _world()
+    dev = JaxSolver()
+    result = dev.solve(
+        pods, its, tpls,
+        cluster_pods=[(pods[0], dict(pods[0].metadata.labels))],
+    )
+    assert dev._device_world.last_outcome == "standdown-unsupported-args"
+    assert accounted(result, len(pods))
+    assert WORLD_PATCH.value({"outcome": "standdown-unsupported-args"}) >= 1
+
+
+def test_standdown_unsupported_args_override():
+    from karpenter_tpu.scheduling import pod_requirements
+
+    pods, its, tpls = _world()
+    dev = JaxSolver()
+    result = dev.solve(
+        pods, its, tpls,
+        pod_requirements_override=[pod_requirements(p) for p in pods],
+    )
+    assert dev._device_world.last_outcome == "standdown-unsupported-args"
+    assert accounted(result, len(pods))
+
+
+def test_standdown_runs_mode(monkeypatch):
+    from karpenter_tpu.solver import jax_backend as jb
+
+    monkeypatch.setattr(jb, "_USE_RUNS", True)
+    pods, its, tpls = _world()
+    dev = JaxSolver()
+    result = dev.solve(pods, its, tpls)
+    assert dev._device_world.last_outcome == "standdown-runs-mode"
+    assert accounted(result, len(pods))
+
+
+def test_standdown_shard(monkeypatch):
+    monkeypatch.setenv("KARPENTER_TPU_SHARD", "1")
+    pods, its, tpls = _world()
+    dev = JaxSolver()
+    result = dev.solve(pods, its, tpls)
+    assert dev._device_world.last_outcome == "standdown-shard"
+    assert accounted(result, len(pods))
+
+
+def test_standdown_order_policy(monkeypatch):
+    monkeypatch.setenv("KARPENTER_TPU_ORDER_POLICY", "builtin")
+    pods, its, tpls = _world()
+    dev = JaxSolver()
+    result = dev.solve(pods, its, tpls)
+    assert dev._device_world.last_outcome == "standdown-order-policy"
+    assert accounted(result, len(pods))
+
+
+def test_standdown_not_sweeps_prefer_no_schedule():
+    pods, its, tpls = _world()
+    tpls = [
+        dataclasses.replace(
+            tpls[0],
+            taints=type(tpls[0].taints)(
+                [Taint(key="soft", value="x", effect="PreferNoSchedule")]
+            ),
+        )
+    ]
+    dev = JaxSolver()
+    result = dev.solve(pods, its, tpls)
+    assert dev._device_world.last_outcome == "standdown-not-sweeps"
+    assert accounted(result, len(pods))
+
+
+def test_standdown_topology():
+    from karpenter_tpu.apis import labels as wk
+    from karpenter_tpu.apis.objects import TopologySpreadConstraint
+
+    pods, its, tpls = _world()
+    pods[0].spec.topology_spread_constraints = [
+        TopologySpreadConstraint(max_skew=1, topology_key=wk.LABEL_HOSTNAME)
+    ]
+    dev = JaxSolver()
+    result = dev.solve(pods, its, tpls)
+    assert dev._device_world.last_outcome == "standdown-topology"
+    assert accounted(result, len(pods))
+
+
+def test_standdown_relax_applicable(monkeypatch):
+    monkeypatch.delenv("KARPENTER_TPU_RELAX", raising=False)  # default ON
+    pods, its, tpls = _world()
+    assert device_world._relax_would_fire(tpls)  # fake catalog: no limits
+    dev = JaxSolver()
+    result = dev.solve(pods, its, tpls)
+    assert dev._device_world.last_outcome == "standdown-relax-applicable"
+    assert accounted(result, len(pods))
+    # finite remaining limits pin phase 1 off: the resident path serves
+    limited = [
+        dataclasses.replace(tpls[0], remaining_resources={"cpu": 1e6})
+    ]
+    assert not device_world._relax_would_fire(limited)
+    dev2 = JaxSolver()
+    result2 = dev2.solve(pods, its, limited)
+    assert dev2._device_world.last_outcome == "adopt-first-encode"
+    assert accounted(result2, len(pods))
+
+
+def test_slot_overflow():
+    """Claims exceed the resident program's slot bucket: the legacy path owns
+    the escalation ladder; the world is dropped (its claim axis is stale)."""
+    its, tpls = build_world()
+    # 7-cpu pods on a catalog topping out at 12 cpu: one claim per pod
+    from factories import make_pod
+
+    pods = [make_pod(name=f"big-{i}", cpu=7.0) for i in range(20)]
+    dev = JaxSolver(initial_claim_slots=8)
+    result = dev.solve(pods, its, tpls)
+    assert dev._device_world.last_outcome == "standdown-slot-overflow"
+    assert dev._device_world.world is None
+    assert accounted(result, len(pods))
+    assert len(result.new_claims) == 20
+    # the next supported cycle adopts fresh, at the escalated bucket
+    result2 = dev.solve(pods, its, tpls)
+    assert dev._device_world.last_outcome == "adopt-first-encode"
+    assert accounted(result2, len(pods))
+
+
+def test_standdown_gate_reject_resets_world(monkeypatch):
+    """A fused-gate rejection (forced here) is a standdown, not an error:
+    the world drops, the legacy path serves, placements stay correct."""
+    real = device_world.solve_ffd_fused_gate
+
+    def sabotaged(*args, **kw):
+        result, counts = real(*args, **kw)
+        return result, counts.at[0].add(1)
+
+    pods, its, tpls = _world()
+    dev = JaxSolver()
+    ref = ref_solver()
+    monkeypatch.setattr(device_world, "solve_ffd_fused_gate", sabotaged)
+    result = dev.solve(pods, its, tpls)
+    assert dev._device_world.last_outcome == "standdown-gate-reject"
+    assert dev._device_world.world is None
+    assert placement_map(pods, result) == placement_map(
+        pods, ref.solve(pods, its, tpls)
+    )
+
+
+def test_standdown_error_resets_world(monkeypatch):
+    pods, its, tpls = _world()
+    dev = JaxSolver()
+    dev.solve(pods, its, tpls)  # adopt
+    def boom(*a, **kw):
+        raise RuntimeError("forced patch failure")
+
+    monkeypatch.setattr(device_world, "build_patch_args", boom)
+    pods2 = pods[1:] + [default_pod_factory("p-new", random.Random(1))]
+    result = dev.solve(pods2, its, tpls)
+    assert dev._device_world.last_outcome == "standdown-error"
+    assert dev._device_world.world is None
+    assert accounted(result, len(pods2))
+    monkeypatch.undo()
+    monkeypatch.setenv("KARPENTER_TPU_DEVICE_WORLD", "1")
+    monkeypatch.setenv("KARPENTER_TPU_RELAX", "0")
+    dev.solve(pods2, its, tpls)
+    assert dev._device_world.last_outcome == "adopt-first-encode"
+
+
+def test_adopt_classification_node_added_and_bucket_growth():
+    its, tpls = build_world()
+    rng = random.Random(13)
+    pods = [default_pod_factory(f"p-{i}", rng) for i in range(24)]
+    nodes = [make_node(f"n-{i}") for i in range(3)]
+    dev = JaxSolver()
+    dev.solve(pods, its, tpls, nodes=nodes)
+    assert dev._device_world.last_outcome == "adopt-first-encode"
+    # node added: a delta blocker — classified cold adopt, not a patch
+    dev.solve(pods, its, tpls, nodes=nodes + [make_node("n-new")])
+    assert dev._device_world.last_outcome == "adopt-node-added"
+    # pod bucket growth (24 -> 40 crosses the 32 bucket): shape drift adopt
+    grown = pods + [default_pod_factory(f"g-{i}", rng) for i in range(16)]
+    dev.solve(grown, its, tpls, nodes=nodes + [make_node("n-new")])
+    assert dev._device_world.last_outcome == "adopt-shape-drift"
+
+
+# -- 4: reset + restart --------------------------------------------------------
+
+
+def test_validator_rejection_reset_drops_world():
+    """The supervisor's quarantine hook (reset_streaming_state) must reach
+    the resident world — directly on the backend, and through a streaming
+    wrapper."""
+    pods, its, tpls = _world()
+    dev = JaxSolver()
+    dev.solve(pods, its, tpls)
+    dw = dev._device_world
+    assert dw.world is not None
+    dev.reset_streaming_state()
+    assert dw.world is None and dw.delta._state is None
+    dev.solve(pods, its, tpls)
+    assert dw.last_outcome == "adopt-first-encode"
+
+    # through StreamingSolver: the chain the supervisor actually calls
+    inner = JaxSolver()
+    stream = StreamingSolver(inner)
+    stream.solve(pods, its, tpls)
+    # streaming serves warm cycles itself; force the inner world alive
+    inner.solve(pods, its, tpls)
+    assert inner._device_world.world is not None
+    stream.reset_streaming_state()
+    assert inner._device_world.world is None
+
+
+def test_supervisor_reset_reaches_device_world():
+    from karpenter_tpu.solver import supervisor as sup_mod
+
+    pods, its, tpls = _world()
+    dev = JaxSolver()
+    dev.solve(pods, its, tpls)
+    assert dev._device_world.world is not None
+    # the exact hook _reset_streaming uses
+    hook = getattr(dev, "reset_streaming_state", None)
+    assert hook is not None
+    hook()
+    assert dev._device_world.world is None
+    assert "_reset_streaming" in dir(sup_mod.SupervisedSolver)
+
+
+def test_process_restart_never_resurrects_world(tmp_path):
+    """A fresh process — even with the journal dir populated — starts at
+    adopt-first-encode and reproduces the control placements: DeviceWorld
+    state is process-local and never journaled."""
+    child = r"""
+import os, random, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["KARPENTER_TPU_DEVICE_WORLD"] = "1"
+os.environ["KARPENTER_TPU_RELAX"] = "0"
+from karpenter_tpu.testing.restart import base_problem, result_digest, accounted, _churn
+from karpenter_tpu.solver.jax_backend import JaxSolver
+
+pods, its, tpls = base_problem(24, 12)
+proc = _churn(pods, 5, 3, 2)
+start = int(sys.argv[1])
+for _ in range(start):
+    proc.step()
+dev = JaxSolver()
+for cycle in range(start, start + 2):
+    proc.step()
+    r = dev.solve(proc.pods, its, tpls)
+    assert accounted(r, len(proc.pods))
+    print("CYCLE", cycle, result_digest(r), dev._device_world.last_outcome, flush=True)
+"""
+    env = dict(os.environ)
+    env["KARPENTER_TPU_STATE_DIR"] = str(tmp_path)
+    pkg_parent = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = pkg_parent + os.pathsep + env.get("PYTHONPATH", "")
+
+    def run(start):
+        out = subprocess.run(
+            [sys.executable, "-c", child, str(start)],
+            capture_output=True, text=True, env=env, timeout=240,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        lines = [l.split() for l in out.stdout.splitlines() if l.startswith("CYCLE")]
+        return {int(l[1]): (l[2], l[3]) for l in lines}
+
+    first = run(0)
+    second = run(2)  # the "restarted" process, frontier replayed
+    assert first[0][1] == "adopt-first-encode"
+    # restart: no resurrection — the world is re-adopted, never patched
+    assert second[2][1] == "adopt-first-encode"
+
+    # control for the restarted cycles, in-process with the flag off
+    from karpenter_tpu.testing.restart import _churn as churn2, base_problem as bp2
+
+    pods, its, tpls = bp2(24, 12)
+    proc = churn2(pods, 5, 3, 2)
+    os.environ["KARPENTER_TPU_DEVICE_WORLD"] = "0"
+    try:
+        ref = JaxSolver()
+        digests = {}
+        for cycle in range(4):
+            proc.step()
+            digests[cycle] = result_digest(ref.solve(proc.pods, its, tpls))
+    finally:
+        os.environ["KARPENTER_TPU_DEVICE_WORLD"] = "1"
+    for cycle, (digest, _outcome) in {**first, **second}.items():
+        assert digest == digests[cycle], f"cycle {cycle} diverged after restart"
+
+
+# -- bookkeeping surfaces ------------------------------------------------------
+
+
+def test_last_cycle_telemetry_and_counters():
+    pods, its, tpls = _world(pods=24)
+    dev = JaxSolver()
+    dev.solve(pods, its, tpls)
+    dev.solve(list(pods), its, tpls)
+    dw = dev._device_world
+    lc = dw.last_cycle
+    assert lc["world_bytes"] > 0
+    assert lc["cycle_ms"] > 0
+    assert 0.0 <= lc["overlap_frac"] <= 1.0
+    assert dw.cold_solves == 1  # exactly the first adopt; steady state patches
+    assert dw.cycles == 2
+    assert WORLD_PATCH.value({"outcome": "adopt-first-encode"}) >= 1
+
+
+def test_pipeline_depth_zero_is_bit_identical(monkeypatch):
+    """Synchronous mode is a measurement baseline, not a different program:
+    placements match the pipelined default exactly."""
+    pods, its, tpls = _world(pods=20, seed=23)
+    dev_sync = JaxSolver()
+    monkeypatch.setenv("KARPENTER_TPU_DEVICE_WORLD_PIPELINE", "0")
+    r_sync = dev_sync.solve(pods, its, tpls)
+    assert dev_sync._device_world.last_cycle["overlap_frac"] == 0.0
+    monkeypatch.setenv("KARPENTER_TPU_DEVICE_WORLD_PIPELINE", "1")
+    dev_pipe = JaxSolver()
+    r_pipe = dev_pipe.solve(pods, its, tpls)
+    assert placement_map(pods, r_sync) == placement_map(pods, r_pipe)

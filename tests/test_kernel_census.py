@@ -19,6 +19,8 @@ import pytest
 
 from tools.kernel_census import (
     build_census_problem,
+    fused_body_jaxpr_eqns,
+    fused_epilogue_jaxpr_eqns,
     gate_jaxpr_eqns,
     narrow_jaxpr_eqns,
     policy_scorer_jaxpr_eqns,
@@ -71,6 +73,15 @@ POLICY_SCORER_EQN_BUDGET = 50
 # scaffolding. It is lane-count invariant: more partitions widen the batch,
 # never the program
 SHARD_EQN_BUDGET = 3900
+
+# round-21 DeviceWorld fused solve+gate body (KARPENTER_TPU_DEVICE_WORLD):
+# the fused program must be pure concatenation — narrow loop body plus the
+# one-shot gate epilogue — so its budget is DERIVED, not measured: the
+# narrow pin (2394) plus the gate pin (336) plus 10% for the epilogue's
+# pod-bin reconstruction glue. Measured 2741 at the round-21 commit
+# (epilogue 347). Growth past the derived ceiling means the fusion started
+# re-tracing work instead of concatenating programs
+FUSED_BODY_EQN_BUDGET = int((2394 + 336) * 1.10)  # 3003
 
 # round-20 residual-lane screen body (KARPENTER_TPU_SCREEN_DELTA): measured
 # 3754 at the round-20 commit. This is the WHOLE per-dispatch program — the
@@ -466,3 +477,63 @@ class TestScreenDeltaBudget:
         assert residual_screen_jaxpr_eqns(
             census_problem, lanes=4, runs=4
         ) == residual_screen_jaxpr_eqns(census_problem, lanes=8, runs=8)
+
+
+class TestDeviceWorldBudget:
+    """Round-21 DeviceWorld fused dispatch: the fused solve+gate body gets a
+    DERIVED budget (narrow pin + gate pin + 10% glue) rather than a
+    free-standing measurement — the whole point of the fusion is that it
+    concatenates the two already-pinned programs, so any growth beyond the
+    glue means the fusion started re-tracing work. The flag must also leave
+    the narrow body itself untouched: KARPENTER_TPU_DEVICE_WORLD selects
+    the fused entry and the patch program at the backend seam, it never
+    edits the sweeps kernels."""
+
+    def test_fused_body_under_derived_budget(self, census_problem):
+        eqns = fused_body_jaxpr_eqns(census_problem)
+        assert eqns <= FUSED_BODY_EQN_BUDGET, (
+            f"fused solve+gate body grew to {eqns} jaxpr eqns (derived "
+            f"budget {FUSED_BODY_EQN_BUDGET} = (narrow 2394 + gate 336) * "
+            f"1.10); the fusion must stay pure concatenation — see "
+            f"tools/kernel_census.py fused_epilogue_jaxpr_eqns to attribute "
+            f"the growth"
+        )
+
+    def test_fused_budget_is_tight(self, census_problem):
+        eqns = fused_body_jaxpr_eqns(census_problem)
+        assert eqns >= FUSED_BODY_EQN_BUDGET * 0.8, (
+            f"fused solve+gate body shrank to {eqns} jaxpr eqns — nice! "
+            f"re-derive FUSED_BODY_EQN_BUDGET from the tightened component "
+            f"pins to keep the guard meaningful"
+        )
+
+    def test_epilogue_costs_gate_plus_glue_only(self, census_problem):
+        """The epilogue is the gate reduction plus pod-bin reconstruction —
+        if it ever costs meaningfully more than the standalone gate program,
+        the fusion is rebuilding state it already has."""
+        epi = fused_epilogue_jaxpr_eqns(census_problem)
+        gate = gate_jaxpr_eqns(census_problem)
+        assert epi <= gate + 50, (
+            f"fused epilogue ({epi} eqns) costs more than the standalone "
+            f"gate ({gate} eqns) plus glue — the epilogue should assemble "
+            f"GateArgs from the carried FFDState, never recompute it"
+        )
+
+    def test_device_world_flag_on_narrow_body_unchanged(self, census_problem):
+        """With the streaming DeviceWorld imported AND the flag forced on,
+        the flag-off narrow body must still count EXACTLY 2394 equations:
+        the resident-world path dispatches solve_ffd_fused_gate and
+        patch_world as SEPARATE named programs, and the sweeps loop inside
+        the fused program is the same traced body byte for byte."""
+        from karpenter_tpu.streaming import device_world
+
+        old = os.environ.get("KARPENTER_TPU_DEVICE_WORLD")
+        os.environ["KARPENTER_TPU_DEVICE_WORLD"] = "1"
+        try:
+            assert device_world.enabled()
+            assert narrow_jaxpr_eqns(census_problem, wavefront=0) == 2394
+        finally:
+            if old is None:
+                os.environ.pop("KARPENTER_TPU_DEVICE_WORLD", None)
+            else:
+                os.environ["KARPENTER_TPU_DEVICE_WORLD"] = old

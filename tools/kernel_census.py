@@ -293,6 +293,48 @@ def residual_screen_jaxpr_eqns(problem=None, C: int = 16, lanes: int = 4,
     return _count_jaxpr_eqns(jaxpr)
 
 
+def fused_epilogue_jaxpr_eqns(problem=None, C: int = 16) -> int:
+    """Flattened jaxpr equation count of the fused program's verification
+    epilogue (ops/fused.fused_gate_counts, KARPENTER_TPU_DEVICE_WORLD) — the
+    GateArgs assembly from the final FFDState plus the invariant reduction
+    the fused solve+gate dispatch appends after the sweeps loop. One-shot
+    per solve, so the meaningful comparison is against the standalone gate
+    program (gate_jaxpr_eqns): the epilogue should cost the gate plus a
+    handful of eqns for the pod-bin reconstruction, never a second solve."""
+    import jax
+    import jax.numpy as jnp
+
+    from karpenter_tpu.ops.ffd_core import _pad_lanes_mult32, initial_state
+    from karpenter_tpu.ops.fused import fused_gate_counts
+    from karpenter_tpu.verify.device import gate_bounds_free, gate_problem
+
+    if problem is None:
+        problem = build_census_problem(claim_slots=C)
+    padded = _pad_lanes_mult32(jax.device_put(problem))
+    gbf = gate_bounds_free(gate_problem(padded))
+    P = padded.num_pods
+    state = initial_state(padded, C)
+    kind = jnp.zeros((P,), jnp.int32)
+    index = jnp.zeros((P,), jnp.int32)
+    pod_check = jnp.ones((P,), bool)
+    jaxpr = jax.make_jaxpr(
+        lambda p, k, i, s, pc: fused_gate_counts(p, k, i, s, pc, C, gbf)
+    )(padded, kind, index, state, pod_check)
+    return _count_jaxpr_eqns(jaxpr)
+
+
+def fused_body_jaxpr_eqns(problem=None, C: int = 16) -> int:
+    """Per-iteration-equivalent equation count of the DeviceWorld fused
+    solve+gate program (ops/fused.solve_ffd_fused_gate): the narrow loop
+    body plus the one-shot verification epilogue. The fusion must be pure
+    concatenation — the budget test pins this at (narrow + gate) * 1.10 and
+    separately proves the flag-on narrow body still counts EXACTLY its
+    flag-off number, so fusing the gate in can never reinflate the loop."""
+    if problem is None:
+        problem = build_census_problem(claim_slots=C)
+    return narrow_jaxpr_eqns(problem, C) + fused_epilogue_jaxpr_eqns(problem, C)
+
+
 def shard_jaxpr_eqns(problem=None, C: int = 16, lanes: int = 8, wavefront: int = 0) -> int:
     """Flattened jaxpr equation count of the WHOLE mesh-partitioned solve
     program (parallel/mesh.py shard_sweeps_program, KARPENTER_TPU_SHARD).
@@ -383,6 +425,11 @@ def main(argv):
     residual_eqns = residual_screen_jaxpr_eqns(problem, C)
     print(f"  jaxpr_eqns_residual  = {residual_eqns}  (residual-lane screen "
           f"body, per dispatch)")
+    fused_epi = fused_epilogue_jaxpr_eqns(problem, C)
+    print(f"  jaxpr_eqns_fused_epi = {fused_epi}  (fused gate epilogue, "
+          f"once per fused solve)")
+    print(f"  jaxpr_eqns_fused     = {eqns + fused_epi}  (fused body: narrow "
+          f"+ epilogue)")
     try:
         shard_eqns = shard_jaxpr_eqns(problem, C)
         print(f"  jaxpr_eqns_shard     = {shard_eqns}  (whole mesh-partitioned "

@@ -116,6 +116,15 @@ DEFAULT_BANDS = {
     # window; policy-on and policy-off runs both emit it and gate against
     # their own trajectory.
     "narrow_iterations_10k": (LOWER_BETTER, 1.5),
+    # round-21 DeviceWorld steady-state churn (streaming/device_world.py,
+    # KARPENTER_TPU_DEVICE_WORLD): HOST-INCLUSIVE per-cycle wall (encode +
+    # patch + fused dispatch + decode + verify) at the churn shape, p50 over
+    # patched cycles only — the number the resident world exists to hold
+    # down. Cold solves (adopt cycles) are counted in the row but not
+    # banded: their COUNT is the regression signal (cold solves leaking
+    # into steady state), and bench.py reports it per run. The first
+    # device-world-carrying run seeds the window.
+    "churn_cycle_host_ms": (LOWER_BETTER, 3.0),
 }
 
 # absolute ceiling for the --smoke tiny-shape solve (steady-state, post
@@ -187,6 +196,13 @@ def row_from_bench(out: dict, label: str = "run") -> dict:
         "shard_pad_frac": out.get("shard_pad_frac"),
         "shard_speedup_vs_control": out.get("shard_speedup_vs_control"),
         "shard_mesh_devices": out.get("shard_mesh_devices"),
+        # schema v2, round 21: DeviceWorld steady-state churn columns —
+        # present only when the bench device_churn scenario served through
+        # the resident path (standdowns or flag-off runs omit them)
+        "churn_cycle_host_ms": out.get("churn_cycle_host_ms"),
+        "churn_cold_solves": out.get("churn_cold_solves"),
+        "device_world_speedup": out.get("device_world_speedup"),
+        "device_world_overlap_frac": out.get("device_world_overlap_frac"),
         "error": out.get("error"),
     }
     row.update({k: v for k, v in optional.items() if v is not None})
