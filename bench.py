@@ -378,6 +378,29 @@ def run_child():
                 ev["relax"]["phase_s"] = round(
                     last_trace["phases"]["relax"], 4
                 )
+        # round-22 convex phase-1 telemetry (KARPENTER_TPU_RELAX2): the
+        # placed fraction, iterations-to-convergence, and phase wall the A/B
+        # bands gate on — plus the classified standdown reason when the
+        # solve fell through to the proven path
+        last_relax2 = getattr(solver, "last_relax2", None)
+        if last_relax2 is not None:
+            if last_relax2.get("reason") is None:
+                ev["relax2"] = {
+                    "placed_frac": round(
+                        last_relax2["placed"] / max(pod_count, 1), 4
+                    ),
+                    "eligible": last_relax2["eligible"],
+                    "demoted": last_relax2["demoted"],
+                    "pgd_iterations": last_relax2["pgd_iterations"],
+                    "residual": round(last_relax2["residual"], 6),
+                    "fallbacks": solver.relax_fallbacks,
+                }
+                if "phase_s" in last_relax2:
+                    ev["relax2"]["phase_s"] = last_relax2["phase_s"]
+                if solver.last_iters is not None:
+                    ev["relax2"]["repair_iterations"] = solver.last_iters.narrow
+            else:
+                ev["relax2"] = {"standdown": last_relax2["reason"]}
         cc_hits = solver.compile_cache_hits - cache_before[0]
         cc_misses = solver.compile_cache_misses - cache_before[1]
         ev["compile_cache"] = {
@@ -1460,6 +1483,40 @@ def main():
             # and a pure-FFD run are different modes, so they must not
             # share solve_10k_pods_s's baseline window
             out["solve_10k_relax_s"] = round(north["solve_s"], 3)
+    # round-22 convex phase-1 columns: same discipline as the relax block —
+    # present only on KARPENTER_TPU_RELAX2 runs, with the 10k shape's solve
+    # wall published under its own gated metric
+    if any("relax2" in e for e in shapes):
+        out["per_shape_relax2"] = {
+            str(e["pods"]): e["relax2"] for e in shapes if "relax2" in e
+        }
+        fracs2 = {e["pods"]: e["relax2"]["placed_frac"]
+                  for e in shapes
+                  if "relax2" in e and "placed_frac" in e["relax2"]}
+        if fracs2:
+            out["relax2_placed_frac"] = fracs2.get(10000, min(fracs2.values()))
+        iters2 = {
+            e["pods"]: e["relax2"]["pgd_iterations"]
+            for e in shapes
+            if "relax2" in e and "pgd_iterations" in e["relax2"]
+        }
+        if iters2:
+            out["relax2_pgd_iterations"] = iters2.get(10000, max(iters2.values()))
+        walls2 = {
+            e["pods"]: e["relax2"]["phase_s"]
+            for e in shapes if "relax2" in e and "phase_s" in e["relax2"]
+        }
+        if walls2:
+            out["relax2_phase_s"] = walls2.get(10000, max(walls2.values()))
+        standdowns = {
+            str(e["pods"]): e["relax2"]["standdown"]
+            for e in shapes
+            if "relax2" in e and "standdown" in e["relax2"]
+        }
+        if standdowns:
+            out["relax2_standdowns"] = standdowns
+        if north is not None and "relax2" in north and "placed_frac" in north["relax2"]:
+            out["solve_10k_relax2_s"] = round(north["solve_s"], 3)
     cold = next((e for e in events if e.get("event") == "coldstart"), None)
     if cold is not None and "cold_s" in cold:
         out["coldstart_2500_s"] = cold["cold_s"]

@@ -220,8 +220,12 @@ SOLVE_DEADLINE_EXCEEDED = REGISTRY.counter(
 )
 RELAX_FALLBACK = REGISTRY.counter(
     "solver_relax_fallback_total",
-    "Two-phase (KARPENTER_TPU_RELAX) solves redone without relaxation after "
-    "the full-level validator rejected the relaxed result",
+    "Phase-1 relaxation fallbacks, by classified reason: gate-rejected "
+    "covers both phase-1 solvers' validator re-solves (KARPENTER_TPU_RELAX "
+    "waterfill and KARPENTER_TPU_RELAX2 convex solve); the convex solve "
+    "additionally classifies its standdowns (finite-pool, ports, topology, "
+    "no-eligible, non-convergence, rounding-overflow, error) before falling "
+    "through to the waterfill",
 )
 
 # -- mesh-sharded partitioned solve series (shard/, KARPENTER_TPU_SHARD) ------

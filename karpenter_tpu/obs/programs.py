@@ -106,6 +106,9 @@ PROGRAM_FLAGS = (
     "KARPENTER_TPU_ABLATE",
     "KARPENTER_TPU_RELAX",
     "KARPENTER_TPU_RELAX_PASSES",
+    "KARPENTER_TPU_RELAX2",
+    "KARPENTER_TPU_RELAX2_ITERS",
+    "KARPENTER_TPU_RELAX2_STEP",
     "KARPENTER_TPU_SCREEN_DELTA",
     "KARPENTER_TPU_SCREEN_DELTA_MAX_RUNS",
 )

@@ -1,0 +1,384 @@
+"""Convex-relaxation phase-1 placement: projected gradient over the
+fractional pod x bin assignment polytope (KARPENTER_TPU_RELAX2, round 22).
+
+The round-15 waterfill (ops/relax.py) places each eligible pod at its
+prefix-sum level in one shot — no feedback between the assignment and the
+per-bin load, so heterogeneous sizes overshoot bins and the rounding ladder
+demotes the overflow to the sequential repair loop. This module replaces
+the assignment math with a real first-order convex solve (CvxCluster's
+relaxation recipe, PAPERS.md):
+
+  variables   X[p, j] — fractional assignment of pod p to the j-th bin of
+              its group's slot window (the same bin-groups, template pick,
+              and normalized scalar demand w_p as the waterfill, via the
+              shared ops/relax_common.plan_groups);
+  polytope    row simplex  sum_j X[p, j] <= 1, X >= 0  (a pod places at
+              most once), bin capacity  load_c = sum_p w_p X[p, c] <= 1
+              handled by penalty;
+  objective   minimize  sum w_p X[p,c] (price_c - 1) + (rho/2) sum_c
+              max(0, load_c - 1)^2 — placed mass is rewarded, a linear
+              within-group bin price (gamma * bin index + beta * distance
+              from the pod's waterfill bin) biases mass into early bins
+              and breaks the symmetry of identical pods, and the quadratic
+              term prices capacity violations.
+
+The solve is a fixed-trip-count jitted ``lax.scan``: each trip is one
+projected-gradient step — scatter the bin loads, form the gradient, clip
+to [0, 1], and radially rescale rows whose mass exceeds 1 (a cheap
+feasible map onto the simplex, not the exact Euclidean projection; exact
+projection needs a per-row sort and buys nothing because the rounding and
+the real instance-type gate re-check everything). The support of X is a
+static window of ``_WINDOW`` bins centered on the pod's waterfill bin, so
+memory is O(P * W), not O(P * C) — the gradient flow only ever needs to
+push a pod a few bins off its warm start to smooth overloads.
+
+Rounding is deterministic and jitted: each pod's largest fraction names
+its bin, pods sort by (bin, -fraction), and a segmented prefix sum admits
+pods while the bin's scalar load stays <= 1 (largest-fraction-first with
+capacity bookkeeping). The admitted assignment then goes through the SAME
+real-gate rounding ladder and FFDState commit as the waterfill
+(relax_common.commit_assignment), and the residue rides the carried
+sweeps repair unchanged.
+
+Correctness is the round-15 contract, unchanged: every relax2 result is
+full-gated before the backend returns it (a relax2 bug costs latency,
+never correctness), and flag off nothing here is ever imported on the
+solve path. Classified standdowns (STANDDOWN_REASONS) ride the round-15
+counter: solver_relax_fallback_total{reason}."""
+
+import functools
+import os
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from karpenter_tpu.models.problem import SchedulingProblem
+from karpenter_tpu.ops.ffd_core import (
+    FFDState,
+    _pad_lanes_mult32,
+    _statics,
+    problem_bounds_free,
+)
+from karpenter_tpu.ops.relax import RelaxOut, relax_passes
+from karpenter_tpu.ops.relax_common import (
+    commit_assignment,
+    eligibility,
+    plan_groups,
+    relax_applicable,
+)
+from karpenter_tpu.ops.topology_kernels import TYPE_ANTI_AFFINITY
+
+__all__ = [
+    "Relax2Stats",
+    "STANDDOWN_REASONS",
+    "classify_ineligible",
+    "converged",
+    "enabled",
+    "pgd_iters",
+    "pgd_step",
+    "pgd_tol",
+    "relax2_place",
+    "relax_applicable",
+]
+
+# Bounded standdown vocabulary (solver_relax_fallback_total{reason}; the
+# bare "gate-rejected" covers BOTH phase-1 solvers' validator fallbacks):
+#   finite-pool       nodepool limits — relax_applicable false, no dispatch
+#   ports / topology  nothing eligible, dominant blocker named
+#   no-eligible       nothing eligible, no single dominant blocker
+#   non-convergence   PGD still moving AND capacity-violating at the trip
+#                     limit — the fractional point is not worth rounding
+#   rounding-overflow eligible mass existed but rounding + the real-gate
+#                     ladder demoted every pod (phase 1 placed nothing)
+#   gate-rejected     the committed result failed the full validator gate;
+#                     re-solved with the flag off
+#   error             any exception inside the phase — fall through to the
+#                     proven path
+STANDDOWN_REASONS = (
+    "finite-pool",
+    "ports",
+    "topology",
+    "no-eligible",
+    "non-convergence",
+    "rounding-overflow",
+    "gate-rejected",
+    "error",
+)
+
+# static window of candidate bins per pod, centered on its waterfill bin.
+# W=16 keeps X at O(P*16) floats and still lets the gradient flow move a
+# pod 8 bins either way — overload smoothing is local by construction
+# (neighboring prefix-sum bins), so a wider window only adds zeros.
+_WINDOW = 16
+_RHO = 8.0  # quadratic capacity-violation price
+_GAMMA = 0.02  # linear within-group bin price (first-fill bias)
+_BETA = 0.05  # distance-from-waterfill-bin tilt (symmetry breaking)
+# rounding floor, RELATIVE to the uniform share of the pod's valid window:
+# the LP optimum is routinely diffuse (many equal-price bins), so an
+# absolute floor would demote rows the solve fully committed. The real
+# eviction signal is row mass driven toward zero (positive gradient =
+# overloaded everywhere), which puts best-fraction x valid-columns well
+# below 1; a committed row — however spread — keeps it at >= 1.
+_MIN_REL_MASS = 1.0 - 1e-4
+_CAPVIOL_OK = 0.05  # fractional overload the rounding absorbs routinely
+
+
+def enabled() -> bool:
+    """KARPENTER_TPU_RELAX2=1 turns the convex phase-1 solve on. Read at
+    call time (not import) so the parity fuzz can A/B both arms in one
+    process. Ships OFF: the round-22 A/B (docs/PERF_NOTES.md) measured the
+    CPU-fallback wall; flip per deployment once the win is measured on the
+    target accelerator."""
+    return os.environ.get("KARPENTER_TPU_RELAX2", "0") == "1"
+
+
+def pgd_iters() -> int:
+    """Fixed trip count of the projected-gradient scan (static jit
+    argument). The warm start is the waterfill assignment itself, so the
+    scan only needs enough trips to drain overloaded bins; 24 converges the
+    bench corpora with slack (last_relax2.pgd_iterations tells you where a
+    workload actually lands)."""
+    return max(int(os.environ.get("KARPENTER_TPU_RELAX2_ITERS", "24")), 1)
+
+
+def pgd_step() -> float:
+    """Gradient step size (static jit argument). The gradient is scaled by
+    the pod's normalized demand, so the effective per-unit-mass step is
+    workload-independent; 0.3 is stable against rho=8 (step * rho < 3
+    keeps the capacity term from oscillating)."""
+    return float(os.environ.get("KARPENTER_TPU_RELAX2_STEP", "0.3"))
+
+
+def pgd_tol() -> float:
+    """Host-side convergence tolerance on the final step's max |dX|. Only
+    consulted together with the capacity violation — a still-sliding but
+    capacity-feasible point rounds fine (see ``converged``)."""
+    return float(os.environ.get("KARPENTER_TPU_RELAX2_TOL", "0.01"))
+
+
+class Relax2Stats(NamedTuple):
+    """Device-side relax2 telemetry (fetched in one tiny roundtrip)."""
+
+    eligible: Any  # i32 pods that passed the shared eligibility screen
+    placed: Any  # i32 pods phase 1 committed (post-ladder)
+    demoted: Any  # i32 eligible pods sent to repair (any stage)
+    claims: Any  # i32 claims phase 1 opened
+    pgd_iterations: Any  # i32 first trip where max|dX| < tol (trip count if never)
+    residual: Any  # f32 final max|dX|
+    capviol: Any  # f32 final max fractional bin overload (load - 1)+
+    overflow: Any  # i32 eligible pods whose slot window fell beyond C
+    round_demoted: Any  # i32 eligible pods the rounding (pre-ladder) demoted
+
+
+_SCAN_TOL = 1e-3  # device-side tolerance for the iterations-to-convergence
+# counter only; the go/no-go convergence decision is the host's (pgd_tol)
+
+
+def _pgd_step_op(X, valid, absc, price, wcol, C, step):
+    """One projected-gradient step over the windowed fractional assignment:
+    scatter bin loads, form the mass-weighted gradient, clip to the box,
+    radially rescale over-full rows back onto the simplex. This is the
+    entire scan-body math — census-pinned by tests/test_kernel_census.py
+    (relax2_scan_body_jaxpr_eqns) and iteration-count invariant because
+    the scan traces it exactly once."""
+    cidx = jnp.where(valid, absc, C)
+    load = jnp.zeros((C,), jnp.float32).at[cidx].add(X * wcol, mode="drop")
+    over = jnp.maximum(load - 1.0, 0.0)
+    overp = jnp.where(valid, over[jnp.clip(absc, 0, C - 1)], 0.0)
+    grad = wcol * (price - 1.0 + _RHO * overp)
+    Xn = jnp.where(valid, jnp.clip(X - step * grad, 0.0, 1.0), 0.0)
+    rowsum = jnp.sum(Xn, axis=1)
+    Xn = Xn / jnp.maximum(rowsum, 1.0)[:, None]
+    return Xn, jnp.max(over)
+
+
+def _round_lff(X, valid, absc, w, C):
+    """Deterministic largest-fraction-first rounding with per-bin capacity
+    bookkeeping: each pod's heaviest window column names its bin; pods sort
+    by (bin, -fraction, index); a segmented prefix sum over the sorted
+    normalized demands admits pods while the bin's scalar load stays <= 1.
+    Pods whose best fraction falls below the uniform share of their valid
+    window (the solve evicted them — see _MIN_REL_MASS) go to repair.
+    Returns (slot, admitted, cand)."""
+    P = X.shape[0]
+    pidx = jnp.arange(P, dtype=jnp.int32)
+    Xm = jnp.where(valid, X, -1.0)
+    bestj = jnp.argmax(Xm, axis=1).astype(jnp.int32)
+    frac = Xm[pidx, bestj]
+    slot = absc[pidx, bestj].astype(jnp.int32)
+    nvalid = jnp.sum(valid, axis=1).astype(jnp.float32)
+    cand = frac * nvalid >= _MIN_REL_MASS  # no valid column -> frac=-1 -> out
+    key_bin = jnp.where(cand, slot, C).astype(jnp.int32)
+    order = jnp.lexsort((pidx, -frac, key_bin))
+    ws = jnp.where(cand, w, 0.0)[order]
+    bs = key_bin[order]
+    cum = jnp.cumsum(ws)
+    newseg = jnp.concatenate([jnp.ones((1,), bool), bs[1:] != bs[:-1]])
+    segbase = lax.cummax(jnp.where(newseg, cum - ws, -jnp.inf))
+    binload = cum - segbase
+    admit_sorted = (binload <= 1.0 + 1e-6) & (bs < C)
+    admitted = jnp.zeros((P,), bool).at[order].set(admit_sorted)
+    return slot, admitted, cand
+
+
+def _relax2_impl(
+    problem: SchedulingProblem,
+    C: int,
+    bounds_free: bool,
+    iters: int,
+    step: float,
+    n_passes: int,
+) -> RelaxOut:
+    statics = _statics(problem, bounds_free)
+    plan = plan_groups(problem, C, statics)
+    elig, gid, gidc, hp, w = plan.elig, plan.gid, plan.gidc, plan.hp, plan.w
+    P = problem.num_pods
+
+    # -- slot windows: ceil(group mass) + 1 bins per group (the slack bin
+    # absorbs integral fragmentation the fractional optimum doesn't see)
+    gw = jnp.zeros((C,), jnp.float32).at[jnp.where(elig, gid, C)].add(
+        w, mode="drop"
+    )
+    nbins = jnp.where(
+        plan.gvalid & (gw > 0),
+        jnp.minimum(jnp.ceil(gw).astype(jnp.int32) + 1, C),
+        0,
+    )
+    slotbase = jnp.cumsum(nbins) - nbins  # exclusive prefix
+    lo = slotbase[gidc]  # [P]
+    hi = lo + nbins[gidc]  # [P]
+
+    # -- warm start: the waterfill bin (same prefix-sum level as relax.py).
+    # It doubles as the symmetry anchor — identical pods get DISTINCT
+    # preferred bins, so the rounding never has to break a tie the
+    # objective left open.
+    csum = jnp.cumsum(w)
+    level = (csum - w) - (csum - w)[hp][gidc]
+    binp = jnp.maximum(jnp.floor(level + 1e-6).astype(jnp.int32), 0)
+    pref = jnp.minimum(lo + binp, jnp.maximum(hi - 1, lo))  # [P]
+
+    offs = jnp.arange(_WINDOW, dtype=jnp.int32)[None, :]  # [1, W]
+    absc = pref[:, None] + offs - _WINDOW // 2  # [P, W]
+    valid = (
+        elig[:, None] & (absc >= lo[:, None]) & (absc < hi[:, None]) & (absc < C)
+    )
+    has_bin = jnp.any(valid, axis=1)
+    overflow = elig & ~has_bin  # window truncated past the claim axis
+
+    price = (
+        _GAMMA * (absc - lo[:, None]).astype(jnp.float32)
+        + _BETA * jnp.abs(absc - pref[:, None]).astype(jnp.float32)
+    )
+    wcol = w[:, None]
+    X0 = jnp.where(valid & (absc == pref[:, None]), 1.0, 0.0)
+
+    def body(carry, t):
+        X, conv, _ = carry
+        Xn, capviol = _pgd_step_op(X, valid, absc, price, wcol, C, step)
+        delta = jnp.max(jnp.abs(Xn - X))
+        conv = jnp.where((conv < 0) & (delta < _SCAN_TOL), t + 1, conv)
+        return (Xn, conv, delta), None
+
+    init = (X0, jnp.int32(-1), jnp.float32(jnp.inf))
+    (X, conv, delta), _ = lax.scan(
+        body, init, jnp.arange(iters, dtype=jnp.int32)
+    )
+    _, capviol = _pgd_step_op(X, valid, absc, price, wcol, C, step)
+
+    # -- rounding + the shared real-gate ladder/commit
+    slot, admitted, cand = _round_lff(X, valid, absc, w, C)
+    assigned0 = elig & admitted & (slot < C)
+    com = commit_assignment(
+        problem, C, statics, plan, slot, assigned0, n_passes
+    )
+    stats = Relax2Stats(
+        eligible=jnp.sum(plan.elig0).astype(jnp.int32),
+        placed=jnp.sum(com.assigned).astype(jnp.int32),
+        demoted=jnp.sum(plan.elig0 & ~com.assigned).astype(jnp.int32),
+        claims=jnp.sum(com.open_c).astype(jnp.int32),
+        pgd_iterations=jnp.where(conv >= 0, conv, iters).astype(jnp.int32),
+        residual=delta.astype(jnp.float32),
+        capviol=capviol.astype(jnp.float32),
+        overflow=jnp.sum(overflow).astype(jnp.int32),
+        round_demoted=jnp.sum(elig & ~assigned0).astype(jnp.int32),
+    )
+    return RelaxOut(
+        state=com.state, kind=com.kind, index=com.index,
+        residue_active=com.residue_active, stats=stats,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2, 3, 4, 5))
+def _relax2_place_jit(
+    problem: SchedulingProblem,
+    max_claims: int,
+    bounds_free: bool,
+    iters: int,
+    step: float,
+    n_passes: int,
+) -> RelaxOut:
+    problem = _pad_lanes_mult32(problem)
+    return _relax2_impl(problem, max_claims, bounds_free, iters, step, n_passes)
+
+
+def relax2_place(
+    problem: SchedulingProblem, max_claims: int, init: Optional[FFDState] = None
+) -> RelaxOut:
+    """The convex phase-1 solve (see module docstring). ``init`` must be
+    None — phase 1 only ever runs on a fresh solve; the signature matches
+    the other entry points for the backend/aot dispatch plumbing."""
+    assert init is None, "relaxation always starts a fresh solve"
+    return _relax2_place_jit(
+        problem, int(max_claims), problem_bounds_free(problem),
+        pgd_iters(), pgd_step(), relax_passes(),
+    )
+
+
+def converged(residual: float, capviol: float) -> bool:
+    """The go/no-go rounding decision: a point still sliding AND still
+    capacity-violating at the trip limit is not worth rounding (the ladder
+    would demote most of it anyway) — the backend stands down with
+    reason="non-convergence". A capacity-feasible point rounds fine even if
+    mass is still drifting between equivalent bins."""
+    return residual <= pgd_tol() or capviol <= _CAPVIOL_OK
+
+
+def classify_ineligible(problem: SchedulingProblem) -> str:
+    """Name the dominant blocker when the shared screen left nothing
+    eligible (host-side numpy, bounded vocabulary): "ports" when port-bearing
+    pods dominate, "topology" when topology-role pods dominate, else
+    "no-eligible" (hostname pins, node candidates, mixed causes)."""
+    import numpy as np
+
+    active = np.asarray(problem.pod_active)
+    n_port = n_topo = 0
+    if problem.pod_ports.shape[1] > 0:
+        ports = np.any(np.asarray(problem.pod_ports), axis=1) | np.any(
+            np.asarray(problem.pod_port_conflict), axis=1
+        )
+        n_port = int(np.sum(active & ports))
+    G = problem.grp_key.shape[0]
+    if G > 0:
+        blocking = np.asarray(problem.grp_inverse) | (
+            np.asarray(problem.grp_type) == TYPE_ANTI_AFFINITY
+        )
+        topo = (
+            np.any(np.asarray(problem.pod_grp_match), axis=1)
+            | np.any(np.asarray(problem.pod_grp_owned), axis=1)
+            | np.any(
+                np.asarray(problem.pod_grp_selects) & blocking[None, :], axis=1
+            )
+        )
+        n_topo = int(np.sum(active & topo))
+    if n_port >= n_topo and n_port > 0:
+        return "ports"
+    if n_topo > 0:
+        return "topology"
+    return "no-eligible"
+
+
+# re-exported so callers (and the satellite parity test) can assert both
+# solvers consume the literally-same screen and mask builder
+_eligibility = eligibility

@@ -1,0 +1,445 @@
+"""Shared machinery for the phase-1 relaxation solvers (round 22).
+
+Both phase-1 solvers — the round-15 waterfill (ops/relax.py,
+KARPENTER_TPU_RELAX) and the round-22 projected-gradient convex solve
+(ops/relax2.py, KARPENTER_TPU_RELAX2) — run the same pipeline around their
+bin-assignment math:
+
+  screen -> bin-groups -> template pick -> [assignment math] -> real-gate
+  rounding ladder -> committed FFDState + residue
+
+Until round 22 the screen and eligibility mask lived only in relax.py and a
+second solver would have had to duplicate them; duplicated over-approximate
+screens drift (a pod one screen demotes and the other keeps is a latent
+correctness split the gate would catch only at solve time). This module is
+the single home:
+
+  - ``relax_applicable``: the ONE host-side screen (numpy, pre-jit);
+  - ``eligibility``: the ONE traced eligibility mask builder;
+  - ``plan_groups``: bin-groups over adjacent byte-equal eligible pods,
+    template pick per group, and the best-packing instance-type capacity
+    vector / normalized scalar demand every assignment math consumes;
+  - ``commit_assignment``: the REAL instance-type-gate rounding ladder over
+    a proposed (slot, assigned) and the FFDState/verdict/topology commit.
+
+Everything here is pure code motion from relax.py — the waterfill's traced
+program is op-for-op what it was before the split (the relax census budget
+in tests/test_kernel_census.py holds the line)."""
+
+from dataclasses import replace
+from typing import Any, NamedTuple
+
+import jax.numpy as jnp
+from jax import vmap
+
+from karpenter_tpu.models.problem import (
+    HOSTNAME_KEY,
+    ReqTensor,
+    SchedulingProblem,
+)
+from karpenter_tpu.ops import masks
+from karpenter_tpu.ops.ffd_core import (
+    FFDState,
+    KIND_CLAIM,
+    KIND_FAIL,
+    KIND_NEW_CLAIM,
+    _first_true,
+    _intersect_rows,
+    _make_it_gate,
+    _mix_req_rows,
+    _pin_hostname,
+    initial_state,
+)
+from karpenter_tpu.ops.topology_kernels import (
+    TYPE_ANTI_AFFINITY,
+    PodTopoStatics,
+    record_delta,
+)
+
+
+def relax_applicable(problem: SchedulingProblem) -> bool:
+    """Host-side screen (numpy, pre-jit) shared by BOTH phase-1 solvers:
+    finite nodepool limits make claim opens burn ``remaining`` sequentially,
+    which no vectorized open can reproduce — the backend skips the phase-1
+    dispatch entirely."""
+    import numpy as np
+
+    return bool(np.all(np.isinf(np.asarray(problem.tpl_remaining))))
+
+
+def eligibility(problem: SchedulingProblem, state0: FFDState, statics):
+    """bool[P] — pods phase 1 may place, by construction of the mask unable
+    to interact with any phase-2 pod except through claim membership:
+
+      - host ports reserve per-claim lanes sequentially -> demoted;
+      - matched topology groups are GATED by counters other pods move;
+        owned groups feed inverse (anti-affinity) gates; pods selected by an
+        inverse or anti-affinity group record into a BLOCKING gate, and
+        recording out of queue order could fail a pod FFD would have placed
+        -> all demoted. Pods selected by spread/affinity groups stay: their
+        recording only rides domains spread pods also mint fresh, and the
+        validator + parity corpus hold the line (docs/PERF_NOTES.md r15);
+      - a hostname requirement may pin to another claim's minted lane;
+      - any possibly-compatible existing node (over-approximate screen at
+        the INITIAL node state — node gates only narrow as the solve fills
+        them) must keep node-priority semantics -> demoted;
+      - finite remaining headroom disables relaxation (traced twin of
+        relax_applicable, for direct kernel callers)."""
+    lv, ln = statics.lv, statics.ln
+    bounds_free = statics.bounds_free
+    G = problem.grp_key.shape[0]
+    N = problem.num_nodes
+    pr = problem.pod_reqs
+    req = jnp.asarray(problem.pod_requests)
+
+    elig = jnp.asarray(problem.pod_active)
+    if problem.pod_ports.shape[1] > 0:
+        elig &= ~jnp.any(problem.pod_ports, axis=1)
+        elig &= ~jnp.any(problem.pod_port_conflict, axis=1)
+    if G > 0:
+        elig &= ~jnp.any(problem.pod_grp_match, axis=1)
+        elig &= ~jnp.any(problem.pod_grp_owned, axis=1)
+        blocking = problem.grp_inverse | (problem.grp_type == TYPE_ANTI_AFFINITY)
+        elig &= ~jnp.any(problem.pod_grp_selects & blocking[None, :], axis=1)
+    elig &= ~pr.defined[:, HOSTNAME_KEY]
+    elig &= jnp.all(jnp.isinf(state0.remaining))
+    if N > 0:
+        node_fit = masks.fits(
+            jnp.asarray(problem.node_overhead)[None, :, :] + req[:, None, :],
+            jnp.asarray(problem.node_avail)[None, :, :],
+        )  # [P, N]
+        pod_packed = masks.pack_lanes(pr.admitted)
+        pod_neg = vmap(lambda r: masks.negative_polarity(r, lv, ln, bounds_free))(pr)
+        node_packed = masks.pack_lanes(jnp.asarray(problem.node_reqs.admitted))
+        node_neg = vmap(
+            lambda r: masks.negative_polarity(r, lv, ln, bounds_free)
+        )(problem.node_reqs)
+        compat = masks.packed_pairwise_compat(
+            pr, pod_packed, pod_neg,
+            problem.node_reqs, node_packed, node_neg, bounds_free,
+        )  # [P, N] — allowance-free, exactly the node gate's no_allow
+        maybe = jnp.asarray(problem.pod_tol_node) & node_fit & compat
+        if problem.pod_vol_counts.shape[1] > 0:
+            vol_ok = jnp.all(
+                jnp.asarray(problem.node_vol_used)[None, :, :]
+                + jnp.asarray(problem.pod_vol_counts)[:, None, :]
+                <= jnp.asarray(problem.node_vol_limits)[None, :, :],
+                axis=-1,
+            )
+            maybe &= vol_ok
+        elig &= ~jnp.any(maybe, axis=1)
+    return elig
+
+
+class GroupPlan(NamedTuple):
+    """The shared pre-assignment landscape: bin-groups, the template each
+    group packs on, and the normalized scalar demand the assignment math
+    (waterfill prefix sum OR projected-gradient polytope) consumes."""
+
+    state0: FFDState
+    it_gate: Any  # the real instance-type gate closure (traced kernel)
+    elig0: Any  # bool[P] raw eligibility screen
+    elig: Any  # bool[P] after group cap + template validity
+    head: Any  # bool[P] group head pods
+    gid: Any  # i32[P] group id (valid where elig0)
+    gidc: Any  # i32[P] clip(gid, 0, C-1)
+    hp: Any  # i32[C] head pod index per group
+    gvalid: Any  # bool[C]
+    merged: Any  # ReqTensor [C, TPL, ...] template rows merged with the head
+    tpick: Any  # i32[C] picked template per group
+    prior: Any  # bool[TPL, T]
+    overhead: Any  # f32[TPL, R]
+    capvec: Any  # f32[C, R] best-packing instance-type capacity per group
+    size: Any  # f32[P] normalized scalar demand against capvec
+    w: Any  # f32[P] = where(elig, size, 0)
+
+
+class Commit(NamedTuple):
+    """Result of the shared rounding ladder + state commit."""
+
+    state: FFDState
+    kind: Any  # i32[P]
+    index: Any  # i32[P]
+    residue_active: Any  # bool[P]
+    assigned: Any  # bool[P] final (post-ladder) assignment
+    open_c: Any  # bool[C] claims committed open
+
+
+def plan_groups(
+    problem: SchedulingProblem, C: int, statics
+) -> GroupPlan:
+    """Steps 1-3 of the phase-1 pipeline (see relax.py module docstring):
+    eligibility, bin-groups over adjacent byte-equal eligible pods, template
+    pick per group, and the best-packing instance-type capacity vector /
+    normalized per-pod scalar demand."""
+    P, R = problem.num_pods, problem.num_resources
+    TPL, T = problem.num_templates, problem.num_instance_types
+    K, V = problem.num_keys, problem.num_lanes
+    bounds_free = statics.bounds_free
+    lv, ln, wellknown = statics.lv, statics.ln, statics.wellknown
+    it_gate = _make_it_gate(problem, statics)
+    state0 = initial_state(problem, C)
+    pr = problem.pod_reqs
+    req = jnp.asarray(problem.pod_requests)
+    pidx = jnp.arange(P, dtype=jnp.int32)
+
+    elig0 = eligibility(problem, state0, statics)
+
+    # -- bin-groups: adjacent eligible pods with byte-equal requirement rows
+    # and template tolerations (requests may differ — the rounding handles
+    # size spread). Direct row comparison, NOT pod_eqprev_gate: that chain
+    # predicate also requires equal requests and gate-blind topology, which
+    # would shatter groups the relaxation merges fine.
+    def eq_prev(a):
+        flat = a.reshape(P, -1)
+        return jnp.all(flat[1:] == flat[:-1], axis=1)
+
+    same = (
+        eq_prev(jnp.asarray(pr.admitted))
+        & eq_prev(jnp.asarray(pr.comp))
+        & eq_prev(jnp.asarray(pr.defined))
+        & eq_prev(jnp.asarray(problem.pod_tol_tpl))
+    )
+    if not bounds_free:
+        same &= eq_prev(jnp.asarray(pr.gt)) & eq_prev(jnp.asarray(pr.lt))
+    same = jnp.concatenate([jnp.zeros((1,), bool), same])
+    join = elig0 & same & jnp.concatenate([jnp.zeros((1,), bool), elig0[:-1]])
+    head = elig0 & ~join
+    gid = jnp.cumsum(head.astype(jnp.int32)) - 1  # [P], valid where elig0
+    # group axis statically capped at C: a group beyond C slots could not
+    # open a claim anyway — demote it wholesale to the repair pass
+    elig = elig0 & (gid < C)
+    head &= gid < C
+    gidc = jnp.clip(gid, 0, C - 1)
+    gscatter = jnp.where(head, gid, C)
+    hp = jnp.zeros((C,), jnp.int32).at[gscatter].set(pidx, mode="drop")
+    gvalid = jnp.zeros((C,), bool).at[gscatter].set(True, mode="drop")
+    escatter = jnp.where(elig, gid, C)
+    gmax = jnp.zeros((C, R), jnp.float32).at[escatter].max(req, mode="drop")
+
+    # -- template pick per group, from the head row (byte-equal across the
+    # group) and the group's elementwise-max request: if the max member fits
+    # an instance type per-resource, every member does
+    rep = pr.row(hp)  # [C, K, V...] representative rows
+    rep_neg = vmap(lambda r: masks.negative_polarity(r, lv, ln, bounds_free))(rep)
+    merged = vmap(lambda r: _intersect_rows(problem.tpl_reqs, r, bounds_free))(
+        rep
+    )  # [C, TPL, K, V...]
+    if bounds_free:
+        tpl_compat = vmap(
+            lambda m, d, n: masks.compatible_from_merged(
+                masks.nonempty(m, True),
+                problem.tpl_reqs.defined, statics.tpl_neg,
+                d, n, wellknown,
+            )
+        )(merged, rep.defined, rep_neg)  # [C, TPL]
+    else:
+        tpl_compat = vmap(
+            lambda row: vmap(
+                lambda tr: masks.compatible_ok(tr, row, lv, ln, wellknown)
+            )(problem.tpl_reqs)
+        )(rep)
+    within_limits = masks.fits(
+        jnp.asarray(problem.it_cap)[None, :, :], state0.remaining[:, None, :]
+    )  # [TPL, T]
+    prior = jnp.asarray(problem.tpl_it_ok) & within_limits  # [TPL, T]
+    tol = jnp.asarray(problem.pod_tol_tpl)[hp]  # [C, TPL]
+    overhead = jnp.asarray(problem.tpl_overhead)  # [TPL, R]
+    flat_rows = ReqTensor(
+        admitted=merged.admitted.reshape(C * TPL, K, V),
+        comp=merged.comp.reshape(C * TPL, K),
+        gt=merged.gt.reshape(C * TPL, K),
+        lt=merged.lt.reshape(C * TPL, K),
+        defined=merged.defined.reshape(C * TPL, K),
+    )
+    # instance-type survival against the max member; hostname pinning cannot
+    # move this gate (instance types never define the hostname key), and the
+    # committed claim_it_ok below re-runs it on the pinned rows regardless
+    it_ok_max = it_gate(
+        flat_rows,
+        (overhead[None, :, :] + gmax[:, None, :]).reshape(C * TPL, R),
+        jnp.tile(prior, (C, 1)),
+    ).reshape(C, TPL, T)
+    tpl_ok = tol & tpl_compat & jnp.any(it_ok_max, axis=-1)  # [C, TPL]
+    tpick = vmap(_first_true)(tpl_ok).astype(jnp.int32)  # [C]; TPL when none
+    gvalid &= jnp.any(tpl_ok, axis=1)
+    tpick = jnp.minimum(tpick, TPL - 1)
+    elig &= gvalid[gidc]
+
+    # -- normalized demand against the group's best-packing instance type:
+    # the scalar every assignment math waterfills / optimizes over
+    garange = jnp.arange(C)
+    it_pick_ok = it_ok_max[garange, tpick]  # [C, T]
+    capvec_t = (
+        jnp.asarray(problem.it_alloc)[None, :, :] - overhead[tpick][:, None, :]
+    )  # [C, T, R]
+    gsum = jnp.zeros((C, R), jnp.float32).at[
+        jnp.where(elig, gid, C)
+    ].add(jnp.where(elig[:, None], req, 0.0), mode="drop")
+    demand = gsum[:, None, :] > 0  # [C, 1->T, R]
+    frac = jnp.max(
+        jnp.where(demand, gsum[:, None, :] / jnp.maximum(capvec_t, 1e-9), 0.0),
+        axis=-1,
+    )  # [C, T] fractional bins if the group packed on that instance type
+    no_room = jnp.any(demand & (capvec_t <= 0), axis=-1)
+    frac = jnp.where(no_room, jnp.inf, frac)
+    tau = jnp.argmin(jnp.where(it_pick_ok, frac, jnp.inf), axis=-1)  # [C]
+    capvec = jnp.asarray(problem.it_alloc)[tau] - overhead[tpick]  # [C, R]
+    cv = capvec[gidc]  # [P, R]
+    size = jnp.max(jnp.where(req > 0, req / jnp.maximum(cv, 1e-9), 0.0), axis=-1)
+    size = jnp.clip(size, 1e-6, 1.0)
+    w = jnp.where(elig, size, 0.0)
+    return GroupPlan(
+        state0=state0, it_gate=it_gate, elig0=elig0, elig=elig, head=head,
+        gid=gid, gidc=gidc, hp=hp, gvalid=gvalid, merged=merged, tpick=tpick,
+        prior=prior, overhead=overhead, capvec=capvec, size=size, w=w,
+    )
+
+
+def commit_assignment(
+    problem: SchedulingProblem,
+    C: int,
+    statics,
+    plan: GroupPlan,
+    slot,
+    assigned,
+    n_passes: int,
+) -> Commit:
+    """Steps 4b-5 of the phase-1 pipeline: the REAL instance-type-gate
+    rounding ladder over a proposed assignment (``slot`` i32[P] claim slot
+    per pod, ``assigned`` bool[P]; slots must partition by group — every pod
+    assigned to a slot belongs to the slot's owning group), then the
+    FFDState/verdict/topology commit. Each ladder rung demotes the
+    last-assigned pod of every claim the gate rejects; the final rung
+    demotes whole claims that never became feasible."""
+    P, R = problem.num_pods, problem.num_resources
+    K, V = problem.num_keys, problem.num_lanes
+    G = problem.grp_key.shape[0]
+    wellknown = statics.wellknown
+    lv, ln = statics.lv, statics.ln
+    bounds_free = statics.bounds_free
+    state0, it_gate = plan.state0, plan.it_gate
+    merged, tpick = plan.merged, plan.tpick
+    prior, overhead = plan.prior, plan.overhead
+    gid = plan.gid
+    mint_hostnames = problem.claim_hostname_lane.shape[0] > 0
+    req = jnp.asarray(problem.pod_requests)
+    pidx = jnp.arange(P, dtype=jnp.int32)
+    garange = jnp.arange(C)
+
+    slotc = jnp.clip(slot, 0, C - 1)
+    g_of_c = jnp.zeros((C,), jnp.int32).at[
+        jnp.where(assigned, slot, C)
+    ].max(gid, mode="drop")
+
+    # -- per-claim rows (constant across the ladder): merged template row of
+    # the owning group, pinned to the slot's minted hostname exactly like
+    # _fresh_template_rows does for the narrow step
+    tpl_of_c = tpick[g_of_c]  # [C]
+    rows_c = ReqTensor(
+        admitted=merged.admitted[g_of_c, tpl_of_c],
+        comp=merged.comp[g_of_c, tpl_of_c],
+        gt=merged.gt[g_of_c, tpl_of_c],
+        lt=merged.lt[g_of_c, tpl_of_c],
+        defined=merged.defined[g_of_c, tpl_of_c],
+    )
+    if mint_hostnames:
+        lanes = problem.claim_hostname_lane[
+            jnp.minimum(garange, problem.claim_hostname_lane.shape[0] - 1)
+        ]
+        host1 = jnp.arange(V)[None, :] == lanes[:, None]  # [C, V]
+        rows_c = _pin_hostname(rows_c, host1)
+    else:
+        host1 = jnp.zeros((C, V), bool)
+    prior_c = prior[tpl_of_c]  # [C, T]
+    overhead_c = overhead[tpl_of_c]  # [C, R]
+
+    # -- rounding ladder: the REAL instance-type gate (compat x fits x
+    # offering, same kernel as the narrow step) over every claim; each rung
+    # demotes the last-assigned pod of an infeasible claim, the final rung
+    # demotes whole claims that never became feasible
+    for rung in range(n_passes + 1):
+        sidx = jnp.where(assigned, slot, C)
+        sums = jnp.zeros((C, R), jnp.float32).at[sidx].add(
+            jnp.where(assigned[:, None], req, 0.0), mode="drop"
+        )
+        ok_c = it_gate(rows_c, overhead_c + sums, prior_c)  # [C, T]
+        feas = jnp.any(ok_c, axis=-1)
+        if rung < n_passes:
+            lastp = jnp.full((C,), -1, jnp.int32).at[sidx].max(pidx, mode="drop")
+            assigned &= feas[slotc] | (pidx != lastp[slotc])
+        else:
+            assigned &= feas[slotc]
+
+    # -- commit: final sums/gates over the surviving assignment
+    sidx = jnp.where(assigned, slot, C)
+    npods = jnp.zeros((C,), jnp.int32).at[sidx].add(1, mode="drop")
+    sums = jnp.zeros((C, R), jnp.float32).at[sidx].add(
+        jnp.where(assigned[:, None], req, 0.0), mode="drop"
+    )
+    creq = overhead_c + sums
+    ok_c = it_gate(rows_c, creq, prior_c)
+    open_c = (npods > 0) & jnp.any(ok_c, axis=-1)
+
+    new_registered = state0.grp_registered
+    new_counts = state0.grp_counts
+    if G > 0:
+        if mint_hostnames:
+            # a claim open registers its minted hostname lane for every
+            # hostname-keyed group (mirrors the narrow step's open commit)
+            minted = jnp.any(open_c[:, None] & host1, axis=0)  # [V]
+            new_registered = new_registered | (
+                (problem.grp_key == HOSTNAME_KEY)[:, None] & minted[None, :]
+            )
+        # record_delta depends on the pod only through grp_selects/grp_owned:
+        # one all-select probe per claim row yields the per-group unit delta,
+        # and the per-pod records are that unit scaled by how many assigned
+        # pods of the claim actually select the group (eligible pods never
+        # own, so the inverse term is identically zero)
+        probe = PodTopoStatics(
+            strict_admitted=jnp.zeros((K, V), bool),
+            grp_match=jnp.zeros((G,), bool),
+            grp_selects=jnp.ones((G,), bool),
+            grp_owned=jnp.zeros((G,), bool),
+        )
+        units = vmap(
+            lambda row, committed: record_delta(
+                problem, probe, row, wellknown, committed, lv, ln
+            )
+        )(rows_c, open_c)  # [C, G, V]
+        selcnt = jnp.zeros((C, G), jnp.int32).at[sidx].add(
+            jnp.where(
+                assigned[:, None], jnp.asarray(problem.pod_grp_selects), False
+            ).astype(jnp.int32),
+            mode="drop",
+        )
+        new_counts = new_counts + jnp.sum(
+            selcnt[:, :, None] * units.astype(jnp.int32), axis=0
+        )
+        new_registered = new_registered | jnp.any(
+            (selcnt > 0)[:, :, None] & units, axis=0
+        )
+
+    state1 = replace(
+        state0,
+        claim_req=_mix_req_rows(state0.claim_req, rows_c, open_c, bounds_free),
+        claim_requests=jnp.where(open_c[:, None], creq, 0.0),
+        claim_it_ok=ok_c & open_c[:, None],
+        claim_open=open_c,
+        claim_npods=jnp.where(open_c, npods, 0),
+        claim_tpl=jnp.where(open_c, tpl_of_c, 0),
+        grp_counts=new_counts,
+        grp_registered=new_registered,
+    )
+    firstp = jnp.full((C,), P, jnp.int32).at[sidx].min(pidx, mode="drop")
+    kind = jnp.where(
+        assigned,
+        jnp.where(pidx == firstp[slotc], KIND_NEW_CLAIM, KIND_CLAIM),
+        KIND_FAIL,
+    ).astype(jnp.int32)
+    index = jnp.where(assigned, slot, -1).astype(jnp.int32)
+    residue = jnp.asarray(problem.pod_active) & ~assigned
+    return Commit(
+        state=state1, kind=kind, index=index, residue_active=residue,
+        assigned=assigned, open_c=open_c,
+    )

@@ -456,6 +456,69 @@ def shard_sweeps_program(
     )
 
 
+@functools.lru_cache(maxsize=None)
+def shard_relax2_sweeps_program(
+    mesh: Mesh, max_claims: int, bounds_free: bool, wavefront: int,
+    iters: int, step: float, n_passes: int,
+):
+    """The convex-relaxation twin of ``shard_sweeps_program``
+    (KARPENTER_TPU_RELAX2, round 22): each lane runs the windowed
+    projected-gradient phase-1 solve (ops/relax2.py) and hands its claim
+    landscape straight into the carried sweeps repair — ONE fused program,
+    so the fractional solve, the rounding ladder, and the repair loop share
+    a single dispatch per escalation round and the phase-1 state never
+    round-trips to the host. Per-lane Relax2Stats ride out alongside the
+    FFDResult (vmap gives every scalar stat a [lanes] axis) so the backend
+    can aggregate placed_frac/pgd_iterations without a second fetch.
+
+    Deliberately a sharded ``jit(vmap)``, NOT ``shard_map`` like the fresh
+    sweeps program. Under shard_map on the multi-device SPMD path, the
+    carried repair's data-dependent while_loop MISCOMPILES when the loop
+    carry is phase-1 state (not constants): every device except device 0
+    returns the carry's INPUT state with the state updates dropped, while
+    kinds/idxs partially update — decoded claims then disagree with their
+    own request sums and the per-partition gate rejects the merge
+    (tests/test_shard_parity.py::test_relax2_shard_consistency pins the
+    repro; the fresh path and a cold fresh_carry are unaffected, so
+    shard_sweeps_program keeps shard_map). vmap's batched while runs every
+    lane to the GLOBAL trip count with converged lanes masked — lockstep
+    the shard_map design avoided — but relax2 makes that cheap: phase 1 is
+    a fixed-trip scan and the residue queues are a fraction of the fresh
+    queues, so the worst lane's few extra sweeps cost far less than the
+    round trip a standdown (the alternative) would.
+
+    Cached per (mesh, claim bucket, bounds_free, wavefront, PGD statics) —
+    iters/step/passes are compiled in, mirroring the unsharded relax2
+    program key."""
+    import dataclasses
+
+    from karpenter_tpu.ops.ffd_sweeps import _solve_ffd_sweeps_carried_jit
+    from karpenter_tpu.ops.relax2 import _relax2_place_jit
+
+    def _lane(p: SchedulingProblem):
+        r = _relax2_place_jit.__wrapped__(
+            p, max_claims, bounds_free, iters, step, n_passes
+        )
+        residue = dataclasses.replace(p, pod_active=r.residue_active)
+        res = _solve_ffd_sweeps_carried_jit.__wrapped__(
+            residue, (r.state, r.kind, r.index), max_claims, bounds_free,
+            wavefront,
+        )
+        return res, r.stats
+
+    sharding = NamedSharding(mesh, P(CANDIDATE_AXIS))
+
+    def shard_relax2_sweeps(batch: SchedulingProblem):
+        return jax.vmap(_lane)(batch)
+
+    return jax.jit(
+        shard_relax2_sweeps,
+        in_shardings=sharding,
+        out_shardings=sharding,
+        donate_argnums=(0,),
+    )
+
+
 def scheduled_counts(result: FFDResult) -> jnp.ndarray:
     """[B] number of pods placed per candidate problem — the consolidation
     scoring reduction (does the cluster still fit with these nodes gone?)."""

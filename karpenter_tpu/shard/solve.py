@@ -24,6 +24,10 @@ The pipeline:
    shard_map lays the partition axis across the mesh so each device runs
    its own sweeps while-loop to local convergence. NO_SLOT in any lane
    escalates the shared claim bucket exactly like the unsharded ladder.
+   With KARPENTER_TPU_RELAX2 on, shard_relax2_sweeps_program fuses the
+   per-lane convex phase-1 + carried repair instead — as a sharded
+   jit(vmap), not shard_map (see its docstring for the SPMD miscompile
+   that forces the difference).
 5. **Gate per partition**: each lane's decoded result carries its own
    GateContext (the padded tensors it decoded from) through the existing
    full-level device gate — sound because partitions are constraint-disjoint,
@@ -46,6 +50,7 @@ claims can change claim groupings but never whether a pod schedules.
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -237,6 +242,7 @@ def _try_shard_solve(
 ) -> Optional[SolveResult]:
     from karpenter_tpu.parallel.mesh import (
         default_mesh,
+        shard_relax2_sweeps_program,
         shard_sweeps_program,
         stack_problems,
     )
@@ -372,7 +378,27 @@ def _try_shard_solve(
         from karpenter_tpu.ops.ffd_sweeps import _wavefront_lanes
 
         wavefront = _wavefront_lanes()
-        fn = shard_sweeps_program(mesh, max_claims, bounds_free, wavefront)
+        # KARPENTER_TPU_RELAX2 rides the mesh too: when the stacked batch
+        # is relax-applicable (infinite pools across every lane), each lane
+        # runs the fused convex-solve + carried-repair program instead of
+        # the fresh sweeps — the env check gates the import so the module
+        # never loads flag-off (tests/test_relax2.py pins that).
+        relax2_on = False
+        if os.environ.get("KARPENTER_TPU_RELAX2", "0") == "1":
+            from karpenter_tpu.ops import relax2
+
+            relax2_on = relax2.relax_applicable(batch)
+        if relax2_on:
+            from karpenter_tpu.ops.relax import relax_passes
+
+            r2_statics = (relax2.pgd_iters(), relax2.pgd_step(), relax_passes())
+            fn = shard_relax2_sweeps_program(
+                mesh, max_claims, bounds_free, wavefront, *r2_statics
+            )
+            program_name = "shard_relax2_sweeps"
+        else:
+            fn = shard_sweeps_program(mesh, max_claims, bounds_free, wavefront)
+            program_name = "shard_sweeps"
 
         key = jb._program_key(fn, max_claims, batch)
         cache_hit = key in jb._COMPILED_PROGRAMS
@@ -380,7 +406,7 @@ def _try_shard_solve(
         COMPILE_CACHE.inc({"result": "hit" if cache_hit else "miss"})
         if cache_hit:
             solver.compile_cache_hits += 1
-            span_name = "shard_sweeps"
+            span_name = program_name
         else:
             solver.compile_cache_misses += 1
             span_name = "compile"
@@ -395,7 +421,7 @@ def _try_shard_solve(
 
         aot_handle = aot.maybe_begin(fn, batch, max_claims, None)
         obs = programs.begin_dispatch(
-            "shard_sweeps", max_claims, batch,
+            program_name, max_claims, batch,
             statics={
                 "partitions": len(plan.parts), "devices": n_dev,
                 "bounds_free": bounds_free, "wavefront": wavefront,
@@ -404,13 +430,16 @@ def _try_shard_solve(
         with trace.span(
             span_name,
             cache="hit" if cache_hit else "miss",
-            program="shard_sweeps",
+            program=program_name,
             partitions=len(plan.parts),
         ) as sp:
             if aot_handle is not None:
                 result = aot_handle.call()
             else:
                 result = fn(batch)
+            r2_stats = None
+            if relax2_on:
+                result, r2_stats = result
             state = result.state
             fetched = jax.device_get(
                 (
@@ -431,7 +460,9 @@ def _try_shard_solve(
             (kinds, indices, iters, claim_open, claim_tpl, claim_it_ok,
              claim_requests, claim_adm, claim_comp, claim_gt, claim_lt,
              claim_def) = fetched
-            d2h = _nbytes(fetched)
+            if r2_stats is not None:
+                r2_stats = jax.device_get(r2_stats)
+            d2h = _nbytes(fetched) + _nbytes(r2_stats)
             TRANSFER_BYTES.inc({"direction": "d2h"}, d2h)
             if obs is not None:
                 source = obs.finish(
@@ -603,6 +634,27 @@ def _try_shard_solve(
     solver.last_iters = None
     solver.last_wave_hist = None
     solver.last_relax = None
+    solver.last_relax2 = None
+    if r2_stats is not None:
+        # real lanes come first in the stack; inert pad lanes contribute
+        # zeros anyway, but slice to keep the aggregates honest
+        k = len(plan.parts)
+        solver.last_relax2 = {
+            "reason": None,
+            "sharded": True,
+            "lanes": k,
+            "eligible": int(np.asarray(r2_stats.eligible)[:k].sum()),
+            "placed": int(np.asarray(r2_stats.placed)[:k].sum()),
+            "demoted": int(np.asarray(r2_stats.demoted)[:k].sum()),
+            "claims": int(np.asarray(r2_stats.claims)[:k].sum()),
+            "pgd_iterations": int(np.asarray(r2_stats.pgd_iterations)[:k].max()),
+            "residual": float(np.asarray(r2_stats.residual)[:k].max()),
+            "capviol": float(np.asarray(r2_stats.capviol)[:k].max()),
+            "rounding": {
+                "overflow": int(np.asarray(r2_stats.overflow)[:k].sum()),
+                "demoted": int(np.asarray(r2_stats.round_demoted)[:k].sum()),
+            },
+        }
     solver.last_shard = {
         "reason": None,
         "partitions": len(plan.parts),

@@ -241,6 +241,28 @@ def _call_spec(solve_name: str, problem, max_claims: int, init) -> Optional[_Spe
             (problem,),
             (f"C{int(max_claims)}", f"bf{int(bf)}", f"rp{int(rp)}"),
         )
+    if solve_name == "relax2_place":
+        # the convex phase-1 program (ops/relax2.py): iteration count and
+        # step size are static scan/gradient parameters baked into the
+        # executable, so they key the table entry alongside the claim bucket
+        from karpenter_tpu.ops.relax import relax_passes
+        from karpenter_tpu.ops.relax2 import (
+            _relax2_place_jit,
+            pgd_iters,
+            pgd_step,
+        )
+
+        bf = problem_bounds_free(problem)
+        rp = relax_passes()
+        it = pgd_iters()
+        st = pgd_step()
+        return _Spec(
+            _relax2_place_jit,
+            (problem, int(max_claims), bf, it, st, rp),
+            (problem,),
+            (f"C{int(max_claims)}", f"bf{int(bf)}", f"it{int(it)}",
+             f"st{st:g}", f"rp{int(rp)}", "relax2"),
+        )
     if solve_name == "verify_gate":
         # the device verification program (verify/device.py): ``problem`` is
         # a GateProblem view and ``init`` carries (GateArgs, bounds_free) —

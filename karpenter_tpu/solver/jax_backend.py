@@ -23,6 +23,14 @@ full-gated through the validator before the backend returns it; a violation
 triggers one fallback re-solve with relaxation off
 (solver_relax_fallback_total). Flag off, nothing changes: same programs,
 bit-identical placements.
+
+Convex phase 1 (KARPENTER_TPU_RELAX2, round 22): when enabled, the backend
+tries the projected-gradient convex solve (ops/relax2.py) ahead of the
+waterfill — same carried handoff, same full-gate contract, its own
+allow_relax2 retry latch. Standdowns are classified into the round-15
+counter (solver_relax_fallback_total{reason}) and recorded in last_relax2;
+any standdown falls through to the waterfill unchanged. The module is
+imported lazily, so flag off the solve path never loads it.
 """
 
 from __future__ import annotations
@@ -280,8 +288,15 @@ class JaxSolver(SolverBackend):
         # after a validator fallback, since the returned placements are then
         # not relaxed
         self.last_relax = None
+        # convex phase-1 telemetry of the LAST solve (KARPENTER_TPU_RELAX2,
+        # ops/relax2.py): {"reason": None, placed, pgd_iterations, phase_s,
+        # ...} when the returned result rode relax2, {"reason": <classified>}
+        # on a standdown (solver_relax_fallback_total{reason}), None when the
+        # phase never ran (flag off, or relaxable pods kept sweeps mode off)
+        self.last_relax2 = None
         # lifetime count of full-gate rejections that forced a re-solve with
-        # relaxation off (mirrors solver_relax_fallback_total per backend)
+        # relaxation off (mirrors solver_relax_fallback_total per backend,
+        # both phase-1 flavors)
         self.relax_fallbacks = 0
         # telemetry dict of the LAST partitioned-solve attempt
         # (KARPENTER_TPU_SHARD, shard/solve.py): {"reason": None, partitions,
@@ -330,11 +345,13 @@ class JaxSolver(SolverBackend):
         bound_executable_maps()
         t0 = _t("maps-guard", t0)
         self.last_explain = None  # never misattribute a prior solve's report
+        self.last_relax2 = None  # ditto for the convex phase-1 record
         max_claims = min(self.claim_slots, claim_axis_bucket(len(pods)))
         # passthrough: when the supervisor (or provisioner) already opened
         # this cycle, phases land directly under its span; a direct backend
         # call becomes its own cycle root
         allow_relax = True
+        allow_relax2 = True
         with trace.cycle(
             "solve", backend=type(self).__name__, passthrough=True, pods=len(pods)
         ), self._dispatch_device(len(pods), len(nodes)):
@@ -377,7 +394,7 @@ class JaxSolver(SolverBackend):
                     result = self._solve_with_slots(
                         pods, instance_types, templates, nodes,
                         pod_requirements_override, topology, cluster_pods, domains,
-                        max_claims, pod_volumes, allow_relax,
+                        max_claims, pod_volumes, allow_relax, allow_relax2,
                     )
                 except _SlotOverflow:
                     if max_claims >= len(pods):
@@ -394,12 +411,17 @@ class JaxSolver(SolverBackend):
                     with trace.span("escalate", max_claims=max_claims):
                         pass
                     continue
-                if self.last_relax is not None:
+                relax2_used = (
+                    self.last_relax2 is not None
+                    and self.last_relax2.get("reason") is None
+                )
+                if self.last_relax is not None or relax2_used:
                     # the relaxed-solve contract: phase-1 placements are
                     # validator-equivalent rather than bit-identical, so EVERY
                     # result the two-phase path produced is full-gated before
-                    # it leaves the backend; a violation falls back to one
-                    # pure-FFD re-solve (the safe, parity-proven path). The
+                    # it leaves the backend — waterfill and convex phase 1
+                    # alike; a violation falls back to a re-solve with the
+                    # offending phase off (the safe, parity-proven path). The
                     # gate rides the device program when the result carries a
                     # GateContext (verify/, KARPENTER_TPU_DEVICE_GATE) — the
                     # change that makes relax-by-default affordable — and is
@@ -411,9 +433,13 @@ class JaxSolver(SolverBackend):
                         pod_requirements_override, cluster_pods, domains,
                     )
                     if violations:
-                        RELAX_FALLBACK.inc()
+                        RELAX_FALLBACK.inc({"reason": "gate-rejected"})
                         self.relax_fallbacks += 1
-                        allow_relax = False
+                        if relax2_used:
+                            allow_relax2 = False
+                            self.last_relax2 = {"reason": "gate-rejected"}
+                        else:
+                            allow_relax = False
                         with trace.span(
                             "relax_fallback", violations=len(violations)
                         ):
@@ -606,10 +632,125 @@ class JaxSolver(SolverBackend):
             return None
         return rout
 
+    def _relax2_standdown(self, reason, **info):
+        """Classified convex-phase-1 standdown: count it on the round-15
+        fallback counter (bounded vocabulary, ops/relax2.STANDDOWN_REASONS),
+        record it for supervisor.status()/bench, and fall through to the
+        waterfill/sweeps path by returning None. Mirrors shard/solve.py's
+        _standdown playbook."""
+        RELAX_FALLBACK.inc({"reason": reason})
+        self.last_relax2 = {"reason": reason, **info}
+        with trace.span("relax2_standdown", reason=reason):
+            pass
+        return None
+
+    def _relax2_phase(self, problem, max_claims):
+        """Convex phase 1 (KARPENTER_TPU_RELAX2): dispatch the projected-
+        gradient + rounding program (ops/relax2.py) and return its RelaxOut,
+        or None on a classified standdown — the waterfill and the sweeps
+        repair then run exactly as if the flag were off. Instrumented like
+        _relax_phase; lazy import keeps the module off the flag-off solve
+        path entirely."""
+        import time as _time_mod
+
+        from karpenter_tpu.ops import relax2
+
+        t_phase = _time_mod.perf_counter()
+        try:
+            if not relax2.relax_applicable(problem):
+                return self._relax2_standdown("finite-pool")
+            relax2_place = relax2.relax2_place
+            key = _program_key(relax2_place, max_claims, problem)
+            cache_hit = key in _COMPILED_PROGRAMS
+            _COMPILED_PROGRAMS.add(key)
+            COMPILE_CACHE.inc({"result": "hit" if cache_hit else "miss"})
+            if cache_hit:
+                self.compile_cache_hits += 1
+                span_name = "relax2"
+            else:
+                self.compile_cache_misses += 1
+                span_name = "compile"
+            prob_bytes = _nbytes(problem)
+            TRANSFER_BYTES.inc({"direction": "h2d"}, prob_bytes)
+            reg_eqns = None
+            if not cache_hit and programs.eqns_enabled():
+                reg_eqns = programs.maybe_count_eqns(
+                    lambda: jax.make_jaxpr(
+                        lambda: relax2_place(problem, max_claims)
+                    )()
+                )
+            aot_handle = aot.maybe_begin(relax2_place, problem, max_claims, None)
+            obs = programs.begin_dispatch(
+                relax2_place.__name__, max_claims, problem
+            )
+            with trace.span(
+                span_name,
+                cache="hit" if cache_hit else "miss",
+                program=relax2_place.__name__,
+            ) as sp:
+                if aot_handle is not None:
+                    rout = aot_handle.call()
+                else:
+                    rout = relax2_place(problem, max_claims)
+                # the stats scalars are all the host needs; state and verdict
+                # tensors stay on device and ride the carried sweeps dispatch
+                stats = jax.device_get(rout.stats)
+                d2h = _nbytes(stats)
+                TRANSFER_BYTES.inc({"direction": "d2h"}, d2h)
+                if obs is not None:
+                    source = obs.finish(
+                        problem_bytes=prob_bytes,
+                        result_bytes=d2h,
+                        eqns=reg_eqns,
+                        source_override=(
+                            aot_handle.source_override
+                            if aot_handle is not None else None
+                        ),
+                    )
+                    if sp is not None:
+                        sp.attrs["program_key"] = obs.key
+                        sp.attrs["cache_source"] = source
+            eligible = int(stats.eligible)
+            residual = float(stats.residual)
+            capviol = float(stats.capviol)
+            if eligible <= 0:
+                return self._relax2_standdown(
+                    relax2.classify_ineligible(problem)
+                )
+            if not relax2.converged(residual, capviol):
+                return self._relax2_standdown(
+                    "non-convergence", residual=residual, capviol=capviol,
+                    pgd_iterations=int(stats.pgd_iterations),
+                )
+            if int(stats.placed) <= 0:
+                return self._relax2_standdown(
+                    "rounding-overflow", eligible=eligible,
+                    overflow=int(stats.overflow),
+                    round_demoted=int(stats.round_demoted),
+                )
+            self.last_relax2 = {
+                "reason": None,
+                "eligible": eligible,
+                "placed": int(stats.placed),
+                "demoted": int(stats.demoted),
+                "claims": int(stats.claims),
+                "pgd_iterations": int(stats.pgd_iterations),
+                "residual": residual,
+                "capviol": capviol,
+                "rounding": {
+                    "overflow": int(stats.overflow),
+                    "demoted": int(stats.round_demoted),
+                },
+                "phase_s": round(_time_mod.perf_counter() - t_phase, 6),
+            }
+            return rout
+        except Exception as exc:  # never trade latency for an unsolved batch
+            return self._relax2_standdown("error", error=repr(exc))
+
     def _solve_with_slots(
         self, pods, instance_types, templates, nodes,
         pod_requirements_override, topology, cluster_pods, domains, max_claims,
-        pod_volumes=None, allow_relax=True,
+        pod_volumes=None, allow_relax=True, allow_relax2=True,
     ) -> SolveResult:
         t_init = _now()
         self.last_relax = None  # never misattribute a prior attempt's phase 1
@@ -723,8 +864,23 @@ class JaxSolver(SolverBackend):
                     solve = solve_ffd_sweeps
             else:
                 solve = solve_ffd
+            rout = None
             if (
                 use_sweeps
+                and allow_relax2
+                and state is None
+                and _os.environ.get("KARPENTER_TPU_RELAX2", "0") == "1"
+            ):
+                # convex phase 1 (KARPENTER_TPU_RELAX2): projected-gradient
+                # solve over the fractional pod x bin polytope, rounded and
+                # committed (ops/relax2.py). None = classified standdown
+                # (solver_relax_fallback_total{reason}) — fall through to
+                # the waterfill unchanged. The env check here (not a relax2
+                # helper) keeps the module un-imported on the flag-off path.
+                rout = self._relax2_phase(problem, max_claims)
+            if (
+                rout is None
+                and use_sweeps
                 and allow_relax
                 and state is None
                 and relax.enabled()
@@ -736,6 +892,7 @@ class JaxSolver(SolverBackend):
                 # verdicts. Sweeps mode runs exactly one pass, so phase 1
                 # only ever fires here with fresh state.
                 rout = self._relax_phase(problem, max_claims)
+            if use_sweeps:
                 if rout is not None:
                     import dataclasses
 

@@ -257,6 +257,14 @@ class SupervisedSolver(SolverBackend):
             # it; otherwise the classified standdown that sent the solve to
             # the ordinary unsharded program
             out["shard"] = last_shard
+        last_relax2 = getattr(self.primary, "last_relax2", None)
+        if last_relax2 is not None:
+            # the convex phase-1 attempt of the last supervised solve
+            # (KARPENTER_TPU_RELAX2): reason=None means the returned result
+            # rode relax2 (phase walls, iterations-to-convergence, placed
+            # counts, rounding stats); otherwise the classified standdown
+            # that sent phase 1 back to the waterfill/sweeps path
+            out["relax2"] = last_relax2
         return out
 
     # -- circuit transitions --------------------------------------------------

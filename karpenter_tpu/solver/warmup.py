@@ -25,7 +25,12 @@ so the relaxation program (ops/relax.py) and the carried repair sweeps
 compile — and AOT-serialize/restore (solver/aot.py) — at the SAME pod and
 claim buckets as the narrow step; with it off, the warms compile the plain
 sweeps program instead, so a mismatched server recompiles on first contact
-either way. With ``KARPENTER_TPU_DEVICE_GATE`` on (the default), each warm
+either way. ``KARPENTER_TPU_RELAX2`` (and ``_RELAX2_ITERS``/``_RELAX2_STEP``,
+both static jit arguments baked into the program key) follows the identical
+contract for the convex phase-1 solve (ops/relax2.py): flag-on warms compile
+and AOT-snapshot the projected-gradient program plus the carried repair at
+the warmed buckets; a server with a different iteration count or step size
+keys to a different executable and recompiles. With ``KARPENTER_TPU_DEVICE_GATE`` on (the default), each warm
 solve additionally drives the device verification gate (verify/), so the
 gate program compiles and AOT-serializes at the same buckets too.
 ``KARPENTER_TPU_ORDER_POLICY`` joins the same contract: with it on, every

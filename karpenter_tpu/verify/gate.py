@@ -222,7 +222,14 @@ def gate_relaxed(
     pod_requirements_override=None, cluster_pods=(), domains=None,
 ) -> List[Any]:
     """The relax retry-loop gate (solver/jax_backend.py): composite verdict
-    when a GateContext is available, the host full_gate_relaxed otherwise."""
+    when a GateContext is available, the host full_gate_relaxed otherwise.
+
+    BOTH phase-1 solvers ride this gate unchanged — the round-15 waterfill
+    (KARPENTER_TPU_RELAX) and the round-22 convex projected-gradient solve
+    (KARPENTER_TPU_RELAX2). The gate checks the committed RESULT, never the
+    solver's internals, so the contract is identical for either flavor: a
+    phase-1 bug costs one re-solve with that flag off (latency), never
+    correctness."""
     outcome = full_gate(
         result, pods, instance_types, templates, nodes,
         pod_requirements_override, cluster_pods, domains,

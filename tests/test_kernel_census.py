@@ -24,6 +24,9 @@ from tools.kernel_census import (
     gate_jaxpr_eqns,
     narrow_jaxpr_eqns,
     policy_scorer_jaxpr_eqns,
+    relax2_jaxpr_eqns,
+    relax2_rounding_jaxpr_eqns,
+    relax2_scan_body_jaxpr_eqns,
     relax_jaxpr_eqns,
     residual_screen_jaxpr_eqns,
     shard_jaxpr_eqns,
@@ -51,6 +54,24 @@ WAVEFRONT_EQN_BUDGET = 5300
 # entire economics of the two-phase solve: one dense dispatch stands in for
 # the hundreds of narrow iterations the bulk would otherwise cost
 RELAX_EQN_BUDGET = 1450
+
+# round-22 convex phase-1 program (KARPENTER_TPU_RELAX2): measured 1552 at
+# the round-22 commit — the whole one-shot program (windowed PGD scan +
+# rounding + the shared ladder/commit), ~0.65x of ONE narrow iteration.
+# The scan body is traced exactly once, so the count is trip-count
+# invariant (pinned below); growth here taxes every flag-on bulk solve
+RELAX2_EQN_BUDGET = 1750
+
+# one projected-gradient step (the relax2 scan body): measured 48 at the
+# round-22 commit. The economics of the convex solve REQUIRE this to stay
+# at or below one narrow FFD iteration (2394) — it is the body the scan
+# repeats in place of sequential placement — and in practice it is ~50x
+# smaller (scatter-add, gradient, clip, rescale; no gates)
+RELAX2_SCAN_BODY_EQN_BUDGET = 80
+
+# the largest-fraction-first rounding pass: measured 76 at the round-22
+# commit — argmax + lexsort + segmented prefix sum, once per solve
+RELAX2_ROUNDING_EQN_BUDGET = 110
 
 # round-16 device verification gate (KARPENTER_TPU_DEVICE_GATE): measured
 # 336 at the round-16 commit. The whole one-shot reduction re-proving seven
@@ -290,6 +311,75 @@ class TestRelaxBudget:
             f"one extra rounding pass costs {more - base} eqns — the ladder "
             f"was designed around a per-rung gate sweep of <300"
         )
+
+
+class TestRelax2Budget:
+    """Round-22 convex phase-1 solve: the projected-gradient program gets
+    its own pinned budgets, and the flag must not touch the narrow body —
+    like the waterfill, relax2 is orchestrated at the backend layer
+    (solver/jax_backend.py), so KARPENTER_TPU_RELAX2=1 selects DIFFERENT
+    programs rather than editing the existing ones."""
+
+    def test_relax2_program_under_budget(self, census_problem):
+        eqns = relax2_jaxpr_eqns(census_problem)
+        assert eqns <= RELAX2_EQN_BUDGET, (
+            f"convex phase-1 program grew to {eqns} jaxpr eqns "
+            f"(budget {RELAX2_EQN_BUDGET}); see tools/kernel_census.py "
+            f"relax2_jaxpr_eqns to attribute the growth"
+        )
+
+    def test_relax2_budget_is_tight(self, census_problem):
+        eqns = relax2_jaxpr_eqns(census_problem)
+        assert eqns >= RELAX2_EQN_BUDGET * 0.8, (
+            f"convex phase-1 program shrank to {eqns} jaxpr eqns — nice! "
+            f"tighten RELAX2_EQN_BUDGET to keep the guard meaningful"
+        )
+
+    def test_relax2_scan_body_under_budget(self, census_problem):
+        """The scan body must stay at or below ONE narrow FFD iteration —
+        that inequality is the whole premise of replacing sequential
+        placement with a fixed-trip fractional solve — and its own tight
+        budget catches creep long before the premise breaks."""
+        eqns = relax2_scan_body_jaxpr_eqns(census_problem)
+        assert eqns <= RELAX2_SCAN_BODY_EQN_BUDGET, (
+            f"one projected-gradient step grew to {eqns} jaxpr eqns "
+            f"(budget {RELAX2_SCAN_BODY_EQN_BUDGET})"
+        )
+        assert eqns <= 2394, (
+            f"the PGD step ({eqns} eqns) exceeds one narrow iteration — the "
+            f"convex solve now costs more per trip than the loop it replaces"
+        )
+
+    def test_relax2_rounding_under_budget(self, census_problem):
+        eqns = relax2_rounding_jaxpr_eqns(census_problem)
+        assert eqns <= RELAX2_ROUNDING_EQN_BUDGET, (
+            f"rounding pass grew to {eqns} jaxpr eqns "
+            f"(budget {RELAX2_ROUNDING_EQN_BUDGET})"
+        )
+
+    def test_relax2_iteration_count_invariant(self, census_problem):
+        """lax.scan traces its body once: doubling the trip count must not
+        change the program size by a single equation, or the fixed-trip
+        design has silently unrolled."""
+        assert relax2_jaxpr_eqns(census_problem, iters=8) == relax2_jaxpr_eqns(
+            census_problem, iters=16
+        )
+
+    def test_relax2_flag_on_narrow_body_unchanged(self, census_problem):
+        """With KARPENTER_TPU_RELAX2 forced on, the flag-off narrow body
+        must still count EXACTLY 2394 equations: the flag is read by the
+        backend dispatch and ops/relax2.py's own entry, never inside the
+        sweeps/narrow kernels, so the repair pass runs the SAME narrow
+        program as a pure-FFD solve."""
+        old = os.environ.get("KARPENTER_TPU_RELAX2")
+        os.environ["KARPENTER_TPU_RELAX2"] = "1"
+        try:
+            assert narrow_jaxpr_eqns(census_problem, wavefront=0) == 2394
+        finally:
+            if old is None:
+                os.environ.pop("KARPENTER_TPU_RELAX2", None)
+            else:
+                os.environ["KARPENTER_TPU_RELAX2"] = old
 
 
 class TestGateBudget:

@@ -1,0 +1,487 @@
+"""Convex-relaxation phase-1 solve (KARPENTER_TPU_RELAX2) differential fuzz.
+
+The round-22 projected-gradient solver (ops/relax2.py) inherits the round-15
+two-phase contract verbatim (tests/test_solver_relax_parity.py) and adds its
+own obligations, pinned here:
+
+  validator-clean   every flag-on result passes the FULL-level validator;
+  no-worse          scheduled_frac(flag on) >= scheduled_frac(flag off);
+  exactly-once      every pod accounted exactly once across node_pods /
+                    new_claims / failures — AND, inside the phase, every
+                    eligible pod lands in exactly one of relax2-placed /
+                    demoted-to-repair (Relax2Stats accounting);
+  classified        every standdown reason in relax2.STANDDOWN_REASONS fires
+                    on a purpose-built input (or a surgical injection for
+                    the defense-in-depth reasons no natural input reaches)
+                    and every standdown is transparent — the result is the
+                    proven path's result;
+  shared screen     relax2 and the waterfill consume the LITERALLY same
+                    host screen and eligibility mask builder
+                    (ops/relax_common.py) — identity, not equivalence;
+  flag-off inert    with the flag off, ops/relax2 is never imported on the
+                    solve path and placements are bit-identical.
+
+Corruption injection: a wrapped relax2_place that piles every phase-1 pod
+into claim slot 0 must be caught by the full gate and re-solved with relax2
+off — proving "a relax2 bug costs latency, never correctness" end to end.
+"""
+
+import os
+import random
+import sys
+from contextlib import contextmanager
+
+import pytest
+
+from karpenter_tpu.apis import labels as wk
+from karpenter_tpu.apis.objects import (
+    DO_NOT_SCHEDULE,
+    ContainerPort,
+    LabelSelector,
+    TopologySpreadConstraint,
+)
+from karpenter_tpu.cloudprovider.fake import FAKE_WELL_KNOWN_LABELS, instance_types
+from karpenter_tpu.solver.jax_backend import JaxSolver
+from karpenter_tpu.solver.validator import full_gate_relaxed
+
+# aliased so pytest does not re-collect the parity suites in this module
+from test_solver_parity import (
+    TestExistingNodesParity as _ExistingNodes,
+    TestRandomizedTopologyParity as _RandomizedTopology,
+    make_pod,
+    simple_template,
+)
+from test_solver_relax_parity import assert_exactly_once
+
+RELAX2_KNOBS = (
+    "KARPENTER_TPU_RELAX2",
+    "KARPENTER_TPU_RELAX2_ITERS",
+    "KARPENTER_TPU_RELAX2_STEP",
+    "KARPENTER_TPU_RELAX2_TOL",
+)
+
+
+@contextmanager
+def relax2_env(**env):
+    """Set relax2 knobs for one solve, restoring the ambient environment
+    after — the census/parity suites pin the flag-off path."""
+    keys = set(RELAX2_KNOBS) | set(env)
+    old = {k: os.environ.get(k) for k in keys}
+    for k in RELAX2_KNOBS:
+        os.environ.pop(k, None)
+    os.environ.update(env)
+    try:
+        yield
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def run_ab(pods, its, templates, nodes=(), **env):
+    """(off_solver, off_result, on_solver, on_result) for one workload.
+    conftest pins KARPENTER_TPU_RELAX=0, so the off arm is the pure-FFD
+    solver and the on arm isolates relax2 (no waterfill in front)."""
+    s_off = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS)
+    with relax2_env(KARPENTER_TPU_RELAX2="0"):
+        off = s_off.solve(pods, its, templates, nodes)
+    s_on = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS)
+    with relax2_env(KARPENTER_TPU_RELAX2="1", **env):
+        on = s_on.solve(pods, its, templates, nodes)
+    return s_off, off, s_on, on
+
+
+def assert_contract(pods, its, templates, nodes, off, on):
+    assert_exactly_once(on, len(pods))
+    violations = full_gate_relaxed(on, pods, its, templates, nodes)
+    assert not violations, f"relax2 result failed FULL validator: {violations}"
+    assert on.num_scheduled() >= off.num_scheduled(), (
+        f"relax2 lost pods: on={on.num_scheduled()} "
+        f"off={off.num_scheduled()} of {len(pods)}"
+    )
+
+
+class TestRelax2FuzzGeneric:
+    """The randomized-parity workload family (selectors, tolerations, ports,
+    sizes, capped pool limits, existing nodes) under the A/B flag. Pool
+    limits trip the finite-pool standdown and port pods shrink eligibility —
+    both must degrade gracefully, never violate."""
+
+    @pytest.mark.parametrize("seed", range(5))
+    def test_fuzz(self, seed):
+        rng = random.Random(22000 + seed)
+        its = instance_types(rng.randint(2, 10))
+        zones = ["test-zone-1", "test-zone-2", "test-zone-3"]
+        templates = [simple_template(its, name="a")]
+        if rng.random() < 0.3:
+            templates[0].remaining_resources = {"cpu": float(rng.randint(4, 40))}
+        pods = []
+        for i in range(rng.randint(5, 24)):
+            selector = {}
+            if rng.random() < 0.3:
+                selector[wk.LABEL_TOPOLOGY_ZONE] = rng.choice(zones)
+            pod = make_pod(
+                i,
+                cpu=rng.choice([0.1, 0.25, 0.5, 1.0, 1.5, 3.0]),
+                mem=rng.choice([1e8, 2.5e8, 1e9, 4e9]),
+                selector=selector,
+            )
+            if rng.random() < 0.25:
+                pod.spec.containers[0].ports.append(
+                    ContainerPort(
+                        host_port=rng.choice([80, 443, 8080]),
+                        protocol=rng.choice(["TCP", "UDP"]),
+                    )
+                )
+            pods.append(pod)
+        nodes = [
+            _ExistingNodes().make_node(
+                f"node-{n}", cpu=rng.choice([2.0, 4.0, 8.0])
+            )
+            for n in range(rng.randint(0, 2))
+        ]
+        _, off, _, on = run_ab(pods, its, templates, nodes)
+        assert_contract(pods, its, templates, nodes, off, on)
+
+
+class TestRelax2FuzzTopology:
+    """The hard corpus: spread/affinity/anti-affinity mixes. Topology-
+    constrained pods are never phase-1 eligible, so these seeds push heavy
+    residue through the repair loop carrying relax2's committed state."""
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_fuzz_topology(self, seed):
+        gen = _RandomizedTopology()
+        rng = random.Random(23000 + seed)
+        its = instance_types(rng.choice([6, 10]))
+        templates = [simple_template(its, name="a")]
+        n = rng.randint(10, 40)
+        pods = [gen._make_topology_pod(rng, i) for i in range(n)]
+        _, off, _, on = run_ab(pods, its, templates)
+        assert_contract(pods, its, templates, (), off, on)
+
+
+class TestRelax2Telemetry:
+    """The convex solve must actually serve its target workload (homogeneous
+    bulk), report the full phase record, and be INERT flag-off — no module
+    import, no telemetry, bit-identical placements."""
+
+    def test_phase1_places_bulk_and_shrinks_repair(self):
+        its = instance_types(8)
+        pods = [make_pod(i, cpu=0.3 + 0.2 * (i % 5)) for i in range(48)]
+        templates = [simple_template(its)]
+        s_off, off, s_on, on = run_ab(pods, its, templates)
+        assert s_off.last_relax2 is None
+        info = s_on.last_relax2
+        assert info is not None and info["reason"] is None, info
+        assert info["placed"] > 0.5 * len(pods), info
+        assert info["pgd_iterations"] >= 1
+        assert info["phase_s"] > 0
+        assert s_on.relax_fallbacks == 0
+        # phase-1 state seeds the repair: strictly fewer narrow iterations
+        # than the pure-FFD solve of the same batch
+        assert s_on.last_iters.narrow < s_off.last_iters.narrow, (
+            s_on.last_iters, s_off.last_iters,
+        )
+        assert_contract(pods, its, templates, (), off, on)
+
+    def test_eligible_pods_accounted_exactly_once(self):
+        """Relax2Stats accounting: eligible == placed + demoted (every
+        eligible pod lands in exactly one bucket), and the demoted +
+        never-eligible pods are exactly what the repair pass received."""
+        its = instance_types(8)
+        pods = [make_pod(i, cpu=0.4 + 0.3 * (i % 3)) for i in range(32)]
+        # a port pod and a spread pod keep eligibility < the full batch
+        pods[0].spec.containers[0].ports.append(
+            ContainerPort(host_port=9090, protocol="TCP")
+        )
+        s_off, off, s_on, on = run_ab(pods, its, [simple_template(its)])
+        info = s_on.last_relax2
+        assert info is not None and info["reason"] is None, info
+        assert info["eligible"] == info["placed"] + info["demoted"], info
+        assert info["eligible"] <= len(pods) - 1  # the port pod never eligible
+        assert info["rounding"]["demoted"] <= info["demoted"]
+        assert_contract(pods, its, [simple_template(its)], (), off, on)
+
+    def test_status_surfaces_last_relax2(self):
+        from karpenter_tpu.solver.supervisor import SupervisedSolver
+
+        its = instance_types(6)
+        pods = [make_pod(i, cpu=0.5) for i in range(16)]
+        s = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS)
+        sup = SupervisedSolver(primary=s)
+        with relax2_env(KARPENTER_TPU_RELAX2="1"):
+            sup.solve(pods, its, [simple_template(its)])
+        status = sup.status()
+        assert "relax2" in status, sorted(status)
+        assert status["relax2"]["reason"] is None
+        assert status["relax2"]["placed"] > 0
+
+    def test_flag_off_never_imports_and_reports_nothing(self):
+        """Flag off, the solve path must not even IMPORT ops/relax2 — the
+        lazy-import discipline is the proof the flag-off program set is
+        byte-for-byte the round-21 one."""
+        sys.modules.pop("karpenter_tpu.ops.relax2", None)
+        its = instance_types(6)
+        pods = [make_pod(i, cpu=0.5) for i in range(16)]
+        s = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS)
+        with relax2_env(KARPENTER_TPU_RELAX2="0"):
+            s.solve(pods, its, [simple_template(its)])
+        assert "karpenter_tpu.ops.relax2" not in sys.modules, (
+            "flag-off solve imported ops/relax2"
+        )
+        assert s.last_relax2 is None
+        assert s.relax_fallbacks == 0
+
+    def test_flag_off_bit_identical_placements(self):
+        """The knob env vars alone (flag OFF) must not perturb the solve:
+        placements are bit-identical to a run with no relax2 vars set."""
+        its = instance_types(6)
+        pods = [make_pod(i, cpu=0.25 + 0.25 * (i % 4)) for i in range(20)]
+        templates = [simple_template(its)]
+        with relax2_env():
+            base = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(
+                pods, its, templates
+            )
+        with relax2_env(
+            KARPENTER_TPU_RELAX2="0",
+            KARPENTER_TPU_RELAX2_ITERS="7",
+            KARPENTER_TPU_RELAX2_STEP="1.5",
+        ):
+            knobs = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(
+                pods, its, templates
+            )
+        assert base.node_pods == knobs.node_pods
+        assert base.failures == knobs.failures
+        assert [sorted(c.pod_indices) for c in base.new_claims] == [
+            sorted(c.pod_indices) for c in knobs.new_claims
+        ]
+
+
+class TestRelax2SharedScreen:
+    """Satellite 2: BOTH phase-1 solvers consume the literally-same host
+    screen and eligibility mask builder — object identity plus an end-to-end
+    equal-eligible-count differential."""
+
+    def test_screen_and_mask_are_shared_objects(self):
+        from karpenter_tpu.ops import relax, relax2, relax_common
+
+        assert relax2.relax_applicable is relax_common.relax_applicable
+        assert relax.relax_applicable is relax_common.relax_applicable
+        assert relax2._eligibility is relax_common.eligibility
+        assert relax._eligibility is relax_common.eligibility
+
+    def test_both_solvers_see_equal_eligibility(self):
+        """Same workload, one arm per solver: the eligible count each phase
+        reports must match exactly — the shared mask builder leaves no room
+        for drift."""
+        its = instance_types(8)
+        pods = []
+        for i in range(24):
+            p = make_pod(i, cpu=0.3 + 0.2 * (i % 4))
+            if i % 6 == 0:
+                p.spec.containers[0].ports.append(
+                    ContainerPort(host_port=7777, protocol="TCP")
+                )
+            pods.append(p)
+        templates = [simple_template(its)]
+        s_wf = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS)
+        old = os.environ.get("KARPENTER_TPU_RELAX")
+        os.environ["KARPENTER_TPU_RELAX"] = "1"
+        try:
+            s_wf.solve(pods, its, templates)
+        finally:
+            if old is None:
+                os.environ.pop("KARPENTER_TPU_RELAX", None)
+            else:
+                os.environ["KARPENTER_TPU_RELAX"] = old
+        s_cv = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS)
+        with relax2_env(KARPENTER_TPU_RELAX2="1"):
+            s_cv.solve(pods, its, templates)
+        assert s_wf.last_relax is not None, "waterfill did not fire"
+        assert s_cv.last_relax2 is not None, "relax2 did not fire"
+        assert s_cv.last_relax2["reason"] is None, s_cv.last_relax2
+        assert (
+            s_wf.last_relax["eligible"] == s_cv.last_relax2["eligible"]
+        ), (s_wf.last_relax, s_cv.last_relax2)
+
+
+def solve_on(pods, its, templates, nodes=(), **env):
+    s = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS)
+    with relax2_env(KARPENTER_TPU_RELAX2="1", **env):
+        r = s.solve(pods, its, templates, nodes)
+    return s, r
+
+
+class TestRelax2Standdowns:
+    """One test per classified reason in relax2.STANDDOWN_REASONS. Every
+    standdown must be transparent: the returned result is the proven path's
+    result (exactly-once + validator-clean), only latency was spent."""
+
+    def test_finite_pool(self):
+        its = instance_types(6)
+        tpl = simple_template(its)
+        tpl.remaining_resources = {"cpu": 6.0}
+        pods = [make_pod(i, cpu=1.0) for i in range(12)]
+        s, r = solve_on(pods, its, [tpl])
+        assert s.last_relax2 == {"reason": "finite-pool"}
+        assert_exactly_once(r, len(pods))
+
+    def test_ports(self):
+        its = instance_types(6)
+        pods = []
+        for i in range(10):
+            p = make_pod(i, cpu=0.2)
+            p.spec.containers[0].ports.append(
+                ContainerPort(host_port=8443, protocol="TCP")
+            )
+            pods.append(p)
+        s, r = solve_on(pods, its, [simple_template(its)])
+        assert s.last_relax2 == {"reason": "ports"}
+        assert_exactly_once(r, len(pods))
+
+    def test_topology(self):
+        its = instance_types(6)
+        pods = []
+        for i in range(10):
+            p = make_pod(i, cpu=0.2)
+            p.metadata.labels = {"grp": "all-spread"}
+            p.spec.topology_spread_constraints = [
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key=wk.LABEL_TOPOLOGY_ZONE,
+                    when_unsatisfiable=DO_NOT_SCHEDULE,
+                    label_selector=LabelSelector(match_labels={"grp": "all-spread"}),
+                )
+            ]
+            pods.append(p)
+        s, r = solve_on(pods, its, [simple_template(its)])
+        assert s.last_relax2 == {"reason": "topology"}
+        assert_exactly_once(r, len(pods))
+
+    def test_no_eligible(self):
+        """Every pod possibly fits an existing node (node-priority screen
+        demotes all of them) — no ports, no topology, so the dominant-blocker
+        classifier falls through to the bounded catch-all."""
+        its = instance_types(6)
+        pods = [make_pod(i, cpu=0.2) for i in range(8)]
+        nodes = [_ExistingNodes().make_node("node-big", cpu=16.0)]
+        s, r = solve_on(pods, its, [simple_template(its)], nodes)
+        assert s.last_relax2 == {"reason": "no-eligible"}
+        assert_exactly_once(r, len(pods))
+
+    def test_non_convergence(self, monkeypatch):
+        """Convergence-failure injection: force the host verdict to 'still
+        sliding AND capacity-violating' — the backend must refuse to round
+        and fall through, and the result must be the proven path's."""
+        from karpenter_tpu.ops import relax2
+
+        monkeypatch.setattr(relax2, "converged", lambda *_: False)
+        its = instance_types(8)
+        pods = [make_pod(i, cpu=0.4 + 0.3 * (i % 3)) for i in range(24)]
+        s_off = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS)
+        with relax2_env(KARPENTER_TPU_RELAX2="0"):
+            off = s_off.solve(pods, its, [simple_template(its)])
+        s, r = solve_on(pods, its, [simple_template(its)])
+        assert s.last_relax2 is not None
+        assert s.last_relax2["reason"] == "non-convergence", s.last_relax2
+        assert "residual" in s.last_relax2 and "pgd_iterations" in s.last_relax2
+        assert_contract(pods, its, [simple_template(its)], (), off, r)
+
+    def test_non_convergence_env_injection(self):
+        """The same standdown via the public knobs alone: one trip, a zero
+        tolerance, and a wild step leave the point sliding; if the corpus
+        happens to be capacity-feasible anyway, the phase is allowed to
+        round — either way the contract holds."""
+        its = instance_types(8)
+        pods = [make_pod(i, cpu=0.7, mem=2e9) for i in range(24)]
+        s, r = solve_on(
+            pods, its, [simple_template(its)],
+            KARPENTER_TPU_RELAX2_ITERS="1",
+            KARPENTER_TPU_RELAX2_STEP="50.0",
+            KARPENTER_TPU_RELAX2_TOL="0.0",
+        )
+        assert s.last_relax2 is not None
+        assert s.last_relax2["reason"] in (None, "non-convergence")
+        assert_exactly_once(r, len(pods))
+
+    def test_rounding_overflow(self, monkeypatch):
+        """Doctored stats: eligible mass existed but phase 1 placed nothing
+        — the backend must classify and fall through rather than dispatch a
+        pointless carried repair over a full residue."""
+        from karpenter_tpu.ops import relax2
+
+        real = relax2.relax2_place
+
+        def doctored(problem, max_claims, init=None):
+            r = real(problem, max_claims, init)
+            return r._replace(
+                stats=r.stats._replace(
+                    placed=r.stats.placed * 0, round_demoted=r.stats.eligible
+                )
+            )
+
+        monkeypatch.setattr(relax2, "relax2_place", doctored)
+        its = instance_types(6)
+        pods = [make_pod(i, cpu=0.5) for i in range(16)]
+        s, r = solve_on(pods, its, [simple_template(its)])
+        assert s.last_relax2 is not None
+        assert s.last_relax2["reason"] == "rounding-overflow", s.last_relax2
+        assert s.last_relax2["eligible"] > 0
+        assert_exactly_once(r, len(pods))
+
+    def test_gate_rejected_corruption_is_caught_and_resolved(self, monkeypatch):
+        """THE safety property: corrupt the committed assignment (every
+        phase-1 pod piled into claim slot 0, residue zeroed) and the full
+        gate must catch it and re-solve with relax2 off — identical final
+        quality, one classified fallback."""
+        import jax.numpy as jnp
+
+        from karpenter_tpu.ops import relax2
+        from karpenter_tpu.ops.ffd_core import KIND_CLAIM, KIND_NEW_CLAIM
+
+        real = relax2.relax2_place
+
+        def corrupt(problem, max_claims, init=None):
+            r = real(problem, max_claims, init)
+            placed = (r.kind == KIND_NEW_CLAIM) | (r.kind == KIND_CLAIM)
+            return r._replace(index=jnp.where(placed, 0, r.index))
+
+        monkeypatch.setattr(relax2, "relax2_place", corrupt)
+        its = instance_types(8)
+        # enough demand that one claim cannot legally hold the pile
+        pods = [make_pod(i, cpu=2.0, mem=4e9) for i in range(32)]
+        templates = [simple_template(its)]
+        s_off = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS)
+        with relax2_env(KARPENTER_TPU_RELAX2="0"):
+            off = s_off.solve(pods, its, templates)
+        s, r = solve_on(pods, its, templates)
+        assert s.last_relax2 == {"reason": "gate-rejected"}, s.last_relax2
+        assert s.relax_fallbacks >= 1
+        assert_contract(pods, its, templates, (), off, r)
+        assert r.num_scheduled() == off.num_scheduled()
+
+    def test_error(self, monkeypatch):
+        from karpenter_tpu.ops import relax2
+
+        def boom(problem, max_claims, init=None):
+            raise RuntimeError("injected relax2 failure")
+
+        monkeypatch.setattr(relax2, "relax2_place", boom)
+        its = instance_types(6)
+        pods = [make_pod(i, cpu=0.5) for i in range(16)]
+        s, r = solve_on(pods, its, [simple_template(its)])
+        assert s.last_relax2 is not None
+        assert s.last_relax2["reason"] == "error", s.last_relax2
+        assert "injected relax2 failure" in s.last_relax2.get("error", "")
+        assert_exactly_once(r, len(pods))
+
+    def test_vocabulary_is_bounded(self):
+        from karpenter_tpu.ops import relax2
+
+        assert relax2.STANDDOWN_REASONS == (
+            "finite-pool", "ports", "topology", "no-eligible",
+            "non-convergence", "rounding-overflow", "gate-rejected", "error",
+        )

@@ -353,6 +353,45 @@ class TestShardParityFuzz:
         s.solve([make_pod(i) for i in range(8)], its, [simple_template(its)])
         assert s.last_shard is None
 
+    def test_relax2_shard_consistency(self):
+        """Regression pin for the round-22 sharded relax2 composition
+        (parallel/mesh.py shard_relax2_sweeps_program): the program must be
+        a sharded ``jit(vmap)``, NOT ``shard_map``. Under shard_map on the
+        multi-device SPMD path the carried repair's data-dependent
+        while_loop miscompiles when the loop carry is phase-1 state —
+        every device except device 0 returns the carry's INPUT state with
+        the repair's updates dropped, so decoded claims disagree with
+        their own request sums and the per-partition gate rejects every
+        merge. This corpus is the measured repro: heterogeneous fleet
+        lanes across all 8 devices with a non-empty phase-1 residue per
+        batch (demoted > 0), the exact shape that diverged; reinstating
+        shard_map flips last_shard to the merge-rejected standdown and
+        fails the first assert. The fresh-sweeps shard program and a cold
+        fresh_carry are unaffected — shard_sweeps_program keeps shard_map."""
+        from bench import make_fleet_pods
+
+        its = instance_types(12)
+        pods = make_fleet_pods(160, random.Random(13))
+        with shard_on(
+            KARPENTER_TPU_SHARD_MIN_PODS="20",
+            KARPENTER_TPU_RELAX2="1",
+            KARPENTER_TPU_RELAX="0",
+        ):
+            s = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS)
+            result = s.solve(pods, its, [simple_template(its)])
+        assert_served_by_shard(s, parts_at_least=8)
+        r2 = s.last_relax2
+        assert r2 is not None and r2["reason"] is None, r2
+        assert r2["sharded"] is True
+        assert r2["placed"] > 0
+        # the miscompile only bites when the carried repair does real work
+        # on a phase-1-valued carry: the corpus must leave a residue
+        assert r2["demoted"] > 0, r2
+        # absolute coverage: every pod scheduled exactly once, none failed
+        assert scheduled_set(result) == list(range(len(pods)))
+        assert not result.failures
+        assert not result.node_pods
+
 
 # ---------------------------------------------------------------------------
 # classified standdowns — every reason in shard.REASONS

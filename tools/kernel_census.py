@@ -201,6 +201,80 @@ def relax_jaxpr_eqns(problem=None, C: int = 16, passes: int = 2) -> int:
     return _count_jaxpr_eqns(jaxpr)
 
 
+def relax2_jaxpr_eqns(problem=None, C: int = 16, iters: int = 24,
+                      passes: int = 2) -> int:
+    """Flattened jaxpr equation count of the WHOLE convex phase-1 program
+    (ops/relax2.py, KARPENTER_TPU_RELAX2): windowed projected-gradient scan,
+    largest-fraction-first rounding, and the shared real-gate ladder/commit.
+    The PGD loop is a ``lax.scan``, so its body is traced exactly ONCE
+    regardless of the trip count — tests/test_kernel_census.py pins
+    iteration-count invariance (iters=8 == iters=16) on top of the budget."""
+    import jax
+
+    from karpenter_tpu.ops.ffd_core import _pad_lanes_mult32, problem_bounds_free
+    from karpenter_tpu.ops.relax2 import _relax2_impl, pgd_step
+
+    if problem is None:
+        problem = build_census_problem(claim_slots=C)
+    bounds_free = problem_bounds_free(problem)
+    step = pgd_step()
+    padded = _pad_lanes_mult32(jax.device_put(problem))
+    jaxpr = jax.make_jaxpr(
+        lambda p: _relax2_impl(p, C, bounds_free, iters, step, passes)
+    )(padded)
+    return _count_jaxpr_eqns(jaxpr)
+
+
+def relax2_scan_body_jaxpr_eqns(problem=None, C: int = 16) -> int:
+    """Flattened jaxpr equation count of ONE projected-gradient step
+    (ops/relax2._pgd_step_op) — the body the relax2 scan repeats. This is
+    the per-iteration cost of the convex solve, so its budget is measured
+    against one narrow FFD step: the fractional step must stay at or below
+    the sequential body it displaces."""
+    import jax
+    import jax.numpy as jnp
+
+    from karpenter_tpu.ops import relax2
+
+    if problem is None:
+        problem = build_census_problem(claim_slots=C)
+    P = int(problem.pod_active.shape[0])
+    W = relax2._WINDOW
+    step = relax2.pgd_step()
+    X = jnp.zeros((P, W), jnp.float32)
+    valid = jnp.zeros((P, W), bool)
+    absc = jnp.zeros((P, W), jnp.int32)
+    price = jnp.zeros((P, W), jnp.float32)
+    wcol = jnp.zeros((P, 1), jnp.float32)
+    jaxpr = jax.make_jaxpr(
+        lambda x, v, a, pr, wc: relax2._pgd_step_op(x, v, a, pr, wc, C, step)
+    )(X, valid, absc, price, wcol)
+    return _count_jaxpr_eqns(jaxpr)
+
+
+def relax2_rounding_jaxpr_eqns(problem=None, C: int = 16) -> int:
+    """Flattened jaxpr equation count of the deterministic rounding pass
+    (ops/relax2._round_lff): argmax column, (bin, -fraction) lexsort, and
+    the segmented prefix-sum admission. One-shot per solve, like the gate."""
+    import jax
+    import jax.numpy as jnp
+
+    from karpenter_tpu.ops import relax2
+
+    if problem is None:
+        problem = build_census_problem(claim_slots=C)
+    P = int(problem.pod_active.shape[0])
+    W = relax2._WINDOW
+    X = jnp.zeros((P, W), jnp.float32)
+    valid = jnp.zeros((P, W), bool)
+    absc = jnp.zeros((P, W), jnp.int32)
+    w = jnp.zeros((P,), jnp.float32)
+    jaxpr = jax.make_jaxpr(
+        lambda x, v, a, ww: relax2._round_lff(x, v, a, ww, C)
+    )(X, valid, absc, w)
+    return _count_jaxpr_eqns(jaxpr)
+
+
 def policy_scorer_jaxpr_eqns(problem=None, C: int = 16) -> int:
     """Flattened jaxpr equation count of the learned-ordering scorer
     (ops/policy.lane_scores, KARPENTER_TPU_ORDER_POLICY) — the feature
@@ -416,6 +490,15 @@ def main(argv):
     relax_eqns = relax_jaxpr_eqns(problem, C)
     print(f"  jaxpr_eqns_relax     = {relax_eqns}  (whole phase-1 program, "
           f"2 rounding passes)")
+    relax2_eqns = relax2_jaxpr_eqns(problem, C)
+    print(f"  jaxpr_eqns_relax2    = {relax2_eqns}  (whole convex phase-1 "
+          f"program, scan body traced once)")
+    relax2_body = relax2_scan_body_jaxpr_eqns(problem, C)
+    print(f"  jaxpr_eqns_relax2_pgd = {relax2_body}  (one projected-gradient "
+          f"step, the scan body)")
+    relax2_round = relax2_rounding_jaxpr_eqns(problem, C)
+    print(f"  jaxpr_eqns_relax2_rnd = {relax2_round}  (largest-fraction-first "
+          f"rounding, once per solve)")
     gate_eqns = gate_jaxpr_eqns(problem, C)
     print(f"  jaxpr_eqns_gate      = {gate_eqns}  (whole verification gate "
           f"program)")

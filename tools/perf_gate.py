@@ -116,6 +116,13 @@ DEFAULT_BANDS = {
     # window; policy-on and policy-off runs both emit it and gate against
     # their own trajectory.
     "narrow_iterations_10k": (LOWER_BETTER, 1.5),
+    # round-22 convex-relaxation bulk solver (KARPENTER_TPU_RELAX2=1 runs):
+    # the relaxed 10k solve gates against its OWN window for the same
+    # mode-separation reason as solve_10k_relax_s, and phase-1 rounding
+    # coverage must not silently collapse back into the repair loop. The
+    # first flag-on run seeds each window; flag-off rows lack the columns.
+    "solve_10k_relax2_s": (LOWER_BETTER, 3.0),
+    "relax2_placed_frac": (HIGHER_BETTER, 2.0),
     # round-21 DeviceWorld steady-state churn (streaming/device_world.py,
     # KARPENTER_TPU_DEVICE_WORLD): HOST-INCLUSIVE per-cycle wall (encode +
     # patch + fused dispatch + decode + verify) at the churn shape, p50 over
@@ -174,6 +181,15 @@ def row_from_bench(out: dict, label: str = "run") -> dict:
         "repair_iterations": out.get("repair_iterations"),
         "relax_phase_s": out.get("relax_phase_s"),
         "solve_10k_relax_s": out.get("solve_10k_relax_s"),
+        # schema v2, round 22: convex-relaxation (PGD) solve columns —
+        # present only on KARPENTER_TPU_RELAX2=1 runs (bench.py
+        # per_shape_relax2 aggregation); standdown runs omit the numeric
+        # columns and carry the classified reasons instead
+        "relax2_placed_frac": out.get("relax2_placed_frac"),
+        "relax2_pgd_iterations": out.get("relax2_pgd_iterations"),
+        "relax2_phase_s": out.get("relax2_phase_s"),
+        "solve_10k_relax2_s": out.get("solve_10k_relax2_s"),
+        "relax2_standdowns": out.get("relax2_standdowns"),
         # schema v2, round 16: device verification gate columns — present
         # only when the bench gate scenario ran with the gate enabled
         "gate_full_s": out.get("gate_full_s"),
@@ -285,7 +301,9 @@ def smoke(baseline_path=DEFAULT_BASELINE) -> list:
     """Tier-1 smoke: (1) the committed baseline parses and its newest row
     passes its own window; (2) a tiny-shape solve through the real backend,
     program registry on, lands inside generous absolute bands and actually
-    populated the registry. Returns problem strings."""
+    populated the registry; (3) a 120-pod homogeneous-fleet A/B proving the
+    round-22 convex relaxation fires and collapses the narrow repair loop.
+    Returns problem strings."""
     import time
 
     problems = []
@@ -344,6 +362,59 @@ def smoke(baseline_path=DEFAULT_BASELINE) -> list:
             )
         if snap["memory"]["last"] is None:
             problems.append("program registry captured no memory sample")
+
+        # (3) homogeneous-fleet quick scenario (round 22): the corpus the
+        # convex relaxation exists for — a fleet-style mix where the narrow
+        # repair loop's sequential depth is the wall. Relax2-on must fire
+        # (not stand down) and cut narrow repair iterations to <=10% of the
+        # both-relax-off control, with an absolute slop floor of 5 because
+        # at this 120-pod shape the counts are single-digit (measured: 4 on
+        # vs 33 off) and iteration counts are integers. Scheduled parity is
+        # the correctness floor.
+        import os
+
+        from bench import make_fleet_pods
+
+        fleet = make_fleet_pods(120, random.Random(7))
+        saved = {
+            k: os.environ.get(k)
+            for k in ("KARPENTER_TPU_RELAX", "KARPENTER_TPU_RELAX2")
+        }
+        try:
+            os.environ["KARPENTER_TPU_RELAX"] = "0"
+            os.environ["KARPENTER_TPU_RELAX2"] = "0"
+            s_off = JaxSolver()
+            r_off = s_off.solve(fleet, its, [tpl])
+            os.environ["KARPENTER_TPU_RELAX2"] = "1"
+            s_on = JaxSolver()
+            r_on = s_on.solve(fleet, its, [tpl])
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+        if r_on.num_scheduled() != r_off.num_scheduled():
+            problems.append(
+                f"fleet smoke scheduled parity broke: relax2-on placed "
+                f"{r_on.num_scheduled()} vs control {r_off.num_scheduled()}"
+            )
+        last = getattr(s_on, "last_relax2", None)
+        if not last or last.get("reason") is not None:
+            problems.append(
+                f"fleet smoke: relax2 stood down on the homogeneous corpus "
+                f"(last_relax2={last!r})"
+            )
+        off_narrow = s_off.last_iters.narrow if s_off.last_iters else None
+        on_narrow = s_on.last_iters.narrow if s_on.last_iters else None
+        if off_narrow is None or on_narrow is None:
+            problems.append("fleet smoke: missing narrow iteration telemetry")
+        elif on_narrow > max(0.1 * off_narrow, 5.0):
+            problems.append(
+                f"fleet smoke: relax2 left {on_narrow} narrow repair "
+                f"iterations vs {off_narrow} flag-off (ceiling "
+                f"max(0.1x, 5))"
+            )
     finally:
         programs.set_enabled(None)
     return problems
