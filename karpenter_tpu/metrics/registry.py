@@ -8,6 +8,7 @@ the node/nodepool/pod exporters.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from bisect import bisect_left
@@ -331,7 +332,8 @@ WARM_SOLVES = REGISTRY.counter(
     "solver_warm_solves_total",
     "Streaming solve cycles, by outcome (warm, warm-rejected, warm-error, "
     "cold-first, cold-threshold, cold-unsupported, cold-world-changed) and, "
-    "under the multi-tenant serve layer, tenant",
+    "under the multi-tenant serve layer, tenant (label values capped via "
+    "tenant_label(); overflow tenants aggregate into 'other')",
 )
 WORLD_PATCH = REGISTRY.counter(
     "solver_world_patch_total",
@@ -345,31 +347,56 @@ WORLD_PATCH = REGISTRY.counter(
 )
 
 # -- multi-tenant serve series (serve/, KARPENTER_TPU_SERVE) -------------------
-# The tenant label on these (and on solver_circuit_state,
-# validator_rejections_total, solver_warm_solves_total) is bounded by
-# KARPENTER_TPU_SERVE_MAX_TENANTS; tools/metrics_lint.py enforces the bound.
+# Serve HOT-PATH series carry the tenant CLASS label ("cls"), never tenant
+# ids: classes are operator config (KARPENTER_TPU_SERVE_CLASSES), a bounded
+# set at any fleet size, while 1,000 registered tenants would put 1,000
+# series on every family. Per-tenant detail lives in /debug/tenants. Series
+# that DO carry a tenant label (solver_circuit_state,
+# validator_rejections_total, solver_warm_solves_total — cold paths, one
+# write per solve) go through tenant_label() below, which caps the value
+# set; tools/metrics_lint.py enforces both rules.
 SERVE_QUEUE_DEPTH = REGISTRY.gauge(
     "serve_queue_depth",
-    "Queued solve requests per tenant stream (each queue bounded by "
-    "KARPENTER_TPU_SERVE_QUEUE_DEPTH)",
+    "Queued solve requests per tenant class (each tenant's queue bounded by "
+    "KARPENTER_TPU_SERVE_QUEUE_DEPTH; per-tenant depth in /debug/tenants)",
 )
 SERVE_ADMISSION = REGISTRY.counter(
     "serve_admission_total",
-    "Serve-layer admission decisions, by tenant and classified outcome "
-    "(accepted, overloaded-queue-full, overloaded-predicted-wait, "
-    "overloaded-expired, rejected-max-tenants, rejected-shutdown) — an "
-    "unadmitted request is always one of these, never a silent drop",
+    "Serve-layer admission decisions, by tenant class and classified "
+    "outcome (accepted, overloaded-queue-full, overloaded-predicted-wait, "
+    "overloaded-saturated, overloaded-expired, rejected-max-tenants, "
+    "rejected-shutdown) — an unadmitted request is always one of these, "
+    "never a silent drop",
 )
 SERVE_FAIRNESS_DEFICIT = REGISTRY.gauge(
     "serve_fairness_deficit",
-    "Deficit-weighted-round-robin balance per tenant: the pod-units of "
-    "service the stream may still spend before yielding its turn",
+    "Hierarchical-DWRR class-level balance: the pod-units of service a "
+    "tenant class may still spend before yielding to the other classes "
+    "(flat single-class mode writes nothing here; per-tenant balances in "
+    "/debug/tenants)",
 )
 SERVE_CYCLES = REGISTRY.counter(
     "serve_cycles_total",
-    "Solve requests completed by the serve dispatcher, by tenant and path "
-    "(solo = per-tenant supervised solve, batched = answered by a "
+    "Solve requests completed by the serve dispatcher, by tenant class and "
+    "path (solo = per-tenant supervised solve, batched = answered by a "
     "cross-stream stacked dispatch)",
+)
+SERVE_ACTIVE = REGISTRY.gauge(
+    "serve_active_streams",
+    "Backlogged (ready-ring) tenant streams per class — the population the "
+    "O(active) dispatcher actually sweeps, vs. registered tenants which "
+    "cost nothing while idle",
+)
+SERVE_POOL = REGISTRY.counter(
+    "serve_pool_total",
+    "Shared program-pool gather outcomes per dispatch (hit = the shape-"
+    "family index produced co-batch riders, alone = the lead dispatched "
+    "solo)",
+)
+SERVE_REPLICA_PLACEMENTS = REGISTRY.counter(
+    "serve_replica_placements_total",
+    "Tenant-to-replica placement decisions by classified reason (pinned, "
+    "big-tenant = routed to the largest mesh slice, hash = stable default)",
 )
 SERVE_BATCH = REGISTRY.counter(
     "serve_batch_total",
@@ -456,6 +483,39 @@ def measure(histogram: Histogram, labels: Optional[Dict[str, str]] = None):
         yield
     finally:
         histogram.observe(time.perf_counter() - start, labels)
+
+
+def tenant_label_max() -> int:
+    """Cap on DISTINCT tenant-id label values any metric family may carry
+    (KARPENTER_TPU_TENANT_LABEL_MAX, default 32). At fleet scale (1,000
+    registered tenants) per-tenant series would dwarf everything else on
+    the endpoint; the first N distinct tenants keep their ids, the rest
+    aggregate into ``other``. Forensics (quarantine/journal namespaces)
+    always use the raw tenant id — this caps metric LABELS only."""
+    try:
+        return max(1, int(os.environ.get("KARPENTER_TPU_TENANT_LABEL_MAX", "32")))
+    except ValueError:
+        return 32
+
+
+_tenant_label_lock = threading.Lock()
+_tenant_label_seen: Dict[str, str] = {}
+
+
+def tenant_label(tenant: str) -> str:
+    """Bounded metric-label value for a tenant id: the id itself for the
+    first tenant_label_max() distinct tenants this process sees, ``other``
+    beyond that. Stable within a process (first-come keeps its id)."""
+    with _tenant_label_lock:
+        mapped = _tenant_label_seen.get(tenant)
+        if mapped is None:
+            mapped = (
+                tenant
+                if len(_tenant_label_seen) < tenant_label_max()
+                else "other"
+            )
+            _tenant_label_seen[tenant] = mapped
+        return mapped
 
 
 class Store:

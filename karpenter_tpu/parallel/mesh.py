@@ -407,6 +407,35 @@ def default_mesh(min_devices: int = 2) -> Optional[Mesh]:
     return make_mesh()
 
 
+def carve_meshes(n_slices: int, devices=None) -> list:
+    """Carve the local devices into ``n_slices`` contiguous 1-D candidate
+    meshes — one per serve replica (serve/replica.py), so fleets partition
+    the host instead of contending for all of it.
+
+    The split is balanced with the remainder devices going to the FIRST
+    slices: slice 0 is always the largest, and the replica set pins
+    big-tenant streams there. A slice that lands fewer than 2 devices gets
+    None (a mesh over one device buys nothing over vmap — same contract as
+    default_mesh). Device discovery happens at call time, never at import
+    time."""
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    n_slices = max(1, int(n_slices))
+    base, extra = divmod(len(devices), n_slices)
+    out = []
+    start = 0
+    for i in range(n_slices):
+        size = base + (1 if i < extra else 0)
+        chunk = devices[start:start + size]
+        start += size
+        if len(chunk) >= 2:
+            out.append(Mesh(np.array(chunk), (CANDIDATE_AXIS,)))
+        else:
+            out.append(None)
+    return out
+
+
 @functools.lru_cache(maxsize=None)
 def shard_sweeps_program(
     mesh: Mesh, max_claims: int, bounds_free: bool, wavefront: int

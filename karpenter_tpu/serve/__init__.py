@@ -39,15 +39,31 @@ Flag contract: the layer activates only through explicit construction or
 the single-tenant path and placements are bit-identical to the pre-serve
 tree (the flag-off kernel census stays exactly 2,394 eqns).
 
+At fleet scale (1,000+ registered streams) the flat layer grows a hierarchy
+(docs/SERVING.md "Fleet scale"): tenant CLASSES above tenants with two-level
+deficit accounting, ready-rings so idle streams cost zero dispatcher work,
+shared per-shape program pools (serve/pool.py) keeping cross-tenant
+co-batching hot at 1k tenants, and replica sets (serve/replica.py) each
+owning a carved mesh slice. Hot-path metrics aggregate to the bounded
+tenant-class label; per-tenant detail stays in /debug/tenants.
+
 Knobs (all read at construction; see docs/SERVING.md):
 
   KARPENTER_TPU_SERVE                  enable the serve layer (operator wiring)
-  KARPENTER_TPU_SERVE_MAX_TENANTS      tenant capacity + metric-label bound (16)
+  KARPENTER_TPU_SERVE_MAX_TENANTS      tenant capacity bound (16)
   KARPENTER_TPU_SERVE_QUEUE_DEPTH      per-tenant queue bound (8)
   KARPENTER_TPU_SERVE_QUANTUM          DWRR pod-units earned per sweep (64)
   KARPENTER_TPU_SERVE_WEIGHTS          per-tenant weights, "a=4,b=1"
+  KARPENTER_TPU_SERVE_CLASSES          tenant-class weights, "gold=4,bronze=1"
+                                       (unset = one implicit "default" class:
+                                       the flat, bit-identical 16-tenant path)
   KARPENTER_TPU_SERVE_ADMIT_DEADLINE_S predicted-wait shed bound (0 = off)
   KARPENTER_TPU_SERVE_BATCH            cross-tenant stacking (1)
+  KARPENTER_TPU_SERVE_BATCH_LANES      max lanes per stacked dispatch (8)
+  KARPENTER_TPU_SERVE_REPLICAS         serve replicas / mesh slices (1)
+  KARPENTER_TPU_SERVE_BIG_PODS         big-tenant placement threshold (1024)
+  KARPENTER_TPU_SERVE_EWMA_HALF_LIFE_S wait-estimate decay half-life (5)
+  KARPENTER_TPU_SERVE_EWMA_FLOOR       wait-estimate staleness floor (0.25)
 """
 
 from __future__ import annotations
@@ -94,6 +110,50 @@ def batching_enabled() -> bool:
     return os.environ.get("KARPENTER_TPU_SERVE_BATCH", "1") not in ("", "0")
 
 
+def batch_lanes() -> int:
+    """Max lanes per stacked dispatch: wider stops amortizing and starts
+    inflating the padded batch (one lane's latency holds every lane hostage)."""
+    try:
+        return max(2, int(os.environ.get("KARPENTER_TPU_SERVE_BATCH_LANES", "8")))
+    except ValueError:
+        return 8
+
+
+def replicas() -> int:
+    try:
+        return max(1, int(os.environ.get("KARPENTER_TPU_SERVE_REPLICAS", "1")))
+    except ValueError:
+        return 1
+
+
+def big_tenant_pods() -> int:
+    """Expected-pods threshold above which a tenant is placed on the replica
+    owning the largest mesh slice (the round-18 sharded path's home)."""
+    try:
+        return max(1, int(os.environ.get("KARPENTER_TPU_SERVE_BIG_PODS", "1024")))
+    except ValueError:
+        return 1024
+
+
+def ewma_half_life_s() -> float:
+    try:
+        return max(
+            1e-3,
+            float(os.environ.get("KARPENTER_TPU_SERVE_EWMA_HALF_LIFE_S", "5")),
+        )
+    except ValueError:
+        return 5.0
+
+
+def ewma_floor() -> float:
+    try:
+        return min(1.0, max(
+            0.0, float(os.environ.get("KARPENTER_TPU_SERVE_EWMA_FLOOR", "0.25"))
+        ))
+    except ValueError:
+        return 0.25
+
+
 def parse_weights(spec: Optional[str] = None) -> Dict[str, float]:
     """``KARPENTER_TPU_SERVE_WEIGHTS="a=4,b=1"`` -> {"a": 4.0, "b": 1.0}.
     Malformed entries are skipped (an operator typo must not take down the
@@ -113,6 +173,19 @@ def parse_weights(spec: Optional[str] = None) -> Dict[str, float]:
         if name.strip() and weight > 0:
             out[name.strip()] = weight
     return out
+
+
+def parse_classes(spec: Optional[str] = None) -> Dict[str, float]:
+    """``KARPENTER_TPU_SERVE_CLASSES="gold=4,bronze=1"`` -> class weights.
+    Same grammar and tolerance as parse_weights. Empty/unset means ONE
+    implicit ``default`` class — the dispatcher then skips class-level
+    accounting entirely and the 16-tenant flat DWRR path is bit-identical."""
+    if spec is None:
+        spec = os.environ.get("KARPENTER_TPU_SERVE_CLASSES", "")
+    return parse_weights(spec)
+
+
+DEFAULT_CLASS = "default"
 
 
 # The live service this process is running, if any — serving.py's
@@ -140,17 +213,24 @@ from karpenter_tpu.serve.dispatcher import (  # noqa: E402  (re-export)
 from karpenter_tpu.serve.tenant import TenantState, build_tenant_solver  # noqa: E402
 
 __all__ = [
+    "DEFAULT_CLASS",
     "ServeOutcome",
     "SolveService",
     "TenantState",
     "Ticket",
     "admit_deadline_s",
+    "batch_lanes",
     "batching_enabled",
+    "big_tenant_pods",
     "build_tenant_solver",
     "current_service",
     "enabled",
+    "ewma_floor",
+    "ewma_half_life_s",
     "max_tenants",
+    "parse_classes",
     "parse_weights",
     "quantum",
     "queue_depth",
+    "replicas",
 ]

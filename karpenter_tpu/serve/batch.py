@@ -189,12 +189,17 @@ def _decode_lane(
     return out
 
 
-def stacked_solve(group: Sequence) -> List[Optional[SolveResult]]:
+def stacked_solve(group: Sequence, mesh="auto") -> List[Optional[SolveResult]]:
     """Solve a group of batchable requests in one ``batched_screen``
     dispatch. Returns one entry per request: a validator-clean SolveResult,
     or None where the stacked path stood down (that request then runs its
     tenant's ordinary solo solve). Never raises — any failure in here is a
-    fallback, not an outage."""
+    fallback, not an outage.
+
+    ``mesh`` selects the device slice the stacked dispatch runs on: a Mesh
+    (a serve replica's carved slice from parallel/mesh.carve_meshes), None
+    for a single-device vmap, or the default ``"auto"`` which resolves to
+    parallel/mesh.default_mesh() at dispatch time."""
     results: List[Optional[SolveResult]] = [None] * len(group)
     if len(group) < 2:
         return results
@@ -229,8 +234,11 @@ def stacked_solve(group: Sequence) -> List[Optional[SolveResult]]:
         # (parallel/mesh.py batched_screen with lane-axis padding): one
         # program per shape family in the census, and on multi-device hosts
         # the tenant lanes actually distribute instead of vmapping on one
-        # device
-        fr = batched_screen(batch, shared_claims, mesh=default_mesh())
+        # device. A replica passes its own carved slice here so fleets
+        # partition the host instead of contending for all of it.
+        if isinstance(mesh, str):
+            mesh = default_mesh()
+        fr = batched_screen(batch, shared_claims, mesh=mesh)
         state = fr.state
         fetched = jax.device_get((
             fr.kind, fr.index,

@@ -1,0 +1,168 @@
+"""Serve replicas: N dispatchers, each owning a carved mesh slice.
+
+One dispatcher thread serializes every tenant's solves onto one device
+set — correct, but at fleet scale a single serializing loop is the
+throughput ceiling. A ``ReplicaSet`` runs N ``SolveService`` replicas side
+by side, each with its OWN dispatcher thread and its OWN slice of the local
+devices (parallel/mesh.carve_meshes): replicas never contend for a device,
+so aggregate pods/s scales with the slice count while every per-replica
+property (fairness, isolation, classified admission) is untouched — a
+replica IS a SolveService.
+
+Placement is sticky and CLASSIFIED — every tenant->replica decision carries
+a reason, the same no-unclassified-outcomes rule admission follows:
+
+  pinned      the operator said so (tests, forced co-location)
+  big-tenant  expected pods >= KARPENTER_TPU_SERVE_BIG_PODS: the stream
+              rides replica 0, which owns the LARGEST carved slice (where
+              the sharded screen path pays off)
+  hash        everyone else: stable crc32(tenant) % n — deterministic
+              across processes, no coordination state to lose
+
+Stickiness is what keeps the isolation contract: a tenant's solver stack
+(circuit, warm state, quarantine namespace) lives on exactly one replica,
+so replica routing never splits a stream's state.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from karpenter_tpu.metrics.registry import SERVE_REPLICA_PLACEMENTS
+from karpenter_tpu.serve.dispatcher import AUTO_MESH, SolveService
+
+PLACE_PINNED = "pinned"
+PLACE_BIG_TENANT = "big-tenant"
+PLACE_HASH = "hash"
+
+
+class ReplicaSet:
+    """N SolveService replicas over carved mesh slices, with classified
+    sticky tenant placement. Construct explicitly; knobs fill the gaps
+    (KARPENTER_TPU_SERVE_REPLICAS, KARPENTER_TPU_SERVE_BIG_PODS)."""
+
+    def __init__(
+        self,
+        n_replicas: Optional[int] = None,
+        meshes: Optional[Sequence] = None,
+        big_tenant_pods: Optional[int] = None,
+        **service_kwargs,
+    ):
+        from karpenter_tpu import serve as cfg
+
+        self.n = max(1, int(n_replicas if n_replicas is not None else cfg.replicas()))
+        self.big_tenant_pods = (
+            big_tenant_pods
+            if big_tenant_pods is not None
+            else cfg.big_tenant_pods()
+        )
+        if meshes is None:
+            if self.n == 1:
+                # one replica owns everything: same mesh the flat service uses
+                meshes = [AUTO_MESH]
+            else:
+                from karpenter_tpu.parallel.mesh import carve_meshes
+
+                meshes = carve_meshes(self.n)
+        if len(meshes) != self.n:
+            raise ValueError(
+                f"{len(meshes)} meshes for {self.n} replicas"
+            )
+        self.replicas: List[SolveService] = [
+            SolveService(name=f"r{i}", mesh=meshes[i], **service_kwargs)
+            for i in range(self.n)
+        ]
+        # sticky placement: tenant -> (replica index, classified reason)
+        self._placements: Dict[str, Tuple[int, str]] = {}
+        self._lock = threading.Lock()
+
+    # -- placement ------------------------------------------------------------
+
+    def place(
+        self,
+        tenant_id: str,
+        expected_pods: int = 0,
+        pinned: Optional[int] = None,
+    ) -> Tuple[int, str]:
+        """Resolve (and remember) a tenant's replica. Idempotent: the first
+        decision sticks — a tenant's solver state lives on one replica."""
+        with self._lock:
+            existing = self._placements.get(tenant_id)
+            if existing is not None:
+                return existing
+            if pinned is not None:
+                decision = (pinned % self.n, PLACE_PINNED)
+            elif expected_pods >= self.big_tenant_pods:
+                # replica 0 holds the largest carved slice (carve_meshes
+                # gives the remainder devices to the first chunks)
+                decision = (0, PLACE_BIG_TENANT)
+            else:
+                decision = (
+                    zlib.crc32(tenant_id.encode()) % self.n, PLACE_HASH
+                )
+            self._placements[tenant_id] = decision
+        SERVE_REPLICA_PLACEMENTS.inc({"reason": decision[1]})
+        return decision
+
+    def replica_for(self, tenant_id: str, expected_pods: int = 0) -> SolveService:
+        idx, _ = self.place(tenant_id, expected_pods=expected_pods)
+        return self.replicas[idx]
+
+    # -- the SolveService surface, routed -------------------------------------
+
+    def register_tenant(self, tenant_id: str, expected_pods: int = 0, **kwargs):
+        return self.replica_for(
+            tenant_id, expected_pods=expected_pods
+        ).register_tenant(tenant_id, **kwargs)
+
+    def submit(self, tenant_id: str, pods, instance_types, templates, **kwargs):
+        return self.replica_for(
+            tenant_id, expected_pods=len(pods)
+        ).submit(tenant_id, pods, instance_types, templates, **kwargs)
+
+    def solve(self, tenant_id: str, pods, instance_types, templates, **kwargs):
+        return self.replica_for(
+            tenant_id, expected_pods=len(pods)
+        ).solve(tenant_id, pods, instance_types, templates, **kwargs)
+
+    def start(self) -> "ReplicaSet":
+        for r in self.replicas:
+            r.start()
+        return self
+
+    def close(self, timeout: float = 10.0) -> None:
+        for r in self.replicas:
+            r.close(timeout=timeout)
+
+    def healthy(self) -> bool:
+        return all(r.healthy() for r in self.replicas)
+
+    # -- introspection --------------------------------------------------------
+
+    def placements(self) -> Dict[str, Tuple[int, str]]:
+        with self._lock:
+            return dict(self._placements)
+
+    def snapshot(self) -> Dict:
+        placed = self.placements()
+        reasons: Dict[str, int] = {}
+        for _, reason in placed.values():
+            reasons[reason] = reasons.get(reason, 0) + 1
+        return {
+            "replicas": [r.snapshot() for r in self.replicas],
+            "placements": len(placed),
+            "placement_reasons": reasons,
+        }
+
+    def summary(self) -> Dict:
+        out: Dict = {"replicas": self.n, "placements": len(self._placements)}
+        totals: Dict[str, int] = {}
+        for r in self.replicas:
+            for key, value in r.summary().items():
+                if isinstance(value, (int, float)) and not isinstance(value, bool):
+                    totals[key] = totals.get(key, 0) + value
+        out.update(totals)
+        out["healthy"] = self.healthy()
+        return out

@@ -55,6 +55,7 @@ class TenantState:
         weight: float = 1.0,
         deadline_s: float = 0.0,
         queue_depth: int = 8,
+        cls: str = "default",
     ):
         self.id = tenant_id
         self.solver = solver
@@ -63,8 +64,13 @@ class TenantState:
         # without an explicit deadline; 0 = no budget
         self.deadline_s = float(deadline_s)
         self.queue_depth = int(queue_depth)
+        self.cls = cls
         self.queue: Deque = deque()
         self.deficit = 0.0
+        # ready == this stream sits in its class's ready-ring (nonempty
+        # queue). Idle streams are NOT swept by the dispatcher at all — that
+        # is the O(active) contract at 1k registered tenants.
+        self.ready = False
         self.counters: Dict[str, int] = {
             "submitted": 0,
             "completed": 0,
@@ -97,6 +103,7 @@ class TenantState:
         counters, latency quantiles, and the solver's own health."""
         out = {
             "tenant": self.id,
+            "class": self.cls,
             "weight": self.weight,
             "deadline_s": self.deadline_s,
             "queued": len(self.queue),

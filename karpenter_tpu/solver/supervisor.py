@@ -210,9 +210,14 @@ class SupervisedSolver(SolverBackend):
     def _labels(self, **labels) -> Optional[Dict[str, str]]:
         """Metric labels with the tenant folded in. Returns the exact
         pre-tenant shape (None for no labels) when untenanted, so existing
-        series and their tests stay bit-identical."""
+        series and their tests stay bit-identical. The tenant label value
+        goes through tenant_label() — bounded at fleet scale (overflow
+        tenants aggregate into 'other'); quarantine/journal namespaces keep
+        the raw id."""
         if self.tenant is not None:
-            labels["tenant"] = self.tenant
+            from karpenter_tpu.metrics.registry import tenant_label
+
+            labels["tenant"] = tenant_label(self.tenant)
         return labels or None
 
     # -- public introspection (serving.py /statusz) ---------------------------

@@ -294,7 +294,11 @@ class StreamingSolver(SolverBackend):
         self.counters[outcome] = self.counters.get(outcome, 0) + 1
         labels = {"outcome": outcome}
         if self.tenant is not None:
-            labels["tenant"] = self.tenant
+            # bounded label value (overflow tenants -> "other"); the raw
+            # tenant id still namespaces the journal and quarantine
+            from karpenter_tpu.metrics.registry import tenant_label
+
+            labels["tenant"] = tenant_label(self.tenant)
         WARM_SOLVES.inc(labels=labels)
         DELTA_REUSE_RATIO.set(ratio)
         trace.attr("streaming_outcome", outcome)

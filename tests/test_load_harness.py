@@ -1,0 +1,205 @@
+"""tools/load_harness.py: trace determinism, open-loop semantics, and the
+classified-outcome accounting the serve_fleet bench gates on."""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+from tools.load_harness import (  # noqa: E402
+    TraceEvent,
+    TraceSpec,
+    build_fleet,
+    make_trace,
+    run_trace,
+    summarize,
+)
+
+
+class _Outcome:
+    def __init__(self, status, reason="", latency_s=0.0):
+        self.status = status
+        self.reason = reason
+        self.latency_s = latency_s
+
+
+def _ev(cls="gold", pods=2, at=0.0):
+    return TraceEvent(at_s=at, tenant="t0000", cls=cls, pods=pods)
+
+
+class TestTrace:
+    def test_same_seed_same_trace_byte_for_byte(self):
+        spec = TraceSpec(n_tenants=50, duration_s=2.0, base_rate_hz=40.0)
+        assert make_trace(spec, seed=3) == make_trace(spec, seed=3)
+        assert make_trace(spec, seed=3) != make_trace(spec, seed=4)
+
+    def test_events_sorted_and_bounded(self):
+        spec = TraceSpec(n_tenants=50, duration_s=2.0, base_rate_hz=40.0)
+        trace = make_trace(spec, seed=1)
+        assert trace
+        ats = [e.at_s for e in trace]
+        assert ats == sorted(ats)
+        assert all(0.0 <= a < spec.duration_s for a in ats)
+        assert all(spec.pods_lo <= e.pods <= spec.pods_hi for e in trace)
+
+    def test_bursts_land_as_clusters(self):
+        spec = TraceSpec(
+            n_tenants=50, duration_s=4.0, base_rate_hz=10.0,
+            bursts=2, burst_size=16,
+        )
+        trace = make_trace(spec, seed=0)
+        by_instant = {}
+        for e in trace:
+            by_instant[e.at_s] = by_instant.get(e.at_s, 0) + 1
+        clustered = [t for t, n in by_instant.items() if n >= spec.burst_size]
+        assert len(clustered) == spec.bursts
+
+    def test_storm_windows_tag_events(self):
+        quiet = TraceSpec(n_tenants=20, duration_s=2.0, storm_windows=0)
+        assert not any(e.storm for e in make_trace(quiet, seed=0))
+        stormy = TraceSpec(
+            n_tenants=20, duration_s=2.0, storm_windows=1, storm_span_s=1.0
+        )
+        trace = make_trace(stormy, seed=0)
+        assert any(e.storm for e in trace)
+        assert any(not e.storm for e in trace)
+
+    def test_fleet_stripes_every_class(self):
+        spec = TraceSpec(n_tenants=10)
+        fleet = build_fleet(spec)
+        assert len(fleet) == 10
+        assert {cls for _, cls in fleet} == set(spec.classes)
+        # churn: across a long trace, traffic reaches beyond one window
+        spec = TraceSpec(
+            n_tenants=200, duration_s=4.0, base_rate_hz=100.0,
+            active_window=16, churn_period_s=0.5,
+        )
+        tenants = {e.tenant for e in make_trace(spec, seed=0)}
+        assert len(tenants) > spec.active_window
+
+
+class TestSummarize:
+    def test_classified_vocabulary_and_unclassified_detection(self):
+        rows = [
+            (_ev(pods=3), _Outcome("ok", "accepted", latency_s=0.010)),
+            (_ev(pods=2), _Outcome("ok", "accepted", latency_s=0.030)),
+            (_ev(cls="bronze"), _Outcome("overloaded", "overloaded-saturated")),
+            (_ev(cls="bronze"), _Outcome("rejected", "rejected-shutdown")),
+            (_ev(), _Outcome("pending")),
+            (_ev(), _Outcome("error", "boom")),
+            (_ev(cls="bronze"), _Outcome("overloaded", "mystery-reason")),
+        ]
+        report = summarize(rows, wall_s=2.0)
+        assert report["requests"] == 7
+        assert report["served"] == 2
+        assert report["served_pods"] == 5
+        assert report["pending"] == 1
+        assert report["unclassified"] == 1  # only "mystery-reason"
+        assert report["agg_pods_per_s"] == 2.5
+        assert report["outcomes"]["overloaded-saturated"] == 1
+        assert report["by_class"]["bronze"]["shed"] == 3
+        assert report["by_class"]["gold"]["served"] == 2
+
+    def test_quantiles_from_served_latencies(self):
+        rows = [
+            (_ev(), _Outcome("ok", latency_s=0.001 * (i + 1)))
+            for i in range(100)
+        ]
+        report = summarize(rows, wall_s=1.0)
+        assert report["p50_cycle_s"] == 0.051
+        assert report["p99_cycle_s"] == 0.1
+        assert summarize([], wall_s=0.0)["p99_cycle_s"] == 0.0
+
+
+class _StubResult:
+    new_claims = ()
+    node_pods: dict = {}
+    failures: dict = {}
+
+    def num_scheduled(self):
+        return 0
+
+
+class _StubSolver:
+    def solve(self, pods, its, tpls, **kwargs):
+        return _StubResult()
+
+
+class TestRunTrace:
+    def test_open_loop_never_waits_between_submits(self):
+        """The driver sleeps only toward each arrival instant; it must not
+        block on outcomes mid-trace. With a virtual clock every computed
+        delay is observable: all sleeps are bounded by inter-arrival gaps."""
+
+        class _Clock:
+            t = 0.0
+
+            def __call__(self):
+                return self.t
+
+        clock = _Clock()
+        sleeps = []
+
+        def sleep(d):
+            sleeps.append(d)
+            clock.t += d
+
+        from karpenter_tpu.serve.dispatcher import SolveService
+
+        spec = TraceSpec(
+            n_tenants=20, duration_s=1.0, base_rate_hz=40.0, bursts=1,
+            burst_size=8, active_window=8,
+        )
+        trace = make_trace(spec, seed=5)
+        service = SolveService(
+            solver_factory=lambda t: _StubSolver(), batching=False,
+            max_tenants=spec.n_tenants, classes=dict(spec.classes),
+        )
+        try:
+            report = run_trace(
+                service, trace, lambda ev: ([object()] * ev.pods, [], [], {}),
+                time_fn=clock, sleep_fn=sleep,
+            )
+        finally:
+            service.close()
+        assert report["requests"] == len(trace)
+        assert report["unclassified"] == 0
+        gaps = [
+            b.at_s - a.at_s for a, b in zip(trace, trace[1:])
+        ]
+        # one sleep per arrival at most, each no longer than its gap
+        assert len(sleeps) <= len(trace)
+        assert max(sleeps) <= max(gaps) + 1e-6
+
+    def test_end_to_end_stub_fleet_all_outcomes_classified(self):
+        from karpenter_tpu.serve.dispatcher import SolveService
+
+        spec = TraceSpec(
+            n_tenants=100, duration_s=1.0, base_rate_hz=80.0,
+            active_window=16, bursts=2, burst_size=12,
+        )
+        trace = make_trace(spec, seed=9)
+        service = SolveService(
+            solver_factory=lambda t: _StubSolver(), batching=False,
+            max_tenants=spec.n_tenants, classes=dict(spec.classes),
+            admit_deadline_s=5.0,
+        )
+        try:
+            report = run_trace(
+                service, trace, lambda ev: ([object()] * ev.pods, [], [], {}),
+                time_scale=0.02,
+            )
+        finally:
+            service.close()
+        assert report["requests"] == len(trace)
+        assert report["unclassified"] == 0
+        assert report["served"] > 0
+        accounted = (
+            report["served"] + report["pending"]
+            + sum(
+                n for reason, n in report["outcomes"].items()
+                if reason not in ("ok", "pending")
+            )
+        )
+        assert accounted == report["requests"]
+        assert set(report["by_class"]) <= set(spec.classes)

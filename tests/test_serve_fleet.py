@@ -1,0 +1,455 @@
+"""Fleet-scale serve (karpenter_tpu/serve/ at 1,000 streams): hierarchical
+DWRR properties, O(active) scheduling cost, the time-decayed admission
+estimator, class-aware saturation shedding, the shared program pool, mesh
+carving, and classified replica placement."""
+
+import threading
+
+import jax
+import pytest
+
+from karpenter_tpu.serve.dispatcher import (
+    ADMIT_ACCEPTED,
+    ADMIT_PREDICTED_WAIT,
+    ADMIT_SATURATED,
+    STATUS_OK,
+    STATUS_OVERLOADED,
+    SolveService,
+)
+from karpenter_tpu.serve.estimator import WaitEstimator
+from karpenter_tpu.serve.pool import ProgramPool, shape_family
+
+
+class _StubResult:
+    new_claims = ()
+    node_pods: dict = {}
+    failures: dict = {}
+
+    def num_scheduled(self):
+        return 0
+
+
+class _RecordingSolver:
+    def __init__(self, tenant, log):
+        self.tenant = tenant
+        self.log = log
+
+    def solve(self, pods, its, tpls, **kwargs):
+        self.log.append(self.tenant)
+        return _StubResult()
+
+
+def _preload(service):
+    """Park the dispatcher before it ever runs a decision: a dummy thread
+    that has already exited satisfies the submit() auto-start check, so
+    every queue can be loaded BEFORE scheduling starts — the DWRR schedule
+    over the preloaded backlog is then fully deterministic."""
+    dummy = threading.Thread(target=lambda: None)
+    dummy.start()
+    dummy.join()
+    service._thread = dummy
+
+
+def _release(service):
+    service._thread = None
+    service.start()
+
+
+def _drain(tickets, timeout=30.0):
+    return [t.wait(timeout) for t in tickets]
+
+
+# the flat DWRR schedule for weights 3:1, quantum 1, preloaded queues —
+# pinned by tests/test_serve.py's fairness window and re-pinned here as the
+# one-class bit-parity bar for the hierarchical dispatcher
+_FLAT_TRACE_12 = [
+    "heavy", "light", "heavy", "heavy", "light", "heavy",
+    "heavy", "heavy", "light", "heavy", "heavy", "heavy",
+]
+
+
+class TestHierarchicalDWRR:
+    def _run_two_class(self, classes, assign, per_tenant=8):
+        log = []
+        service = SolveService(
+            solver_factory=lambda t: _RecordingSolver(t, log),
+            batching=False, quantum=1.0, queue_depth=64,
+            classes=classes, max_tenants=16,
+        )
+        for tid, cls in assign.items():
+            service.register_tenant(tid, tenant_class=cls)
+        _preload(service)
+        tickets = []
+        for _ in range(per_tenant):
+            for tid in assign:
+                tickets.append(service.submit(tid, [object()], [], []))
+        _release(service)
+        outs = _drain(tickets)
+        service.close()
+        assert all(o.status == STATUS_OK for o in outs)
+        return log, service
+
+    def test_class_weights_bound_interclass_service_ratio(self):
+        """Property (i): under saturation the 3:1 class weights bound the
+        inter-class service ratio — gold takes ~12 of the first 16 even
+        though gold and bronze have identical tenant counts and weights."""
+        log, _ = self._run_two_class(
+            {"gold": 3.0, "bronze": 1.0},
+            {"g0": "gold", "g1": "gold", "b0": "bronze", "b1": "bronze"},
+        )
+        first = ["g" if t.startswith("g") else "b" for t in log[:16]]
+        assert 11 <= first.count("g") <= 13, first
+        # intra-class fairness: equal-weight members split their class's
+        # service evenly over the full run
+        assert abs(log.count("g0") - log.count("g1")) <= 1
+        assert abs(log.count("b0") - log.count("b1")) <= 1
+
+    def test_one_class_bit_identical_to_flat_dwrr(self):
+        """Property (ii): with ONE class — any name, configured or implicit —
+        the schedule is bit-identical to the flat 16-tenant DWRR trace."""
+        logs = []
+        for classes in (None, {"solo": 1.0}):
+            log = []
+            service = SolveService(
+                solver_factory=lambda t: _RecordingSolver(t, log),
+                batching=False, quantum=1.0, queue_depth=16,
+                classes=classes,
+            )
+            cls = None if classes is None else "solo"
+            service.register_tenant("heavy", weight=3.0, tenant_class=cls)
+            service.register_tenant("light", weight=1.0, tenant_class=cls)
+            _preload(service)
+            tickets = []
+            for _ in range(12):
+                tickets.append(service.submit("heavy", [object()], [], []))
+                tickets.append(service.submit("light", [object()], [], []))
+            _release(service)
+            outs = _drain(tickets)
+            service.close()
+            assert all(o.status == STATUS_OK for o in outs)
+            logs.append(log)
+        assert logs[0][:12] == _FLAT_TRACE_12
+        assert logs[0] == logs[1], (
+            "an implicit default class and an explicit single class must "
+            "produce the same schedule bit for bit"
+        )
+
+    def test_idle_forfeit_at_both_levels(self):
+        """Property (iii): an emptied stream forfeits its tenant balance and
+        an emptied class forfeits its class balance — no credit banking
+        while idle, at either level."""
+        log, service2 = self._run_two_class(
+            {"gold": 3.0, "bronze": 1.0},
+            {"g0": "gold", "b0": "bronze"},
+            per_tenant=4,
+        )
+        # service2 is closed; inspect the final state it drained to
+        for state in service2._tenants.values():
+            assert state.deficit == 0.0, (
+                f"{state.id} banked {state.deficit} pod-units while idle"
+            )
+            assert state.ready is False
+        for c in service2._classes.values():
+            assert c.deficit == 0.0, (
+                f"class {c.name} banked {c.deficit} pod-units while idle"
+            )
+            assert c.ring == []
+
+    def test_idle_registered_tenant_earns_nothing(self):
+        log = []
+        service = SolveService(
+            solver_factory=lambda t: _RecordingSolver(t, log),
+            batching=False, quantum=1.0, queue_depth=16,
+        )
+        service.register_tenant("busy")
+        service.register_tenant("idle")
+        _preload(service)
+        tickets = [service.submit("busy", [object()], [], []) for _ in range(6)]
+        _release(service)
+        _drain(tickets)
+        idle = service._tenants["idle"]
+        service.close()
+        assert idle.deficit == 0.0
+        assert idle.ready is False
+        assert "idle" not in log
+
+    def test_scheduling_is_o_active_not_o_registered(self):
+        """The ready-ring contract, measured: 500 registered streams, 4
+        active. Scan work per decision tracks the ACTIVE population — far
+        under even one sweep of the registry per decision."""
+        log = []
+        service = SolveService(
+            solver_factory=lambda t: _RecordingSolver(t, log),
+            batching=False, quantum=1.0, queue_depth=16,
+            max_tenants=600,
+        )
+        for i in range(500):
+            service.register_tenant(f"t{i:03d}")
+        active = [f"t{i:03d}" for i in range(4)]
+        _preload(service)
+        tickets = []
+        for _ in range(10):
+            for tid in active:
+                tickets.append(service.submit(tid, [object()], [], []))
+        _release(service)
+        outs = _drain(tickets)
+        snap = service.snapshot()
+        service.close()
+        assert all(o.status == STATUS_OK for o in outs)
+        decisions = snap["sched"]["decisions"]
+        scans = snap["sched"]["scans"]
+        assert decisions == 40
+        # each decision scans the 4-member ring at most a few times
+        # (affordability check + post-replenish rescan); one O(registered)
+        # sweep per decision would be 500 scans/decision
+        assert scans <= decisions * 16, snap["sched"]
+        assert snap["backlog"] == 0
+
+
+class _FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _deterministic_service(**kwargs):
+    """A service whose dispatcher NEVER runs (parked dummy thread) and whose
+    clock is test-owned: submit() admission decisions become a pure function
+    of the seeded estimator and the maintained backlog."""
+    clock = _FakeClock()
+    service = SolveService(
+        solver_factory=lambda t: _RecordingSolver(t, []),
+        batching=False, queue_depth=100, time_fn=clock, **kwargs,
+    )
+    _preload(service)
+    return service, clock
+
+
+class TestWaitEstimator:
+    def test_decay_and_staleness_floor(self):
+        clock = _FakeClock()
+        est = WaitEstimator(half_life_s=5.0, floor=0.25, time_fn=clock)
+        assert est.per_request_s() == 0.0
+        est.observe(1.0, now=0.0)
+        assert est.per_request_s(now=0.0) == pytest.approx(1.0)
+        assert est.per_request_s(now=5.0) == pytest.approx(0.5)
+        # two half-lives hits the floor exactly; far beyond stays AT it
+        assert est.per_request_s(now=10.0) == pytest.approx(0.25)
+        assert est.per_request_s(now=1000.0) == pytest.approx(0.25)
+        # a fresh observation snaps the estimate current again
+        est.observe(1.0, now=1000.0)
+        assert est.per_request_s(now=1000.0) > 0.25
+
+    def test_burst_admission_regression_trace(self):
+        """Satellite regression pin: the recorded burst trace. A busy period
+        seeds the EWMA at 0.5s/request and backlogs 5 requests; the 6th
+        sheds on predicted wait. After a 10s idle gap the SAME backlog
+        admits again — the decayed estimate (0.5 x 0.25 floor) no longer
+        predicts past the bound. The undecayed estimator shed here, which
+        is exactly the bursty-arrival bug this pins closed."""
+        service, clock = _deterministic_service(admit_deadline_s=2.0)
+        service._wait.observe(0.5, now=0.0)
+        expected = [
+            (STATUS_OK, ADMIT_ACCEPTED),          # backlog 0: wait 0.0
+            (STATUS_OK, ADMIT_ACCEPTED),          # backlog 1: wait 0.5
+            (STATUS_OK, ADMIT_ACCEPTED),          # backlog 2: wait 1.0
+            (STATUS_OK, ADMIT_ACCEPTED),          # backlog 3: wait 1.5
+            (STATUS_OK, ADMIT_ACCEPTED),          # backlog 4: wait 2.0 == bound
+            (STATUS_OVERLOADED, ADMIT_PREDICTED_WAIT),  # backlog 5: 2.5 > 2.0
+        ]
+        got = []
+        for _ in expected:
+            ticket = service.submit("burst", [object()], [], [])
+            if ticket.done():
+                out = ticket.wait(0)
+                got.append((out.status, out.reason))
+            else:
+                got.append((STATUS_OK, ADMIT_ACCEPTED))
+        assert got == expected
+        # the idle gap: 2 half-lives later the estimate floors at 0.125
+        # (0.5 x 0.25), so the same 5-deep backlog predicts 0.625 < 2.0
+        clock.t = 10.0
+        ticket = service.submit("burst", [object()], [], [])
+        assert not ticket.done(), (
+            "post-gap submit was shed against the stale busy-period EWMA"
+        )
+        assert service._wait.per_request_s() == pytest.approx(0.125)
+        service._closed = True  # parked dispatcher: nothing to join
+        service._thread = None
+        service.close()
+
+
+class TestSaturationShed:
+    def test_lower_class_sheds_while_gold_admits(self):
+        """Class-aware saturation: bronze's slice of the admit bound is
+        weight-scaled (1/4), so at a backlog gold still rides, bronze sheds
+        with the CLASSIFIED overloaded-saturated outcome."""
+        service, _clock = _deterministic_service(
+            admit_deadline_s=10.0,
+            classes={"gold": 4.0, "bronze": 1.0},
+        )
+        service.register_tenant("g", tenant_class="gold")
+        service.register_tenant("b", tenant_class="bronze")
+        service._wait.observe(1.0, now=0.0)
+        for _ in range(4):  # backlog to 4: predicted wait 4.0
+            assert not service.submit("g", [object()], [], []).done()
+        shed = service.submit("b", [object()], [], []).wait(0)
+        assert (shed.status, shed.reason) == (
+            STATUS_OVERLOADED, ADMIT_SATURATED,
+        ), "bronze must shed at 4.0 > 10.0 x (1/4)"
+        assert not service.submit("g", [object()], [], []).done(), (
+            "gold owns the full bound: 5.0 < 10.0 must still admit"
+        )
+        service._closed = True
+        service._thread = None
+        service.close()
+
+    def test_single_class_never_saturation_sheds(self):
+        """One class => factor 1 => the saturated branch is structurally
+        dead; only the flat predicted-wait bound sheds (bit-compat)."""
+        service, _clock = _deterministic_service(admit_deadline_s=10.0)
+        service._wait.observe(1.0, now=0.0)
+        outcomes = []
+        for _ in range(12):
+            ticket = service.submit("t", [object()], [], [])
+            outcomes.append(ticket.wait(0).reason if ticket.done() else "")
+        assert ADMIT_SATURATED not in outcomes
+        assert ADMIT_PREDICTED_WAIT in outcomes  # the flat bound still binds
+        service._closed = True
+        service._thread = None
+        service.close()
+
+
+class _Req:
+    def __init__(self, pods=4, its=3, tpls=1):
+        self.pods = [object()] * pods
+        self.instance_types = [object()] * its
+        self.templates = [object()] * tpls
+
+
+class TestProgramPool:
+    def test_family_key_separates_catalog_shapes(self):
+        assert shape_family(_Req(pods=4)) == shape_family(_Req(pods=4))
+        assert shape_family(_Req(its=3)) != shape_family(_Req(its=5))
+        assert shape_family(_Req(tpls=1)) != shape_family(_Req(tpls=2))
+
+    def test_note_order_and_clear(self):
+        pool = ProgramPool()
+        key = shape_family(_Req())
+        pool.note_head("a", _Req(), eligible=True)
+        pool.note_head("b", _Req(), eligible=True)
+        pool.note_head("c", _Req(), eligible=False)  # de-indexed only
+        assert pool.candidates(key) == ("a", "b")
+        pool.clear("a")
+        assert pool.candidates(key) == ("b",)
+        pool.note_head("b", _Req(its=9), eligible=True)  # head changed family
+        assert pool.candidates(key) == ()
+        assert pool.candidates(shape_family(_Req(its=9))) == ("b",)
+        assert pool.indexed() == 1
+
+    def test_dispatcher_maintains_pool_index(self):
+        """Enqueue-to-empty indexes the head; pop-to-empty clears it. The
+        dispatcher is parked so the index is observable mid-backlog."""
+        from tests.factories import make_pod
+
+        service, _clock = _deterministic_service()
+        service.batching = True
+        service.register_tenant("a")
+        service.submit("a", [make_pod(name=f"p{i}") for i in range(4)], [], [])
+        # stub solver is not a JaxSolver at the bottom => the head is noted
+        # as ineligible (de-indexed only), which is itself the contract:
+        # the pool only ever holds batchable heads
+        assert service._pool.indexed() == 0
+        assert service._pool.noted == 0
+        service._closed = True
+        service._thread = None
+        service.close()
+
+
+class TestCarveMeshes:
+    def test_contiguous_balanced_carve(self):
+        from karpenter_tpu.parallel.mesh import carve_meshes
+
+        devices = jax.devices()
+        if len(devices) != 8:
+            pytest.skip("needs the conftest 8-device CPU topology")
+        two = carve_meshes(2)
+        assert [m.devices.size for m in two] == [4, 4]
+        three = carve_meshes(3)
+        assert [m.devices.size for m in three] == [3, 3, 2], (
+            "remainder devices must land on the FIRST slices (replica 0 "
+            "is the big-tenant home)"
+        )
+        # no device appears in two slices; order is contiguous
+        seen = [d for m in three for d in m.devices.flat]
+        assert seen == devices
+        eight = carve_meshes(8)
+        assert all(m is None for m in eight), (
+            "a 1-device slice buys nothing over vmap and must be None"
+        )
+        one = carve_meshes(1)
+        assert one[0].devices.size == 8
+
+    def test_carve_with_explicit_devices(self):
+        from karpenter_tpu.parallel.mesh import carve_meshes
+
+        assert carve_meshes(2, devices=[]) == [None, None]
+
+
+class TestReplicaSet:
+    def _make(self, n=3):
+        from karpenter_tpu.serve.replica import ReplicaSet
+
+        return ReplicaSet(
+            n_replicas=n, meshes=[None] * n,
+            solver_factory=lambda t: _RecordingSolver(t, []),
+            batching=False, big_tenant_pods=100, max_tenants=64,
+        )
+
+    def test_placement_reasons_classified_and_sticky(self):
+        import zlib
+
+        rs = self._make(3)
+        try:
+            assert rs.place("pinme", pinned=2) == (2, "pinned")
+            assert rs.place("whale", expected_pods=500) == (0, "big-tenant")
+            idx, reason = rs.place("small", expected_pods=4)
+            assert reason == "hash"
+            assert idx == zlib.crc32(b"small") % 3
+            # sticky: a later call with different hints keeps the decision
+            assert rs.place("whale", expected_pods=1) == (0, "big-tenant")
+            reasons = rs.snapshot()["placement_reasons"]
+            assert reasons == {"pinned": 1, "big-tenant": 1, "hash": 1}
+        finally:
+            rs.close()
+
+    def test_submit_routes_and_serves(self):
+        rs = self._make(2)
+        try:
+            rs.start()
+            tickets = [
+                rs.submit(f"t{i}", [object()], [], []) for i in range(8)
+            ]
+            outs = [t.wait(30.0) for t in tickets]
+            assert all(o.status == STATUS_OK for o in outs)
+            # every tenant landed on exactly one replica, every placement
+            # carries a classified reason
+            placed = rs.placements()
+            assert len(placed) == 8
+            assert {r for _, r in placed.values()} <= {
+                "pinned", "big-tenant", "hash",
+            }
+            assert rs.summary()["completed"] >= 8
+        finally:
+            rs.close()
+
+    def test_mesh_count_mismatch_rejected(self):
+        from karpenter_tpu.serve.replica import ReplicaSet
+
+        with pytest.raises(ValueError):
+            ReplicaSet(
+                n_replicas=2, meshes=[None],
+                solver_factory=lambda t: _RecordingSolver(t, []),
+            )

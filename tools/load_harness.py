@@ -1,0 +1,291 @@
+"""Open-loop load harness: the fleet-scale ground truth for serve numbers.
+
+Closed-loop drivers (submit, wait, submit again) lie about saturation: when
+the service slows down the driver slows down with it, so measured latency
+flattens exactly when real clients would be piling up. This harness is
+OPEN-LOOP — the arrival schedule is fixed up front by a seeded trace and
+requests fire at their scheduled instants whether or not earlier ones
+completed. Under saturation the backlog grows and admission sheds, which is
+the point: `bench.py serve_fleet` reports aggregate pods/s AND p99 cycle
+latency under that pressure, and asserts every unserved request carries a
+classified outcome (unclassified count is a bench ERROR, not a statistic).
+
+The trace models a fleet day in miniature:
+
+  diurnal     a sinusoidal rate envelope over the trace (peak/trough),
+  churn       the ACTIVE tenant window rotates through the registered fleet,
+              so 1,000 registered streams stay mostly idle at any instant
+              (exactly the population the O(active) dispatcher contract is
+              about) while every stream gets traffic eventually,
+  bursts      scheduled instants where a cluster of arrivals lands at once
+              (the EWMA-decay admission case),
+  storms      optional reclaim-storm windows tagged on events so chaos runs
+              (tools/chaos_sweep.py fleet row) can align fault injection
+              with arrival pressure.
+
+Everything is deterministic from the seed: the same (seed, spec) produces
+the same event list byte for byte — traces are pinnable in tests.
+
+Stdlib only; solver-agnostic. The driver takes any object with the
+SolveService ``submit(tenant, pods, instance_types, templates)`` surface
+(a real service, a ReplicaSet, or a stub) plus a request factory, so unit
+tests run it against stub solvers in milliseconds.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+# classified outcome vocabulary the report recognizes; anything else on an
+# unserved outcome counts as UNCLASSIFIED (a contract violation upstream)
+_CLASSIFIED_UNSERVED = frozenset({
+    "overloaded-queue-full",
+    "overloaded-predicted-wait",
+    "overloaded-saturated",
+    "overloaded-expired",
+    "rejected-max-tenants",
+    "rejected-shutdown",
+})
+
+
+@dataclass(frozen=True)
+class TraceEvent:
+    at_s: float    # arrival offset from trace start
+    tenant: str
+    cls: str
+    pods: int
+    storm: bool = False  # inside a reclaim-storm window (chaos alignment)
+
+
+@dataclass
+class TraceSpec:
+    """Knobs for one synthetic fleet day. Defaults give a busy-but-sane
+    trace; the bench and chaos rows override deliberately."""
+
+    n_tenants: int = 1000
+    classes: Dict[str, float] = field(
+        default_factory=lambda: {"gold": 4.0, "silver": 2.0, "bronze": 1.0}
+    )
+    duration_s: float = 10.0
+    base_rate_hz: float = 50.0      # mean arrivals/s before the envelope
+    diurnal_amplitude: float = 0.5  # rate swings x(1 +/- amplitude)
+    active_window: int = 64         # tenants receiving traffic at an instant
+    churn_period_s: float = 1.0     # window advance cadence
+    bursts: int = 3                 # evenly spaced burst instants
+    burst_size: int = 32            # arrivals landing at each burst
+    storm_windows: int = 0          # reclaim-storm windows to tag
+    storm_span_s: float = 0.5
+    pods_lo: int = 1
+    pods_hi: int = 8
+
+
+def build_fleet(spec: TraceSpec) -> List[Tuple[str, str]]:
+    """The registered fleet: (tenant_id, class) rows, classes striped
+    round-robin so every class is populated at any fleet size."""
+    names = sorted(spec.classes) or ["default"]
+    return [
+        (f"t{i:04d}", names[i % len(names)])
+        for i in range(max(1, spec.n_tenants))
+    ]
+
+
+def make_trace(spec: TraceSpec, seed: int = 0) -> List[TraceEvent]:
+    """Deterministic open-loop arrival schedule for one fleet day."""
+    rng = random.Random(seed)
+    fleet = build_fleet(spec)
+    events: List[TraceEvent] = []
+    storms = [
+        (
+            (w + 0.5) * spec.duration_s / max(1, spec.storm_windows),
+            (w + 0.5) * spec.duration_s / max(1, spec.storm_windows)
+            + spec.storm_span_s,
+        )
+        for w in range(spec.storm_windows)
+    ]
+
+    def in_storm(t: float) -> bool:
+        return any(lo <= t < hi for lo, hi in storms)
+
+    def pick_tenant(t: float) -> Tuple[str, str]:
+        # the active window slides through the fleet: most registered
+        # streams are idle at any instant, all see traffic across the trace
+        window = min(spec.active_window, len(fleet))
+        start = int(t / max(1e-6, spec.churn_period_s)) * window
+        return fleet[(start + rng.randrange(window)) % len(fleet)]
+
+    # diurnal arrivals: integrate the rate envelope in fixed steps and emit
+    # whenever the accumulator crosses 1 (deterministic thinning — no
+    # Poisson draw, so the schedule is stable across python versions)
+    dt = 1.0 / max(1.0, spec.base_rate_hz * 4.0)
+    acc, t = 0.0, 0.0
+    while t < spec.duration_s:
+        phase = 2.0 * math.pi * t / max(1e-6, spec.duration_s)
+        rate = spec.base_rate_hz * (
+            1.0 + spec.diurnal_amplitude * math.sin(phase)
+        )
+        acc += rate * dt
+        while acc >= 1.0:
+            acc -= 1.0
+            tenant, cls = pick_tenant(t)
+            events.append(TraceEvent(
+                at_s=round(t, 6), tenant=tenant, cls=cls,
+                pods=rng.randint(spec.pods_lo, spec.pods_hi),
+                storm=in_storm(t),
+            ))
+        t += dt
+    # bursts: a cluster of arrivals at one instant (same timestamp — the
+    # admission gate sees them back to back against a possibly-stale EWMA)
+    for b in range(spec.bursts):
+        at = (b + 1) * spec.duration_s / (spec.bursts + 1)
+        for _ in range(spec.burst_size):
+            tenant, cls = pick_tenant(at)
+            events.append(TraceEvent(
+                at_s=round(at, 6), tenant=tenant, cls=cls,
+                pods=rng.randint(spec.pods_lo, spec.pods_hi),
+                storm=in_storm(at),
+            ))
+    events.sort(key=lambda e: (e.at_s, e.tenant))
+    return events
+
+
+def run_trace(
+    service,
+    trace: Sequence[TraceEvent],
+    request_factory: Callable[[TraceEvent], tuple],
+    time_scale: float = 1.0,
+    register: bool = True,
+    drain_timeout_s: float = 30.0,
+    time_fn=time.monotonic,
+    sleep_fn=time.sleep,
+) -> Dict:
+    """Drive the trace open-loop against ``service`` and report.
+
+    ``request_factory(event) -> (pods, instance_types, templates, kwargs)``
+    builds each request's payload. ``time_scale`` compresses the schedule
+    (0.1 = 10x faster than the trace's nominal clock); the loop NEVER waits
+    on outcomes between submits — that is the open-loop contract. Outcomes
+    are collected after the last arrival, bounded by ``drain_timeout_s``.
+    """
+    if register:
+        seen = {}
+        for ev in trace:
+            seen.setdefault(ev.tenant, ev.cls)
+        for tenant, cls in seen.items():
+            service.register_tenant(tenant, tenant_class=cls)
+    pending: List[Tuple[TraceEvent, object, float]] = []
+    started = time_fn()
+    for ev in trace:
+        due = started + ev.at_s * time_scale
+        delay = due - time_fn()
+        if delay > 0:
+            sleep_fn(delay)
+        pods, its, tpls, kwargs = request_factory(ev)
+        ticket = service.submit(ev.tenant, pods, its, tpls, **kwargs)
+        pending.append((ev, ticket, time_fn()))
+    deadline = time_fn() + drain_timeout_s
+    outcomes = []
+    for ev, ticket, _at in pending:
+        outcomes.append((ev, ticket.wait(max(0.0, deadline - time_fn()))))
+    wall = time_fn() - started
+    return summarize(outcomes, wall)
+
+
+def summarize(outcomes: Sequence[Tuple[TraceEvent, object]], wall_s: float) -> Dict:
+    """Fold (event, outcome) pairs into the serve_fleet report row."""
+    served_pods = 0
+    latencies: List[float] = []
+    by_outcome: Dict[str, int] = {}
+    by_class: Dict[str, Dict[str, int]] = {}
+    unclassified = 0
+    pending = 0
+    for ev, out in outcomes:
+        row = by_class.setdefault(ev.cls, {"submitted": 0, "served": 0, "shed": 0})
+        row["submitted"] += 1
+        if out.status == "ok":
+            served_pods += ev.pods
+            latencies.append(out.latency_s)
+            by_outcome["ok"] = by_outcome.get("ok", 0) + 1
+            row["served"] += 1
+        elif out.status == "pending":
+            # still in flight at drain timeout: not shed, not unclassified
+            pending += 1
+            by_outcome["pending"] = by_outcome.get("pending", 0) + 1
+        elif out.status == "error":
+            by_outcome["error"] = by_outcome.get("error", 0) + 1
+        else:
+            reason = out.reason or "UNCLASSIFIED"
+            by_outcome[reason] = by_outcome.get(reason, 0) + 1
+            row["shed"] += 1
+            if reason not in _CLASSIFIED_UNSERVED:
+                unclassified += 1
+    latencies.sort()
+
+    def quantile(q: float) -> float:
+        if not latencies:
+            return 0.0
+        return latencies[min(len(latencies) - 1, int(q * len(latencies)))]
+
+    return {
+        "requests": len(outcomes),
+        "served": by_outcome.get("ok", 0),
+        "served_pods": served_pods,
+        "pending": pending,
+        "unclassified": unclassified,
+        "wall_s": round(wall_s, 4),
+        "agg_pods_per_s": round(served_pods / wall_s, 2) if wall_s > 0 else 0.0,
+        "p50_cycle_s": round(quantile(0.50), 6),
+        "p99_cycle_s": round(quantile(0.99), 6),
+        "outcomes": dict(sorted(by_outcome.items())),
+        "by_class": by_class,
+    }
+
+
+def main() -> int:
+    """Standalone smoke: a small stub-solver fleet run, printed as JSON.
+    The real numbers come from ``python bench.py serve_fleet``."""
+    import json
+
+    from karpenter_tpu.serve.dispatcher import SolveService
+
+    class _StubResult:
+        new_claims = ()
+        node_pods: Dict = {}
+        failures: Dict = {}
+
+        def num_scheduled(self):
+            return 0
+
+    class _StubSolver:
+        def solve(self, pods, its, tpls, **kwargs):
+            return _StubResult()
+
+    spec = TraceSpec(
+        n_tenants=200, duration_s=2.0, base_rate_hz=100.0,
+        active_window=32, bursts=2, burst_size=16,
+    )
+    trace = make_trace(spec, seed=7)
+    service = SolveService(
+        solver_factory=lambda t: _StubSolver(), batching=False,
+        max_tenants=spec.n_tenants, classes=dict(spec.classes),
+    )
+    try:
+        report = run_trace(
+            service, trace,
+            lambda ev: ([object()] * ev.pods, [], [], {}),
+            time_scale=0.05,
+        )
+    finally:
+        service.close()
+    print(json.dumps(report, indent=2))
+    return 0 if report["unclassified"] == 0 else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.path.insert(0, ".")
+    sys.exit(main())

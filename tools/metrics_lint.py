@@ -135,18 +135,32 @@ def _check_explain_taxonomy(docs: str) -> list:
     return problems
 
 
-def _check_tenant_labels() -> list:
-    """The ``tenant`` label is bounded by construction: the serve layer
-    refuses registration past KARPENTER_TPU_SERVE_MAX_TENANTS, so no metric
-    may ever carry more distinct tenant values than that bound (plus the
-    ``-`` placeholder unregistered rejections use). A violation means some
-    code path minted tenant series outside the admission gate — exactly the
-    cardinality leak the bound exists to prevent."""
-    problems = []
-    from karpenter_tpu import serve
-    from karpenter_tpu.metrics.registry import REGISTRY
+# sanity ceiling on distinct tenant-CLASS label values: classes are operator
+# config (KARPENTER_TPU_SERVE_CLASSES), so anything past this is a bug
+# minting classes from data, not a generous operator
+_CLS_BOUND = 64
 
-    bound = serve.max_tenants()
+
+def _check_tenant_labels() -> list:
+    """Cardinality contracts on the two tenant-shaped label axes:
+
+    1. serve hot-path families (``karpenter_serve_*``) must NEVER carry a
+       ``tenant`` label key at all — at fleet scale (1,000 registered
+       streams) per-tenant hot-path series dwarf the whole endpoint; they
+       aggregate to the tenant CLASS (``cls``) label and per-tenant detail
+       lives in /debug/tenants;
+    2. ``cls`` label values are bounded by a fixed sanity ceiling — classes
+       are operator config, never data;
+    3. families that DO carry a ``tenant`` label (circuit state, validator
+       rejections, warm solves — cold paths) must stay within the
+       registry's tenant_label() cap (first N distinct ids + ``other``):
+       more distinct values means some code path wrote ``self.tenant`` raw
+       instead of going through tenant_label().
+    """
+    problems = []
+    from karpenter_tpu.metrics.registry import REGISTRY, tenant_label_max
+
+    bound = tenant_label_max()
     for kind, name, _help in REGISTRY.describe():
         metric = REGISTRY.get(name)
         if metric is None:
@@ -154,17 +168,41 @@ def _check_tenant_labels() -> list:
         values = getattr(metric, "_values", None)
         if values is None:  # histograms carry _counts; none is tenant-labeled
             continue
+        label_keys = {
+            k for label_key in values for k, _ in label_key
+        }
+        # describe() names are fully prefixed (karpenter_serve_*): match the
+        # serve subsystem, not a bare serve_ prefix that would never fire
+        if "_serve_" in name and "tenant" in label_keys:
+            problems.append(
+                f"{name} carries a 'tenant' label: serve hot-path families "
+                f"aggregate to the tenant-class ('cls') label (per-tenant "
+                f"detail belongs in /debug/tenants)"
+            )
+        classes = {
+            dict(label_key).get("cls")
+            for label_key in values
+            if any(k == "cls" for k, _ in label_key)
+        }
+        classes.discard("-")
+        if len(classes) > _CLS_BOUND:
+            problems.append(
+                f"{name} carries {len(classes)} distinct tenant-class label "
+                f"values, above the sanity ceiling of {_CLS_BOUND} (classes "
+                f"are operator config, never data)"
+            )
         tenants = {
             dict(label_key).get("tenant")
             for label_key in values
             if any(k == "tenant" for k, _ in label_key)
         }
         tenants.discard("-")
+        tenants.discard("other")
         if len(tenants) > bound:
             problems.append(
                 f"{name} carries {len(tenants)} distinct tenant label values, "
-                f"above the KARPENTER_TPU_SERVE_MAX_TENANTS bound of {bound} "
-                f"(bounded-cardinality contract)"
+                f"above the KARPENTER_TPU_TENANT_LABEL_MAX bound of {bound} "
+                f"(route tenant labels through registry.tenant_label())"
             )
     return problems
 

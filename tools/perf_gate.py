@@ -123,6 +123,16 @@ DEFAULT_BANDS = {
     # first flag-on run seeds each window; flag-off rows lack the columns.
     "solve_10k_relax2_s": (LOWER_BETTER, 3.0),
     "relax2_placed_frac": (HIGHER_BETTER, 2.0),
+    # round-23 fleet-scale serve (serve_fleet scenario): open-loop aggregate
+    # throughput and p99 cycle latency at 1,000 registered tenants under
+    # saturation (tools/load_harness.py drives the trace; the unclassified-
+    # shed and co-batch-hit-rate acceptance gates live inside bench.py).
+    # The arrival rate is calibrated to the host's measured service time,
+    # so the numbers are steadier than the raw serve scenario's; bands
+    # still start generous because the seed window is one row deep. The
+    # first fleet-carrying run seeds each window.
+    "serve_fleet_pods_s": (HIGHER_BETTER, 4.0),
+    "serve_fleet_p99_cycle_s": (LOWER_BETTER, 3.0),
     # round-21 DeviceWorld steady-state churn (streaming/device_world.py,
     # KARPENTER_TPU_DEVICE_WORLD): HOST-INCLUSIVE per-cycle wall (encode +
     # patch + fused dispatch + decode + verify) at the churn shape, p50 over
@@ -201,6 +211,14 @@ def row_from_bench(out: dict, label: str = "run") -> dict:
         "serve_p99_cycle_s": out.get("serve_p99_cycle_s"),
         "serve_vs_sequential": out.get("serve_vs_sequential"),
         "serve_batch_hit_rate": out.get("serve_batch_hit_rate"),
+        # schema v2, round 23: fleet-scale serve columns — present only
+        # when the bench serve_fleet scenario completed (open-loop load
+        # harness at 1,000 registered tenants; bench.py serve_fleet event)
+        "serve_fleet_pods_s": out.get("serve_fleet_pods_s"),
+        "serve_fleet_p99_cycle_s": out.get("serve_fleet_p99_cycle_s"),
+        "serve_fleet_p99_vs_baseline": out.get("serve_fleet_p99_vs_baseline"),
+        "serve_fleet_pool_hit_rate": out.get("serve_fleet_pool_hit_rate"),
+        "serve_fleet_tenants": out.get("serve_fleet_tenants"),
         # schema v2, round 18: mesh-sharded partitioned solve columns —
         # present only when the bench shard shape family ran and the
         # partitioned path actually served (standdowns omit the columns)
@@ -415,8 +433,72 @@ def smoke(baseline_path=DEFAULT_BASELINE) -> list:
                 f"iterations vs {off_narrow} flag-off (ceiling "
                 f"max(0.1x, 5))"
             )
+
+        # (4) fleet-serve small-N smoke (round 23): the serve_fleet
+        # scenario's machinery — seeded open-loop trace, hierarchical
+        # classes, replica routing — driven with STUB solvers so it proves
+        # the serving path in milliseconds without touching the device.
+        # Gates the contracts, not the numbers: every unserved outcome
+        # classified, traffic actually served, every placement reasoned.
+        problems += _smoke_serve_fleet()
     finally:
         programs.set_enabled(None)
+    return problems
+
+
+def _smoke_serve_fleet() -> list:
+    """Small-N stub-solver run of the serve_fleet shape (see smoke())."""
+    problems = []
+    from karpenter_tpu.serve.replica import ReplicaSet
+    from tools.load_harness import TraceSpec, make_trace, run_trace
+
+    class _StubResult:
+        new_claims = ()
+        node_pods: dict = {}
+        failures: dict = {}
+
+        def num_scheduled(self):
+            return 0
+
+    class _StubSolver:
+        def solve(self, pods, its_, tpls_, **kw):
+            return _StubResult()
+
+    spec = TraceSpec(
+        n_tenants=200, duration_s=1.0, base_rate_hz=150.0,
+        active_window=32, churn_period_s=0.2, bursts=2, burst_size=16,
+    )
+    trace = make_trace(spec, seed=11)
+    fleet = ReplicaSet(
+        n_replicas=2, meshes=[None, None],
+        solver_factory=lambda t: _StubSolver(),
+        max_tenants=spec.n_tenants, classes=dict(spec.classes),
+        batching=False, admit_deadline_s=0.5,
+    )
+    try:
+        report = run_trace(
+            fleet, trace, lambda ev: ([object()] * ev.pods, [], [], {}),
+            time_scale=0.05, drain_timeout_s=30.0,
+        )
+        placed = fleet.placements()
+    finally:
+        fleet.close()
+    if report["unclassified"] > 0:
+        problems.append(
+            f"fleet-serve smoke: {report['unclassified']} unserved outcomes "
+            f"without a classified reason"
+        )
+    if report["served"] == 0:
+        problems.append("fleet-serve smoke: nothing served")
+    bad_reasons = {
+        r for _, r in placed.values() if r not in ("pinned", "big-tenant", "hash")
+    }
+    if bad_reasons:
+        problems.append(
+            f"fleet-serve smoke: unclassified placement reasons {bad_reasons}"
+        )
+    if len(placed) == 0:
+        problems.append("fleet-serve smoke: no tenant placements recorded")
     return problems
 
 
