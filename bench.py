@@ -1310,6 +1310,39 @@ def run_child():
                       "error": f"rc={out.returncode}: {out.stderr[-300:]}"})
         except subprocess.TimeoutExpired:
             emit({"event": "shard", "pods": n, "error": "timeout"})
+
+    # degraded-mesh recovery (solver/mesh_health.py, docs/ROBUSTNESS.md
+    # "Degraded mesh"): inject a device loss into the first sharded dispatch
+    # and measure failure -> first green solve on the shrunken mesh. Own
+    # subprocess for the same reason as the shard shapes: the health layer
+    # needs a multi-device topology, forced only in the child.
+    mh_env = dict(os.environ)
+    if dev.platform == "cpu":
+        flags = mh_env.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            mh_env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--mesh-health-child"],
+            capture_output=True,
+            text=True,
+            timeout=int(os.environ.get("BENCH_MESH_HEALTH_TIMEOUT", "570")),
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env=mh_env,
+        )
+        line = next(
+            (l for l in out.stdout.splitlines()
+             if l.startswith('{"event": "mesh_recovery"')), None
+        )
+        if line:
+            emit(json.loads(line))
+        else:
+            emit({"event": "mesh_recovery",
+                  "error": f"rc={out.returncode}: {out.stderr[-300:]}"})
+    except subprocess.TimeoutExpired:
+        emit({"event": "mesh_recovery", "error": "timeout"})
     emit({"event": "done"})
 
 
@@ -1403,6 +1436,80 @@ def run_shard_child():
         "control_scheduled_frac": round(c_result.num_scheduled() / max(n, 1), 4),
         "speedup_vs_control": round(c_median / max(median, 1e-9), 3),
     })
+    print(json.dumps(ev), flush=True)
+
+
+def run_mesh_health_child():
+    """Device-loss recovery scenario: kill one device on the first sharded
+    dispatch (testing/faults.py ``device[1].loss@1``) and measure the wall
+    from the failure to the first green solve on the recarved mesh — the
+    mesh_recovery_s number the perf gate bands. Spawned with the host forced
+    multi-device; prints exactly one JSON mesh_recovery event."""
+    from karpenter_tpu.operator.logging import quiet_xla_warnings
+
+    quiet_xla_warnings()
+    os.environ["KARPENTER_TPU_EXPLAIN"] = "0"
+    os.environ["KARPENTER_TPU_MESH_HEALTH"] = "1"
+    os.environ["KARPENTER_TPU_SHARD"] = "1"
+
+    import __graft_entry__
+
+    __graft_entry__._respect_platform_env()
+
+    import jax
+
+    from karpenter_tpu.apis.nodepool import NodePool
+    from karpenter_tpu.apis.objects import ObjectMeta
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.solver import mesh_health
+    from karpenter_tpu.solver.encode import template_from_nodepool
+    from karpenter_tpu.solver.jax_backend import JaxSolver
+    from karpenter_tpu.testing import faults
+
+    ev = {"event": "mesh_recovery", "devices": len(jax.devices())}
+    if len(jax.devices()) < 2:
+        ev["error"] = "single-device host: nothing to recarve"
+        print(json.dumps(ev), flush=True)
+        return
+
+    n = int(os.environ.get("BENCH_MESH_HEALTH_PODS",
+                           "2000" if os.environ.get("BENCH_QUICK") else "10000"))
+    rng = random.Random(42)
+    its = instance_types(400)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="default")), its, range(len(its))
+    )
+    pods = make_fleet_pods(n, rng)
+    ev["pods"] = n
+
+    solver = JaxSolver()
+    solver.solve(pods, its, [tpl])  # warm compile on the full mesh
+    mesh_health.reset()
+
+    faults.install(faults.FaultInjector.from_spec("seed=5;device[1].loss@1"))
+    try:
+        t0 = time.perf_counter()
+        result = solver.solve(pods, its, [tpl])
+        faulted_s = time.perf_counter() - t0
+    finally:
+        faults.install(None)
+
+    last = getattr(solver, "last_shard", None) or {}
+    snap = mesh_health.tracker().snapshot() if mesh_health.has_tracker() else {}
+    ev.update({
+        "faulted_solve_s": round(faulted_s, 4),
+        "mesh_recovery_s": snap.get("last_recovery_s"),
+        "scheduled": result.num_scheduled(),
+        "reason": last.get("reason", "never-attempted"),
+        "recarves": last.get("recarves"),
+        "recarve_reasons": [r.get("reason") for r in snap.get("recarves", [])],
+    })
+    if ev["mesh_recovery_s"] is None:
+        ev["error"] = "no recovery clock closed (fault never fired?)"
+    elif ev["reason"] is not None:
+        ev["error"] = f"shard path stood down: {ev['reason']}"
+    elif not ev["recarves"]:
+        ev["error"] = "solve served without a recarve (fault never fired?)"
     print(json.dumps(ev), flush=True)
 
 
@@ -1935,6 +2042,16 @@ def main():
         out["shard_errors"] = {
             str(e.get("pods")): e["error"] for e in shard_errs
         }
+    mh = next((e for e in events if e.get("event") == "mesh_recovery"), None)
+    if mh is not None and "error" not in mh:
+        # degraded-mesh recovery columns (mesh_recovery scenario): wall from
+        # the injected device loss to the first green solve on the recarved
+        # mesh, plus the faulted solve's total wall for context
+        out["mesh_recovery_s"] = mh.get("mesh_recovery_s")
+        out["mesh_recovery_solve_s"] = mh.get("faulted_solve_s")
+        out["mesh_recovery_recarves"] = mh.get("recarves")
+    elif mh is not None:
+        out["mesh_recovery_error"] = mh["error"]
     if scheduled_frac < 0.95:
         # a solver that drops pods must not read as a throughput win
         # (reference asserts full schedulability of the diverse mix)
@@ -2134,6 +2251,8 @@ if __name__ == "__main__":
         run_child()
     elif "--shard-child" in sys.argv:
         run_shard_child()
+    elif "--mesh-health-child" in sys.argv:
+        run_mesh_health_child()
     elif "--record-order-corpus" in sys.argv:
         _i = sys.argv.index("--record-order-corpus")
         sys.exit(record_order_corpus(sys.argv[_i + 1]))

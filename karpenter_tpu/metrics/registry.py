@@ -253,6 +253,29 @@ SHARD_FALLBACK = REGISTRY.counter(
     "shape-mismatch, slot-overflow, merge-rejected, error)",
 )
 
+# -- degraded-mesh resilience series (solver/mesh_health.py,
+# KARPENTER_TPU_MESH_HEALTH) ---------------------------------------------------
+MESH_DEVICES = REGISTRY.gauge(
+    "solver_mesh_devices",
+    "Local devices by mesh-health state (healthy, degraded, lost, "
+    "probation); written on every recarve and probe pass "
+    "(KARPENTER_TPU_MESH_HEALTH)",
+)
+MESH_RECARVE = REGISTRY.counter(
+    "solver_mesh_recarve_total",
+    "Mesh recarve events by classified reason: device-lost / "
+    "device-degraded (a dispatch failure excluded the device), probe-failed "
+    "(an excluded device failed its re-entry probe), recovered (a device "
+    "cleared probation and rejoined) — an unclassified recarve never "
+    "happens",
+)
+MESH_RECOVERY_SECONDS = REGISTRY.histogram(
+    "solver_mesh_recovery_seconds",
+    "Wall time from a device failure to the first green solve on the "
+    "recarved (shrunken) mesh — the degraded-mesh latency cost the "
+    "resilience contract trades for correctness",
+)
+
 # -- verification gate series (verify/, KARPENTER_TPU_DEVICE_GATE) ------------
 GATE_DURATION = REGISTRY.histogram(
     "solver_gate_duration_seconds",
@@ -343,7 +366,8 @@ WORLD_PATCH = REGISTRY.counter(
     "the delta cold reason or shape/node-axis drift), or standdown-* "
     "(classified reason — the legacy host path served the cycle: "
     "unsupported-args, topology, not-sweeps, runs-mode, shard, order-policy, "
-    "relax-applicable, slot-overflow, gate-reject, error)",
+    "relax-applicable, slot-overflow, gate-reject, device-lost (the world's "
+    "device died mid-cycle; reset-then-re-adopt, never resurrected), error)",
 )
 
 # -- multi-tenant serve series (serve/, KARPENTER_TPU_SERVE) -------------------

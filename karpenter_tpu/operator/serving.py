@@ -135,6 +135,13 @@ class OperatorStatus:
         if self.serve_service is not None:
             # multi-tenant fleet totals (/debug/tenants has per-stream rows)
             out["serve"] = self.serve_service.summary()
+        # degraded-mesh health (solver/mesh_health.py): per-device states,
+        # recarve log, last recovery wall time — only once a tracker exists
+        # (flag off or no failures yet means no section, zero cost)
+        from karpenter_tpu.solver import mesh_health
+
+        if mesh_health.has_tracker():
+            out["mesh_health"] = mesh_health.tracker().snapshot()
         return out
 
 

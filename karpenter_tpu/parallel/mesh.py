@@ -46,11 +46,30 @@ def _tree_bytes(tree) -> int:
     )
 
 
-def make_mesh(n_devices: Optional[int] = None, axis: str = CANDIDATE_AXIS) -> Mesh:
-    devices = jax.devices()
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axis: str = CANDIDATE_AXIS,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
     if n_devices is not None:
         devices = devices[:n_devices]
     return Mesh(np.array(devices), (axis,))
+
+
+def healthy_devices() -> list:
+    """The local device list mesh carving starts from: every device, minus
+    whatever the mesh-health tracker (solver/mesh_health.py) currently
+    excludes when KARPENTER_TPU_MESH_HEALTH is on. Flag off this is exactly
+    ``jax.devices()`` — one env read, no tracker construction."""
+    from karpenter_tpu.solver import mesh_health
+
+    devices = jax.devices()
+    if mesh_health.enabled() and mesh_health.has_tracker():
+        devices = mesh_health.tracker().healthy_devices(devices)
+    return list(devices)
 
 
 def stack_problems(problems: Sequence[SchedulingProblem]) -> SchedulingProblem:
@@ -400,11 +419,14 @@ def residual_screen(
 
 
 def default_mesh(min_devices: int = 2) -> Optional[Mesh]:
-    """A 1-D candidate mesh over every local device, or None on a single
-    device (vmap alone already uses the whole chip)."""
-    if len(jax.devices()) < min_devices:
+    """A 1-D candidate mesh over every HEALTHY local device, or None below
+    ``min_devices`` (vmap alone already uses the whole chip — the same
+    standdown a recarve below 2 devices degrades to). Flag-off mesh health
+    changes nothing: healthy_devices() is then jax.devices() verbatim."""
+    devices = healthy_devices()
+    if len(devices) < min_devices:
         return None
-    return make_mesh()
+    return make_mesh(devices=devices)
 
 
 def carve_meshes(n_slices: int, devices=None) -> list:
@@ -417,10 +439,16 @@ def carve_meshes(n_slices: int, devices=None) -> list:
     big-tenant streams there. A slice that lands fewer than 2 devices gets
     None (a mesh over one device buys nothing over vmap — same contract as
     default_mesh). Device discovery happens at call time, never at import
-    time."""
+    time, and excludes mesh-health-failed devices when the flag is on.
+
+    The carve is a DETERMINISTIC function of the device SET: devices sort by
+    id before chunking, so a shrunken list (post-recarve) always yields the
+    same slices regardless of the order the health filter or a caller
+    produced it in — failover placement stays stable across repeated
+    recarves (tests/test_mesh_health.py pins this)."""
     if devices is None:
-        devices = jax.devices()
-    devices = list(devices)
+        devices = healthy_devices()
+    devices = sorted(devices, key=lambda d: int(getattr(d, "id", 0)))
     n_slices = max(1, int(n_slices))
     base, extra = divmod(len(devices), n_slices)
     out = []

@@ -695,7 +695,26 @@ class SolveService:
         if len(group) > 1:
             from karpenter_tpu.serve import batch as xbatch
 
-            stacked = xbatch.stacked_solve(group, mesh=self.mesh)
+            from karpenter_tpu.solver import mesh_health
+
+            try:
+                mesh_health.dispatch_check(
+                    list(self.mesh.devices.flat)
+                    if self.mesh is not None and not isinstance(self.mesh, str)
+                    else None
+                )
+                stacked = xbatch.stacked_solve(group, mesh=self.mesh)
+            except Exception as exc:  # noqa: BLE001 — classified or re-raised
+                if mesh_health.handle_dispatch_failure(exc) is None:
+                    raise
+                # a device in this replica's slice died mid-dispatch: the
+                # tracker recarved around it. Degrade THIS replica to the
+                # unsliced path (mesh=None -> default device) and serve the
+                # whole group solo below — a device loss costs batching
+                # throughput, never a dropped cycle. ReplicaSet.failover
+                # handles the stronger whole-replica-death case.
+                self.mesh = None
+                stacked = [None] * len(group)
         for req, pre in zip(group, stacked):
             if pre is not None:
                 SERVE_BATCH.inc({"result": "hit"})

@@ -22,6 +22,20 @@ a reason, the same no-unclassified-outcomes rule admission follows:
 Stickiness is what keeps the isolation contract: a tenant's solver stack
 (circuit, warm state, quarantine namespace) lives on exactly one replica,
 so replica routing never splits a stream's state.
+
+Degraded-mesh failover (docs/ROBUSTNESS.md "Degraded mesh"): when a
+replica's slice loses its devices, ``failover(dead_idx)`` migrates every
+tenant placed there to the survivors under a fourth classified reason:
+
+  failover    the original replica died; the tenant was re-hashed over the
+              SURVIVING replicas (stable: crc32(tenant) % len(survivors))
+
+The move is pessimistic about the surge it creates: each survivor's wait
+estimator is seeded with 2x the worst per-request estimate either side had
+learned, so admission backpressure engages BEFORE the first migrated solve
+lands rather than after the queue has already built. A dead replica never
+receives new placements, and re-running ``failover`` for the same replica
+is a no-op — stickiness holds on the new home too.
 """
 
 from __future__ import annotations
@@ -36,6 +50,11 @@ from karpenter_tpu.serve.dispatcher import AUTO_MESH, SolveService
 PLACE_PINNED = "pinned"
 PLACE_BIG_TENANT = "big-tenant"
 PLACE_HASH = "hash"
+PLACE_FAILOVER = "failover"
+
+# seed for the survivors' wait estimators when nobody has a measurement yet:
+# pessimistic enough to engage predicted-wait shedding on a deep backlog
+FAILOVER_SEED_S = 0.05
 
 
 class ReplicaSet:
@@ -76,7 +95,15 @@ class ReplicaSet:
         ]
         # sticky placement: tenant -> (replica index, classified reason)
         self._placements: Dict[str, Tuple[int, str]] = {}
+        self._dead: set = set()
+        self._failovers = 0  # tenant migrations, for accounting
         self._lock = threading.Lock()
+
+    def _survivors(self) -> List[int]:
+        """Live replica indices, ascending (caller holds the lock). Index 0
+        stays first while alive, so big-tenant placement keeps the largest
+        carved slice."""
+        return [i for i in range(self.n) if i not in self._dead]
 
     # -- placement ------------------------------------------------------------
 
@@ -92,19 +119,89 @@ class ReplicaSet:
             existing = self._placements.get(tenant_id)
             if existing is not None:
                 return existing
+            live = self._survivors()
+            if not live:
+                raise RuntimeError("no live replicas (all failed over)")
             if pinned is not None:
-                decision = (pinned % self.n, PLACE_PINNED)
+                decision = (live[pinned % len(live)], PLACE_PINNED)
             elif expected_pods >= self.big_tenant_pods:
-                # replica 0 holds the largest carved slice (carve_meshes
-                # gives the remainder devices to the first chunks)
-                decision = (0, PLACE_BIG_TENANT)
+                # the first LIVE replica holds the largest surviving carved
+                # slice (carve_meshes gives remainder devices to the first
+                # chunks, and failover never revives a dead index)
+                decision = (live[0], PLACE_BIG_TENANT)
             else:
                 decision = (
-                    zlib.crc32(tenant_id.encode()) % self.n, PLACE_HASH
+                    live[zlib.crc32(tenant_id.encode()) % len(live)],
+                    PLACE_HASH,
                 )
             self._placements[tenant_id] = decision
         SERVE_REPLICA_PLACEMENTS.inc({"reason": decision[1]})
         return decision
+
+    def failover(self, dead_idx: int, close_timeout: float = 5.0) -> Dict[str, int]:
+        """Declare replica ``dead_idx`` dead (its mesh slice lost devices)
+        and migrate every tenant placed on it to the survivors. Returns
+        ``{tenant: new_replica}`` for the tenants moved; idempotent — a
+        second call for the same replica moves nothing.
+
+        Every migrated tenant is re-placed with the classified ``failover``
+        reason and re-registered on its survivor with the SAME weight,
+        deadline, and class (a fresh solver stack — device-resident state
+        died with the slice and is never resurrected). Survivors' wait
+        estimators are seeded pessimistically so admission backpressure
+        covers the migration surge."""
+        dead_idx = int(dead_idx)
+        with self._lock:
+            if dead_idx in self._dead or not (0 <= dead_idx < self.n):
+                return {}
+            self._dead.add(dead_idx)
+            live = self._survivors()
+            if not live:
+                # the last replica died: nothing to migrate onto. Leave the
+                # placements — healthy() reports the set down.
+                return {}
+            moved: Dict[str, int] = {}
+            for tenant, (idx, _reason) in list(self._placements.items()):
+                if idx != dead_idx:
+                    continue
+                new_idx = live[zlib.crc32(tenant.encode()) % len(live)]
+                self._placements[tenant] = (new_idx, PLACE_FAILOVER)
+                moved[tenant] = new_idx
+            self._failovers += len(moved)
+        dead = self.replicas[dead_idx]
+        # seed BEFORE re-registering: backpressure should precede the surge
+        worst = max(
+            [FAILOVER_SEED_S, dead._wait.per_request_s()]
+            + [self.replicas[i]._wait.per_request_s() for i in live]
+        )
+        for i in live:
+            self.replicas[i]._wait.seed(2.0 * worst)
+        for tenant, new_idx in moved.items():
+            state = dead._tenants.get(tenant)
+            try:
+                self.replicas[new_idx].register_tenant(
+                    tenant,
+                    weight=state.weight if state is not None else None,
+                    deadline_s=state.deadline_s if state is not None else 0.0,
+                    tenant_class=state.cls if state is not None else None,
+                )
+            except ValueError:
+                # survivor at tenant capacity: submit classifies the miss
+                # as rejected-max-tenants — still never unclassified
+                pass
+        for tenant in moved:
+            SERVE_REPLICA_PLACEMENTS.inc({"reason": PLACE_FAILOVER})
+        # drain the dead dispatcher: anything still queued there resolves
+        # classified (rejected-shutdown), never silently dropped
+        try:
+            dead.close(timeout=close_timeout)
+        except Exception:
+            pass
+        return moved
+
+    def dead_replicas(self) -> List[int]:
+        with self._lock:
+            return sorted(self._dead)
 
     def replica_for(self, tenant_id: str, expected_pods: int = 0) -> SolveService:
         idx, _ = self.place(tenant_id, expected_pods=expected_pods)
@@ -137,7 +234,11 @@ class ReplicaSet:
             r.close(timeout=timeout)
 
     def healthy(self) -> bool:
-        return all(r.healthy() for r in self.replicas)
+        """Live replicas only: a failed-over replica is expected-dead, not
+        unhealthy — the set stays ready as long as one survivor serves."""
+        with self._lock:
+            live = self._survivors()
+        return bool(live) and all(self.replicas[i].healthy() for i in live)
 
     # -- introspection --------------------------------------------------------
 
@@ -154,6 +255,8 @@ class ReplicaSet:
             "replicas": [r.snapshot() for r in self.replicas],
             "placements": len(placed),
             "placement_reasons": reasons,
+            "dead_replicas": self.dead_replicas(),
+            "failovers": self._failovers,
         }
 
     def summary(self) -> Dict:

@@ -292,12 +292,17 @@ def _try_shard_solve(
         topo_full.inverse_topologies.values()
     )
 
-    with trace.span("shard_partition", pods=len(pods)):
-        plan = partition_pods(
-            pods, templates, nodes, groups,
-            flags.target_partitions(mesh.devices.size),
-            pod_requirements_override,
-        )
+    def _plan_for(mesh_):
+        # re-invoked after a mesh recarve: partition fan-out tracks the
+        # CURRENT device count, so a shrunken mesh gets a shrunken plan
+        with trace.span("shard_partition", pods=len(pods)):
+            return partition_pods(
+                pods, templates, nodes, groups,
+                flags.target_partitions(mesh_.devices.size),
+                pod_requirements_override,
+            )
+
+    plan = _plan_for(mesh)
     if plan.reason is not None:
         return _standdown(
             solver, plan.reason,
@@ -315,7 +320,9 @@ def _try_shard_solve(
     max_claims = min(solver.claim_slots, claim_axis_bucket(max_part))
     claim_cap = claim_axis_bucket(max_part)
     n_dev = mesh.devices.size
+    from karpenter_tpu.solver import mesh_health
 
+    recarves = 0
     while True:
         padded, metas = [], []
         with trace.span(
@@ -427,59 +434,93 @@ def _try_shard_solve(
                 "bounds_free": bounds_free, "wavefront": wavefront,
             },
         )
-        with trace.span(
-            span_name,
-            cache="hit" if cache_hit else "miss",
-            program=program_name,
-            partitions=len(plan.parts),
-        ) as sp:
-            if aot_handle is not None:
-                result = aot_handle.call()
-            else:
-                result = fn(batch)
-            r2_stats = None
-            if relax2_on:
-                result, r2_stats = result
-            state = result.state
-            fetched = jax.device_get(
-                (
-                    result.kind,
-                    result.index,
-                    result.iters,
-                    state.claim_open,
-                    state.claim_tpl,
-                    state.claim_it_ok,
-                    state.claim_requests,
-                    state.claim_req.admitted,
-                    state.claim_req.comp,
-                    state.claim_req.gt,
-                    state.claim_req.lt,
-                    state.claim_req.defined,
+        try:
+            mesh_health.dispatch_check(list(mesh.devices.flat))
+            with trace.span(
+                span_name,
+                cache="hit" if cache_hit else "miss",
+                program=program_name,
+                partitions=len(plan.parts),
+            ) as sp:
+                if aot_handle is not None:
+                    result = aot_handle.call()
+                else:
+                    result = fn(batch)
+                r2_stats = None
+                if relax2_on:
+                    result, r2_stats = result
+                state = result.state
+                fetched = jax.device_get(
+                    (
+                        result.kind,
+                        result.index,
+                        result.iters,
+                        state.claim_open,
+                        state.claim_tpl,
+                        state.claim_it_ok,
+                        state.claim_requests,
+                        state.claim_req.admitted,
+                        state.claim_req.comp,
+                        state.claim_req.gt,
+                        state.claim_req.lt,
+                        state.claim_req.defined,
+                    )
                 )
-            )
-            (kinds, indices, iters, claim_open, claim_tpl, claim_it_ok,
-             claim_requests, claim_adm, claim_comp, claim_gt, claim_lt,
-             claim_def) = fetched
-            if r2_stats is not None:
-                r2_stats = jax.device_get(r2_stats)
-            d2h = _nbytes(fetched) + _nbytes(r2_stats)
-            TRANSFER_BYTES.inc({"direction": "d2h"}, d2h)
-            if obs is not None:
-                source = obs.finish(
-                    problem_bytes=prob_bytes,
-                    result_bytes=d2h,
-                    eqns=reg_eqns,
-                    source_override=(
-                        aot_handle.source_override
-                        if aot_handle is not None else None
-                    ),
-                )
+                (kinds, indices, iters, claim_open, claim_tpl, claim_it_ok,
+                 claim_requests, claim_adm, claim_comp, claim_gt, claim_lt,
+                 claim_def) = fetched
+                if r2_stats is not None:
+                    r2_stats = jax.device_get(r2_stats)
+                d2h = _nbytes(fetched) + _nbytes(r2_stats)
+                TRANSFER_BYTES.inc({"direction": "d2h"}, d2h)
+                if obs is not None:
+                    source = obs.finish(
+                        problem_bytes=prob_bytes,
+                        result_bytes=d2h,
+                        eqns=reg_eqns,
+                        source_override=(
+                            aot_handle.source_override
+                            if aot_handle is not None else None
+                        ),
+                    )
+                    if sp is not None:
+                        sp.attrs["program_key"] = obs.key
+                        sp.attrs["cache_source"] = source
                 if sp is not None:
-                    sp.attrs["program_key"] = obs.key
-                    sp.attrs["cache_source"] = source
-            if sp is not None:
-                sp.count("h2d_bytes", prob_bytes)
-                sp.count("d2h_bytes", d2h)
+                    sp.count("h2d_bytes", prob_bytes)
+                    sp.count("d2h_bytes", d2h)
+        except Exception as exc:  # noqa: BLE001 — classified or re-raised
+            if mesh_health.handle_dispatch_failure(exc) is None:
+                raise
+            # a mesh device died mid-dispatch: the tracker recarved around
+            # it. Re-plan against the shrunken device count and re-dispatch
+            # the WHOLE lane set from host-side problem data — every loop
+            # iteration re-encodes/pads/stacks from host, so nothing
+            # device-resident (donated or otherwise) is resurrected.
+            recarves += 1
+            mesh = default_mesh(flags.min_devices())
+            if mesh is None:
+                # below 2 healthy devices the mesh buys nothing: the same
+                # single-device standdown the seed path already classifies
+                return _standdown(
+                    solver, flags.REASON_SINGLE_DEVICE, recarves=recarves,
+                )
+            n_dev = mesh.devices.size
+            plan = _plan_for(mesh)
+            if plan.reason is not None:
+                return _standdown(
+                    solver, plan.reason,
+                    atomic=plan.atomic_components,
+                    splittable=plan.splittable_pods,
+                )
+            max_part = max(len(pt.pod_idx) for pt in plan.parts)
+            if 0 < ceiling < max_part:
+                return _standdown(
+                    solver, flags.REASON_SINGLE_PARTITION, dominant=max_part,
+                )
+            claim_cap = claim_axis_bucket(max_part)
+            max_claims = min(solver.claim_slots, claim_cap)
+            continue
         programs.note_shard_lanes(
             len(plan.parts), len(lanes),
             [len(pt.pod_idx) for pt in plan.parts],
@@ -669,6 +710,9 @@ def _try_shard_solve(
         "narrow_iters": int(np.asarray(iters.narrow).sum()),
         "sweep_iters": int(np.asarray(iters.sweeps).sum()),
         "gate_rejections": gate_rejections,
+        "recarves": recarves,
     }
+    # first green solve after a device failure closes the recovery clock
+    mesh_health.note_green()
     programs.sample_memory(pods=len(pods), cycle=trace.current_trace_id())
     return out

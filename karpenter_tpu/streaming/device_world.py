@@ -203,9 +203,30 @@ class DeviceWorld:
         if relax.enabled() and _relax_would_fire(templates):
             return self._standdown("relax-applicable")
 
+        from karpenter_tpu.solver import mesh_health
+        from karpenter_tpu.testing import faults as _faults
+
         try:
+            if _faults.active() is not None:
+                # fault-injection hook: the resident world lives on exactly
+                # the devices its buffers sit on — a device rule targeting
+                # one of them fires here, before any dispatch touches them
+                leaves = (
+                    jax.tree_util.tree_leaves(self.world)
+                    if self.world is not None else []
+                )
+                devs = list(leaves[0].devices()) if leaves else None
+                mesh_health.dispatch_check(devs)
             return self._cycle(pods, instance_types, templates, nodes, max_claims)
         except Exception as exc:  # noqa: BLE001 — degrade to legacy, drop the world
+            if mesh_health.handle_dispatch_failure(exc) is not None:
+                # the device died WITH the resident buffers: reset and let a
+                # later cycle re-adopt from scratch on whatever devices the
+                # recarved mesh kept — a world whose buffers died is never
+                # resurrected (patching against it would read garbage)
+                self.reset()
+                self._record("standdown-device-lost")
+                return None
             log.warning(
                 "device_world: standdown on error, world dropped: %s: %s",
                 type(exc).__name__, exc, exc_info=True,

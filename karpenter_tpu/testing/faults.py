@@ -9,13 +9,16 @@ explicit schedule rather than ambient randomness:
 Grammar — ``;``-separated entries, optional leading ``seed=N``:
 
     entry  := site ['[' tenant ']'] '.' kind ['=' param] '@' sched
-    site   := 'solve' | 'create' | 'delete' | 'cloud' | 'proc'
+    site   := 'solve' | 'create' | 'delete' | 'cloud' | 'proc' | 'device'
     kind   := solve: compile | device | encode | nan | hang
               create/delete: ice | ratelimit | timeout
               cloud: reclaim
               proc: crash
+              device: loss | degraded
     param  := float   (solve.hang: duration in seconds, default 30;
-                       cloud.reclaim: nodes reclaimed per firing, default 1)
+                       cloud.reclaim: nodes reclaimed per firing, default 1;
+                       device.degraded: injected wall-time inflation in
+                       seconds, default 0.02)
     sched  := N       fire on the N-th call to the site (1-based)
             | N..M    fire on calls N through M inclusive
             | pP      fire with probability P per call (seeded, per-call
@@ -30,6 +33,16 @@ schedule counts THAT tenant's visits to the site — so ``solve[t3].device@2``
 fires on t3's second solve regardless of how other tenants interleave.
 Rules without a selector keep the global per-site counter, byte-for-byte
 compatible with every pre-existing spec.
+
+The ``device`` site models MESH-DEVICE failure (solver/mesh_health.py):
+``device[2].loss@3`` makes mesh device 2 raise :class:`FaultDeviceLost` on
+the third mesh dispatch that includes it; ``device[0].degraded=0.05@*``
+inflates every dispatch's wall time by 0.05 s and raises
+:class:`FaultDeviceDegraded`. The bracket selector is the DEVICE INDEX
+(required, integer — it names which device fails), not a tenant scope, and
+the schedule counts visits to the shared 'device' site: every health-hooked
+mesh dispatch AND every health probe advances it, so a replayed schedule
+fires on the same visit sequence.
 
 Probabilistic draws hash ``(seed, site, call#)`` with crc32 — Python's
 ``hash()`` is per-process salted and must not leak into schedules
@@ -46,13 +59,18 @@ import contextlib
 import contextvars
 import os
 import random
+import time
 import zlib
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence, Tuple
 
-SITES = ("solve", "create", "delete", "cloud", "proc")
+SITES = ("solve", "create", "delete", "cloud", "proc", "device")
 SOLVE_KINDS = ("compile", "device", "encode", "nan", "hang")
 CLOUD_KINDS = ("ice", "ratelimit", "timeout")
+# the 'device' site models a MESH DEVICE failing (vs solve.device, which is
+# a whole-dispatch runtime error the supervisor retries): the selector names
+# the device index, and the mesh-health layer recarves around it
+DEVICE_KINDS = ("loss", "degraded")
 # the 'cloud' site models provider-initiated events (spot reclaims) rather
 # than API-call failures; the churn generator (streaming/churn.py) draws it
 # once per cycle, so chaos specs and churn configs share one grammar
@@ -81,6 +99,24 @@ class FaultDeviceError(InjectedFault):
 
 class FaultEncodeError(InjectedFault):
     """Injected host-side encode failure (classified 'encode')."""
+
+
+class FaultDeviceLost(FaultDeviceError):
+    """Injected loss of ONE mesh device (``device[n].loss``): buffers and
+    in-flight dispatches on that device are gone. Subclasses
+    FaultDeviceError so the supervisor's retry classification ('device',
+    retryable) applies unchanged; ``.device`` carries the lost index so the
+    mesh-health layer knows what to exclude."""
+
+    def __init__(self, message: str, device: int = 0):
+        super().__init__(message)
+        self.device = int(device)
+
+
+class FaultDeviceDegraded(FaultDeviceLost):
+    """Injected degraded mesh device (``device[n].degraded``): the dispatch
+    wall time was inflated before this raised — a limping chip rather than a
+    dead one. Classified device-degraded by the mesh-health layer."""
 
 
 @dataclass
@@ -149,6 +185,14 @@ def parse_spec(spec: str) -> Tuple[List[FaultRule], int]:
             allowed = RECLAIM_KINDS
         elif site == "proc":
             allowed = PROC_KINDS
+        elif site == "device":
+            allowed = DEVICE_KINDS
+            # the bracket selector is the device INDEX here, not a tenant
+            if not tenant or not tenant.isdigit():
+                raise ValueError(
+                    f"fault entry {entry!r}: device rules need a device[N] "
+                    f"index selector"
+                )
         else:
             allowed = CLOUD_KINDS
         if kind not in allowed:
@@ -236,6 +280,14 @@ class FaultInjector:
         for rule in self.rules:
             if rule.site != site:
                 continue
+            if rule.site == "device":
+                # the selector names WHICH device fails, not when: device
+                # rules always match against the global site counter (one
+                # visit per health-hooked mesh dispatch or probe)
+                if rule.matches(n, self.seed):
+                    self.fired.append((rule.site_key(), rule.kind, n))
+                    return rule
+                continue
             if rule.tenant:
                 if tenant != rule.tenant:
                     continue
@@ -260,6 +312,28 @@ def raise_solve_fault(rule: FaultRule) -> None:
         raise FaultDeviceError(f"injected device failure (call schedule {rule})")
     if rule.kind == "encode":
         raise FaultEncodeError(f"injected encode failure (call schedule {rule})")
+
+
+def device_index(rule: FaultRule) -> int:
+    """The mesh-device index a ``device``-site rule targets (the bracket
+    selector; parse_spec guarantees it is an integer)."""
+    return int(rule.tenant or 0)
+
+
+def realize_device_fault(rule: FaultRule) -> None:
+    """Raise the typed exception for a device-site rule. ``degraded``
+    inflates the dispatch's wall time first (``param`` seconds, default
+    0.02) — the limping-chip signature — then raises so the mesh-health
+    layer classifies and recarves exactly like a loss."""
+    dev = device_index(rule)
+    if rule.kind == "degraded":
+        time.sleep(rule.param if rule.param > 0 else 0.02)
+        raise FaultDeviceDegraded(
+            f"injected degraded device {dev} (call schedule {rule})", device=dev
+        )
+    raise FaultDeviceLost(
+        f"injected loss of device {dev} (call schedule {rule})", device=dev
+    )
 
 
 def corrupt_result(result) -> None:

@@ -311,3 +311,19 @@ def dummy_gate_args(gp: GateProblem, max_claims: int) -> GateArgs:
         pod_bin=np.full((P,), -1, dtype=np.int32),
         pod_check=np.zeros((P,), dtype=bool),
     )
+
+
+def probe_device(dev) -> bool:
+    """Health probe for ONE device (solver/mesh_health.py re-entry checks):
+    a tiny jitted reduction pinned to ``dev`` whose result is exact in
+    float32, so a pass means the device ran a real XLA program and returned
+    correct arithmetic — not merely that the runtime still lists it. Any
+    exception or a wrong sum is a failed probe; the caller classifies."""
+    import numpy as np
+
+    try:
+        x = jax.device_put(np.arange(16, dtype=np.float32), dev)
+        total = float(jax.jit(jnp.sum)(x))
+    except Exception:  # noqa: BLE001 — a dead device raises; that IS the signal
+        return False
+    return total == 120.0

@@ -1,0 +1,380 @@
+"""Degraded-mesh resilience (solver/mesh_health.py, KARPENTER_TPU_MESH_HEALTH).
+
+The round-19 contract, mirroring rounds 9/14/16: a device failure costs
+LATENCY, never a dropped cycle, a wrong placement, or an unclassified
+outcome. Coverage, per the satellite checklist:
+
+- one test per recarve reason (device-lost / device-degraded / probe-failed
+  / recovered), each asserting the classified counter, the state machine
+  transition, and the shrunken healthy-device list;
+- shard re-dispatch parity: a device dies mid-pass, the pass re-partitions
+  onto the recarved mesh and schedules the IDENTICAL set an unfaulted
+  control schedules;
+- replica failover accounting: every tenant of a dead replica lands on a
+  survivor under the classified ``failover`` reason, estimators seeded
+  pessimistically, idempotent;
+- device-world reset-then-re-adopt: a world whose buffers died is dropped
+  (classified ``standdown-device-lost``) and the next cycle ADOPTS from
+  scratch — never patches against dead buffers;
+- probation re-entry: one clean probe is probation, not health;
+- carve determinism: ``carve_meshes`` is a function of the device SET, so
+  failover placement is stable across repeated recarves;
+- flag-off zero overhead: no tracker is ever created and mesh carving sees
+  every device, bit-identically (the 2,394-eqn narrow-body census pin in
+  test_kernel_census.py rides on this).
+"""
+
+import os
+import random
+import time
+
+import jax
+import pytest
+
+from test_shard_parity import assert_parity, scheduled_set, shard_on, solve_pair
+from test_streaming_parity import build_world, placement_map
+
+from karpenter_tpu import shard
+from karpenter_tpu.cloudprovider.fake import FAKE_WELL_KNOWN_LABELS
+from karpenter_tpu.metrics.registry import (
+    MESH_DEVICES,
+    MESH_RECARVE,
+    MESH_RECOVERY_SECONDS,
+)
+from karpenter_tpu.parallel import mesh as pmesh
+from karpenter_tpu.serve.replica import (
+    FAILOVER_SEED_S,
+    PLACE_FAILOVER,
+    ReplicaSet,
+)
+from karpenter_tpu.solver import mesh_health as mh
+from karpenter_tpu.solver.jax_backend import JaxSolver
+from karpenter_tpu.solver.oracle import OracleSolver
+from karpenter_tpu.streaming.churn import default_pod_factory
+from karpenter_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracker():
+    """Every test starts and ends with no injector and no tracker — the
+    process-wide singleton must not leak device exclusions into the parity
+    and census suites that share this process."""
+    faults.install(None)
+    mh.reset()
+    yield
+    faults.install(None)
+    mh.reset()
+
+
+def _inject(spec: str):
+    faults.install(faults.FaultInjector.from_spec(spec))
+
+
+def _ids(devices) -> list:
+    return [int(d.id) for d in devices]
+
+
+# -- one test per recarve reason -----------------------------------------------
+
+
+def test_recarve_reason_device_lost():
+    _inject("seed=3;device[1].loss@1")
+    before = MESH_RECARVE.value({"reason": mh.REASON_DEVICE_LOST})
+    with pytest.raises(faults.FaultDeviceLost) as ei:
+        mh.dispatch_check(None)
+    healthy = mh.handle_dispatch_failure(ei.value)
+    assert healthy is not None and 1 not in _ids(healthy)
+    assert mh.tracker().state_of(1) == mh.STATE_LOST
+    assert MESH_RECARVE.value({"reason": mh.REASON_DEVICE_LOST}) == before + 1
+    assert mh.tracker().snapshot()["recarves"][-1] == {
+        "reason": mh.REASON_DEVICE_LOST, "device": 1,
+    }
+    # the census gauge re-exported: exactly one device out
+    assert MESH_DEVICES.value({"state": mh.STATE_LOST}) == 1.0
+    assert MESH_DEVICES.value({"state": mh.STATE_HEALTHY}) == float(
+        len(jax.devices()) - 1
+    )
+
+
+def test_recarve_reason_device_degraded_inflates_wall_time():
+    _inject("seed=3;device[2].degraded=0.05@1")
+    before = MESH_RECARVE.value({"reason": mh.REASON_DEVICE_DEGRADED})
+    t0 = time.perf_counter()
+    with pytest.raises(faults.FaultDeviceDegraded) as ei:
+        mh.dispatch_check(None)
+    assert time.perf_counter() - t0 >= 0.05  # the degraded kind sleeps first
+    healthy = mh.handle_dispatch_failure(ei.value)
+    assert 2 not in _ids(healthy)
+    assert mh.tracker().state_of(2) == mh.STATE_DEGRADED
+    assert MESH_RECARVE.value(
+        {"reason": mh.REASON_DEVICE_DEGRADED}
+    ) == before + 1
+
+
+def test_recarve_reason_probe_failed():
+    tr = mh.tracker()
+    tr.report_failure(1, mh.REASON_DEVICE_LOST)
+    before = MESH_RECARVE.value({"reason": mh.REASON_PROBE_FAILED})
+    _inject("seed=3;device[1].loss@*")  # every probe visit fails
+    assert tr.probe(force=True) == {1: mh.STATE_LOST}
+    assert MESH_RECARVE.value({"reason": mh.REASON_PROBE_FAILED}) == before + 1
+    assert tr.state_of(1) == mh.STATE_LOST
+    assert tr._states[1].clean_probes == 0  # a failed probe zeroes the streak
+
+
+def test_recarve_reason_recovered_after_probation():
+    tr = mh.tracker()
+    tr.report_failure(3, mh.REASON_DEVICE_LOST)
+    before = MESH_RECARVE.value({"reason": mh.REASON_RECOVERED})
+    # first clean probe: probation, still EXCLUDED from carving
+    assert tr.probe(force=True) == {3: mh.STATE_PROBATION}
+    assert 3 not in _ids(tr.healthy_devices())
+    assert MESH_RECARVE.value({"reason": mh.REASON_RECOVERED}) == before
+    # second consecutive clean probe (default KARPENTER_TPU_MESH_PROBATION=2)
+    assert tr.probe(force=True) == {3: mh.STATE_HEALTHY}
+    assert 3 in _ids(tr.healthy_devices())
+    assert MESH_RECARVE.value({"reason": mh.REASON_RECOVERED}) == before + 1
+
+
+def test_probation_re_entry_failure_resets_streak(monkeypatch):
+    monkeypatch.setenv("KARPENTER_TPU_MESH_PROBATION", "3")
+    tr = mh.tracker()
+    tr.report_failure(4, mh.REASON_DEVICE_LOST)
+    assert tr.probe(force=True) == {4: mh.STATE_PROBATION}
+    assert tr.probe(force=True) == {4: mh.STATE_PROBATION}
+    # a failure mid-probation throws the device back out and zeroes the streak
+    tr.report_failure(4, mh.REASON_DEVICE_LOST)
+    assert tr.state_of(4) == mh.STATE_LOST
+    assert tr._states[4].clean_probes == 0
+    assert tr.probe(force=True) == {4: mh.STATE_PROBATION}
+    assert tr.probe(force=True) == {4: mh.STATE_PROBATION}
+    assert tr.probe(force=True) == {4: mh.STATE_HEALTHY}
+
+
+def test_unclassified_recarve_reason_raises():
+    with pytest.raises(ValueError, match="unclassified"):
+        mh.tracker().recarve("cosmic-rays")
+
+
+def test_recovery_clock_closes_on_first_green():
+    tr = mh.tracker()
+    before = MESH_RECOVERY_SECONDS.count()
+    tr.report_failure(1, mh.REASON_DEVICE_LOST)
+    assert tr.snapshot()["recovery_pending"]
+    mh.note_green()
+    assert MESH_RECOVERY_SECONDS.count() == before + 1
+    assert tr.last_recovery_s is not None and tr.last_recovery_s >= 0
+    mh.note_green()  # no failure pending: no-op, consumers call it every solve
+    assert MESH_RECOVERY_SECONDS.count() == before + 1
+
+
+# -- shard re-dispatch parity --------------------------------------------------
+
+
+def _shard_corpus(n=48, seed=5):
+    from test_solver_parity import make_pod, simple_template
+
+    from karpenter_tpu.cloudprovider.fake import instance_types
+
+    rng = random.Random(seed)
+    pods = [
+        make_pod(
+            f"mh-{i}",
+            cpu=rng.choice([0.25, 0.5, 1.0]),
+            mem=rng.choice([1.0, 2.0]) * 2**30,
+        )
+        for i in range(n)
+    ]
+    its = instance_types(20)
+    return pods, its, [simple_template(its)]
+
+
+def test_shard_redispatch_parity_after_device_loss(monkeypatch):
+    monkeypatch.setenv("KARPENTER_TPU_MESH_HEALTH", "1")
+    pods, its, tpls = _shard_corpus()
+    _inject("seed=5;device[1].loss@1")  # first mesh dispatch kills device 1
+    try:
+        solver, sharded, control = solve_pair(pods, its, tpls)
+    finally:
+        faults.install(None)
+    assert solver.last_shard is not None
+    assert solver.last_shard["reason"] is None, solver.last_shard
+    assert solver.last_shard["recarves"] >= 1
+    # identical scheduled set vs the unfaulted control — latency, not
+    # placement, is what the failure cost
+    assert_parity(pods, sharded, control)
+    reasons = [r["reason"] for r in mh.tracker().snapshot()["recarves"]]
+    assert reasons and all(r in mh.REASONS for r in reasons)
+    assert mh.tracker().last_recovery_s is not None  # note_green closed it
+
+
+def test_shard_standdown_below_two_devices(monkeypatch):
+    monkeypatch.setenv("KARPENTER_TPU_MESH_HEALTH", "1")
+    tr = mh.tracker()
+    for dev in range(2, len(jax.devices())):
+        tr.report_failure(dev, mh.REASON_DEVICE_LOST)
+    pods, its, tpls = _shard_corpus()
+    _inject("seed=5;device[1].loss@1")  # kills one of the two survivors
+    try:
+        solver, sharded, control = solve_pair(pods, its, tpls)
+    finally:
+        faults.install(None)
+    # below 2 healthy devices the shard path stands down CLASSIFIED and the
+    # unsharded path serves the cycle — transparent, like every standdown
+    assert solver.last_shard["reason"] == shard.REASON_SINGLE_DEVICE
+    assert scheduled_set(sharded) == scheduled_set(control)
+
+
+# -- replica failover accounting -----------------------------------------------
+
+
+def test_replica_failover_tenant_accounting():
+    rs = ReplicaSet(n_replicas=3, meshes=[None, None, None], batching=False)
+    for i in range(9):
+        rs.place(f"t{i}")
+    victims = [t for t, (idx, _) in rs.placements().items() if idx == 1]
+    assert victims  # crc32 spreads 9 tenants over 3 replicas
+    moved = rs.failover(1)
+    assert sorted(moved) == sorted(victims)
+    placed = rs.placements()
+    for tenant in victims:
+        idx, reason = placed[tenant]
+        assert idx in (0, 2) and reason == PLACE_FAILOVER
+    # non-victims keep their original placement and reason
+    for tenant, (idx, reason) in placed.items():
+        if tenant not in moved:
+            assert idx != 1 and reason != PLACE_FAILOVER
+    assert rs.snapshot()["failovers"] == len(victims)
+    assert rs.dead_replicas() == [1]
+    # idempotent: the second declaration moves nothing
+    assert rs.failover(1) == {}
+    assert rs.snapshot()["failovers"] == len(victims)
+    # new placements never land on the dead replica
+    for i in range(20, 40):
+        idx, _ = rs.place(f"t{i}")
+        assert idx != 1
+    # estimators seeded pessimistically on every survivor
+    for i in (0, 2):
+        assert rs.replicas[i]._wait.per_request_s() >= FAILOVER_SEED_S
+    # the set stays ready: dead-by-failover is expected, not unhealthy
+    assert rs.healthy()
+    rs.close()
+
+
+def test_failover_migrated_tenants_keep_serving():
+    rs = ReplicaSet(n_replicas=2, meshes=[None, None], batching=False).start()
+    pods, its, tpls = _shard_corpus(n=6)
+    tenants = [f"s{i}" for i in range(4)]
+    try:
+        for tid in tenants:
+            rs.register_tenant(tid, solver=OracleSolver())
+        first = [rs.submit(t, pods, its, tpls) for t in tenants]
+        assert all(x.wait(timeout=30).status == "ok" for x in first)
+        rs.failover(1)
+        # zero dropped cycles: every post-failover submit resolves ok on the
+        # survivor, including tenants that lived on the dead replica
+        second = [rs.submit(t, pods, its, tpls) for t in tenants]
+        assert all(x.wait(timeout=30).status == "ok" for x in second)
+        assert all(idx == 0 for idx, _ in rs.placements().values())
+    finally:
+        rs.close()
+
+
+# -- device world: reset then re-adopt ----------------------------------------
+
+
+def test_device_world_reset_then_readopt(monkeypatch):
+    monkeypatch.setenv("KARPENTER_TPU_DEVICE_WORLD", "1")
+    monkeypatch.setenv("KARPENTER_TPU_RELAX", "0")
+    its, tpls = build_world()
+    rng = random.Random(11)
+    pods = [default_pod_factory(f"dw-{i}", rng) for i in range(16)]
+    dev = JaxSolver()
+    ref = JaxSolver()
+    dev.solve(pods, its, tpls)
+    assert dev._device_world.last_outcome.startswith("adopt")
+    world_dev = int(
+        next(iter(jax.tree_util.tree_leaves(dev._device_world.world)[0].devices())).id
+    )
+    # the world's own device dies mid-cycle: classified standdown, world
+    # dropped, the legacy path serves the cycle
+    _inject(f"seed=7;device[{world_dev}].loss@1")
+    try:
+        result = dev.solve(pods, its, tpls)
+    finally:
+        faults.install(None)
+    assert dev._device_world.last_outcome == "standdown-device-lost"
+    assert dev._device_world.world is None  # never resurrected
+    assert mh.tracker().state_of(world_dev) == mh.STATE_LOST
+    # next cycle re-ADOPTS from scratch (not a patch against dead buffers)
+    result2 = dev.solve(pods, its, tpls)
+    assert dev._device_world.last_outcome.startswith("adopt")
+    expect = ref.solve(pods, its, tpls)
+    assert placement_map(pods, result) == placement_map(pods, expect)
+    assert placement_map(pods, result2) == placement_map(pods, expect)
+
+
+# -- carve determinism under a shrunken device list ----------------------------
+
+
+def test_carve_meshes_deterministic_under_shrunken_list():
+    devices = list(jax.devices())
+    assert len(devices) >= 8  # conftest forces the 8-device host
+    survivors = [d for d in devices if int(d.id) != 1]
+
+    def carve_ids(devs):
+        return [
+            tuple(_ids(m.devices.flat)) if m is not None else None
+            for m in pmesh.carve_meshes(3, devices=devs)
+        ]
+
+    baseline = carve_ids(survivors)
+    for seed in range(5):
+        shuffled = list(survivors)
+        random.Random(seed).shuffle(shuffled)
+        assert carve_ids(shuffled) == baseline
+    # repeated recarves of the same surviving SET carve the same slices —
+    # failover placement is stable across recarve repetitions
+    assert carve_ids(survivors) == baseline
+    # slices are sorted, contiguous, remainder to the FIRST slice
+    sizes = [len(s) for s in baseline]
+    assert sizes[0] >= sizes[-1] and sum(sizes) == len(survivors)
+
+
+def test_carve_meshes_health_aware(monkeypatch):
+    monkeypatch.setenv("KARPENTER_TPU_MESH_HEALTH", "1")
+    mh.tracker().report_failure(2, mh.REASON_DEVICE_LOST)
+    for m in pmesh.carve_meshes(2):
+        assert 2 not in _ids(m.devices.flat)
+    assert len(pmesh.healthy_devices()) == len(jax.devices()) - 1
+
+
+# -- flag-off zero overhead ----------------------------------------------------
+
+
+def test_flag_off_no_tracker_no_exclusion():
+    assert not mh.enabled()
+    # no injector, flag off: the hooks are attribute reads — no tracker is
+    # ever constructed by the dispatch path
+    mh.dispatch_check(None)
+    mh.note_green()
+    assert not mh.has_tracker()
+    # even a tracker WITH failures is ignored while the flag is off: carving
+    # sees every device, bit-identically
+    mh.tracker().report_failure(1, mh.REASON_DEVICE_LOST)
+    assert _ids(pmesh.healthy_devices()) == _ids(jax.devices())
+    assert pmesh.default_mesh(2).devices.size == len(jax.devices())
+
+
+def test_flag_off_shard_placements_bit_identical():
+    pods, its, tpls = _shard_corpus(n=24, seed=9)
+    with shard_on():
+        a = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(pods, its, tpls)
+    mh.tracker().report_failure(1, mh.REASON_DEVICE_LOST)  # ignored flag-off
+    with shard_on():
+        b = JaxSolver(well_known=FAKE_WELL_KNOWN_LABELS).solve(pods, its, tpls)
+    assert scheduled_set(a) == scheduled_set(b)
+    assert a.failures == b.failures
+    assert {
+        (c.template_index, tuple(c.pod_indices)) for c in a.new_claims
+    } == {(c.template_index, tuple(c.pod_indices)) for c in b.new_claims}

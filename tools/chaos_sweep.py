@@ -317,6 +317,296 @@ def restart_storm(kills: int = 5, cycles: int = 8) -> bool:
     return bool(summary["ok"])
 
 
+def run_device_loss_child() -> int:
+    """Subprocess body for the device_loss row (spawned with the host forced
+    multi-device): a sharded solve loses a mesh device MID-PASS, then a
+    2-replica serve run loses a replica's slice mid-run. Prints exactly one
+    JSON verdict line. The bars, per docs/ROBUSTNESS.md "Degraded mesh":
+    zero dropped cycles, full-validator-green placements, every recarve and
+    failover CLASSIFIED, and the recovery wall time measured."""
+    import json
+    import os
+
+    from karpenter_tpu.operator.logging import quiet_xla_warnings
+
+    quiet_xla_warnings()
+    os.environ["KARPENTER_TPU_EXPLAIN"] = "0"
+    os.environ["KARPENTER_TPU_MESH_HEALTH"] = "1"
+    os.environ["KARPENTER_TPU_SHARD"] = "1"
+
+    import __graft_entry__
+
+    __graft_entry__._respect_platform_env()
+
+    import jax
+
+    from karpenter_tpu.serve.replica import (
+        PLACE_BIG_TENANT,
+        PLACE_FAILOVER,
+        PLACE_HASH,
+        PLACE_PINNED,
+        ReplicaSet,
+    )
+    from karpenter_tpu.solver import mesh_health as mh
+    from karpenter_tpu.solver.jax_backend import JaxSolver
+    from karpenter_tpu.solver.oracle import OracleSolver
+    from karpenter_tpu.solver.validator import validate_result
+    from karpenter_tpu.testing import faults
+
+    n_pods = int(os.environ.get("CHAOS_DEVICE_LOSS_PODS", "10000"))
+    ev = {"event": "device_loss", "pods": n_pods,
+          "devices": len(jax.devices())}
+    if len(jax.devices()) < 2:
+        ev.update({"ok": True, "skipped": "single-device"})
+        print(json.dumps(ev), flush=True)
+        return 0
+
+    # -- shard arm: device dies mid-pass; the pass must still complete -----
+    pods, its, tpls = build_problem(n_pods, 50)
+    control = JaxSolver()
+    control_result = control.solve(pods, its, tpls)
+    control_set = set(range(len(pods))) - set(control_result.failures)
+
+    faults.install(faults.FaultInjector.from_spec("seed=5;device[1].loss@1"))
+    solver = JaxSolver()
+    try:
+        result = solver.solve(pods, its, tpls)
+        shard_survived = result is not None
+    except Exception as exc:  # a raised solve IS a dropped cycle
+        ev["shard_error"] = f"{type(exc).__name__}: {exc}"
+        result, shard_survived = None, False
+    finally:
+        faults.install(None)
+    last = getattr(solver, "last_shard", None) or {}
+    recarves = mh.tracker().snapshot()["recarves"] if mh.has_tracker() else []
+    classified = bool(recarves) and all(
+        r["reason"] in mh.REASONS for r in recarves
+    )
+    violations = (
+        validate_result(
+            result, pods, its, tpls, [], None, [], None, level="full",
+        )
+        if result is not None else ["no result"]
+    )
+    scheduled_set = (
+        set(range(len(pods))) - set(result.failures) if result else set()
+    )
+    recovery_s = mh.tracker().last_recovery_s if mh.has_tracker() else None
+    shard_ok = (
+        shard_survived
+        and last.get("reason") is None
+        and int(last.get("recarves") or 0) >= 1
+        and classified
+        and not violations
+        and scheduled_set == control_set
+        and recovery_s is not None
+    )
+    ev.update({
+        "shard_ok": shard_ok,
+        "shard_reason": last.get("reason", "never-attempted"),
+        "recarves": [r["reason"] for r in recarves],
+        "violations": len(violations) if result is not None else -1,
+        "scheduled": f"{len(scheduled_set)}/{len(pods)}",
+        "parity": scheduled_set == control_set,
+        "mesh_recovery_s": round(recovery_s, 4) if recovery_s else None,
+    })
+
+    # -- serve arm: a replica's slice dies mid-run; tenants fail over ------
+    mh.reset()
+    os.environ["KARPENTER_TPU_SHARD"] = "0"
+    _, its_s, tpls_s = build_problem(20, 20)
+    spods, _, _ = build_problem(12, 20)
+    rs = ReplicaSet(n_replicas=2, batching=False, max_tenants=16)
+    tenants = [f"t{i}" for i in range(6)]
+    for tid in tenants:
+        rs.register_tenant(tid, solver=OracleSolver())
+    rs.start()
+    outcomes = []
+    try:
+        for cycle in range(6):
+            if cycle == 3:
+                # device in replica 1's slice dies: the dispatcher-shaped
+                # recovery (classify -> report -> recarve) then whole-replica
+                # failover, exactly what serve/dispatcher.py does in-band
+                dead_dev = len(jax.devices()) - 1
+                exc = faults.FaultDeviceLost(
+                    f"injected loss of device {dead_dev}", device=dead_dev,
+                )
+                assert mh.handle_dispatch_failure(exc) is not None
+                moved = rs.failover(1)
+                ev["migrated"] = len(moved)
+            tickets = [
+                (tid, rs.submit(tid, spods, its_s, tpls_s))
+                for tid in tenants
+            ]
+            outcomes.extend(t.wait(timeout=60.0) for _, t in tickets)
+    finally:
+        rs.close()
+    placed = rs.placements()
+    known = {PLACE_PINNED, PLACE_BIG_TENANT, PLACE_HASH, PLACE_FAILOVER}
+    serve_recarves = mh.tracker().snapshot()["recarves"]
+    serve_ok = (
+        all(o.status == "ok" for o in outcomes)
+        and ev.get("migrated", 0) >= 1
+        and all(reason in known for _, reason in placed.values())
+        and all(idx == 0 for idx, _ in placed.values())  # survivor only
+        and all(r["reason"] in mh.REASONS for r in serve_recarves)
+    )
+    ev.update({
+        "serve_ok": serve_ok,
+        "serve_outcomes": len(outcomes),
+        "serve_recarves": [r["reason"] for r in serve_recarves],
+        "ok": shard_ok and serve_ok,
+    })
+    print(json.dumps(ev), flush=True)
+    return 0 if ev["ok"] else 1
+
+
+def device_loss(quick: bool = False) -> bool:
+    """Post-matrix row: kill a mesh device mid-pass in both consumers (see
+    run_device_loss_child). Runs in a subprocess with the host forced to 8
+    devices so the row is meaningful on single-device CPU hosts too."""
+    import json
+    import os
+    import subprocess
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if "host_platform_device_count" not in env.get("XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    env["CHAOS_DEVICE_LOSS_PODS"] = "2000" if quick else "10000"
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--device-loss-child"],
+            capture_output=True, text=True, timeout=600,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            env=env,
+        )
+    except subprocess.TimeoutExpired:
+        print("device loss: child timed out -> FAILED")
+        return False
+    line = next(
+        (ln for ln in proc.stdout.splitlines()
+         if ln.startswith('{"event": "device_loss"')),
+        None,
+    )
+    if line is None:
+        print(
+            "device loss: no verdict from child -> FAILED\n"
+            + proc.stdout[-2000:] + proc.stderr[-2000:]
+        )
+        return False
+    ev = json.loads(line)
+    ok = bool(ev.get("ok"))
+    if ev.get("skipped"):
+        print(f"device loss: skipped ({ev['skipped']}) -> OK")
+        return True
+    print(
+        f"device loss: shard {ev.get('scheduled')} scheduled "
+        f"(parity={ev.get('parity')}, violations={ev.get('violations')}, "
+        f"recarves={ev.get('recarves')}, "
+        f"recovery={ev.get('mesh_recovery_s')}s), serve "
+        f"{ev.get('serve_outcomes')} cycles "
+        f"({ev.get('migrated', 0)} tenants failed over, "
+        f"recarves={ev.get('serve_recarves')})"
+        f" -> {'OK' if ok else 'FAILED: ' + json.dumps(ev)}"
+    )
+    return ok
+
+
+def soak(budget_s: float, seed: int = 17) -> bool:
+    """--soak: replay a SEEDED multi-subsystem fault schedule (solver faults,
+    cloud reclaims, device loss + probe re-entry) through the supervised
+    streaming solver under a wall-clock budget. Every cycle must complete
+    and every outcome — cycle, recarve, restore — must be classified."""
+    from karpenter_tpu.apis import labels as wk
+    from karpenter_tpu.scheduling import Taints, label_requirements
+    from karpenter_tpu.solver import mesh_health as mh
+    from karpenter_tpu.solver.encode import NodeInfo
+    from karpenter_tpu.solver.oracle import OracleSolver
+    from karpenter_tpu.solver.supervisor import SupervisedSolver
+    from karpenter_tpu.streaming import StreamingSolver
+    from karpenter_tpu.streaming.churn import ChurnConfig, ChurnProcess
+    from karpenter_tpu.testing import faults
+
+    pods, its, tpls = build_problem(60, 20)
+    nodes = [
+        NodeInfo(
+            name=f"soak-node-{i}",
+            requirements=label_requirements({wk.LABEL_HOSTNAME: f"soak-node-{i}"}),
+            taints=Taints(()),
+            available={"cpu": 8.0, "memory": 32 * 1024.0**3, "pods": 40.0},
+            daemon_overhead={},
+        )
+        for i in range(6)
+    ]
+    spec = (
+        f"seed={seed};solve.device@p0.2;solve.nan@p0.1;"
+        f"cloud.reclaim=1@p0.25;device[0].loss@p0.15"
+    )
+    faults.install(faults.FaultInjector.from_spec(spec))
+    mh.reset()
+    solver = SupervisedSolver(
+        StreamingSolver(OracleSolver()), fallback=OracleSolver(),
+        retries=1, backoff_base_s=0.01,
+    )
+    process = ChurnProcess(
+        pods, nodes=nodes,
+        config=ChurnConfig(seed=seed, arrivals_per_cycle=4,
+                           deletes_per_cycle=2),
+    )
+    cycles = 0
+    device_hits = 0
+    dropped = []
+    deadline = time.monotonic() + max(1.0, budget_s)
+    try:
+        while time.monotonic() < deadline:
+            process.step()
+            # the mesh-consumer visit this soak models: one device-site draw
+            # per cycle, recovered through the same classify->recarve->probe
+            # path the shard/serve/world consumers run in-band
+            try:
+                mh.dispatch_check(None)
+            except faults.FaultDeviceLost as exc:
+                device_hits += 1
+                if mh.handle_dispatch_failure(exc) is None:
+                    dropped.append(("device", repr(exc)))
+                mh.tracker().probe(force=True)
+            try:
+                result = solver.solve(
+                    list(process.pods), its, tpls, nodes=list(process.nodes),
+                )
+                if result is None:
+                    dropped.append(("cycle", cycles))
+            except Exception as exc:  # a raised solve IS a dropped cycle
+                dropped.append(("cycle", f"{type(exc).__name__}: {exc}"))
+            cycles += 1
+    finally:
+        faults.install(None)
+    recarves = mh.tracker().snapshot()["recarves"] if mh.has_tracker() else []
+    unclassified = [r for r in recarves if r["reason"] not in mh.REASONS]
+    ok = (
+        not dropped and not unclassified and cycles > 0
+        and solver.counters["solve_fallbacks"] + solver.counters["solve_retries"] > 0
+    )
+    by_reason: dict = {}
+    for r in recarves:
+        by_reason[r["reason"]] = by_reason.get(r["reason"], 0) + 1
+    print(
+        f"soak: {cycles} cycles in {budget_s:.0f}s budget, "
+        f"{device_hits} device faults, "
+        f"{len(recarves)} recarves ({by_reason}), "
+        f"retries={solver.counters['solve_retries']}, "
+        f"fallbacks={solver.counters['solve_fallbacks']}, "
+        f"dropped={len(dropped)}"
+        f" -> {'OK' if ok else 'FAILED: ' + repr(dropped or unclassified or 'no faults fired')}"
+    )
+    return ok
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--pods", default="60,300",
@@ -328,7 +618,15 @@ def main() -> int:
                     help="watchdog deadline in seconds (catches 'hang')")
     ap.add_argument("--quick", action="store_true",
                     help="oracle primary only, 60-pod corpus")
+    ap.add_argument("--soak", type=float, default=0.0, metavar="SECONDS",
+                    help="also replay a seeded multi-subsystem fault "
+                         "schedule for this wall-clock budget")
+    ap.add_argument("--device-loss-child", action="store_true",
+                    help=argparse.SUPPRESS)
     args = ap.parse_args()
+
+    if args.device_loss_child:
+        return run_device_loss_child()
 
     from karpenter_tpu.solver.oracle import OracleSolver
     from karpenter_tpu.solver.supervisor import SupervisedSolver
@@ -404,9 +702,11 @@ def main() -> int:
         active=16 if args.quick else 64,
     )
     storm_ok = restart_storm()
+    device_ok = device_loss(quick=args.quick)
+    soak_ok = soak(args.soak) if args.soak > 0 else True
     return 1 if (
         failed or not churn_ok or not tenant_ok or not fleet_ok
-        or not storm_ok
+        or not storm_ok or not device_ok or not soak_ok
     ) else 0
 
 

@@ -142,6 +142,12 @@ DEFAULT_BANDS = {
     # into steady state), and bench.py reports it per run. The first
     # device-world-carrying run seeds the window.
     "churn_cycle_host_ms": (LOWER_BETTER, 3.0),
+    # round-24 degraded-mesh recovery (solver/mesh_health.py): wall seconds
+    # from an injected device loss to the first green solve on the recarved
+    # mesh (bench.py mesh_recovery scenario). Dominated by the re-plan +
+    # re-compile on the shrunken topology, so host-noisy — the band starts
+    # wide. The first recovery-carrying run seeds each window.
+    "mesh_recovery_s": (LOWER_BETTER, 3.0),
 }
 
 # absolute ceiling for the --smoke tiny-shape solve (steady-state, post
@@ -237,6 +243,11 @@ def row_from_bench(out: dict, label: str = "run") -> dict:
         "churn_cold_solves": out.get("churn_cold_solves"),
         "device_world_speedup": out.get("device_world_speedup"),
         "device_world_overlap_frac": out.get("device_world_overlap_frac"),
+        # schema v2, round 24: degraded-mesh recovery columns — present only
+        # when the bench mesh_recovery scenario closed a recovery clock
+        # (single-device hosts and fault-never-fired runs omit them)
+        "mesh_recovery_s": out.get("mesh_recovery_s"),
+        "mesh_recovery_recarves": out.get("mesh_recovery_recarves"),
         "error": out.get("error"),
     }
     row.update({k: v for k, v in optional.items() if v is not None})
@@ -441,8 +452,75 @@ def smoke(baseline_path=DEFAULT_BASELINE) -> list:
         # Gates the contracts, not the numbers: every unserved outcome
         # classified, traffic actually served, every placement reasoned.
         problems += _smoke_serve_fleet()
+
+        # (5) degraded-mesh small-N smoke (round 24): inject a device loss
+        # into the first sharded dispatch and require the solve to recover
+        # on the recarved mesh — recarve classified, recovery clock closed,
+        # no dropped pods. Multi-device hosts only (under tests the conftest
+        # forces 8 emulated CPU devices; a bare single-device run skips).
+        problems += _smoke_mesh_recovery(fleet, its, tpl)
     finally:
         programs.set_enabled(None)
+    return problems
+
+
+def _smoke_mesh_recovery(fleet, its, tpl) -> list:
+    """Small-N device-loss recovery through the real sharded path (see
+    smoke()). Gates the robustness contract, not the wall: the recovery
+    number itself is banded from bench rows, not here."""
+    import os
+
+    import jax
+
+    if len(jax.devices()) < 2:
+        return []  # nothing to recarve on a single-device host
+    problems = []
+    from karpenter_tpu.solver import mesh_health
+    from karpenter_tpu.solver.jax_backend import JaxSolver
+    from karpenter_tpu.testing import faults
+
+    saved = {
+        k: os.environ.get(k)
+        for k in ("KARPENTER_TPU_MESH_HEALTH", "KARPENTER_TPU_SHARD",
+                  "KARPENTER_TPU_SHARD_MIN_PODS")
+    }
+    try:
+        os.environ["KARPENTER_TPU_MESH_HEALTH"] = "1"
+        os.environ["KARPENTER_TPU_SHARD"] = "1"
+        os.environ["KARPENTER_TPU_SHARD_MIN_PODS"] = "2"
+        mesh_health.reset()
+        faults.install(faults.FaultInjector.from_spec("seed=5;device[1].loss@1"))
+        solver = JaxSolver()
+        result = solver.solve(fleet, its, [tpl])
+        recovery_s = (
+            mesh_health.tracker().snapshot().get("last_recovery_s")
+            if mesh_health.has_tracker() else None
+        )
+    finally:
+        faults.install(None)
+        mesh_health.reset()
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    last = getattr(solver, "last_shard", None) or {}
+    if last.get("reason", "never-attempted") is not None:
+        problems.append(
+            f"mesh smoke: shard path stood down after device loss "
+            f"(reason={last.get('reason', 'never-attempted')!r})"
+        )
+    if not last.get("recarves"):
+        problems.append("mesh smoke: injected device loss caused no recarve")
+    if recovery_s is None:
+        problems.append("mesh smoke: no recovery clock closed")
+    elif recovery_s > SMOKE_WARM_CEILING_S:
+        problems.append(
+            f"mesh smoke: recovery took {recovery_s:.1f}s "
+            f"(ceiling {SMOKE_WARM_CEILING_S:g}s)"
+        )
+    if result.num_scheduled() == 0:
+        problems.append("mesh smoke: recovered solve scheduled 0 pods")
     return problems
 
 
