@@ -1266,6 +1266,71 @@ def run_child():
     except Exception as exc:
         emit({"event": "serve_fleet", "error": repr(exc)})
 
+    # fleet SLO engine + flight recorder overhead (obs/slo.py, obs/flight.py,
+    # docs/OBSERVABILITY.md "SLOs & flight recorder"): the SAME 2,500-pod
+    # supervised solve measured with the engine OFF then ON (ring appends +
+    # burn-rate window accounting live on every cycle, no breach fired), then
+    # one quick multi-tenant serve burst with the engine ON to prove the
+    # per-request hooks stay live at dispatch speed. slo_overhead_frac is the
+    # ON/OFF solve median ratio — gated at <= 1.05x by tools/perf_gate.py.
+    try:
+        from karpenter_tpu.obs import flight as obs_flight, slo as obs_slo
+
+        slo_n = 500 if os.environ.get("BENCH_QUICK") else 2500
+        slo_pods = make_diverse_pods(slo_n, random.Random(4242))
+        sup.solve(slo_pods, its, [tpl])  # warm the shape outside the A/B
+        slo_reps = max(reps, 3)
+        _, off_median, _ = _measure(
+            lambda: sup.solve(slo_pods, its, [tpl]), slo_reps
+        )
+        obs_slo.set_enabled(True)
+        obs_flight.set_enabled(True)
+        obs_slo.reset()
+        obs_flight.reset()
+        try:
+            _, on_median, _ = _measure(
+                lambda: sup.solve(slo_pods, its, [tpl]), slo_reps
+            )
+            solve_recorded = obs_flight.ring().recorded
+            # quick serve pass: 8 oracle tenants x 4 cycles through the real
+            # dispatcher, admission/latency hooks firing per request
+            from karpenter_tpu import serve as serve_pkg
+            from karpenter_tpu.solver.oracle import OracleSolver
+
+            spods = make_diverse_pods(12, random.Random(7))
+            service = serve_pkg.SolveService(batching=False, max_tenants=8)
+            for i in range(8):
+                service.register_tenant(f"slo-t{i}", solver=OracleSolver())
+            service.start()
+            try:
+                for _ in range(4):
+                    tickets = [
+                        service.submit(f"slo-t{i}", spods, its, [tpl])
+                        for i in range(8)
+                    ]
+                    for t in tickets:
+                        t.wait(timeout=60.0)
+            finally:
+                service.close()
+            serve_recorded = obs_flight.ring().recorded - solve_recorded
+            breached = obs_slo.engine().breached()
+        finally:
+            obs_slo.set_enabled(None)
+            obs_flight.set_enabled(None)
+        emit({
+            "event": "slo_overhead",
+            "pods": slo_n,
+            "reps": slo_reps,
+            "off_s": round(off_median, 4),
+            "on_s": round(on_median, 4),
+            "overhead_frac": round(on_median / max(off_median, 1e-9), 4),
+            "flight_solve_events": solve_recorded,
+            "flight_serve_events": serve_recorded,
+            "breached": breached,
+        })
+    except Exception as exc:
+        emit({"event": "slo_overhead", "error": repr(exc)})
+
     # mesh-sharded partitioned solve (shard/): the fleet-scale shape family,
     # A/B against the unsharded control on the same diverse mix. Each shape
     # runs in a fresh subprocess so a CPU host can be forced to an 8-device
@@ -1989,6 +2054,24 @@ def main():
             )
     elif fleet is not None:
         out["serve_fleet_error"] = fleet["error"]
+    slo_ev = next(
+        (e for e in events if e.get("event") == "slo_overhead"), None
+    )
+    if slo_ev is not None and "error" not in slo_ev:
+        # SLO engine + flight recorder cost (slo_overhead scenario): the
+        # ON/OFF supervised-solve median ratio at 2,500 pods, gated <= 1.05x
+        out["slo_overhead_frac"] = slo_ev.get("overhead_frac")
+        out["slo_flight_events"] = (
+            (slo_ev.get("flight_solve_events") or 0)
+            + (slo_ev.get("flight_serve_events") or 0)
+        )
+        if slo_ev.get("breached"):
+            out["error"] = (
+                f"slo_overhead: objectives breached on a healthy bench run: "
+                f"{slo_ev['breached']}"
+            )
+    elif slo_ev is not None:
+        out["slo_overhead_error"] = slo_ev["error"]
     shard_evs = [
         e for e in events if e.get("event") == "shard" and "error" not in e
     ]

@@ -434,6 +434,28 @@ SERVE_CYCLE_SECONDS = REGISTRY.histogram(
     "(queue wait included; per-tenant quantiles live in /debug/tenants)",
 )
 
+# -- fleet SLO engine + flight recorder (obs/slo.py, obs/flight.py) -----------
+SLO_BURN_RATE = REGISTRY.gauge(
+    "slo_burn_rate",
+    "Error-budget burn-rate multiple per SLO objective and window "
+    "(multi-window burn rate; breach requires both fast and slow windows "
+    "over the threshold). Labels {objective, window} are bounded: a fixed "
+    "objective set plus per-tenant-class serve objectives, window in "
+    "(fast, slow). SLO-gated (KARPENTER_TPU_SLO).",
+)
+SLO_BREACH = REGISTRY.counter(
+    "slo_breach_total",
+    "Edge-triggered SLO breach transitions by {objective} — each one also "
+    "records a slo-breach flight event and snapshots the flight ring. "
+    "SLO-gated.",
+)
+FLIGHT_DUMPS = REGISTRY.counter(
+    "flight_dumps_total",
+    "Flight-recorder ring snapshots written to disk, by classified {reason} "
+    "(slo-breach, circuit-open, recarve, validator-reject, manual). "
+    "SLO-gated.",
+)
+
 # -- restart-resilience series (solver/aot.py, streaming/snapshot.py,
 # solver/warmup.py recovery) ---------------------------------------------------
 RESTART_RECOVERY_SECONDS = REGISTRY.histogram(

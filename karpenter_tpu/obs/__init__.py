@@ -1,4 +1,6 @@
-"""Observability: solve-cycle tracing (phase spans, ring buffer, exporters)
-and the XLA program registry (compile/device-memory telemetry)."""
+"""Observability: solve-cycle tracing (phase spans, ring buffer, exporters),
+the XLA program registry (compile/device-memory telemetry), the fleet SLO
+engine (burn-rate objectives, /statusz rollup), and the flight recorder
+(classified event ring + breach-triggered incident dumps)."""
 
-from karpenter_tpu.obs import programs, trace  # noqa: F401
+from karpenter_tpu.obs import flight, programs, slo, trace  # noqa: F401

@@ -20,6 +20,18 @@ from typing import Callable, Optional
 
 from karpenter_tpu.metrics import REGISTRY
 
+# Every debug endpoint the handler resolves — /statusz indexes these and
+# tools/metrics_lint.py checks docs/OBSERVABILITY.md names each one (and
+# names nothing that is not here).
+DEBUG_ENDPOINTS = (
+    "/debug/explain",
+    "/debug/flight",
+    "/debug/programs",
+    "/debug/slo",
+    "/debug/tenants",
+    "/debug/traces",
+)
+
 
 def _series(name: str, labels, value) -> str:
     if labels:
@@ -32,6 +44,11 @@ def render_prometheus() -> str:
     # HELP/TYPE headers come from describe() so every REGISTERED metric
     # appears in the exposition even before its first sample — scrape configs
     # and tools/metrics_lint.py see the full surface from process start.
+    from karpenter_tpu.obs import slo
+
+    # burn-rate gauges are computed on the read path (the engine's hot path
+    # never allocates label dicts); one flag check when the engine is off
+    slo.refresh_metrics()
     samples: dict = {}
     for kind, name, labels, value in REGISTRY.collect():
         samples.setdefault(name, []).append((kind, labels, value))
@@ -142,6 +159,12 @@ class OperatorStatus:
 
         if mesh_health.has_tracker():
             out["mesh_health"] = mesh_health.tracker().snapshot()
+        # fleet SLO rollup (obs/slo.py): single verdict (ok/warn/breach)
+        # with worst-objective attribution; /debug/slo has the full table
+        from karpenter_tpu.obs import slo
+
+        out["slo"] = slo.rollup()
+        out["debug_endpoints"] = list(DEBUG_ENDPOINTS)
         return out
 
 
@@ -213,6 +236,27 @@ class _Handler(BaseHTTPRequestHandler):
                 else {"enabled": serve_pkg.enabled(), "tenants": []}
             )
             body = (json.dumps(payload, indent=1, default=str) + "\n").encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+        elif self.path.startswith("/debug/slo"):
+            from karpenter_tpu.obs import slo
+
+            # the full objective table: per-objective burn rates, event
+            # counts, breach history, plus the fleet rollup verdict
+            body = (
+                json.dumps(slo.debug_payload(), indent=1, default=str) + "\n"
+            ).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+        elif self.path.startswith("/debug/flight"):
+            from karpenter_tpu.obs import flight
+
+            # the flight-recorder ring (chronological) and the on-disk dump
+            # inventory; tools/flight_report.py renders either as a timeline
+            body = (
+                json.dumps(flight.debug_payload(), indent=1, default=str)
+                + "\n"
+            ).encode()
             self.send_response(200)
             self.send_header("Content-Type", "application/json")
         elif self.path.startswith("/debug/traces"):

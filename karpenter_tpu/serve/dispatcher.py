@@ -61,6 +61,7 @@ from karpenter_tpu.metrics.registry import (
     SERVE_POOL,
     SERVE_QUEUE_DEPTH,
 )
+from karpenter_tpu.obs import flight, slo
 from karpenter_tpu.serve.estimator import WaitEstimator
 from karpenter_tpu.serve.pool import ProgramPool, shape_family
 from karpenter_tpu.solver.backend import SolveResult
@@ -380,6 +381,12 @@ class SolveService:
             # the cls label stays bounded: classes are operator config, and
             # unregistered ids never mint anything ("-" is the placeholder)
             SERVE_ADMISSION.inc({"cls": cls_label, "outcome": outcome})
+            if slo.enabled():
+                slo.on_serve_admission(cls_label, False)
+                flight.record(
+                    flight.KIND_ADMISSION, outcome=outcome,
+                    cls=cls_label, tenant=tenant_id,
+                )
             ticket.resolve(ServeOutcome(
                 status=status, tenant=tenant_id, reason=outcome,
             ))
@@ -444,6 +451,7 @@ class SolveService:
             self._enqueue_locked(state, c, req)
             state.counters["submitted"] += 1
             SERVE_ADMISSION.inc({"cls": c.name, "outcome": ADMIT_ACCEPTED})
+            slo.on_serve_admission(c.name, True)
             started = self._thread is not None
             self._cond.notify_all()
         if not started:
@@ -575,6 +583,12 @@ class SolveService:
         ) >= req.deadline_s:
             state.counters["shed"] += 1
             SERVE_ADMISSION.inc({"cls": c.name, "outcome": ADMIT_EXPIRED})
+            if slo.enabled():
+                slo.on_serve_admission(c.name, False)
+                flight.record(
+                    flight.KIND_ADMISSION, outcome=ADMIT_EXPIRED,
+                    cls=c.name, tenant=state.id,
+                )
             req.ticket.resolve(ServeOutcome(
                 status=STATUS_OVERLOADED, tenant=state.id,
                 reason=ADMIT_EXPIRED,
@@ -745,6 +759,12 @@ class SolveService:
                 SERVE_ADMISSION.inc(
                     {"cls": state.cls, "outcome": ADMIT_EXPIRED}
                 )
+                if slo.enabled():
+                    slo.on_serve_admission(state.cls, False)
+                    flight.record(
+                        flight.KIND_ADMISSION, outcome=ADMIT_EXPIRED,
+                        cls=state.cls, tenant=req.tenant,
+                    )
                 req.ticket.resolve(ServeOutcome(
                     status=STATUS_OVERLOADED, tenant=req.tenant,
                     reason=ADMIT_EXPIRED,
@@ -760,6 +780,10 @@ class SolveService:
             )
         except Exception as exc:  # noqa: BLE001 — a tenant solve must never kill the loop
             state.counters["errors"] += 1
+            flight.record(
+                flight.KIND_SERVE_COMPLETE, cls=state.cls, tenant=req.tenant,
+                status=STATUS_ERROR, error=type(exc).__name__,
+            )
             req.ticket.resolve(ServeOutcome(
                 status=STATUS_ERROR, tenant=req.tenant,
                 reason=f"{type(exc).__name__}: {exc}",
@@ -780,6 +804,12 @@ class SolveService:
         state.record_latency(latency)
         SERVE_CYCLES.inc({"cls": state.cls, "path": path})
         SERVE_CYCLE_SECONDS.observe(latency)
+        if slo.enabled():
+            slo.on_serve_latency(state.cls, latency)
+            flight.record(
+                flight.KIND_SERVE_COMPLETE, cls=state.cls, tenant=req.tenant,
+                latency_s=round(latency, 6), path=path,
+            )
         req.ticket.resolve(ServeOutcome(
             status=STATUS_OK, tenant=req.tenant, result=result,
             latency_s=latency, path=path,

@@ -66,7 +66,7 @@ from karpenter_tpu.metrics.registry import (
     SHARD_PARTITIONS,
     TRANSFER_BYTES,
 )
-from karpenter_tpu.obs import programs, trace
+from karpenter_tpu.obs import flight, programs, trace
 from karpenter_tpu.ops.ffd_core import (
     KIND_CLAIM,
     KIND_NEW_CLAIM,
@@ -86,6 +86,7 @@ from karpenter_tpu.solver.encode import Encoder, _reqs_digest
 def _standdown(solver, reason: str, **info) -> None:
     """Record one classified fallback and return None to the caller."""
     SHARD_FALLBACK.inc({"reason": reason})
+    flight.record(flight.KIND_SHARD_STANDDOWN, reason=reason)
     solver.last_shard = {"reason": reason, **info}
     with trace.span("shard_standdown", reason=reason):
         pass

@@ -57,7 +57,7 @@ from karpenter_tpu.metrics.registry import (
     MESH_RECARVE,
     MESH_RECOVERY_SECONDS,
 )
-from karpenter_tpu.obs import trace
+from karpenter_tpu.obs import flight, slo, trace
 from karpenter_tpu.testing import faults
 
 log = logging.getLogger(__name__)
@@ -206,6 +206,10 @@ class MeshHealth:
             ent.history.append(state)
             if self._failed_at is None:
                 self._failed_at = now
+        flight.record(
+            flight.KIND_MESH_FAULT, device=int(device_id), reason=reason,
+            state=state,
+        )
         log.warning(
             "mesh_health: device %d -> %s (%s, failure #%d)",
             device_id, state, reason, ent.failures,
@@ -222,6 +226,14 @@ class MeshHealth:
             self.recarves.append((reason, device))
         healthy = self.healthy_devices()
         self._export()
+        flight.record(
+            flight.KIND_MESH_RECARVE, reason=reason, device=device,
+            healthy=len(healthy),
+        )
+        if reason != REASON_RECOVERED:
+            # a shrinking recarve is an incident: snapshot the ring with the
+            # fault + recarve chain in it (growing back is routine)
+            flight.snapshot_dump("recarve")
         with trace.span("mesh_recarve", reason=reason, healthy=len(healthy)):
             pass
         log.warning(
@@ -241,6 +253,11 @@ class MeshHealth:
             self._failed_at = None
             self.last_recovery_s = elapsed
         MESH_RECOVERY_SECONDS.observe(elapsed)
+        if slo.enabled():
+            slo.on_recovery(elapsed)
+            flight.record(
+                flight.KIND_MESH_RECOVERED, seconds=round(elapsed, 6),
+            )
 
     # -- probes / probation ----------------------------------------------------
 

@@ -54,7 +54,7 @@ from karpenter_tpu.metrics.registry import (
     SOLVER_RETRIES,
     VALIDATOR_REJECTIONS,
 )
-from karpenter_tpu.obs import trace
+from karpenter_tpu.obs import flight, slo, trace
 from karpenter_tpu.solver import validator as val
 from karpenter_tpu.solver.backend import SolveResult, SolverBackend
 from karpenter_tpu.testing import faults
@@ -300,22 +300,33 @@ class SupervisedSolver(SolverBackend):
             self._set_circuit(CIRCUIT_CLOSED)
 
     def _record_primary_failure(self) -> None:
+        opened = False
         with self._lock:
             self._consecutive_failures += 1
             if self._circuit == CIRCUIT_HALF_OPEN:
                 # failed probe: restart the cooldown
                 self._opened_at = self._time()
                 self._set_circuit(CIRCUIT_OPEN)
+                opened = True
             elif (
                 self._circuit == CIRCUIT_CLOSED
                 and self._consecutive_failures >= self.circuit_threshold
             ):
                 self._opened_at = self._time()
                 self._set_circuit(CIRCUIT_OPEN)
+                opened = True
                 log.warning(
                     "solver circuit opened after %d consecutive failures",
                     self._consecutive_failures,
                 )
+        if opened and slo.enabled():
+            # a tripped breaker is an incident: capture the ring now, while
+            # the failures that opened it are still in it
+            flight.record(
+                flight.KIND_CIRCUIT, state=CIRCUIT_OPEN, tenant=self.tenant,
+                failures=self._consecutive_failures,
+            )
+            flight.snapshot_dump("circuit-open")
 
     # -- the solve ------------------------------------------------------------
 
@@ -346,12 +357,29 @@ class SupervisedSolver(SolverBackend):
         with trace.cycle(
             "solve", backend=type(self.primary).__name__, **attrs
         ):
+            result = None
+            t0 = time.perf_counter()
             try:
-                return self._solve_supervised(pods, instance_types, templates, kwargs)
+                result = self._solve_supervised(
+                    pods, instance_types, templates, kwargs
+                )
+                return result
             finally:
                 trace_id = trace.current_trace_id()
                 if trace_id is not None:
                     self._last_trace_id = trace_id
+                if slo.enabled():
+                    duration_s = time.perf_counter() - t0
+                    scheduled = result.num_scheduled() if result is not None else 0
+                    failed = (
+                        len(result.failures) if result is not None else len(pods)
+                    )
+                    slo.on_solve_cycle(duration_s, scheduled, failed)
+                    flight.record(
+                        flight.KIND_SOLVE_CYCLE, tenant=self.tenant,
+                        duration_s=round(duration_s, 6), pods=len(pods),
+                        scheduled=scheduled, failed=failed,
+                    )
 
     def _solve_supervised(self, pods, instance_types, templates, kwargs) -> SolveResult:
         route = self._route()
@@ -368,6 +396,10 @@ class SupervisedSolver(SolverBackend):
             to_name = type(self.fallback).__name__
             SOLVER_FALLBACK.inc({"from": from_name, "to": to_name})
             self.counters["solve_fallbacks"] += 1
+            flight.record(flight.KIND_SOLVE_FALLBACK, **{
+                "from": from_name, "to": to_name,
+                "class": failure_class or "circuit-open",
+            })
             log.warning(
                 "solve falling back %s -> %s (class=%s, trace=%s)",
                 from_name, to_name, failure_class or "circuit-open",
@@ -424,6 +456,9 @@ class SupervisedSolver(SolverBackend):
                 if failure_class in RETRYABLE and attempt + 1 < attempts:
                     SOLVER_RETRIES.inc({"class": failure_class})
                     self.counters["solve_retries"] += 1
+                    flight.record(flight.KIND_SOLVE_RETRY, **{
+                        "class": failure_class, "attempt": attempt + 1,
+                    })
                     with trace.span(
                         "retry", **{"class": failure_class, "attempt": attempt + 1}
                     ):
@@ -544,6 +579,25 @@ class SupervisedSolver(SolverBackend):
     def _validate(
         self, result, pods, instance_types, templates, kwargs
     ) -> List[val.Violation]:
+        violations = self._validate_inner(
+            result, pods, instance_types, templates, kwargs
+        )
+        if slo.enabled() and self.validate_level != "off":
+            # gate-integrity objective: every validated result is one event,
+            # a rejection is budget burn (min_events=1 — one quarantine is
+            # an incident, not noise)
+            slo.on_gate(not violations)
+            if violations:
+                flight.record(
+                    flight.KIND_VALIDATOR_REJECT, tenant=self.tenant,
+                    count=len(violations),
+                    invariants=sorted({v.invariant for v in violations[:8]}),
+                )
+        return violations
+
+    def _validate_inner(
+        self, result, pods, instance_types, templates, kwargs
+    ) -> List[val.Violation]:
         if self.validate_level == "off":
             return []
         violations = self._device_gate(result, pods, instance_types, templates, kwargs)
@@ -623,6 +677,14 @@ class SupervisedSolver(SolverBackend):
             result, violations, backend=backend,
             parent_trace_id=self._last_trace_id, tenant=self.tenant,
         )
+        if slo.enabled():
+            # cross-link the incident lineage: the flight ring names the
+            # quarantine file, the dump that follows carries the ring
+            flight.record(
+                flight.KIND_QUARANTINE, backend=backend, tenant=self.tenant,
+                path=path, violations=len(violations),
+            )
+            flight.snapshot_dump("validator-reject")
         log.error(
             "validator rejected %s result (%d violation(s), first: %s)%s",
             backend, len(violations), violations[0],
@@ -643,6 +705,9 @@ class SupervisedSolver(SolverBackend):
         every pod — FailedScheduling events fire and the next cycle retries,
         instead of the controllers seeing an exception and dropping the batch."""
         self._record_salvage()
+        flight.record(flight.KIND_SOLVE_SALVAGE, **{
+            "class": failure_class, "pods": len(pods),
+        })
         with trace.span("salvage", **{"class": failure_class}):
             reason = self._requeue_reason(failure_class)
             return SolveResult(failures={i: reason for i in range(len(pods))})

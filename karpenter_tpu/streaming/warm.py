@@ -47,7 +47,7 @@ from karpenter_tpu.apis import labels as wk
 from karpenter_tpu.apis.objects import IN, Pod
 from karpenter_tpu.cloudprovider.types import InstanceType
 from karpenter_tpu.metrics.registry import DELTA_REUSE_RATIO, WARM_SOLVES
-from karpenter_tpu.obs import trace
+from karpenter_tpu.obs import flight, slo, trace
 from karpenter_tpu.scheduling import Requirement, Requirements, pod_requirements
 from karpenter_tpu.scheduling.hostports import get_host_ports
 from karpenter_tpu.solver import validator as val
@@ -301,6 +301,14 @@ class StreamingSolver(SolverBackend):
             labels["tenant"] = tenant_label(self.tenant)
         WARM_SOLVES.inc(labels=labels)
         DELTA_REUSE_RATIO.set(ratio)
+        if slo.enabled():
+            # stream-warm objective: cold leaks burn budget, warm hits and
+            # legitimate first-cold solves earn it
+            slo.on_stream(outcome)
+            flight.record(
+                flight.KIND_STREAM_CYCLE, outcome=outcome, pods=pods,
+                tenant=self.tenant, reuse_ratio=round(ratio, 4),
+            )
         trace.attr("streaming_outcome", outcome)
         trace.attr("reuse_ratio", round(ratio, 4))
 

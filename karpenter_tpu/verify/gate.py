@@ -207,6 +207,9 @@ def full_gate(
             # float64 disagrees with the device accept on a sampled row:
             # the full host gate governs this cycle
             GATE_AUDIT.inc({"outcome": "mismatch"})
+            from karpenter_tpu.obs import flight
+
+            flight.record(flight.KIND_GATE_AUDIT, outcome="mismatch")
             violations = _host_full(*host_args)
             return GateOutcome(
                 violations=violations, mode="host-confirm", counts=counts,

@@ -1,0 +1,291 @@
+"""Fleet SLO engine + flight recorder (obs/slo.py, obs/flight.py).
+
+The contracts under test, per docs/OBSERVABILITY.md "SLOs & flight
+recorder": burn-rate math matches a hand trace through the ring-bucketed
+windows; a breach is edge-triggered and snapshots the flight ring into a
+crash-consistent framed dump; dump damage loads as a CLASSIFIED
+PersistError; flag-off is bit-identical (zero records, identical
+placements); the debug endpoints serve untorn JSON while live solves
+publish into the rings they read; and the narrow solve program counts
+EXACTLY the same equations with the engine forced on.
+"""
+
+import json
+import os
+import socket
+import threading
+import urllib.request
+
+import pytest
+
+from karpenter_tpu.obs import flight, slo
+from karpenter_tpu.utils.persist import PersistError
+
+
+@pytest.fixture
+def slo_on(monkeypatch, tmp_path):
+    """Both layers enabled against a private dump dir and a controllable
+    clock shared by the engine and the recorder."""
+    clock = {"t": 1000.0}
+    monkeypatch.setenv("KARPENTER_TPU_FLIGHT_DIR", str(tmp_path / "flight"))
+    monkeypatch.setattr(slo, "_wall", lambda: clock["t"])
+    monkeypatch.setattr(flight, "_wall", lambda: clock["t"])
+    slo.set_enabled(True)
+    flight.set_enabled(True)
+    slo.reset()
+    flight.reset()
+    try:
+        yield clock
+    finally:
+        slo.set_enabled(None)
+        flight.set_enabled(None)
+        slo.reset()
+        flight.reset()
+
+
+def test_burn_rate_matches_hand_trace(slo_on):
+    """8 good + 2 bad solve-latency events: burn = (2/10)/0.01 = 20.0 on
+    both windows; the fast window forgets first, the slow window later;
+    a full wrap zeroes both."""
+    clock = slo_on
+    for _ in range(8):
+        slo.on_solve_cycle(0.05, scheduled=10, failed=0)
+    for _ in range(2):
+        slo.on_solve_cycle(31.0, scheduled=10, failed=0)  # > 30s ceiling
+    snap = {s["name"]: s for s in slo.engine().snapshot()}
+    lat = snap["solve-latency"]
+    assert lat["events"] == {"fast": 10, "slow": 10}
+    assert lat["burn"]["fast"] == pytest.approx(20.0)
+    assert lat["burn"]["slow"] == pytest.approx(20.0)
+    assert lat["status"] == "breach"  # 20.0 >= 14.4 on both windows
+    # past the 300s fast window: fast forgets, slow (3600s) still burns
+    clock["t"] += 400.0
+    snap = {s["name"]: s for s in slo.engine().snapshot()}
+    lat = snap["solve-latency"]
+    assert lat["events"]["fast"] == 0
+    assert lat["burn"]["fast"] == 0.0
+    assert lat["burn"]["slow"] == pytest.approx(20.0)
+    # past the slow window too: all forgotten
+    clock["t"] += 4000.0
+    snap = {s["name"]: s for s in slo.engine().snapshot()}
+    assert snap["solve-latency"]["events"] == {"fast": 0, "slow": 0}
+    assert snap["solve-latency"]["burn"] == {"fast": 0.0, "slow": 0.0}
+
+
+def test_breach_needs_both_windows_and_min_events(slo_on):
+    """One bad event below min_events must NOT breach solve-latency
+    (min_events=8); the gate-integrity objective (min_events=1) must."""
+    slo.on_solve_cycle(31.0, scheduled=1, failed=0)
+    assert slo.engine().breached() == []
+    slo.on_gate(False)
+    assert slo.engine().breached() == ["gate-integrity"]
+    roll = slo.rollup()
+    assert roll["verdict"] == "breach"
+    assert roll["worst"]["objective"] == "gate-integrity"
+
+
+def test_breach_snapshots_linked_flight_dump(slo_on):
+    """The breach edge captures the ring: exactly one dump, framed and
+    loadable, holding the pre-breach events and the slo-breach record
+    attributing the objective."""
+    flight.record(flight.KIND_SOLVE_CYCLE, trace_id="t-1", pods=10)
+    flight.record(flight.KIND_GATE_AUDIT, trace_id="t-1", outcome="mismatch")
+    slo.on_gate(False)
+    dumps = flight.scan_dumps()
+    assert len(dumps) == 1
+    body = flight.load_dump(dumps[0])
+    assert body["reason"] == "slo-breach"
+    assert body["objective"] == "gate-integrity"
+    kinds = [e["kind"] for e in body["events"]]
+    assert kinds == ["solve-cycle", "gate-audit", "slo-breach"]
+    breach = body["events"][-1]
+    assert breach["objective"] == "gate-integrity"
+    # the ring itself gained the post-dump marker, cross-linking the path
+    ring_kinds = [e["kind"] for e in flight.ring().snapshot()]
+    assert ring_kinds[-1] == "flight-dump"
+    # edge-triggered: the already-breached objective must not dump again
+    slo.on_gate(False)
+    assert len(flight.scan_dumps()) == 1
+
+
+def test_dump_damage_is_classified(slo_on):
+    """Every way a dump can rot loads as PersistError with a classified
+    reason — never a raw json/struct error."""
+    slo.on_gate(False)
+    path = flight.scan_dumps()[0]
+    with pytest.raises(PersistError) as exc:
+        flight.load_dump(path + ".gone")
+    assert exc.value.reason == "missing"
+    blob = open(path, "rb").read()
+    with open(path, "wb") as f:
+        f.write(blob[: len(blob) // 2])
+    with pytest.raises(PersistError) as exc:
+        flight.load_dump(path)
+    assert exc.value.reason == "truncated"
+    with open(path, "wb") as f:
+        f.write(blob[:-8] + b"XXXXXXXX")  # payload bytes flipped
+    with pytest.raises(PersistError) as exc:
+        flight.load_dump(path)
+    assert exc.value.reason == "checksum"
+
+
+def test_unclassified_kind_and_reason_raise(slo_on):
+    with pytest.raises(ValueError):
+        flight.record("made-up-kind")
+    with pytest.raises(ValueError):
+        flight.snapshot_dump("made-up-reason")
+
+
+def test_flag_off_zero_records_bit_identical_placements():
+    """Engine off (the default): no record lands, no window moves, and the
+    solve path produces byte-for-byte the same placements as with the
+    engine forced on — the zero-overhead contract."""
+    import random
+
+    from bench import make_diverse_pods
+    from karpenter_tpu.apis.nodepool import NodePool
+    from karpenter_tpu.apis.objects import ObjectMeta
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.solver.encode import template_from_nodepool
+    from karpenter_tpu.solver.oracle import OracleSolver
+    from karpenter_tpu.solver.supervisor import SupervisedSolver
+    from tools.chaos_sweep import placements_key
+
+    its = instance_types(12)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="slo-ab")), its, range(len(its))
+    )
+    pods = make_diverse_pods(40, random.Random(3))
+    flight.reset()
+    slo.reset()
+    assert not slo.enabled() and not flight.enabled()
+    off_key = placements_key(
+        SupervisedSolver(OracleSolver()).solve(pods, its, [tpl])
+    )
+    assert len(flight.ring()) == 0
+    assert flight.ring().recorded == 0
+    assert all(  # no window ever moved
+        s["events"] == {"fast": 0, "slow": 0}
+        for s in slo.engine().snapshot()
+    )
+    slo.set_enabled(True)
+    flight.set_enabled(True)
+    try:
+        on_key = placements_key(
+            SupervisedSolver(OracleSolver()).solve(pods, its, [tpl])
+        )
+        assert flight.ring().recorded >= 1  # the hooks really fired
+    finally:
+        slo.set_enabled(None)
+        flight.set_enabled(None)
+        slo.reset()
+        flight.reset()
+    assert on_key == off_key
+
+
+def test_slo_endpoints_untorn_json_under_live_solves(slo_on):
+    """Round-13 pattern: /debug/slo, /debug/flight, /statusz and /metrics
+    must serve parseable payloads while supervised solves publish into the
+    engine and the ring they read."""
+    from karpenter_tpu.apis.nodepool import NodePool
+    from karpenter_tpu.apis.objects import ObjectMeta
+    from karpenter_tpu.cloudprovider.fake import instance_types
+    from karpenter_tpu.operator import serving
+    from karpenter_tpu.solver.encode import template_from_nodepool
+    from karpenter_tpu.solver.oracle import OracleSolver
+    from karpenter_tpu.solver.supervisor import SupervisedSolver
+    from tests.factories import make_pod
+
+    its = instance_types(8)
+    tpl = template_from_nodepool(
+        NodePool(metadata=ObjectMeta(name="slo-hammer")), its, range(len(its))
+    )
+    sup = SupervisedSolver(OracleSolver())
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    server = serving.serve(
+        port, status=serving.OperatorStatus(supervisor=sup)
+    )
+    base = f"http://127.0.0.1:{port}"
+    stop = threading.Event()
+    errors = []
+
+    def solve_loop():
+        try:
+            for i in range(40):
+                sup.solve(
+                    [make_pod(name=f"slo-{i}", cpu=0.25)], its, [tpl]
+                )
+        except Exception as exc:  # pragma: no cover - diagnostic
+            errors.append(("solve", exc))
+        finally:
+            stop.set()
+
+    def hammer(path):
+        try:
+            while not stop.is_set():
+                body = urllib.request.urlopen(
+                    f"{base}{path}", timeout=5
+                ).read()
+                if path != "/metrics":
+                    json.loads(body)
+        except Exception as exc:  # pragma: no cover - diagnostic
+            errors.append((path, exc))
+
+    threads = [threading.Thread(target=solve_loop)] + [
+        threading.Thread(target=hammer, args=(p,))
+        for p in ("/debug/slo", "/debug/flight", "/statusz", "/metrics")
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not errors, errors
+        assert not any(t.is_alive() for t in threads)
+        payload = json.loads(
+            urllib.request.urlopen(f"{base}/debug/flight", timeout=5).read()
+        )
+        assert payload["recorded"] >= 40  # hooks raced the readers for real
+        statusz = json.loads(
+            urllib.request.urlopen(f"{base}/statusz", timeout=5).read()
+        )
+        assert statusz["slo"]["enabled"]
+        assert "/debug/slo" in statusz["debug_endpoints"]
+    finally:
+        stop.set()
+        server.shutdown()
+
+
+def test_serve_class_objectives_bounded(slo_on):
+    """Per-class serve objectives are lazily created but BOUNDED: past the
+    cap, unseen classes fold into the .other bucket instead of growing the
+    label space without limit."""
+    for i in range(200):
+        slo.on_serve_admission(f"cls-{i}", True)
+    names = {s["name"] for s in slo.engine().snapshot()}
+    shed = {n for n in names if n.startswith("serve-shed.")}
+    assert len(shed) <= 65
+    assert "serve-shed.other" in shed
+
+
+@pytest.mark.skipif(
+    os.environ.get("JAX_PLATFORMS", "") not in ("", "cpu"),
+    reason="trace-only census runs on the CPU lowering",
+)
+def test_narrow_census_pinned_with_slo_enabled():
+    """The engine lives entirely host-side: with SLO + flight forced on,
+    the narrow solve body must count EXACTLY the same 2394 equations —
+    zero ops may leak into the jitted program."""
+    from tools.kernel_census import build_census_problem, narrow_jaxpr_eqns
+
+    slo.set_enabled(True)
+    flight.set_enabled(True)
+    try:
+        assert narrow_jaxpr_eqns(
+            build_census_problem(), wavefront=0
+        ) == 2394
+    finally:
+        slo.set_enabled(None)
+        flight.set_enabled(None)

@@ -143,9 +143,11 @@ def tenant_isolation(
     dispatcher with striped class assignment (the parity bar is unchanged:
     a tenant's placements depend on its own stream, not dispatch order)."""
     import random as _random
+    import tempfile
 
     from karpenter_tpu import serve as serve_pkg
     from karpenter_tpu.apis import labels as wk
+    from karpenter_tpu.obs import flight as obs_flight, slo as obs_slo
     from karpenter_tpu.scheduling import Taints, label_requirements
     from karpenter_tpu.solver.encode import NodeInfo
     from karpenter_tpu.solver.oracle import OracleSolver
@@ -233,10 +235,39 @@ def tenant_isolation(
             service.close()
         return outcomes, keys, solvers
 
-    control_out, control_keys, _ = run("")
-    spec = (f"seed=13;solve[{faulty}].device@p1.0;"
-            f"cloud[{faulty}].{reclaim_spec}")
-    chaos_out, chaos_keys, solvers = run(spec)
+    # SLO engine live for both runs: one hostile tenant must not push any
+    # HEALTHY class's serve objectives off green — blast radius measured at
+    # the burn-rate layer too, not just placement parity
+    import os as _os
+
+    saved_flight_dir = _os.environ.get("KARPENTER_TPU_FLIGHT_DIR")
+    _os.environ["KARPENTER_TPU_FLIGHT_DIR"] = tempfile.mkdtemp(
+        prefix="chaos-flight-"
+    )
+    obs_slo.set_enabled(True)
+    obs_flight.set_enabled(True)
+    obs_slo.reset()
+    obs_flight.reset()
+    try:
+        control_out, control_keys, _ = run("")
+        spec = (f"seed=13;solve[{faulty}].device@p1.0;"
+                f"cloud[{faulty}].{reclaim_spec}")
+        chaos_out, chaos_keys, solvers = run(spec)
+        hostile_cls = cls_of(tenants - 1)
+        healthy_cls = {c for c in class_names if c != hostile_cls}
+        slo_red = [
+            s["name"] for s in obs_slo.engine().snapshot()
+            if s["status"] != "ok"
+            and s["name"].startswith(("serve-latency.", "serve-shed."))
+            and s["name"].split(".", 1)[1] in healthy_cls
+        ]
+    finally:
+        obs_slo.set_enabled(None)
+        obs_flight.set_enabled(None)
+        if saved_flight_dir is None:
+            _os.environ.pop("KARPENTER_TPU_FLIGHT_DIR", None)
+        else:
+            _os.environ["KARPENTER_TPU_FLIGHT_DIR"] = saved_flight_dir
 
     dropped = [
         (tid, o.status, o.reason)
@@ -264,15 +295,17 @@ def tenant_isolation(
     # absolute slack floors the ratio bound: sub-ms oracle solves would
     # otherwise fail on scheduler jitter alone
     slow = chaos_p99 > max(1.5 * control_p99, control_p99 + 0.25)
-    ok = not dropped and not parity_bad and contained and not slow
+    ok = not dropped and not parity_bad and contained and not slow \
+        and not slo_red
     print(
         f"{label}: {tenants} active / {total} registered x {cycles} cycles, "
         f"faulty={faulty} (fallbacks={sup.counters['solve_fallbacks']}, "
         f"circuit={sup.circuit_state()}), dropped={len(dropped)}, "
         f"healthy parity={'ok' if not parity_bad else parity_bad}, "
         f"healthy p99 {chaos_p99 * 1e3:.1f}ms vs control "
-        f"{control_p99 * 1e3:.1f}ms"
-        f" -> {'OK' if ok else 'FAILED: ' + repr(dropped or parity_bad or ('not contained' if not contained else 'p99'))}"
+        f"{control_p99 * 1e3:.1f}ms, "
+        f"healthy-class slo={'green' if not slo_red else slo_red}"
+        f" -> {'OK' if ok else 'FAILED: ' + repr(dropped or parity_bad or slo_red or ('not contained' if not contained else 'p99'))}"
     )
     return ok
 
@@ -326,6 +359,7 @@ def run_device_loss_child() -> int:
     failover CLASSIFIED, and the recovery wall time measured."""
     import json
     import os
+    import tempfile
 
     from karpenter_tpu.operator.logging import quiet_xla_warnings
 
@@ -333,6 +367,20 @@ def run_device_loss_child() -> int:
     os.environ["KARPENTER_TPU_EXPLAIN"] = "0"
     os.environ["KARPENTER_TPU_MESH_HEALTH"] = "1"
     os.environ["KARPENTER_TPU_SHARD"] = "1"
+    # the SLO arm: device loss must breach the mesh-recovery objective and
+    # ONLY it, with a trace-linked flight dump capturing the fault chain.
+    # RECOVERY_S=0 makes any real recovery wall time a "bad" event, so the
+    # breach is deterministic; TRACE=1 stamps the records with cycle ids.
+    os.environ["KARPENTER_TPU_SLO"] = "1"
+    os.environ["KARPENTER_TPU_SLO_RECOVERY_S"] = "0"
+    os.environ["KARPENTER_TPU_TRACE"] = "1"
+    # the serve arm's in-band recarve wall time (CPU host forced to 8
+    # devices) lands inside serve latencies; park that objective's ceiling
+    # out of the way so the row isolates the mesh-recovery breach
+    os.environ["KARPENTER_TPU_SLO_SERVE_P99_S"] = "600"
+    os.environ["KARPENTER_TPU_FLIGHT_DIR"] = tempfile.mkdtemp(
+        prefix="chaos-flight-"
+    )
 
     import __graft_entry__
 
@@ -452,11 +500,47 @@ def run_device_loss_child() -> int:
         and all(idx == 0 for idx, _ in placed.values())  # survivor only
         and all(r["reason"] in mh.REASONS for r in serve_recarves)
     )
+    # -- SLO arm: the loss breached mesh-recovery and nothing else, and the
+    # flight recorder captured a loadable dump with the fault chain in it --
+    from karpenter_tpu.obs import flight, slo
+
+    breached = slo.engine().breached()
+    slo_ok = breached == ["mesh-recovery"]
+    dump_kinds: list = []
+    dump_linked = False
+    dumps = flight.scan_dumps()
+    if dumps:
+        try:
+            body = flight.load_dump(dumps[-1])
+        except Exception as exc:
+            ev["flight_error"] = f"{type(exc).__name__}: {exc}"
+        else:
+            dump_kinds = sorted({e.get("kind") for e in body["events"]})
+            traced = [e for e in body["events"] if e.get("trace_id")]
+            # trace linkage: the fault and its recarve rode the same cycle
+            by_trace: dict = {}
+            for e in traced:
+                by_trace.setdefault(e["trace_id"], set()).add(e.get("kind"))
+            dump_linked = any(
+                {"mesh-fault", "mesh-recarve"} <= kinds
+                for kinds in by_trace.values()
+            )
+    flight_ok = (
+        bool(dumps)
+        and "mesh-fault" in dump_kinds
+        and "mesh-recarve" in dump_kinds
+        and dump_linked
+    )
     ev.update({
         "serve_ok": serve_ok,
         "serve_outcomes": len(outcomes),
         "serve_recarves": [r["reason"] for r in serve_recarves],
-        "ok": shard_ok and serve_ok,
+        "slo_breached": breached,
+        "slo_ok": slo_ok,
+        "flight_dumps": len(dumps),
+        "flight_dump_kinds": dump_kinds,
+        "flight_ok": flight_ok,
+        "ok": shard_ok and serve_ok and slo_ok and flight_ok,
     })
     print(json.dumps(ev), flush=True)
     return 0 if ev["ok"] else 1
@@ -511,7 +595,11 @@ def device_loss(quick: bool = False) -> bool:
         f"recovery={ev.get('mesh_recovery_s')}s), serve "
         f"{ev.get('serve_outcomes')} cycles "
         f"({ev.get('migrated', 0)} tenants failed over, "
-        f"recarves={ev.get('serve_recarves')})"
+        f"recarves={ev.get('serve_recarves')}), "
+        f"slo breached={ev.get('slo_breached')} "
+        f"(only-recovery={ev.get('slo_ok')}), flight "
+        f"{ev.get('flight_dumps')} dumps kinds={ev.get('flight_dump_kinds')} "
+        f"(linked={ev.get('flight_ok')})"
         f" -> {'OK' if ok else 'FAILED: ' + json.dumps(ev)}"
     )
     return ok
@@ -522,7 +610,10 @@ def soak(budget_s: float, seed: int = 17) -> bool:
     cloud reclaims, device loss + probe re-entry) through the supervised
     streaming solver under a wall-clock budget. Every cycle must complete
     and every outcome — cycle, recarve, restore — must be classified."""
+    import tempfile
+
     from karpenter_tpu.apis import labels as wk
+    from karpenter_tpu.obs import flight as obs_flight, slo as obs_slo
     from karpenter_tpu.scheduling import Taints, label_requirements
     from karpenter_tpu.solver import mesh_health as mh
     from karpenter_tpu.solver.encode import NodeInfo
@@ -549,6 +640,20 @@ def soak(budget_s: float, seed: int = 17) -> bool:
     )
     faults.install(faults.FaultInjector.from_spec(spec))
     mh.reset()
+    # flight recorder live for the whole soak: every event the subsystems
+    # emit under the fault schedule must land in the CLOSED kind vocabulary
+    # (record() raises on strays, but the ring is re-checked here so a future
+    # bypass still fails the row rather than shipping unclassified events)
+    import os as _os
+
+    saved_flight_dir = _os.environ.get("KARPENTER_TPU_FLIGHT_DIR")
+    _os.environ["KARPENTER_TPU_FLIGHT_DIR"] = tempfile.mkdtemp(
+        prefix="chaos-flight-"
+    )
+    obs_slo.set_enabled(True)
+    obs_flight.set_enabled(True)
+    obs_slo.reset()
+    obs_flight.reset(capacity=4096)
     solver = SupervisedSolver(
         StreamingSolver(OracleSolver()), fallback=OracleSolver(),
         retries=1, backoff_base_s=0.01,
@@ -586,11 +691,23 @@ def soak(budget_s: float, seed: int = 17) -> bool:
             cycles += 1
     finally:
         faults.install(None)
+        flight_events = obs_flight.ring().snapshot()
+        obs_slo.set_enabled(None)
+        obs_flight.set_enabled(None)
+        if saved_flight_dir is None:
+            _os.environ.pop("KARPENTER_TPU_FLIGHT_DIR", None)
+        else:
+            _os.environ["KARPENTER_TPU_FLIGHT_DIR"] = saved_flight_dir
     recarves = mh.tracker().snapshot()["recarves"] if mh.has_tracker() else []
     unclassified = [r for r in recarves if r["reason"] not in mh.REASONS]
+    unclassified_flight = sorted({
+        str(e.get("kind")) for e in flight_events
+        if e.get("kind") not in obs_flight.KINDS
+    })
     ok = (
         not dropped and not unclassified and cycles > 0
         and solver.counters["solve_fallbacks"] + solver.counters["solve_retries"] > 0
+        and flight_events and not unclassified_flight
     )
     by_reason: dict = {}
     for r in recarves:
@@ -601,8 +718,10 @@ def soak(budget_s: float, seed: int = 17) -> bool:
         f"{len(recarves)} recarves ({by_reason}), "
         f"retries={solver.counters['solve_retries']}, "
         f"fallbacks={solver.counters['solve_fallbacks']}, "
-        f"dropped={len(dropped)}"
-        f" -> {'OK' if ok else 'FAILED: ' + repr(dropped or unclassified or 'no faults fired')}"
+        f"dropped={len(dropped)}, "
+        f"flight={len(flight_events)} events "
+        f"({'all classified' if not unclassified_flight else unclassified_flight})"
+        f" -> {'OK' if ok else 'FAILED: ' + repr(dropped or unclassified or unclassified_flight or 'no faults fired')}"
     )
     return ok
 

@@ -1,0 +1,149 @@
+"""Dev tool: render a flight-recorder capture as a causal timeline.
+
+Reads classified flight events from a framed ``flight-*.bin`` dump (written
+by ``karpenter_tpu.obs.flight.snapshot_dump`` on an SLO breach or classified
+fault), from a live ``/debug/flight`` endpoint URL, or replays a synthetic
+incident locally with ``--demo``, then prints the events chronologically
+with per-event offsets and a trace-lineage grouping — which solve cycle the
+breach rode in on, what the recorder saw around it:
+
+    flight dump reason=slo-breach objective=gate-integrity events=9
+      +0.000s solve-cycle      [t-4f2a..] pods=120 scheduled=118 ...
+      ...
+      +2.113s slo-breach       [t-9c01..] objective=gate-integrity ...
+
+    python tools/flight_report.py /path/to/flight-....bin
+    python tools/flight_report.py http://localhost:8080/debug/flight
+    JAX_PLATFORMS=cpu python tools/flight_report.py --demo
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+if __name__ == "__main__":
+    sys.path.insert(0, ".")
+
+from karpenter_tpu.obs import flight
+
+_SKIP_KEYS = ("t", "kind", "trace_id")
+
+
+def _load(source: str) -> dict:
+    """A dump body from a framed .bin path or a /debug/flight URL. Both
+    normalize to {"events": [...], ...context}."""
+    if source.startswith("http://") or source.startswith("https://"):
+        import urllib.request
+
+        with urllib.request.urlopen(source) as resp:
+            payload = json.load(resp)
+        payload.setdefault("reason", "live")
+        return payload
+    return flight.load_dump(source)
+
+
+def _detail(rec: dict) -> str:
+    return " ".join(
+        f"{k}={rec[k]}" for k in sorted(rec) if k not in _SKIP_KEYS
+    )
+
+
+def _short_trace(rec: dict) -> str:
+    tid = rec.get("trace_id")
+    return f"[{str(tid)[:12]}]" if tid else "[-]"
+
+
+def render(body: dict) -> str:
+    """The timeline text for one capture body ({"events": [...], ...})."""
+    events = body.get("events") or []
+    head = [
+        "flight "
+        + " ".join(
+            f"{k}={body[k]}"
+            for k in ("reason", "objective", "pid", "captured_unix")
+            if body.get(k) is not None
+        )
+        + f" events={len(events)}"
+    ]
+    if not events:
+        head.append("  (empty ring — nothing recorded before capture)")
+        return "\n".join(head)
+    t0 = events[0].get("t", 0.0)
+    for rec in events:
+        head.append(
+            f"  +{rec.get('t', t0) - t0:7.3f}s {rec.get('kind', '?'):<17}"
+            f" {_short_trace(rec):<15} {_detail(rec)}"
+        )
+    # trace lineage: which events share a solve/serve trace — the causal
+    # chain a breach belongs to, vs. bystander records in the same window
+    lineage: dict = {}
+    for rec in events:
+        lineage.setdefault(rec.get("trace_id") or "(no trace)", []).append(rec)
+    head.append("")
+    head.append(f"trace lineage ({len(lineage)} chains):")
+    for tid, chain in lineage.items():
+        kinds = " -> ".join(r.get("kind", "?") for r in chain)
+        head.append(f"  {str(tid)[:20]:<22} {len(chain):>3} events: {kinds}")
+    return "\n".join(head)
+
+
+def _demo() -> dict:
+    """A synthetic incident: a few healthy solve cycles, then a gate-audit
+    mismatch that breaches the gate-integrity objective and dumps."""
+    import os
+    import tempfile
+
+    from karpenter_tpu.obs import slo
+
+    tmp = tempfile.mkdtemp(prefix="flight-demo-")
+    os.environ["KARPENTER_TPU_FLIGHT_DIR"] = tmp
+    slo.set_enabled(True)
+    flight.set_enabled(True)
+    try:
+        slo.reset()
+        flight.reset()
+        for i in range(4):
+            slo.on_solve_cycle(0.012 + i * 0.001, scheduled=118, failed=2)
+            flight.record(
+                flight.KIND_SOLVE_CYCLE,
+                trace_id=f"t-demo-{i}",
+                pods=120, scheduled=118, failed=2,
+                duration_s=0.012 + i * 0.001,
+            )
+        flight.record(
+            flight.KIND_GATE_AUDIT, trace_id="t-demo-4", outcome="mismatch"
+        )
+        slo.on_gate(ok=False)  # min_events=1 objective: one bad event breaches
+        path = flight.scan_dumps(tmp)[-1]
+        return flight.load_dump(path)
+    finally:
+        slo.set_enabled(None)
+        flight.set_enabled(None)
+        del os.environ["KARPENTER_TPU_FLIGHT_DIR"]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "source", nargs="?",
+        help="flight-*.bin dump path or /debug/flight URL",
+    )
+    ap.add_argument(
+        "--demo", action="store_true",
+        help="replay a synthetic breach locally and render its dump",
+    )
+    args = ap.parse_args(argv)
+    if args.demo:
+        body = _demo()
+    elif args.source:
+        body = _load(args.source)
+    else:
+        ap.error("need a dump path / URL, or --demo")
+    print(render(body))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

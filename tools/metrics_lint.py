@@ -95,6 +95,9 @@ def run() -> list:
             )
     problems.extend(_check_explain_taxonomy(docs))
     problems.extend(_check_tenant_labels())
+    problems.extend(_check_bucket_drift())
+    problems.extend(_check_slo_labels(docs))
+    problems.extend(_check_endpoints_documented(docs))
     return problems
 
 
@@ -204,6 +207,120 @@ def _check_tenant_labels() -> list:
                 f"above the KARPENTER_TPU_TENANT_LABEL_MAX bound of {bound} "
                 f"(route tenant labels through registry.tenant_label())"
             )
+    return problems
+
+
+def _check_bucket_drift() -> list:
+    """Bucket-boundary hygiene: every ``*_seconds`` histogram must use the
+    canonical DURATION_BUCKETS boundary set. The SLO engine (and any
+    cross-family latency dashboard) compares solve/serve/gate/recovery
+    latencies against each other; drifting bucket sets make those
+    comparisons quietly wrong, so a divergent set fails CI instead of
+    shipping."""
+    problems = []
+    from karpenter_tpu.metrics.registry import DURATION_BUCKETS, REGISTRY
+
+    canonical = tuple(sorted(DURATION_BUCKETS))
+    for kind, name, _help in REGISTRY.describe():
+        if kind != "histogram":
+            continue
+        metric = REGISTRY.get(name)
+        buckets = getattr(metric, "buckets", None)
+        if buckets is None:
+            continue
+        if tuple(buckets) != canonical:
+            problems.append(
+                f"{name} uses a drifting bucket set ({len(buckets)} bounds); "
+                f"*_seconds histograms share the canonical DURATION_BUCKETS "
+                f"so cross-family latency comparisons stay meaningful"
+            )
+    return problems
+
+
+def _check_slo_labels(docs: str) -> list:
+    """The SLO families carry exactly the contracted bounded labels:
+    ``slo_burn_rate`` emits {objective, window} with window in (fast, slow);
+    ``slo_breach_total`` emits {objective}; ``flight_dumps_total`` emits a
+    {reason} from obs/flight.DUMP_REASONS. Objectives are a fixed set plus
+    per-tenant-class serve objectives — bounded by the same class ceiling as
+    the serve families. Every dump reason must also be documented (operators
+    grep a dump's reason to find what triggers it)."""
+    problems = []
+    from karpenter_tpu.metrics.registry import (
+        FLIGHT_DUMPS, SLO_BREACH, SLO_BURN_RATE,
+    )
+    from karpenter_tpu.obs import flight as obs_flight
+
+    objectives = set()
+    for label_key in SLO_BURN_RATE._values:
+        labels = dict(label_key)
+        if set(labels) != {"objective", "window"}:
+            problems.append(
+                f"{SLO_BURN_RATE.name} emitted labels {sorted(labels)} "
+                f"(contract: exactly {{objective, window}})"
+            )
+            continue
+        if labels["window"] not in ("fast", "slow"):
+            problems.append(
+                f"{SLO_BURN_RATE.name} emitted window={labels['window']!r} "
+                f"(contract: fast or slow)"
+            )
+        objectives.add(labels["objective"])
+    for label_key in SLO_BREACH._values:
+        labels = dict(label_key)
+        if set(labels) != {"objective"}:
+            problems.append(
+                f"{SLO_BREACH.name} emitted labels {sorted(labels)} "
+                f"(contract: exactly one label, 'objective')"
+            )
+        else:
+            objectives.add(labels["objective"])
+    # static set + two per-class families bounded by the class ceiling
+    if len(objectives) > 8 + 2 * _CLS_BOUND:
+        problems.append(
+            f"SLO families carry {len(objectives)} distinct objective label "
+            f"values, above the bounded-objective ceiling"
+        )
+    for label_key in FLIGHT_DUMPS._values:
+        reason = dict(label_key).get("reason")
+        if reason not in obs_flight.DUMP_REASONS:
+            problems.append(
+                f"{FLIGHT_DUMPS.name} emitted reason={reason!r}, not in the "
+                f"obs/flight.py DUMP_REASONS vocabulary (bounded label "
+                f"contract)"
+            )
+    for reason in sorted(obs_flight.DUMP_REASONS):
+        if f"`{reason}`" not in docs and f"{reason}" not in docs:
+            problems.append(
+                f"flight dump reason '{reason}' is not documented in "
+                f"docs/*.md or README.md"
+            )
+    return problems
+
+
+def _check_endpoints_documented(docs: str) -> list:
+    """Doc-vs-endpoint consistency, both directions: every debug endpoint
+    the handler resolves (operator/serving.DEBUG_ENDPOINTS) must be named in
+    the docs, and every ``/debug/<name>`` path the docs mention must resolve
+    to a handler — a documented endpoint that 404s is a broken runbook."""
+    import re
+
+    problems = []
+    from karpenter_tpu.operator import serving
+
+    for endpoint in serving.DEBUG_ENDPOINTS:
+        if endpoint not in docs:
+            problems.append(
+                f"endpoint {endpoint} is served but not documented in "
+                f"docs/*.md or README.md"
+            )
+    documented = set(re.findall(r"(/debug/[a-z_]+)", docs))
+    served = set(serving.DEBUG_ENDPOINTS)
+    for path in sorted(documented - served):
+        problems.append(
+            f"docs reference {path} but operator/serving.py has no handler "
+            f"for it (stale doc or missing DEBUG_ENDPOINTS entry)"
+        )
     return problems
 
 

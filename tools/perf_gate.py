@@ -148,6 +148,11 @@ DEFAULT_BANDS = {
     # re-compile on the shrunken topology, so host-noisy — the band starts
     # wide. The first recovery-carrying run seeds each window.
     "mesh_recovery_s": (LOWER_BETTER, 3.0),
+    # round-25 fleet SLO engine + flight recorder (obs/slo.py, obs/flight.py):
+    # the ON/OFF supervised-solve median ratio at 2,500 pods (bench.py
+    # slo_overhead scenario). The recorder's contract is near-zero cost —
+    # this band is a tight absolute ceiling, not a drift window.
+    "slo_overhead_frac": (LOWER_BETTER, 1.05),
 }
 
 # absolute ceiling for the --smoke tiny-shape solve (steady-state, post
@@ -248,6 +253,11 @@ def row_from_bench(out: dict, label: str = "run") -> dict:
         # (single-device hosts and fault-never-fired runs omit them)
         "mesh_recovery_s": out.get("mesh_recovery_s"),
         "mesh_recovery_recarves": out.get("mesh_recovery_recarves"),
+        # schema v2, round 25: fleet SLO engine + flight recorder columns —
+        # present only when the bench slo_overhead A/B completed (bench.py
+        # slo_overhead event; errored scenarios omit them)
+        "slo_overhead_frac": out.get("slo_overhead_frac"),
+        "slo_flight_events": out.get("slo_flight_events"),
         "error": out.get("error"),
     }
     row.update({k: v for k, v in optional.items() if v is not None})
@@ -459,8 +469,82 @@ def smoke(baseline_path=DEFAULT_BASELINE) -> list:
         # no dropped pods. Multi-device hosts only (under tests the conftest
         # forces 8 emulated CPU devices; a bare single-device run skips).
         problems += _smoke_mesh_recovery(fleet, its, tpl)
+
+        # (6) forced SLO breach drill (round 25): one bad gate event must
+        # flip the min_events=1 gate-integrity objective to breach and
+        # produce EXACTLY ONE classified flight dump — a second capture
+        # attempt inside the debounce window must be suppressed, not stack
+        # a dump per breach-side event.
+        problems += _smoke_slo_breach()
     finally:
         programs.set_enabled(None)
+    return problems
+
+
+def _smoke_slo_breach() -> list:
+    """Forced gate-integrity breach through the real engine + recorder (see
+    smoke()): breach fires, the dump is crash-consistent and classified,
+    and the debounce holds the dump count at one."""
+    import os
+    import tempfile
+
+    from karpenter_tpu.obs import flight, slo
+
+    problems = []
+    saved_dir = os.environ.get("KARPENTER_TPU_FLIGHT_DIR")
+    os.environ["KARPENTER_TPU_FLIGHT_DIR"] = tempfile.mkdtemp(
+        prefix="perf-gate-flight-"
+    )
+    slo.set_enabled(True)
+    flight.set_enabled(True)
+    try:
+        slo.reset()
+        flight.reset()
+        flight.record(flight.KIND_GATE_AUDIT, outcome="mismatch")
+        slo.on_gate(False)
+        breached = slo.engine().breached()
+        if breached != ["gate-integrity"]:
+            problems.append(
+                f"slo smoke: forced gate failure breached {breached!r} "
+                f"(want exactly ['gate-integrity'])"
+            )
+        if flight.snapshot_dump("manual") is not None:
+            problems.append(
+                "slo smoke: second dump inside the debounce window was "
+                "not suppressed"
+            )
+        dumps = flight.scan_dumps()
+        if len(dumps) != 1:
+            problems.append(
+                f"slo smoke: expected exactly one flight dump, "
+                f"got {len(dumps)}"
+            )
+        else:
+            try:
+                body = flight.load_dump(dumps[0])
+            except Exception as exc:
+                problems.append(f"slo smoke: breach dump unloadable: {exc!r}")
+            else:
+                if body.get("reason") != "slo-breach":
+                    problems.append(
+                        f"slo smoke: dump reason {body.get('reason')!r} "
+                        f"(want 'slo-breach')"
+                    )
+                kinds = {e.get("kind") for e in body.get("events", [])}
+                if not {"gate-audit", "slo-breach"} <= kinds:
+                    problems.append(
+                        f"slo smoke: dump missing the breach chain "
+                        f"(kinds={sorted(kinds)})"
+                    )
+    finally:
+        slo.set_enabled(None)
+        flight.set_enabled(None)
+        slo.reset()
+        flight.reset()
+        if saved_dir is None:
+            os.environ.pop("KARPENTER_TPU_FLIGHT_DIR", None)
+        else:
+            os.environ["KARPENTER_TPU_FLIGHT_DIR"] = saved_dir
     return problems
 
 
